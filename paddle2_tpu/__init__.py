@@ -1,0 +1,64 @@
+"""paddle2_tpu — a TPU-native deep learning framework.
+
+Capability surface of the reference (waliwali777/Paddle2, a PaddlePaddle
+snapshot — see SURVEY.md) rebuilt idiomatically on the TPU stack: JAX/XLA via
+PJRT for compute, GSPMD mesh sharding + shard_map collectives for hybrid
+parallelism, Pallas for custom kernels. Import as::
+
+    import paddle2_tpu as paddle
+
+and the familiar API (paddle.to_tensor, paddle.nn.Layer, paddle.optimizer.AdamW,
+paddle.distributed.fleet, ...) is available, executing on TPU.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# framework core
+from .framework import (  # noqa: F401
+    CPUPlace, CUDAPlace, CustomPlace, Place, TPUPlace,
+    Tensor, Parameter, to_tensor,
+    bool_ as bool,  # noqa: A001 — paddle exposes paddle.bool
+    uint8, int8, int16, int32, int64, float16, bfloat16, float32, float64,
+    complex64, complex128,
+    get_default_dtype, set_default_dtype, seed,
+    no_grad, enable_grad, set_grad_enabled, is_grad_enabled,
+    get_rng_state, set_rng_state,
+    is_compiled_with_cuda, is_compiled_with_tpu, synchronize,
+)
+from .framework.core import set_device, get_device, device_count  # noqa: F401
+from .flags import set_flags, get_flags, define_flag  # noqa: F401
+
+# ops → top-level namespace (paddle.matmul, paddle.reshape, ...)
+from .ops import *  # noqa: F401,F403
+from .ops import dispatch as _dispatch  # noqa: F401
+from .ops.logic import is_tensor  # noqa: F401
+
+from . import autograd  # noqa: F401
+from .autograd import grad  # noqa: F401
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from . import device  # noqa: F401
+from .framework import io_state as _io_state  # noqa: F401
+from .framework.io_state import save, load  # noqa: F401
+
+# lazy-ish heavy subsystems
+from . import distributed  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from . import incubate  # noqa: F401
+from . import static  # noqa: F401
+from . import profiler  # noqa: F401
+
+disable_static = lambda place=None: None  # dygraph is the default & only eager mode
+enable_static = lambda: None  # static graphs are served by jit.to_static
+
+def in_dynamic_mode() -> bool:
+    return True

@@ -1,0 +1,96 @@
+"""PyLayer: user-defined forward/backward (python/paddle/autograd/py_layer.py:36).
+
+The reference implements custom autograd nodes in C++ (eager/pylayer/); here a
+PyLayer plugs a user backward straight into the tape as a GradNode whose vjp is
+the user's `backward` staticmethod.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax.numpy as jnp
+
+from ..framework import core
+from .tape import GradNode
+
+
+def _tensor_cls():
+    from ..framework.tensor import Tensor
+    return Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: Tuple = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    # paddle also exposes arbitrary attribute stashing on ctx
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        self._non_diff = args
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        Tensor = _tensor_cls()
+        ctx = PyLayerContext()
+        with core.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = (core.is_grad_enabled()
+                      and any(not t.stop_gradient for t in tensor_inputs))
+        if needs_grad:
+            def vjp(cts):
+                if not isinstance(cts, tuple):
+                    cts = (cts,)
+                grads = cls.backward(
+                    ctx, *[Tensor(c, stop_gradient=True) for c in cts])
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                out_grads: List[Any] = []
+                gi = 0
+                for a in args:
+                    if isinstance(a, Tensor):
+                        g = grads[gi] if gi < len(grads) else None
+                        gi += 1
+                        out_grads.append(
+                            None if g is None else
+                            (g._data if isinstance(g, Tensor) else g))
+                return tuple(out_grads)
+
+            avals = [(tuple(o.shape), o.dtype) for o in out_list]
+            node = GradNode(cls.__name__, vjp, tensor_inputs, avals)
+            for i, o in enumerate(out_list):
+                o.stop_gradient = False
+                o._grad_node = node
+                o._output_index = i
+        return outs

@@ -1,0 +1,218 @@
+"""Dygraph autograd engine: a tape of VJP nodes over JAX ops.
+
+TPU-native redesign of the reference's eager autograd
+(``paddle/fluid/eager/grad_node_info.h:197`` GradNodeBase + Edges,
+``paddle/fluid/eager/backward.cc:105`` RunBackward with in-degree topo order).
+Instead of per-op handwritten CUDA grad kernels, every eager op records a JAX
+VJP closure (``jax.vjp`` over the op's pure function); backward() walks the node
+DAG in reverse-topological order and lets JAX/XLA compute each node's cotangents.
+Under ``jit.to_static`` tracing the tape is bypassed entirely — gradients come
+from ``jax.grad`` over the functional program, which is the TPU-fast path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..flags import flag_value
+
+
+class GradNode:
+    """One recorded op: maps output cotangents to input cotangents.
+
+    Mirrors GradNodeBase (grad_node_info.h:197): `inputs` are the forward input
+    tensors (edges to producer nodes), `out_avals` the shapes/dtypes of forward
+    outputs (to materialize zero cotangents for unused outputs), `vjp_fn` the
+    JAX-linearized backward. Holding strong refs to input tensors keeps the
+    graph alive from the outputs, like TensorWrapper does in the reference.
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "out_is_tuple",
+                 "__weakref__")
+
+    def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any],
+                 out_avals: Sequence[Tuple[Tuple[int, ...], Any]],
+                 out_is_tuple: bool = False):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)   # Tensor objects (leaf or intermediate)
+        self.out_avals = list(out_avals)
+        # whether the forward fn returned a tuple (the vjp_fn expects the
+        # cotangent pytree to match — a 1-tuple is NOT a bare array)
+        self.out_is_tuple = out_is_tuple
+
+    def apply(self, cotangents: List[Optional[jnp.ndarray]]) -> Tuple:
+        full = []
+        for ct, (shape, dtype) in zip(cotangents, self.out_avals):
+            if ct is None:
+                ct = jnp.zeros(shape, dtype)
+            full.append(ct)
+        out = self.vjp_fn(tuple(full) if self.out_is_tuple else full[0])
+        if not isinstance(out, tuple):
+            out = (out,)
+        return out
+
+
+_engine_tls = threading.local()
+
+
+def _check_nan_inf(name: str, arrays: Sequence[jnp.ndarray]) -> None:
+    for a in arrays:
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            if bool(jnp.any(~jnp.isfinite(a))):
+                raise FloatingPointError(
+                    f"nan/inf detected in gradient of op '{name}' "
+                    f"(FLAGS_check_nan_inf is enabled)")
+
+
+def run_backward(tensors: Sequence[Any], grad_tensors: Sequence[Optional[Any]],
+                 retain_graph: bool = False) -> None:
+    """Reverse-topological execution over the GradNode DAG.
+
+    Same structure as RunBackward (backward.cc:105): build an in-degree map
+    from the root set, then drain a ready queue, accumulating per-node output
+    cotangents until all consumers have reported.
+    """
+    from ..framework.tensor import Tensor  # cycle: tensor imports tape
+
+    # --- seed cotangents ------------------------------------------------
+    node_cts: Dict[int, List[Optional[jnp.ndarray]]] = {}
+    node_by_id: Dict[int, GradNode] = {}
+    roots: List[GradNode] = []
+
+    def seed(node: GradNode, idx: int, ct: jnp.ndarray):
+        nid = id(node)
+        if nid not in node_cts:
+            node_cts[nid] = [None] * len(node.out_avals)
+            node_by_id[nid] = node
+            roots.append(node)
+        cur = node_cts[nid][idx]
+        node_cts[nid][idx] = ct if cur is None else cur + ct
+
+    for t, g in zip(tensors, grad_tensors):
+        if t._grad_node is None:
+            if not t.stop_gradient:
+                gt = g._data if g is not None else jnp.ones(t.shape, t.dtype)
+                t._accumulate_grad(gt)
+            continue
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {tuple(t.shape)}")
+            g_arr = jnp.ones(t.shape, t.dtype)
+        else:
+            g_arr = g._data
+        seed(t._grad_node, t._output_index, g_arr)
+
+    # --- in-degree pass (number of pending consumer contributions) -------
+    indeg: Dict[int, int] = {}
+    visited: Dict[int, GradNode] = {}
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        nid = id(node)
+        if nid in visited:
+            continue
+        visited[nid] = node
+        for inp in node.inputs:
+            pnode = inp._grad_node
+            if pnode is not None:
+                pid = id(pnode)
+                indeg[pid] = indeg.get(pid, 0) + 1
+                if pid not in visited:
+                    stack.append(pnode)
+
+    # --- ready-queue execution ------------------------------------------
+    # A node runs only when every consumer in the visited subgraph has
+    # contributed (indeg == 0) — a seeded root that is also an interior node
+    # must wait for its consumers (backward.cc:105 semantics).
+    ready = [n for n in visited.values() if indeg.get(id(n), 0) == 0]
+    processed = set()
+    while ready:
+        node = ready.pop()
+        nid = id(node)
+        if nid in processed:
+            continue
+        processed.add(nid)
+        cts = node_cts.pop(nid, None)
+        if cts is None or all(c is None for c in cts):
+            in_grads: Tuple = tuple(None for _ in node.inputs)
+        else:
+            in_grads = node.apply(cts)
+            if flag_value("check_nan_inf"):
+                _check_nan_inf(node.name, [g for g in in_grads if g is not None])
+
+        for inp, g in zip(node.inputs, in_grads):
+            pnode = inp._grad_node
+            if pnode is not None:
+                pid = id(pnode)
+                if g is not None:
+                    g = inp._apply_grad_hooks(g)
+                    if pid not in node_cts:
+                        node_cts[pid] = [None] * len(pnode.out_avals)
+                        node_by_id[pid] = pnode
+                    cur = node_cts[pid][inp._output_index]
+                    node_cts[pid][inp._output_index] = (
+                        g if cur is None else cur + g)
+                indeg[pid] -= 1
+                if indeg[pid] == 0:
+                    ready.append(pnode)
+            elif g is not None and not inp.stop_gradient:
+                g = inp._apply_grad_hooks(g)
+                inp._accumulate_grad(g)
+
+        if not retain_graph:
+            node.vjp_fn = None  # free linearization residuals
+            node.inputs = []
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad parity (autograd/backward_mode.py): grads of outputs w.r.t.
+    inputs without touching .grad on leaves.
+
+    Implemented by running the tape backward with temporary accumulation
+    targets. `create_graph` (double grad) is served by the functional path:
+    recompute through jax.grad is recommended; the tape supports first order.
+    """
+    from ..framework.tensor import Tensor
+
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle2_tpu.incubate.autograd (functional "
+            "jax.grad composition) for higher-order derivatives")
+
+    # Temporarily capture accumulation on the requested inputs.
+    captured: Dict[int, Any] = {}
+    saved = [(t, t.grad, t.stop_gradient) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t.stop_gradient = False
+    try:
+        run_backward(outputs, grad_outputs, retain_graph=bool(retain_graph))
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "one of the input tensors receives no gradient "
+                        "(pass allow_unused=True to return None for it)")
+                results.append(None)
+            else:
+                results.append(t.grad)
+        return results
+    finally:
+        for t, g, sg in saved:
+            t.grad, t.stop_gradient = g, sg

@@ -1,0 +1,97 @@
+"""Global flag registry.
+
+TPU-native analog of the reference's gflags-style global flag system
+(``paddle/common/flags.cc`` — 184 ``PHI_DEFINE_EXPORTED_*`` entries, readable and
+writable from Python via ``paddle.set_flags``/``get_flags``,
+``python/paddle/base/framework.py:132``). Flags are env-overridable with the
+``FLAGS_`` prefix, typed, and registered at import time by the subsystems that
+consume them.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "type", "help", "on_change")
+
+    def __init__(self, name: str, default: Any, help_str: str,
+                 type_: type, on_change: Optional[Callable[[Any], None]] = None):
+        self.name = name
+        self.default = default
+        self.type = type_
+        self.help = help_str
+        self.on_change = on_change
+        self.value = default
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+_LOCK = threading.RLock()
+
+
+def _coerce(flag: _Flag, value: Any) -> Any:
+    if flag.type is bool and isinstance(value, str):
+        return value.lower() in ("1", "true", "yes", "on")
+    return flag.type(value)
+
+
+def define_flag(name: str, default: Any, help_str: str = "",
+                on_change: Optional[Callable[[Any], None]] = None) -> None:
+    """Register a flag. Environment ``FLAGS_<name>`` overrides the default."""
+    with _LOCK:
+        if name in _REGISTRY:
+            return
+        flag = _Flag(name, default, help_str, type(default), on_change)
+        env = os.environ.get("FLAGS_" + name)
+        if env is not None:
+            flag.value = _coerce(flag, env)
+        _REGISTRY[name] = flag
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Set one or more registered flags (``paddle.set_flags`` parity)."""
+    with _LOCK:
+        for name, value in flags.items():
+            key = name[6:] if name.startswith("FLAGS_") else name
+            if key not in _REGISTRY:
+                raise ValueError(f"unknown flag {name!r}")
+            flag = _REGISTRY[key]
+            flag.value = _coerce(flag, value)
+            if flag.on_change is not None:
+                flag.on_change(flag.value)
+
+
+def get_flags(flags: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
+    """Read registered flags (``paddle.get_flags`` parity)."""
+    with _LOCK:
+        if flags is None:
+            names: List[str] = list(_REGISTRY)
+        elif isinstance(flags, str):
+            names = [flags]
+        else:
+            names = list(flags)
+        out = {}
+        for name in names:
+            key = name[6:] if name.startswith("FLAGS_") else name
+            if key not in _REGISTRY:
+                raise ValueError(f"unknown flag {name!r}")
+            out["FLAGS_" + key] = _REGISTRY[key].value
+        return out
+
+
+def flag_value(name: str) -> Any:
+    """Fast internal read of a single flag value."""
+    return _REGISTRY[name].value
+
+
+# Core flags (subsystem-specific flags are defined where they are used).
+define_flag("check_nan_inf", False,
+            "Per-op nan/inf checking in eager mode (nan_inf_utils parity).")
+define_flag("enable_api_kernel_fallback", True,
+            "Fall back to CPU execution when an op has no device lowering.")
+define_flag("eager_vjp_cache", True,
+            "Cache per-op linearized VJP computations keyed on shapes/dtypes.")
+define_flag("log_level", 0, "Framework verbosity (VLOG-style).")

@@ -1,0 +1,74 @@
+"""Global RNG state.
+
+The reference seeds per-device generators (``paddle.seed``). On TPU the idiomatic
+form is a functional PRNG key; this module bridges the two: an imperative global
+key that is split on every consumption, plus a scoped override so traced code
+(``jit.to_static``) consumes keys threaded through the compiled function instead
+of baking a constant into the executable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_global_key = None
+_traced = threading.local()
+
+
+def seed(value: int):
+    """paddle.seed parity: reset the global generator."""
+    global _global_key
+    with _lock:
+        _global_key = jax.random.PRNGKey(value)
+    return value
+
+
+def _ensure_key():
+    global _global_key
+    if _global_key is None:
+        _global_key = jax.random.PRNGKey(0)
+    return _global_key
+
+
+def next_key():
+    """Split one subkey off the active generator.
+
+    Inside a `scoped_rng` region (the jit.to_static functional bridge) the key
+    comes from the traced state so randomness is a function input, not a
+    compile-time constant.
+    """
+    holder = getattr(_traced, "holder", None)
+    if holder is not None:
+        holder[0], sub = jax.random.split(holder[0])
+        return sub
+    global _global_key
+    with _lock:
+        key = _ensure_key()
+        _global_key, sub = jax.random.split(key)
+        return sub
+
+
+@contextlib.contextmanager
+def scoped_rng(key):
+    """Route next_key() through `key` (a traced PRNGKey) for the duration."""
+    prev = getattr(_traced, "holder", None)
+    _traced.holder = [key]
+    try:
+        yield _traced.holder
+    finally:
+        _traced.holder = prev
+
+
+def get_rng_state():
+    with _lock:
+        return _ensure_key()
+
+
+def set_rng_state(state):
+    global _global_key
+    with _lock:
+        _global_key = state

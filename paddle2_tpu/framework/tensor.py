@@ -1,0 +1,240 @@
+"""Eager Tensor: a jax.Array plus autograd metadata.
+
+TPU-native redesign of the reference's eager Tensor
+(``paddle/fluid/pybind/eager_method.cc`` methods/properties over a phi
+DenseTensor). The payload is a ``jax.Array`` living in HBM via PJRT; autograd
+metadata (stop_gradient / grad / producer GradNode) mirrors AutogradMeta.
+Tensor methods are mostly monkey-patched in by ``paddle2_tpu.ops`` the same way
+``eager_math_op_patch.cc`` patches operators onto the pybind class.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import core
+from ..autograd import tape
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_grad_node", "_output_index",
+                 "name", "persistable", "_hooks", "trainable", "__weakref__")
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient: bool = True,
+                 name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            self._data = data._data
+        elif isinstance(data, jnp.ndarray) or _is_tracer(data):
+            self._data = data if dtype is None else data.astype(
+                core.convert_dtype(dtype))
+        else:
+            self._data = core.to_jax_array(data, dtype, place)
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._grad_node: Optional[tape.GradNode] = None
+        self._output_index = 0
+        self.name = name or ""
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._hooks: Optional[List] = None
+
+    # -- properties -----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        return core.current_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        from .. import ops
+        return ops.manipulation.t(self)
+
+    # -- conversion -----------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._data.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd -------------------------------------------------------
+    def backward(self, grad_tensor: Optional["Tensor"] = None,
+                 retain_graph: bool = False) -> None:
+        tape.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def _accumulate_grad(self, g) -> None:
+        if self.grad is None:
+            self.grad = Tensor(g, stop_gradient=True)
+        else:
+            self.grad = Tensor(self.grad._data + g, stop_gradient=True)
+
+    def _apply_grad_hooks(self, g):
+        if self._hooks:
+            for h in self._hooks:
+                out = h(Tensor(g, stop_gradient=True))
+                if out is not None:
+                    g = out._data if isinstance(out, Tensor) else out
+        return g
+
+    def register_hook(self, hook):
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+        hooks = self._hooks
+        class _Removable:
+            def remove(self_inner):
+                if hook in hooks:
+                    hooks.remove(hook)
+        return _Removable()
+
+    def clear_grad(self) -> None:
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False) -> None:
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data), stop_gradient=True)
+        else:
+            self.grad = None
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._data, stop_gradient=True, name=self.name)
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..ops.dispatch import apply_op
+        return apply_op("clone", lambda x: x + 0, (self,), {})
+
+    # -- in-place value mutation (optimizer updates, set_value) ----------
+    def _replace_data(self, new_data) -> None:
+        self._data = new_data
+
+    def set_value(self, value) -> None:
+        if isinstance(value, Tensor):
+            new = value._data.astype(self._data.dtype)
+        else:
+            new = core.to_jax_array(np.asarray(value), self._data.dtype)
+        if tuple(new.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {tuple(new.shape)} vs "
+                f"{tuple(self._data.shape)}")
+        self._data = new
+
+    def copy_(self, other: "Tensor") -> "Tensor":
+        self.set_value(other)
+        return self
+
+    # -- misc -----------------------------------------------------------
+    def pin_memory(self):
+        return self
+
+    def cpu(self):
+        arr = jax.device_put(self._data, jax.devices("cpu")[0])
+        t = Tensor(arr, stop_gradient=self.stop_gradient)
+        return t
+
+    def to(self, *args, **kwargs):
+        from ..ops.dispatch import apply_op
+        device = kwargs.pop("device", None)
+        dtype = kwargs.pop("dtype", None)
+        for a in args:
+            if isinstance(a, str) and (":" in a or a in ("cpu", "tpu", "gpu")):
+                device = a
+            else:
+                dtype = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            place = core.set_device(device) if False else None  # no global switch
+            name, _, idx = device.partition(":")
+            p = core.CPUPlace(int(idx or 0)) if name == "cpu" else core.TPUPlace(int(idx or 0))
+            out = Tensor(jax.device_put(out._data, p.jax_device()),
+                         stop_gradient=out.stop_gradient)
+        return out
+
+    def astype(self, dtype) -> "Tensor":
+        from ..ops.dispatch import apply_op
+        dt = core.convert_dtype(dtype)
+        return apply_op("cast", lambda x: x.astype(dt), (self,), {})
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def __repr__(self):
+        grad_str = "" if self.stop_gradient else ", stop_gradient=False"
+        if _is_tracer(self._data):
+            return f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_str}, <traced>)"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_str},\n"
+                f"       {np.array2string(self.numpy(), prefix='       ')})")
+
+    # Indexing / math dunders are patched in by paddle2_tpu.ops (monkey-patch
+    # mirror of eager_math_op_patch.cc). Placeholders raise until ops import.
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor parity."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (python/paddle/base/framework.py Parameter parity)."""
+
+    def __init__(self, data, dtype=None, name: Optional[str] = None,
+                 trainable: bool = True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable

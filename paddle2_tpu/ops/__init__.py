@@ -1,0 +1,134 @@
+"""Op library assembly + Tensor method patching.
+
+Mirrors the reference's split: tensor function namespaces
+(python/paddle/tensor/{math,linalg,manipulation,creation,logic,search,random}.py)
+plus the operator/method patch that the reference does in C++
+(paddle/fluid/pybind/eager_math_op_patch.cc and eager_method.cc).
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import creation, linalg, logic, manipulation, math, random
+from .dispatch import apply_op, ensure_tensor, rebind_inplace
+from ..framework.tensor import Tensor
+
+# re-export everything into paddle2_tpu.ops namespace
+from .math import *          # noqa: F401,F403
+from .creation import *      # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *        # noqa: F401,F403
+from .logic import *         # noqa: F401,F403
+from .random import *        # noqa: F401,F403
+
+
+# ---------------------------------------------------------------------------
+# Tensor indexing
+# ---------------------------------------------------------------------------
+
+def _convert_index(item):
+    """Convert Tensors inside an index expression to jax arrays."""
+    if isinstance(item, tuple):
+        return tuple(_convert_index(i) for i in item)
+    if isinstance(item, Tensor):
+        return item._data
+    if isinstance(item, (list, np.ndarray)):
+        return jnp.asarray(item)
+    return item
+
+
+def _getitem(self, item):
+    idx = _convert_index(item)
+    # bool-mask indexing has data-dependent shape: resolve eagerly via numpy
+    def has_bool(x):
+        if isinstance(x, tuple):
+            return builtins.any(has_bool(i) for i in x)
+        return (hasattr(x, "dtype") and jnp.issubdtype(jnp.result_type(x), jnp.bool_)
+                and getattr(x, "ndim", 0) > 0)
+    if has_bool(idx) and not isinstance(self._data, jax.core.Tracer):
+        np_idx = jax.tree_util.tree_map(np.asarray, idx) if isinstance(idx, tuple) \
+            else np.asarray(idx)
+        return Tensor(jnp.asarray(np.asarray(self._data)[np_idx]))
+    return apply_op("getitem", lambda a: a[idx], (self,), {})
+
+
+def _setitem(self, item, value):
+    idx = _convert_index(item)
+    if isinstance(value, Tensor):
+        out = apply_op("setitem",
+                       lambda a, v: a.at[idx].set(v.astype(a.dtype)),
+                       (self, value), {})
+    else:
+        out = apply_op("setitem", lambda a: a.at[idx].set(value), (self,), {})
+    return rebind_inplace(self, out)
+
+
+# ---------------------------------------------------------------------------
+# operator overloads
+# ---------------------------------------------------------------------------
+
+def _patch():
+    T = Tensor
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    T.__add__ = lambda s, o: math.add(s, o)
+    T.__radd__ = lambda s, o: math.add(o, s)
+    T.__sub__ = lambda s, o: math.subtract(s, o)
+    T.__rsub__ = lambda s, o: math.subtract(o, s)
+    T.__mul__ = lambda s, o: math.multiply(s, o)
+    T.__rmul__ = lambda s, o: math.multiply(o, s)
+    T.__truediv__ = lambda s, o: math.divide(s, o)
+    T.__rtruediv__ = lambda s, o: math.divide(o, s)
+    T.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    T.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+    T.__mod__ = lambda s, o: math.remainder(s, o)
+    T.__pow__ = lambda s, o: math.pow(s, o)
+    T.__rpow__ = lambda s, o: math.pow(o, s)
+    T.__neg__ = lambda s: math.neg(s)
+    T.__abs__ = lambda s: math.abs(s)
+    T.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: linalg.matmul(o, s)
+    T.__eq__ = lambda s, o: logic.equal(s, o)
+    T.__ne__ = lambda s, o: logic.not_equal(s, o)
+    T.__lt__ = lambda s, o: logic.less_than(s, o)
+    T.__le__ = lambda s, o: logic.less_equal(s, o)
+    T.__gt__ = lambda s, o: logic.greater_than(s, o)
+    T.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    T.__and__ = lambda s, o: math.bitwise_and(s, o)
+    T.__or__ = lambda s, o: math.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: math.bitwise_xor(s, o)
+    T.__invert__ = lambda s: math.bitwise_not(s)
+
+    # method forms — mirror paddle Tensor methods
+    _method_sources = [math, creation, manipulation, linalg, logic, random]
+    skip = {"to_tensor", "as_tensor", "pow"}
+    for mod in _method_sources:
+        for name in getattr(mod, "__all__", []):
+            if name in skip or hasattr(T, name):
+                continue
+            fn = getattr(mod, name)
+            if callable(fn):
+                setattr(T, name, fn)
+    # names that collide with @property or builtins get explicit treatment
+    T.pow = lambda s, y, name=None: math.pow(s, y)
+    T.add_ = lambda s, o: s.copy_(math.add(s, o))
+    T.sub_ = lambda s, o: s.copy_(math.subtract(s, o))
+    T.subtract_ = T.sub_
+    T.multiply_ = lambda s, o: s.copy_(math.multiply(s, o))
+    T.scale_ = lambda s, *a, **k: s.copy_(math.scale(s, *a, **k))
+    T.clip_ = lambda s, *a, **k: s.copy_(math.clip(s, *a, **k))
+    T.zero_ = lambda s: s.copy_(creation.zeros_like(s))
+    T.fill_ = lambda s, v: s.copy_(creation.full_like(s, v))
+    T.mean_all = lambda s: math.mean(s)
+    T.dim = lambda s: s.ndim
+    T.numel_ = T.numel if hasattr(T, "numel") else None
+
+
+_patch()
+del _patch

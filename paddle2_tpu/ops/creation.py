@@ -1,0 +1,210 @@
+"""Tensor creation ops (python/paddle/tensor/creation.py parity)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dispatch import apply_op, ensure_tensor
+from ..framework import core
+from ..framework.tensor import Tensor, to_tensor  # re-export to_tensor
+
+__all__ = ["to_tensor", "zeros", "ones", "full", "empty", "zeros_like",
+           "ones_like", "full_like", "empty_like", "arange", "linspace",
+           "logspace", "eye", "meshgrid", "diag", "diagflat", "diag_embed",
+           "tril", "triu", "tril_indices", "triu_indices", "assign", "clone",
+           "complex", "polar", "as_tensor"]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) if not isinstance(s, Tensor) else int(s.item())
+                 for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = core.convert_dtype(dtype)
+    return d if d is not None else (default or core.get_default_dtype())
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = (core.bool_ if isinstance(fill_value, bool)
+                 else core.int64 if isinstance(fill_value, int)
+                 else core.get_default_dtype())
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jnp.zeros_like(x._data, dtype=core.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jnp.ones_like(x._data, dtype=core.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jnp.full_like(x._data, fill_value,
+                                dtype=core.convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (core.int64 if all(isinstance(v, int) for v in (start, end, step))
+                 else core.get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)), base=_v(base),
+                               dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_dt(dtype)))
+
+
+def meshgrid(*args, **kwargs) -> List[Tensor]:
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    ts = [ensure_tensor(a) for a in args]
+    outs = apply_op("meshgrid", lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")),
+                    tuple(ts), {})
+    return list(outs)
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    def fn(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a), k=offset)
+                out = out + (1 - mask) * padding_value
+            return out.astype(a.dtype)
+        return jnp.diag(a, k=offset)
+    return apply_op("diag", fn, (x,), {})
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("diagflat", lambda a: jnp.diagflat(a, k=offset), (x,), {})
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None) -> Tensor:
+    input = ensure_tensor(input)
+    def fn(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        # move the two new axes to dim1/dim2
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            perm = [i for i in range(nd - 2)]
+            order = list(range(nd - 2))
+            # insert axes
+            axes = sorted([(d1, nd - 2), (d2, nd - 1)])
+            for pos, src in axes:
+                order.insert(pos, src)
+            out = jnp.transpose(out, order)
+        return out
+    return apply_op("diag_embed", fn, (input,), {})
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("tril", lambda a: jnp.tril(a, k=diagonal), (x,), {})
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("triu", lambda a: jnp.triu(a, k=diagonal), (x,), {})
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None) -> Tensor:
+    col = col if col is not None else row
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=core.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None) -> Tensor:
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=core.convert_dtype(dtype)))
+
+
+def assign(x, output: Optional[Tensor] = None) -> Tensor:
+    x = ensure_tensor(x)
+    out = apply_op("assign", lambda a: a + 0, (x,), {})
+    if output is not None:
+        output._replace_data(out._data)
+        return output
+    return out
+
+
+def clone(x, name=None) -> Tensor:
+    return ensure_tensor(x).clone()
+
+
+def complex(real, imag, name=None) -> Tensor:
+    real, imag = ensure_tensor(real), ensure_tensor(imag)
+    return apply_op("complex", jax.lax.complex, (real, imag), {})
+
+
+def polar(abs, angle, name=None) -> Tensor:
+    abs, angle = ensure_tensor(abs), ensure_tensor(angle)
+    return apply_op("polar",
+                    lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+                    (abs, angle), {})
+
+
+def as_tensor(data, dtype=None, place=None) -> Tensor:
+    return data if isinstance(data, Tensor) and dtype is None else to_tensor(
+        data, dtype=dtype, place=place)

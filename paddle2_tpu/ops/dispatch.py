@@ -1,0 +1,140 @@
+"""Eager op dispatch: run a pure JAX function over Tensors, recording the tape.
+
+Plays the role of the reference's generated ``*_ad_func`` chain
+(``eager_gen.py`` output: AMP cast → create GradNode → phi kernel call,
+SURVEY.md §3.1). Here the "kernel" is a pure JAX function (XLA-compiled and
+cached by shape under the hood) and the GradNode's backward is the JAX VJP of
+that same function — one definition serves forward, backward, and the
+jit.to_static trace path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import core
+from ..framework.tensor import Tensor
+from ..autograd.tape import GradNode
+
+_OP_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_op(name: str, fn: Callable) -> None:
+    _OP_REGISTRY[name] = fn
+
+
+def get_op(name: str) -> Callable:
+    return _OP_REGISTRY[name]
+
+
+def _wrap_outputs(name, out, requires_grad, node_builder):
+    """Wrap raw jax output(s) into Tensor(s), attaching the grad node."""
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    node = node_builder(outs) if requires_grad else None
+    tensors = []
+    for i, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=not requires_grad)
+        if node is not None:
+            t._grad_node = node
+            t._output_index = i
+        tensors.append(t)
+    if multi:
+        return type(out)(tensors) if isinstance(out, tuple) else tensors
+    return tensors[0]
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
+
+
+def apply_op(name: str, fn: Callable, tensors: Sequence[Tensor],
+             kwargs: Dict[str, Any], differentiable: bool = True):
+    """Execute `fn(*arrays, **kwargs)` over the payloads of `tensors`.
+
+    When the tape is active and any float input requires grad, linearize with
+    jax.vjp and record a GradNode; otherwise run the function directly (XLA's
+    jit-by-default primitive cache makes this the cheap path).
+    """
+    arrays = tuple(t._data for t in tensors)
+    needs_grad = (differentiable
+                  and core.is_grad_enabled()
+                  and any(not t.stop_gradient and _is_float(t._data)
+                          for t in tensors))
+    if not needs_grad:
+        out = fn(*arrays, **kwargs) if kwargs else fn(*arrays)
+        return _wrap_outputs(name, out, False, None)
+
+    closed = (lambda *xs: fn(*xs, **kwargs)) if kwargs else fn
+    out, vjp_fn = jax.vjp(closed, *arrays)
+
+    def node_builder(outs):
+        inputs = list(tensors)
+
+        def run_vjp(cts):
+            raw = vjp_fn(cts)
+            # jax returns float0 for non-differentiable (integer) inputs;
+            # normalize those to None so the tape skips them.
+            return tuple(
+                None if (g is None or g.dtype == jax.dtypes.float0) else g
+                for g in raw)
+
+        avals = [(tuple(o.shape), o.dtype) for o in outs]
+        return GradNode(name, run_vjp, inputs, avals,
+                        out_is_tuple=isinstance(out, (tuple, list)))
+
+    return _wrap_outputs(name, out, True, node_builder)
+
+
+class _ShadowTensor(Tensor):
+    """Pre-inplace-write identity of a tensor: keeps the old grad edge alive
+    while routing leaf accumulation back to the original tensor's .grad."""
+
+    __slots__ = ("_origin",)
+
+    def _accumulate_grad(self, g):
+        self._origin._accumulate_grad(g)
+
+
+def rebind_inplace(x: Tensor, out: Tensor) -> Tensor:
+    """Finish an in-place op: `out = f(x, ...)` replaces x's payload/history.
+
+    The grad node recorded for `out` holds `x` among its inputs; left as-is
+    that becomes a self-edge once x adopts the new node (deadlocking the
+    backward topo-sort). Swap in a shadow carrying x's OLD autograd identity.
+    """
+    node = out._grad_node
+    if node is not None:
+        shadow = _ShadowTensor.__new__(_ShadowTensor)
+        shadow._data = x._data
+        shadow.stop_gradient = x.stop_gradient
+        shadow.grad = None
+        shadow._grad_node = x._grad_node
+        shadow._output_index = x._output_index
+        shadow.name = x.name
+        shadow.persistable = False
+        shadow.trainable = x.trainable
+        shadow._hooks = x._hooks
+        shadow._origin = x
+        node.inputs = [shadow if t is x else t for t in node.inputs]
+    x._replace_data(out._data)
+    x._grad_node = out._grad_node
+    x._output_index = out._output_index
+    return x
+
+
+def ensure_tensor(x, ref: Tensor = None) -> Tensor:
+    """Coerce python scalars / numpy arrays to Tensor (binary-op promotion)."""
+    if isinstance(x, Tensor):
+        return x
+    dtype = None
+    if ref is not None and isinstance(x, (int, float)) and not isinstance(x, bool):
+        ref_is_float = jnp.issubdtype(ref.dtype, jnp.inexact)
+        if isinstance(x, int) or ref_is_float:
+            dtype = ref.dtype  # follow the tensor's dtype
+        # float scalar with integer tensor: leave dtype None so the result
+        # promotes to float (paddle promotes, never truncates the scalar)
+    return Tensor(core.to_jax_array(x, dtype))

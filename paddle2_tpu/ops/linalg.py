@@ -1,0 +1,301 @@
+"""Linear algebra ops (python/paddle/tensor/linalg.py:191 matmul etc.).
+
+matmul is THE MXU op: keep operands batched and let XLA tile onto the
+systolic array. All decompositions ride jax.numpy.linalg (lowered to
+XLA custom calls / QR-based routines on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dispatch import apply_op, ensure_tensor
+from ..framework import core
+from ..framework.tensor import Tensor
+
+__all__ = ["matmul", "bmm", "mm", "mv", "dot", "norm", "dist", "cond",
+           "cholesky", "cholesky_solve", "qr", "svd", "pca_lowrank", "inv",
+           "pinv", "det", "slogdet", "solve", "triangular_solve", "lstsq",
+           "eig", "eigh", "eigvals", "eigvalsh", "matrix_power", "matrix_rank",
+           "multi_dot", "corrcoef", "cov", "householder_product", "lu",
+           "lu_unpack", "einsum"]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    def fn(a, b):
+        if transpose_x and a.ndim >= 2:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y and b.ndim >= 2:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+    return apply_op("matmul", fn, (x, y), {})
+
+
+def mm(input, mat2, name=None) -> Tensor:
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None) -> Tensor:
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None) -> Tensor:
+    return matmul(x, vec)
+
+
+def dot(x, y, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("dot", lambda a, b: jnp.sum(a * b, axis=-1), (x, y), {})
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    pval = "fro" if p is None else p
+    def fn(a):
+        if axis is None and (pval == "fro" or pval == 2):
+            return jnp.sqrt(jnp.sum(jnp.square(a)))
+        if pval == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=keepdim))
+        if pval == float("inf"):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if pval == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if pval == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** pval, axis=axis, keepdims=keepdim) ** (1.0 / pval)
+    return apply_op("norm", fn, (x,), {})
+
+
+def dist(x, y, p=2, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    def fn(a, b):
+        d = a - b
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype)).astype(d.dtype)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply_op("dist", fn, (x, y), {})
+
+
+def cond(x, p=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    pv = 2 if p is None else p
+    return apply_op("cond", lambda a: jnp.linalg.cond(a, p=pv), (x,), {})
+
+
+def cholesky(x, upper=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    def fn(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply_op("cholesky", fn, (x,), {})
+
+
+def cholesky_solve(x, y, upper=False, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    def fn(b, l):
+        lo = jnp.swapaxes(l, -1, -2) if upper else l
+        z = jax.scipy.linalg.solve_triangular(lo, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(lo, -1, -2), z, lower=False)
+    return apply_op("cholesky_solve", fn, (x, y), {})
+
+
+def qr(x, mode="reduced", name=None):
+    x = ensure_tensor(x)
+    if mode == "r":
+        return apply_op("qr_r", lambda a: jnp.linalg.qr(a, mode="r"), (x,), {})
+    outs = apply_op("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), (x,), {})
+    return outs[0], outs[1]
+
+
+def svd(x, full_matrices=False, name=None):
+    x = ensure_tensor(x)
+    outs = apply_op(
+        "svd", lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        (x,), {})
+    return outs[0], outs[1], outs[2]
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = ensure_tensor(x)
+    m, n = x.shape[-2], x.shape[-1]
+    qv = q if q is not None else min(6, m, n)
+    def fn(a):
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :qv], s[..., :qv], jnp.swapaxes(vt, -1, -2)[..., :qv]
+    outs = apply_op("pca_lowrank", fn, (x,), {})
+    return outs[0], outs[1], outs[2]
+
+
+def inv(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("inv", jnp.linalg.inv, (x,), {})
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("pinv",
+                    lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+                    (x,), {})
+
+
+def det(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("det", jnp.linalg.det, (x,), {})
+
+
+def slogdet(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    outs = apply_op("slogdet", lambda a: tuple(jnp.linalg.slogdet(a)), (x,), {})
+    # paddle returns stacked [sign, logdet]
+    from .manipulation import stack
+    return stack([outs[0], outs[1]], axis=0)
+
+
+def solve(x, y, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    def fn(a, b):
+        squeeze = b.ndim == a.ndim - 1
+        if squeeze:
+            b = b[..., None]
+        out = jnp.linalg.solve(a, b)
+        return out[..., 0] if squeeze else out
+    return apply_op("solve", fn, (x, y), {})
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op(
+        "triangular_solve",
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular),
+        (x, y), {})
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    outs = apply_op("lstsq",
+                    lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)),
+                    (x, y), {})
+    return tuple(outs)
+
+
+def eig(x, name=None):
+    x = ensure_tensor(x)
+    w, v = np.linalg.eig(np.asarray(x._data))  # complex eig: host LAPACK
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    w = np.linalg.eigvals(np.asarray(x._data))
+    return Tensor(jnp.asarray(w))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    outs = apply_op("eigh",
+                    lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), (x,), {})
+    return outs[0], outs[1]
+
+
+def eigvalsh(x, UPLO="L", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO),
+                    (x,), {})
+
+
+def matrix_power(x, n, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("matrix_power", lambda a: jnp.linalg.matrix_power(a, n),
+                    (x,), {})
+
+
+def matrix_rank(x, tol=None, hermitian=False, atol=None, rtol=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("matrix_rank",
+                    lambda a: jnp.linalg.matrix_rank(a, tol=tol),
+                    (x,), {}, differentiable=False)
+
+
+def multi_dot(x, name=None) -> Tensor:
+    ts = [ensure_tensor(t) for t in x]
+    return apply_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs),
+                    tuple(ts), {})
+
+
+def corrcoef(x, rowvar=True, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), (x,), {})
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    fw = fweights._data if isinstance(fweights, Tensor) else fweights
+    aw = aweights._data if isinstance(aweights, Tensor) else aweights
+    return apply_op("cov",
+                    lambda a: jnp.cov(a, rowvar=rowvar,
+                                      ddof=1 if ddof else 0,
+                                      fweights=fw, aweights=aw),
+                    (x,), {})
+
+
+def householder_product(x, tau, name=None) -> Tensor:
+    x, tau = ensure_tensor(x), ensure_tensor(tau)
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
+        for i in range(t.shape[-1]):
+            v = jnp.concatenate([
+                jnp.zeros(a.shape[:-2] + (i,), a.dtype),
+                jnp.ones(a.shape[:-2] + (1,), a.dtype),
+                a[..., i + 1:, i]], axis=-1)
+            ti = t[..., i:i + 1]
+            h = - ti[..., None] * (v[..., :, None] * v[..., None, :])
+            q = q + jnp.matmul(q, h)
+        return q[..., :, :n]
+    return apply_op("householder_product", fn, (x, tau), {})
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = ensure_tensor(x)
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x._data)
+    outs = (Tensor(lu_mat), Tensor((piv + 1).astype(jnp.int32)))
+    if get_infos:
+        return outs + (Tensor(jnp.zeros((), jnp.int32)),)
+    return outs
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    a, piv = np.asarray(x._data), np.asarray(y._data) - 1
+    m, n = a.shape[-2], a.shape[-1]
+    k = min(m, n)
+    l = np.tril(a[..., :, :k], -1) + np.eye(m, k, dtype=a.dtype)
+    u = np.triu(a[..., :k, :])
+    p = np.eye(m, dtype=a.dtype)
+    for i, pv in enumerate(piv):
+        row = p[i].copy(); p[i] = p[pv]; p[pv] = row
+    return Tensor(jnp.asarray(p.T)), Tensor(jnp.asarray(l)), Tensor(jnp.asarray(u))
+
+
+def einsum(equation, *operands) -> Tensor:
+    ts = [ensure_tensor(o) for o in operands]
+    return apply_op("einsum", lambda *xs: jnp.einsum(equation, *xs),
+                    tuple(ts), {})

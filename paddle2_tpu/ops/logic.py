@@ -1,0 +1,180 @@
+"""Comparison / logic / search ops (python/paddle/tensor/{logic,search}.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dispatch import apply_op, ensure_tensor
+from ..framework import core
+from ..framework.tensor import Tensor
+
+__all__ = ["equal", "not_equal", "greater_than", "greater_equal", "less_than",
+           "less_equal", "equal_all", "allclose", "isclose", "is_empty",
+           "is_tensor", "argmax", "argmin", "topk", "kthvalue", "mode",
+           "searchsorted", "bucketize", "index_fill", "masked_scatter"]
+
+
+def _cmp(name, jfn):
+    def op(x, y, name_arg=None):
+        x = ensure_tensor(x, y if isinstance(y, Tensor) else None)
+        y = ensure_tensor(y, x)
+        return apply_op(name, jfn, (x, y), {}, differentiable=False)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+
+
+def equal_all(x, y, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if tuple(x.shape) != tuple(y.shape):
+        return Tensor(jnp.asarray(False))
+    return apply_op("equal_all", lambda a, b: jnp.all(a == b), (x, y), {},
+                    differentiable=False)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("allclose",
+                    lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                              equal_nan=equal_nan),
+                    (x, y), {}, differentiable=False)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("isclose",
+                    lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan),
+                    (x, y), {}, differentiable=False)
+
+
+def is_empty(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(ensure_tensor(x).size == 0))
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    dt = core.convert_dtype(dtype)
+    def fn(a):
+        out = jnp.argmax(a.reshape(-1) if axis is None else a,
+                         axis=None if axis is None else axis,
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(dt)
+    return apply_op("argmax", fn, (x,), {}, differentiable=False)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    dt = core.convert_dtype(dtype)
+    def fn(a):
+        out = jnp.argmin(a.reshape(-1) if axis is None else a,
+                         axis=None if axis is None else axis,
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(dt)
+    return apply_op("argmin", fn, (x,), {}, differentiable=False)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = -1 if axis is None else axis
+    def fn(a):
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, k)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax))
+    values_indices = apply_op("topk", fn, (x,), {})
+    vals, idx = values_indices
+    idx_t = Tensor(idx._data.astype(jnp.int32))
+    return vals, idx_t
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    def fn(a):
+        srt = jnp.sort(a, axis=axis)
+        sidx = jnp.argsort(a, axis=axis)
+        vals = jnp.take(srt, k - 1, axis=axis)
+        idx = jnp.take(sidx, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+    vals, idx = apply_op("kthvalue", fn, (x,), {})
+    return vals, Tensor(idx._data.astype(jnp.int32))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    a = np.asarray(x._data)
+    moved = np.moveaxis(a, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], a.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        v = uniq[np.argmax(counts)]
+        vals[i] = v
+        idxs[i] = np.where(row == v)[0][-1]
+    shape = moved.shape[:-1]
+    vals = vals.reshape(shape)
+    idxs = idxs.reshape(shape)
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        idxs = np.expand_dims(idxs, axis)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None) -> Tensor:
+    ss, v = ensure_tensor(sorted_sequence), ensure_tensor(values)
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int32
+    def fn(a, b):
+        if a.ndim == 1:
+            return jnp.searchsorted(a, b, side=side).astype(dt)
+        flat_a = a.reshape(-1, a.shape[-1])
+        flat_b = b.reshape(-1, b.shape[-1])
+        out = jnp.stack([jnp.searchsorted(fa, fb, side=side)
+                         for fa, fb in zip(flat_a, flat_b)])
+        return out.reshape(b.shape).astype(dt)
+    return apply_op("searchsorted", fn, (ss, v), {}, differentiable=False)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None) -> Tensor:
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_fill(x, index, axis, value, name=None) -> Tensor:
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    def fn(a, i):
+        moved = jnp.moveaxis(a, axis, 0)
+        out = moved.at[i.reshape(-1)].set(value)
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op("index_fill", fn, (x, index), {})
+
+
+def masked_scatter(x, mask, value, name=None) -> Tensor:
+    x, mask, value = ensure_tensor(x), ensure_tensor(mask), ensure_tensor(value)
+    a, m, v = (np.asarray(x._data), np.asarray(mask._data),
+               np.asarray(value._data).reshape(-1))
+    m = np.broadcast_to(m, a.shape)
+    out = a.copy()
+    out[m] = v[:int(m.sum())]
+    return Tensor(jnp.asarray(out))

@@ -1,0 +1,573 @@
+"""Shape/layout manipulation ops (python/paddle/tensor/manipulation.py parity).
+
+All views are functional on TPU (XLA has no aliasing across op boundaries);
+"inplace_" variants rebind the Tensor's payload, matching eager semantics.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dispatch import apply_op, ensure_tensor, rebind_inplace
+from ..framework import core
+from ..framework.tensor import Tensor
+
+__all__ = ["reshape", "reshape_", "transpose", "t", "flatten", "squeeze",
+           "squeeze_", "unsqueeze", "unsqueeze_", "concat", "stack", "split",
+           "chunk", "tile", "expand", "expand_as", "broadcast_to",
+           "broadcast_tensors", "flip", "rot90", "roll", "gather", "gather_nd",
+           "scatter", "scatter_", "scatter_nd", "scatter_nd_add", "index_select",
+           "index_sample", "index_add", "index_put", "masked_select",
+           "masked_fill", "where", "nonzero", "take_along_axis", "put_along_axis",
+           "unbind", "repeat_interleave", "unique", "unique_consecutive",
+           "sort", "argsort", "slice", "strided_slice", "moveaxis", "swapaxes",
+           "as_complex", "as_real", "cast", "numel", "shard_index",
+           "unstack", "unfold", "tensordot", "atleast_1d", "atleast_2d",
+           "atleast_3d", "view", "view_as", "tolist", "crop", "pad_basic"]
+
+
+def _axes(axis):
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in axis.numpy().reshape(-1))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def reshape(x, shape, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = tuple(int(s) for s in shape.numpy().reshape(-1))
+    else:
+        shape = tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                      for s in shape)
+    return apply_op("reshape", lambda a: jnp.reshape(a, shape), (x,), {})
+
+
+def reshape_(x, shape, name=None) -> Tensor:
+    return rebind_inplace(x, reshape(x, shape))
+
+
+def transpose(x, perm, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    perm = tuple(int(p) for p in perm)
+    return apply_op("transpose", lambda a: jnp.transpose(a, perm), (x,), {})
+
+
+def t(input, name=None) -> Tensor:
+    input = ensure_tensor(input)
+    if input.ndim < 2:
+        return input.clone()
+    if input.ndim == 2:
+        return apply_op("t", lambda a: a.T, (input,), {})
+    raise ValueError("paddle.t only supports ndim<=2; use transpose")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    nd = builtins.max(x.ndim, 1)
+    s = start_axis % nd
+    e = stop_axis % nd
+    def fn(a):
+        if a.ndim == 0:
+            return a.reshape(1)
+        shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return a.reshape(shape)
+    return apply_op("flatten", fn, (x,), {})
+
+
+def squeeze(x, axis=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    def fn(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = _axes(axis)
+        ax = (ax,) if isinstance(ax, int) else ax
+        ax = tuple(a_ % a.ndim for a_ in ax if a.shape[a_ % a.ndim] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+    return apply_op("squeeze", fn, (x,), {})
+
+
+def squeeze_(x, axis=None, name=None) -> Tensor:
+    return rebind_inplace(x, squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = _axes(axis)
+    ax = (ax,) if isinstance(ax, int) else ax
+    return apply_op("unsqueeze", lambda a: jnp.expand_dims(a, ax), (x,), {})
+
+
+def unsqueeze_(x, axis, name=None) -> Tensor:
+    return rebind_inplace(x, unsqueeze(x, axis))
+
+
+def concat(x, axis=0, name=None) -> Tensor:
+    ts = [ensure_tensor(t_) for t_ in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op("concat", lambda *xs: jnp.concatenate(xs, axis=axis),
+                    tuple(ts), {})
+
+
+def stack(x, axis=0, name=None) -> Tensor:
+    ts = [ensure_tensor(t_) for t_ in x]
+    return apply_op("stack", lambda *xs: jnp.stack(xs, axis=axis), tuple(ts), {})
+
+
+def split(x, num_or_sections, axis=0, name=None) -> List[Tensor]:
+    x = ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: axis dim {dim} is not divisible by "
+                f"num_or_sections={num_or_sections}")
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                    for s in num_or_sections]
+        neg = [i for i, s in enumerate(sections) if s < 0]
+        if neg:
+            sections[neg[0]] = dim - builtins.sum(s for s in sections if s >= 0)
+    offsets = np.cumsum([0] + sections).tolist()
+    def fn(a):
+        return tuple(jax.lax.slice_in_dim(a, offsets[i], offsets[i + 1], axis=axis)
+                     for i in range(len(sections)))
+    return list(apply_op("split", fn, (x,), {}))
+
+
+def chunk(x, chunks, axis=0, name=None) -> List[Tensor]:
+    return split(x, chunks, axis)
+
+
+def unstack(x, axis=0, num=None) -> List[Tensor]:
+    x = ensure_tensor(x)
+    n = num or x.shape[axis]
+    def fn(a):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(a, n, axis=axis))
+    return list(apply_op("unstack", fn, (x,), {}))
+
+
+def unbind(input, axis=0) -> List[Tensor]:
+    return unstack(input, axis)
+
+
+def tile(x, repeat_times, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if isinstance(repeat_times, Tensor):
+        repeat_times = tuple(int(r) for r in repeat_times.numpy().reshape(-1))
+    else:
+        repeat_times = tuple(int(r.item()) if isinstance(r, Tensor) else int(r)
+                             for r in repeat_times)
+    return apply_op("tile", lambda a: jnp.tile(a, repeat_times), (x,), {})
+
+
+def _resolve_expand_shape(x, shape):
+    if isinstance(shape, Tensor):
+        shape = [int(s) for s in shape.numpy().reshape(-1)]
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    nd = len(shape)
+    xs = [1] * (nd - len(x.shape)) + list(x.shape)
+    return tuple(xs[i] if shape[i] == -1 else shape[i] for i in range(nd))
+
+
+def expand(x, shape, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    target = _resolve_expand_shape(x, shape)
+    return apply_op("expand", lambda a: jnp.broadcast_to(a, target), (x,), {})
+
+
+def expand_as(x, y, name=None) -> Tensor:
+    y = ensure_tensor(y)
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None) -> Tensor:
+    return expand(x, shape)
+
+
+def broadcast_tensors(input, name=None) -> List[Tensor]:
+    ts = [ensure_tensor(t_) for t_ in input]
+    shape = np.broadcast_shapes(*[tuple(t_.shape) for t_ in ts])
+    return [expand(t_, list(shape)) for t_ in ts]
+
+
+def flip(x, axis, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = _axes(axis)
+    return apply_op("flip", lambda a: jnp.flip(a, axis=ax), (x,), {})
+
+
+def rot90(x, k=1, axes=(0, 1), name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), (x,), {})
+
+
+def roll(x, shifts, axis=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if isinstance(shifts, Tensor):
+        shifts = tuple(int(s) for s in shifts.numpy().reshape(-1))
+    ax = _axes(axis) if axis is not None else None
+    return apply_op("roll", lambda a: jnp.roll(a, shifts, axis=ax), (x,), {})
+
+
+def gather(x, index, axis=0, name=None) -> Tensor:
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op("gather",
+                    lambda a, i: jnp.take(a, i.reshape(-1), axis=axis),
+                    (x, index), {})
+
+
+def gather_nd(x, index, name=None) -> Tensor:
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    def fn(a, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+    return apply_op("gather_nd", fn, (x, index), {})
+
+
+def scatter(x, index, updates, overwrite=True, name=None) -> Tensor:
+    x, index, updates = (ensure_tensor(x), ensure_tensor(index),
+                         ensure_tensor(updates))
+    def fn(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        # paddle: non-overwrite zeroes target rows then accumulates
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+    return apply_op("scatter", fn, (x, index, updates), {})
+
+
+def scatter_(x, index, updates, overwrite=True, name=None) -> Tensor:
+    return rebind_inplace(x, scatter(x, index, updates, overwrite))
+
+
+def scatter_nd(index, updates, shape, name=None) -> Tensor:
+    index, updates = ensure_tensor(index), ensure_tensor(updates)
+    shape = tuple(int(s) for s in (shape.numpy().reshape(-1)
+                                   if isinstance(shape, Tensor) else shape))
+    def fn(i, u):
+        zero = jnp.zeros(shape, u.dtype)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return zero.at[idx].add(u)
+    return apply_op("scatter_nd", fn, (index, updates), {})
+
+
+def scatter_nd_add(x, index, updates, name=None) -> Tensor:
+    x, index, updates = (ensure_tensor(x), ensure_tensor(index),
+                         ensure_tensor(updates))
+    def fn(a, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u)
+    return apply_op("scatter_nd_add", fn, (x, index, updates), {})
+
+
+def index_select(x, index, axis=0, name=None) -> Tensor:
+    return gather(x, index, axis)
+
+
+def index_sample(x, index) -> Tensor:
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return apply_op("index_sample",
+                    lambda a, i: jnp.take_along_axis(a, i, axis=1),
+                    (x, index), {})
+
+
+def index_add(x, index, axis, value, name=None) -> Tensor:
+    x, index, value = ensure_tensor(x), ensure_tensor(index), ensure_tensor(value)
+    def fn(a, i, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        vmoved = jnp.moveaxis(v, axis, 0)
+        out = moved.at[i.reshape(-1)].add(vmoved)
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op("index_add", fn, (x, index, value), {})
+
+
+def index_put(x, indices, value, accumulate=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    value = ensure_tensor(value)
+    idx_ts = tuple(ensure_tensor(i) for i in indices)
+    def fn(a, v, *idx):
+        if accumulate:
+            return a.at[tuple(idx)].add(v)
+        return a.at[tuple(idx)].set(v)
+    return apply_op("index_put", fn, (x, value) + idx_ts, {})
+
+
+def masked_select(x, mask, name=None) -> Tensor:
+    # data-dependent output shape: eager-only (documented; same limit exists
+    # for dynamic ops under jit in the reference's to_static)
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    data = np.asarray(x._data)[np.asarray(mask._data)]
+    return Tensor(jnp.asarray(data))
+
+
+def masked_fill(x, mask, value, name=None) -> Tensor:
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    if isinstance(value, Tensor):
+        return apply_op("masked_fill",
+                        lambda a, m, v: jnp.where(m, v.astype(a.dtype), a),
+                        (x, mask, value), {})
+    return apply_op("masked_fill", lambda a, m: jnp.where(m, value, a),
+                    (x, mask), {})
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x, y = ensure_tensor(x, y if isinstance(y, Tensor) else None), ensure_tensor(y, x if isinstance(x, Tensor) else None)
+    return apply_op("where", lambda c, a, b: jnp.where(c, a, b),
+                    (condition, x, y), {})
+
+
+def nonzero(x, as_tuple=False):
+    x = ensure_tensor(x)
+    nz = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True) -> Tensor:
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    return apply_op("take_along_axis",
+                    lambda a, i: jnp.take_along_axis(a, i, axis=axis),
+                    (arr, indices), {})
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True) -> Tensor:
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    values = ensure_tensor(values)
+    def fn(a, i, v):
+        v = jnp.broadcast_to(v, i.shape) if v.ndim else jnp.full(i.shape, v, a.dtype)
+        dims = [jnp.arange(s).reshape([-1 if k == d else 1
+                                        for k in range(a.ndim)])
+                for d, s in enumerate(i.shape)]
+        full_idx = tuple(i if d == axis else jnp.broadcast_to(dims[d], i.shape)
+                         for d in range(a.ndim))
+        if reduce == "add":
+            return a.at[full_idx].add(v)
+        if reduce == "multiply" or reduce == "mul":
+            return a.at[full_idx].multiply(v)
+        return a.at[full_idx].set(v)
+    return apply_op("put_along_axis", fn, (arr, indices, values), {})
+
+
+def repeat_interleave(x, repeats, axis=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        reps = jnp.asarray(repeats._data)
+        total = int(np.sum(repeats.numpy()))
+        return apply_op("repeat_interleave",
+                        lambda a: jnp.repeat(a, reps, axis=axis,
+                                             total_repeat_length=total),
+                        (x,), {})
+    return apply_op("repeat_interleave",
+                    lambda a: jnp.repeat(a, repeats, axis=axis), (x,), {})
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    res = np.unique(np.asarray(x._data), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    x = ensure_tensor(x)
+    a = np.asarray(x._data)
+    if axis is None:
+        a = a.reshape(-1)
+        keep = np.concatenate([[True], a[1:] != a[:-1]])
+        vals = a[keep]
+    else:
+        if a.shape[axis] == 0:
+            keep = np.zeros(0, bool)
+        else:
+            diff = np.any(np.diff(a, axis=axis) != 0,
+                          axis=tuple(i for i in range(a.ndim) if i != axis))
+            keep = np.concatenate([[True], diff])
+        vals = np.take(a, np.nonzero(keep)[0], axis=axis)
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.nonzero(np.concatenate([keep, [True]]))[0]
+        outs.append(Tensor(jnp.asarray(np.diff(idx))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    def fn(a):
+        out = jnp.sort(a, axis=axis, stable=True)
+        return jnp.flip(out, axis=axis) if descending else out
+    return apply_op("sort", fn, (x,), {})
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    def fn(a):
+        idx = jnp.argsort(a, axis=axis, stable=True)
+        return jnp.flip(idx, axis=axis) if descending else idx
+    return apply_op("argsort", fn, (x,), {}, differentiable=False)
+
+
+def slice(input, axes, starts, ends) -> Tensor:
+    input = ensure_tensor(input)
+    def _v(s):
+        return int(s.item()) if isinstance(s, Tensor) else int(s)
+    starts = [_v(s) for s in starts]
+    ends = [_v(e) for e in ends]
+    def fn(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(s, e)
+        return a[tuple(idx)]
+    return apply_op("slice", fn, (input,), {})
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    def fn(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(int(s), int(e), int(st))
+        return a[tuple(idx)]
+    return apply_op("strided_slice", fn, (x,), {})
+
+
+def crop(x, shape=None, offsets=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    shape = [int(s) for s in (shape or x.shape)]
+    offsets = [int(o) for o in (offsets or [0] * x.ndim)]
+    def fn(a):
+        idx = tuple(builtins.slice(o, o + s) for o, s in zip(offsets, shape))
+        return a[idx]
+    return apply_op("crop", fn, (x,), {})
+
+
+def moveaxis(x, source, destination, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination),
+                    (x,), {})
+
+
+def swapaxes(x, axis0, axis1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), (x,), {})
+
+
+def as_complex(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("as_complex",
+                    lambda a: jax.lax.complex(a[..., 0], a[..., 1]), (x,), {})
+
+
+def as_real(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("as_real",
+                    lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+                    (x,), {})
+
+
+def cast(x, dtype) -> Tensor:
+    return ensure_tensor(x).astype(dtype)
+
+
+def numel(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int32))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    input = ensure_tensor(input)
+    shard_size = (index_num + nshards - 1) // nshards
+    def fn(a):
+        lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+        inside = (a >= lo) & (a < hi)
+        return jnp.where(inside, a - lo, ignore_value)
+    return apply_op("shard_index", fn, (input,), {}, differentiable=False)
+
+
+def unfold(x, axis, size, step, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    def fn(a):
+        n = (a.shape[axis] - size) // step + 1
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+        moved = jnp.moveaxis(a, axis, -1)
+        win = moved[..., idx]  # (..., n, size)
+        return jnp.moveaxis(win, -2, axis)
+    return apply_op("unfold", fn, (x,), {})
+
+
+def tensordot(x, y, axes=2, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    return apply_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes),
+                    (x, y), {})
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op("atleast_1d", jnp.atleast_1d, (ensure_tensor(i),), {})
+            for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op("atleast_2d", jnp.atleast_2d, (ensure_tensor(i),), {})
+            for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op("atleast_3d", jnp.atleast_3d, (ensure_tensor(i),), {})
+            for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def view(x, shape_or_dtype, name=None) -> Tensor:
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    x = ensure_tensor(x)
+    dt = core.convert_dtype(shape_or_dtype)
+    return apply_op("view_dtype", lambda a: a.view(dt), (x,), {},
+                    differentiable=False)
+
+
+def view_as(x, other, name=None) -> Tensor:
+    return reshape(x, ensure_tensor(other).shape)
+
+
+def tolist(x):
+    return ensure_tensor(x).tolist()
+
+
+def pad_basic(x, pad, value=0.0):
+    x = ensure_tensor(x)
+    cfg = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(len(pad) // 2)]
+    cfg = [(0, 0)] * (x.ndim - len(cfg)) + cfg
+    return apply_op("pad", lambda a: jnp.pad(a, cfg, constant_values=value),
+                    (x,), {})

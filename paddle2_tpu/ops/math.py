@@ -1,0 +1,492 @@
+"""Elementwise + reduction math ops (python/paddle/tensor/math.py parity).
+
+Each op is a thin Tensor wrapper over a pure jnp function; XLA fuses chains of
+these into single TPU kernels under jit, and the eager path records the tape
+via dispatch.apply_op. Reference: op list from paddle/phi/ops/yaml/ops.yaml.
+"""
+
+from __future__ import annotations
+
+import builtins
+import math as _pymath
+from functools import partial
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp_special
+
+from .dispatch import apply_op, ensure_tensor
+from ..framework import core
+from ..framework.tensor import Tensor
+
+__all__ = []
+
+
+def _export(name, fn):
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def _unary(name, jfn, differentiable=True):
+    def op(x, name=None):  # noqa: A002 — paddle API takes `name`
+        return apply_op(op.__name__, jfn, (ensure_tensor(x),), {},
+                        differentiable=differentiable)
+    op.__name__ = name
+    op.__qualname__ = name
+    return _export(name, op)
+
+
+def _binary(name, jfn, differentiable=True):
+    def op(x, y, name=None):  # noqa: A002
+        x = ensure_tensor(x, y if isinstance(y, Tensor) else None)
+        y = ensure_tensor(y, x)
+        return apply_op(op.__name__, jfn, (x, y), {},
+                        differentiable=differentiable)
+    op.__name__ = name
+    op.__qualname__ = name
+    return _export(name, op)
+
+
+# ---------------------------------------------------------------------------
+# unary
+# ---------------------------------------------------------------------------
+_unary("abs", jnp.abs)
+_unary("acos", jnp.arccos)
+_unary("acosh", jnp.arccosh)
+_unary("asin", jnp.arcsin)
+_unary("asinh", jnp.arcsinh)
+_unary("atan", jnp.arctan)
+_unary("atanh", jnp.arctanh)
+_unary("ceil", jnp.ceil)
+_unary("cos", jnp.cos)
+_unary("cosh", jnp.cosh)
+_unary("digamma", jsp_special.digamma)
+_unary("erf", jax.lax.erf)
+_unary("erfinv", jax.lax.erf_inv)
+_unary("exp", jnp.exp)
+_unary("expm1", jnp.expm1)
+_unary("floor", jnp.floor)
+_unary("lgamma", jsp_special.gammaln)
+_unary("log", jnp.log)
+_unary("log2", jnp.log2)
+_unary("log10", jnp.log10)
+_unary("log1p", jnp.log1p)
+_unary("neg", jnp.negative)
+_unary("reciprocal", jnp.reciprocal)
+_unary("round", jnp.round)
+_unary("rsqrt", jax.lax.rsqrt)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("sign", jnp.sign)
+_unary("sin", jnp.sin)
+_unary("sinh", jnp.sinh)
+_unary("sqrt", jnp.sqrt)
+_unary("square", jnp.square)
+_unary("tan", jnp.tan)
+_unary("tanh", jnp.tanh)
+_unary("trunc", jnp.trunc)
+_unary("frac", lambda x: x - jnp.trunc(x))
+_unary("angle", jnp.angle)
+_unary("conj", jnp.conj)
+_unary("i0", jsp_special.i0)
+_unary("i0e", jsp_special.i0e)
+_unary("i1", jsp_special.i1)
+_unary("i1e", jsp_special.i1e)
+_unary("isnan", jnp.isnan, differentiable=False)
+_unary("isinf", jnp.isinf, differentiable=False)
+_unary("isfinite", jnp.isfinite, differentiable=False)
+_unary("bitwise_not", jnp.bitwise_not, differentiable=False)
+_unary("logit", jsp_special.logit)
+_unary("deg2rad", jnp.deg2rad)
+_unary("rad2deg", jnp.rad2deg)
+_unary("exponential_", lambda x: x)  # placeholder; random fills in random.py
+
+
+def logical_not(x, out=None, name=None):
+    return apply_op("logical_not", jnp.logical_not, (ensure_tensor(x),), {},
+                    differentiable=False)
+_export("logical_not", logical_not)
+
+
+# ---------------------------------------------------------------------------
+# binary
+# ---------------------------------------------------------------------------
+_binary("add", jnp.add)
+_binary("subtract", jnp.subtract)
+_binary("multiply", jnp.multiply)
+_binary("divide", jnp.divide)
+_binary("floor_divide", jnp.floor_divide, differentiable=False)
+_binary("remainder", jnp.remainder)
+_binary("mod", jnp.remainder)
+_binary("floor_mod", jnp.remainder)
+_binary("pow_op", jnp.power)
+_binary("maximum", jnp.maximum)
+_binary("minimum", jnp.minimum)
+_binary("fmax", jnp.fmax)
+_binary("fmin", jnp.fmin)
+_binary("atan2", jnp.arctan2)
+_binary("logaddexp", jnp.logaddexp)
+_binary("heaviside", jnp.heaviside)
+_binary("hypot", jnp.hypot)
+_binary("copysign", jnp.copysign)
+_binary("nextafter", jnp.nextafter, differentiable=False)
+_binary("gcd", jnp.gcd, differentiable=False)
+_binary("lcm", jnp.lcm, differentiable=False)
+_binary("ldexp", lambda x, y: x * (2.0 ** y))
+_binary("polygamma_n", lambda x, n: jsp_special.polygamma(n, x))
+_binary("logical_and", jnp.logical_and, differentiable=False)
+_binary("logical_or", jnp.logical_or, differentiable=False)
+_binary("logical_xor", jnp.logical_xor, differentiable=False)
+_binary("bitwise_and", jnp.bitwise_and, differentiable=False)
+_binary("bitwise_or", jnp.bitwise_or, differentiable=False)
+_binary("bitwise_xor", jnp.bitwise_xor, differentiable=False)
+
+
+def pow(x, y, name=None):
+    if isinstance(y, int) and not isinstance(y, bool):
+        x = ensure_tensor(x)
+        return apply_op("pow", lambda a: jax.lax.integer_pow(a, y), (x,), {})
+    return pow_op(x, y)  # noqa: F821
+_export("pow", pow)
+
+
+def divide_no_nan(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("divide_no_nan",
+                    lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b)),
+                    (x, y), {})
+_export("divide_no_nan", divide_no_nan)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = ensure_tensor(x)
+    def fn(a):
+        out = a * scale + bias if bias_after_scale else (a + bias) * scale
+        return out
+    out = apply_op("scale", fn, (x,), {})
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+_export("scale", scale)
+
+
+def clip(x, min=None, max=None, name=None):
+    x = ensure_tensor(x)
+    lo = float(min) if isinstance(min, (int, float)) else (min._data if min is not None else None)
+    hi = float(max) if isinstance(max, (int, float)) else (max._data if max is not None else None)
+    return apply_op("clip", lambda a: jnp.clip(a, lo, hi), (x,), {})
+_export("clip", clip)
+
+
+def lerp(x, y, weight, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return apply_op("lerp", lambda a, b, w: a + w * (b - a), (x, y, weight), {})
+    return apply_op("lerp", lambda a, b: a + weight * (b - a), (x, y), {})
+_export("lerp", lerp)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = ensure_tensor(x)
+    return apply_op("nan_to_num",
+                    lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                    (x,), {})
+_export("nan_to_num", nan_to_num)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    x = ensure_tensor(x)
+    return apply_op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), (x,), {})
+_export("stanh", stanh)
+
+
+def multiplex(inputs, index, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    index = ensure_tensor(index)
+    def fn(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0)[0]
+    return apply_op("multiplex", fn, (index, *ts), {})
+_export("multiplex", multiplex)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in axis.numpy().reshape(-1))
+    return int(axis)
+
+
+def _reduction(name, jfn, differentiable=True, dtype_arg=False):
+    opname = name
+    def op(x, axis=None, keepdim=False, dtype=None, name=None):  # noqa: A002
+        x = ensure_tensor(x)
+        ax = _norm_axis(axis)
+        def fn(a):
+            if dtype_arg and dtype is not None:
+                a = a.astype(core.convert_dtype(dtype))
+            elif opname == "sum" and jnp.issubdtype(a.dtype, jnp.bool_):
+                a = a.astype(jnp.int32)
+            return jfn(a, axis=ax, keepdims=keepdim)
+        return apply_op(opname, fn, (x,), {}, differentiable=differentiable)
+    op.__name__ = opname
+    return _export(opname, op)
+
+
+_reduction("sum", jnp.sum, dtype_arg=True)
+_reduction("mean", jnp.mean, dtype_arg=True)
+_reduction("prod", jnp.prod, dtype_arg=True)
+_reduction("max", jnp.max)
+_reduction("min", jnp.min)
+_reduction("amax", jnp.amax)
+_reduction("amin", jnp.amin)
+_reduction("nansum", jnp.nansum, dtype_arg=True)
+_reduction("nanmean", jnp.nanmean)
+_reduction("all", jnp.all, differentiable=False)
+_reduction("any", jnp.any, differentiable=False)
+_reduction("logsumexp", jsp_special.logsumexp)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return apply_op("count_nonzero",
+                    lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim),
+                    (x,), {}, differentiable=False)
+_export("count_nonzero", count_nonzero)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply_op("var", lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                    (x,), {})
+_export("var", var)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply_op("std", lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                    (x,), {})
+_export("std", std)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return apply_op("median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim),
+                    (x,), {})
+_export("median", median)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    qv = jnp.asarray(q)
+    return apply_op("quantile",
+                    lambda a: jnp.quantile(a, qv, axis=ax, keepdims=keepdim,
+                                           method=interpolation),
+                    (x,), {})
+_export("quantile", quantile)
+
+
+# ---------------------------------------------------------------------------
+# scans & misc
+# ---------------------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    def fn(a):
+        if ax is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=core.convert_dtype(dtype))
+        return jnp.cumsum(a, axis=ax, dtype=core.convert_dtype(dtype))
+    return apply_op("cumsum", fn, (x,), {})
+_export("cumsum", cumsum)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return apply_op("cumprod",
+                    lambda a: jnp.cumprod(a, axis=dim, dtype=core.convert_dtype(dtype)),
+                    (x,), {})
+_export("cumprod", cumprod)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    def fn(a):
+        if axis is None:
+            a2 = a.reshape(-1)
+            vals = jax.lax.cummax(a2, axis=0)
+            return vals
+        return jax.lax.cummax(a, axis=axis)
+    values = apply_op("cummax", fn, (x,), {})
+    # indices pass (non-differentiable)
+    def idx_fn(a):
+        ax = 0 if axis is None else axis
+        a2 = a.reshape(-1) if axis is None else a
+        n = a2.shape[ax]
+        iota = jax.lax.broadcasted_iota(jnp.int32, a2.shape, ax)
+        vals = jax.lax.cummax(a2, axis=ax)
+        isnew = a2 >= vals  # True where a new max is set
+        idx = jax.lax.cummax(jnp.where(isnew, iota, -1), axis=ax)
+        return idx.astype(core.convert_dtype(dtype))
+    indices = apply_op("cummax_idx", idx_fn, (x,), {}, differentiable=False)
+    return values, indices
+_export("cummax", cummax)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    def fn(a):
+        a2 = a.reshape(-1) if axis is None else a
+        return jax.lax.cummin(a2, axis=0 if axis is None else axis)
+    values = apply_op("cummin", fn, (x,), {})
+    def idx_fn(a):
+        ax = 0 if axis is None else axis
+        a2 = a.reshape(-1) if axis is None else a
+        iota = jax.lax.broadcasted_iota(jnp.int32, a2.shape, ax)
+        vals = jax.lax.cummin(a2, axis=ax)
+        isnew = a2 <= vals
+        idx = jax.lax.cummax(jnp.where(isnew, iota, -1), axis=ax)
+        return idx.astype(core.convert_dtype(dtype))
+    indices = apply_op("cummin_idx", idx_fn, (x,), {}, differentiable=False)
+    return values, indices
+_export("cummin", cummin)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    def fn(a):
+        if axis is None:
+            a2 = a.reshape(-1)
+            return jax.lax.cumlogsumexp(a2, axis=0)
+        return jax.lax.cumlogsumexp(a, axis=axis)
+    return apply_op("logcumsumexp", fn, (x,), {})
+_export("logcumsumexp", logcumsumexp)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = ensure_tensor(x)
+    pre = prepend._data if isinstance(prepend, Tensor) else prepend
+    app = append._data if isinstance(append, Tensor) else append
+    return apply_op("diff",
+                    lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app),
+                    (x,), {})
+_export("diff", diff)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return apply_op("trace",
+                    lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+                    (x,), {})
+_export("trace", trace)
+
+
+def kron(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("kron", jnp.kron, (x, y), {})
+_export("kron", kron)
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axis if axis != 9 else None
+    def fn(a, b):
+        if ax is None:
+            # first axis with dim 3 (paddle semantics)
+            for i, d in enumerate(a.shape):
+                if d == 3:
+                    return jnp.cross(a, b, axis=i)
+            raise ValueError("cross: no axis with dimension 3")
+        return jnp.cross(a, b, axis=ax)
+    return apply_op("cross", fn, (x, y), {})
+_export("cross", cross)
+
+
+def inner(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("inner", jnp.inner, (x, y), {})
+_export("inner", inner)
+
+
+def outer(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("outer", lambda a, b: jnp.outer(a.reshape(-1), b.reshape(-1)),
+                    (x, y), {})
+_export("outer", outer)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    input, x, y = ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)
+    return apply_op("addmm",
+                    lambda i, a, b: beta * i + alpha * (a @ b), (input, x, y), {})
+_export("addmm", addmm)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    x = ensure_tensor(x)
+    def fn(a):
+        dims = tuple(i for i in range(a.ndim) if i != axis)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+    return apply_op("renorm", fn, (x,), {})
+_export("renorm", renorm)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    input = ensure_tensor(input)
+    def fn(a):
+        lo, hi = (float(min), float(max))
+        if lo == 0 and hi == 0:
+            lo, hi = jnp.min(a), jnp.max(a)
+        h, _ = jnp.histogram(a.reshape(-1), bins=bins, range=(lo, hi))
+        return h
+    return apply_op("histogram", fn, (input,), {}, differentiable=False)
+_export("histogram", histogram)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    n = int(x.numpy().max()) + 1 if x.size else 0
+    length = builtins.max(n, minlength)
+    if weights is not None:
+        w = ensure_tensor(weights)
+        return apply_op("bincount",
+                        lambda a, ww: jnp.bincount(a.reshape(-1), ww.reshape(-1),
+                                                   length=length),
+                        (x, w), {}, differentiable=False)
+    return apply_op("bincount", lambda a: jnp.bincount(a.reshape(-1), length=length),
+                    (x,), {}, differentiable=False)
+_export("bincount", bincount)
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+_export("broadcast_shape", broadcast_shape)
+
+
+def increment(x, value=1.0, name=None):
+    x = ensure_tensor(x)
+    out = apply_op("increment", lambda a: a + value, (x,), {})
+    x._replace_data(out._data)
+    return x
+_export("increment", increment)

