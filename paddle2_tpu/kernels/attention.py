@@ -1,0 +1,89 @@
+"""Attention kernels (phi flash_attn_kernel.cu / third_party/flashattn parity).
+
+Two paths:
+- `scaled_dot_product_attention`: reference XLA implementation (fused well by
+  XLA on small/medium sequence lengths).
+- the Pallas TPU flash-attention kernel in pallas_flash.py, used automatically
+  on TPU for long sequences (tile-wise online softmax, O(S) memory).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply_op, ensure_tensor
+
+
+def _sdpa_xla(q, k, v, bias=None, causal=False, scale=None, dropout_p=0.0,
+              dropout_key=None):
+    """q,k,v: (B, S, H, D) paddle layout."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # (B, H, S, D)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), bool), k=t - s)
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def use_pallas(q_shape) -> bool:
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    if dev.platform.lower() == "cpu":
+        return False
+    # Pallas wins once the S*S score matrix stops fitting in VMEM-friendly
+    # tiles; below that XLA's fusion is already near-roofline.
+    return q_shape[1] >= 1024
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, causal=None,
+                                 training=True, name=None):
+    """paddle.nn.functional.scaled_dot_product_attention parity.
+
+    Inputs are (batch, seq, num_heads, head_dim) like the reference flash-attn
+    API (paddle/phi/kernels/gpu/flash_attn_kernel.cu qkv layout).
+    """
+    causal = causal if causal is not None else is_causal
+    query, key, value = (ensure_tensor(query), ensure_tensor(key),
+                         ensure_tensor(value))
+    tensors = [query, key, value]
+    has_mask = attn_mask is not None
+    if has_mask:
+        tensors.append(ensure_tensor(attn_mask))
+    drop_key = None
+    if dropout_p > 0.0 and training:
+        from ..framework import random as fr
+        drop_key = fr.next_key()
+
+    if use_pallas(tuple(query.shape)) and not has_mask and drop_key is None:
+        from .pallas_flash import flash_attention_bshd
+        def fn(q, k, v):
+            return flash_attention_bshd(q, k, v, causal=causal)
+        return apply_op("flash_attention", fn, tuple(tensors), {})
+
+    def fn(q, k, v, *mask):
+        bias = mask[0] if mask else None
+        return _sdpa_xla(q, k, v, bias=bias, causal=causal,
+                         dropout_p=dropout_p if drop_key is not None else 0.0,
+                         dropout_key=drop_key)
+    return apply_op("sdpa", fn, tuple(tensors), {})

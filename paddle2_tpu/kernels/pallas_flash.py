@@ -1,0 +1,10 @@
+"""Pallas TPU flash-attention (placeholder wiring; kernel lands with the
+kernels milestone). Falls back to the XLA fused path, which is numerically
+identical."""
+
+from __future__ import annotations
+
+
+def flash_attention_bshd(q, k, v, causal=False, scale=None):
+    from .attention import _sdpa_xla
+    return _sdpa_xla(q, k, v, causal=causal, scale=scale)

@@ -1,0 +1,31 @@
+"""paddle.nn.functional namespace (python/paddle/nn/functional/ parity)."""
+
+from .activation import *   # noqa: F401,F403
+from .common import *       # noqa: F401,F403
+from .conv import *         # noqa: F401,F403
+from .pooling import *      # noqa: F401,F403
+from .norm import *         # noqa: F401,F403
+from .loss import *         # noqa: F401,F403
+
+from ...kernels.attention import scaled_dot_product_attention  # noqa: F401
+
+# sequence mask helper used widely in NLP codebases
+import jax.numpy as _jnp
+from ...framework.tensor import Tensor as _Tensor
+from ...ops.dispatch import apply_op as _apply_op, ensure_tensor as _ensure
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    x = _ensure(x)
+    m = maxlen if maxlen is not None else int(x.numpy().max())
+    from ...framework import core as _core
+    dt = _core.convert_dtype(dtype)
+    return _apply_op(
+        "sequence_mask",
+        lambda a: (_jnp.arange(m)[None, :] < a[..., None]).astype(dt),
+        (x,), {}, differentiable=False)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    from ...ops.creation import diag_embed as _de
+    return _de(x, offset, dim1, dim2)

@@ -1,0 +1,208 @@
+"""Activation functionals (python/paddle/nn/functional/activation.py parity).
+
+All are single fused XLA elementwise graphs — on TPU these fuse into the
+surrounding matmul's epilogue, so there is no per-activation kernel to write.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply_op, ensure_tensor
+from ...framework.tensor import Tensor
+
+__all__ = ["relu", "relu_", "relu6", "elu", "selu", "celu", "gelu", "silu",
+           "swish", "mish", "softplus", "softshrink", "hardshrink",
+           "tanhshrink", "hardtanh", "hardsigmoid", "hardswish", "leaky_relu",
+           "log_sigmoid", "log_softmax", "softmax", "softmax_", "softsign",
+           "sigmoid", "tanh", "maxout", "prelu", "rrelu", "glu",
+           "gumbel_softmax", "thresholded_relu"]
+
+
+def _unary(name, jfn):
+    def op(x, *args, name=None, **kwargs):
+        return apply_op(op.__name__,
+                        (lambda a: jfn(a, *args, **kwargs)),
+                        (ensure_tensor(x),), {})
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+softsign = _unary("softsign", jax.nn.soft_sign)
+silu = _unary("silu", jax.nn.silu)
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+mish = _unary("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+
+
+def relu_(x, name=None):
+    from ...ops.dispatch import rebind_inplace
+    return rebind_inplace(x, relu(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda a: jax.nn.elu(a, alpha),
+                    (ensure_tensor(x),), {})
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(
+        "selu",
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+        (ensure_tensor(x),), {})
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda a: jax.nn.celu(a, alpha),
+                    (ensure_tensor(x),), {})
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate),
+                    (ensure_tensor(x),), {})
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def fn(a):
+        scaled = beta * a
+        return jnp.where(scaled > threshold, a,
+                         jnp.log1p(jnp.exp(jnp.minimum(scaled, threshold))) / beta)
+    return apply_op("softplus", fn, (ensure_tensor(x),), {})
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        (ensure_tensor(x),), {})
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "hardshrink",
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0),
+        (ensure_tensor(x),), {})
+
+
+def tanhshrink(x, name=None):
+    return apply_op("tanhshrink", lambda a: a - jnp.tanh(a),
+                    (ensure_tensor(x),), {})
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op("hardtanh", lambda a: jnp.clip(a, min, max),
+                    (ensure_tensor(x),), {})
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return apply_op("hardsigmoid",
+                    lambda a: jnp.clip(slope * a + offset, 0.0, 1.0),
+                    (ensure_tensor(x),), {})
+
+
+def hardswish(x, name=None):
+    return apply_op("hardswish",
+                    lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0,
+                    (ensure_tensor(x),), {})
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu",
+                    lambda a: jax.nn.leaky_relu(a, negative_slope),
+                    (ensure_tensor(x),), {})
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op("thresholded_relu",
+                    lambda a: jnp.where(a > threshold, a, value),
+                    (ensure_tensor(x),), {})
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework import core
+    dt = core.convert_dtype(dtype)
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.softmax(a, axis=axis)
+    return apply_op("softmax", fn, (ensure_tensor(x),), {})
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...ops.dispatch import rebind_inplace
+    return rebind_inplace(x, softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework import core
+    dt = core.convert_dtype(dtype)
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply_op("log_softmax", fn, (ensure_tensor(x),), {})
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply_op("maxout", fn, (ensure_tensor(x),), {})
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    def fn(a, w):
+        if w.size > 1:
+            ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+            shape = [1] * a.ndim
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, w * a)
+    return apply_op("prelu", fn, (x, weight), {})
+
+
+def rrelu(x, lower=0.125, upper=1.0 / 3, training=True, name=None):
+    from ...framework import random as fr
+    x = ensure_tensor(x)
+    if training:
+        slope = jax.random.uniform(fr.next_key(), tuple(x.shape),
+                                   minval=lower, maxval=upper)
+        return apply_op("rrelu", lambda a: jnp.where(a >= 0, a, slope * a),
+                        (x,), {})
+    mid = (lower + upper) / 2.0
+    return apply_op("rrelu", lambda a: jnp.where(a >= 0, a, mid * a), (x,), {})
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op("glu", lambda a: jax.nn.glu(a, axis=axis),
+                    (ensure_tensor(x),), {})
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as fr
+    x = ensure_tensor(x)
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(fr.next_key(), tuple(x.shape), minval=1e-20,
+                           maxval=1.0)))
+    def fn(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            onehot = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis],
+                                    axis=axis, dtype=y.dtype)
+            # straight-through estimator
+            return onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return apply_op("gumbel_softmax", fn, (x,), {})

@@ -1,0 +1,343 @@
+"""Common functionals: linear, dropout, embedding, pad, one_hot, interpolate
+(python/paddle/nn/functional/common.py + input.py parity)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import core
+from ...framework import random as fr
+from ...framework.tensor import Tensor
+from ...ops.dispatch import apply_op, ensure_tensor
+
+__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+           "embedding", "one_hot", "pad", "zeropad2d", "unfold", "fold",
+           "interpolate", "upsample", "pixel_shuffle", "pixel_unshuffle",
+           "channel_shuffle", "cosine_similarity", "bilinear", "label_smooth",
+           "class_center_sample", "flash_attention", "normalize"]
+
+
+def linear(x, weight, bias=None, name=None) -> Tensor:
+    """y = x @ W + b; W is (in_features, out_features) like the reference
+    (python/paddle/nn/functional/common.py linear)."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        return apply_op("linear", lambda a, w, b: jnp.matmul(a, w) + b,
+                        (x, weight, bias), {})
+    return apply_op("linear", jnp.matmul, (x, weight), {})
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_op("dropout_infer", lambda a: a * (1 - p), (x,), {})
+        return x.clone()
+    shape = list(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    keep = jax.random.bernoulli(fr.next_key(), 1.0 - p, tuple(shape))
+    def fn(a):
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return apply_op("dropout", fn, (x,), {})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None) -> Tensor:
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None) -> Tensor:
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x.clone()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(fr.next_key(), 1.0 - p, tuple(x.shape))
+    a_coef = (1.0 - p + p * alpha_p ** 2) ** -0.5
+    b_coef = -a_coef * p * alpha_p
+    return apply_op(
+        "alpha_dropout",
+        lambda a: (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype),
+        (x,), {})
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None) -> Tensor:
+    """Lookup rows of `weight` — on TPU a gather that XLA turns into a
+    one-hot matmul or dynamic-gather depending on vocab size."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    pad_idx = padding_idx
+    if pad_idx is not None and pad_idx < 0:
+        pad_idx = weight.shape[0] + pad_idx
+    def fn(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if pad_idx is not None:
+            mask = (ids == pad_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply_op("embedding", fn, (x, weight), {})
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("one_hot",
+                    lambda a: jax.nn.one_hot(a, num_classes,
+                                             dtype=core.get_default_dtype()),
+                    (x,), {}, differentiable=False)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = [int(p) for p in pad.numpy().reshape(-1)]
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle semantics: pad applies to the spatial dims per data_format,
+        # listed innermost-first (W, H, D)
+        n_spatial = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial_axes = list(range(2, 2 + n_spatial))
+        else:
+            spatial_axes = list(range(1, 1 + n_spatial))
+        for i, ax in enumerate(reversed(spatial_axes)):
+            cfg[ax] = (pad[2 * i], pad[2 * i + 1])
+
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    def fn(a):
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+    return apply_op("pad", fn, (x,), {})
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None) -> Tensor:
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None) -> Tensor:
+    """im2col (N,C,H,W) -> (N, C*kh*kw, L)."""
+    x = ensure_tensor(x)
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) \
+        else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+    if isinstance(paddings, int):
+        pt = pb = pl = pr = paddings
+    elif len(paddings) == 2:
+        pt = pb = paddings[0]; pl = pr = paddings[1]
+    else:
+        pt, pl, pb, pr = paddings
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        hh, ww = a.shape[2], a.shape[3]
+        oh = (hh - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (ww - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            a, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: (N, C*kh*kw, oh, ow)
+        return patches.reshape(n, c * kh * kw, oh * ow)
+    return apply_op("unfold", fn, (x,), {})
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None) -> Tensor:
+    """col2im — adjoint of unfold."""
+    x = ensure_tensor(x)
+    oh, ow = (output_sizes, output_sizes) if isinstance(output_sizes, int) \
+        else output_sizes
+    def fwd(cols):
+        n = cols.shape[0]
+        c_kk = cols.shape[1]
+        kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) \
+            else kernel_sizes
+        c = c_kk // (kh * kw)
+        zeros = jnp.zeros((n, c, oh, ow), cols.dtype)
+        _, vjp = jax.vjp(
+            lambda img: unfold_raw(img, kernel_sizes, strides, paddings,
+                                   dilations), zeros)
+        (out,) = vjp(cols)
+        return out
+    def unfold_raw(a, ks, st, pd, dl):
+        t = Tensor(a)
+        return unfold(t, ks, st, pd, dl)._data
+    return apply_op("fold", fwd, (x,), {})
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if data_format not in ("NCHW", "NHWC", "NCW", "NWC", "NCDHW", "NDHWC"):
+        raise ValueError(f"bad data_format {data_format}")
+    channel_last = not data_format.startswith("NC")
+    nd = x.ndim
+    n_spatial = nd - 2
+    spatial_axes = (list(range(1, 1 + n_spatial)) if channel_last
+                    else list(range(2, 2 + n_spatial)))
+    in_sizes = [x.shape[a] for a in spatial_axes]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in size.numpy().reshape(-1)]
+        out_sizes = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                     for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        sf = (scale_factor if isinstance(scale_factor, (list, tuple))
+              else [scale_factor] * n_spatial)
+        out_sizes = [int(i * float(s)) for i, s in zip(in_sizes, sf)]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def fn(a):
+        out_shape = list(a.shape)
+        for ax, s in zip(spatial_axes, out_sizes):
+            out_shape[ax] = s
+        if jmode == "nearest":
+            idx = [jnp.floor(jnp.arange(s) * (in_sizes[i] / s)).astype(jnp.int32)
+                   for i, s in enumerate(out_sizes)]
+            out = a
+            for i, ax in enumerate(spatial_axes):
+                out = jnp.take(out, idx[i], axis=ax)
+            return out
+        return jax.image.resize(a, out_shape, method=jmode)
+    return apply_op("interpolate", fn, (x,), {})
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    r = upscale_factor
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return apply_op("pixel_shuffle", fn, (x,), {})
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    r = downscale_factor
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+    return apply_op("pixel_unshuffle", fn, (x,), {})
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            return a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        return a.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return apply_op("channel_shuffle", fn, (x,), {})
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8) -> Tensor:
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply_op("cosine_similarity", fn, (x1, x2), {})
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    def fn(a):
+        norm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(norm, epsilon)
+    return apply_op("normalize", fn, (x,), {})
+
+
+def bilinear(x1, x2, weight, bias=None, name=None) -> Tensor:
+    x1, x2, weight = ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        return apply_op("bilinear",
+                        lambda a, b, w, bi: jnp.einsum("bi,oij,bj->bo", a, w, b) + bi,
+                        (x1, x2, weight, bias), {})
+    return apply_op("bilinear",
+                    lambda a, b, w: jnp.einsum("bi,oij,bj->bo", a, w, b),
+                    (x1, x2, weight), {})
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None) -> Tensor:
+    label = ensure_tensor(label)
+    if prior_dist is not None:
+        prior_dist = ensure_tensor(prior_dist)
+        return apply_op("label_smooth",
+                        lambda l, p: (1 - epsilon) * l + epsilon * p,
+                        (label, prior_dist), {})
+    k = label.shape[-1]
+    return apply_op("label_smooth",
+                    lambda l: (1 - epsilon) * l + epsilon / k, (label,), {})
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    label = ensure_tensor(label)
+    pos = np.unique(np.asarray(label._data))
+    n_extra = max(0, num_samples - pos.size)
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    extra = np.random.choice(rest, size=min(n_extra, rest.size), replace=False) \
+        if n_extra else np.array([], np.int64)
+    sampled = np.sort(np.concatenate([pos, extra])).astype(np.int32)
+    remap = -np.ones(num_classes, np.int32)
+    remap[sampled] = np.arange(sampled.size)
+    remapped = remap[np.asarray(label._data)]
+    return (Tensor(jnp.asarray(remapped)), Tensor(jnp.asarray(sampled)))
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, name=None):
+    """Memory-efficient attention entry point; the Pallas TPU kernel lives in
+    paddle2_tpu.kernels.flash_attention (phi flash_attn_kernel.cu parity)."""
+    from ...kernels.attention import scaled_dot_product_attention
+    return scaled_dot_product_attention(query, key, value, causal=causal,
+                                        dropout_p=dropout)

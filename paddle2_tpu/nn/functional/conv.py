@@ -1,0 +1,170 @@
+"""Convolution functionals (python/paddle/nn/functional/conv.py parity).
+
+Lowered to lax.conv_general_dilated — THE conv path onto the TPU MXU; XLA
+picks the layout, so the NCHW-default paddle API costs nothing vs NHWC.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...ops.dispatch import apply_op, ensure_tensor
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _padding(padding, n, stride, dilation, kernel):
+    """Resolve paddle padding spec → lax padding list or string."""
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n_spatial,
+          data_format, op_name):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    stride = _tuplize(stride, n_spatial)
+    dilation = _tuplize(dilation, n_spatial)
+    channel_last = not data_format.startswith("NC")
+    spatial = "DHW"[-n_spatial:]
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    pad = _padding(padding, n_spatial, stride, dilation, None)
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, out_spec))
+
+    def fn(a, w, *maybe_b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else out.ndim - 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, ensure_tensor(bias))
+    return apply_op(op_name, fn, args, {})
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None) -> Tensor:
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 "NCW" if data_format == "NCL" else "NWC", "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None) -> Tensor:
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None) -> Tensor:
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n_spatial, data_format, op_name, output_size=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    stride = _tuplize(stride, n_spatial)
+    dilation = _tuplize(dilation, n_spatial)
+    out_pad = _tuplize(output_padding, n_spatial)
+    channel_last = not data_format.startswith("NC")
+    spatial = "DHW"[-n_spatial:]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # paddle conv_transpose weight layout: (in_channels, out_channels/groups, *k)
+    rhs_spec = "IO" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, lhs_spec))
+
+    if isinstance(padding, str):
+        pad_cfg = padding.upper()
+    else:
+        base = _padding(padding, n_spatial, stride, dilation, None)
+        kernel = weight.shape[2:]
+        # gradient-of-conv padding: lo = dilation*(k-1) - pad_lo
+        pad_cfg = []
+        for i in range(n_spatial):
+            k_eff = dilation[i] * (kernel[i] - 1)
+            lo, hi = base[i]
+            pad_cfg.append((k_eff - lo, k_eff - hi + out_pad[i]))
+
+    def fn(a, w, *maybe_b):
+        w_flipped = jnp.flip(w, axis=tuple(range(2, 2 + n_spatial)))
+        if groups > 1:
+            # lax grouped conv wants rhs I = C_in/groups with O blocked by
+            # group; regroup (C_in, C_out/g, k) -> (C_in/g, C_out, k) so
+            # output block i consumes input block i.
+            cin, cog = w_flipped.shape[0], w_flipped.shape[1]
+            k = w_flipped.shape[2:]
+            w_flipped = (w_flipped
+                         .reshape((groups, cin // groups, cog) + k)
+                         .transpose((1, 0, 2) + tuple(range(3, 3 + n_spatial)))
+                         .reshape((cin // groups, groups * cog) + k))
+        out = jax.lax.conv_general_dilated(
+            a, w_flipped, window_strides=(1,) * n_spatial,
+            padding=pad_cfg if not isinstance(pad_cfg, str) else pad_cfg,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else out.ndim - 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, ensure_tensor(bias))
+    return apply_op(op_name, fn, args, {})
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None) -> Tensor:
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1,
+                           "NCW" if data_format == "NCL" else "NWC",
+                           "conv1d_transpose", output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None) -> Tensor:
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format,
+                           "conv2d_transpose", output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None) -> Tensor:
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format,
+                           "conv3d_transpose", output_size)

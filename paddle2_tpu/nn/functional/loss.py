@@ -1,0 +1,370 @@
+"""Loss functionals (python/paddle/nn/functional/loss.py parity)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ...ops.dispatch import apply_op, ensure_tensor
+
+__all__ = ["cross_entropy", "softmax_with_cross_entropy", "nll_loss",
+           "binary_cross_entropy", "binary_cross_entropy_with_logits",
+           "mse_loss", "l1_loss", "smooth_l1_loss", "kl_div", "margin_ranking_loss",
+           "hinge_embedding_loss", "cosine_embedding_loss", "ctc_loss",
+           "sigmoid_focal_loss", "square_error_cost", "log_loss",
+           "triplet_margin_loss", "poisson_nll_loss", "huber_loss"]
+
+
+def _reduce(out_fn, reduction):
+    def wrap(a, *rest):
+        out = out_fn(a, *rest)
+        if reduction == "mean":
+            return jnp.mean(out)
+        if reduction == "sum":
+            return jnp.sum(out)
+        return out
+    return wrap
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    tensors = [input, label]
+    has_w = weight is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+
+    def fn(logits, lbl, *w):
+        logp = (jax.nn.log_softmax(logits, axis=axis) if use_softmax
+                else jnp.log(jnp.clip(logits, 1e-12, None)))
+        n_classes = logits.shape[axis]
+        if soft_label or (lbl.ndim == logits.ndim
+                          and lbl.shape[axis] == n_classes
+                          and jnp.issubdtype(lbl.dtype, jnp.inexact)):
+            soft = lbl
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+            if reduction == "mean":
+                return jnp.mean(loss)
+            if reduction == "sum":
+                return jnp.sum(loss)
+            return loss
+        lbl_i = lbl
+        if lbl_i.ndim == logits.ndim:
+            lbl_i = jnp.squeeze(lbl_i, axis=axis)
+        lbl_i = lbl_i.astype(jnp.int32)
+        valid = lbl_i != ignore_index
+        safe = jnp.where(valid, lbl_i, 0)
+        picked = -jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis), axis=axis).squeeze(axis)
+        if label_smoothing > 0:
+            uniform = -jnp.mean(logp, axis=axis)
+            picked = (1 - label_smoothing) * picked + label_smoothing * uniform
+        if has_w:
+            wv = jnp.take(w[0], safe)
+            picked = picked * wv
+            denom = jnp.sum(jnp.where(valid, wv, 0.0))
+        else:
+            denom = jnp.sum(valid.astype(picked.dtype))
+        picked = jnp.where(valid, picked, 0.0)
+        if reduction == "mean":
+            return jnp.sum(picked) / jnp.maximum(denom, 1e-12)
+        if reduction == "sum":
+            return jnp.sum(picked)
+        return picked
+    return apply_op("cross_entropy", fn, tuple(tensors), {})
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    tensors = [input, label]
+    has_w = weight is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    def fn(logp, lbl, *w):
+        lbl = lbl.astype(jnp.int32)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = -jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+        if has_w:
+            wv = jnp.take(w[0], safe)
+            picked *= wv
+            denom = jnp.sum(jnp.where(valid, wv, 0.0))
+        else:
+            denom = jnp.sum(valid.astype(picked.dtype))
+        picked = jnp.where(valid, picked, 0.0)
+        if reduction == "mean":
+            return jnp.sum(picked) / jnp.maximum(denom, 1e-12)
+        if reduction == "sum":
+            return jnp.sum(picked)
+        return picked
+    return apply_op("nll_loss", fn, tuple(tensors), {})
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    tensors = [input, label]
+    has_w = weight is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    def fn(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        out = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if has_w:
+            out = out * w[0]
+        if reduction == "mean":
+            return jnp.mean(out)
+        if reduction == "sum":
+            return jnp.sum(out)
+        return out
+    return apply_op("bce", fn, tuple(tensors), {})
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None) -> Tensor:
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+    tensors = [logit, label]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_pw:
+        tensors.append(ensure_tensor(pos_weight))
+    def fn(z, y, *rest):
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        i = 0
+        if has_pw:
+            pw = rest[-1]
+            out = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            out = -(y * log_sig + (1 - y) * log_sig_neg)
+        if has_w:
+            out = out * rest[0]
+        if reduction == "mean":
+            return jnp.mean(out)
+        if reduction == "sum":
+            return jnp.sum(out)
+        return out
+    return apply_op("bce_logits", fn, tuple(tensors), {})
+
+
+def mse_loss(input, label, reduction="mean", name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply_op("mse_loss", _reduce(lambda a, b: jnp.square(a - b),
+                                        reduction), (input, label), {})
+
+
+def square_error_cost(input, label) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply_op("square_error_cost", lambda a, b: jnp.square(a - b),
+                    (input, label), {})
+
+
+def l1_loss(input, label, reduction="mean", name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply_op("l1_loss", _reduce(lambda a, b: jnp.abs(a - b), reduction),
+                    (input, label), {})
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    def base(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        return jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+    return apply_op("smooth_l1", _reduce(base, reduction), (input, label), {})
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    def base(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        return jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+    return apply_op("huber", _reduce(base, reduction), (input, label), {})
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    def base(logq, p):
+        if log_target:
+            return jnp.exp(p) * (p - logq)
+        return p * (jnp.log(jnp.clip(p, 1e-12, None)) - logq)
+    return apply_op("kl_div", _reduce(base, reduction), (input, label), {})
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None) -> Tensor:
+    input, other, label = (ensure_tensor(input), ensure_tensor(other),
+                           ensure_tensor(label))
+    def base(a, b, y):
+        return jnp.maximum(0.0, -y * (a - b) + margin)
+    return apply_op("margin_ranking", _reduce(base, reduction),
+                    (input, other, label), {})
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    def base(a, y):
+        return jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+    return apply_op("hinge_embedding", _reduce(base, reduction),
+                    (input, label), {})
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None) -> Tensor:
+    input1, input2, label = (ensure_tensor(input1), ensure_tensor(input2),
+                             ensure_tensor(label))
+    def base(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        return jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return apply_op("cosine_embedding", _reduce(base, reduction),
+                    (input1, input2, label), {})
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None) -> Tensor:
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+    tensors = [logit, label]
+    has_n = normalizer is not None
+    if has_n:
+        tensors.append(ensure_tensor(normalizer))
+    def fn(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        out = a_t * ((1 - p_t) ** gamma) * ce
+        if has_n:
+            out = out / n[0]
+        if reduction == "mean":
+            return jnp.mean(out)
+        if reduction == "sum":
+            return jnp.sum(out)
+        return out
+    return apply_op("focal", fn, tuple(tensors), {})
+
+
+def log_loss(input, label, epsilon=1e-4, name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply_op(
+        "log_loss",
+        lambda p, y: -(y * jnp.log(p + epsilon)
+                       + (1 - y) * jnp.log(1 - p + epsilon)),
+        (input, label), {})
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None) -> Tensor:
+    input, positive, negative = (ensure_tensor(input), ensure_tensor(positive),
+                                 ensure_tensor(negative))
+    def base(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return jnp.maximum(0.0, d_pos - d_neg + margin)
+    return apply_op("triplet", _reduce(base, reduction),
+                    (input, positive, negative), {})
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    def base(a, y):
+        if log_input:
+            out = jnp.exp(a) - y * a
+        else:
+            out = a - y * jnp.log(a + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(
+                2 * jnp.pi * (y + epsilon))
+            out = out + jnp.where(y > 1, stirling, 0.0)
+        return out
+    return apply_op("poisson_nll", _reduce(base, reduction), (input, label), {})
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False) -> Tensor:
+    """CTC via the standard forward algorithm in log space (lax.scan over
+    time) — the warpctc equivalent (third_party/warpctc in the reference)."""
+    log_probs = ensure_tensor(log_probs)      # (T, B, C), already log-softmax?
+    labels = ensure_tensor(labels)            # (B, S)
+    input_lengths = ensure_tensor(input_lengths)
+    label_lengths = ensure_tensor(label_lengths)
+
+    def fn(lp, lbl, in_len, lbl_len):
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, B, C = lp.shape
+        S = lbl.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        L = 2 * S + 1
+        neg_inf = -1e30
+
+        emit = jnp.take_along_axis(
+            jnp.moveaxis(lp, 0, 1), ext[:, None, :].repeat(T, 1), axis=2)
+        # emit: (B, T, L)
+
+        alpha0 = jnp.full((B, L), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(emit[:, 0, 0])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(S > 0, emit[:, 0, 1], neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, emit_t):
+            a_shift1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            new = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2) + emit_t
+            return new, new
+
+        _, alphas = jax.lax.scan(step, alpha0,
+                                 jnp.moveaxis(emit[:, 1:, :], 1, 0))
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T, B, L)
+
+        t_idx = (in_len.astype(jnp.int32) - 1)
+        final = alphas[t_idx, jnp.arange(B)]          # (B, L)
+        l_end = 2 * lbl_len.astype(jnp.int32)
+        p_blank = jnp.take_along_axis(final, l_end[:, None], axis=1)[:, 0]
+        p_label = jnp.take_along_axis(
+            final, jnp.maximum(l_end - 1, 0)[:, None], axis=1)[:, 0]
+        ll = jnp.logaddexp(p_blank, p_label)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lbl_len.astype(loss.dtype), 1))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply_op("ctc_loss", fn,
+                    (log_probs, labels, input_lengths, label_lengths), {})

@@ -1,0 +1,188 @@
+"""Pooling functionals (python/paddle/nn/functional/pooling.py parity) —
+reduce_window lowerings."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ...ops.dispatch import apply_op, ensure_tensor
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
+           "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
+           "adaptive_max_pool2d", "adaptive_max_pool3d", "lp_pool2d"]
+
+
+def _tuplize(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(int(x) for x in v)
+
+
+def _pad_cfg(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+
+
+def _pool(x, kernel, stride, padding, n, reducer, init, ceil_mode,
+          channel_last, count_include_pad=True, name="pool"):
+    x = ensure_tensor(x)
+    kernel = _tuplize(kernel, n)
+    stride = _tuplize(stride if stride is not None else kernel, n)
+    pad = _pad_cfg(padding, n)
+    nd = x.ndim
+    if ceil_mode and not isinstance(pad, str):
+        # extend hi padding so partial trailing windows are kept
+        spatial = ([x.shape[a] for a in range(1, 1 + n)] if channel_last
+                   else [x.shape[a] for a in range(nd - n, nd)])
+        pad = list(pad)
+        for i in range(n):
+            eff = spatial[i] + pad[i][0] + pad[i][1]
+            rem = (eff - kernel[i]) % stride[i]
+            if rem:
+                pad[i] = (pad[i][0], pad[i][1] + (stride[i] - rem))
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        full_pad = ([(0, 0)] + pad + [(0, 0)]) if not isinstance(pad, str) else pad
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        full_pad = ([(0, 0), (0, 0)] + pad) if not isinstance(pad, str) else pad
+
+    def fn(a):
+        if reducer == "max":
+            out = jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, window,
+                                        strides, full_pad)
+            return out.astype(a.dtype)
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, full_pad)
+        if count_include_pad or isinstance(full_pad, str):
+            denom = float(np.prod(kernel))
+            return (s / denom).astype(a.dtype)
+        ones = jnp.ones_like(a)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                       full_pad)
+        return (s / counts).astype(a.dtype)
+    return apply_op(name, fn, (x,), {})
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, "max", None, ceil_mode,
+                not data_format.startswith("NC"), name="max_pool1d")
+    return (out, None) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, "max", None, ceil_mode,
+                not data_format.startswith("NC"), name="max_pool2d")
+    return (out, None) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, "max", None, ceil_mode,
+                not data_format.startswith("NC"), name="max_pool3d")
+    return (out, None) if return_mask else out
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", None, ceil_mode,
+                 not data_format.startswith("NC"),
+                 count_include_pad=not exclusive, name="avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", None, ceil_mode,
+                 not data_format.startswith("NC"),
+                 count_include_pad=not exclusive, name="avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", None, ceil_mode,
+                 not data_format.startswith("NC"),
+                 count_include_pad=not exclusive, name="avg_pool3d")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    p = float(norm_type)
+    powed = apply_op("lp_pow", lambda a: jnp.abs(a) ** p, (x,), {})
+    pooled = _pool(powed, kernel_size, stride, padding, 2, "avg", None,
+                   ceil_mode, not data_format.startswith("NC"),
+                   name="lp_pool2d")
+    kernel = _tuplize(kernel_size, 2)
+    scale = float(np.prod(kernel))
+    return apply_op("lp_root", lambda a: (a * scale) ** (1.0 / p), (pooled,), {})
+
+
+def _adaptive(x, output_size, n, reducer, channel_last, name):
+    x = ensure_tensor(x)
+    out_sizes = _tuplize(output_size, n)
+    nd = x.ndim
+    spatial_axes = (list(range(1, 1 + n)) if channel_last
+                    else list(range(nd - n, nd)))
+
+    def fn(a):
+        out = a
+        for i, ax in enumerate(spatial_axes):
+            osz = out_sizes[i]
+            if osz is None:
+                continue
+            isz = out.shape[ax]
+            # split positions follow paddle: start = floor(i*I/O), end = ceil((i+1)*I/O)
+            starts = [int(np.floor(j * isz / osz)) for j in range(osz)]
+            ends = [int(np.ceil((j + 1) * isz / osz)) for j in range(osz)]
+            pieces = []
+            for s, e in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(out, s, e, axis=ax)
+                if reducer == "max":
+                    pieces.append(jnp.max(seg, axis=ax, keepdims=True))
+                else:
+                    pieces.append(jnp.mean(seg, axis=ax, keepdims=True))
+            out = jnp.concatenate(pieces, axis=ax)
+        return out
+    return apply_op(name, fn, (x,), {})
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", False, "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg",
+                     not data_format.startswith("NC"), "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg",
+                     not data_format.startswith("NC"), "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 1, "max", False, "adaptive_max_pool1d")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 2, "max", False, "adaptive_max_pool2d")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 3, "max", False, "adaptive_max_pool3d")
+    return (out, None) if return_mask else out

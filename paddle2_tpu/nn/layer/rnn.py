@@ -1,0 +1,339 @@
+"""Recurrent layers (python/paddle/nn/layer/rnn.py parity).
+
+Each cell exposes a pure `step(x_t, states, *params)` function; RNN records
+ONE tape op whose forward is a lax.scan over time — the XLA-native recurrence
+(static trip count, one compiled kernel, O(1) tape nodes instead of O(T)).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...ops.dispatch import apply_op, ensure_tensor
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["SimpleRNNCell", "GRUCell", "LSTMCell", "RNN", "SimpleRNN", "GRU",
+           "LSTM", "BiRNN"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops.creation import full
+        batch = batch_ref.shape[batch_dim_idx]
+        if isinstance(self.state_shape[0], (list, tuple)):
+            return tuple(full([batch] + list(s), init_value,
+                              dtype or "float32") for s in self.state_shape)
+        return full([batch] + list(self.state_shape), init_value,
+                    dtype or "float32")
+
+    def _params(self):
+        return (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+
+    # pure step: (x_t, state_tuple, *param_arrays) -> (out, new_state_tuple)
+    @staticmethod
+    def step(x, states, wi, wh, bi, bh):
+        raise NotImplementedError
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        single = not isinstance(states, (tuple, list))
+        state_list = [states] if single else list(states)
+        inputs = ensure_tensor(inputs)
+        n_states = len(state_list)
+        cls = type(self)
+        extra = ({"activation": self.activation}
+                 if isinstance(self, SimpleRNNCell) else {})
+
+        def fn(x, *rest):
+            st = tuple(rest[:n_states])
+            params = rest[n_states:]
+            out, new_st = cls.step(x, st if not single else (st[0],), *params,
+                                   **extra)
+            return (out,) + tuple(new_st if isinstance(new_st, tuple)
+                                  else (new_st,))
+        outs = apply_op(cls.__name__, fn,
+                        (inputs, *[ensure_tensor(s) for s in state_list],
+                         *self._params()), {})
+        out = outs[0]
+        new_states = outs[1] if single and len(outs) == 2 else tuple(outs[1:])
+        return out, new_states
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    @staticmethod
+    def step(x, states, wi, wh, bi, bh, activation="tanh"):
+        (h,) = states
+        act = jnp.tanh if activation == "tanh" else jax.nn.relu
+        out = act(x @ wi.T + bi + h @ wh.T + bh)
+        return out, (out,)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    @staticmethod
+    def step(x, states, wi, wh, bi, bh):
+        (h,) = states
+        hs = wh.shape[1]
+        gi = x @ wi.T + bi
+        gh = h @ wh.T + bh
+        r = jax.nn.sigmoid(gi[..., :hs] + gh[..., :hs])
+        z = jax.nn.sigmoid(gi[..., hs:2 * hs] + gh[..., hs:2 * hs])
+        c = jnp.tanh(gi[..., 2 * hs:] + r * gh[..., 2 * hs:])
+        out = (1 - z) * c + z * h
+        return out, (out,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    @staticmethod
+    def step(x, states, wi, wh, bi, bh):
+        hp, cp = states
+        hs = wh.shape[1]
+        gates = x @ wi.T + bi + hp @ wh.T + bh
+        i = jax.nn.sigmoid(gates[..., :hs])
+        f = jax.nn.sigmoid(gates[..., hs:2 * hs])
+        g = jnp.tanh(gates[..., 2 * hs:3 * hs])
+        o = jax.nn.sigmoid(gates[..., 3 * hs:])
+        cn = f * cp + i * g
+        hn = o * jnp.tanh(cn)
+        return hn, (hn, cn)
+
+
+class RNN(Layer):
+    """Sequence scan around a cell: one lax.scan per forward
+    (python/paddle/nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = ensure_tensor(inputs)
+        batch_idx = 1 if self.time_major else 0
+        if initial_states is None:
+            initial_states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=batch_idx)
+        single = not isinstance(initial_states, (tuple, list))
+        state_list = [initial_states] if single else list(initial_states)
+        n_states = len(state_list)
+        time_axis = 0 if self.time_major else 1
+        step = type(self.cell).step
+        reverse = self.is_reverse
+        extra = ({"activation": self.cell.activation}
+                 if isinstance(self.cell, SimpleRNNCell) else {})
+
+        def fn(x, *rest):
+            st = tuple(rest[:n_states])
+            params = rest[n_states:]
+            xs = jnp.moveaxis(x, time_axis, 0)
+            if reverse:
+                xs = jnp.flip(xs, axis=0)
+
+            def body(carry, x_t):
+                out, new_st = step(x_t, carry, *params, **extra)
+                return tuple(new_st), out
+
+            final_st, outs = jax.lax.scan(body, st, xs)
+            if reverse:
+                outs = jnp.flip(outs, axis=0)
+            outs = jnp.moveaxis(outs, 0, time_axis)
+            return (outs,) + tuple(final_st)
+
+        results = apply_op("rnn_scan", fn,
+                           (inputs, *[ensure_tensor(s) for s in state_list],
+                            *self.cell._params()), {})
+        outputs = results[0]
+        final = results[1] if single and len(results) == 2 else tuple(results[1:])
+        return outputs, final
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        from ...ops.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    cell_cls = None
+    n_states = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, **cell_kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirectional else 1
+        self.num_directions = num_dirs
+        from .container import LayerList
+        layers = []
+        for i in range(num_layers):
+            in_sz = input_size if i == 0 else hidden_size * num_dirs
+            if self.bidirectional:
+                layers.append(BiRNN(
+                    self._make_cell(in_sz, hidden_size, weight_ih_attr,
+                                    weight_hh_attr, bias_ih_attr, bias_hh_attr,
+                                    **cell_kwargs),
+                    self._make_cell(in_sz, hidden_size, weight_ih_attr,
+                                    weight_hh_attr, bias_ih_attr, bias_hh_attr,
+                                    **cell_kwargs),
+                    time_major))
+            else:
+                layers.append(RNN(
+                    self._make_cell(in_sz, hidden_size, weight_ih_attr,
+                                    weight_hh_attr, bias_ih_attr, bias_hh_attr,
+                                    **cell_kwargs),
+                    False, time_major))
+        self.rnns = LayerList(layers)
+
+    def _make_cell(self, in_sz, hid, wi, wh, bi, bh, **kw):
+        return type(self).cell_cls(in_sz, hid, weight_ih_attr=wi,
+                                   weight_hh_attr=wh, bias_ih_attr=bi,
+                                   bias_hh_attr=bh, **kw)
+
+    def _split_initial(self, initial_states):
+        """paddle packs initial states as (num_layers*num_dirs, batch, hidden)
+        tensors (h for GRU/SimpleRNN; (h, c) tuple for LSTM). Split per
+        layer/direction."""
+        if initial_states is None:
+            return [None] * self.num_layers
+        from ...ops.manipulation import unstack
+        if isinstance(initial_states, (tuple, list)):
+            hs = unstack(initial_states[0], axis=0)
+            cs = unstack(initial_states[1], axis=0)
+            packed = [(hs[i], cs[i]) for i in range(len(hs))]
+        else:
+            packed = [(h,) for h in unstack(initial_states, axis=0)]
+        per_layer = []
+        nd = self.num_directions
+        for i in range(self.num_layers):
+            if nd == 2:
+                fw = packed[2 * i]
+                bw = packed[2 * i + 1]
+                fw = fw if len(fw) > 1 else fw[0]
+                bw = bw if len(bw) > 1 else bw[0]
+                per_layer.append((fw, bw))
+            else:
+                st = packed[i]
+                per_layer.append(st if len(st) > 1 else st[0])
+        return per_layer
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as F
+        out = inputs
+        per_layer_states = self._split_initial(initial_states)
+        final_states = []
+        for i, rnn in enumerate(self.rnns):
+            out, st = rnn(out, per_layer_states[i])
+            final_states.append(st)
+            if self.dropout and i < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        return out, final_states
+
+
+class SimpleRNN(_RNNBase):
+    cell_cls = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kwargs)
+
+
+class GRU(_RNNBase):
+    cell_cls = GRUCell
+
+
+class LSTM(_RNNBase):
+    cell_cls = LSTMCell
+    n_states = 2

@@ -1,0 +1,32 @@
+"""nn.utils (python/paddle/nn/utils/ parity: clip_grad_*, params flatten)."""
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = max(float(jnp.max(jnp.abs(p.grad._data))) for p in params)
+        total_norm = jnp.asarray(total)
+    else:
+        total_norm = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(p.grad._data.astype(jnp.float32)) ** norm_type)
+             for p in params])) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / (total_norm + 1e-6), 1.0)
+    for p in params:
+        p.grad._replace_data((p.grad._data.astype(jnp.float32) * scale)
+                             .astype(p.grad._data.dtype))
+    return Tensor(total_norm)
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = parameters if isinstance(parameters, (list, tuple)) else [parameters]
+    for p in params:
+        if p.grad is not None:
+            p.grad._replace_data(jnp.clip(p.grad._data, -clip_value, clip_value))

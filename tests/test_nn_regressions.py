@@ -1,0 +1,89 @@
+"""Regression tests for nn review findings (RNN states, grouped conv-T,
+ceil_mode, gumbel hard, padding_idx, attn dropout, MHA defaults)."""
+
+import numpy as np
+
+import paddle2_tpu as paddle
+import paddle2_tpu.nn as nn
+import paddle2_tpu.nn.functional as F
+
+
+def test_rnn_initial_states_honored():
+    lstm = nn.LSTM(4, 8)
+    x = paddle.randn([2, 5, 4])
+    h0 = paddle.full([1, 2, 8], 10.0)
+    c0 = paddle.full([1, 2, 8], 10.0)
+    o1, _ = lstm(x)
+    o2, _ = lstm(x, (h0, c0))
+    assert not np.allclose(o1.numpy(), o2.numpy())
+
+
+def test_rnn_scan_single_tape_node():
+    gru = nn.GRU(4, 8)
+    x = paddle.randn([2, 16, 4])
+    x.stop_gradient = False
+    out, _ = gru(x)
+    out.sum().backward()
+    assert gru.rnns[0].cell.weight_ih.grad is not None
+    assert x.grad is not None
+
+
+def test_grouped_conv_transpose():
+    out = F.conv2d_transpose(paddle.randn([1, 4, 5, 5]),
+                             paddle.randn([4, 2, 3, 3]), groups=2)
+    assert out.shape == [1, 4, 7, 7]
+
+
+def test_conv_transpose_is_conv_adjoint():
+    import jax
+    import jax.numpy as jnp
+    xx = np.random.rand(1, 2, 4, 4).astype(np.float32)
+    ww = np.random.rand(3, 2, 3, 3).astype(np.float32)
+    dn = jax.lax.conv_dimension_numbers(xx.shape, ww.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    fwd = lambda img: jax.lax.conv_general_dilated(
+        img, jnp.asarray(ww), (2, 2), [(1, 1), (1, 1)], dimension_numbers=dn)
+    y = fwd(jnp.asarray(xx))
+    _, vjp = jax.vjp(fwd, jnp.asarray(xx))
+    (gx,) = vjp(jnp.ones_like(y))
+    out_t = F.conv2d_transpose(
+        paddle.to_tensor(np.ones(y.shape, np.float32)),
+        paddle.to_tensor(ww.copy()), stride=2, padding=1, output_padding=1)
+    np.testing.assert_allclose(out_t.numpy(), np.asarray(gx), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_pool_ceil_mode():
+    out = F.max_pool2d(paddle.randn([1, 1, 5, 5]), 2, 2, ceil_mode=True)
+    assert out.shape == [1, 1, 3, 3]
+    out = F.avg_pool2d(paddle.to_tensor(np.ones((1, 1, 5, 5), np.float32)),
+                       2, 2, ceil_mode=True)
+    np.testing.assert_allclose(out.numpy()[0, 0], 1.0)  # exclusive avg
+
+
+def test_gumbel_softmax_hard():
+    out = F.gumbel_softmax(paddle.randn([3, 5]), hard=True)
+    np.testing.assert_allclose(out.numpy().sum(-1), 1.0, rtol=1e-5)
+    assert set(np.unique(out.numpy())).issubset({0.0, 1.0})
+
+
+def test_embedding_negative_padding_idx():
+    w = paddle.randn([5, 3])
+    out = F.embedding(paddle.to_tensor(np.array([4, 1])), w, padding_idx=-1)
+    np.testing.assert_allclose(out.numpy()[0], 0.0)
+
+
+def test_attention_dropout_active():
+    q = paddle.randn([1, 8, 2, 4])
+    o1 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.9, training=True)
+    o2 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.0)
+    assert not np.allclose(o1.numpy(), o2.numpy())
+    o3 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.9, training=False)
+    np.testing.assert_allclose(o3.numpy(), o2.numpy())
+
+
+def test_mha_value_defaults_to_query():
+    mha = nn.MultiHeadAttention(8, 2)
+    q, k = paddle.randn([1, 3, 8]), paddle.randn([1, 3, 8])
+    np.testing.assert_allclose(mha(q, key=k).numpy(),
+                               mha(q, key=k, value=q).numpy())
