@@ -1,1 +1,114 @@
+"""AMP: auto_cast / GradScaler / decorate (python/paddle/amp/ parity).
 
+TPU-native stance: bf16 is the native mixed-precision dtype (MXU runs bf16 at
+full rate, no loss scaling needed); fp16 + dynamic GradScaler is kept for API
+parity. O1 inserts per-op casts via the dispatch hook (the reference does this
+inside generated ad_funcs — eager_gen.py:589 AMP_LOGIC_TEMPLATE); O2 casts
+parameters once (decorate).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import core
+from ..framework.tensor import Tensor
+from . import amp_lists
+from .grad_scaler import GradScaler  # noqa: F401
+
+
+class _AmpState:
+    __slots__ = ("enable", "dtype", "level", "custom_white", "custom_black")
+
+    def __init__(self, enable, dtype, level, custom_white, custom_black):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level
+        self.custom_white = set(custom_white or ())
+        self.custom_black = set(custom_black or ())
+
+
+def amp_state() -> Optional[_AmpState]:
+    return getattr(core._tls(), "amp_state", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast parity (auto_cast.py:144)."""
+    tls = core._tls()
+    prev = getattr(tls, "amp_state", None)
+    tls.amp_state = _AmpState(enable, core.convert_dtype(dtype), level,
+                              custom_white_list, custom_black_list) \
+        if enable else None
+    try:
+        yield
+    finally:
+        tls.amp_state = prev
+
+
+amp_guard = auto_cast  # legacy alias
+
+
+def cast_inputs_for_op(name: str, arrays):
+    """Dispatch hook: apply O1/O2 per-op casting. Returns possibly-cast arrays."""
+    st = amp_state()
+    if st is None or not st.enable:
+        return arrays
+    white = (name in amp_lists.WHITE_LIST or name in st.custom_white) \
+        and name not in st.custom_black
+    black = (name in amp_lists.BLACK_LIST or name in st.custom_black) \
+        and name not in st.custom_white
+    if st.level == "O2":
+        target = jnp.float32 if black else st.dtype
+    else:
+        if white:
+            target = st.dtype
+        elif black:
+            target = jnp.float32
+        else:
+            return arrays
+    out = []
+    for a in arrays:
+        if jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != target:
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False):
+    """paddle.amp.decorate parity: cast model params to the amp dtype (O2),
+    keeping norm-family params in fp32 for stability."""
+    from ..nn.layer.norm import _BatchNormBase, GroupNorm, LayerNorm, RMSNorm
+    dt = core.convert_dtype(dtype)
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    if level == "O2":
+        for model in model_list:
+            keep_fp32_params = set()
+            for layer in model.sublayers(include_self=True):
+                if isinstance(layer, (_BatchNormBase, LayerNorm, GroupNorm,
+                                      RMSNorm)):
+                    for p in layer.parameters(include_sublayers=False):
+                        keep_fp32_params.add(id(p))
+            for p in model.parameters():
+                if (id(p) not in keep_fp32_params
+                        and jnp.issubdtype(p._data.dtype, jnp.floating)):
+                    p._replace_data(p._data.astype(dt))
+    if optimizers is None:
+        return models if isinstance(models, (list, tuple)) else model_list[0]
+    return (models if isinstance(models, (list, tuple)) else model_list[0],
+            optimizers)
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    return True
+
+
+def is_float16_supported(device=None) -> bool:
+    return True

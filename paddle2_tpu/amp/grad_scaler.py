@@ -1,0 +1,113 @@
+"""GradScaler (python/paddle/amp/grad_scaler.py parity).
+
+Dynamic loss scaling for fp16; with bf16 (the TPU default) scaling is usually
+unnecessary — enable=False makes every method a passthrough, matching the
+reference's behavior knobs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._dynamic
+
+    def get_loss_scaling(self) -> float:
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def scale(self, loss: Tensor) -> Tensor:
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer) -> None:
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / self._scale
+        found_inf = False
+        for p in optimizer._parameter_list():
+            if p.grad is not None:
+                g = p.grad._data.astype(jnp.float32) * inv
+                if bool(jnp.any(~jnp.isfinite(g))):
+                    found_inf = True
+                p.grad._replace_data(g.astype(p.grad._data.dtype))
+        self._found_inf = found_inf
+        self._unscaled = True
+
+    def step(self, optimizer) -> None:
+        """Unscale + conditionally step. Does NOT update the scale — call
+        update() after, like the reference (grad_scaler.py:802 pattern:
+        `scaler.step(opt); scaler.update()`)."""
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def minimize(self, optimizer, loss) -> None:
+        """step + update in one call (reference minimize semantics)."""
+        self.step(optimizer)
+        if self._enable:
+            self.update()
+
+    def update(self) -> None:
+        if not self._enable:
+            return
+        if not self._dynamic:
+            self._unscaled = False
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def state_dict(self) -> Dict:
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state: Dict) -> None:
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)

@@ -1,0 +1,59 @@
+"""Process/rank environment (ParallelEnv parity, parallel.py:677).
+
+Ranks come from PADDLE_* env vars set by the launcher, falling back to
+JAX process indices (multi-host PJRT) and then to single-process defaults.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def get_rank() -> int:
+    v = os.environ.get("PADDLE_TRAINER_ID") or os.environ.get("RANK")
+    if v is not None:
+        return int(v)
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    v = (os.environ.get("PADDLE_TRAINERS_NUM")
+         or os.environ.get("WORLD_SIZE"))
+    if v is not None:
+        return int(v)
+    try:
+        import jax
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", get_rank()))
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else ["127.0.0.1:6170"]

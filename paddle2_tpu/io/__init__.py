@@ -1,1 +1,1 @@
-
+from .dataloader import *  # noqa: F401,F403

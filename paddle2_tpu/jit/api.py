@@ -1,0 +1,140 @@
+"""paddle.jit API: to_static / not_to_static / save / load
+(python/paddle/jit/api.py:196 parity)."""
+
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Any, Callable, List, Optional
+
+from ..framework.tensor import Tensor
+from .functional import TracedProgram
+
+__all__ = ["to_static", "not_to_static", "ignore_module", "save", "load",
+           "enable_to_static", "InputSpec", "TranslatedLayer"]
+
+_to_static_enabled = True
+
+
+def enable_to_static(flag: bool):
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+class InputSpec:
+    """Static input signature (paddle.static.InputSpec parity)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+class StaticFunction:
+    def __init__(self, function: Callable, layer=None, input_spec=None,
+                 build_strategy=None, full_graph=True):
+        self._function = function
+        self._layer = layer
+        self._input_spec = input_spec
+        layers = [layer] if layer is not None else []
+        self._program = TracedProgram(function, layers)
+        functools.update_wrapper(self, function,
+                                 assigned=("__name__", "__doc__"), updated=())
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        # method access: bind the layer instance
+        bound = StaticFunction(self._function.__get__(instance, owner),
+                               layer=instance, input_spec=self._input_spec)
+        setattr(instance, self._function.__name__, bound)
+        return bound
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            return self._function(*args, **kwargs)
+        return self._program(*args, **kwargs)
+
+    @property
+    def program_cache_size(self):
+        return self._program.program_cache_size
+
+    def concrete_program(self):
+        return self._program
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Compile a function or Layer to a single XLA executable
+    (python/paddle/jit/api.py:196 parity; the SOT/AST front-end is replaced
+    by direct JAX tracing — see jit/functional.py)."""
+
+    def decorate(obj):
+        from ..nn import Layer
+        if isinstance(obj, Layer):
+            orig_forward = obj.forward
+            program = TracedProgram(orig_forward, [obj])
+            obj._traced_program = program
+            obj.forward = program  # Layer.__call__ routes through the program
+            return obj
+        # plain function or unbound method
+        layer = getattr(obj, "__self__", None)
+        from ..nn import Layer as _L
+        layer = layer if isinstance(layer, _L) else None
+        return StaticFunction(obj, layer=layer, input_spec=input_spec,
+                              build_strategy=build_strategy)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(func):
+    func._not_to_static = True
+    return func
+
+
+def ignore_module(modules: List[Any]):
+    pass
+
+
+class TranslatedLayer:
+    """Loaded inference artifact (jit.load result)."""
+
+    def __init__(self, state_dict, config, layer_factory=None):
+        self._state_dict = state_dict
+        self._config = config
+
+    def state_dict(self):
+        return self._state_dict
+
+    def __call__(self, *args):
+        raise RuntimeError(
+            "TranslatedLayer from jit.load holds weights + config only; "
+            "rebuild the architecture and use set_state_dict (StableHLO "
+            "export lands with the inference milestone)")
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save: persist weights + spec. Weights as numpy pickle; a full
+    StableHLO export (jax.export) is the inference-engine milestone."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    from ..nn import Layer
+    payload = {"config": {"input_spec": [repr(s) for s in (input_spec or [])]}}
+    if isinstance(layer, Layer):
+        payload["state_dict"] = {k: v.numpy()
+                                 for k, v in layer.state_dict().items()}
+    with open(path + ".pdparams", "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load(path, **configs) -> TranslatedLayer:
+    with open(path + ".pdparams", "rb") as f:
+        payload = pickle.load(f)
+    return TranslatedLayer(payload.get("state_dict", {}),
+                           payload.get("config", {}))

@@ -1,0 +1,180 @@
+"""Functionalization bridge: run imperative Layer code as a pure JAX function.
+
+The TPU-native replacement for the reference's SOT/AST dy2static stack
+(python/paddle/jit/sot/ bytecode tracing + PartialProgramLayer running a
+captured program via the run_program op, SURVEY.md §3.3). Because every eager
+op is already a pure JAX call on `Tensor._data`, capturing the program is just
+tracing the same Python code with tracer payloads: parameters/buffers are
+temporarily rebound to traced arrays, the function runs once under jit, and
+XLA compiles the whole graph. Guards (arg shapes/dtypes, training mode, grad
+mode) key the executable cache, mirroring the reference's guard-based compile
+cache (sot/symbolic/compile_cache.py). Backward re-linearizes the program
+inside jit (rematerialized forward) so both directions are single XLA
+executables.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import core
+from ..framework import random as fr
+from ..framework.tensor import Tensor
+from ..autograd.tape import GradNode
+
+_trace_lock = threading.RLock()
+_SENTINEL = "__TENSOR__"
+
+
+def _collect_state(layers) -> Tuple[List[Tensor], List[Tensor]]:
+    params: List[Tensor] = []
+    buffers: List[Tensor] = []
+    seen = set()
+    for layer in layers:
+        for _, p in layer.named_parameters():
+            if id(p) not in seen:
+                seen.add(id(p))
+                params.append(p)
+        for _, b in layer.named_buffers():
+            if b is not None and id(b) not in seen:
+                seen.add(id(b))
+                buffers.append(b)
+    return params, buffers
+
+
+def _split_tensors(args, kwargs):
+    """Flatten (args, kwargs) into (template, tensor_list)."""
+    flat, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    tensors = [a for a in flat if isinstance(a, Tensor)]
+    template = jax.tree_util.tree_unflatten(
+        treedef, [_SENTINEL if isinstance(a, Tensor) else a for a in flat])
+    return template, tensors
+
+
+def _fill_template(template, tensors):
+    it = iter(tensors)
+    return jax.tree_util.tree_map(
+        lambda x: next(it) if x == _SENTINEL else x, template)
+
+
+class TracedProgram:
+    """One traced function: guarded cache of (fwd_jit, vjp_jit) executables."""
+
+    def __init__(self, fn: Callable, layers: Sequence = ()):
+        self.fn = fn
+        self.layers = list(layers)
+        self._compiled: Dict[Any, Any] = {}
+
+    # -- public ----------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        with _trace_lock:
+            return self._call(args, kwargs)
+
+    @property
+    def program_cache_size(self):
+        return len(self._compiled)
+
+    # -- internals -------------------------------------------------------
+    def _call(self, args, kwargs):
+        params, buffers = _collect_state(self.layers)
+        template, args_t = _split_tensors(args, kwargs)
+        arg_arrays = [t._data for t in args_t]
+
+        key = (jax.tree_util.tree_structure(template),
+               tuple(str(x) for x in jax.tree_util.tree_leaves(template)
+                     if not isinstance(x, (jnp.ndarray,))),
+               tuple((tuple(a.shape), str(a.dtype)) for a in arg_arrays),
+               tuple(getattr(l, "training", False) for l in self.layers),
+               core.is_grad_enabled())
+        entry = self._compiled.get(key)
+        if entry is None:
+            entry = self._build(template, params, buffers, len(args_t))
+            self._compiled[key] = entry
+        fwd_jit, vjp_jit, meta = entry
+
+        param_arrays = [p._data for p in params]
+        buffer_arrays = [b._data for b in buffers]
+        rng_key = fr.next_key()
+        result = fwd_jit(param_arrays, buffer_arrays, arg_arrays, rng_key)
+        n_out = meta["n_out"]
+        out_arrays = list(result[:n_out])
+        for b, a in zip(buffers, result[n_out:]):
+            b._replace_data(a)
+
+        diff_inputs = params + args_t
+        needs_grad = (core.is_grad_enabled()
+                      and any(not t.stop_gradient
+                              and jnp.issubdtype(jnp.result_type(t._data),
+                                                 jnp.inexact)
+                              for t in diff_inputs))
+        out_tensors = [Tensor(a, stop_gradient=not needs_grad)
+                       for a in out_arrays]
+        if needs_grad:
+            def run_vjp(cts):
+                if not isinstance(cts, tuple):
+                    cts = (cts,)
+                g_params, g_args = vjp_jit(param_arrays, buffer_arrays,
+                                           arg_arrays, rng_key, tuple(cts))
+                grads = list(g_params) + list(g_args)
+                return tuple(
+                    None if (g is None or g.dtype == jax.dtypes.float0) else g
+                    for g in grads)
+
+            avals = [(tuple(a.shape), a.dtype) for a in out_arrays]
+            node = GradNode("to_static", run_vjp, diff_inputs, avals,
+                            out_is_tuple=True)
+            for i, t in enumerate(out_tensors):
+                t._grad_node = node
+                t._output_index = i
+        return jax.tree_util.tree_unflatten(meta["treedef"], out_tensors)
+
+    def _build(self, template, params, buffers, n_args):
+        fn = self.fn
+        state_tensors = params + buffers
+        n_params = len(params)
+        meta: Dict[str, Any] = {}
+
+        def pure(param_arrays, buffer_arrays, arg_arrays, rng_key):
+            originals = [t._data for t in state_tensors]
+            for t, a in zip(state_tensors, list(param_arrays)
+                            + list(buffer_arrays)):
+                t._data = a
+            try:
+                with core.no_grad(), fr.scoped_rng(rng_key):
+                    call_args, call_kwargs = _fill_template(
+                        template, [Tensor(a) for a in arg_arrays])
+                    out = fn(*call_args, **call_kwargs)
+                post_buffers = [b._data for b in buffers]
+            finally:
+                for t, a in zip(state_tensors, originals):
+                    t._data = a
+            flat, treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            out_arrays = [o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                          for o in flat]
+            meta["treedef"] = treedef
+            meta["n_out"] = len(out_arrays)
+            return tuple(out_arrays) + tuple(post_buffers)
+
+        # meta (treedef/n_out) is filled by the first fwd_jit trace, which
+        # always precedes any vjp_jit call for this guard entry
+        fwd_jit = jax.jit(pure)
+        n_out_holder = meta
+
+        @jax.jit
+        def vjp_jit(param_arrays, buffer_arrays, arg_arrays, rng_key, cts):
+            def f(p_arrays, a_arrays):
+                out = pure(p_arrays, buffer_arrays, a_arrays, rng_key)
+                return out[:n_out_holder["n_out"]]
+
+            outs, vjp_fn = jax.vjp(f, list(param_arrays), list(arg_arrays))
+            full = list(cts) + [jnp.zeros(o.shape, o.dtype)
+                                for o in outs[len(cts):]]
+            return vjp_fn(tuple(full))
+
+        return fwd_jit, vjp_jit, meta

@@ -60,6 +60,9 @@ def apply_op(name: str, fn: Callable, tensors: Sequence[Tensor],
     jit-by-default primitive cache makes this the cheap path).
     """
     arrays = tuple(t._data for t in tensors)
+    if getattr(core._tls(), "amp_state", None) is not None:
+        from ..amp import cast_inputs_for_op
+        arrays = cast_inputs_for_op(name, arrays)
     needs_grad = (differentiable
                   and core.is_grad_enabled()
                   and any(not t.stop_gradient and _is_float(t._data)

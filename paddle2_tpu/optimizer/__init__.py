@@ -1,1 +1,8 @@
+"""paddle.optimizer namespace (python/paddle/optimizer/ parity)."""
 
+from . import lr  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LBFGS, Lion, Momentum,
+    NAdam, RAdam, RMSProp,
+)

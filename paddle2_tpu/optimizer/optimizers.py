@@ -1,0 +1,360 @@
+"""Concrete optimizers (python/paddle/optimizer/{sgd,momentum,adam,adamw,
+lamb,...}.py parity). Each defines only the pure per-parameter update rule;
+the base class fuses all parameters into one jitted TPU kernel."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+           "Adadelta", "RMSProp", "Lamb", "Lion", "NAdam", "RAdam", "LBFGS"]
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _update_one(self, param, grad, state, lr, step):
+        return param - lr * grad, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(
+            p._data, dtype=jnp.float32 if self._multi_precision else None)}
+
+    def _update_one(self, param, grad, state, lr, step):
+        v = self._momentum * state["velocity"] + grad
+        if self._nesterov:
+            new_p = param - lr * (grad + self._momentum * v)
+        else:
+            new_p = param - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+
+    def _init_state(self, p):
+        dt = jnp.float32 if p._data.dtype in (jnp.bfloat16, jnp.float16) \
+            else p._data.dtype
+        s = {"m": jnp.zeros(p._data.shape, dt),
+             "v": jnp.zeros(p._data.shape, dt)}
+        if self._amsgrad:
+            s["vmax"] = jnp.zeros(p._data.shape, dt)
+        return s
+
+    def _update_one(self, param, grad, state, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        t = step.astype(jnp.float32)
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * jnp.square(grad)
+        mhat = m / (1 - b1 ** t)
+        if self._amsgrad:
+            vmax = jnp.maximum(state["vmax"], v)
+            vhat = vmax / (1 - b2 ** t)
+            new_state = {"m": m, "v": v, "vmax": vmax}
+        else:
+            vhat = v / (1 - b2 ** t)
+            new_state = {"m": m, "v": v}
+        new_p = param - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p, new_state
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None,
+                 amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name, amsgrad)
+        self._apply_decay_fn = apply_decay_param_fun
+        if apply_decay_param_fun is not None:
+            # mark params excluded from decay so the fused update skips them
+            for g in self._param_groups:
+                for p in g["params"]:
+                    if not apply_decay_param_fun(p.name):
+                        p.no_weight_decay = True
+
+    def _decoupled_wd(self):
+        return True
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {"m": jnp.zeros_like(p._data),
+                "u": jnp.zeros_like(p._data)}
+
+    def _update_one(self, param, grad, state, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        t = step.astype(jnp.float32)
+        m = b1 * state["m"] + (1 - b1) * grad
+        u = jnp.maximum(b2 * state["u"], jnp.abs(grad))
+        new_p = param - lr / (1 - b1 ** t) * m / (u + eps)
+        return new_p, {"m": m, "u": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p._data, self._init_acc)}
+
+    def _update_one(self, param, grad, state, lr, step):
+        moment = state["moment"] + jnp.square(grad)
+        new_p = param - lr * grad / (jnp.sqrt(moment) + self._eps)
+        return new_p, {"moment": moment}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps, self._rho = epsilon, rho
+
+    def _init_state(self, p):
+        return {"avg_sq_grad": jnp.zeros_like(p._data),
+                "avg_sq_update": jnp.zeros_like(p._data)}
+
+    def _update_one(self, param, grad, state, lr, step):
+        rho, eps = self._rho, self._eps
+        asg = rho * state["avg_sq_grad"] + (1 - rho) * jnp.square(grad)
+        update = (jnp.sqrt(state["avg_sq_update"] + eps)
+                  / jnp.sqrt(asg + eps)) * grad
+        asu = rho * state["avg_sq_update"] + (1 - rho) * jnp.square(update)
+        return param - lr * update, {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, p):
+        s = {"mean_square": jnp.zeros_like(p._data),
+             "moment": jnp.zeros_like(p._data)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(p._data)
+        return s
+
+    def _update_one(self, param, grad, state, lr, step):
+        rho, eps = self._rho, self._eps
+        ms = rho * state["mean_square"] + (1 - rho) * jnp.square(grad)
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * grad
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+            new_state = {"mean_square": ms, "mean_grad": mg}
+        else:
+            denom = jnp.sqrt(ms + eps)
+            new_state = {"mean_square": ms}
+        mom = self._momentum * state["moment"] + lr * grad / denom
+        new_state["moment"] = mom
+        return param - mom, new_state
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        dt = jnp.float32
+        return {"m": jnp.zeros(p._data.shape, dt),
+                "v": jnp.zeros(p._data.shape, dt),
+                "wd": jnp.asarray(
+                    0.0 if (self._exclude_fn is not None
+                            and self._exclude_fn(p)) else self._lamb_wd,
+                    dt)}
+
+    def _update_one(self, param, grad, state, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        t = step.astype(jnp.float32)
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * jnp.square(grad)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + eps) + state["wd"] * param
+        w_norm = jnp.linalg.norm(param.astype(jnp.float32))
+        r_norm = jnp.linalg.norm(r.astype(jnp.float32))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return param - lr * trust * r, {"m": m, "v": v, "wd": state["wd"]}
+
+
+class Lion(Optimizer):
+    """Lion (EvoLved sign momentum) — bf16-friendly, half the state of Adam."""
+
+    def __init__(self, learning_rate=1e-4, beta1=0.9, beta2=0.99,
+                 parameters=None, weight_decay=0.0, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2 = beta1, beta2
+
+    def _decoupled_wd(self):
+        return True
+
+    def _init_state(self, p):
+        return {"m": jnp.zeros_like(p._data)}
+
+    def _update_one(self, param, grad, state, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        update = jnp.sign(b1 * state["m"] + (1 - b1) * grad)
+        m = b2 * state["m"] + (1 - b2) * grad
+        return param - lr * update, {"m": m}
+
+
+class NAdam(Adam):
+    def _update_one(self, param, grad, state, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        t = step.astype(jnp.float32)
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * jnp.square(grad)
+        mhat = (b1 * m / (1 - b1 ** (t + 1))
+                + (1 - b1) * grad / (1 - b1 ** t))
+        vhat = v / (1 - b2 ** t)
+        return param - lr * mhat / (jnp.sqrt(vhat) + eps), {"m": m, "v": v}
+
+
+class RAdam(Adam):
+    def _update_one(self, param, grad, state, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        t = step.astype(jnp.float32)
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * jnp.square(grad)
+        mhat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * t * b2 ** t / (1 - b2 ** t)
+        def rect_update():
+            r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                         / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            vhat = jnp.sqrt(v / (1 - b2 ** t))
+            return param - lr * r * mhat / (vhat + eps)
+        new_p = jnp.where(rho_t > 5.0, rect_update(), param - lr * mhat)
+        return new_p, {"m": m, "v": v}
+
+
+class LBFGS(Optimizer):
+    """L-BFGS (python/paddle/optimizer/lbfgs.py parity, strong-Wolfe-free
+    variant with fixed step fallback). Runs eagerly: the two-loop recursion
+    over a deque of (s, y) pairs is host-side control flow by nature."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._max_iter = max_iter
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history_size = history_size
+        self._line_search_fn = line_search_fn
+        self._hist_s: list = []
+        self._hist_y: list = []
+        self._prev_flat = None
+        self._prev_grad = None
+
+    def _flat(self, arrs):
+        return jnp.concatenate([a.reshape(-1).astype(jnp.float32)
+                                for a in arrs])
+
+    def step(self, closure=None):
+        if closure is not None:
+            with jax.disable_jit(False):
+                loss = closure()
+        params = [p for g in self._param_groups for p in g["params"]
+                  if p.trainable and p.grad is not None]
+        if not params:
+            return
+        flat = self._flat([p._data for p in params])
+        grad = self._flat([p.grad._data for p in params])
+        if self._prev_flat is not None:
+            s = flat - self._prev_flat
+            y = grad - self._prev_grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._hist_s.append(s)
+                self._hist_y.append(y)
+                if len(self._hist_s) > self._history_size:
+                    self._hist_s.pop(0)
+                    self._hist_y.pop(0)
+        q = grad
+        alphas = []
+        for s, y in zip(reversed(self._hist_s), reversed(self._hist_y)):
+            rho = 1.0 / jnp.dot(y, s)
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if self._hist_s:
+            s, y = self._hist_s[-1], self._hist_y[-1]
+            q = q * (jnp.dot(s, y) / jnp.dot(y, y))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        direction = -q
+        lr = self.get_lr()
+        new_flat = flat + lr * direction
+        self._prev_flat = flat
+        self._prev_grad = grad
+        offset = 0
+        for p in params:
+            n = int(np_prod(p.shape))
+            p._replace_data(new_flat[offset:offset + n]
+                            .reshape(p._data.shape).astype(p._data.dtype))
+            offset += n
+        self._step_count += 1
+
+
+def np_prod(shape):
+    out = 1
+    for s in shape:
+        out *= s
+    return out

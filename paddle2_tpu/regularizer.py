@@ -1,0 +1,11 @@
+"""paddle.regularizer parity: L1Decay/L2Decay markers consumed by optimizers."""
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)

@@ -1,0 +1,196 @@
+"""jit.to_static bridge + io.DataLoader tests."""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.nn as nn
+import paddle2_tpu.nn.functional as F
+import paddle2_tpu.optimizer as opt
+from paddle2_tpu.io import (BatchSampler, DataLoader, Dataset,
+                            DistributedBatchSampler, IterableDataset,
+                            TensorDataset, random_split)
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.l1 = nn.Linear(8, 16)
+        self.l2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.l2(F.relu(self.l1(x)))
+
+
+def test_to_static_matches_eager():
+    paddle.seed(0)
+    net = _MLP()
+    x = paddle.randn([4, 8])
+    eager = net(x).numpy()
+    snet = paddle.jit.to_static(net)
+    np.testing.assert_allclose(snet(x).numpy(), eager, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_grads_match_eager():
+    paddle.seed(0)
+    net = _MLP()
+    x = paddle.randn([4, 8])
+    net(x).sum().backward()
+    g_eager = net.l1.weight.grad.numpy().copy()
+    net.clear_gradients()
+    snet = paddle.jit.to_static(net)
+    snet(x).sum().backward()
+    np.testing.assert_allclose(net.l1.weight.grad.numpy(), g_eager,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_to_static_training_loop():
+    paddle.seed(0)
+    net = paddle.jit.to_static(_MLP())
+    o = opt.Adam(learning_rate=0.01, parameters=net.parameters())
+    x, y = paddle.randn([16, 8]), paddle.randint(0, 4, [16])
+    first = None
+    for _ in range(40):
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        if first is None:
+            first = loss.item()
+    assert loss.item() < 0.5 * first
+
+
+def test_to_static_guard_cache():
+    net = paddle.jit.to_static(_MLP())
+    net(paddle.randn([2, 8]))
+    net(paddle.randn([2, 8]))
+    assert net._traced_program.program_cache_size == 1
+    net(paddle.randn([5, 8]))  # new shape → new guard entry
+    assert net._traced_program.program_cache_size == 2
+
+
+def test_to_static_decorator_on_function():
+    lin = nn.Linear(4, 4)
+
+    @paddle.jit.to_static
+    def fn(a, b):
+        return paddle.matmul(a, b) + 1.0
+
+    x, y = paddle.randn([3, 4]), paddle.randn([4, 4])
+    np.testing.assert_allclose(fn(x, y).numpy(),
+                               x.numpy() @ y.numpy() + 1.0, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_to_static_bn_buffers_update():
+    net = nn.Sequential(nn.Conv2D(2, 3, 1), nn.BatchNorm2D(3))
+    snet = paddle.jit.to_static(net)
+    m0 = net[1]._mean.numpy().copy()
+    snet(paddle.randn([4, 2, 5, 5]))
+    assert not np.allclose(net[1]._mean.numpy(), m0)
+    net.eval()
+    m1 = net[1]._mean.numpy().copy()
+    snet(paddle.randn([4, 2, 5, 5]))
+    np.testing.assert_allclose(net[1]._mean.numpy(), m1)
+
+
+def test_to_static_dropout_rng():
+    net = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.5))
+    snet = paddle.jit.to_static(net)
+    a = paddle.ones([4, 8])
+    o1, o2 = snet(a), snet(a)
+    assert not np.allclose(o1.numpy(), o2.numpy())
+    net.eval()
+    np.testing.assert_allclose(snet(a).numpy(), snet(a).numpy())
+
+
+def test_jit_save_load(tmp_path):
+    net = _MLP()
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path)
+    loaded = paddle.jit.load(path)
+    sd = loaded.state_dict()
+    np.testing.assert_allclose(sd["l1.weight"], net.l1.weight.numpy())
+
+
+# ---------------- io ----------------
+
+class _Square(Dataset):
+    def __len__(self):
+        return 10
+
+    def __getitem__(self, i):
+        return np.float32(i), np.int64(i * i)
+
+
+def test_dataloader_basic():
+    dl = DataLoader(_Square(), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 3
+    np.testing.assert_array_equal(batches[0][0].numpy(), [0, 1, 2, 3])
+    np.testing.assert_array_equal(batches[0][1].numpy(), [0, 1, 4, 9])
+    assert len(batches[2][0]) == 2  # remainder kept
+
+
+def test_dataloader_drop_last_and_shuffle():
+    dl = DataLoader(_Square(), batch_size=4, drop_last=True, shuffle=True)
+    batches = list(dl)
+    assert len(batches) == 2
+    seen = np.concatenate([b[0].numpy() for b in batches])
+    assert len(np.unique(seen)) == 8
+
+
+def test_dataloader_workers_ordered():
+    dl = DataLoader(_Square(), batch_size=2, num_workers=3)
+    batches = list(dl)
+    np.testing.assert_array_equal(
+        np.concatenate([b[0].numpy() for b in batches]), np.arange(10))
+
+
+def test_dataloader_worker_error_propagates():
+    class Bad(Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            if i == 2:
+                raise ValueError("boom")
+            return np.float32(i)
+
+    dl = DataLoader(Bad(), batch_size=1, num_workers=2)
+    with pytest.raises(ValueError):
+        list(dl)
+
+
+def test_iterable_dataset():
+    class Stream(IterableDataset):
+        def __iter__(self):
+            return iter(np.arange(7, dtype=np.float32))
+
+    dl = DataLoader(Stream(), batch_size=3)
+    batches = list(dl)
+    assert [len(b) for b in batches] == [3, 3, 1]
+
+
+def test_distributed_batch_sampler_partition():
+    s0 = DistributedBatchSampler(_Square(), batch_size=2, num_replicas=2,
+                                 rank=0)
+    s1 = DistributedBatchSampler(_Square(), batch_size=2, num_replicas=2,
+                                 rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert not set(i0) & set(i1)
+    assert len(i0) == len(i1) == 5
+
+
+def test_collate_nested_dict():
+    class D(Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return {"x": np.full(3, i, np.float32), "meta": (np.int64(i),)}
+
+    batch = next(iter(DataLoader(D(), batch_size=2)))
+    assert batch["x"].shape == [2, 3]
+    assert batch["meta"][0].numpy().tolist() == [0, 1]

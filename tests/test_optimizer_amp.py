@@ -1,0 +1,192 @@
+"""Optimizer / LR scheduler / AMP tests."""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.nn as nn
+import paddle2_tpu.nn.functional as F
+import paddle2_tpu.optimizer as opt
+
+
+def _fit(optimizer_ctor, steps=100, tol_ratio=0.25, **kw):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 32), nn.Tanh(), nn.Linear(32, 1))
+    o = optimizer_ctor(parameters=net.parameters(), **kw)
+    x, y = paddle.randn([16, 4]), paddle.randn([16, 1])
+    first = None
+    for _ in range(steps):
+        loss = F.mse_loss(net(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        if first is None:
+            first = loss.item()
+    assert loss.item() < tol_ratio * first, (first, loss.item())
+    return o
+
+
+@pytest.mark.parametrize("ctor,kw", [
+    (opt.SGD, dict(learning_rate=0.3, steps=150, tol_ratio=0.5)),
+    (opt.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+    (opt.Adam, dict(learning_rate=0.01)),
+    (opt.AdamW, dict(learning_rate=0.01, weight_decay=0.01)),
+    (opt.RMSProp, dict(learning_rate=0.01)),
+    (opt.Adagrad, dict(learning_rate=0.1)),
+    (opt.Adamax, dict(learning_rate=0.02)),
+    (opt.Lamb, dict(learning_rate=0.02)),
+    (opt.Lion, dict(learning_rate=0.005)),
+], ids=lambda v: getattr(v, "__name__", ""))
+def test_optimizer_converges(ctor, kw):
+    _fit(ctor, **kw)
+
+
+def test_adam_matches_reference_formula():
+    p0 = np.array([1.0, 2.0], np.float32)
+    g = np.array([0.1, -0.2], np.float32)
+    p = paddle.to_tensor(p0.copy(), stop_gradient=False)
+    o = opt.Adam(learning_rate=0.1, parameters=[p])
+    p.grad = paddle.to_tensor(g.copy())
+    o.step()
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    ref = p0 - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(p.numpy(), ref, rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    o = opt.AdamW(learning_rate=0.1, parameters=[p], weight_decay=0.5)
+    p.grad = paddle.zeros([1])
+    o.step()
+    # zero grad → update is pure decay: p - lr*wd*p
+    np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 0.5], rtol=1e-5)
+
+
+def test_weight_decay_l2_coupled():
+    p = paddle.to_tensor([2.0], stop_gradient=False)
+    o = opt.SGD(learning_rate=0.1, parameters=[p], weight_decay=0.1)
+    p.grad = paddle.zeros([1])
+    o.step()
+    np.testing.assert_allclose(p.numpy(), [2.0 - 0.1 * 0.1 * 2.0], rtol=1e-5)
+
+
+def test_grad_clip_in_optimizer():
+    p = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    o = opt.SGD(learning_rate=1.0, parameters=[p],
+                grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    p.grad = paddle.to_tensor([30.0, 40.0])
+    o.step()
+    moved = 1.0 - p.numpy()
+    np.testing.assert_allclose(np.linalg.norm(moved), 1.0, rtol=1e-4)
+
+
+def test_optimizer_state_dict_roundtrip():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    p.name = "w"
+    o = opt.Adam(learning_rate=0.1, parameters=[p])
+    p.grad = paddle.to_tensor([0.5])
+    o.step()
+    sd = o.state_dict()
+    p2 = paddle.to_tensor([1.0], stop_gradient=False)
+    p2.name = "w"
+    o2 = opt.Adam(learning_rate=0.1, parameters=[p2])
+    o2.set_state_dict(sd)
+    assert o2._step_count == 1
+    np.testing.assert_allclose(
+        np.asarray(o2._states[id(p2)]["m"]), np.asarray(o._states[id(p)]["m"]))
+
+
+def test_lr_schedulers():
+    s = opt.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(s())
+        s.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
+
+    s = opt.lr.CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(s() - 1.0) < 1e-6
+    s.step(10)
+    assert abs(s()) < 1e-6
+
+    s = opt.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+    assert s() < 0.02
+    for _ in range(12):
+        s.step()
+    assert abs(s() - 0.1) < 1e-6
+
+    s = opt.lr.PiecewiseDecay([2, 4], [0.1, 0.01, 0.001])
+    vals = []
+    for _ in range(5):
+        vals.append(s())
+        s.step()
+    np.testing.assert_allclose(vals, [0.1, 0.1, 0.01, 0.01, 0.001])
+
+    s = opt.lr.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+    s.step(1.0); s.step(1.0); s.step(1.0)
+    assert s() == pytest.approx(0.05)
+
+
+def test_scheduler_drives_optimizer():
+    sched = opt.lr.ExponentialDecay(0.1, gamma=0.5)
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    o = opt.SGD(learning_rate=sched, parameters=[p])
+    assert o.get_lr() == pytest.approx(0.1)
+    sched.step()
+    assert o.get_lr() == pytest.approx(0.05)
+
+
+def test_auto_cast_o1():
+    lin = nn.Linear(4, 4)
+    x = paddle.randn([2, 4])
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = paddle.matmul(x, lin.weight)
+        assert str(out.dtype) == "bfloat16"  # white op computes in bf16
+        s = paddle.exp(out)
+        assert str(s.dtype) == "float32"     # black op promoted to fp32
+    out2 = paddle.matmul(x, lin.weight)
+    assert str(out2.dtype) == "float32"
+
+
+def test_amp_decorate_o2():
+    net = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    net = paddle.amp.decorate(net, level="O2", dtype="bfloat16")
+    assert str(net[0].weight.dtype) == "bfloat16"
+    assert str(net[1].weight.dtype) == "float32"  # norms stay fp32
+
+
+def test_grad_scaler_dynamic():
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                   incr_every_n_steps=1)
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    o = opt.SGD(learning_rate=0.1, parameters=[p])
+    loss = p * 2
+    scaled = scaler.scale(loss.sum())
+    assert scaled.item() == pytest.approx(8.0)
+    scaled.backward()
+    scaler.step(o)
+    np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 2.0], rtol=1e-5)
+    assert scaler.get_loss_scaling() == pytest.approx(8.0)  # grew
+
+    # inf grad skips the step and shrinks the scale
+    p.clear_grad()
+    p.grad = paddle.to_tensor([float("inf")])
+    before = p.numpy().copy()
+    scaler.step(o)
+    np.testing.assert_allclose(p.numpy(), before)
+    assert scaler.get_loss_scaling() < 8.0
+
+
+def test_multi_precision_master_weights():
+    p = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    p._replace_data(p._data.astype(paddle.bfloat16))
+    o = opt.AdamW(learning_rate=1e-4, parameters=[p], multi_precision=True)
+    for _ in range(3):
+        p.grad = paddle.to_tensor(np.full(4, 1e-3, np.float32))
+        o.step()
+    st = o._states[id(p)]
+    assert "master" in st and str(st["master"].dtype) == "float32"
+    assert str(p.dtype) == "bfloat16"
