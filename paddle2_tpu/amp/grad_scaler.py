@@ -45,6 +45,10 @@ class GradScaler:
     def scale(self, loss: Tensor) -> Tensor:
         if not self._enable:
             return loss
+        # a new scale() starts a new step cycle: even if the user skipped
+        # update(), stale unscale/inf state must not leak into this cycle
+        self._unscaled = False
+        self._found_inf = False
         return loss * self._scale
 
     def unscale_(self, optimizer) -> None:

@@ -69,26 +69,39 @@ class PyLayer(metaclass=PyLayerMeta):
         needs_grad = (core.is_grad_enabled()
                       and any(not t.stop_gradient for t in tensor_inputs))
         if needs_grad:
-            def vjp(cts):
-                if not isinstance(cts, tuple):
-                    cts = (cts,)
-                grads = cls.backward(
-                    ctx, *[Tensor(c, stop_gradient=True) for c in cts])
+            def _align(grads) -> List[Any]:
                 if not isinstance(grads, (tuple, list)):
                     grads = (grads,)
                 out_grads: List[Any] = []
                 gi = 0
                 for a in args:
                     if isinstance(a, Tensor):
-                        g = grads[gi] if gi < len(grads) else None
+                        out_grads.append(grads[gi] if gi < len(grads) else None)
                         gi += 1
-                        out_grads.append(
-                            None if g is None else
-                            (g._data if isinstance(g, Tensor) else g))
-                return tuple(out_grads)
+                return out_grads
+
+            def vjp(cts):
+                if not isinstance(cts, tuple):
+                    cts = (cts,)
+                with core.no_grad():  # array mode must not re-record the tape
+                    grads = cls.backward(
+                        ctx, *[Tensor(c, stop_gradient=True) for c in cts])
+                return tuple(
+                    None if g is None else
+                    (g._data if isinstance(g, Tensor) else g)
+                    for g in _align(grads))
+
+            def tensor_apply(ct_tensors):
+                # create_graph: run the user's backward with grad ENABLED so
+                # its eager ops land on the tape (double grad through PyLayer)
+                grads = cls.backward(ctx, *ct_tensors)
+                return [None if g is None else
+                        (g if isinstance(g, Tensor) else Tensor(g))
+                        for g in _align(grads)]
 
             avals = [(tuple(o.shape), o.dtype) for o in out_list]
-            node = GradNode(cls.__name__, vjp, tensor_inputs, avals)
+            node = GradNode(cls.__name__, vjp, tensor_inputs, avals,
+                            tensor_apply=tensor_apply)
             for i, o in enumerate(out_list):
                 o.stop_gradient = False
                 o._grad_node = node

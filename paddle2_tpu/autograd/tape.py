@@ -32,11 +32,12 @@ class GradNode:
     """
 
     __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "out_is_tuple",
-                 "__weakref__")
+                 "fwd_fn", "tensor_apply", "_live_slots", "__weakref__")
 
     def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any],
                  out_avals: Sequence[Tuple[Tuple[int, ...], Any]],
-                 out_is_tuple: bool = False):
+                 out_is_tuple: bool = False, fwd_fn: Optional[Callable] = None,
+                 tensor_apply: Optional[Callable] = None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)   # Tensor objects (leaf or intermediate)
@@ -44,6 +45,13 @@ class GradNode:
         # whether the forward fn returned a tuple (the vjp_fn expects the
         # cotangent pytree to match — a 1-tuple is NOT a bare array)
         self.out_is_tuple = out_is_tuple
+        # pure array→array forward (kwargs closed over); create_graph re-
+        # linearizes through it so second order sees the forward inputs
+        self.fwd_fn = fwd_fn
+        # optional create_graph path: list[Tensor cotangents] -> list[grads],
+        # run with grad ENABLED so its eager ops land on the tape (PyLayer)
+        self.tensor_apply = tensor_apply
+        self._live_slots: Optional[List[int]] = None  # cached probe result
 
     def apply(self, cotangents: List[Optional[jnp.ndarray]]) -> Tuple:
         full = []
@@ -55,6 +63,20 @@ class GradNode:
         if not isinstance(out, tuple):
             out = (out,)
         return out
+
+    def live_slots(self) -> List[int]:
+        """Input positions that receive a (non-float0) gradient — dtype-static,
+        probed once with jax.eval_shape (zero FLOPs) and cached."""
+        if self._live_slots is None:
+            structs = tuple(jax.ShapeDtypeStruct(shape, dtype)
+                            for shape, dtype in self.out_avals)
+            raw = jax.eval_shape(
+                lambda cts: self.vjp_fn(cts if self.out_is_tuple else cts[0]),
+                structs)
+            self._live_slots = [
+                i for i, g in enumerate(raw)
+                if g is not None and g.dtype != jax.dtypes.float0]
+        return self._live_slots
 
 
 _engine_tls = threading.local()
@@ -69,22 +91,145 @@ def _check_nan_inf(name: str, arrays: Sequence[jnp.ndarray]) -> None:
                     f"(FLAGS_check_nan_inf is enabled)")
 
 
-def run_backward(tensors: Sequence[Any], grad_tensors: Sequence[Optional[Any]],
-                 retain_graph: bool = False) -> None:
-    """Reverse-topological execution over the GradNode DAG.
+def _make_relinearize_fn(fwd_fn: Callable, is_tuple: bool, n_in: int,
+                         live: Sequence[int]) -> Callable:
+    """Pure fn (fwd inputs..., cotangents...) -> live input grads.
+
+    Module-level factory: the returned closure must capture THESE bindings,
+    not loop variables of the walker (which are rebound every iteration).
+    """
+    live = tuple(live)
+
+    def fn(*arrays):
+        ins, ct_arrays = arrays[:n_in], arrays[n_in:]
+        _, vjp_fn = jax.vjp(fwd_fn, *ins)
+        r = vjp_fn(tuple(ct_arrays) if is_tuple else ct_arrays[0])
+        out = tuple(r[i] for i in live)
+        return out if len(out) > 1 else out[0]
+
+    return fn
+
+
+def _make_ct_only_fn(vjp_fn: Callable, is_tuple: bool,
+                     live: Sequence[int]) -> Callable:
+    """Pure fn (cotangents...) -> live input grads, residuals as constants.
+
+    Used when a node has no stored forward (to_static programs): first-order
+    correct, but the result is constant w.r.t. the node's forward inputs.
+    """
+    live = tuple(live)
+
+    def fn(*ct_arrays):
+        r = vjp_fn(tuple(ct_arrays) if is_tuple else ct_arrays[0])
+        out = tuple(r[i] for i in live)
+        return out if len(out) > 1 else out[0]
+
+    return fn
+
+
+def _apply_node_tensor_mode(node: GradNode, cts: List[Optional[Any]]):
+    """Apply one GradNode with Tensor cotangents THROUGH apply_op, so the
+    backward computation itself lands on the tape (create_graph=True)."""
+    from ..framework.tensor import Tensor
+    from ..ops.dispatch import apply_op
+
+    full = [ct if ct is not None else Tensor(jnp.zeros(shape, dtype))
+            for ct, (shape, dtype) in zip(cts, node.out_avals)]
+    if node.tensor_apply is not None:
+        # the node knows how to run its backward as eager Tensor ops
+        # (PyLayer: the user's backward staticmethod, taped live)
+        return node.tensor_apply(full)
+    live = node.live_slots()
+    in_grads: List[Optional[Any]] = [None] * len(node.inputs)
+    if not live:
+        return in_grads
+    if node.fwd_fn is not None:
+        fn = _make_relinearize_fn(node.fwd_fn, node.out_is_tuple,
+                                  len(node.inputs), live)
+        res = apply_op("grad_" + node.name, fn,
+                       tuple(node.inputs) + tuple(full), {})
+    else:
+        import warnings
+        warnings.warn(
+            f"create_graph=True through op '{node.name}' which has no stored "
+            "forward: its gradient is treated as CONSTANT w.r.t. the forward "
+            "inputs, so higher-order derivatives through it are dropped",
+            RuntimeWarning, stacklevel=3)
+        fn = _make_ct_only_fn(node.vjp_fn, node.out_is_tuple, live)
+        res = apply_op("grad_" + node.name, fn, tuple(full), {})
+    res = list(res) if isinstance(res, (tuple, list)) else [res]
+    for i, g in zip(live, res):
+        in_grads[i] = g
+    return in_grads
+
+
+def _execute_backward(tensors: Sequence[Any],
+                      grad_tensors: Sequence[Optional[Any]],
+                      retain_graph: bool = False,
+                      capture: Optional[Tuple[Dict[int, Any], set]] = None,
+                      accumulate: bool = True,
+                      no_grad_ids: frozenset = frozenset(),
+                      tensor_mode: bool = False) -> None:
+    """Reverse-topological execution over the GradNode DAG — ONE engine for
+    backward(), paddle.grad() and paddle.grad(create_graph=True).
 
     Same structure as RunBackward (backward.cc:105): build an in-degree map
     from the root set, then drain a ready queue, accumulating per-node output
     cotangents until all consumers have reported.
+
+    - ``capture=(sink, idset)`` routes the cotangent of every tensor whose
+      ``id`` is in ``idset`` — leaf or interior — into ``sink`` as well
+      (paddle.grad's only_inputs path). Tensors with ``stop_gradient=True``
+      are constants and are never captured (reference semantics).
+    - ``accumulate=False`` suppresses leaf ``.grad`` mutation entirely, so
+      ``paddle.grad`` has no side effects on uninvolved leaves.
+    - ``no_grad_ids`` cuts propagation at those tensors (no_grad_vars).
+    - ``tensor_mode=True``: cotangents are eager Tensors and every node is
+      applied through apply_op, recording the backward on the tape
+      (create_graph=True — double grad).
     """
     from ..framework.tensor import Tensor  # cycle: tensor imports tape
 
+    cap_sink, cap_ids = capture if capture is not None else (None, frozenset())
+
+    def captured(t, g) -> None:
+        cur = cap_sink.get(id(t))
+        cap_sink[id(t)] = g if cur is None else cur + g
+
+    def as_value(g):
+        # cotangent payload: Tensor in tensor mode, raw array otherwise
+        if tensor_mode:
+            return g if isinstance(g, Tensor) else Tensor(g)
+        return g._data if isinstance(g, Tensor) else g
+
+    def ones_like(t):
+        arr = jnp.ones(t.shape, t.dtype)
+        return Tensor(arr) if tensor_mode else arr
+
+    def run_hooks(inp, g):
+        if not inp._hooks:
+            return g
+        if tensor_mode:
+            # call hooks on the live Tensor — their ops stay on the tape
+            for h in inp._hooks:
+                out = h(g)
+                if out is not None:
+                    g = out if isinstance(out, Tensor) else Tensor(out)
+            return g
+        return inp._apply_grad_hooks(g)
+
     # --- seed cotangents ------------------------------------------------
-    node_cts: Dict[int, List[Optional[jnp.ndarray]]] = {}
+    # Hooks and capture fire ONCE per tensor on its ACCUMULATED cotangent
+    # (reference hook semantics), not per consumer edge: contributions are
+    # summed raw into node_cts / leaf_sums, and the owner's hooks run when
+    # the producer node pops (all consumers reported) or at walk end (leaf).
+    node_cts: Dict[int, List[Optional[Any]]] = {}
     node_by_id: Dict[int, GradNode] = {}
+    slot_owner: Dict[Tuple[int, int], Any] = {}
+    leaf_sums: Dict[int, List[Any]] = {}  # id -> [tensor, summed ct]
     roots: List[GradNode] = []
 
-    def seed(node: GradNode, idx: int, ct: jnp.ndarray):
+    def seed(node: GradNode, idx: int, ct):
         nid = id(node)
         if nid not in node_cts:
             node_cts[nid] = [None] * len(node.out_avals)
@@ -93,23 +238,32 @@ def run_backward(tensors: Sequence[Any], grad_tensors: Sequence[Optional[Any]],
         cur = node_cts[nid][idx]
         node_cts[nid][idx] = ct if cur is None else cur + ct
 
+    def add_leaf(t, g):
+        entry = leaf_sums.get(id(t))
+        if entry is None:
+            leaf_sums[id(t)] = [t, g]
+        else:
+            entry[1] = entry[1] + g
+
     for t, g in zip(tensors, grad_tensors):
         if t._grad_node is None:
             if not t.stop_gradient:
-                gt = g._data if g is not None else jnp.ones(t.shape, t.dtype)
-                t._accumulate_grad(gt)
+                add_leaf(t, as_value(g) if g is not None else ones_like(t))
             continue
         if g is None:
             if t._data.size != 1:
                 raise RuntimeError(
                     "grad can be implicitly created only for scalar outputs; "
                     f"got shape {tuple(t.shape)}")
-            g_arr = jnp.ones(t.shape, t.dtype)
+            g_val = ones_like(t)
         else:
-            g_arr = g._data
-        seed(t._grad_node, t._output_index, g_arr)
+            g_val = as_value(g)
+        slot_owner.setdefault((id(t._grad_node), t._output_index), t)
+        seed(t._grad_node, t._output_index, g_val)
 
     # --- in-degree pass (number of pending consumer contributions) -------
+    # Inputs listed in no_grad_ids are constants: do not descend through them
+    # and do not count their edge (execution skips them symmetrically).
     indeg: Dict[int, int] = {}
     visited: Dict[int, GradNode] = {}
     stack = list(roots)
@@ -120,6 +274,8 @@ def run_backward(tensors: Sequence[Any], grad_tensors: Sequence[Optional[Any]],
             continue
         visited[nid] = node
         for inp in node.inputs:
+            if id(inp) in no_grad_ids:
+                continue
             pnode = inp._grad_node
             if pnode is not None:
                 pid = id(pnode)
@@ -140,19 +296,39 @@ def run_backward(tensors: Sequence[Any], grad_tensors: Sequence[Optional[Any]],
             continue
         processed.add(nid)
         cts = node_cts.pop(nid, None)
+        if cts is not None:
+            # all consumer contributions are in: run the owners' hooks on the
+            # accumulated slot cotangents, then capture (paddle.grad inputs)
+            hooked = []
+            for idx, ct in enumerate(cts):
+                owner = slot_owner.get((nid, idx))
+                if ct is not None and owner is not None:
+                    ct = run_hooks(owner, ct)
+                    if id(owner) in cap_ids and not owner.stop_gradient:
+                        captured(owner, ct)
+                hooked.append(ct)
+            cts = hooked
         if cts is None or all(c is None for c in cts):
-            in_grads: Tuple = tuple(None for _ in node.inputs)
+            in_grads: Sequence = tuple(None for _ in node.inputs)
+        elif node.vjp_fn is None and node.tensor_apply is None:
+            raise RuntimeError(
+                "trying to backward through the graph a second time: pass "
+                "retain_graph=True / create_graph=True to the first backward")
+        elif tensor_mode:
+            in_grads = _apply_node_tensor_mode(node, cts)
         else:
             in_grads = node.apply(cts)
             if flag_value("check_nan_inf"):
                 _check_nan_inf(node.name, [g for g in in_grads if g is not None])
 
         for inp, g in zip(node.inputs, in_grads):
+            if id(inp) in no_grad_ids:
+                continue
             pnode = inp._grad_node
             if pnode is not None:
                 pid = id(pnode)
                 if g is not None:
-                    g = inp._apply_grad_hooks(g)
+                    slot_owner.setdefault((pid, inp._output_index), inp)
                     if pid not in node_cts:
                         node_cts[pid] = [None] * len(pnode.out_avals)
                         node_by_id[pid] = pnode
@@ -163,23 +339,37 @@ def run_backward(tensors: Sequence[Any], grad_tensors: Sequence[Optional[Any]],
                 if indeg[pid] == 0:
                     ready.append(pnode)
             elif g is not None and not inp.stop_gradient:
-                g = inp._apply_grad_hooks(g)
-                inp._accumulate_grad(g)
+                add_leaf(inp, g)
 
         if not retain_graph:
             node.vjp_fn = None  # free linearization residuals
             node.inputs = []
+            node.fwd_fn = None
+            node.tensor_apply = None
+
+    # --- finalize leaves: hooks once on the accumulated grad --------------
+    for t, g in leaf_sums.values():
+        g = run_hooks(t, g)
+        if id(t) in cap_ids:
+            captured(t, g)
+        if accumulate:
+            t._accumulate_grad(g._data if tensor_mode else g)
+
+
+def run_backward(tensors: Sequence[Any], grad_tensors: Sequence[Optional[Any]],
+                 retain_graph: bool = False) -> None:
+    """backward() entry: array-mode engine accumulating into leaf ``.grad``."""
+    _execute_backward(tensors, grad_tensors, retain_graph=retain_graph)
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):
     """paddle.grad parity (autograd/backward_mode.py): grads of outputs w.r.t.
-    inputs without touching .grad on leaves.
-
-    Implemented by running the tape backward with temporary accumulation
-    targets. `create_graph` (double grad) is served by the functional path:
-    recompute through jax.grad is recommended; the tape supports first order.
+    inputs — leaf or interior tensors — with NO side effects on any tensor's
+    ``.grad`` (only_inputs semantics). ``create_graph=True`` records the
+    backward itself on the tape for double grad. ``no_grad_vars`` tensors are
+    treated as constants (propagation is cut at them).
     """
     from ..framework.tensor import Tensor
 
@@ -189,30 +379,29 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         grad_outputs = [None] * len(outputs)
     elif not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use paddle2_tpu.incubate.autograd (functional "
-            "jax.grad composition) for higher-order derivatives")
+    if retain_graph is None:
+        retain_graph = create_graph
+    no_grad_ids = frozenset(
+        id(t) for t in (no_grad_vars or ()))
 
-    # Temporarily capture accumulation on the requested inputs.
-    captured: Dict[int, Any] = {}
-    saved = [(t, t.grad, t.stop_gradient) for t in inputs]
+    sink: Dict[int, Any] = {}
+    _execute_backward(outputs, grad_outputs,
+                      retain_graph=bool(retain_graph) or create_graph,
+                      capture=(sink, {id(t) for t in inputs}),
+                      accumulate=not only_inputs,
+                      no_grad_ids=no_grad_ids,
+                      tensor_mode=create_graph)
+    results = []
     for t in inputs:
-        t.grad = None
-        t.stop_gradient = False
-    try:
-        run_backward(outputs, grad_outputs, retain_graph=bool(retain_graph))
-        results = []
-        for t in inputs:
-            if t.grad is None:
-                if not allow_unused:
-                    raise RuntimeError(
-                        "one of the input tensors receives no gradient "
-                        "(pass allow_unused=True to return None for it)")
-                results.append(None)
-            else:
-                results.append(t.grad)
-        return results
-    finally:
-        for t, g, sg in saved:
-            t.grad, t.stop_gradient = g, sg
+        g = sink.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors receives no gradient "
+                    "(pass allow_unused=True to return None for it)")
+            results.append(None)
+        elif create_graph:
+            results.append(g)  # already a live Tensor on the tape
+        else:
+            results.append(Tensor(g, stop_gradient=True))
+    return results

@@ -202,7 +202,13 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
         if hard:
             onehot = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis],
                                     axis=axis, dtype=y.dtype)
-            # straight-through estimator
-            return onehot + y - jax.lax.stop_gradient(y)
+
+            # straight-through estimator with a BITWISE-exact one-hot forward
+            # (onehot + y - stop_grad(y) leaves float dust like 0.9999999)
+            @jax.custom_vjp
+            def st(soft):
+                return onehot
+            st.defvjp(lambda soft: (onehot, None), lambda _, ct: (ct,))
+            return st(y)
         return y
     return apply_op("gumbel_softmax", fn, (x,), {})

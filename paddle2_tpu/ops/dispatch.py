@@ -87,7 +87,8 @@ def apply_op(name: str, fn: Callable, tensors: Sequence[Tensor],
 
         avals = [(tuple(o.shape), o.dtype) for o in outs]
         return GradNode(name, run_vjp, inputs, avals,
-                        out_is_tuple=isinstance(out, (tuple, list)))
+                        out_is_tuple=isinstance(out, (tuple, list)),
+                        fwd_fn=closed)
 
     return _wrap_outputs(name, out, True, node_builder)
 

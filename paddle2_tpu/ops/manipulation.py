@@ -307,12 +307,27 @@ def index_put(x, indices, value, accumulate=False, name=None) -> Tensor:
     return apply_op("index_put", fn, (x, value) + idx_ts, {})
 
 
+def _require_eager(op_name: str, *tensors) -> None:
+    import jax
+    for t in tensors:
+        if isinstance(t._data, jax.core.Tracer):
+            raise RuntimeError(
+                f"{op_name} has a data-dependent output shape and cannot run "
+                "under jit.to_static tracing; compute it in eager mode "
+                "(reference to_static has the same dynamic-shape limit)")
+
+
 def masked_select(x, mask, name=None) -> Tensor:
-    # data-dependent output shape: eager-only (documented; same limit exists
-    # for dynamic ops under jit in the reference's to_static)
+    # data-dependent output shape: the *index* is computed eagerly with numpy,
+    # then the gather itself goes through apply_op so the op is differentiable
+    # (reference masked_select_grad scatters into zeros).
     x, mask = ensure_tensor(x), ensure_tensor(mask)
-    data = np.asarray(x._data)[np.asarray(mask._data)]
-    return Tensor(jnp.asarray(data))
+    _require_eager("masked_select", x, mask)
+    mask_np = np.broadcast_to(np.asarray(mask._data).astype(bool),
+                              tuple(x._data.shape))
+    idx = jnp.asarray(np.flatnonzero(mask_np))
+    return apply_op("masked_select",
+                    lambda a: jnp.take(a.reshape(-1), idx), (x,), {})
 
 
 def masked_fill(x, mask, value, name=None) -> Tensor:
@@ -336,6 +351,7 @@ def where(condition, x=None, y=None, name=None):
 
 def nonzero(x, as_tuple=False):
     x = ensure_tensor(x)
+    _require_eager("nonzero", x)
     nz = np.nonzero(np.asarray(x._data))
     if as_tuple:
         return tuple(Tensor(jnp.asarray(i)) for i in nz)

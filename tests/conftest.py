@@ -7,11 +7,19 @@ on a virtual 8-device CPU mesh so CI needs no accelerator.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu: the driver env pins JAX_PLATFORMS to the tunneled TPU and a
+# site hook re-prepends it, so the env var alone is not enough — every tiny
+# test compile would pay a network roundtrip. config.update after import is
+# the override that sticks (backend not yet initialized).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -76,3 +76,124 @@ def test_diamond_graph_grad():
     b = x * 4
     ((a + b) * a).sum().backward()  # d/dx[(3x+4x)*3x] = 42x
     np.testing.assert_allclose(x.grad.numpy(), [84.0])
+
+
+def test_grad_only_inputs_no_side_effects():
+    # ADVICE r1: paddle.grad must not leave phantom .grad on other leaves
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    w = paddle.to_tensor([2.0], stop_gradient=False)
+    y = w * x
+    (gx,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+    assert x.grad is None and w.grad is None
+
+
+def test_grad_intermediate_input():
+    # ADVICE r1: grads w.r.t. interior (non-leaf) tensors
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    h = x * 3          # interior
+    y = h * h          # y = 9x^2
+    gh, gx = paddle.grad(y, [h, x])
+    np.testing.assert_allclose(gh.numpy(), [12.0])  # dy/dh = 2h = 12
+    np.testing.assert_allclose(gx.numpy(), [36.0])  # dy/dx = 18x = 36
+
+
+def test_grad_create_graph_double_grad():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x * x  # y = x^3
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), [27.0])  # 3x^2
+    assert not g1.stop_gradient
+    (g2,) = paddle.grad(g1, [x])
+    np.testing.assert_allclose(g2.numpy(), [18.0])  # 6x
+
+
+def test_grad_create_graph_gradient_penalty():
+    # WGAN-GP shape: penalty = (|dy/dx| - 1)^2, then backward through it
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    w = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (w * x * x).sum()
+    (gx,) = paddle.grad(y, [x], create_graph=True)  # 2wx = 6
+    penalty = ((gx - 1.0) ** 2).sum()
+    penalty.backward()
+    # d/dw (2wx-1)^2 = 2(2wx-1)*2x = 2*5*3 = 30
+    np.testing.assert_allclose(w.grad.numpy(), [30.0], rtol=1e-6)
+
+
+def test_masked_select_differentiable():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    m = paddle.to_tensor(np.array([[True, False], [False, True]]))
+    out = paddle.masked_select(x, m)
+    np.testing.assert_allclose(out.numpy(), [1.0, 4.0])
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1.0, 0.0], [0.0, 1.0]])
+
+
+def test_grad_create_graph_mixed_ops():
+    # r2 review: relinearize fn must not capture walker loop variables
+    x = paddle.to_tensor([3.0, 1.0], stop_gradient=False)
+    y = (x * x).sum()           # two nodes with different arities
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    (g2,) = paddle.grad(g1.sum(), [x], create_graph=True)
+    np.testing.assert_allclose(g2.numpy(), [2.0, 2.0])
+    (g3,) = paddle.grad(g2.sum(), [x], allow_unused=True)
+    assert g3 is None or np.allclose(g3.numpy(), 0.0)
+
+
+def test_grad_create_graph_applies_hooks():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    h = x * 1.0
+    h.register_hook(lambda g: g * 2)
+    y = h * h
+    (ga,) = paddle.grad(y, [x], retain_graph=True)
+    (gb,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(ga.numpy(), gb.numpy())
+
+
+def test_grad_no_grad_vars():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    w = paddle.to_tensor([3.0], stop_gradient=False)
+    h = w * x
+    y = h * x          # y = w x^2 ; cutting at h removes its contribution
+    (gx,) = paddle.grad(y, [x], no_grad_vars=[h])
+    np.testing.assert_allclose(gx.numpy(), [6.0])  # only the direct x edge: h=6
+
+
+def test_grad_stop_gradient_input_consistent():
+    w = paddle.to_tensor([5.0])  # stop_gradient=True
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = w * x
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [w], retain_graph=True)
+    (gw,) = paddle.grad(y, [w], allow_unused=True)
+    assert gw is None
+
+
+def test_hook_applies_once_on_accumulated_grad():
+    # r2 review: hooks fire once on the SUM of consumer contributions
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    a = x * 1.0
+    a.register_hook(lambda g: g + 1.0)
+    y = (a * 3 + a * 4).sum()
+    (gx,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(gx.numpy(), [8.0])  # (3+4)+1, not (3+1)+(4+1)
+
+
+def test_pylayer_double_grad():
+    class Square(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * 2 * x
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Square.apply(x) + x * x           # y = 2x^2
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), [12.0])
+    (g2,) = paddle.grad(g1, [x])
+    np.testing.assert_allclose(g2.numpy(), [4.0])  # both terms' 2nd order

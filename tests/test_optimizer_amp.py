@@ -168,6 +168,7 @@ def test_grad_scaler_dynamic():
     assert scaled.item() == pytest.approx(8.0)
     scaled.backward()
     scaler.step(o)
+    scaler.update()  # reference pattern: step(); update() grows the scale
     np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 2.0], rtol=1e-5)
     assert scaler.get_loss_scaling() == pytest.approx(8.0)  # grew
 
@@ -176,6 +177,7 @@ def test_grad_scaler_dynamic():
     p.grad = paddle.to_tensor([float("inf")])
     before = p.numpy().copy()
     scaler.step(o)
+    scaler.update()
     np.testing.assert_allclose(p.numpy(), before)
     assert scaler.get_loss_scaling() < 8.0
 
