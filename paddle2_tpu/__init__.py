@@ -51,6 +51,7 @@ from .framework.io_state import save, load  # noqa: F401
 
 # lazy-ish heavy subsystems
 from . import distributed  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from . import incubate  # noqa: F401

@@ -27,8 +27,11 @@ class GradScaler:
         self._dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
         self._bad_steps = 0
-        self._found_inf = False
-        self._unscaled = False
+        # per-optimizer unscale/inf flags (reference OptimizerState map):
+        # a GAN-style step with two optimizers must not let one optimizer's
+        # scale()/unscale_ cycle erase the other's inf detection
+        self._opt_state: Dict[int, Dict[str, bool]] = {}
+        self._cycle_found_inf = False  # union since last update()
 
     def is_enable(self) -> bool:
         return self._enable
@@ -45,14 +48,23 @@ class GradScaler:
     def scale(self, loss: Tensor) -> Tensor:
         if not self._enable:
             return loss
-        # a new scale() starts a new step cycle: even if the user skipped
-        # update(), stale unscale/inf state must not leak into this cycle
-        self._unscaled = False
-        self._found_inf = False
+        # a new scale() marks the start of a new backward cycle: clear stale
+        # per-optimizer UNSCALED flags (so a skipped update() cannot let a
+        # later step() skip unscaling) but keep inf detections for update()
+        for st in self._opt_state.values():
+            st["unscaled"] = False
         return loss * self._scale
 
+    def _state_for(self, optimizer) -> Dict[str, bool]:
+        st = self._opt_state.get(id(optimizer))
+        if st is None:
+            st = {"unscaled": False, "found_inf": False}
+            self._opt_state[id(optimizer)] = st
+        return st
+
     def unscale_(self, optimizer) -> None:
-        if not self._enable or self._unscaled:
+        st = self._state_for(optimizer)
+        if not self._enable or st["unscaled"]:
             return
         inv = 1.0 / self._scale
         found_inf = False
@@ -62,8 +74,9 @@ class GradScaler:
                 if bool(jnp.any(~jnp.isfinite(g))):
                     found_inf = True
                 p.grad._replace_data(g.astype(p.grad._data.dtype))
-        self._found_inf = found_inf
-        self._unscaled = True
+        st["found_inf"] = found_inf
+        st["unscaled"] = True
+        self._cycle_found_inf = self._cycle_found_inf or found_inf
 
     def step(self, optimizer) -> None:
         """Unscale + conditionally step. Does NOT update the scale — call
@@ -72,9 +85,10 @@ class GradScaler:
         if not self._enable:
             optimizer.step()
             return
-        if not self._unscaled:
+        st = self._state_for(optimizer)
+        if not st["unscaled"]:
             self.unscale_(optimizer)
-        if not self._found_inf:
+        if not st["found_inf"]:
             optimizer.step()
 
     def minimize(self, optimizer, loss) -> None:
@@ -87,9 +101,10 @@ class GradScaler:
         if not self._enable:
             return
         if not self._dynamic:
-            self._unscaled = False
+            self._opt_state.clear()
+            self._cycle_found_inf = False
             return
-        if self._found_inf:
+        if self._cycle_found_inf:
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:
@@ -101,8 +116,8 @@ class GradScaler:
             if self._good_steps >= self._incr_every:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
-        self._found_inf = False
-        self._unscaled = False
+        self._opt_state.clear()
+        self._cycle_found_inf = False
 
     def state_dict(self) -> Dict:
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,

@@ -1,1 +1,32 @@
+"""paddle.distributed — TPU-native distributed API.
 
+Reference surface: python/paddle/distributed/ (collectives, parallel env,
+fleet hybrid parallelism, auto-parallel sharding). Here the backbone is a
+global jax.sharding.Mesh whose named axes are the communication groups; all
+collectives compile to XLA HLO over ICI (SURVEY.md §5.8 TPU-native design).
+"""
+
+from .env import ParallelEnv, get_rank, get_world_size
+from .mesh import (HYBRID_AXES, axis_size, constrain, get_mesh, init_mesh,
+                   replicated, set_mesh, world_size)
+from .collective import (Group, P2POp, ReduceOp, all_gather,
+                         all_gather_object, all_reduce, all_to_all, alltoall,
+                         barrier, batch_isend_irecv, broadcast,
+                         destroy_process_group, get_group, irecv,
+                         is_initialized, isend, new_group, ppermute, recv,
+                         reduce, reduce_scatter, scatter, send, wait)
+from .parallel import DataParallel, init_parallel_env, parallel_initialized
+from .sharding import ShardedOptimizer, group_sharded_parallel, shard_optimizer
+from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+
+__all__ = [
+    "ParallelEnv", "get_rank", "get_world_size", "init_parallel_env",
+    "DataParallel", "ReduceOp", "Group", "new_group", "get_group",
+    "all_reduce", "all_gather", "all_gather_object", "reduce_scatter",
+    "broadcast", "reduce", "scatter", "all_to_all", "alltoall", "send",
+    "recv", "isend", "irecv", "barrier", "wait", "ppermute",
+    "batch_isend_irecv", "P2POp", "is_initialized", "destroy_process_group",
+    "get_mesh", "init_mesh", "set_mesh", "constrain", "replicated",
+    "axis_size", "world_size", "HYBRID_AXES", "parallel_initialized",
+]

@@ -1,0 +1,482 @@
+"""Eager collective communication API (python/paddle/distributed/communication/).
+
+TPU-native redesign of ProcessGroupNCCL (process_group_nccl.cc:860): every
+collective is a jitted ``shard_map`` program over the global mesh, so the
+"communicator" is an XLA HLO collective riding ICI — there is no eager NCCL
+call to wrap. The single-controller SPMD view replaces per-rank processes:
+
+    A distributed tensor is RANK-MAJOR — ``x[i]`` is rank i's local tensor,
+    i.e. the global array of the SPMD program, sharded over the mesh. Each
+    collective consumes/produces that global view and mutates the input
+    Tensor in place like the reference API.
+
+Groups are mesh axes (see mesh.py): the world group spans every axis; a
+sub-group (e.g. the 'mp' ring inside a dp×mp mesh) reduces over its axis
+only, which is exactly how XLA lowers grouped collectives.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec
+
+from ..framework.tensor import Tensor
+from . import mesh as mesh_mod
+
+P = PartitionSpec
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
+           "all_gather", "all_gather_object", "reduce_scatter", "broadcast",
+           "reduce", "scatter", "all_to_all", "alltoall", "send", "recv",
+           "isend", "irecv", "barrier", "ppermute", "wait",
+           "batch_isend_irecv", "P2POp", "is_initialized",
+           "destroy_process_group"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class _Task:
+    """Return object of async collectives (reference ProcessGroup::Task);
+    XLA dispatch is already async, wait() blocks on the result buffer."""
+
+    def __init__(self, tensor: Optional[Tensor] = None):
+        self._tensor = tensor
+
+    def wait(self):
+        if self._tensor is not None:
+            jax.block_until_ready(self._tensor._data)
+
+    def is_completed(self):
+        return True
+
+
+class Group:
+    """A communication group = a (tuple of) mesh axis(es)."""
+
+    _next_id = 0
+
+    def __init__(self, axes: Tuple[str, ...], ranks: Optional[List[int]] = None):
+        self.axes = tuple(axes)
+        mesh = mesh_mod.get_mesh()
+        self.nranks = int(np.prod([mesh.shape[a] for a in self.axes]))
+        self.ranks = ranks if ranks is not None else list(range(self.nranks))
+        self.id = Group._next_id
+        Group._next_id += 1
+        self._p2p_queue: List[Tuple[Tensor, int]] = []
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_ids(self):
+        return self.ranks
+
+
+_world_cache: Dict[int, Group] = {}
+
+
+def _world_group() -> Group:
+    mesh = mesh_mod.get_mesh()
+    g = _world_cache.get(id(mesh))
+    if g is None:
+        g = Group(tuple(mesh.axis_names))
+        _world_cache[id(mesh)] = g
+    return g
+
+
+_groups: Dict[int, Group] = {}
+
+
+def is_initialized() -> bool:
+    return mesh_mod.mesh_initialized()
+
+
+def destroy_process_group(group: Optional[Group] = None) -> None:
+    _groups.clear()
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    return _groups.get(gid)
+
+
+def new_group(ranks: Optional[List[int]] = None, backend: Optional[str] = None,
+              timeout=None) -> Group:
+    """Create a group. Groups must be axis-aligned sub-meshes — on TPU a
+    communication group IS a mesh axis (XLA grouped collectives); arbitrary
+    rank subsets have no efficient ICI mapping (reference new_group
+    collective.py:194 builds NCCL sub-rings instead)."""
+    mesh = mesh_mod.get_mesh()
+    world = int(np.prod(list(mesh.shape.values())))
+    if ranks is None or sorted(ranks) == list(range(world)):
+        g = _world_group()
+    else:
+        axis = _find_axis_for_ranks(mesh, sorted(ranks))
+        if axis is None:
+            raise NotImplementedError(
+                f"new_group({ranks}): only axis-aligned groups are supported "
+                f"on the TPU mesh {dict(mesh.shape)}; reshape the mesh so the "
+                "group lies along one axis")
+        g = Group((axis,), list(sorted(ranks)))
+    _groups[g.id] = g
+    return g
+
+
+def _find_axis_for_ranks(mesh, ranks: List[int]) -> Optional[str]:
+    """If `ranks` is one of the sub-groups obtained by varying a single mesh
+    axis (others fixed), return that axis name."""
+    sizes = [mesh.shape[a] for a in mesh.axis_names]
+    grid = np.arange(int(np.prod(sizes))).reshape(sizes)
+    for i, name in enumerate(mesh.axis_names):
+        rolled = np.moveaxis(grid, i, -1).reshape(-1, sizes[i])
+        for row in rolled:
+            if row.tolist() == ranks:
+                return name
+    return None
+
+
+# --------------------------------------------------------------------------
+# collective kernels: jit(shard_map(...)) cached per (kind, axes, aval, extra)
+# --------------------------------------------------------------------------
+
+_kernel_cache: Dict[Any, Any] = {}
+
+
+def _rank_spec(mesh) -> P:
+    """Rank-major leading dim: sharded over ALL mesh axes in order."""
+    return P(tuple(mesh.axis_names))
+
+
+def _kernel(kind: str, axes: Tuple[str, ...], aval, extra=()) -> Any:
+    mesh = mesh_mod.get_mesh()
+    key = (kind, axes, id(mesh), aval.shape, str(aval.dtype), extra)
+    fn = _kernel_cache.get(key)
+    if fn is not None:
+        return fn
+
+    spec = _rank_spec(mesh)
+    ax = axes if len(axes) > 1 else axes[0]
+
+    def _psum(v):
+        return jax.lax.psum(v, ax)
+
+    def _group_size():
+        return int(np.prod([mesh.shape[a] for a in axes]))
+
+    def _gather_cat(v):
+        # concat of the group's blocks along dim0 (paddle all_gather layout)
+        out = v
+        for a in axes[::-1]:
+            out = jax.lax.all_gather(out, a, axis=0, tiled=True)
+        return out
+
+    def _gather_stack(v):
+        # stack of the group's blocks on a NEW leading dim [G, *S]
+        return _gather_cat(v[None])
+
+    if kind == "all_reduce_sum":
+        body = lambda x: _psum(x)
+    elif kind == "all_reduce_max":
+        body = lambda x: jax.lax.pmax(x, ax)
+    elif kind == "all_reduce_min":
+        body = lambda x: jax.lax.pmin(x, ax)
+    elif kind == "all_reduce_prod":
+        body = lambda x: jnp.prod(_gather_stack(x), axis=0)
+    elif kind == "all_reduce_avg":
+        body = lambda x: _psum(x) / _group_size()
+    elif kind == "all_gather":
+        body = _gather_cat
+    elif kind == "reduce_scatter":
+        def body(x):
+            return jax.lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True)
+    elif kind == "broadcast":
+        src = extra[0]
+
+        def body(x):
+            return _gather_stack(x)[src]
+    elif kind == "reduce":
+        dst, op = extra
+
+        def body(x):
+            if op == ReduceOp.MAX:
+                red = jax.lax.pmax(x, ax)
+            elif op == ReduceOp.MIN:
+                red = jax.lax.pmin(x, ax)
+            elif op == ReduceOp.AVG:
+                red = _psum(x) / _group_size()
+            elif op == ReduceOp.PROD:
+                red = jnp.prod(_gather_stack(x), axis=0)
+            else:
+                red = _psum(x)
+            idx = jax.lax.axis_index(ax)
+            return jnp.where(idx == dst, red, x)
+    elif kind == "scatter":
+        src = extra[0]
+
+        def body(x):
+            # x: [G, *S] on every rank; only src's row matters
+            g = _gather_stack(x)  # [G, G, *S]
+            return g[src, jax.lax.axis_index(ax)]
+    elif kind == "all_to_all":
+        def body(x):
+            # x: [G, *S]; block j goes to rank j
+            return jax.lax.all_to_all(x, ax, split_axis=0, concat_axis=0,
+                                      tiled=True)
+    elif kind == "ppermute":
+        perm = extra[0]
+
+        def body(x):
+            return jax.lax.ppermute(x, ax, perm=list(perm))
+    elif kind == "p2p":
+        src, dst, = extra
+
+        def body(sent, buf):
+            moved = jax.lax.ppermute(sent, ax, perm=[(src, dst)])
+            idx = jax.lax.axis_index(ax)
+            return jnp.where(idx == dst, moved, buf)
+    else:
+        raise ValueError(kind)
+
+    rank_first = _rank_spec(mesh)
+
+    def wrap(single_body):
+        def f(*xs):
+            # each x: local block [1, *S] → op on [*S]
+            outs = single_body(*[x[0] for x in xs])
+            return outs[None]
+        return f
+
+    n_args = 2 if kind == "p2p" else 1
+    fn = jax.jit(shard_map(wrap(body), mesh=mesh,
+                           in_specs=tuple([rank_first] * n_args),
+                           out_specs=rank_first))
+    _kernel_cache[key] = fn
+    return fn
+
+
+def _axes(group: Optional[Group]) -> Tuple[str, ...]:
+    g = group if group is not None else _world_group()
+    return g.axes
+
+
+def _check_rank_major(t: Tensor, group: Optional[Group]) -> None:
+    w = mesh_mod.world_size()
+    if not t.shape or t.shape[0] != w:
+        raise ValueError(
+            f"collective tensors are RANK-MAJOR: leading dim must equal the "
+            f"mesh world size {w}, got shape {t.shape}")
+
+
+def _to_mesh(arr: jax.Array) -> jax.Array:
+    """Commit a rank-major array onto the mesh (dim0 split across devices)."""
+    mesh = mesh_mod.get_mesh()
+    from jax.sharding import NamedSharding
+    spec = P(tuple(mesh.axis_names), *([None] * (arr.ndim - 1)))
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def _run(kind: str, t: Tensor, group: Optional[Group], extra=()) -> Tensor:
+    _check_rank_major(t, group)
+    arr = t._data
+    # per-rank scalars ([W] global): lift to [W, 1] so axis-0 kernels work,
+    # then drop the lifted dim (all_gather keeps it: its output IS the dim)
+    lifted = arr.ndim == 1
+    if lifted:
+        arr = arr[:, None]
+    fn = _kernel(kind, _axes(group),
+                 jax.ShapeDtypeStruct(arr.shape, arr.dtype), extra)
+    out = fn(_to_mesh(arr))
+    if lifted and kind != "all_gather":
+        out = out[..., 0]
+    t._replace_data(out)
+    return t
+
+
+# --------------------------------------------------------------------------
+# public API (communication/all_reduce.py etc. parity)
+# --------------------------------------------------------------------------
+
+def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM,
+               group: Optional[Group] = None, sync_op: bool = True):
+    _run(f"all_reduce_{op}", tensor, group)
+    return _Task(tensor)
+
+
+def all_gather(tensor_or_list, tensor: Optional[Tensor] = None,
+               group: Optional[Group] = None, sync_op: bool = True):
+    """paddle signature: all_gather(tensor_list, tensor). Also accepts a
+    single rank-major tensor, returning the gathered rank-major result
+    ([W, G*S0, ...])."""
+    if isinstance(tensor_or_list, list):
+        out_list, t = tensor_or_list, tensor
+        _check_rank_major(t, group)
+        g = group if group is not None else _world_group()
+        arr = t._data
+        scalar_per_rank = arr.ndim == 1
+        if scalar_per_rank:
+            arr = arr[:, None]
+        fn = _kernel("all_gather", _axes(group),
+                     jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+        out = fn(_to_mesh(arr))  # [W, G*S0, ...]
+        s0 = arr.shape[1]
+        for i in range(g.nranks):
+            block = out[:, i * s0:(i + 1) * s0]
+            if scalar_per_rank:
+                block = block[:, 0]
+            out_list.append(Tensor(block))
+        return _Task()
+    return _run("all_gather", tensor_or_list, group)
+
+
+def all_gather_object(object_list: list, obj, group: Optional[Group] = None):
+    # single-controller: every "rank" holds the same object
+    g = group if group is not None else _world_group()
+    object_list.extend([obj] * g.nranks)
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list=None,
+                   op: str = ReduceOp.SUM, group: Optional[Group] = None,
+                   sync_op: bool = True):
+    t = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
+    if isinstance(t, list):
+        from ..ops.manipulation import concat
+        t = concat(t, axis=1)
+    if op != ReduceOp.SUM:
+        raise NotImplementedError("reduce_scatter supports SUM on TPU")
+    out = _run("reduce_scatter", t, group)
+    if t is not tensor:
+        tensor._replace_data(out._data)
+    return _Task(tensor)
+
+
+def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op: bool = True):
+    g = group if group is not None else _world_group()
+    rel = g.get_group_rank(src) if src in g.ranks else src
+    _run("broadcast", tensor, group, extra=(int(rel),))
+    return _Task(tensor)
+
+
+def reduce(tensor: Tensor, dst: int = 0, op: str = ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op: bool = True):
+    g = group if group is not None else _world_group()
+    rel = g.get_group_rank(dst) if dst in g.ranks else dst
+    _run("reduce", tensor, group, extra=(int(rel), op))
+    return _Task(tensor)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src: int = 0,
+            group: Optional[Group] = None, sync_op: bool = True):
+    """Rank-major: tensor is [W, G, *S] (row src holds the payload);
+    result [W, *S]. With tensor_list, the list is stacked first."""
+    g = group if group is not None else _world_group()
+    if tensor_list is not None:
+        from ..ops.manipulation import stack
+        payload = stack(tensor_list, axis=1)
+    else:
+        payload = tensor
+    rel = g.get_group_rank(src) if src in g.ranks else src
+    out = _run("scatter", payload, group, extra=(int(rel),))
+    if payload is not tensor:
+        tensor._replace_data(out._data)
+    return _Task(tensor)
+
+
+def all_to_all(out_tensor_list, in_tensor_list=None,
+               group: Optional[Group] = None, sync_op: bool = True):
+    """paddle signature: (out_tensor_list, in_tensor_list). Also accepts a
+    single rank-major [W, G, *S] tensor."""
+    if isinstance(out_tensor_list, Tensor):
+        return _run("all_to_all", out_tensor_list, group)
+    from ..ops.manipulation import stack
+    t = stack(in_tensor_list, axis=1)  # [W, G, *S]
+    out = _run("all_to_all", t, group)
+    g = group if group is not None else _world_group()
+    for i in range(g.nranks):
+        out_tensor_list.append(Tensor(out._data[:, i]))
+    return _Task()
+
+
+alltoall = all_to_all
+
+
+def ppermute(tensor: Tensor, perm: Sequence[Tuple[int, int]],
+             group: Optional[Group] = None) -> Tensor:
+    """Native collective-permute (no reference twin; the building block of
+    pipeline p2p). perm = [(src, dst), ...]; un-targeted ranks get zeros."""
+    return _run("ppermute", tensor, group, extra=(tuple(map(tuple, perm)),))
+
+
+def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True):
+    g = group if group is not None else _world_group()
+    _groups.setdefault(g.id, g)
+    g._p2p_queue.append((tensor, dst))
+    return _Task()
+
+
+def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True):
+    g = group if group is not None else _world_group()
+    # pair with the oldest pending send (single-controller executes both
+    # sides of the reference's rank-to-rank handshake at once)
+    if not g._p2p_queue:
+        raise RuntimeError("recv() without a matching send() in this process")
+    if len(g._p2p_queue) > 1:
+        import warnings
+        warnings.warn(
+            "multiple sends queued: recv() pairs FIFO with the OLDEST send; "
+            "issue send/recv in matching order or use batch_isend_irecv",
+            RuntimeWarning, stacklevel=2)
+    sent, dst = g._p2p_queue.pop(0)
+    _check_rank_major(sent, group)
+    _check_rank_major(tensor, group)
+    fn = _kernel("p2p", g.axes,
+                 jax.ShapeDtypeStruct(sent._data.shape, sent._data.dtype),
+                 extra=(int(src), int(dst)))
+    tensor._replace_data(fn(_to_mesh(sent._data), _to_mesh(tensor._data)))
+    return _Task(tensor)
+
+
+isend = send
+irecv = recv
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op, self.tensor, self.peer, self.group = op, tensor, peer, group
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]) -> List[_Task]:
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, group=op.group))
+    return tasks
+
+
+def wait(tensor: Tensor, group: Optional[Group] = None, use_calc_stream=True):
+    jax.block_until_ready(tensor._data)
+
+
+def barrier(group: Optional[Group] = None):
+    mesh = mesh_mod.get_mesh()
+    w = mesh_mod.world_size()
+    token = Tensor(jnp.zeros((w,), jnp.float32))
+    _run("all_reduce_sum", token, group)
+    token.numpy()
+    return _Task()

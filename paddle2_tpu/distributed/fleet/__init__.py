@@ -1,0 +1,125 @@
+"""paddle.distributed.fleet — hybrid-parallel orchestration
+(python/paddle/distributed/fleet/fleet.py:218 parity).
+
+fleet.init builds the hybrid mesh [dp, pp, sharding, sep, mp];
+distributed_model/distributed_optimizer apply the per-axis strategies
+(DataParallel batch sharding, TP layer shardings, ZeRO placement).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       get_hybrid_communicate_group,
+                       set_hybrid_communicate_group)
+from . import mp_layers  # noqa: F401
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from ..sharding import ShardedOptimizer, group_sharded_parallel
+
+__all__ = ["init", "DistributedStrategy", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "HybridCommunicateGroup", "CommunicateTopology",
+           "ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy",
+           "ShardedOptimizer", "group_sharded_parallel", "worker_index",
+           "worker_num", "is_first_worker", "meta_parallel"]
+
+
+class DistributedStrategy:
+    """fleet/base/distributed_strategy.py:284 parity — the knobs our TPU
+    runtime consumes; unknown knobs are stored but inert."""
+
+    def __init__(self):
+        self.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 1}
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.find_unused_parameters = False
+
+
+_state = {"strategy": None, "initialized": False}
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None):
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    # reference topology infers dp as world/(mp*pp*sharding*sep) when the
+    # configured degrees don't fill the device count (fleet.init default)
+    import jax
+    world = jax.device_count()
+    others = (hc.get("pp_degree", 1) * hc.get("sharding_degree", 1)
+              * hc.get("sep_degree", 1) * hc.get("mp_degree", 1))
+    dp = hc.get("dp_degree", 1)
+    if dp * others != world and world % others == 0:
+        dp = world // others
+    topo = CommunicateTopology(
+        dims=(dp, hc.get("pp_degree", 1),
+              hc.get("sharding_degree", 1), hc.get("sep_degree", 1),
+              hc.get("mp_degree", 1)))
+    hcg = HybridCommunicateGroup(topo)
+    set_hybrid_communicate_group(hcg)
+    _state["strategy"] = strategy
+    _state["initialized"] = True
+    return hcg
+
+
+def initialized() -> bool:
+    return _state["initialized"]
+
+
+def distributed_model(model):
+    """Wrap per the active strategy (fleet.py distributed_model parity).
+
+    TP layers shard themselves at construction; this adds the data-parallel
+    batch sharding when dp_degree > 1 (pipeline models wrap elsewhere)."""
+    from ..parallel import DataParallel
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model, group=hcg.get_data_parallel_group())
+    return model
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy]
+                          = None):
+    """HybridParallelOptimizer parity: grads sync via GSPMD; sharding stage-1
+    applies when sharding_degree > 1."""
+    strategy = strategy or _state["strategy"] or DistributedStrategy()
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
+        return ShardedOptimizer(optimizer, level="os",
+                                group=hcg.get_sharding_parallel_group())
+    return optimizer
+
+
+def worker_index() -> int:
+    from ..env import get_rank
+    return get_rank()
+
+
+def worker_num() -> int:
+    from ..env import get_world_size
+    return get_world_size()
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+class meta_parallel:
+    """Namespace parity for fleet.meta_parallel imports."""
+    ColumnParallelLinear = ColumnParallelLinear
+    RowParallelLinear = RowParallelLinear
+    VocabParallelEmbedding = VocabParallelEmbedding
+    ParallelCrossEntropy = ParallelCrossEntropy

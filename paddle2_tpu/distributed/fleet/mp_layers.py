@@ -1,0 +1,171 @@
+"""Tensor-parallel layers (fleet/layers/mpu/mp_layers.py:49,336,543,744 parity).
+
+The reference implements TP with explicitly split weights plus
+identity/allreduce PyLayers (mpu/mp_ops.py). TPU-native: the SAME layer code
+holds one logical weight committed with a NamedSharding over the 'mp' mesh
+axis; XLA's SPMD partitioner inserts the all-reduce (RowParallel contraction)
+/ all-gather (gather_output) — the GSPMD formulation of Megatron TP.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...framework.tensor import Tensor  # noqa: F401 (re-export convenience)
+from ..mesh import constrain, get_mesh
+from ...nn.layer.layers import Layer
+
+P = PartitionSpec
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy"]
+
+
+def _mp_axis() -> str:
+    mesh = get_mesh()
+    return "mp" if "mp" in mesh.axis_names else mesh.axis_names[-1]
+
+
+def _shard_param(p, spec: P):
+    mesh = get_mesh()
+    p._replace_data(jax.device_put(p._data, NamedSharding(mesh, spec)))
+    return p
+
+
+class ColumnParallelLinear(Layer):
+    """Linear whose OUTPUT dim is sharded over mp (mp_layers.py:336).
+
+    Forward: X [.., in] replicated-over-mp @ W [in, out-sharded] -> Y sharded
+    on the feature dim; gather_output=True re-replicates (all-gather).
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._axis = _mp_axis()
+        n = get_mesh().shape[self._axis]
+        if out_features % n != 0:
+            raise ValueError(
+                f"out_features {out_features} not divisible by mp degree {n}")
+        self.gather_output = gather_output
+        self.weight = _shard_param(
+            self.create_parameter([in_features, out_features],
+                                  attr=weight_attr),
+            P(None, self._axis))
+        self.bias = None
+        if has_bias:
+            self.bias = _shard_param(
+                self.create_parameter([out_features], is_bias=True),
+                P(self._axis))
+
+    def forward(self, x):
+        from ...nn import functional as F
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = _constrain_tensor(y, P(*([None] * y.ndim)))
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Linear whose INPUT dim is sharded over mp (mp_layers.py:543).
+
+    The contraction runs over the sharded dim -> XLA inserts the all-reduce
+    that the reference issues explicitly after the local matmul.
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._axis = _mp_axis()
+        n = get_mesh().shape[self._axis]
+        if in_features % n != 0:
+            raise ValueError(
+                f"in_features {in_features} not divisible by mp degree {n}")
+        self.input_is_parallel = input_is_parallel
+        self.weight = _shard_param(
+            self.create_parameter([in_features, out_features],
+                                  attr=weight_attr),
+            P(self._axis, None))
+        self.bias = None
+        if has_bias:
+            # bias is applied AFTER the reduction, replicated
+            self.bias = self.create_parameter([out_features], is_bias=True)
+
+    def forward(self, x):
+        from ...nn import functional as F
+        if not self.input_is_parallel:
+            spec = P(*([None] * (x.ndim - 1) + [self._axis]))
+            x = _constrain_tensor(x, spec)
+        y = F.linear(x, self.weight)  # contraction over sharded dim -> psum
+        y = _constrain_tensor(y, P(*([None] * y.ndim)))
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over mp (mp_layers.py:49)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._axis = _mp_axis()
+        n = get_mesh().shape[self._axis]
+        if num_embeddings % n != 0:
+            raise ValueError(
+                f"num_embeddings {num_embeddings} not divisible by mp "
+                f"degree {n}")
+        self.weight = _shard_param(
+            self.create_parameter([num_embeddings, embedding_dim],
+                                  attr=weight_attr),
+            P(self._axis, None))
+
+    def forward(self, x):
+        from ...nn import functional as F
+        y = F.embedding(x, self.weight)
+        return _constrain_tensor(y, P(*([None] * y.ndim)))
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over mp-sharded logits (mp_layers.py:744).
+
+    The reference computes local max/sum + allreduce by hand; GSPMD derives
+    the same pattern from the sharded softmax reduction.
+    """
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self._axis = _mp_axis()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label, soft_label=False):
+        from ...nn import functional as F
+        spec = P(*([None] * (input.ndim - 1) + [self._axis]))
+        logits = _constrain_tensor(input, spec)
+        return F.cross_entropy(logits, label, soft_label=soft_label,
+                               reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+def _constrain_tensor(t, spec: P):
+    """Differentiable sharding annotation on an eager Tensor.
+
+    Eager: a real device_put (placement-only change; the result shares the
+    producer's grad edge, so backward is the implicit identity). Traced
+    (to_static): records with_sharding_constraint for GSPMD.
+    """
+    if isinstance(t._data, jax.core.Tracer):
+        from ...ops.dispatch import apply_op
+        return apply_op("sharding_constraint",
+                        lambda a: constrain(a, spec), (t,), {})
+    out = Tensor(jax.device_put(t._data, NamedSharding(get_mesh(), spec)),
+                 stop_gradient=t.stop_gradient)
+    out._grad_node = t._grad_node
+    out._output_index = t._output_index
+    return out

@@ -1,0 +1,117 @@
+"""Hybrid-parallel topology (fleet/base/topology.py:70,189 parity).
+
+The reference's CommunicateTopology/HybridCommunicateGroup carve NCCL
+sub-communicators out of the world by axis order [data, pipe, sharding, sep,
+model]. TPU-native: the topology IS the device mesh — axes are created once
+as named mesh dims and every "communication group" is just an axis name that
+XLA lowers grouped collectives over.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .. import mesh as mesh_mod
+from ..collective import Group
+from ..env import get_rank
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep",
+                                           "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._names = list(hybrid_group_names)
+        self._dims = list(dims)
+
+    def get_hybrid_group_names(self):
+        return self._names
+
+    def get_dim(self, name):
+        return self._dims[self._names.index(name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+
+# reference axis name -> mesh axis name
+_AXIS = {"data": "dp", "pipe": "pp", "sharding": "sharding", "sep": "sep",
+         "model": "mp"}
+
+
+class HybridCommunicateGroup:
+    """Builds the N-D mesh [dp, pp, sharding, sep, mp] and exposes the
+    per-axis groups (topology.py:189 parity)."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        axes: Dict[str, int] = {}
+        for name in topology.get_hybrid_group_names():
+            axes[_AXIS[name]] = topology.get_dim(name)
+        self._axes = axes
+        mesh_mod.init_mesh(axes)
+        self._groups = {a: Group((a,)) for a in axes}
+
+    # -- degrees ---------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._axes["dp"]
+
+    def get_model_parallel_world_size(self):
+        return self._axes["mp"]
+
+    def get_pipe_parallel_world_size(self):
+        return self._axes["pp"]
+
+    def get_sharding_parallel_world_size(self):
+        return self._axes["sharding"]
+
+    def get_sep_parallel_world_size(self):
+        return self._axes["sep"]
+
+    # -- groups ----------------------------------------------------------
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, *a, **k) -> Group:
+        return Group(tuple(self._axes.keys()))
+
+    # single-controller SPMD: "this rank" is the launch process
+    def get_global_rank(self):
+        return get_rank()
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def topology(self):
+        return self._topo
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

@@ -1,0 +1,87 @@
+"""Global device mesh — the TPU-native ProcessGroup topology.
+
+The reference builds rank topologies out of NCCL communicators
+(``paddle/phi/core/distributed/collective/process_group.h:48``,
+``fleet/base/topology.py:189`` HybridCommunicateGroup). On TPU the native
+equivalent is a single ``jax.sharding.Mesh`` over all chips whose NAMED AXES
+are the communication groups: collectives compile to XLA HLO over an axis
+(ICI ring), sub-groups are sub-axes, and hybrid parallelism is an N-D mesh
+with axes ordered [dp, pp, sharding, sep, mp] like the reference's
+``topology.py:195-199`` axis order.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+# axis order mirrors HybridCommunicateGroup (topology.py:195-199)
+HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+_state: Dict[str, Optional[Mesh]] = {"mesh": None}
+
+
+def init_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Create (and install) the global mesh.
+
+    ``axes`` maps axis name -> degree in rank-major order; total must equal
+    the device count. Default: one data-parallel axis over every device.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {"dp": len(devices)}
+    names = tuple(axes.keys())
+    sizes = tuple(int(v) for v in axes.values())
+    n = int(np.prod(sizes))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh {dict(axes)} needs {n} devices, have {len(devices)}")
+    mesh = Mesh(np.array(devices).reshape(sizes), names)
+    _state["mesh"] = mesh
+    return mesh
+
+
+def set_mesh(mesh: Mesh) -> None:
+    _state["mesh"] = mesh
+
+
+def get_mesh(auto_init: bool = True) -> Optional[Mesh]:
+    if _state["mesh"] is None and auto_init:
+        init_mesh()
+    return _state["mesh"]
+
+
+def mesh_initialized() -> bool:
+    return _state["mesh"] is not None
+
+
+def axis_size(name: str) -> int:
+    mesh = get_mesh()
+    return int(mesh.shape[name])
+
+
+def world_size() -> int:
+    return int(np.prod(list(get_mesh().shape.values())))
+
+
+def replicated(x: jax.Array) -> jax.Array:
+    """Commit an array as fully replicated over the mesh."""
+    return jax.device_put(x, NamedSharding(get_mesh(), P()))
+
+
+def constrain(x: jax.Array, spec: PartitionSpec) -> jax.Array:
+    """Sharding annotation that works both eagerly and under tracing.
+
+    Eager: a real device_put (resharding collective). Traced: a GSPMD
+    sharding constraint, the pjit idiom.
+    """
+    sharding = NamedSharding(get_mesh(), spec)
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sharding)
+    return jax.device_put(x, sharding)

@@ -114,6 +114,7 @@ class Layer:
             if params is None:
                 raise RuntimeError("call Layer.__init__ before assigning params")
             params[name] = value
+            self.__dict__.pop(name, None)  # a plain attr would shadow us
             for d in (layers, buffers):
                 if d is not None:
                     d.pop(name, None)
@@ -121,6 +122,7 @@ class Layer:
             if layers is None:
                 raise RuntimeError("call Layer.__init__ before assigning layers")
             layers[name] = value
+            self.__dict__.pop(name, None)
             for d in (params, buffers):
                 if d is not None:
                     d.pop(name, None)

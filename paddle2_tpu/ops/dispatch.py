@@ -22,6 +22,38 @@ from ..autograd.tape import GradNode
 _OP_REGISTRY: Dict[str, Callable] = {}
 
 
+def _harmonize_placements(tensors) -> tuple:
+    """When a device mesh is active, promote single-device-committed payloads
+    to mesh-replicated so eager ops can mix them with mesh-sharded operands
+    (XLA refuses computations whose committed device sets differ). The
+    promoted placement is written BACK onto the owning Tensor so the
+    device_put is paid once per tensor, not once per op."""
+    import sys
+    arrays = tuple(t._data for t in tensors)
+    mesh_mod = sys.modules.get("paddle2_tpu.distributed.mesh")
+    if mesh_mod is None or not mesh_mod.mesh_initialized():
+        return arrays
+    multi = False
+    for a in arrays:
+        s = getattr(a, "sharding", None)
+        if s is not None and len(s.device_set) > 1:
+            multi = True
+            break
+    if not multi:
+        return arrays
+    from jax.sharding import NamedSharding, PartitionSpec
+    repl = NamedSharding(mesh_mod.get_mesh(), PartitionSpec())
+    out = []
+    for t, a in zip(tensors, arrays):
+        s = getattr(a, "sharding", None)
+        if s is not None and len(s.device_set) == 1 \
+                and not isinstance(a, jax.core.Tracer):
+            a = jax.device_put(a, repl)
+            t._data = a
+        out.append(a)
+    return tuple(out)
+
+
 def register_op(name: str, fn: Callable) -> None:
     _OP_REGISTRY[name] = fn
 
@@ -59,7 +91,7 @@ def apply_op(name: str, fn: Callable, tensors: Sequence[Tensor],
     jax.vjp and record a GradNode; otherwise run the function directly (XLA's
     jit-by-default primitive cache makes this the cheap path).
     """
-    arrays = tuple(t._data for t in tensors)
+    arrays = _harmonize_placements(tensors)
     if getattr(core._tls(), "amp_state", None) is not None:
         from ..amp import cast_inputs_for_op
         arrays = cast_inputs_for_op(name, arrays)

@@ -1,0 +1,165 @@
+"""Collective API on the 8-virtual-device CPU mesh (test/collective/* parity).
+
+Tensors are RANK-MAJOR: x[i] is rank i's local tensor (the SPMD global view).
+"""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.distributed as dist
+
+
+@pytest.fixture(autouse=True)
+def _fresh_mesh():
+    dist.init_mesh()  # 1-D dp mesh over all 8 devices
+    yield
+
+
+W = 8
+
+
+def _ranks(shape=(2,)):
+    return np.arange(W * int(np.prod(shape)), dtype=np.float32).reshape(
+        (W,) + shape)
+
+
+def test_all_reduce_sum():
+    x = paddle.to_tensor(_ranks())
+    dist.all_reduce(x)
+    expect = np.tile(_ranks().sum(0), (W, 1))
+    np.testing.assert_allclose(x.numpy(), expect)
+
+
+def test_all_reduce_max_min_avg_prod():
+    base = np.random.RandomState(0).rand(W, 3).astype(np.float32) + 0.5
+    for op, ref in [(dist.ReduceOp.MAX, base.max(0)),
+                    (dist.ReduceOp.MIN, base.min(0)),
+                    (dist.ReduceOp.AVG, base.mean(0)),
+                    (dist.ReduceOp.PROD, base.prod(0))]:
+        x = paddle.to_tensor(base.copy())
+        dist.all_reduce(x, op=op)
+        np.testing.assert_allclose(x.numpy(), np.tile(ref, (W, 1)), rtol=1e-5)
+
+
+def test_all_gather_tensor():
+    x = paddle.to_tensor(_ranks((2, 3)))
+    dist.all_gather(x)
+    assert x.shape == [W, W * 2, 3]
+    expect = _ranks((2, 3)).reshape(W * 2, 3)
+    for i in range(W):
+        np.testing.assert_allclose(x.numpy()[i], expect)
+
+
+def test_all_gather_list():
+    out = []
+    x = paddle.to_tensor(_ranks((2,)))
+    dist.all_gather(out, x)
+    assert len(out) == W
+    for i, t in enumerate(out):
+        # element i = rank i's tensor, replicated in every rank row
+        np.testing.assert_allclose(t.numpy(), np.tile(_ranks()[i], (W, 1)))
+
+
+def test_reduce_scatter():
+    x = paddle.to_tensor(_ranks((W, 2)))  # each rank holds [8, 2]
+    dist.reduce_scatter(x)
+    # rank i gets sum over ranks of slice i
+    full = _ranks((W, 2))
+    expect = full.sum(0)  # [8, 2]
+    for i in range(W):
+        np.testing.assert_allclose(x.numpy()[i, 0], expect[i])
+
+
+def test_broadcast():
+    x = paddle.to_tensor(_ranks())
+    dist.broadcast(x, src=3)
+    np.testing.assert_allclose(x.numpy(), np.tile(_ranks()[3], (W, 1)))
+
+
+def test_reduce_to_dst():
+    x = paddle.to_tensor(_ranks())
+    dist.reduce(x, dst=2)
+    out = x.numpy()
+    np.testing.assert_allclose(out[2], _ranks().sum(0))
+    np.testing.assert_allclose(out[5], _ranks()[5])  # others unchanged
+
+
+def test_scatter():
+    payload = _ranks((W, 2))  # [W, W, 2]: row src meaningful
+    x = paddle.to_tensor(payload)
+    dist.scatter(x, src=1)
+    for i in range(W):
+        np.testing.assert_allclose(x.numpy()[i], payload[1, i])
+
+
+def test_all_to_all():
+    x = paddle.to_tensor(_ranks((W, 2)))  # [W, W, 2]
+    orig = _ranks((W, 2))
+    dist.all_to_all(x)
+    for i in range(W):
+        for j in range(W):
+            np.testing.assert_allclose(x.numpy()[i, j], orig[j, i])
+
+
+def test_send_recv():
+    x = paddle.to_tensor(_ranks())
+    buf = paddle.to_tensor(np.zeros((W, 2), np.float32))
+    dist.send(x, dst=6)
+    dist.recv(buf, src=2)
+    out = buf.numpy()
+    np.testing.assert_allclose(out[6], _ranks()[2])
+    np.testing.assert_allclose(out[0], 0.0)
+
+
+def test_ppermute_ring():
+    x = paddle.to_tensor(_ranks())
+    perm = [(i, (i + 1) % W) for i in range(W)]
+    dist.ppermute(x, perm)
+    np.testing.assert_allclose(x.numpy(), np.roll(_ranks(), 1, axis=0))
+
+
+def test_barrier():
+    dist.barrier()
+
+
+def test_subgroup_all_reduce_on_2d_mesh():
+    dist.init_mesh({"dp": 4, "mp": 2})
+    # mp groups: ranks {0,1},{2,3},{4,5},{6,7} in rank-major order
+    g = dist.new_group([4, 5])
+    x = paddle.to_tensor(_ranks())
+    dist.all_reduce(x, group=g)
+    full = _ranks()
+    out = x.numpy()
+    for pair in [(0, 1), (2, 3), (4, 5), (6, 7)]:
+        s = full[pair[0]] + full[pair[1]]
+        np.testing.assert_allclose(out[pair[0]], s)
+        np.testing.assert_allclose(out[pair[1]], s)
+    dist.init_mesh()  # restore 1-D
+
+
+def test_non_axis_aligned_group_raises():
+    dist.init_mesh({"dp": 4, "mp": 2})
+    with pytest.raises(NotImplementedError):
+        dist.new_group([0, 3])
+    dist.init_mesh()
+
+
+def test_world_size_and_env():
+    env = dist.init_parallel_env()
+    assert dist.world_size() == W
+    assert env.world_size >= 1
+
+
+def test_scalar_per_rank_collectives():
+    # r2 review: [W] tensors (one scalar per rank) must work
+    x = paddle.to_tensor(np.arange(W, dtype=np.float32))
+    dist.all_reduce(x)
+    np.testing.assert_allclose(x.numpy(), np.full(W, 28.0))
+    out = []
+    y = paddle.to_tensor(np.arange(W, dtype=np.float32))
+    dist.all_gather(out, y)
+    assert len(out) == W and out[3].numpy()[0] == 3.0
+    z = paddle.to_tensor(np.arange(W, dtype=np.float32))
+    dist.all_gather(z)
+    assert z.shape == [W, W]

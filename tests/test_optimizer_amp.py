@@ -192,3 +192,40 @@ def test_multi_precision_master_weights():
     st = o._states[id(p)]
     assert "master" in st and str(st["master"].dtype) == "float32"
     assert str(p.dtype) == "bfloat16"
+
+
+def test_grad_scaler_two_optimizers_gan_pattern():
+    # r2 review: one optimizer's inf must survive the other's scale() cycle
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    pd = paddle.to_tensor([1.0], stop_gradient=False)
+    pg = paddle.to_tensor([1.0], stop_gradient=False)
+    od = opt.SGD(learning_rate=0.1, parameters=[pd])
+    og = opt.SGD(learning_rate=0.1, parameters=[pg])
+
+    lossD = (pd * 2).sum()
+    scaler.scale(lossD).backward()
+    pd.grad = paddle.to_tensor([float("inf")])  # poison D's grads
+    before = pd.numpy().copy()
+    scaler.step(od)                      # detects inf, skips
+    np.testing.assert_allclose(pd.numpy(), before)
+
+    lossG = (pg * 2).sum()
+    scaler.scale(lossG).backward()       # must NOT erase D's inf record
+    scaler.step(og)                      # G's grads fine -> steps
+    assert pg.numpy()[0] != 1.0
+    scaler.update()
+    assert scaler.get_loss_scaling() < 1024.0  # decayed because of D's inf
+
+
+def test_grad_scaler_skipped_update_still_unscales_next_cycle():
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                   incr_every_n_steps=1000)
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    o = opt.SGD(learning_rate=1.0, parameters=[p])
+    scaler.scale((p * 1).sum()).backward()
+    scaler.step(o)  # user forgets update()
+    o.clear_grad()
+    start = p.numpy().copy()
+    scaler.scale((p * 1).sum()).backward()
+    scaler.step(o)  # must re-unscale: applied grad == 1.0, not 8.0
+    np.testing.assert_allclose(p.numpy(), start - 1.0, rtol=1e-6)
