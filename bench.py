@@ -1,0 +1,115 @@
+"""Flagship single-chip benchmark: GPT LM pretraining step (bf16, to_static).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+Baseline semantics (BASELINE.md: "match A100 step time"): vs_baseline is the
+ratio of achieved model FLOP/s to an A100 running the same model at 50% MFU
+(0.5 * 312 bf16 TFLOP/s) — >= 1.0 means the TPU chip matches or beats a
+well-tuned A100 on step time for this workload.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import paddle2_tpu as paddle
+    import paddle2_tpu.nn.functional as F
+    import paddle2_tpu.optimizer as opt
+    from paddle2_tpu.models import GPTForCausalLM, GPTConfig
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform.lower() not in ("cpu",)
+    log(f"bench device: {dev} (tpu={on_tpu})")
+
+    # GPT-2 medium-ish geometry; bf16 params via AMP O2
+    hidden = int(os.environ.get("BENCH_HIDDEN", 1024))
+    layers = int(os.environ.get("BENCH_LAYERS", 24))
+    heads = hidden // 64
+    seq = int(os.environ.get("BENCH_SEQ", 1024))
+    batch = int(os.environ.get("BENCH_BATCH", 8))
+    vocab = int(os.environ.get("BENCH_VOCAB", 32768))
+    steps = int(os.environ.get("BENCH_STEPS", 10))
+    if not on_tpu:  # CPU smoke profile so the harness never hangs
+        hidden, layers, heads, seq, batch, vocab, steps = 256, 4, 4, 256, 4, 4096, 3
+
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_position_embeddings=seq,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    n_params = model.num_params()
+    log(f"params: {n_params/1e6:.1f}M  seq={seq} batch={batch}")
+
+    o = opt.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                  multi_precision=True)
+
+    def train_fn(ids, labels):
+        _, loss = model(ids, labels=labels)
+        return loss
+
+    st = paddle.jit.to_static(train_fn)
+    rs = np.random.RandomState(0)
+    ids_np = rs.randint(0, vocab, (batch, seq))
+
+    def one_step():
+        ids = paddle.to_tensor(ids_np.astype(np.int32))
+        loss = st(ids, ids)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    # warmup (compile)
+    t0 = time.time()
+    loss = one_step()
+    jax.block_until_ready(loss._data)
+    log(f"compile+first step: {time.time()-t0:.1f}s  loss={float(np.asarray(loss._data)):.3f}")
+    for _ in range(2):
+        loss = one_step()
+    jax.block_until_ready(loss._data)
+
+    t0 = time.time()
+    for _ in range(steps):
+        loss = one_step()
+    jax.block_until_ready(loss._data)
+    dt = (time.time() - t0) / steps
+
+    tokens = batch * seq
+    tokens_per_sec = tokens / dt
+    # fwd+bwd FLOPs: 6N per token + attention 12*L*S*H per token (PaLM MFU)
+    flops_per_token = 6 * n_params + 12 * layers * seq * hidden
+    model_flops = tokens_per_sec * flops_per_token
+    tpu_peak = 197e12  # TPU v5e bf16 peak per chip
+    mfu = model_flops / tpu_peak
+    a100_at_half_mfu = 0.5 * 312e12
+    vs_baseline = model_flops / a100_at_half_mfu
+
+    print(json.dumps({
+        "metric": "gpt_lm_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 3),
+        "step_time_s": round(dt, 4),
+        "mfu_vs_v5e_peak": round(mfu, 3),
+        "model_params_m": round(n_params / 1e6, 1),
+        "config": {"hidden": hidden, "layers": layers, "seq": seq,
+                   "batch": batch, "vocab": vocab},
+        "device": str(dev),
+        "loss": float(np.asarray(loss._data)),
+    }))
+
+
+if __name__ == "__main__":
+    main()

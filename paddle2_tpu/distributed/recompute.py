@@ -1,0 +1,77 @@
+"""Activation recomputation (fleet/recompute/recompute.py:455 parity).
+
+The reference re-runs the forward inside a PyLayer backward with saved RNG
+state. TPU-native: ``jax.checkpoint`` (remat) on the block's pure function —
+XLA saves only the block inputs and re-materializes activations in the
+backward, the standard HBM-for-FLOPs trade on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, use_reentrant: bool = True, **kwargs):
+    """Run ``function`` (Layer or callable) over ``args`` with activation
+    checkpointing: only the inputs (and params) are saved for backward."""
+    from ..nn.layer.layers import Layer
+
+    params: List[Tensor] = []
+    buffers: List[Tensor] = []
+    if isinstance(function, Layer):
+        params = [p for _, p in function.named_parameters()]
+        buffers = [b for _, b in function.named_buffers() if b is not None]
+
+    arg_tensors = [a for a in args if isinstance(a, Tensor)]
+    n_p, n_b = len(params), len(buffers)
+    state = params + buffers
+
+    def pure(*arrays):
+        originals = [t._data for t in state]
+        for t, a in zip(state, arrays[:n_p + n_b]):
+            t._data = a
+        try:
+            from ..framework import core
+            it = iter(arrays[n_p + n_b:])
+            call_args = [Tensor(next(it)) if isinstance(a, Tensor) else a
+                         for a in args]
+            with core.no_grad():
+                out = function(*call_args, **kwargs)
+        finally:
+            for t, a in zip(state, originals):
+                t._data = a
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    ckpt = jax.checkpoint(pure)
+    return apply_op("recompute", ckpt, tuple(state + arg_tensors), {})
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """fleet/recompute/recompute.py:622 parity: checkpoint a Sequential in
+    segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    funcs = list(functions)
+    seg_len = max(1, len(funcs) // max(1, segments))
+    out = args
+    for i in range(0, len(funcs), seg_len):
+        seg = funcs[i:i + seg_len]
+
+        def run_seg(*xs, _seg=seg):
+            y = xs
+            for f in _seg:
+                y = f(*y) if isinstance(y, tuple) else f(y)
+                y = y if isinstance(y, tuple) else (y,)
+            return y[0] if len(y) == 1 else y
+
+        out = (recompute(run_seg, *out),) if isinstance(out, tuple) else \
+            (recompute(run_seg, out),)
+    return out[0] if isinstance(out, tuple) and len(out) == 1 else out

@@ -77,11 +77,25 @@ class Tensor:
         return ops.manipulation.t(self)
 
     # -- conversion -----------------------------------------------------
+    def _guard_value_read(self, what: str) -> None:
+        """Under jit.to_static tracing a Tensor has no concrete value: a
+        Python branch on it would silently BAKE the trace-time path into the
+        cached program (the reference's SOT graph-breaks instead, jit/sot/).
+        Raise loudly rather than specialize."""
+        if _is_tracer(self._data):
+            raise RuntimeError(
+                f"jit.to_static: {what} reads the VALUE of a traced Tensor — "
+                "Python control flow would be frozen at trace time. Rewrite "
+                "with paddle.where/paddle.clip or tensor ops, or run this "
+                "function eagerly (reference SOT falls back here).")
+
     def numpy(self) -> np.ndarray:
+        self._guard_value_read("Tensor.numpy()")
         return np.asarray(self._data)
 
     def item(self, *args):
-        return self.numpy().item(*args)
+        self._guard_value_read("Tensor.item()")
+        return np.asarray(self._data).item(*args)
 
     def tolist(self):
         return self.numpy().tolist()
@@ -91,12 +105,15 @@ class Tensor:
         return a.astype(dtype) if dtype is not None else a
 
     def __float__(self):
+        self._guard_value_read("float(Tensor)")
         return float(self.item())
 
     def __int__(self):
+        self._guard_value_read("int(Tensor)")
         return int(self.item())
 
     def __bool__(self):
+        self._guard_value_read("bool(Tensor) / `if tensor:`")
         return bool(self.item())
 
     def __len__(self):

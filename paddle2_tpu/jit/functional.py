@@ -8,9 +8,12 @@ tracing the same Python code with tracer payloads: parameters/buffers are
 temporarily rebound to traced arrays, the function runs once under jit, and
 XLA compiles the whole graph. Guards (arg shapes/dtypes, training mode, grad
 mode) key the executable cache, mirroring the reference's guard-based compile
-cache (sot/symbolic/compile_cache.py). Backward re-linearizes the program
-inside jit (rematerialized forward) so both directions are single XLA
-executables.
+cache (sot/symbolic/compile_cache.py).
+
+Backward: the forward executable returns the linearization residuals
+(jax.vjp's Partial is a pytree, so it crosses the jit boundary); backward is
+a second executable applying them — forward runs ONCE per step, like the
+reference's program-pair (forward program + backward program) split.
 """
 
 from __future__ import annotations
@@ -63,7 +66,7 @@ def _fill_template(template, tensors):
 
 
 class TracedProgram:
-    """One traced function: guarded cache of (fwd_jit, vjp_jit) executables."""
+    """One traced function: guarded cache of compiled executables."""
 
     def __init__(self, fn: Callable, layers: Sequence = ()):
         self.fn = fn
@@ -85,6 +88,13 @@ class TracedProgram:
         template, args_t = _split_tensors(args, kwargs)
         arg_arrays = [t._data for t in args_t]
 
+        diff_inputs = params + args_t
+        needs_grad = (core.is_grad_enabled()
+                      and any(not t.stop_gradient
+                              and jnp.issubdtype(jnp.result_type(t._data),
+                                                 jnp.inexact)
+                              for t in diff_inputs))
+
         key = (jax.tree_util.tree_structure(template),
                tuple(str(x) for x in jax.tree_util.tree_leaves(template)
                      if not isinstance(x, (jnp.ndarray,))),
@@ -95,31 +105,30 @@ class TracedProgram:
         if entry is None:
             entry = self._build(template, params, buffers, len(args_t))
             self._compiled[key] = entry
-        fwd_jit, vjp_jit, meta = entry
+        fwd_jit, fwd_vjp_jit, vjp_apply_jit, meta = entry
 
         param_arrays = [p._data for p in params]
         buffer_arrays = [b._data for b in buffers]
         rng_key = fr.next_key()
-        result = fwd_jit(param_arrays, buffer_arrays, arg_arrays, rng_key)
-        n_out = meta["n_out"]
-        out_arrays = list(result[:n_out])
-        for b, a in zip(buffers, result[n_out:]):
+
+        if needs_grad:
+            out_arrays, post_buffers, f_vjp = fwd_vjp_jit(
+                param_arrays, buffer_arrays, arg_arrays, rng_key)
+        else:
+            out_arrays, post_buffers = fwd_jit(
+                param_arrays, buffer_arrays, arg_arrays, rng_key)
+        for b, a in zip(buffers, post_buffers):
             b._replace_data(a)
 
-        diff_inputs = params + args_t
-        needs_grad = (core.is_grad_enabled()
-                      and any(not t.stop_gradient
-                              and jnp.issubdtype(jnp.result_type(t._data),
-                                                 jnp.inexact)
-                              for t in diff_inputs))
         out_tensors = [Tensor(a, stop_gradient=not needs_grad)
                        for a in out_arrays]
         if needs_grad:
             def run_vjp(cts):
                 if not isinstance(cts, tuple):
                     cts = (cts,)
-                g_params, g_args = vjp_jit(param_arrays, buffer_arrays,
-                                           arg_arrays, rng_key, tuple(cts))
+                full = list(cts) + [jnp.zeros(a.shape, a.dtype)
+                                    for a in out_arrays[len(cts):]]
+                g_params, g_args = vjp_apply_jit(f_vjp, tuple(full))
                 grads = list(g_params) + list(g_args)
                 return tuple(
                     None if (g is None or g.dtype == jax.dtypes.float0) else g
@@ -136,10 +145,11 @@ class TracedProgram:
     def _build(self, template, params, buffers, n_args):
         fn = self.fn
         state_tensors = params + buffers
-        n_params = len(params)
         meta: Dict[str, Any] = {}
 
         def pure(param_arrays, buffer_arrays, arg_arrays, rng_key):
+            """Run the imperative fn functionally.
+            Returns (out_arrays tuple, post_buffer_arrays tuple)."""
             originals = [t._data for t in state_tensors]
             for t, a in zip(state_tensors, list(param_arrays)
                             + list(buffer_arrays)):
@@ -149,32 +159,33 @@ class TracedProgram:
                     call_args, call_kwargs = _fill_template(
                         template, [Tensor(a) for a in arg_arrays])
                     out = fn(*call_args, **call_kwargs)
-                post_buffers = [b._data for b in buffers]
+                post_buffers = tuple(b._data for b in buffers)
             finally:
                 for t, a in zip(state_tensors, originals):
                     t._data = a
             flat, treedef = jax.tree_util.tree_flatten(
                 out, is_leaf=lambda x: isinstance(x, Tensor))
-            out_arrays = [o._data if isinstance(o, Tensor) else jnp.asarray(o)
-                          for o in flat]
+            out_arrays = tuple(o._data if isinstance(o, Tensor)
+                               else jnp.asarray(o) for o in flat)
             meta["treedef"] = treedef
-            meta["n_out"] = len(out_arrays)
-            return tuple(out_arrays) + tuple(post_buffers)
+            return out_arrays, post_buffers
 
-        # meta (treedef/n_out) is filled by the first fwd_jit trace, which
-        # always precedes any vjp_jit call for this guard entry
         fwd_jit = jax.jit(pure)
-        n_out_holder = meta
 
         @jax.jit
-        def vjp_jit(param_arrays, buffer_arrays, arg_arrays, rng_key, cts):
+        def fwd_vjp_jit(param_arrays, buffer_arrays, arg_arrays, rng_key):
+            # jax.vjp's bound residual function is a pytree (Partial), so it
+            # crosses the jit boundary: forward executes ONCE and backward
+            # replays only the transpose over saved residuals.
             def f(p_arrays, a_arrays):
-                out = pure(p_arrays, buffer_arrays, a_arrays, rng_key)
-                return out[:n_out_holder["n_out"]]
+                outs, post_b = pure(p_arrays, buffer_arrays, a_arrays,
+                                    rng_key)
+                return outs, post_b
 
-            outs, vjp_fn = jax.vjp(f, list(param_arrays), list(arg_arrays))
-            full = list(cts) + [jnp.zeros(o.shape, o.dtype)
-                                for o in outs[len(cts):]]
-            return vjp_fn(tuple(full))
+            outs, f_vjp, post_b = jax.vjp(f, list(param_arrays),
+                                          list(arg_arrays), has_aux=True)
+            return outs, post_b, f_vjp
 
-        return fwd_jit, vjp_jit, meta
+        vjp_apply_jit = jax.jit(lambda f_vjp, cts: f_vjp(cts))
+
+        return fwd_jit, fwd_vjp_jit, vjp_apply_jit, meta

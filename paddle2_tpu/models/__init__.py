@@ -1,0 +1,7 @@
+"""Model zoo: flagship architectures matching BASELINE.json configs."""
+
+from .gpt import (GPTConfig, GPTModel, GPTForCausalLM, gpt3_1p3b, gpt_small,
+                  gpt_tiny)
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt3_1p3b",
+           "gpt_small", "gpt_tiny"]

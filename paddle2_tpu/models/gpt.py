@@ -1,0 +1,239 @@
+"""GPT-style decoder LM — the flagship model family (BASELINE config 4:
+"GPT-3 1.3B Fleet hybrid-parallel"; reference model zoo lives in PaddleNLP,
+structure mirrored from fleet mp examples: fused qkv, pre-LN blocks,
+Column/Row-parallel MLP like fleet/layers/mpu/mp_layers.py usage).
+
+TPU-first design: one logical module works at every parallelism degree —
+  * tensor_parallel=True swaps Linear for GSPMD-sharded Column/Row layers
+    (mp mesh axis), including the vocab-parallel embedding + tied head.
+  * sequence_parallel=True keeps inter-block activations sharded over the
+    'sep' axis on the sequence dim (Megatron-SP; attention re-gathers).
+  * the flash-attention kernel (kernels/) serves the sdpa hot path.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..kernels.attention import scaled_dot_product_attention
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 1024
+    intermediate_size: Optional[int] = None  # default 4*hidden
+    hidden_dropout_prob: float = 0.0
+    attention_dropout_prob: float = 0.0
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    tie_word_embeddings: bool = True
+    tensor_parallel: bool = False
+    sequence_parallel: bool = False
+    use_recompute: bool = False
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def _init_attr(std):
+    return nn.ParamAttr(initializer=nn.initializer.Normal(mean=0.0, std=std))
+
+
+def _linear_pair(cfg: GPTConfig, d_in, d_mid, std):
+    """(up, down) projections: parallel Column/Row when tensor_parallel."""
+    if cfg.tensor_parallel:
+        from ..distributed.fleet import (ColumnParallelLinear,
+                                         RowParallelLinear)
+        up = ColumnParallelLinear(d_in, d_mid, weight_attr=_init_attr(std),
+                                  gather_output=False)
+        down = RowParallelLinear(d_mid, d_in, weight_attr=_init_attr(std),
+                                 input_is_parallel=True)
+    else:
+        up = nn.Linear(d_in, d_mid, weight_attr=_init_attr(std))
+        down = nn.Linear(d_mid, d_in, weight_attr=_init_attr(std))
+    return up, down
+
+
+def _seq_constrain(x: Tensor, cfg: GPTConfig) -> Tensor:
+    """Keep activations sharded [dp(batch), sep(seq), -] between blocks."""
+    if not cfg.sequence_parallel:
+        return x
+    from ..distributed import get_mesh
+    from ..distributed.fleet.mp_layers import _constrain_tensor
+    from jax.sharding import PartitionSpec as P
+    mesh = get_mesh()
+    if mesh is None or "sep" not in mesh.axis_names:
+        return x
+    batch_axis = "dp" if "dp" in mesh.axis_names else None
+    return _constrain_tensor(x, P(batch_axis, "sep",
+                                  *([None] * (x.ndim - 2))))
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        h = cfg.hidden_size
+        std = cfg.initializer_range
+        proj_std = std / math.sqrt(2 * cfg.num_layers)
+        if cfg.tensor_parallel:
+            from ..distributed.fleet import (ColumnParallelLinear,
+                                             RowParallelLinear)
+            self.qkv = ColumnParallelLinear(h, 3 * h,
+                                            weight_attr=_init_attr(std),
+                                            gather_output=False)
+            self.out_proj = RowParallelLinear(h, h,
+                                              weight_attr=_init_attr(std),
+                                              input_is_parallel=True)
+        else:
+            self.qkv = nn.Linear(h, 3 * h, weight_attr=_init_attr(std))
+            self.out_proj = nn.Linear(h, h, weight_attr=_init_attr(std))
+        # GPT-2 init: residual-out projections scaled by 1/sqrt(2*layers)
+        w = self.out_proj.weight
+        data = nn.initializer.Normal(mean=0.0, std=proj_std)(w.shape, w.dtype)
+        data = data._data if isinstance(data, Tensor) else jnp.asarray(data)
+        w._replace_data(jax.device_put(data, w._data.sharding))
+
+    def forward(self, x):
+        cfg = self.cfg
+        b, s, h = x.shape
+        qkv = self.qkv(x)  # [b, s, 3h] (mp-sharded when TP)
+        qkv = qkv.reshape([b, s, 3, cfg.num_heads, cfg.head_dim])
+        q, k, v = qkv.unbind(axis=2)
+        out = scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=cfg.attention_dropout_prob, training=self.training)
+        out = out.reshape([b, s, h])
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.up, self.down = _linear_pair(cfg, cfg.hidden_size, cfg.ffn_size,
+                                          cfg.initializer_range)
+
+    def forward(self, x):
+        return self.down(F.gelu(self.up(x)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        eps = cfg.layer_norm_epsilon
+        self.ln_1 = nn.LayerNorm(cfg.hidden_size, epsilon=eps)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = nn.LayerNorm(cfg.hidden_size, epsilon=eps)
+        self.mlp = GPTMLP(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.dropout(self.mlp(self.ln_2(x)))
+        return _seq_constrain(x, self.cfg)
+
+
+class GPTModel(nn.Layer):
+    """Transformer trunk: embeddings -> blocks -> final LN."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        std = cfg.initializer_range
+        if cfg.tensor_parallel:
+            from ..distributed.fleet import VocabParallelEmbedding
+            self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size,
+                                              weight_attr=_init_attr(std))
+        else:
+            self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                    weight_attr=_init_attr(std))
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size,
+                                weight_attr=_init_attr(std))
+        self.drop = nn.Dropout(cfg.hidden_dropout_prob)
+        self.h = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        pos = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = _seq_constrain(self.drop(x), self.cfg)
+        for block in self.h:
+            if self.cfg.use_recompute and self.training:
+                from ..distributed.recompute import recompute
+                x = recompute(block, x)
+            else:
+                x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """Trunk + LM head (tied to wte by default, like the reference zoo)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     weight_attr=_init_attr(
+                                         cfg.initializer_range),
+                                     bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.gpt(input_ids)
+        if self.cfg.tie_word_embeddings:
+            logits = F.linear(hidden, self.gpt.wte.weight.T)
+        else:
+            logits = self.lm_head(hidden)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.cfg.vocab_size]).astype("float32"),
+            labels.reshape([-1]))
+        return logits, loss
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+
+def gpt3_1p3b(**overrides) -> GPTConfig:
+    """BASELINE config 4 geometry (GPT-3 1.3B)."""
+    cfg = dict(vocab_size=50304, hidden_size=2048, num_layers=24,
+               num_heads=16, max_position_embeddings=2048)
+    cfg.update(overrides)
+    return GPTConfig(**cfg)
+
+
+def gpt_small(**overrides) -> GPTConfig:
+    cfg = dict(vocab_size=50304, hidden_size=768, num_layers=12,
+               num_heads=12, max_position_embeddings=1024)
+    cfg.update(overrides)
+    return GPTConfig(**cfg)
+
+
+def gpt_tiny(**overrides) -> GPTConfig:
+    """Test/dryrun geometry."""
+    cfg = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+               max_position_embeddings=64)
+    cfg.update(overrides)
+    return GPTConfig(**cfg)

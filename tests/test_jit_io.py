@@ -194,3 +194,68 @@ def test_collate_nested_dict():
     batch = next(iter(DataLoader(D(), batch_size=2)))
     assert batch["x"].shape == [2, 3]
     assert batch["meta"][0].numpy().tolist() == [0, 1]
+
+
+def test_to_static_forward_runs_once_per_step():
+    """r2: backward must NOT re-run the forward (residual-based vjp)."""
+    calls = {"n": 0}
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            calls["n"] += 1
+            return self.fc(x).sum()
+
+    net = Net()
+    st = paddle.jit.to_static(net)
+    x = paddle.randn([2, 4])
+    loss = st(x)
+    loss.backward()
+    # tracing runs the python fn a bounded number of times (fwd trace +
+    # vjp trace); afterwards steps must not re-enter python at all
+    traced = calls["n"]
+    for _ in range(3):
+        loss = st(x)
+        loss.backward()
+    assert calls["n"] == traced
+
+
+def test_to_static_value_dependence_raises():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if float(h.sum()) > 0:  # value-dependent python branch
+                return h * 2
+            return h
+
+    st = paddle.jit.to_static(Net())
+    with pytest.raises(RuntimeError, match="traced Tensor"):
+        st(paddle.randn([2, 4]))
+
+
+def test_to_static_grad_correctness_after_vjp_rework():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    x_np = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+
+    x1 = paddle.to_tensor(x_np, stop_gradient=False)
+    (net(x1) ** 2).sum().backward()
+    eager_grads = [p.grad.numpy().copy() for p in net.parameters()]
+    xg_eager = x1.grad.numpy().copy()
+    for p in net.parameters():
+        p.clear_grad()
+
+    st = paddle.jit.to_static(net)
+    x2 = paddle.to_tensor(x_np, stop_gradient=False)
+    (st(x2) ** 2).sum().backward()
+    np.testing.assert_allclose(xg_eager, x2.grad.numpy(), rtol=1e-5,
+                               atol=1e-6)
+    for ref, p in zip(eager_grads, net.parameters()):
+        np.testing.assert_allclose(ref, p.grad.numpy(), rtol=1e-5, atol=1e-6)
