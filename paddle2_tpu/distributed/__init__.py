@@ -19,6 +19,7 @@ from .parallel import DataParallel, init_parallel_env, parallel_initialized
 from .sharding import ShardedOptimizer, group_sharded_parallel, shard_optimizer
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
+from . import checkpoint  # noqa: F401
 
 __all__ = [
     "ParallelEnv", "get_rank", "get_world_size", "init_parallel_env",
