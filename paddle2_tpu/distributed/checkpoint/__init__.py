@@ -68,6 +68,13 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     os.makedirs(path, exist_ok=True)
     flat = flatten_state_dict(state_dict)
     rank = get_rank()
+    if rank == coordinator_rank:
+        # a re-save to the same path must not leave stale shard files from a
+        # wider previous run behind — load merges every data_*.pkl it finds
+        # (the reference versions files with unique_id instead)
+        for fname in os.listdir(path):
+            if fname.startswith("data_") and fname.endswith(".pkl"):
+                os.remove(os.path.join(path, fname))
 
     meta: Dict[str, Any] = {"tensors": {}, "scalars": {}}
     data: Dict[Tuple[str, Tuple], np.ndarray] = {}

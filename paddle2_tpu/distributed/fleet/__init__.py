@@ -17,6 +17,8 @@ from . import mp_layers  # noqa: F401
 from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
                         RowParallelLinear, VocabParallelEmbedding)
 from ..sharding import ShardedOptimizer, group_sharded_parallel
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+from .pipeline_parallel import PipelineParallel
 
 __all__ = ["init", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
@@ -24,7 +26,9 @@ __all__ = ["init", "DistributedStrategy", "distributed_model",
            "ColumnParallelLinear", "RowParallelLinear",
            "VocabParallelEmbedding", "ParallelCrossEntropy",
            "ShardedOptimizer", "group_sharded_parallel", "worker_index",
-           "worker_num", "is_first_worker", "meta_parallel"]
+           "worker_num", "is_first_worker", "meta_parallel",
+           "LayerDesc", "SharedLayerDesc", "PipelineLayer",
+           "PipelineParallel"]
 
 
 class DistributedStrategy:
@@ -86,6 +90,9 @@ def distributed_model(model):
     batch sharding when dp_degree > 1 (pipeline models wrap elsewhere)."""
     from ..parallel import DataParallel
     hcg = get_hybrid_communicate_group()
+    if isinstance(model, PipelineLayer):
+        strategy = _state["strategy"] or DistributedStrategy()
+        return PipelineParallel(model, hcg=hcg, strategy=strategy)
     if hcg is not None and hcg.get_data_parallel_world_size() > 1:
         return DataParallel(model, group=hcg.get_data_parallel_group())
     return model
@@ -123,3 +130,7 @@ class meta_parallel:
     RowParallelLinear = RowParallelLinear
     VocabParallelEmbedding = VocabParallelEmbedding
     ParallelCrossEntropy = ParallelCrossEntropy
+    LayerDesc = LayerDesc
+    SharedLayerDesc = SharedLayerDesc
+    PipelineLayer = PipelineLayer
+    PipelineParallel = PipelineParallel

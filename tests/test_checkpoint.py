@@ -154,3 +154,24 @@ def test_dist_checkpoint_nested_flatten(tmp_path):
     dck.load_state_dict(tgt, path)
     np.testing.assert_array_equal(tgt["model"]["fc"].numpy(), np.eye(3))
     assert tgt["opt"]["lr"] == 0.5
+
+
+def test_dist_checkpoint_resave_removes_stale_shards(tmp_path):
+    """Re-saving to the same path must not leave old data_*.pkl behind —
+    load merges every shard file it finds (regression)."""
+    import pickle
+    path = str(tmp_path / "dist_ckpt")
+    state = _sharded_state({"dp": 8}, "dp")
+    dck.save_state_dict(state, path)
+    # plant a stale shard file as if from a wider previous run
+    stale = {("w", ((0, 8), (0, 8))): np.full((8, 8), -1, np.float32)}
+    with open(os.path.join(path, "data_7.pkl"), "wb") as f:
+        pickle.dump(stale, f)
+    dck.save_state_dict(state, path)
+    assert "data_7.pkl" not in os.listdir(path)
+    target = _sharded_state({"dp": 8}, "dp")
+    target["w"]._replace_data(target["w"]._data * 0)
+    dck.load_state_dict(target, path)
+    np.testing.assert_array_equal(
+        np.asarray(target["w"]._data),
+        np.arange(64, dtype=np.float32).reshape(8, 8))

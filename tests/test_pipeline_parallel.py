@@ -1,0 +1,269 @@
+"""Pipeline parallelism: 1F1B schedule + PipelineLayer/PipelineParallel
+parity with non-pipelined training (test/collective/fleet
+hybrid_parallel_pp_* parity)."""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.nn as nn
+import paddle2_tpu.nn.functional as F
+import paddle2_tpu.optimizer as opt
+from paddle2_tpu.distributed import fleet
+from paddle2_tpu.distributed.fleet.pipeline_parallel import (
+    _tick_trace, schedule_1f1b, schedule_gpipe)
+
+
+# ----------------------------------------------------------------- schedule
+
+def test_1f1b_schedule_shape():
+    S, M = 4, 8
+    sched = schedule_1f1b(S, M)
+    for s, ops in enumerate(sched):
+        assert len(ops) == 2 * M
+        fwd = [m for op, m in ops if op == "F"]
+        bwd = [m for op, m in ops if op == "B"]
+        assert fwd == list(range(M)) and bwd == list(range(M))
+        warm = min(S - 1 - s, M)
+        assert all(op == "F" for op, _ in ops[:warm])
+        # steady state strictly alternates F,B after warmup
+        steady = ops[warm:warm + 2 * (M - warm)]
+        assert all(steady[i][0] == ("F" if i % 2 == 0 else "B")
+                   for i in range(len(steady)))
+
+
+def test_1f1b_trace_dataflow_and_no_deadlock():
+    S, M = 4, 8
+    trace = _tick_trace(schedule_1f1b(S, M), S)
+    done = set()
+    for tick, s, op, m in trace:
+        if op == "F" and s > 0:
+            assert ("F", s - 1, m) in done
+        if op == "B":
+            assert ("F", s, m) in done
+            if s < S - 1:
+                assert ("B", s + 1, m) in done
+        done.add((op, s, m))
+    assert len(trace) == 2 * S * M
+
+
+def _build_stack(n_hidden=6, width=16):
+    paddle.seed(7)
+    layers = []
+    for _ in range(n_hidden):
+        layers.append(nn.Linear(width, width))
+        layers.append(nn.GELU())
+    layers.append(nn.Linear(width, 1))
+    return layers
+
+
+def _pp_setup(pp=4):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": pp, "sharding_degree": 1,
+                               "sep_degree": 1}
+    # pp>1 on the 8-dev CPU mesh leaves dp to absorb the rest
+    return fleet.init(strategy=strategy)
+
+
+def _mse(out, label):
+    return F.mse_loss(out, label)
+
+
+# ----------------------------------------------------------------- parity
+
+@pytest.mark.parametrize("schedule", ["1F1B", "GPIPE"])
+def test_pipeline_training_parity(schedule):
+    _pp_setup(pp=4)
+    x_np = np.random.RandomState(0).randn(8, 16).astype("float32")
+    y_np = np.random.RandomState(1).randn(8, 1).astype("float32")
+
+    # pipelined: 4 stages x 4 microbatches
+    pipe = fleet.PipelineLayer(_build_stack(), num_stages=4, loss_fn=_mse)
+    pp = fleet.PipelineParallel(pipe, num_microbatches=4, schedule=schedule)
+    o1 = opt.SGD(learning_rate=0.1, parameters=pp.parameters())
+    loss_pp = pp.train_batch([paddle.to_tensor(x_np), paddle.to_tensor(y_np)],
+                             optimizer=o1)
+
+    # reference: same stack (identical init via seed), plain full batch
+    ref_layers = _build_stack()
+    o2 = opt.SGD(learning_rate=0.1,
+                 parameters=[p for l in ref_layers for p in l.parameters()])
+    h = paddle.to_tensor(x_np)
+    for l in ref_layers:
+        h = l(h)
+    loss_ref = _mse(h, paddle.to_tensor(y_np))
+    loss_ref.backward()
+    o2.step()
+    o2.clear_grad()
+
+    np.testing.assert_allclose(float(loss_pp.numpy()),
+                               float(loss_ref.numpy()), rtol=1e-5)
+    ref_flat = [p for l in ref_layers for p in l.parameters()]
+    pp_flat = pp.parameters()
+    assert len(ref_flat) == len(pp_flat)
+    for a, b in zip(pp_flat, ref_flat):
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-4,
+                                   atol=1e-6)
+
+
+def test_pipeline_peak_activation_memory():
+    """1F1B's point: stage s holds at most min(S-s, M) live activations;
+    GPipe holds all M."""
+    _pp_setup(pp=4)
+    S, M = 4, 8
+    x_np = np.random.RandomState(0).randn(M * 2, 16).astype("float32")
+    y_np = np.random.RandomState(1).randn(M * 2, 1).astype("float32")
+    for schedule, expect in (("1F1B", [min(S - s, M) for s in range(S)]),
+                             ("GPIPE", [M] * S)):
+        pipe = fleet.PipelineLayer(_build_stack(), num_stages=S,
+                                   loss_fn=_mse)
+        pp = fleet.PipelineParallel(pipe, num_microbatches=M,
+                                    schedule=schedule)
+        o = opt.SGD(learning_rate=0.01, parameters=pp.parameters())
+        pp.train_batch([paddle.to_tensor(x_np), paddle.to_tensor(y_np)],
+                       optimizer=o)
+        assert [pp.peak_live_fwd[s] for s in range(S)] == expect, schedule
+
+
+def test_interleaved_vpp_parity():
+    _pp_setup(pp=2)
+    x_np = np.random.RandomState(0).randn(8, 16).astype("float32")
+    y_np = np.random.RandomState(1).randn(8, 1).astype("float32")
+    pipe = fleet.PipelineLayer(_build_stack(), num_stages=2, loss_fn=_mse,
+                               num_virtual_pipeline_stages=2)
+    assert len(pipe.segment_parts) == 5  # 2 stages x 2 chunks + 1
+    pp = fleet.PipelineParallel(pipe, num_microbatches=4)
+    o1 = opt.SGD(learning_rate=0.1, parameters=pp.parameters())
+    loss_pp = pp.train_batch([paddle.to_tensor(x_np), paddle.to_tensor(y_np)],
+                             optimizer=o1)
+
+    ref_layers = _build_stack()
+    o2 = opt.SGD(learning_rate=0.1,
+                 parameters=[p for l in ref_layers for p in l.parameters()])
+    h = paddle.to_tensor(x_np)
+    for l in ref_layers:
+        h = l(h)
+    loss_ref = _mse(h, paddle.to_tensor(y_np))
+    loss_ref.backward()
+    o2.step()
+    np.testing.assert_allclose(float(loss_pp.numpy()),
+                               float(loss_ref.numpy()), rtol=1e-5)
+    for a, b in zip(pp.parameters(),
+                    [p for l in ref_layers for p in l.parameters()]):
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-4,
+                                   atol=1e-6)
+
+
+# --------------------------------------------------------- layer desc / misc
+
+def test_layer_desc_and_seg_method():
+    _pp_setup(pp=4)
+    descs = []
+    for _ in range(8):
+        descs.append(fleet.LayerDesc(nn.Linear, 8, 8))
+        descs.append(nn.ReLU())
+    pipe = fleet.PipelineLayer(descs, num_stages=4, seg_method="layer:Linear")
+    assert len(pipe.run_function) == 16
+    # each stage starts at a Linear boundary and gets 2 of the 8 Linears
+    for s in range(4):
+        seg = pipe.stage_layers(s)
+        assert isinstance(seg[0], nn.Linear)
+        assert sum(isinstance(l, nn.Linear) for l in seg) == 2
+    out = pipe(paddle.randn([2, 8]))
+    assert tuple(out.shape) == (2, 8)
+
+
+def test_shared_layer_desc_tied_embeddings():
+    """SharedLayerDesc ties input/output embedding; grads flow from BOTH
+    uses into the one weight (pp_layers.py:116 shared-weight contract)."""
+    _pp_setup(pp=2)
+    vocab, dim = 12, 8
+
+    def as_logits(emb_layer, x):
+        return paddle.matmul(x, paddle.transpose(emb_layer.weight, [1, 0]))
+
+    descs = [
+        fleet.SharedLayerDesc("emb", nn.Embedding, vocab, dim),
+        fleet.LayerDesc(nn.Linear, dim, dim),
+        fleet.SharedLayerDesc("emb", nn.Embedding, vocab, dim,
+                              forward_func=as_logits),
+    ]
+    pipe = fleet.PipelineLayer(descs, num_stages=2,
+                               loss_fn=lambda out, y:
+                               F.cross_entropy(out, y))
+    emb_first = pipe.run_function[0].shared
+    emb_last = pipe.run_function[2].shared
+    assert emb_first is emb_last
+    pp = fleet.PipelineParallel(pipe, num_microbatches=2)
+    ids = paddle.to_tensor(np.random.RandomState(0)
+                           .randint(0, vocab, (4,)).astype("int64"))
+    loss = pp.train_batch([ids, ids],
+                          optimizer=opt.SGD(learning_rate=0.1,
+                                            parameters=pp.parameters()))
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_pipeline_with_grad_scaler_matches_unscaled():
+    """scaler.step() unscales grads that train_batch really scaled — the
+    update must equal the no-scaler run (regression: seed was unscaled)."""
+    _pp_setup(pp=2)
+    x_np = np.random.RandomState(0).randn(8, 16).astype("float32")
+    y_np = np.random.RandomState(1).randn(8, 1).astype("float32")
+
+    pipe = fleet.PipelineLayer(_build_stack(), num_stages=2, loss_fn=_mse)
+    pp = fleet.PipelineParallel(pipe, num_microbatches=4)
+    o1 = opt.SGD(learning_rate=0.1, parameters=pp.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    pp.train_batch([paddle.to_tensor(x_np), paddle.to_tensor(y_np)],
+                   optimizer=o1, scaler=scaler)
+
+    pipe2 = fleet.PipelineLayer(_build_stack(), num_stages=2, loss_fn=_mse)
+    pp2 = fleet.PipelineParallel(pipe2, num_microbatches=4)
+    o2 = opt.SGD(learning_rate=0.1, parameters=pp2.parameters())
+    pp2.train_batch([paddle.to_tensor(x_np), paddle.to_tensor(y_np)],
+                    optimizer=o2)
+    for a, b in zip(pp.parameters(), pp2.parameters()):
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-4,
+                                   atol=1e-6)
+
+
+def test_hybrid_dp_pp_parity():
+    """dp=2 x pp=2: inputs shard over dp, params replicate, loss matches the
+    single-process run (regression: hcg was dropped)."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 1,
+                               "sep_degree": 1}
+    hcg = fleet.init(strategy=strategy)
+    x_np = np.random.RandomState(0).randn(8, 16).astype("float32")
+    y_np = np.random.RandomState(1).randn(8, 1).astype("float32")
+
+    pipe = fleet.PipelineLayer(_build_stack(), num_stages=2, loss_fn=_mse)
+    pp = fleet.distributed_model(pipe)
+    assert pp._dp_axis == "dp"
+    o1 = opt.SGD(learning_rate=0.1, parameters=pp.parameters())
+    loss = pp.train_batch([paddle.to_tensor(x_np), paddle.to_tensor(y_np)],
+                          optimizer=o1)
+    assert len(pp.state_dict())  # checkpointable through the wrapper
+
+    ref_layers = _build_stack()
+    h = paddle.to_tensor(x_np)
+    for l in ref_layers:
+        h = l(h)
+    loss_ref = _mse(h, paddle.to_tensor(y_np))
+    np.testing.assert_allclose(float(loss.numpy()), float(loss_ref.numpy()),
+                               rtol=1e-5)
+
+
+def test_distributed_model_wraps_pipeline():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 1,
+                               "sep_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    fleet.init(strategy=strategy)
+    pipe = fleet.PipelineLayer(_build_stack(), num_stages=2, loss_fn=_mse)
+    wrapped = fleet.distributed_model(pipe)
+    assert isinstance(wrapped, fleet.PipelineParallel)
+    assert wrapped.accumulate_steps == 2
