@@ -68,6 +68,11 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     os.makedirs(path, exist_ok=True)
     flat = flatten_state_dict(state_dict)
     rank = get_rank()
+    import jax
+    multi = jax.process_count() > 1
+    if multi:  # nobody may still be writing shards from a previous save
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("ckpt_save_enter")
     if rank == coordinator_rank:
         # a re-save to the same path must not leave stale shard files from a
         # wider previous run behind — load merges every data_*.pkl it finds
@@ -75,6 +80,9 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         for fname in os.listdir(path):
             if fname.startswith("data_") and fname.endswith(".pkl"):
                 os.remove(os.path.join(path, fname))
+    if multi:  # shard writes must not race the coordinator's cleanup
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("ckpt_save_cleaned")
 
     meta: Dict[str, Any] = {"tensors": {}, "scalars": {}}
     data: Dict[Tuple[str, Tuple], np.ndarray] = {}

@@ -120,7 +120,14 @@ class PipelineParallel:
         if num_microbatches is None and strategy is not None:
             acc = getattr(strategy, "pipeline_configs", {}) or {}
             self.accumulate_steps = acc.get("accumulate_steps", None)
-        self.schedule = schedule.upper()
+        norm = schedule.upper().replace("-", "").replace("_", "")
+        if norm in ("1F1B",):
+            self.schedule = "1F1B"
+        elif norm in ("GPIPE", "FTHENB"):  # reference name: F-then-B
+            self.schedule = "GPIPE"
+        else:
+            raise ValueError(f"unknown pipeline schedule {schedule!r}; "
+                             "expected '1F1B' or 'GPipe'/'F-then-B'")
         self.schedule_log: List[Tuple[int, int, str, int]] = []
         self.peak_live_fwd: Dict[int, int] = {}
         self._boundary_grad: Dict[Tuple[int, int], Tensor] = {}
