@@ -205,6 +205,11 @@ class PipelineParallel:
             inputs, labels = data, None
         M = self.accumulate_steps or self.num_stages
         micro_x = self._split_micro(inputs, M)
+        if labels is not None and not isinstance(labels, Tensor) and \
+                (hasattr(labels, "shape") or isinstance(labels, (list,
+                                                                 tuple))):
+            # array-like labels must be split per microbatch like inputs
+            labels = Tensor(jnp.asarray(np.asarray(labels)))
         micro_y = (self._split_micro(labels, M)
                    if isinstance(labels, Tensor) else [labels] * M)
 

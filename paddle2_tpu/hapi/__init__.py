@@ -1,1 +1,9 @@
+"""paddle.hapi (reference python/paddle/hapi/)."""
 
+from .model import Model
+from . import callbacks  # noqa: F401
+from .callbacks import (Callback, EarlyStopping, LRScheduler,
+                        ModelCheckpoint, ProgBarLogger)
+
+__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
+           "LRScheduler", "EarlyStopping", "callbacks"]

@@ -1,2 +1,315 @@
+"""High-level Model API (reference python/paddle/hapi/model.py:918 Model,
+:1472 fit, :1685 evaluate, :1797 predict; independent implementation on the
+eager engine — the reference's static-graph branch is subsumed by
+jit.to_static, which callers can apply to the wrapped network)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .callbacks import Callback, CallbackList, ProgBarLogger, ModelCheckpoint
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _tensorize(batch):
+    from .. import to_tensor
+    out = []
+    for item in _to_list(batch):
+        if isinstance(item, Tensor):
+            out.append(item)
+        else:
+            out.append(to_tensor(np.asarray(item)))
+    return out
+
+
 class Model:
-    pass
+    """hapi/model.py:918 parity: wraps a Layer with train/eval/predict
+    loops, metric bookkeeping, and checkpoint save/load."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List = []
+        self.stop_training = False
+
+    # ----------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        """model.py:1392 parity."""
+        self._optimizer = optimizer
+        if loss is not None and not (isinstance(loss, Layer)
+                                     or callable(loss)):
+            raise TypeError("loss must be a Layer or callable")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        return self
+
+    # ------------------------------------------------------ batch methods
+    def train_batch(self, inputs, labels=None, update=True):
+        """model.py:1049 parity. Returns [loss values] (+ metric results)."""
+        if self._optimizer is None or self._loss is None:
+            raise RuntimeError("call prepare(optimizer, loss) before "
+                               "training")
+        self.network.train()
+        ins = _tensorize(inputs)
+        lbs = _tensorize(labels)
+        outs = self.network(*ins)
+        losses = self._compute_loss(outs, lbs)
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outs, lbs)
+        loss_vals = [float(np.asarray(l.numpy())) for l in losses]
+        return (loss_vals, metrics) if metrics else loss_vals
+
+    def eval_batch(self, inputs, labels=None):
+        from ..framework import core
+        self.network.eval()
+        ins = _tensorize(inputs)
+        lbs = _tensorize(labels)
+        with core.no_grad():
+            outs = self.network(*ins)
+            losses = self._compute_loss(outs, lbs) if self._loss else []
+        metrics = self._update_metrics(outs, lbs)
+        loss_vals = [float(np.asarray(l.numpy())) for l in losses]
+        return (loss_vals, metrics) if metrics else loss_vals
+
+    def predict_batch(self, inputs):
+        from ..framework import core
+        self.network.eval()
+        ins = _tensorize(inputs)
+        with core.no_grad():
+            outs = self.network(*ins)
+        return [np.asarray(o.numpy()) for o in _to_list(outs)]
+
+    def _compute_loss(self, outs, lbs):
+        outs_l = _to_list(outs)
+        losses = self._loss(*(outs_l + lbs))
+        return _to_list(losses)
+
+    def _update_metrics(self, outs, lbs):
+        outs_l = _to_list(outs)
+        res = {}
+        for m in self._metrics:
+            computed = m.compute(*(outs_l + lbs))
+            m.update(*_to_list(computed))
+            res[str(m.name())] = m.accumulate()
+        return res
+
+    # -------------------------------------------------------------- loops
+    def _make_loader(self, data, batch_size, shuffle, num_workers,
+                     drop_last=False):
+        from ..io.dataloader import DataLoader, Dataset
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    @staticmethod
+    def _split_batch(batch):
+        batch = _to_list(batch)
+        if len(batch) == 1:
+            return batch, []
+        return batch[:-1], batch[-1:]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        """model.py:1472 parity."""
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, drop_last=drop_last)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers)
+        cbks = _to_list(callbacks)
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbks):
+            cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+        if save_dir:
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        cbk = CallbackList(cbks)
+        cbk.set_model(self)
+        cbk.set_params({"epochs": epochs, "steps": len(loader),
+                        "verbose": verbose,
+                        "metrics": ["loss"] + [str(m.name())
+                                               for m in self._metrics]})
+        self.stop_training = False
+        cbk.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            cbk.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                cbk.on_train_batch_begin(step)
+                ins, lbs = self._split_batch(batch)
+                # end-of-epoch flush so a trailing partial accumulation
+                # cannot leak into the next epoch (reference model.py:2808)
+                update = ((step + 1) % accumulate_grad_batches == 0
+                          or step + 1 == len(loader))
+                res = self.train_batch(ins, lbs, update=update)
+                logs = self._pack_logs(res)
+                cbk.on_train_batch_end(step, logs)
+                it += 1
+                if (num_iters is not None and it >= num_iters) or \
+                        self.stop_training:
+                    break
+            epoch_logs = dict(logs) if loader else {}
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          num_workers=num_workers)
+                epoch_logs.update({f"eval_{k}": v
+                                   for k, v in eval_logs.items()})
+            cbk.on_epoch_end(epoch, epoch_logs)
+            if (num_iters is not None and it >= num_iters) or \
+                    self.stop_training:
+                break
+        cbk.on_train_end()
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        """model.py:1685 parity: returns {metric_name: value}.
+        ``num_samples`` caps how many samples are evaluated."""
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        cbk = CallbackList(_to_list(callbacks))
+        cbk.set_model(self)
+        cbk.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        seen = 0
+        for batch in loader:
+            ins, lbs = self._split_batch(batch)
+            res = self.eval_batch(ins, lbs)
+            loss_vals = res[0] if isinstance(res, tuple) else res
+            if loss_vals:
+                losses.append(loss_vals[0])
+            seen += int(_to_list(batch)[0].shape[0]
+                        if hasattr(_to_list(batch)[0], "shape")
+                        else batch_size)
+            if num_samples is not None and seen >= num_samples:
+                break
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[str(m.name())] = m.accumulate()
+        cbk.on_eval_end(logs)
+        if verbose:
+            import sys
+            print("Eval - " + " - ".join(
+                f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+                for k, v in logs.items()), file=sys.stderr)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        """model.py:1797 parity: list (per output) of per-batch arrays."""
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        outputs: Optional[List[List[np.ndarray]]] = None
+        # field count fed to the network: the inputs spec when declared,
+        # else the forward() signature's required-arg count (so a labeled
+        # dataset reused for predict doesn't push its labels into forward)
+        if self._inputs is not None:
+            n_in = len(_to_list(self._inputs))
+        else:
+            import inspect
+            params = [p for p in inspect.signature(
+                self.network.forward).parameters.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+            required = [p for p in params if p.default is p.empty]
+            n_in = max(len(required), 1)
+        for batch in loader:
+            ins = _to_list(batch)
+            outs = self.predict_batch(ins[:n_in])
+            if outputs is None:
+                outputs = [[] for _ in outs]
+            for slot, o in zip(outputs, outs):
+                slot.append(o)
+        if outputs is None:
+            return []
+        if stack_outputs:
+            return [np.concatenate(slot) for slot in outputs]
+        return outputs
+
+    def _pack_logs(self, res):
+        if isinstance(res, tuple):
+            loss_vals, metrics = res
+        else:
+            loss_vals, metrics = res, {}
+        logs = {"loss": loss_vals[0] if loss_vals else 0.0}
+        logs.update(metrics)
+        return logs
+
+    # -------------------------------------------------------- persistence
+    def save(self, path, training=True):
+        """model.py:1149: path + '.pdparams' (+ '.pdopt' with optimizer)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from .. import save as paddle_save
+        paddle_save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None and \
+                hasattr(self._optimizer, "state_dict"):
+            paddle_save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        """model.py:1216 parity."""
+        from .. import load as paddle_load
+        state = paddle_load(path + ".pdparams"
+                            if not path.endswith(".pdparams") else path)
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path) and \
+                hasattr(self._optimizer, "set_state_dict"):
+            self._optimizer.set_state_dict(paddle_load(opt_path))
+
+    # -------------------------------------------------------------- misc
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self.network.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self.network.set_state_dict(state_dict, *args, **kwargs)
+
+    def train(self):
+        self.network.train()
+
+    def eval(self):
+        self.network.eval()
+
+    def summary(self, input_size=None, dtype=None):
+        """model.py:2200: parameter-count summary dict."""
+        total = 0
+        trainable = 0
+        for p in self.network.parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            if not p.stop_gradient:
+                trainable += n
+        return {"total_params": total, "trainable_params": trainable}

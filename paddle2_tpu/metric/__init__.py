@@ -1,1 +1,7 @@
+"""paddle.metric (reference python/paddle/metric/metrics.py; independent
+numpy-accumulator implementation — metrics are host-side bookkeeping, so
+they live in numpy and never trace into XLA programs)."""
 
+from .metrics import Metric, Accuracy, Precision, Recall, Auc, accuracy
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]

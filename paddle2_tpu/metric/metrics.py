@@ -1,0 +1,200 @@
+"""Metric classes (reference python/paddle/metric/metrics.py:79 Metric,
+:194 Accuracy, :371 Precision, :476 Recall, :576 Auc)."""
+
+from __future__ import annotations
+
+import abc
+from typing import List, Sequence, Union
+
+import numpy as np
+
+
+def _np(x):
+    from ..framework.tensor import Tensor
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+class Metric(abc.ABC):
+    """metrics.py:79 contract: reset / update / accumulate / name /
+    compute (optional preprocessing that runs with the network outputs)."""
+
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def name(self):
+        ...
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (metrics.py:194)."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred = _np(pred)
+        label = _np(label)
+        idx = np.argsort(-pred, axis=-1)[..., :self.maxk]
+        if label.ndim == pred.ndim:
+            if label.shape[-1] == pred.shape[-1] and label.shape[-1] != 1:
+                label = label.argmax(axis=-1)  # one-hot / soft labels
+            else:  # [N, 1] index labels (metrics.py:285 guard)
+                label = label[..., 0]
+        correct = (idx == label[..., None]).astype("float32")
+        return correct
+
+    def update(self, correct, *args):
+        correct = _np(correct)
+        num = correct.shape[0]
+        accs = []
+        for k in self.topk:
+            c = correct[..., :k].sum(-1).mean()
+            accs.append(float(c))
+        self.total = [t + float(correct[..., :k].sum()) for t, k in
+                      zip(self.total, self.topk)]
+        self.count += num
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = 0
+
+    def accumulate(self):
+        res = [t / max(self.count, 1) for t in self.total]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision over 0/1 predictions (metrics.py:371)."""
+
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.rint(_np(preds)).astype("int32").reshape(-1)
+        labels = _np(labels).astype("int32").reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall (metrics.py:476)."""
+
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.rint(_np(preds)).astype("int32").reshape(-1)
+        labels = _np(labels).astype("int32").reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC AUC via threshold-bucketed confusion counts (metrics.py:576)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        self._name = name
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        if preds.ndim == 2:  # [N, 2] class probabilities -> P(class 1)
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = _np(labels).reshape(-1)
+        buckets = np.clip((preds * self.num_thresholds).astype("int64"), 0,
+                          self.num_thresholds)
+        pos = buckets[labels > 0.5]
+        neg = buckets[labels <= 0.5]
+        np.add.at(self._stat_pos, pos, 1)
+        np.add.at(self._stat_neg, neg, 1)
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, "int64")
+        self._stat_neg = np.zeros(self.num_thresholds + 1, "int64")
+
+    def accumulate(self):
+        # integrate TPR over FPR from the highest threshold down
+        tot_pos = tot_neg = 0.0
+        area = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        return float(area / (tot_pos * tot_neg))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (metrics.py:859)."""
+    from ..framework.tensor import Tensor
+    import jax.numpy as jnp
+    pred = _np(input)
+    lab = _np(label)
+    idx = np.argsort(-pred, axis=-1)[..., :k]
+    if lab.ndim == pred.ndim:
+        lab = lab.argmax(axis=-1) if lab.shape[-1] == pred.shape[-1] \
+            else lab.reshape(lab.shape[:-1])
+    acc = (idx == lab.reshape(lab.shape[0], -1)[:, :1]).any(-1).mean()
+    return Tensor(jnp.asarray(np.float32(acc)))

@@ -1,0 +1,208 @@
+"""paddle.vision.datasets (reference python/paddle/vision/datasets/).
+
+Offline build: the reference auto-downloads from bcebos; here every dataset
+consumes LOCAL files only and raises a clear error when they're absent.
+DatasetFolder/ImageFolder work on any local directory tree.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ...io.dataloader import Dataset
+
+__all__ = ["DatasetFolder", "ImageFolder", "MNIST", "FashionMNIST",
+           "Cifar10", "Cifar100"]
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp", ".npy")
+
+
+def _pil_loader(path):
+    from PIL import Image
+    with open(path, "rb") as f:
+        return Image.open(f).convert("RGB")
+
+
+def default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    return _pil_loader(path)
+
+
+class DatasetFolder(Dataset):
+    """Class-per-subdirectory dataset (datasets/folder.py:37 parity)."""
+
+    def __init__(self, root, loader: Optional[Callable] = None,
+                 extensions=IMG_EXTENSIONS, transform=None,
+                 is_valid_file: Optional[Callable] = None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders found in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return p.lower().endswith(tuple(extensions))
+        samples: List[Tuple[str, int]] = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for base, _, files in sorted(os.walk(d)):
+                for fname in sorted(files):
+                    p = os.path.join(base, fname)
+                    if is_valid_file(p):
+                        samples.append((p, self.class_to_idx[c]))
+        if not samples:
+            raise RuntimeError(f"found 0 files in subfolders of {root}")
+        self.samples = samples
+        self.targets = [t for _, t in samples]
+
+    def __getitem__(self, index):
+        path, target = self.samples[index]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat image list, no labels (datasets/folder.py:252 parity)."""
+
+    def __init__(self, root, loader: Optional[Callable] = None,
+                 extensions=IMG_EXTENSIONS, transform=None,
+                 is_valid_file: Optional[Callable] = None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return p.lower().endswith(tuple(extensions))
+        samples = []
+        for base, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                p = os.path.join(base, fname)
+                if is_valid_file(p):
+                    samples.append(p)
+        if not samples:
+            raise RuntimeError(f"found 0 files in {root}")
+        self.samples = samples
+
+    def __getitem__(self, index):
+        sample = self.loader(self.samples[index])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return (sample,)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+def _require(path, what):
+    if path is None or not os.path.exists(path):
+        raise ValueError(
+            f"{what}: file not found ({path!r}). This offline build cannot "
+            "download datasets; pass the local path explicitly.")
+    return path
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST reader (datasets/mnist.py:30 parity, local files
+    only: pass image_path/label_path to the raw idx*-ubyte(.gz) files)."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        image_path = _require(image_path, f"{self.NAME} images")
+        label_path = _require(label_path, f"{self.NAME} labels")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else \
+            open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n).astype("int64")
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR python-pickle tarball reader (datasets/cifar.py:30 parity,
+    local data_file only)."""
+
+    _train_members = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_members = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        self.transform = transform
+        data_file = _require(data_file, type(self).__name__)
+        members = (self._train_members if mode == "train"
+                   else self._test_members)
+        xs, ys = [], []
+        with tarfile.open(data_file) as tar:
+            for m in tar.getmembers():
+                base = os.path.basename(m.name)
+                if base in members:
+                    d = pickle.load(tar.extractfile(m), encoding="bytes")
+                    xs.append(np.asarray(d[b"data"], np.uint8))
+                    ys.extend(d[self._label_key])
+        if not xs:
+            raise ValueError(f"no {mode} batches found in {data_file}")
+        self.data = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(ys, "int64")
+
+    def __getitem__(self, idx):
+        img = self.data[idx].transpose(1, 2, 0)  # HWC for transforms
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    _train_members = ["train"]
+    _test_members = ["test"]
+    _label_key = b"fine_labels"

@@ -1,0 +1,25 @@
+"""paddle.vision.models (reference python/paddle/vision/models/__init__.py)."""
+
+from .resnet import (ResNet, BasicBlock, BottleneckBlock, resnet18,
+                     resnet34, resnet50, resnet101, resnet152,
+                     resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
+                     resnext101_64x4d, resnext152_32x4d, resnext152_64x4d,
+                     wide_resnet50_2, wide_resnet101_2)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .small import (LeNet, AlexNet, SqueezeNet, alexnet, squeezenet1_0,
+                    squeezenet1_1)
+from .mobilenet import (MobileNetV1, MobileNetV2, MobileNetV3Small,
+                        MobileNetV3Large, mobilenet_v1, mobilenet_v2,
+                        mobilenet_v3_small, mobilenet_v3_large)
+
+__all__ = [
+    "ResNet", "BasicBlock", "BottleneckBlock", "resnet18", "resnet34",
+    "resnet50", "resnet101", "resnet152", "resnext50_32x4d",
+    "resnext50_64x4d", "resnext101_32x4d", "resnext101_64x4d",
+    "resnext152_32x4d", "resnext152_64x4d", "wide_resnet50_2",
+    "wide_resnet101_2", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+    "LeNet", "AlexNet", "SqueezeNet", "alexnet", "squeezenet1_0",
+    "squeezenet1_1", "MobileNetV1", "MobileNetV2", "MobileNetV3Small",
+    "MobileNetV3Large", "mobilenet_v1", "mobilenet_v2",
+    "mobilenet_v3_small", "mobilenet_v3_large",
+]

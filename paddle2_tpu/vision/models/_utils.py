@@ -1,0 +1,10 @@
+"""Shared helpers for the vision model zoo."""
+
+
+def no_pretrained(pretrained):
+    """This offline build cannot download weights (the reference pulls from
+    bcebos); load them explicitly via paddle.load + set_state_dict."""
+    if pretrained:
+        raise ValueError(
+            "pretrained=True is unavailable offline; use paddle.load + "
+            "set_state_dict with a local weights file")

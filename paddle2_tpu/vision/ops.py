@@ -1,0 +1,124 @@
+"""paddle.vision.ops (reference python/paddle/vision/ops.py; the detection
+primitives re-expressed in jnp — nms runs as an XLA while-loop-free
+mask-matrix algorithm instead of the reference's CUDA kernel)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import ensure_tensor
+
+__all__ = ["nms", "box_area", "box_iou", "roi_align", "RoIAlign"]
+
+
+def box_area(boxes):
+    b = ensure_tensor(boxes)._data
+    return Tensor((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))
+
+
+def _iou_matrix(a, b):
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-9)
+
+
+def box_iou(boxes1, boxes2):
+    a = ensure_tensor(boxes1)._data
+    b = ensure_tensor(boxes2)._data
+    return Tensor(_iou_matrix(a, b))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """ops.py nms parity. Returns kept indices sorted by descending score.
+
+    Greedy NMS as a numpy loop on host (data-dependent output size cannot
+    trace; the reference's GPU kernel is also a sequential bitmask scan).
+    """
+    import numpy as np
+    b = np.asarray(ensure_tensor(boxes)._data)
+    n = b.shape[0]
+    s = (np.asarray(ensure_tensor(scores)._data) if scores is not None
+         else np.arange(n, 0, -1, dtype="float32"))
+    cats = (np.asarray(ensure_tensor(category_idxs)._data)
+            if category_idxs is not None else np.zeros(n, "int64"))
+    iou = np.asarray(_iou_matrix(jnp.asarray(b), jnp.asarray(b)))
+    order = np.argsort(-s)
+    keep, suppressed = [], np.zeros(n, bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        overlap = (iou[i] > iou_threshold) & (cats == cats[i])
+        suppressed |= overlap
+        suppressed[i] = True
+    keep = np.asarray(keep, "int64")
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """ops.py roi_align parity (average-pool variant via bilinear grid
+    sampling with jnp gathers)."""
+    import numpy as np
+    xd = ensure_tensor(x)._data
+    bx = ensure_tensor(boxes)._data
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    n_num = [int(v) for v in ensure_tensor(boxes_num).numpy()]
+    batch_idx = np.repeat(np.arange(len(n_num)), n_num)
+
+    offset = 0.5 if aligned else 0.0
+    C = xd.shape[1]
+    H, W = xd.shape[2], xd.shape[3]
+    outs = []
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+    for r in range(bx.shape[0]):
+        b = batch_idx[r]
+        x1, y1, x2, y2 = [bx[r, i] * spatial_scale - offset for i in range(4)]
+        rh = jnp.maximum(y2 - y1, 1e-3) / ph
+        rw = jnp.maximum(x2 - x1, 1e-3) / pw
+        iy = (jnp.arange(ph)[:, None] + (jnp.arange(ratio) + 0.5)[None, :]
+              / ratio).reshape(-1)
+        ix = (jnp.arange(pw)[:, None] + (jnp.arange(ratio) + 0.5)[None, :]
+              / ratio).reshape(-1)
+        ys = y1 + iy * rh                      # (ph*ratio,)
+        xs = x1 + ix * rw                      # (pw*ratio,)
+        y0 = jnp.clip(jnp.floor(ys), 0, H - 1).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(xs), 0, W - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, H - 1)
+        x1i = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(ys, 0, H - 1) - y0
+        wx = jnp.clip(xs, 0, W - 1) - x0
+        img = xd[b]                            # (C, H, W)
+        v00 = img[:, y0][:, :, x0]
+        v01 = img[:, y0][:, :, x1i]
+        v10 = img[:, y1i][:, :, x0]
+        v11 = img[:, y1i][:, :, x1i]
+        val = (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+               + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+               + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+               + v11 * wy[None, :, None] * wx[None, None, :])
+        val = val.reshape(C, ph, ratio, pw, ratio).mean(axis=(2, 4))
+        outs.append(val)
+    return Tensor(jnp.stack(outs)) if outs else Tensor(
+        jnp.zeros((0, C, ph, pw), xd.dtype))
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)

@@ -1,0 +1,170 @@
+"""Functional image transforms (reference
+python/paddle/vision/transforms/functional.py; independent numpy/PIL
+implementation — TPU note: transforms are host-side data prep, so they stay
+in numpy/PIL and never trace)."""
+
+from __future__ import annotations
+
+import numbers
+from typing import Sequence
+
+import numpy as np
+
+try:
+    from PIL import Image
+    _HAS_PIL = True
+except ImportError:  # pragma: no cover
+    _HAS_PIL = False
+
+
+def _is_pil(img):
+    return _HAS_PIL and isinstance(img, Image.Image)
+
+
+def _to_numpy(img) -> np.ndarray:
+    """HWC uint8/float numpy view of a PIL image / ndarray / Tensor."""
+    if _is_pil(img):
+        return np.asarray(img)
+    from ...framework.tensor import Tensor
+    if isinstance(img, Tensor):
+        return np.asarray(img.numpy())
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    """functional.py to_tensor: HWC [0,255] -> CHW float32 [0,1] Tensor."""
+    from ... import to_tensor as paddle_to_tensor
+    arr = _to_numpy(pic)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype("float32") / 255.0
+    else:
+        arr = arr.astype("float32")
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return paddle_to_tensor(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = _to_numpy(img).astype("float32")
+    mean = np.asarray(mean, dtype="float32")
+    std = np.asarray(std, dtype="float32")
+    if data_format == "CHW":
+        shape = (-1, 1, 1)
+    else:
+        shape = (1, 1, -1)
+    return (arr - mean.reshape(shape)) / std.reshape(shape)
+
+
+def resize(img, size, interpolation="bilinear"):
+    """size: int (short side) or (h, w)."""
+    arr = _to_numpy(img)
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            nh, nw = size, int(size * w / h)
+        else:
+            nh, nw = int(size * h / w), size
+    else:
+        nh, nw = size
+    if _is_pil(img):
+        modes = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+                 "bicubic": Image.BICUBIC}
+        return img.resize((nw, nh), modes.get(interpolation, Image.BILINEAR))
+    import jax
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "bicubic": "cubic"}.get(interpolation, "linear")
+    out_shape = (nh, nw) + arr.shape[2:]
+    out = jax.image.resize(arr.astype("float32"), out_shape, method=method)
+    out = np.asarray(out)
+    return out.astype(arr.dtype) if arr.dtype == np.uint8 else out
+
+
+def crop(img, top, left, height, width):
+    if _is_pil(img):
+        return img.crop((left, top, left + width, top + height))
+    return _to_numpy(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr_h, arr_w = _to_numpy(img).shape[:2]
+    th, tw = output_size
+    top = int(round((arr_h - th) / 2.0))
+    left = int(round((arr_w - tw) / 2.0))
+    return crop(img, top, left, th, tw)
+
+
+def hflip(img):
+    if _is_pil(img):
+        return img.transpose(Image.FLIP_LEFT_RIGHT)
+    return _to_numpy(img)[:, ::-1]
+
+
+def vflip(img):
+    if _is_pil(img):
+        return img.transpose(Image.FLIP_TOP_BOTTOM)
+    return _to_numpy(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _to_numpy(img)
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4  # left, top, right, bottom
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    pads = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    out = np.pad(arr, pads, mode=mode, **kw)
+    if _is_pil(img):
+        return Image.fromarray(out)
+    return out
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    if _is_pil(img):
+        modes = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR}
+        return img.rotate(angle, modes.get(interpolation, Image.NEAREST),
+                          expand=expand, center=center, fillcolor=fill)
+    arr = _to_numpy(img)
+    k = int(round(angle / 90.0)) % 4
+    if not np.isclose(angle % 90, 0):
+        raise NotImplementedError(
+            "ndarray rotate supports multiples of 90 deg; pass a PIL image "
+            "for arbitrary angles")
+    return np.rot90(arr, k).copy()
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _to_numpy(img).astype("float32")
+    if arr.ndim == 2:
+        gray = arr
+    else:
+        gray = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+    gray = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    if _is_pil(img):
+        return Image.fromarray(gray.astype("uint8").squeeze())
+    return gray.astype(_to_numpy(img).dtype)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _to_numpy(img).astype("float32") * brightness_factor
+    out = np.clip(arr, 0, 255)
+    if _is_pil(img):
+        return Image.fromarray(out.astype("uint8"))
+    return out.astype(_to_numpy(img).dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _to_numpy(img).astype("float32")
+    mean = arr.mean()
+    out = np.clip((arr - mean) * contrast_factor + mean, 0, 255)
+    if _is_pil(img):
+        return Image.fromarray(out.astype("uint8"))
+    return out.astype(_to_numpy(img).dtype)

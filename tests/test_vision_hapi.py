@@ -1,0 +1,163 @@
+"""vision models + transforms + datasets + hapi Model.fit
+(reference test/legacy_test/test_vision_models.py + hapi tests parity)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.nn as nn
+import paddle2_tpu.optimizer as opt
+from paddle2_tpu.io.dataloader import Dataset
+from paddle2_tpu.metric import Accuracy, Precision, Recall, Auc
+from paddle2_tpu.vision import models, transforms
+from paddle2_tpu.vision import ops as vops
+
+
+def test_resnet18_forward_backward():
+    m = models.resnet18(num_classes=10)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 3, 32, 32).astype("float32"))
+    y = m(x)
+    assert tuple(y.shape) == (2, 10)
+    y.sum().backward()
+    assert m.conv1.weight.grad is not None
+
+
+def test_model_zoo_constructs():
+    # constructors only (forward on big nets is slow on the CPU test rig)
+    for fn in (models.resnet50, models.vgg16, models.alexnet,
+               models.mobilenet_v2, models.squeezenet1_0,
+               models.mobilenet_v3_small, models.resnext50_32x4d,
+               models.wide_resnet50_2):
+        m = fn(num_classes=4)
+        assert len(m.parameters()) > 0
+    with pytest.raises(ValueError):
+        models.resnet18(pretrained=True)
+
+
+def test_lenet_fit_evaluate_predict(tmp_path):
+    """End-to-end hapi loop: BASELINE config-1 shape (LeNet on MNIST-like
+    data), model.py:1472 fit contract."""
+
+    class FakeMNIST(Dataset):
+        def __init__(self, n=32):
+            rs = np.random.RandomState(0)
+            self.x = rs.rand(n, 1, 28, 28).astype("float32")
+            self.y = (rs.rand(n) * 10).astype("int64")
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+        def __len__(self):
+            return len(self.x)
+
+    model = paddle.Model(models.LeNet(num_classes=10))
+    model.prepare(
+        optimizer=opt.Adam(learning_rate=1e-3,
+                           parameters=model.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    model.fit(FakeMNIST(), epochs=1, batch_size=8, verbose=0)
+    logs = model.evaluate(FakeMNIST(), batch_size=8, verbose=0)
+    assert "loss" in logs and "acc" in logs
+    preds = model.predict(FakeMNIST(8), batch_size=4, stack_outputs=True)
+    assert preds[0].shape == (8, 10)
+    # save / load round-trip
+    path = str(tmp_path / "ckpt" / "model")
+    model.save(path)
+    w0 = model.network.features[0].weight.numpy().copy()
+    model.network.features[0].weight.set_value(w0 * 0)
+    model.load(path)
+    np.testing.assert_array_equal(
+        model.network.features[0].weight.numpy(), w0)
+    assert model.summary()["total_params"] > 0
+
+
+def test_transforms_pipeline():
+    rs = np.random.RandomState(0)
+    img = (rs.rand(40, 48, 3) * 255).astype("uint8")
+    tf = transforms.Compose([
+        transforms.Resize(36),
+        transforms.RandomCrop(32),
+        transforms.RandomHorizontalFlip(0.5),
+        transforms.ToTensor(),
+    ])
+    out = tf(img)
+    assert tuple(out.shape) == (3, 32, 32)
+    assert float(out.numpy().max()) <= 1.0
+
+    norm = transforms.Normalize(mean=[0.5] * 3, std=[0.5] * 3)
+    arr = norm(np.transpose((img[:32, :32] / 255.0).astype("float32"),
+                            (2, 0, 1)))
+    assert arr.min() >= -1.0 - 1e-6 and arr.max() <= 1.0 + 1e-6
+
+    g = transforms.Grayscale(3)(img)
+    assert g.shape == (40, 48, 3)
+    c = transforms.CenterCrop(24)(img)
+    assert c.shape[:2] == (24, 24)
+
+
+def test_dataset_folder(tmp_path):
+    from paddle2_tpu.vision.datasets import DatasetFolder, ImageFolder
+    for cls in ("cat", "dog"):
+        d = tmp_path / "data" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            np.save(str(d / f"{i}.npy"),
+                    np.zeros((4, 4, 3), "uint8"))
+    ds = DatasetFolder(str(tmp_path / "data"))
+    assert len(ds) == 6 and ds.classes == ["cat", "dog"]
+    sample, label = ds[0]
+    assert sample.shape == (4, 4, 3) and label == 0
+    flat = ImageFolder(str(tmp_path / "data"))
+    assert len(flat) == 6
+
+
+def test_metrics():
+    acc = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]], "float32")
+    label = np.array([1, 2], "int64")
+    acc.update(acc.compute(pred, label))
+    top1, top2 = acc.accumulate()
+    assert abs(top1 - 0.5) < 1e-6 and abs(top2 - 0.5) < 1e-6
+
+    p = Precision()
+    p.update(np.array([1, 1, 0, 1]), np.array([1, 0, 1, 1]))
+    assert abs(p.accumulate() - 2 / 3) < 1e-6
+    r = Recall()
+    r.update(np.array([1, 1, 0, 1]), np.array([1, 0, 1, 1]))
+    assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+    auc = Auc()
+    rs = np.random.RandomState(0)
+    scores = rs.rand(200)
+    labels = (scores + rs.rand(200) * 0.5 > 0.75).astype("int64")
+    auc.update(scores, labels)
+    assert 0.8 < auc.accumulate() <= 1.0
+
+
+def test_vision_ops_nms_iou():
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]], "float32"))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], "float32"))
+    keep = vops.nms(boxes, iou_threshold=0.5, scores=scores)
+    assert keep.numpy().tolist() == [0, 2]
+    iou = vops.box_iou(boxes, boxes).numpy()
+    assert abs(iou[0, 0] - 1.0) < 1e-6 and iou[0, 2] == 0.0
+
+
+def test_early_stopping():
+    from paddle2_tpu.hapi.callbacks import EarlyStopping
+
+    class _M:
+        stop_training = False
+
+    es = EarlyStopping(monitor="loss", patience=2, mode="min")
+    es.set_model(_M())
+    es.on_epoch_end(0, {"loss": 1.0})
+    es.on_epoch_end(1, {"loss": 1.2})
+    assert not es.model.stop_training  # one bad epoch < patience
+    es.on_epoch_end(2, {"loss": 1.3})
+    assert es.model.stop_training
