@@ -45,7 +45,10 @@ def main():
 
     cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
                     num_heads=heads, max_position_embeddings=seq,
-                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    # scan-over-remat: depth-independent compile and O(1)
+                    # per-layer activation memory (residuals recomputed)
+                    use_recompute=True)
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")

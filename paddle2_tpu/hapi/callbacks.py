@@ -139,6 +139,8 @@ class EarlyStopping(Callback):
                  min_delta=0, baseline=None, save_best_model=True):
         super().__init__()
         self.monitor = monitor
+        self.verbose = verbose
+        self.save_best_model = save_best_model
         self.patience = patience
         self.min_delta = abs(min_delta)
         self.baseline = baseline
@@ -161,7 +163,14 @@ class EarlyStopping(Callback):
         if self.better(cur, self.best):
             self.best = cur
             self.wait = 0
+            save_dir = self.params.get("save_dir")
+            if self.save_best_model and save_dir:
+                self.model.save(os.path.join(save_dir, "best_model"))
         else:
             self.wait += 1
             if self.wait >= self.patience:
                 self.model.stop_training = True
+                if self.verbose:
+                    print(f"Epoch {epoch + 1}: early stopping "
+                          f"(best {self.monitor}={self.best:.5f})",
+                          file=sys.stderr)

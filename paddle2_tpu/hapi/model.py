@@ -107,13 +107,20 @@ class Model:
         losses = self._loss(*(outs_l + lbs))
         return _to_list(losses)
 
+    @staticmethod
+    def _metric_items(m):
+        names, vals = m.name(), m.accumulate()
+        if isinstance(names, (list, tuple)):
+            return list(zip(names, vals))
+        return [(names, vals)]
+
     def _update_metrics(self, outs, lbs):
         outs_l = _to_list(outs)
         res = {}
         for m in self._metrics:
             computed = m.compute(*(outs_l + lbs))
             m.update(*_to_list(computed))
-            res[str(m.name())] = m.accumulate()
+            res.update(self._metric_items(m))
         return res
 
     # -------------------------------------------------------------- loops
@@ -127,9 +134,12 @@ class Model:
         return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                           num_workers=num_workers, drop_last=drop_last)
 
-    @staticmethod
-    def _split_batch(batch):
+    def _split_batch(self, batch):
         batch = _to_list(batch)
+        if self._inputs is not None or self._labels is not None:
+            n_in = len(_to_list(self._inputs)) if self._inputs is not None \
+                else max(len(batch) - len(_to_list(self._labels)), 1)
+            return batch[:n_in], batch[n_in:]
         if len(batch) == 1:
             return batch, []
         return batch[:-1], batch[-1:]
@@ -146,14 +156,22 @@ class Model:
         cbks = _to_list(callbacks)
         if verbose and not any(isinstance(c, ProgBarLogger) for c in cbks):
             cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+        from .callbacks import LRScheduler as _LRS
+        if not any(isinstance(c, _LRS) for c in cbks) and \
+                hasattr(getattr(self._optimizer, "_learning_rate", None),
+                        "step"):
+            cbks.append(_LRS(by_step=True))  # reference config_callbacks
         if save_dir:
             cbks.append(ModelCheckpoint(save_freq, save_dir))
         cbk = CallbackList(cbks)
         cbk.set_model(self)
+        metric_names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            metric_names += list(n) if isinstance(n, (list, tuple)) else [n]
         cbk.set_params({"epochs": epochs, "steps": len(loader),
-                        "verbose": verbose,
-                        "metrics": ["loss"] + [str(m.name())
-                                               for m in self._metrics]})
+                        "verbose": verbose, "save_dir": save_dir,
+                        "metrics": metric_names})
         self.stop_training = False
         cbk.on_train_begin()
         it = 0
@@ -215,7 +233,7 @@ class Model:
         if losses:
             logs["loss"] = float(np.mean(losses))
         for m in self._metrics:
-            logs[str(m.name())] = m.accumulate()
+            logs.update(self._metric_items(m))
         cbk.on_eval_end(logs)
         if verbose:
             import sys

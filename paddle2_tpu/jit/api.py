@@ -35,14 +35,64 @@ class InputSpec:
         return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
 
 
+def _discover_layers(fn) -> List[Any]:
+    """Layers a plain function closes over (its model state).
+
+    Parameters of every discovered Layer become ARGUMENTS of the compiled
+    program. Without this, a closed-over model's weights trace in as HLO
+    constants — megabytes-to-gigabytes of literals that explode compile
+    time and, worse, receive no gradients (the reference's
+    partial_program passes params explicitly for the same reason).
+    """
+    from ..nn import Layer
+    found: List[Any] = []
+    seen = set()
+
+    def add(obj, depth=0):
+        if isinstance(obj, Layer):
+            if id(obj) not in seen:
+                seen.add(id(obj))
+                found.append(obj)
+        elif depth < 2 and isinstance(obj, (list, tuple)):
+            for o in obj:
+                add(o, depth + 1)
+        elif depth < 2 and isinstance(obj, dict):
+            for o in obj.values():
+                add(o, depth + 1)
+
+    self_obj = getattr(fn, "__self__", None)
+    if self_obj is not None:
+        add(self_obj)
+    for cell in getattr(fn, "__closure__", None) or ():
+        try:
+            add(cell.cell_contents)
+        except ValueError:  # pragma: no cover (empty cell)
+            pass
+    # module-level functions reach their model through globals; only the
+    # names the code object references are considered
+    code = getattr(fn, "__code__", None)
+    globs = getattr(fn, "__globals__", None)
+    if code is not None and globs is not None:
+        for name in code.co_names:
+            if name in globs:
+                add(globs[name])
+    if isinstance(fn, functools.partial):
+        add(list(fn.args))
+        add(fn.keywords or {})
+        found.extend(l for l in _discover_layers(fn.func)
+                     if id(l) not in seen)
+    return found
+
+
 class StaticFunction:
     def __init__(self, function: Callable, layer=None, input_spec=None,
                  build_strategy=None, full_graph=True):
         self._function = function
         self._layer = layer
         self._input_spec = input_spec
-        layers = [layer] if layer is not None else []
+        layers = [layer] if layer is not None else _discover_layers(function)
         self._program = TracedProgram(function, layers)
+        self._rediscover = layer is None
         functools.update_wrapper(self, function,
                                  assigned=("__name__", "__doc__"), updated=())
 
@@ -58,6 +108,14 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled:
             return self._function(*args, **kwargs)
+        if self._rediscover:
+            # decoration can precede model construction (`@to_static` above
+            # `model = ...`): re-resolve globals/closure at call time so a
+            # late-bound model's params still become program arguments
+            layers = _discover_layers(self._function)
+            if [id(l) for l in layers] != [id(l)
+                                           for l in self._program.layers]:
+                self._program = TracedProgram(self._function, layers)
         return self._program(*args, **kwargs)
 
     @property

@@ -21,5 +21,16 @@ def get_image_backend():
 
 
 def image_load(path, backend=None):
+    """Load an image honoring the backend: 'pil' -> PIL.Image,
+    'cv2' -> HWC BGR uint8 ndarray, 'tensor' -> CHW float Tensor."""
+    import numpy as np
     from .datasets import default_loader
-    return default_loader(path)
+    img = default_loader(path)
+    b = backend or _image_backend
+    if b == "pil" or path.endswith(".npy"):
+        return img
+    arr = np.asarray(img)
+    if b == "cv2":
+        return arr[:, :, ::-1].copy() if arr.ndim == 3 else arr
+    from .transforms import functional as TF
+    return TF.to_tensor(arr)
