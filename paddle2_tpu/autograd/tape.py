@@ -243,7 +243,8 @@ def _execute_backward(tensors: Sequence[Any],
         if entry is None:
             leaf_sums[id(t)] = [t, g]
         else:
-            entry[1] = entry[1] + g
+            from ..framework.tensor import _match_devices
+            entry[1] = entry[1] + _match_devices(entry[1], g)
 
     for t, g in zip(tensors, grad_tensors):
         if t._grad_node is None:
@@ -321,6 +322,10 @@ def _execute_backward(tensors: Sequence[Any],
             if flag_value("check_nan_inf"):
                 _check_nan_inf(node.name, [g for g in in_grads if g is not None])
 
+        def _same_devices(cur, g):
+            from ..framework.tensor import _match_devices
+            return _match_devices(cur, g)
+
         for inp, g in zip(node.inputs, in_grads):
             if id(inp) in no_grad_ids:
                 continue
@@ -333,6 +338,8 @@ def _execute_backward(tensors: Sequence[Any],
                         node_cts[pid] = [None] * len(pnode.out_avals)
                         node_by_id[pid] = pnode
                     cur = node_cts[pid][inp._output_index]
+                    if cur is not None:
+                        g = _same_devices(cur, g)
                     node_cts[pid][inp._output_index] = (
                         g if cur is None else cur + g)
                 indeg[pid] -= 1

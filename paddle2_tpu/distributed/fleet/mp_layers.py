@@ -153,19 +153,32 @@ class ParallelCrossEntropy(Layer):
                                ignore_index=self.ignore_index)
 
 
+class _ShardAlias(Tensor):
+    """Placement-changed view: leaf gradient accumulation routes back to
+    the origin tensor (same contract as DataParallel's alias)."""
+
+    __slots__ = ("_origin",)
+
+    def _accumulate_grad(self, g):
+        self._origin._accumulate_grad(g)
+
+
 def _constrain_tensor(t, spec: P):
     """Differentiable sharding annotation on an eager Tensor.
 
     Eager: a real device_put (placement-only change; the result shares the
-    producer's grad edge, so backward is the implicit identity). Traced
-    (to_static): records with_sharding_constraint for GSPMD.
+    producer's grad edge — or, for a leaf, aliases its grad accumulation —
+    so backward is the implicit identity). Traced (to_static): records
+    with_sharding_constraint for GSPMD.
     """
     if isinstance(t._data, jax.core.Tracer):
         from ...ops.dispatch import apply_op
         return apply_op("sharding_constraint",
                         lambda a: constrain(a, spec), (t,), {})
-    out = Tensor(jax.device_put(t._data, NamedSharding(get_mesh(), spec)),
-                 stop_gradient=t.stop_gradient)
+    data = jax.device_put(t._data, NamedSharding(get_mesh(), spec))
+    out = _ShardAlias.__new__(_ShardAlias)
+    Tensor.__init__(out, data, stop_gradient=t.stop_gradient)
     out._grad_node = t._grad_node
     out._output_index = t._output_index
+    out._origin = t
     return out

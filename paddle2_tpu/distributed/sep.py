@@ -1,0 +1,190 @@
+"""Sequence / context parallelism: ring attention + Ulysses all-to-all.
+
+Parity targets: the reference's sep parallelism (fleet/base/topology.py sep
+axis, meta_parallel segment utilities) and its ring-p2p long-context path
+(NCCL send/recv of KV blocks). TPU-native redesign:
+
+- ``ring_attention``: shard_map over the 'sep' mesh axis. Each device owns
+  a sequence chunk of Q/K/V; KV blocks rotate around the ICI ring via
+  lax.ppermute while each step's partial attention is merged online with
+  the numerically-stable log-sum-exp rule (the flash-attention merge).
+  Peak memory is O(S/n) per chip and the N-1 rotations overlap compute.
+- ``ulysses_attention``: the all-to-all formulation (DeepSpeed-Ulysses):
+  resharding seq-sharded QKV to head-sharded via sharding constraints, so
+  GSPMD emits the all-to-alls; full-sequence attention runs per head
+  group, then the output reshards back to sequence-sharded.
+
+Both consume paddle-layout (batch, seq, heads, dim) Tensors.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply_op, ensure_tensor
+from . import mesh as mesh_mod
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+_NEG = float("-inf")
+
+
+def _block_attn_lse(q, k, v, scale, mask):
+    """Full (small-block) attention returning (out, lse).
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; mask: None | 'causal' | 'skip'.
+    'skip' returns a zero block with lse=-inf (fully masked)."""
+    B, Sq, H, D = q.shape
+    if mask == "skip":
+        return (jnp.zeros_like(q),
+                jnp.full((B, H, Sq), _NEG, jnp.float32))
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * scale
+    if mask == "causal":
+        Sk = s.shape[-1]
+        causal = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        s = jnp.where(causal, s, _NEG)
+    m = jnp.max(s, axis=-1)                                  # [B,H,Sq]
+    m_safe = jnp.where(m == _NEG, 0.0, m)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(s == _NEG, 0.0, p)
+    l = jnp.sum(p, axis=-1)                                  # [B,H,Sq]
+    o = jnp.einsum("bhst,bhtd->bhsd", p, vh)
+    o = o / jnp.maximum(l, 1e-30)[..., None]
+    lse = jnp.where(l == 0.0, _NEG, m_safe + jnp.log(jnp.maximum(l, 1e-30)))
+    return jnp.swapaxes(o, 1, 2).astype(q.dtype), lse
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Log-sum-exp merge of two partial attention results."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(m == _NEG, 0.0, m)
+    w1 = jnp.where(lse1 == _NEG, 0.0, jnp.exp(lse1 - m_safe))
+    w2 = jnp.where(lse2 == _NEG, 0.0, jnp.exp(lse2 - m_safe))
+    tot = jnp.maximum(w1 + w2, 1e-30)
+    o = (o1.astype(jnp.float32) * jnp.swapaxes(w1, 1, 2)[..., None]
+         + o2.astype(jnp.float32) * jnp.swapaxes(w2, 1, 2)[..., None]) \
+        / jnp.swapaxes(tot, 1, 2)[..., None]
+    lse = jnp.where((w1 + w2) == 0.0, _NEG, m_safe + jnp.log(tot))
+    return o.astype(o1.dtype), lse
+
+
+def _ring_body(q, k, v, *, axis, n, scale, causal):
+    """Local computation inside shard_map: q/k/v are the device's sequence
+    chunk [B, S/n, H, D]."""
+    i = jax.lax.axis_index(axis)
+    o = jnp.zeros_like(q)
+    lse = jnp.full(
+        (q.shape[0], q.shape[2], q.shape[1]), _NEG, jnp.float32)
+    perm = [(r, (r + 1) % n) for r in range(n)]
+    cur_k, cur_v = k, v
+    for t in range(n):
+        j = (i - t) % n  # origin chunk of the kv currently held
+        if causal:
+            # bottom-right-aligned global causality across chunks:
+            # j < i -> full block; j == i -> intra-chunk causal; j > i skip
+            o_b_c, lse_b_c = _block_attn_lse(q, cur_k, cur_v, scale,
+                                             "causal")
+            o_b_f, lse_b_f = _block_attn_lse(q, cur_k, cur_v, scale, None)
+            is_diag = (j == i)
+            keep = (j <= i)
+            o_b = jnp.where(is_diag, o_b_c, o_b_f)
+            lse_b = jnp.where(is_diag, lse_b_c, lse_b_f)
+            lse_b = jnp.where(keep, lse_b, _NEG)
+            o_b = jnp.where(keep, o_b, 0.0).astype(q.dtype)
+        else:
+            o_b, lse_b = _block_attn_lse(q, cur_k, cur_v, scale, None)
+        o, lse = _merge(o, lse, o_b, lse_b)
+        if t < n - 1:
+            cur_k = jax.lax.ppermute(cur_k, axis, perm)
+            cur_v = jax.lax.ppermute(cur_v, axis, perm)
+    return o
+
+
+def _seq_axis(mesh_axis: Optional[str]) -> str:
+    mesh = mesh_mod.get_mesh()
+    if mesh_axis is not None:
+        return mesh_axis
+    for name in ("sep", "cp", "sp"):
+        if name in mesh.axis_names and mesh.shape[name] > 1:
+            return name
+    raise ValueError("no sequence-parallel mesh axis found; init a mesh "
+                     "with a 'sep' axis or pass mesh_axis=")
+
+
+def ring_attention(query, key, value, causal: bool = False,
+                   scale: Optional[float] = None,
+                   mesh_axis: Optional[str] = None):
+    """Exact attention over a sequence sharded on a mesh ring.
+
+    Inputs are GLOBAL [B, S, H, D] Tensors (sharded or replicated); the
+    sequence dim is (re)sharded over the ring axis, KV blocks rotate via
+    collective-permute, and the result equals full softmax attention to
+    numerical precision — memory per chip stays O(S/n * S/n) per step.
+    """
+    q, k, v = (ensure_tensor(t) for t in (query, key, value))
+    mesh = mesh_mod.get_mesh()
+    axis = _seq_axis(mesh_axis)
+    n = int(mesh.shape[axis])
+    if q.shape[1] % n != 0:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by ring "
+                         f"degree {n}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+
+    batch_axis = "dp" if "dp" in mesh.axis_names else None
+    spec = P(batch_axis, axis, None, None)
+    from .fleet.mp_layers import _constrain_tensor
+    q = _constrain_tensor(q, spec)  # commit chunks onto the ring
+    k = _constrain_tensor(k, spec)
+    v = _constrain_tensor(v, spec)
+    key = (id(mesh), axis, n, float(scale), bool(causal), batch_axis)
+    fn = _ring_cache.get(key)
+    if fn is None:
+        fn = shard_map(
+            partial(_ring_body, axis=axis, n=n, scale=float(scale),
+                    causal=bool(causal)),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        fn = jax.jit(fn)  # executable cache keyed on avals by jax itself
+        _ring_cache[key] = fn
+    return apply_op("ring_attention", fn, (q, k, v), {})
+
+
+_ring_cache: dict = {}
+
+
+def ulysses_attention(query, key, value, causal: bool = False,
+                      scale: Optional[float] = None,
+                      mesh_axis: Optional[str] = None):
+    """DeepSpeed-Ulysses sequence parallelism: all-to-all from seq-sharded
+    to head-sharded, full attention per head group, all-to-all back. The
+    resharding is expressed as GSPMD constraints; XLA emits all-to-alls."""
+    from ..kernels.attention import scaled_dot_product_attention as sdpa
+    q, k, v = (ensure_tensor(t) for t in (query, key, value))
+    mesh = mesh_mod.get_mesh()
+    axis = _seq_axis(mesh_axis)
+    if q.shape[2] % mesh.shape[axis] != 0:
+        raise ValueError(f"num_heads {q.shape[2]} not divisible by sep "
+                         f"degree {mesh.shape[axis]}")
+    batch_axis = "dp" if "dp" in mesh.axis_names else None
+    from .fleet.mp_layers import _constrain_tensor
+    head_spec = P(batch_axis, None, axis, None)
+    seq_spec = P(batch_axis, axis, None, None)
+    if scale is not None:
+        # sdpa hard-codes 1/sqrt(D) (paddle API); fold a custom scale in
+        q = q * (float(scale) * math.sqrt(q.shape[-1]))
+    q = _constrain_tensor(q, head_spec)   # a2a: seq-shard -> head-shard
+    k = _constrain_tensor(k, head_spec)
+    v = _constrain_tensor(v, head_spec)
+    out = sdpa(q, k, v, is_causal=causal)
+    return _constrain_tensor(out, seq_spec)  # a2a back to seq-shard

@@ -20,6 +20,18 @@ from . import core
 from ..autograd import tape
 
 
+def _match_devices(cur, g):
+    """Reshard g onto cur's placement when their committed device sets
+    differ (one cotangent path crossed a mesh collective, the other
+    stayed single-device) — XLA refuses mixed-device-set adds."""
+    sc = getattr(cur, "sharding", None)
+    sg = getattr(g, "sharding", None)
+    if (sc is not None and sg is not None and not _is_tracer(g)
+            and not _is_tracer(cur) and sc.device_set != sg.device_set):
+        return jax.device_put(g, sc)
+    return g
+
+
 def _is_tracer(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
@@ -133,7 +145,9 @@ class Tensor:
         if self.grad is None:
             self.grad = Tensor(g, stop_gradient=True)
         else:
-            self.grad = Tensor(self.grad._data + g, stop_gradient=True)
+            cur = self.grad._data
+            g = _match_devices(cur, g)
+            self.grad = Tensor(cur + g, stop_gradient=True)
 
     def _apply_grad_hooks(self, g):
         if self._hooks:

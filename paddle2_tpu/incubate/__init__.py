@@ -1,1 +1,9 @@
+"""paddle.incubate (reference python/paddle/incubate/)."""
 
+from . import moe  # noqa: F401
+from .moe import MoELayer, SwitchGate, TopKGate
+from . import distributed  # noqa: F401
+from . import nn  # noqa: F401
+
+__all__ = ["MoELayer", "SwitchGate", "TopKGate", "moe", "distributed",
+           "nn"]

@@ -1,0 +1,14 @@
+"""paddle.incubate.nn — fused-layer namespace (reference incubate/nn/).
+
+On TPU the "fused" variants are the plain layers: XLA fuses
+matmul+bias+activation+residual chains itself, so these aliases keep the
+reference API importable without bespoke kernels."""
+
+from ...nn import MultiHeadAttention as FusedMultiHeadAttention  # noqa
+from ...nn import Linear as FusedLinear  # noqa
+from ...nn.layer.transformer import (  # noqa
+    TransformerEncoderLayer as FusedTransformerEncoderLayer)
+from ..moe import MoELayer  # noqa
+
+__all__ = ["FusedMultiHeadAttention", "FusedLinear",
+           "FusedTransformerEncoderLayer", "MoELayer"]

@@ -6,6 +6,8 @@ from __future__ import annotations
 import functools
 import os
 import pickle
+
+import numpy as np
 from typing import Any, Callable, List, Optional
 
 from ..framework.tensor import Tensor
@@ -162,37 +164,192 @@ def ignore_module(modules: List[Any]):
 
 
 class TranslatedLayer:
-    """Loaded inference artifact (jit.load result)."""
+    """Loaded inference artifact (jit.load result; reference
+    TranslatedLayer from paddle.jit.api — a callable program + weights).
 
-    def __init__(self, state_dict, config, layer_factory=None):
+    Holds a deserialized StableHLO executable (jax.export) plus the
+    weights it consumes; ``__call__`` runs the compiled program. The
+    artifact is the TPU-native .pdmodel: a portable, architecture-free
+    serialized program (exported for both cpu and tpu)."""
+
+    def __init__(self, state_dict, config, exported=None, treedef=None):
         self._state_dict = state_dict
         self._config = config
+        self._exported = exported
+        self._treedef = treedef
+        self._weights_dev = None  # device copies, materialized on 1st call
 
     def state_dict(self):
         return self._state_dict
 
+    def eval(self):
+        return self
+
     def __call__(self, *args):
-        raise RuntimeError(
-            "TranslatedLayer from jit.load holds weights + config only; "
-            "rebuild the architecture and use set_state_dict (StableHLO "
-            "export lands with the inference milestone)")
+        if self._exported is None:
+            raise RuntimeError(
+                "this artifact was saved without a program (weights only); "
+                "rebuild the architecture and use set_state_dict")
+        import jax
+        from ..framework.tensor import Tensor
+        arg_arrays = [a._data if isinstance(a, Tensor) else jnp_asarray(a)
+                      for a in args]
+        if self._weights_dev is None:
+            self._weights_dev = [jnp_asarray(v)
+                                 for v in self._state_dict.values()]
+        outs = self._exported.call(self._weights_dev, arg_arrays)
+        out_tensors = [Tensor(o, stop_gradient=True) for o in outs]
+        import jax.tree_util as tu
+        if self._treedef is not None:
+            return tu.tree_unflatten(self._treedef, out_tensors)
+        return out_tensors[0] if len(out_tensors) == 1 else out_tensors
+
+
+def jnp_asarray(x):
+    import jax.numpy as jnp
+    return jnp.asarray(x)
+
+
+def _export_program(fn_call, input_spec, layers=None):
+    """StableHLO-export fn_call(*input_spec) with the layers' weights as
+    runtime arguments (portable across cpu/tpu)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+    from ..framework import core
+    from ..framework import random as fr
+    from ..framework.tensor import Tensor
+    from .functional import _collect_state
+
+    layers = layers if layers is not None else [fn_call]
+    params, buffers = _collect_state(layers)
+    state = params + buffers
+    # names mirror _collect_state's order + id-dedup exactly
+    p_names, b_names = [], []
+    seen = set()
+    for l in layers:
+        for n, p2 in l.named_parameters():
+            if id(p2) not in seen:
+                seen.add(id(p2))
+                p_names.append(n)
+        for n, b2 in l.named_buffers():
+            if b2 is not None and id(b2) not in seen:
+                seen.add(id(b2))
+                b_names.append(n)
+    names = p_names + b_names
+    trainings = [getattr(l, "training", False) for l in layers]
+    for l in layers:
+        l.eval()
+    was_training = any(trainings)
+    meta = {}
+
+    def pure_infer(weight_arrays, arg_arrays):
+        originals = [t._data for t in state]
+        for t, a in zip(state, weight_arrays):
+            t._data = a
+        try:
+            with core.no_grad(), fr.scoped_rng(jax.random.PRNGKey(0)):
+                out = fn_call(*[Tensor(a) for a in arg_arrays])
+        finally:
+            for t, a in zip(state, originals):
+                t._data = a
+        flat, treedef = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        meta["treedef"] = treedef
+        return tuple(o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                     for o in flat)
+
+    weight_avals = [jax.ShapeDtypeStruct(tuple(t.shape),
+                                         t._data.dtype) for t in state]
+    arg_avals = []
+    n_dyn = 0
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            parts = []
+            for d in s.shape:
+                if d is None or (isinstance(d, int) and d < 0):
+                    parts.append(f"_dyn{n_dyn}")  # symbolic batch etc.
+                    n_dyn += 1
+                else:
+                    parts.append(str(int(d)))
+            if any(p.startswith("_dyn") for p in parts):
+                shape = jexport.symbolic_shape(", ".join(parts))
+            else:
+                shape = tuple(int(p) for p in parts)
+            arg_avals.append(jax.ShapeDtypeStruct(tuple(shape),
+                                                  jnp.dtype(s.dtype)))
+        elif isinstance(s, Tensor):
+            arg_avals.append(jax.ShapeDtypeStruct(tuple(s.shape),
+                                                  s._data.dtype))
+        else:
+            a = jnp.asarray(s)
+            arg_avals.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+    try:
+        exp = jexport.export(jax.jit(pure_infer),
+                             platforms=("cpu", "tpu"))(
+            weight_avals, arg_avals)
+    except Exception:
+        # some kernels only lower for the current backend
+        exp = jexport.export(jax.jit(pure_infer))(weight_avals, arg_avals)
+    finally:
+        for l, tr in zip(layers, trainings):
+            if tr:
+                l.train()
+    weights = {n: np.asarray(t._data) for n, t in zip(names, state)}
+    return exp.serialize(), weights, meta["treedef"]
 
 
 def save(layer, path, input_spec=None, **configs):
-    """jit.save: persist weights + spec. Weights as numpy pickle; a full
-    StableHLO export (jax.export) is the inference-engine milestone."""
+    """jit.save (api.py:744 contract): writes path.pdmodel (serialized
+    StableHLO program) + path.pdiparams (weights). Without input_spec only
+    the weights are written."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     from ..nn import Layer
-    payload = {"config": {"input_spec": [repr(s) for s in (input_spec or [])]}}
-    if isinstance(layer, Layer):
-        payload["state_dict"] = {k: v.numpy()
-                                 for k, v in layer.state_dict().items()}
-    with open(path + ".pdparams", "wb") as f:
-        pickle.dump(payload, f)
+    fn_call = None
+    layers = None
+    if isinstance(layer, StaticFunction):
+        if layer._layer is not None:
+            layer = layer._layer
+        else:  # plain function: export it over its discovered layers
+            fn_call = layer._function
+            layers = list(layer._program.layers)
+            layer = None
+    if layer is not None:
+        fn_call = layer
+        layers = [layer]
+    if fn_call is None or (not layers and input_spec):
+        raise TypeError("jit.save expects a Layer or a to_static function "
+                        "that references one")
+    config = {"input_spec": [repr(s) for s in (input_spec or [])]}
+    if input_spec:
+        blob, weights, treedef = _export_program(fn_call, input_spec,
+                                                 layers=layers)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(blob)
+        config["treedef"] = pickle.dumps(treedef)
+    else:
+        state = {}
+        for l in (layers or []):
+            state.update(l.state_dict())
+        weights = {k: v.numpy() for k, v in state.items()}
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({"state_dict": weights, "config": config}, f,
+                    protocol=4)
 
 
 def load(path, **configs) -> TranslatedLayer:
-    with open(path + ".pdparams", "rb") as f:
+    """jit.load: returns a CALLABLE TranslatedLayer executing the exported
+    program (api.py:1065 contract)."""
+    with open(path + ".pdiparams", "rb") as f:
         payload = pickle.load(f)
+    exported = treedef = None
+    model_path = path + ".pdmodel"
+    if os.path.exists(model_path):
+        from jax import export as jexport
+        with open(model_path, "rb") as f:
+            exported = jexport.deserialize(f.read())
+        td = payload.get("config", {}).get("treedef")
+        if td is not None:
+            treedef = pickle.loads(td)
     return TranslatedLayer(payload.get("state_dict", {}),
-                           payload.get("config", {}))
+                           payload.get("config", {}), exported, treedef)

@@ -41,6 +41,10 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     tensor_parallel: bool = False
     sequence_parallel: bool = False
+    # long-context attention over the 'sep' mesh ring: "none" | "ring"
+    # (KV rotation via collective-permute) | "ulysses" (all-to-all head
+    # resharding). See distributed/sep.py.
+    context_parallel: str = "none"
     use_recompute: bool = False
     # compile the block stack as ONE lax.scan body under to_static —
     # compile time (and HLO size) become depth-independent, the standard
@@ -122,9 +126,20 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv(x)  # [b, s, 3h] (mp-sharded when TP)
         qkv = qkv.reshape([b, s, 3, cfg.num_heads, cfg.head_dim])
         q, k, v = qkv.unbind(axis=2)
-        out = scaled_dot_product_attention(
-            q, k, v, is_causal=True,
-            dropout_p=cfg.attention_dropout_prob, training=self.training)
+        if cfg.context_parallel != "none":
+            if cfg.attention_dropout_prob > 0.0 and self.training:
+                raise ValueError(
+                    "attention_dropout_prob > 0 is not supported with "
+                    "context_parallel (the ring/ulysses paths have no "
+                    "dropout); set it to 0 or use hidden dropout")
+            from ..distributed.sep import ring_attention, ulysses_attention
+            attn = (ring_attention if cfg.context_parallel == "ring"
+                    else ulysses_attention)
+            out = attn(q, k, v, causal=True)
+        else:
+            out = scaled_dot_product_attention(
+                q, k, v, is_causal=True,
+                dropout_p=cfg.attention_dropout_prob, training=self.training)
         out = out.reshape([b, s, h])
         return self.out_proj(out)
 
@@ -185,12 +200,7 @@ class GPTModel(nn.Layer):
         if self._can_scan(x):
             x = self._scan_blocks(x)
         else:
-            for block in self.h:
-                if self.cfg.use_recompute and self.training:
-                    from ..distributed.recompute import recompute
-                    x = recompute(block, x)
-                else:
-                    x = block(x)
+            x = self._fallback_loop(x)
         return self.ln_f(x)
 
     def _can_scan(self, x) -> bool:

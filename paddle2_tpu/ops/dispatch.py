@@ -108,8 +108,26 @@ def apply_op(name: str, fn: Callable, tensors: Sequence[Tensor],
 
     def node_builder(outs):
         inputs = list(tensors)
+        out_arrays = out if isinstance(out, (tuple, list)) else (out,)
+        out_shardings = [getattr(o, "sharding", None) for o in out_arrays]
 
         def run_vjp(cts):
+            # a downstream op may have promoted activations onto the mesh
+            # AFTER this node recorded its residuals: reshard cotangents
+            # back to the forward output's placement so vjp_fn's captured
+            # residuals and the cotangent share one device set
+            def fix(c, s):
+                if (s is not None and c is not None
+                        and not isinstance(c, jax.core.Tracer)
+                        and getattr(c, "sharding", None) is not None
+                        and c.sharding.device_set != s.device_set):
+                    return jax.device_put(c, s)
+                return c
+
+            if isinstance(cts, (tuple, list)):
+                cts = tuple(fix(c, s) for c, s in zip(cts, out_shardings))
+            else:
+                cts = fix(cts, out_shardings[0])
             raw = vjp_fn(cts)
             # jax returns float0 for non-differentiable (integer) inputs;
             # normalize those to None so the tape skips them.

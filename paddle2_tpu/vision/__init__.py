@@ -25,8 +25,10 @@ def image_load(path, backend=None):
     'cv2' -> HWC BGR uint8 ndarray, 'tensor' -> CHW float Tensor."""
     import numpy as np
     from .datasets import default_loader
-    img = default_loader(path)
     b = backend or _image_backend
+    if b not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {b!r}")
+    img = default_loader(path)
     if b == "pil" or path.endswith(".npy"):
         return img
     arr = np.asarray(img)

@@ -1,0 +1,99 @@
+"""Ring attention + Ulysses sequence parallelism on the 8-dev CPU mesh
+(sep-axis long-context path; fleet sep parity)."""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.distributed as dist
+from paddle2_tpu.distributed.sep import ring_attention, ulysses_attention
+from paddle2_tpu.kernels.attention import _sdpa_xla
+
+import jax.numpy as jnp
+
+
+def _qkv(B=2, S=16, H=4, D=4):
+    rs = np.random.RandomState(0)
+    mk = lambda i: paddle.to_tensor(
+        np.random.RandomState(i).randn(B, S, H, D).astype("float32"))
+    return mk(0), mk(1), mk(2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    dist.init_mesh({"dp": 2, "sep": 4})
+    q, k, v = _qkv()
+    out = ring_attention(q, k, v, causal=causal)
+    ref = _sdpa_xla(q._data, k._data, v._data, causal=causal)
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    dist.init_mesh({"dp": 8})
+
+
+def test_ring_attention_grads():
+    dist.init_mesh({"dp": 2, "sep": 4})
+    q, k, v = _qkv(S=8)
+    for t in (q, k, v):
+        t.stop_gradient = False
+    out = ring_attention(q, k, v, causal=True)
+    out.sum().backward()
+    import jax
+    # reference grads through full attention
+    def loss(qa, ka, va):
+        return jnp.sum(_sdpa_xla(qa, ka, va, causal=True))
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(
+        q._data, k._data, v._data)
+    np.testing.assert_allclose(q.grad.numpy(), np.asarray(gq), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(k.grad.numpy(), np.asarray(gk), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(v.grad.numpy(), np.asarray(gv), rtol=1e-4,
+                               atol=1e-5)
+    dist.init_mesh({"dp": 8})
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(causal):
+    dist.init_mesh({"dp": 2, "sep": 4})
+    q, k, v = _qkv()
+    out = ulysses_attention(q, k, v, causal=causal)
+    ref = _sdpa_xla(q._data, k._data, v._data, causal=causal)
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    dist.init_mesh({"dp": 8})
+
+
+def test_gpt_with_ring_attention():
+    from paddle2_tpu.models import GPTForCausalLM, gpt_tiny
+    dist.init_mesh({"dp": 2, "sep": 4})
+    paddle.seed(0)
+    cfg = gpt_tiny(context_parallel="ring", max_position_embeddings=64)
+    m = GPTForCausalLM(cfg)
+    ids = paddle.to_tensor(np.random.RandomState(0)
+                           .randint(0, 128, (2, 16)).astype("int32"))
+    _, loss = m(ids, labels=ids)
+    loss.backward()
+    assert np.isfinite(float(loss.numpy()))
+    # parity vs plain attention with identical weights
+    paddle.seed(0)
+    m2 = GPTForCausalLM(gpt_tiny(max_position_embeddings=64))
+    _, loss2 = m2(ids, labels=ids)
+    np.testing.assert_allclose(float(loss.numpy()), float(loss2.numpy()),
+                               rtol=1e-4)
+    dist.init_mesh({"dp": 8})
+
+
+def test_mixed_placement_grad_accumulation():
+    """A param reached through both a mesh-sharded path and a plain path
+    must accumulate grads without device-set conflicts (regression)."""
+    from jax.sharding import PartitionSpec as P
+    from paddle2_tpu.distributed.fleet.mp_layers import _constrain_tensor
+    dist.init_mesh({"dp": 1, "sep": 8})
+    w = paddle.to_tensor(np.arange(8, dtype="float32"))
+    w.stop_gradient = False
+    ws = _constrain_tensor(w, P("sep"))
+    loss = (ws * ws).sum() + (w * 2.0).sum()
+    loss.backward()
+    np.testing.assert_allclose(w.grad.numpy(),
+                               2 * np.arange(8, dtype="float32") + 2.0)
+    dist.init_mesh({"dp": 8})
