@@ -57,6 +57,10 @@ from .hapi.model import Model  # noqa: F401
 from . import incubate  # noqa: F401
 from . import static  # noqa: F401
 from . import profiler  # noqa: F401
+from . import distribution  # noqa: F401
+from . import fft  # noqa: F401
+from . import sparse  # noqa: F401
+from . import quantization  # noqa: F401
 
 disable_static = lambda place=None: None  # dygraph is the default & only eager mode
 enable_static = lambda: None  # static graphs are served by jit.to_static

@@ -1,0 +1,144 @@
+"""Higher-order autodiff: jacobian / hessian / vjp / jvp
+(reference python/paddle/autograd/autograd.py jacobian:22 hessian:383,
+python/paddle/incubate/autograd/functional.py vjp/jvp).
+
+TPU-native: the functional forms lower straight onto jax.jacrev/jacfwd —
+one traced program instead of the reference's row-by-row double-grad
+loops. The tensor form (ys, xs) falls back to tape vjp rows.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def _tensor_cls():
+    from ..framework.tensor import Tensor  # deferred: framework.tensor
+    return Tensor                          # imports autograd.tape first
+
+
+def _functionalize(func: Callable, xs):
+    """Wrap an imperative Tensor->Tensor callable as array->array."""
+
+    def pure(*arrays):
+        from ..framework import core
+        with core.no_grad():
+            out = func(*[_tensor_cls()(a) for a in arrays])
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    return pure
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """autograd.py:22 parity.
+
+    Functional form: ``jacobian(func, xs)`` with ``func`` a callable —
+    computed with jax.jacrev in one compiled pass. Tensor form:
+    ``jacobian(ys, xs)`` with ys already computed — assembled from tape
+    vjp rows (needs the graph alive, i.e. ys produced under grad mode).
+    """
+    Tensor = _tensor_cls()
+    if callable(ys) and not isinstance(ys, Tensor):
+        func = ys
+        xs_l = _as_list(xs)
+        pure = _functionalize(func, xs_l)
+        jac = jax.jacrev(pure, argnums=tuple(range(len(xs_l))))(
+            *[t._data for t in xs_l])
+        if isinstance(jac, (tuple, list)) and len(xs_l) == 1 \
+                and not isinstance(xs, (list, tuple)):
+            jac = jac[0]
+        return jax.tree_util.tree_map(
+            lambda a: Tensor(a, stop_gradient=True), jac,
+            is_leaf=lambda a: isinstance(a, jnp.ndarray))
+
+    # tensor form: rows of vjps through the tape
+    from .tape import grad as tape_grad
+    ys_l = _as_list(ys)
+    xs_l = _as_list(xs)
+    rows = []
+    for y in ys_l:
+        flat_n = int(jnp.prod(jnp.asarray(y.shape))) if y.shape else 1
+        y_rows = []
+        for i in range(flat_n):
+            seed = jnp.zeros((flat_n,), y._data.dtype).at[i].set(1.0)
+            seed = seed.reshape(tuple(y.shape) or ())
+            gs = tape_grad([y], xs_l, grad_outputs=[Tensor(seed)],
+                           retain_graph=True, allow_unused=True)
+            y_rows.append([None if g is None else g._data for g in gs])
+        per_x = []
+        for xi, x in enumerate(xs_l):
+            stacked = jnp.stack([
+                r[xi] if r[xi] is not None
+                else jnp.zeros(tuple(x.shape), x._data.dtype)
+                for r in y_rows])
+            per_x.append(Tensor(stacked.reshape(
+                tuple(y.shape) + tuple(x.shape)), stop_gradient=True))
+        rows.append(per_x if len(xs_l) > 1 or isinstance(xs, (list, tuple))
+                    else per_x[0])
+    if len(ys_l) == 1 and not isinstance(ys, (list, tuple)):
+        return rows[0]
+    return rows
+
+
+def hessian(func, xs, batch_axis=None):
+    """autograd.py:383 parity (functional form): jacfwd-over-jacrev."""
+    Tensor = _tensor_cls()
+    if not callable(func) or isinstance(func, Tensor):
+        raise TypeError("hessian expects a callable producing a scalar")
+    xs_l = _as_list(xs)
+    pure = _functionalize(func, xs_l)
+    h = jax.jacfwd(jax.jacrev(pure, argnums=tuple(range(len(xs_l)))),
+                   argnums=tuple(range(len(xs_l))))(
+        *[t._data for t in xs_l])
+    wrap = lambda a: Tensor(a, stop_gradient=True)
+    out = jax.tree_util.tree_map(wrap, h,
+                                 is_leaf=lambda a: isinstance(a,
+                                                              jnp.ndarray))
+    if len(xs_l) == 1 and not isinstance(xs, (list, tuple)):
+        return out[0][0]
+    return out
+
+
+def vjp(func, xs, v=None):
+    """incubate/autograd/functional.py vjp parity: returns (ys, vjp_out)."""
+    Tensor = _tensor_cls()
+    xs_l = _as_list(xs)
+    pure = _functionalize(func, xs_l)
+    ys, f_vjp = jax.vjp(pure, *[t._data for t in xs_l])
+    if v is None:
+        seed = jax.tree_util.tree_map(jnp.ones_like, ys)
+    else:
+        v_l = v if isinstance(v, (tuple, list)) else [v]
+        seed = tuple(t._data for t in v_l) if isinstance(ys, tuple) \
+            else v_l[0]._data
+    grads = f_vjp(seed)
+    wrap = lambda a: Tensor(a, stop_gradient=True)
+    ys_t = jax.tree_util.tree_map(wrap, ys)
+    gs_t = [wrap(g) for g in grads]
+    return ys_t, (gs_t if isinstance(xs, (list, tuple)) else gs_t[0])
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode counterpart (incubate jvp parity)."""
+    Tensor = _tensor_cls()
+    xs_l = _as_list(xs)
+    pure = _functionalize(func, xs_l)
+    primals = [t._data for t in xs_l]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in primals]
+    else:
+        v_l = v if isinstance(v, (tuple, list)) else [v]
+        tangents = [t._data for t in v_l]
+    ys, out_t = jax.jvp(pure, primals, tangents)
+    wrap = lambda a: Tensor(a, stop_gradient=True)
+    return (jax.tree_util.tree_map(wrap, ys),
+            jax.tree_util.tree_map(wrap, out_t))

@@ -18,6 +18,10 @@ from .collective import (Group, P2POp, ReduceOp, all_gather,
 from .parallel import DataParallel, init_parallel_env, parallel_initialized
 from .sharding import ShardedOptimizer, group_sharded_parallel, shard_optimizer
 from . import fleet  # noqa: F401
+from . import launch  # noqa: F401
+from . import sep  # noqa: F401
+from .sep import ring_attention, ulysses_attention  # noqa: F401
+from .utils import get_logger  # noqa: F401
 from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401
 
@@ -30,4 +34,5 @@ __all__ = [
     "batch_isend_irecv", "P2POp", "is_initialized", "destroy_process_group",
     "get_mesh", "init_mesh", "set_mesh", "constrain", "replicated",
     "axis_size", "world_size", "HYBRID_AXES", "parallel_initialized",
+    "launch", "ring_attention", "ulysses_attention", "get_logger",
 ]

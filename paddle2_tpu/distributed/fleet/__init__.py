@@ -19,6 +19,7 @@ from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
 from ..sharding import ShardedOptimizer, group_sharded_parallel
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
 from .pipeline_parallel import PipelineParallel
+from .elastic import ElasticManager, ElasticStatus
 
 __all__ = ["init", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
@@ -28,7 +29,7 @@ __all__ = ["init", "DistributedStrategy", "distributed_model",
            "ShardedOptimizer", "group_sharded_parallel", "worker_index",
            "worker_num", "is_first_worker", "meta_parallel",
            "LayerDesc", "SharedLayerDesc", "PipelineLayer",
-           "PipelineParallel"]
+           "PipelineParallel", "ElasticManager", "ElasticStatus"]
 
 
 class DistributedStrategy:

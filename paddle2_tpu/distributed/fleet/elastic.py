@@ -1,0 +1,81 @@
+"""Elastic training manager (reference fleet/elastic/manager.py:125).
+
+The reference coordinates scale-in/out through etcd; offline TPU pods have
+no etcd, so membership goes through a shared-filesystem heartbeat store
+(works on GCS-fuse/NFS job dirs) and the restart mechanics live in the
+launcher (--max_restarts). This manager tracks liveness and answers the
+"did the world change" question the trainer polls between steps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from ..env import get_rank, get_world_size
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, store_dir: Optional[str] = None,
+                 heartbeat_interval: float = 10.0,
+                 dead_after: float = 60.0):
+        job = os.environ.get("PADDLE_JOB_ID", "default")
+        self.store_dir = store_dir or os.path.join(
+            os.environ.get("PADDLE_ELASTIC_STORE",
+                           "/tmp/paddle2_tpu_elastic"), job)
+        self.interval = heartbeat_interval
+        self.dead_after = dead_after
+        self.rank = get_rank()
+        self.world = get_world_size()
+        os.makedirs(self.store_dir, exist_ok=True)
+        self._last_beat = 0.0
+
+    def _path(self, rank: int) -> str:
+        return os.path.join(self.store_dir, f"rank_{rank}.hb")
+
+    def heartbeat(self):
+        now = time.time()
+        if now - self._last_beat < self.interval:
+            return
+        with open(self._path(self.rank), "w") as f:
+            json.dump({"rank": self.rank, "ts": now,
+                       "world": self.world}, f)
+        self._last_beat = now
+
+    def alive_ranks(self) -> List[int]:
+        now = time.time()
+        out = []
+        for fname in os.listdir(self.store_dir):
+            if not fname.endswith(".hb"):
+                continue
+            try:
+                with open(os.path.join(self.store_dir, fname)) as f:
+                    d = json.load(f)
+                if now - d["ts"] <= self.dead_after:
+                    out.append(int(d["rank"]))
+            except Exception:
+                continue
+        return sorted(out)
+
+    def world_changed(self) -> bool:
+        return len(self.alive_ranks()) != self.world
+
+    def watch(self) -> str:
+        """One poll of the reference manager's watch loop."""
+        self.heartbeat()
+        alive = self.alive_ranks()
+        if len(alive) == self.world:
+            return ElasticStatus.HOLD
+        if len(alive) < self.world:
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD

@@ -40,20 +40,18 @@ _NEG = float("-inf")
 def _block_attn_lse(q, k, v, scale, mask):
     """Full (small-block) attention returning (out, lse).
 
-    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; mask: None | 'causal' | 'skip'.
-    'skip' returns a zero block with lse=-inf (fully masked)."""
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; mask: None | 'causal' | a
+    traced/bool [Sq, Sk] matrix (True = attend)."""
     B, Sq, H, D = q.shape
-    if mask == "skip":
-        return (jnp.zeros_like(q),
-                jnp.full((B, H, Sq), _NEG, jnp.float32))
     qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
     kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
     vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
     s = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * scale
-    if mask == "causal":
-        Sk = s.shape[-1]
-        causal = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
-        s = jnp.where(causal, s, _NEG)
+    if mask is not None:
+        if isinstance(mask, str):
+            Sk = s.shape[-1]
+            mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        s = jnp.where(mask, s, _NEG)
     m = jnp.max(s, axis=-1)                                  # [B,H,Sq]
     m_safe = jnp.where(m == _NEG, 0.0, m)
     p = jnp.exp(s - m_safe[..., None])
@@ -88,20 +86,18 @@ def _ring_body(q, k, v, *, axis, n, scale, causal):
         (q.shape[0], q.shape[2], q.shape[1]), _NEG, jnp.float32)
     perm = [(r, (r + 1) % n) for r in range(n)]
     cur_k, cur_v = k, v
+    chunk = q.shape[1]
     for t in range(n):
         j = (i - t) % n  # origin chunk of the kv currently held
         if causal:
-            # bottom-right-aligned global causality across chunks:
-            # j < i -> full block; j == i -> intra-chunk causal; j > i skip
-            o_b_c, lse_b_c = _block_attn_lse(q, cur_k, cur_v, scale,
-                                             "causal")
-            o_b_f, lse_b_f = _block_attn_lse(q, cur_k, cur_v, scale, None)
-            is_diag = (j == i)
-            keep = (j <= i)
-            o_b = jnp.where(is_diag, o_b_c, o_b_f)
-            lse_b = jnp.where(is_diag, lse_b_c, lse_b_f)
-            lse_b = jnp.where(keep, lse_b, _NEG)
-            o_b = jnp.where(keep, o_b, 0.0).astype(q.dtype)
+            # bottom-right-aligned global causality across chunks, as ONE
+            # mask select (no duplicated attention): j < i full block,
+            # j == i intra-chunk causal, j > i fully masked
+            tril = jnp.tril(jnp.ones((chunk, chunk), bool))
+            full = jnp.ones((chunk, chunk), bool)
+            none = jnp.zeros((chunk, chunk), bool)
+            mask = jnp.where(j == i, tril, jnp.where(j < i, full, none))
+            o_b, lse_b = _block_attn_lse(q, cur_k, cur_v, scale, mask)
         else:
             o_b, lse_b = _block_attn_lse(q, cur_k, cur_v, scale, None)
         o, lse = _merge(o, lse, o_b, lse_b)

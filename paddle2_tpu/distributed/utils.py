@@ -1,0 +1,27 @@
+"""Distributed utilities: logging + env helpers (reference
+python/paddle/distributed/utils/log_utils.py)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_loggers = {}
+
+
+def get_logger(level=logging.INFO, name: str = "paddle2_tpu.distributed"):
+    lg = _loggers.get(name)
+    if lg is not None:
+        return lg
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    if not lg.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+        h.setFormatter(logging.Formatter(
+            f"[rank {rank}] %(asctime)s %(levelname)s %(message)s"))
+        lg.addHandler(h)
+    lg.propagate = False
+    _loggers[name] = lg
+    return lg

@@ -1,0 +1,496 @@
+"""paddle.distribution (reference python/paddle/distribution/*.py;
+independent jnp implementation over the framework RNG).
+
+Sampling draws keys from the global generator (framework/random.py), so
+``paddle.seed`` reproduces draws; log_prob/entropy are differentiable
+tensor ops recorded on the tape.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework import random as fr
+from ..ops.dispatch import apply_op, ensure_tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Multinomial", "Laplace", "Gumbel",
+           "LogNormal", "Geometric", "Poisson", "ExponentialFamily",
+           "kl_divergence", "register_kl"]
+
+
+def _arr(x):
+    return ensure_tensor(x)._data.astype(jnp.float32) \
+        if not isinstance(x, (int, float)) else jnp.float32(x)
+
+
+def _t(a) -> Tensor:
+    return Tensor(a, stop_gradient=True)
+
+
+def _op(name, fn, *tensors):
+    ts = tuple(ensure_tensor(t) for t in tensors)
+    return apply_op(name, fn, ts, {})
+
+
+class Distribution:
+    """distribution/distribution.py:40 parity."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..ops.math import exp
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """distribution/normal.py:33."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _op("square", jnp.square, self.scale)
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(fr.next_key(), shape, jnp.float32)
+        return _t(self.loc._data + eps * self.scale._data)
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(fr.next_key(), shape, jnp.float32)
+        return _op("normal_rsample",
+                   lambda l, s: l + eps * s, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            var = jnp.square(s)
+            return (-jnp.square(v - l) / (2 * var)
+                    - jnp.log(s) - 0.5 * math.log(2 * math.pi))
+        return _op("normal_log_prob", f, value, self.loc, self.scale)
+
+    def entropy(self):
+        return _op("normal_entropy",
+                   lambda s: 0.5 + 0.5 * math.log(2 * math.pi)
+                   + jnp.log(s) + jnp.zeros(self.batch_shape), self.scale)
+
+    def cdf(self, value):
+        return _op("normal_cdf",
+                   lambda v, l, s: 0.5 * (1 + jax.scipy.special.erf(
+                       (v - l) / (s * math.sqrt(2)))),
+                   value, self.loc, self.scale)
+
+
+class LogNormal(Normal):
+    @property
+    def mean(self):
+        return _op("lognormal_mean",
+                   lambda l, s: jnp.exp(l + jnp.square(s) / 2),
+                   self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return _op("lognormal_var",
+                   lambda l, s: (jnp.exp(jnp.square(s)) - 1)
+                   * jnp.exp(2 * l + jnp.square(s)),
+                   self.loc, self.scale)
+
+    @property
+    def stddev(self):
+        return _op("sqrt", jnp.sqrt, self.variance)
+
+    def sample(self, shape=(), seed=0):
+        return _t(jnp.exp(super().sample(shape)._data))
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            lv = jnp.log(v)
+            return (-jnp.square(lv - l) / (2 * jnp.square(s))
+                    - jnp.log(s * v) - 0.5 * math.log(2 * math.pi))
+        return _op("lognormal_log_prob", f, value, self.loc, self.scale)
+
+
+class Uniform(Distribution):
+    """distribution/uniform.py:32."""
+
+    def __init__(self, low, high, name=None):
+        self.low = ensure_tensor(low)
+        self.high = ensure_tensor(high)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.low.shape), tuple(self.high.shape))))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(fr.next_key(), shape, jnp.float32)
+        return _t(self.low._data + u * (self.high._data - self.low._data))
+
+    def log_prob(self, value):
+        def f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return _op("uniform_log_prob", f, value, self.low, self.high)
+
+    def entropy(self):
+        return _op("uniform_entropy", lambda lo, hi: jnp.log(hi - lo),
+                   self.low, self.high)
+
+
+class Categorical(Distribution):
+    """distribution/categorical.py:34 (logits parameterization)."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is None:
+            logits = _op("log", jnp.log, ensure_tensor(probs))
+        self.logits = ensure_tensor(logits)
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    @property
+    def probs(self):
+        return _op("softmax", lambda l: jax.nn.softmax(l, -1), self.logits)
+
+    def sample(self, shape=(), seed=0):
+        idx = jax.random.categorical(fr.next_key(), self.logits._data,
+                                     shape=tuple(shape) + self.batch_shape)
+        return _t(idx)
+
+    def log_prob(self, value):
+        def f(lg, v):
+            logp = jax.nn.log_softmax(lg, -1)
+            return jnp.take_along_axis(
+                logp, v[..., None].astype(jnp.int32), -1)[..., 0]
+        return _op("categorical_log_prob", f, self.logits,
+                   ensure_tensor(value))
+
+    def entropy(self):
+        def f(lg):
+            logp = jax.nn.log_softmax(lg, -1)
+            return -jnp.sum(jnp.exp(logp) * logp, -1)
+        return _op("categorical_entropy", f, self.logits)
+
+
+class Bernoulli(Distribution):
+    """distribution/bernoulli.py."""
+
+    def __init__(self, probs, name=None):
+        self.probs_t = ensure_tensor(probs)
+        super().__init__(tuple(self.probs_t.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(fr.next_key(), shape, jnp.float32)
+        return _t((u < self.probs_t._data).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(p, v):
+            eps = 1e-7
+            p = jnp.clip(p, eps, 1 - eps)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return _op("bernoulli_log_prob", f, self.probs_t,
+                   ensure_tensor(value))
+
+    def entropy(self):
+        def f(p):
+            eps = 1e-7
+            p = jnp.clip(p, eps, 1 - eps)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return _op("bernoulli_entropy", f, self.probs_t)
+
+
+class Beta(Distribution):
+    """distribution/beta.py."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = ensure_tensor(alpha)
+        self.beta = ensure_tensor(beta)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.alpha.shape), tuple(self.beta.shape))))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        out = jax.random.beta(fr.next_key(), self.alpha._data,
+                              self.beta._data, shape)
+        return _t(out)
+
+    def log_prob(self, value):
+        def f(a, b, v):
+            from jax.scipy.special import betaln
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - betaln(a, b))
+        return _op("beta_log_prob", f, self.alpha, self.beta,
+                   ensure_tensor(value))
+
+    @property
+    def mean(self):
+        return _op("beta_mean", lambda a, b: a / (a + b), self.alpha,
+                   self.beta)
+
+
+class Dirichlet(Distribution):
+    """distribution/dirichlet.py."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = ensure_tensor(concentration)
+        super().__init__(tuple(self.concentration.shape[:-1]),
+                         tuple(self.concentration.shape[-1:]))
+
+    def sample(self, shape=(), seed=0):
+        out = jax.random.dirichlet(fr.next_key(),
+                                   self.concentration._data,
+                                   tuple(shape) + self.batch_shape)
+        return _t(out)
+
+    def log_prob(self, value):
+        def f(c, v):
+            from jax.scipy.special import gammaln
+            return (jnp.sum((c - 1) * jnp.log(v), -1)
+                    + gammaln(jnp.sum(c, -1)) - jnp.sum(gammaln(c), -1))
+        return _op("dirichlet_log_prob", f, self.concentration,
+                   ensure_tensor(value))
+
+
+class Multinomial(Distribution):
+    """distribution/multinomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_t = ensure_tensor(probs)
+        super().__init__(tuple(self.probs_t.shape[:-1]),
+                         tuple(self.probs_t.shape[-1:]))
+
+    def sample(self, shape=(), seed=0):
+        n = self.total_count
+        idx = jax.random.categorical(
+            fr.next_key(), jnp.log(self.probs_t._data),
+            shape=(n,) + tuple(shape) + self.batch_shape)
+        k = self.probs_t.shape[-1]
+        counts = jnp.sum(jax.nn.one_hot(idx, k, dtype=jnp.float32), axis=0)
+        return _t(counts)
+
+    def log_prob(self, value):
+        def f(p, v):
+            from jax.scipy.special import gammaln
+            return (gammaln(jnp.sum(v, -1) + 1)
+                    - jnp.sum(gammaln(v + 1), -1)
+                    + jnp.sum(v * jnp.log(p), -1))
+        return _op("multinomial_log_prob", f, self.probs_t,
+                   ensure_tensor(value))
+
+
+class Laplace(Distribution):
+    """distribution/laplace.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        out = jax.random.laplace(fr.next_key(), shape, jnp.float32)
+        return _t(self.loc._data + self.scale._data * out)
+
+    def log_prob(self, value):
+        return _op("laplace_log_prob",
+                   lambda l, s, v: -jnp.abs(v - l) / s - jnp.log(2 * s),
+                   self.loc, self.scale, ensure_tensor(value))
+
+    def entropy(self):
+        return _op("laplace_entropy", lambda s: 1 + jnp.log(2 * s),
+                   self.scale)
+
+
+class Gumbel(Distribution):
+    """distribution/gumbel.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        g = jax.random.gumbel(fr.next_key(), shape, jnp.float32)
+        return _t(self.loc._data + self.scale._data * g)
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return _op("gumbel_log_prob", f, self.loc, self.scale,
+                   ensure_tensor(value))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_t = ensure_tensor(probs)
+        super().__init__(tuple(self.probs_t.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(fr.next_key(), shape, jnp.float32)
+        return _t(jnp.floor(jnp.log1p(-u)
+                            / jnp.log1p(-self.probs_t._data)))
+
+    def log_prob(self, value):
+        return _op("geometric_log_prob",
+                   lambda p, v: v * jnp.log1p(-p) + jnp.log(p),
+                   self.probs_t, ensure_tensor(value))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = ensure_tensor(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    def sample(self, shape=(), seed=0):
+        out = jax.random.poisson(fr.next_key(), self.rate._data,
+                                 tuple(shape) + self.batch_shape)
+        return _t(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(r, v):
+            from jax.scipy.special import gammaln
+            return v * jnp.log(r) - r - gammaln(v + 1)
+        return _op("poisson_log_prob", f, self.rate, ensure_tensor(value))
+
+
+class ExponentialFamily(Distribution):
+    pass
+
+
+# ------------------------------------------------------------------- KL
+
+_KL_REGISTRY: Dict[Tuple[type, type], Callable] = {}
+
+
+def register_kl(type_p: type, type_q: type):
+    """distribution/kl.py register_kl parity."""
+
+    def decorator(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return decorator
+
+
+def _mro_dist(cls: type, base: type) -> int:
+    return cls.__mro__.index(base)
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    # most-specific dispatch: exact match, then the registered pair with
+    # minimal MRO distance (a subclass with a different sample space must
+    # register its own entry rather than inherit the base formula)
+    exact = _KL_REGISTRY.get((type(p), type(q)))
+    if exact is not None:
+        return exact(p, q)
+    best = None
+    best_d = None
+    for (tp, tq), fn in _KL_REGISTRY.items():
+        if isinstance(p, tp) and isinstance(q, tq):
+            d = _mro_dist(type(p), tp) + _mro_dist(type(q), tq)
+            if best_d is None or d < best_d:
+                best, best_d = fn, d
+    if best is not None:
+        return best(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p: Normal, q: Normal):
+    def f(l1, s1, l2, s2):
+        var1, var2 = jnp.square(s1), jnp.square(s2)
+        return (jnp.log(s2 / s1) + (var1 + jnp.square(l1 - l2))
+                / (2 * var2) - 0.5)
+    return _op("kl_normal", f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p: Uniform, q: Uniform):
+    def f(al, ah, bl, bh):
+        ok = (bl <= al) & (ah <= bh)
+        return jnp.where(ok, jnp.log((bh - bl) / (ah - al)), jnp.inf)
+    return _op("kl_uniform", f, p.low, p.high, q.low, q.high)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p: Categorical, q: Categorical):
+    def f(lp, lq):
+        a = jax.nn.log_softmax(lp, -1)
+        b = jax.nn.log_softmax(lq, -1)
+        return jnp.sum(jnp.exp(a) * (a - b), -1)
+    return _op("kl_categorical", f, p.logits, q.logits)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p: Bernoulli, q: Bernoulli):
+    def f(a, b):
+        eps = 1e-7
+        a = jnp.clip(a, eps, 1 - eps)
+        b = jnp.clip(b, eps, 1 - eps)
+        return a * jnp.log(a / b) + (1 - a) * jnp.log((1 - a) / (1 - b))
+    return _op("kl_bernoulli", f, p.probs_t, q.probs_t)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p: LogNormal, q: LogNormal):
+    # the log transform is a shared bijection: KL equals the underlying
+    # normal KL
+    return _kl_normal_normal(p, q)
+
+
+def _no_kl(p, q):
+    raise NotImplementedError(
+        "KL between LogNormal and Normal has mismatched supports")
+
+
+register_kl(LogNormal, Normal)(_no_kl)
+register_kl(Normal, LogNormal)(_no_kl)

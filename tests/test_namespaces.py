@@ -1,0 +1,258 @@
+"""Profiler, device, distribution, fft, sparse, static, quantization,
+launcher, elastic, jacobian/hessian (SURVEY §2.2 aux namespaces)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.nn as nn
+from paddle2_tpu import (device, distribution as D, fft, profiler, sparse,
+                         static, quantization as Q)
+
+
+# ----------------------------------------------------------------- profiler
+
+def test_profiler_records_and_exports(tmp_path):
+    handler = profiler.export_chrome_tracing(str(tmp_path))
+    prof = profiler.Profiler(timer_only=True, on_trace_ready=handler)
+    prof.start()
+    with profiler.RecordEvent("span_a"):
+        x = paddle.ones([32, 32])
+        paddle.matmul(x, x)
+    prof.step()
+    prof.stop()
+    assert any("span_a" == e["name"] for e in prof.events)
+    trace = json.load(open(prof._export_path))
+    assert trace["traceEvents"]
+    rows = prof.summary()
+    assert rows and {"name", "calls", "total_ms"} <= set(rows[0])
+
+
+def test_profiler_scheduler_states():
+    sch = profiler.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sch(i) for i in range(4)]
+    assert states[0] == profiler.ProfilerState.CLOSED
+    assert states[1] == profiler.ProfilerState.READY
+    assert states[2] == profiler.ProfilerState.RECORD
+    assert states[3] == profiler.ProfilerState.RECORD_AND_RETURN
+    assert sch(10) == profiler.ProfilerState.CLOSED  # past repeat
+
+
+def test_benchmark_ips():
+    b = profiler.benchmark()
+    b.begin()
+    for _ in range(5):
+        b.step(num_samples=4)
+    r = b.end()
+    assert r["steps"] == 5 and r["ips"] > 0
+
+
+# ------------------------------------------------------------------- device
+
+def test_device_stream_event_memory():
+    e1 = device.Event()
+    e1.record()
+    x = paddle.ones([16, 16])
+    y = paddle.matmul(x, x)
+    e2 = device.Event()
+    e2.record()
+    device.synchronize()
+    assert e1.elapsed_time(e2) >= 0.0
+    s = device.current_stream()
+    s.wait_event(e2)
+    assert device.memory_allocated() >= 0
+    assert device.cuda.device_count() >= 1
+    assert not device.is_compiled_with_cuda()
+
+
+# ------------------------------------------------------------- distribution
+
+def test_distribution_normal_moments_and_kl():
+    paddle.seed(0)
+    n = D.Normal(paddle.zeros([1]), paddle.ones([1]))
+    s = n.sample((4000,))
+    assert abs(float(s.numpy().mean())) < 0.1
+    assert abs(float(s.numpy().std()) - 1.0) < 0.1
+    kl = D.kl_divergence(n, D.Normal(paddle.zeros([1]), paddle.ones([1])))
+    np.testing.assert_allclose(kl.numpy(), 0.0, atol=1e-6)
+    ent = n.entropy()
+    np.testing.assert_allclose(ent.numpy(),
+                               0.5 * np.log(2 * np.pi) + 0.5, rtol=1e-5)
+
+
+def test_distribution_categorical_bernoulli():
+    paddle.seed(0)
+    c = D.Categorical(logits=paddle.to_tensor([[0.0, 0.0, 10.0]]))
+    s = c.sample((100,))
+    assert (s.numpy() == 2).mean() > 0.95
+    lp = c.log_prob(paddle.to_tensor([2]))
+    assert float(lp.numpy()) > -0.01
+    b = D.Bernoulli(paddle.to_tensor([0.9]))
+    assert abs(float(b.sample((2000,)).numpy().mean()) - 0.9) < 0.05
+
+
+def test_distribution_log_prob_grad():
+    mu = paddle.zeros([1])
+    mu.stop_gradient = False
+    n = D.Normal(mu, paddle.ones([1]))
+    lp = n.log_prob(paddle.to_tensor([0.5]))
+    lp.sum().backward()
+    np.testing.assert_allclose(mu.grad.numpy(), [0.5], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------- fft
+
+def test_fft_roundtrip_and_grad():
+    x = paddle.randn([16])
+    X = fft.fft(x)
+    back = fft.ifft(X)
+    np.testing.assert_allclose(np.asarray(back._data).real, x.numpy(),
+                               atol=1e-5)
+    out = fft.rfft2(paddle.randn([8, 8]))
+    assert tuple(out.shape) == (8, 5)  # rfft halves the last axis
+    freqs = fft.fftfreq(8)
+    assert freqs.shape[0] == 8
+    sh = fft.fftshift(freqs)
+    assert abs(float(sh.numpy()[0])) == 0.5
+
+
+# ------------------------------------------------------------------- sparse
+
+def test_sparse_coo_csr():
+    coo = sparse.sparse_coo_tensor([[0, 1, 1], [1, 0, 1]],
+                                   [1.0, 2.0, 3.0], (2, 2))
+    dense = coo.to_dense().numpy()
+    np.testing.assert_array_equal(dense, [[0, 1], [2, 3]])
+    csr = coo.to_sparse_csr()
+    np.testing.assert_array_equal(csr.to_dense().numpy(), dense)
+    assert coo.nnz() == 3
+    y = sparse.matmul(coo, paddle.ones([2, 2]))
+    np.testing.assert_array_equal(y.numpy(), [[1, 1], [5, 5]])
+
+
+# ------------------------------------------------------------------- static
+
+def test_static_shims_and_inference_model(tmp_path):
+    spec = static.data("x", [None, 8])
+    assert spec.shape == [None, 8]
+    with static.program_guard(static.Program()):
+        pass
+    paddle.seed(0)
+    net = nn.Linear(8, 2)
+    net.eval()
+    path = str(tmp_path / "inf" / "model")
+    static.save_inference_model(path, [static.InputSpec([4, 8])], net)
+    loaded, _, _ = static.load_inference_model(path)
+    x = paddle.randn([4, 8])
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------- quantization
+
+def test_qat_quantize_and_train():
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    ref_out = m(paddle.ones([2, 8]))
+    Q.QAT().quantize(m)
+    x = paddle.ones([2, 8])
+    y = m(x)
+    # fake-quant is near-identity for well-scaled weights
+    np.testing.assert_allclose(y.numpy(), ref_out.numpy(), atol=0.1)
+    y.sum().backward()
+    assert m[0].inner.weight.grad is not None  # STE passes grads
+
+
+def test_fake_quant_levels():
+    x = paddle.to_tensor(np.linspace(-1, 1, 101).astype("float32"))
+    q = Q.fake_quant(x, scale=1.0, bits=8)
+    lv = np.unique(np.round(q.numpy() * 127))
+    assert len(lv) <= 256
+
+
+# ------------------------------------------------- launcher / elastic / log
+
+def test_launcher_runs_and_wires_env(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os, json\n"
+        "print(json.dumps({k: os.environ.get(k) for k in\n"
+        "    ['PADDLE_TRAINER_ID', 'PADDLE_TRAINERS_NUM']}))\n")
+    from paddle2_tpu.distributed.launch.main import launch
+    log_dir = str(tmp_path / "logs")
+    rc = launch(["--nproc_per_node", "2", "--log_dir", log_dir,
+                 str(script)])
+    assert rc == 0
+    logs = sorted(os.listdir(log_dir))
+    assert logs == ["workerlog.0", "workerlog.1"]
+    env0 = json.loads(open(os.path.join(log_dir, "workerlog.0")).read())
+    assert env0["PADDLE_TRAINER_ID"] == "0"
+    assert env0["PADDLE_TRAINERS_NUM"] == "2"
+
+
+def test_launcher_elastic_restart(tmp_path):
+    marker = tmp_path / "attempted"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        f"import os, sys\n"
+        f"p = {str(marker)!r}\n"
+        f"if not os.path.exists(p):\n"
+        f"    open(p, 'w').write('x')\n"
+        f"    sys.exit(3)\n"
+        f"print('recovered')\n")
+    from paddle2_tpu.distributed.launch.main import launch
+    rc = launch(["--max_restarts", "2", str(script)])
+    assert rc == 0 and marker.exists()
+
+
+def test_elastic_manager_membership(tmp_path):
+    from paddle2_tpu.distributed.fleet import ElasticManager, ElasticStatus
+    em = ElasticManager(store_dir=str(tmp_path), heartbeat_interval=0.0)
+    em.world = 2
+    status = em.watch()   # only our own heartbeat -> world shrunk
+    assert status == ElasticStatus.RESTART
+    em.world = 1
+    assert em.watch() == ElasticStatus.HOLD
+    assert em.alive_ranks() == [0]
+
+
+# ------------------------------------------------------- jacobian / hessian
+
+def test_jacobian_functional_and_tensor_form():
+    import paddle2_tpu.autograd as ag
+
+    def f(x):
+        return (x * x).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+    j = ag.jacobian(f, x)
+    np.testing.assert_allclose(j.numpy(), [2.0, 4.0, 6.0], rtol=1e-6)
+
+    x2 = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    x2.stop_gradient = False
+    y = x2 * x2
+    jt = ag.jacobian(y, x2)
+    np.testing.assert_allclose(jt.numpy(), [[2.0, 0.0], [0.0, 4.0]],
+                               rtol=1e-6)
+
+
+def test_hessian_and_vjp_jvp():
+    import paddle2_tpu.autograd as ag
+
+    def f(x):
+        return (x ** 3).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    h = ag.hessian(f, x)
+    np.testing.assert_allclose(h.numpy(), np.diag([6.0, 12.0]), rtol=1e-5)
+
+    ys, g = ag.vjp(lambda t: t * 2.0, x)
+    np.testing.assert_allclose(g.numpy(), [2.0, 2.0], rtol=1e-6)
+    ys, t_out = ag.jvp(lambda t: t * t, x,
+                       paddle.to_tensor(np.ones(2, "float32")))
+    np.testing.assert_allclose(t_out.numpy(), [2.0, 4.0], rtol=1e-6)
