@@ -111,13 +111,14 @@ class Event:
         self._t_done = None
 
     def query(self) -> bool:
+        """Non-blocking completion poll (CUDA event query contract)."""
         if self._token is None:
             return True
         try:
+            return bool(self._token.is_ready())
+        except AttributeError:  # older jax: fall back to blocking check
             self._token.block_until_ready()
             return True
-        except Exception:
-            return False
 
     def synchronize(self):
         if self._token is not None:

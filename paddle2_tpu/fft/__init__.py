@@ -15,7 +15,7 @@ __all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
 
 
 def _mk1(jnp_fn, name):
-    def f(x, n=None, axis=-1, norm="backward", name_arg=None):
+    def f(x, n=None, axis=-1, norm="backward", name=None):
         t = ensure_tensor(x)
         return apply_op(name, lambda a: jnp_fn(a, n=n, axis=axis,
                                                norm=norm), (t,), {})
@@ -25,7 +25,7 @@ def _mk1(jnp_fn, name):
 
 
 def _mk2(jnp_fn, name):
-    def f(x, s=None, axes=(-2, -1), norm="backward", name_arg=None):
+    def f(x, s=None, axes=(-2, -1), norm="backward", name=None):
         t = ensure_tensor(x)
         return apply_op(name, lambda a: jnp_fn(a, s=s, axes=axes,
                                                norm=norm), (t,), {})
@@ -34,7 +34,7 @@ def _mk2(jnp_fn, name):
 
 
 def _mkn(jnp_fn, name):
-    def f(x, s=None, axes=None, norm="backward", name_arg=None):
+    def f(x, s=None, axes=None, norm="backward", name=None):
         t = ensure_tensor(x)
         return apply_op(name, lambda a: jnp_fn(a, s=s, axes=axes,
                                                norm=norm), (t,), {})

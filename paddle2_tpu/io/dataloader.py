@@ -384,6 +384,8 @@ class DataLoader:
         self.num_workers = max(0, num_workers)
         self.collate_fn = collate_fn or default_collate_fn
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -412,6 +414,15 @@ class DataLoader:
             return self._iter_iterable()
         if self.num_workers == 0:
             return self._iter_sync()
+        if self.use_shared_memory and self.collate_fn is default_collate_fn:
+            # multiprocess + C++ shm ring: Python decode escapes the GIL
+            # (reference dataloader_iter.py:368 design); falls back to the
+            # thread prefetcher when the native lib can't build
+            try:
+                from .shm_loader import ShmProcessIter
+                return ShmProcessIter(self, list(self.batch_sampler))
+            except (RuntimeError, OSError):
+                pass
         return _PrefetchIter(self, iter(self.batch_sampler))
 
     def _iter_sync(self):

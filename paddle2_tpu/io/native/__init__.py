@@ -1,0 +1,4 @@
+"""Native runtime components: build + ctypes bindings for the C++
+shared-memory ring buffer (the DataLoader data plane)."""
+
+from .build import load_shm_ring  # noqa: F401

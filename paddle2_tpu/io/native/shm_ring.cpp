@@ -1,0 +1,180 @@
+// Shared-memory SPSC ring buffer — the native data plane of the
+// multiprocess DataLoader (parity target: the reference's shared-memory
+// LoDTensor transport in python/paddle/fluid/dataloader/dataloader_iter.py
+// + paddle/fluid/memory/allocation (shm blocks); re-designed as a lockless
+// single-producer/single-consumer byte ring per worker, C ABI for ctypes).
+//
+// Layout in the shm segment:
+//   [Header{head, tail, capacity, closed} | data bytes ...]
+// Records are [u64 len][payload]; the ring wraps byte-wise. head is
+// advanced by the consumer, tail by the producer; both are C++11 atomics
+// on cache-line-separated fields, so no locks are needed.
+//
+// Build: g++ -O2 -shared -fPIC shm_ring.cpp -o libshmring.so -lrt
+
+#include <atomic>
+#include <new>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct alignas(64) Header {
+  std::atomic<uint64_t> head;   // consumer cursor (bytes consumed)
+  char pad0[64 - sizeof(std::atomic<uint64_t>)];
+  std::atomic<uint64_t> tail;   // producer cursor (bytes written)
+  char pad1[64 - sizeof(std::atomic<uint64_t>)];
+  uint64_t capacity;            // data area size in bytes
+  std::atomic<uint32_t> closed; // producer hung up
+};
+
+struct Ring {
+  Header* hdr;
+  uint8_t* data;
+  size_t map_len;
+  bool owner;
+  char name[256];
+};
+
+inline uint64_t used(const Header* h) {
+  return h->tail.load(std::memory_order_acquire)
+       - h->head.load(std::memory_order_acquire);
+}
+
+void sleep_us(long us) {
+  struct timespec ts{0, us * 1000L};
+  nanosleep(&ts, nullptr);
+}
+
+// byte-wise circular copy in/out of the data area
+void write_bytes(Ring* r, uint64_t pos, const uint8_t* src, uint64_t len) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = (off + len <= cap) ? len : cap - off;
+  memcpy(r->data + off, src, first);
+  if (first < len) memcpy(r->data, src + first, len - first);
+}
+
+void read_bytes(Ring* r, uint64_t pos, uint8_t* dst, uint64_t len) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = (off + len <= cap) ? len : cap - off;
+  memcpy(dst, r->data + off, first);
+  if (first < len) memcpy(dst + first, r->data, len - first);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rb_create(const char* name, uint64_t capacity) {
+  shm_unlink(name);  // stale segment from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t total = sizeof(Header) + capacity;
+  if (ftruncate(fd, (off_t)total) != 0) { close(fd); return nullptr; }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Header* h = new (mem) Header();
+  h->head.store(0); h->tail.store(0);
+  h->capacity = capacity;
+  h->closed.store(0);
+  Ring* r = new Ring();
+  r->hdr = h;
+  r->data = (uint8_t*)mem + sizeof(Header);
+  r->map_len = total;
+  r->owner = true;
+  strncpy(r->name, name, sizeof(r->name) - 1);
+  return r;
+}
+
+void* rb_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Ring* r = new Ring();
+  r->hdr = (Header*)mem;
+  r->data = (uint8_t*)mem + sizeof(Header);
+  r->map_len = (size_t)st.st_size;
+  r->owner = false;
+  strncpy(r->name, name, sizeof(r->name) - 1);
+  return r;
+}
+
+// push one [len][payload] record; blocks while the ring is full.
+// returns 0 ok, -1 timeout, -2 record larger than capacity.
+int rb_push(void* rv, const void* buf, uint64_t len, int timeout_ms) {
+  Ring* r = (Ring*)rv;
+  Header* h = r->hdr;
+  uint64_t need = len + 8;
+  if (need > h->capacity) return -2;
+  long waited_us = 0;
+  while (h->capacity - used(h) < need) {
+    if (timeout_ms >= 0 && waited_us / 1000 >= timeout_ms) return -1;
+    sleep_us(200);
+    waited_us += 200;
+  }
+  uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  uint64_t len_le = len;
+  write_bytes(r, tail, (const uint8_t*)&len_le, 8);
+  write_bytes(r, tail + 8, (const uint8_t*)buf, len);
+  h->tail.store(tail + need, std::memory_order_release);
+  return 0;
+}
+
+// size of the next record, blocking until one exists.
+// returns len >= 0, -1 on timeout, -3 if closed and drained.
+int64_t rb_next_len(void* rv, int timeout_ms) {
+  Ring* r = (Ring*)rv;
+  Header* h = r->hdr;
+  long waited_us = 0;
+  while (used(h) < 8) {
+    if (h->closed.load(std::memory_order_acquire) && used(h) == 0)
+      return -3;
+    if (timeout_ms >= 0 && waited_us / 1000 >= timeout_ms) return -1;
+    sleep_us(200);
+    waited_us += 200;
+  }
+  uint64_t len;
+  read_bytes(r, h->head.load(std::memory_order_relaxed), (uint8_t*)&len, 8);
+  return (int64_t)len;
+}
+
+// copy out the next record (len from rb_next_len) and advance.
+int rb_pop(void* rv, void* out, uint64_t len) {
+  Ring* r = (Ring*)rv;
+  Header* h = r->hdr;
+  uint64_t head = h->head.load(std::memory_order_relaxed);
+  read_bytes(r, head + 8, (uint8_t*)out, len);
+  h->head.store(head + 8 + len, std::memory_order_release);
+  return 0;
+}
+
+void rb_close_producer(void* rv) {
+  ((Ring*)rv)->hdr->closed.store(1, std::memory_order_release);
+}
+
+uint64_t rb_used(void* rv) { return used(((Ring*)rv)->hdr); }
+
+void rb_detach(void* rv) {
+  Ring* r = (Ring*)rv;
+  munmap((void*)r->hdr, r->map_len);
+  delete r;
+}
+
+void rb_unlink(const char* name) { shm_unlink(name); }
+
+}  // extern "C"

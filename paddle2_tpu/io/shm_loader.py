@@ -1,0 +1,214 @@
+"""Multiprocess DataLoader path over the native shared-memory ring
+(reference dataloader_iter.py:368 _DataLoaderIterMultiProcess + its shm
+LoDTensor transport; here the data plane is the C++ SPSC ring in
+io/native/shm_ring.cpp and workers are forked processes, so Python decode
+work escapes the GIL — the exact limitation of the thread prefetcher).
+
+Workers are jax-free: they decode+collate to NUMPY trees, pickle into
+their ring, and the main process materializes Tensors. Batch order is
+deterministic: worker w owns batches w, w+W, ... and the consumer drains
+rings round-robin.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import signal
+import threading
+import traceback
+from typing import Any, List
+
+import numpy as np
+
+_DEF_RING_BYTES = 64 << 20  # per worker
+
+
+def _np_collate(batch):
+    """default_collate_fn shape contract, numpy leaves only."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: _np_collate([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return type(sample)(_np_collate(list(items))
+                            for items in zip(*batch))
+    if hasattr(sample, "numpy"):  # Tensor-like snuck into a worker
+        return np.stack([np.asarray(s.numpy()) for s in batch])
+    raise TypeError(f"cannot collate {type(sample)}")
+
+
+def _to_tensor_tree(obj):
+    from ..framework.tensor import Tensor
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: _to_tensor_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (tuple, list)):
+        return type(obj)(_to_tensor_tree(v) for v in obj)
+    return obj
+
+
+class ShmProcessIter:
+    """Ordered multiprocess iterator (one ring per worker)."""
+
+    def __init__(self, loader, batches: List[List[int]],
+                 ring_bytes: int = 0):
+        from .native import load_shm_ring
+        self._lib = load_shm_ring()
+        self.loader = loader
+        self.batches = batches
+        self.W = loader.num_workers
+        self.next_emit = 0
+        # timeout=0 means wait forever (reference DataLoader semantics)
+        t = float(getattr(loader, "timeout", 0) or 0)
+        self._timeout_ms = int(t * 1000) if t > 0 else -1
+        ring_bytes = ring_bytes or int(os.environ.get(
+            "PADDLE2_TPU_SHM_RING_BYTES", _DEF_RING_BYTES))
+        uid = f"/p2t_{os.getpid()}_{id(self) & 0xFFFFFF}"
+        self._names = [f"{uid}_{w}".encode() for w in range(self.W)]
+        # error side-channel per worker: survives a full data ring
+        self._err_names = [f"{uid}_{w}e".encode() for w in range(self.W)]
+        self._rings = []
+        self._err_rings = []
+        self._procs = []
+        self._closed = False
+        try:
+            for n, en in zip(self._names, self._err_names):
+                r = self._lib.rb_create(n, ring_bytes)
+                if not r:
+                    raise RuntimeError(f"shm ring create failed ({n!r})")
+                self._rings.append(r)
+                er = self._lib.rb_create(en, 1 << 20)
+                if not er:
+                    raise RuntimeError(f"shm ring create failed ({en!r})")
+                self._err_rings.append(er)
+            import warnings
+            for w in range(self.W):
+                with warnings.catch_warnings():
+                    # jax warns on fork because ITS threads could hold
+                    # locks; our children never enter jax (numpy-only
+                    # decode), the same posture as the reference's forked
+                    # workers
+                    warnings.simplefilter("ignore", RuntimeWarning)
+                    pid = os.fork()
+                if pid == 0:  # child: jax-free decode loop
+                    code = 1
+                    try:
+                        self._worker_main(w)
+                        code = 0
+                    finally:
+                        os._exit(code)
+                self._procs.append(pid)
+        except BaseException:
+            self.close()
+            raise
+
+    # -- worker side -----------------------------------------------------
+    def _worker_main(self, w: int):
+        lib = self._lib
+        ring = lib.rb_attach(self._names[w])
+        err_ring = lib.rb_attach(self._err_names[w])
+        ds = self.loader.dataset
+        from .dataloader import _WorkerInfo, _worker_tls
+        _worker_tls.info = _WorkerInfo(w, self.W, ds)
+        if self.loader.worker_init_fn is not None:
+            self.loader.worker_init_fn(w)
+        try:
+            for i in range(w, len(self.batches), self.W):
+                samples = [ds[j] for j in self.batches[i]]
+                payload = pickle.dumps((i, _np_collate(samples)),
+                                       protocol=4)
+                rc = lib.rb_push(ring, payload, len(payload), -1)
+                if rc == -2:
+                    raise RuntimeError(
+                        f"batch {i} ({len(payload)} bytes) exceeds the shm "
+                        f"ring capacity; set PADDLE2_TPU_SHM_RING_BYTES "
+                        f"higher or use_shared_memory=False")
+        except BaseException as e:
+            try:  # keep the original exception type when picklable
+                blob = pickle.dumps((e, traceback.format_exc()),
+                                    protocol=4)
+            except Exception:
+                blob = pickle.dumps((None, traceback.format_exc()),
+                                    protocol=4)
+            # the DATA ring may be full; errors ride their own channel
+            lib.rb_push(err_ring, blob, len(blob), 2000)
+        finally:
+            lib.rb_close_producer(ring)
+            lib.rb_close_producer(err_ring)
+            lib.rb_detach(ring)
+            lib.rb_detach(err_ring)
+
+    # -- consumer side ---------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def _raise_worker_error(self, w: int, fallback: str):
+        n = self._lib.rb_next_len(self._err_rings[w], 0)
+        if n >= 0:
+            buf = ctypes.create_string_buffer(int(n))
+            self._lib.rb_pop(self._err_rings[w], buf, int(n))
+            exc, tb = pickle.loads(buf.raw)
+            self.close()
+            if exc is not None:
+                raise exc
+            raise RuntimeError(f"DataLoader worker failed:\n{tb}")
+        self.close()
+        raise RuntimeError(fallback)
+
+    def __next__(self):
+        if self.next_emit >= len(self.batches):
+            self.close()
+            raise StopIteration
+        w = self.next_emit % self.W
+        n = self._lib.rb_next_len(self._rings[w], self._timeout_ms)
+        if n == -3:
+            self._raise_worker_error(
+                w, f"worker {w} exited early (batch "
+                   f"{self.next_emit} missing)")
+        if n < 0:
+            self._raise_worker_error(
+                w, f"shm DataLoader timed out after "
+                   f"{self._timeout_ms / 1000:.0f}s waiting on worker {w}")
+        buf = ctypes.create_string_buffer(int(n))
+        self._lib.rb_pop(self._rings[w], buf, int(n))
+        tag, payload = pickle.loads(buf.raw)
+        assert tag == self.next_emit, (tag, self.next_emit)
+        self.next_emit += 1
+        return _to_tensor_tree(payload)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for pid in self._procs:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for pid in self._procs:
+            try:
+                os.waitpid(pid, 0)
+            except ChildProcessError:
+                pass
+        for r, n in zip(self._rings + self._err_rings,
+                        self._names + self._err_names):
+            self._lib.rb_detach(r)
+            self._lib.rb_unlink(n)
+        self._rings = []
+        self._err_rings = []
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
