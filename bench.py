@@ -48,7 +48,9 @@ def main():
                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
                     # scan-over-remat: depth-independent compile and O(1)
                     # per-layer activation memory (residuals recomputed)
-                    use_recompute=True)
+                    use_recompute=True,
+                    recompute_granularity=os.environ.get(
+                        "BENCH_REMAT", "dots"))
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")

@@ -14,31 +14,31 @@ __all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
            "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
 
 
-def _mk1(jnp_fn, name):
+def _mk1(jnp_fn, op_name):
     def f(x, n=None, axis=-1, norm="backward", name=None):
         t = ensure_tensor(x)
-        return apply_op(name, lambda a: jnp_fn(a, n=n, axis=axis,
-                                               norm=norm), (t,), {})
-    f.__name__ = name
-    f.__doc__ = f"python/paddle/fft.py {name} parity."
+        return apply_op(op_name, lambda a: jnp_fn(a, n=n, axis=axis,
+                                                  norm=norm), (t,), {})
+    f.__name__ = op_name
+    f.__doc__ = f"python/paddle/fft.py {op_name} parity."
     return f
 
 
-def _mk2(jnp_fn, name):
+def _mk2(jnp_fn, op_name):
     def f(x, s=None, axes=(-2, -1), norm="backward", name=None):
         t = ensure_tensor(x)
-        return apply_op(name, lambda a: jnp_fn(a, s=s, axes=axes,
-                                               norm=norm), (t,), {})
-    f.__name__ = name
+        return apply_op(op_name, lambda a: jnp_fn(a, s=s, axes=axes,
+                                                  norm=norm), (t,), {})
+    f.__name__ = op_name
     return f
 
 
-def _mkn(jnp_fn, name):
+def _mkn(jnp_fn, op_name):
     def f(x, s=None, axes=None, norm="backward", name=None):
         t = ensure_tensor(x)
-        return apply_op(name, lambda a: jnp_fn(a, s=s, axes=axes,
-                                               norm=norm), (t,), {})
-    f.__name__ = name
+        return apply_op(op_name, lambda a: jnp_fn(a, s=s, axes=axes,
+                                                  norm=norm), (t,), {})
+    f.__name__ = op_name
     return f
 
 

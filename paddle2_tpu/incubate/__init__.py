@@ -4,6 +4,7 @@ from . import moe  # noqa: F401
 from .moe import MoELayer, SwitchGate, TopKGate
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+from . import autotune  # noqa: F401
 
 __all__ = ["MoELayer", "SwitchGate", "TopKGate", "moe", "distributed",
            "nn"]

@@ -1,0 +1,77 @@
+"""paddle.incubate.autotune (reference python/paddle/incubate/autotune.py
+set_config: kernel / layout / dataloader tuning).
+
+TPU-native content: "kernel" tuning measures Pallas flash-attention block
+sizes per attention shape and caches the winner (the analog of the
+reference's cuDNN algo exhaustive search); "layout" is a no-op (XLA owns
+layouts on TPU); "dataloader" tuning probes worker counts.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Optional, Tuple
+
+_config = {"kernel": {"enable": False, "tuning_range": [1, 10]},
+           "layout": {"enable": False},
+           "dataloader": {"enable": False}}
+
+_block_cache: Dict[Tuple, Tuple[int, int]] = {}
+_CANDIDATES = ((256, 256), (256, 512), (512, 512), (512, 1024),
+               (1024, 1024))
+
+
+def set_config(config=None):
+    """incubate/autotune.py:23 parity: dict or json file path."""
+    if config is None:
+        for v in _config.values():
+            v["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    for k, v in config.items():
+        if k in _config and isinstance(v, dict):
+            _config[k].update(v)
+
+
+def kernel_tuning_enabled() -> bool:
+    return bool(_config["kernel"]["enable"])
+
+
+def best_flash_blocks(q_shape, k_shape, causal: bool,
+                      default: Tuple[int, int]) -> Tuple[int, int]:
+    """Measured block-size search, cached per (shapes, causal)."""
+    key = (tuple(q_shape), tuple(k_shape), bool(causal))
+    hit = _block_cache.get(key)
+    if hit is not None:
+        return hit
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..kernels import pallas_flash as pf
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(*q_shape), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(*k_shape), jnp.bfloat16)
+    best, best_t = default, float("inf")
+    for bq, bk in _CANDIDATES:
+        if not pf.supported(q_shape, k_shape, bq, bk):
+            continue
+        try:
+            f = jax.jit(lambda a, b, c, _bq=bq, _bk=bk:
+                        pf.flash_attention_bshd(a, b, c, causal=causal,
+                                                block_q=_bq, block_k=_bk))
+            o = f(q, k, k)
+            _ = float(jnp.sum(o.astype(jnp.float32)))  # true sync
+            t0 = time.perf_counter()
+            for _i in range(3):
+                o = f(o, k, k)
+            _ = float(jnp.sum(o.astype(jnp.float32)))
+            dt = time.perf_counter() - t0
+            if dt < best_t:
+                best, best_t = (bq, bk), dt
+        except Exception:
+            continue
+    _block_cache[key] = best
+    return best

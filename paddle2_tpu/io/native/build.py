@@ -16,10 +16,17 @@ _lib = None
 
 
 def _compile() -> str:
+    # pid-unique output: concurrent ranks may build simultaneously and
+    # os.replace must publish only a COMPLETE library
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++14", _SRC,
-           "-o", _LIB + ".tmp", "-lrt", "-pthread"]
-    subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(_LIB + ".tmp", _LIB)
+           "-o", tmp, "-lrt", "-pthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, _LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return _LIB
 
 

@@ -79,6 +79,7 @@ class ShmProcessIter:
         self._err_names = [f"{uid}_{w}e".encode() for w in range(self.W)]
         self._rings = []
         self._err_rings = []
+        self._created = []  # exact (ring, name) pairs for cleanup
         self._procs = []
         self._closed = False
         try:
@@ -87,10 +88,12 @@ class ShmProcessIter:
                 if not r:
                     raise RuntimeError(f"shm ring create failed ({n!r})")
                 self._rings.append(r)
+                self._created.append((r, n))
                 er = self._lib.rb_create(en, 1 << 20)
                 if not er:
                     raise RuntimeError(f"shm ring create failed ({en!r})")
                 self._err_rings.append(er)
+                self._created.append((er, en))
             import warnings
             for w in range(self.W):
                 with warnings.catch_warnings():
@@ -117,12 +120,14 @@ class ShmProcessIter:
         lib = self._lib
         ring = lib.rb_attach(self._names[w])
         err_ring = lib.rb_attach(self._err_names[w])
-        ds = self.loader.dataset
-        from .dataloader import _WorkerInfo, _worker_tls
-        _worker_tls.info = _WorkerInfo(w, self.W, ds)
-        if self.loader.worker_init_fn is not None:
-            self.loader.worker_init_fn(w)
+        if not ring or not err_ring:
+            os._exit(2)  # parent's liveness poll reports the death
         try:
+            ds = self.loader.dataset
+            from .dataloader import _WorkerInfo, _worker_tls
+            _worker_tls.info = _WorkerInfo(w, self.W, ds)
+            if self.loader.worker_init_fn is not None:
+                self.loader.worker_init_fn(w)
             for i in range(w, len(self.batches), self.W):
                 samples = [ds[j] for j in self.batches[i]]
                 payload = pickle.dumps((i, _np_collate(samples)),
@@ -165,20 +170,38 @@ class ShmProcessIter:
         self.close()
         raise RuntimeError(fallback)
 
+    def _worker_dead(self, w: int) -> bool:
+        pid = self._procs[w]
+        try:
+            done, _ = os.waitpid(pid, os.WNOHANG)
+            return done == pid
+        except ChildProcessError:
+            return True
+
     def __next__(self):
         if self.next_emit >= len(self.batches):
             self.close()
             raise StopIteration
         w = self.next_emit % self.W
-        n = self._lib.rb_next_len(self._rings[w], self._timeout_ms)
+        waited = 0
+        while True:  # 1s slices: detect killed/odd-death workers
+            n = self._lib.rb_next_len(self._rings[w], 1000)
+            if n >= 0 or n == -3:
+                break
+            waited += 1000
+            if self._worker_dead(w) and \
+                    self._lib.rb_next_len(self._rings[w], 0) < 0:
+                self._raise_worker_error(
+                    w, f"worker {w} (pid {self._procs[w]}) died without "
+                       f"reporting an error (OOM-killed?)")
+            if 0 <= self._timeout_ms <= waited:
+                self._raise_worker_error(
+                    w, f"shm DataLoader timed out after "
+                       f"{waited / 1000:.0f}s waiting on worker {w}")
         if n == -3:
             self._raise_worker_error(
                 w, f"worker {w} exited early (batch "
                    f"{self.next_emit} missing)")
-        if n < 0:
-            self._raise_worker_error(
-                w, f"shm DataLoader timed out after "
-                   f"{self._timeout_ms / 1000:.0f}s waiting on worker {w}")
         buf = ctypes.create_string_buffer(int(n))
         self._lib.rb_pop(self._rings[w], buf, int(n))
         tag, payload = pickle.loads(buf.raw)
@@ -193,17 +216,17 @@ class ShmProcessIter:
         for pid in self._procs:
             try:
                 os.kill(pid, signal.SIGTERM)
-            except ProcessLookupError:
+            except (ProcessLookupError, PermissionError):
                 pass
         for pid in self._procs:
             try:
                 os.waitpid(pid, 0)
-            except ChildProcessError:
+            except (ChildProcessError, OSError):
                 pass
-        for r, n in zip(self._rings + self._err_rings,
-                        self._names + self._err_names):
+        for r, n in self._created:
             self._lib.rb_detach(r)
             self._lib.rb_unlink(n)
+        self._created = []
         self._rings = []
         self._err_rings = []
 

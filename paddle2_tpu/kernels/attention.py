@@ -28,7 +28,9 @@ def _sdpa_xla(q, k, v, bias=None, causal=False, scale=None, dropout_p=0.0,
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
-    logits = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * scale
+    from ..ops.linalg import _mxu_precision
+    prec = _mxu_precision(qh, kh)
+    logits = jnp.einsum("bhsd,bhtd->bhst", qh, kh, precision=prec) * scale
     if bias is not None:
         logits = logits + bias
     if causal:
@@ -39,7 +41,7 @@ def _sdpa_xla(q, k, v, bias=None, causal=False, scale=None, dropout_p=0.0,
     if dropout_p > 0.0 and dropout_key is not None:
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
-    out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vh, precision=prec)
     return jnp.swapaxes(out, 1, 2)
 
 
@@ -76,9 +78,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         drop_key = fr.next_key()
 
     if use_pallas(tuple(query.shape)) and not has_mask and drop_key is None:
-        from .pallas_flash import flash_attention_bshd
+        from .pallas_flash import (DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q,
+                                   flash_attention_bshd)
+        from ..incubate import autotune
+        bq, bk = DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+        if autotune.kernel_tuning_enabled():
+            bq, bk = autotune.best_flash_blocks(
+                tuple(query.shape), tuple(key.shape), causal, (bq, bk))
+
         def fn(q, k, v):
-            return flash_attention_bshd(q, k, v, causal=causal)
+            return flash_attention_bshd(q, k, v, causal=causal,
+                                        block_q=bq, block_k=bk)
         return apply_op("flash_attention", fn, tuple(tensors), {})
 
     def fn(q, k, v, *mask):

@@ -46,6 +46,11 @@ class GPTConfig:
     # resharding). See distributed/sep.py.
     context_parallel: str = "none"
     use_recompute: bool = False
+    # remat selectivity: "full" recomputes everything (min memory);
+    # "dots" saves matmul outputs and recomputes elementwise only
+    # (jax checkpoint_policies.dots_with_no_batch_dims_saveable) — the
+    # usual best speed/memory point on TPU
+    recompute_granularity: str = "full"
     # compile the block stack as ONE lax.scan body under to_static —
     # compile time (and HLO size) become depth-independent, the standard
     # TPU recipe for deep transformers. Falls back to the Python loop in
@@ -245,7 +250,12 @@ class GPTModel(nn.Layer):
             return out._data, None
 
         if self.cfg.use_recompute and self.training:
-            body = jax.checkpoint(body)
+            if self.cfg.recompute_granularity == "dots":
+                body = jax.checkpoint(
+                    body, policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+            else:
+                body = jax.checkpoint(body)
         final, _ = jax.lax.scan(body, x._data, stacked)
         out = Tensor(final, stop_gradient=x.stop_gradient)
         return out

@@ -24,12 +24,19 @@ __all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
 def linear(x, weight, bias=None, name=None) -> Tensor:
     """y = x @ W + b; W is (in_features, out_features) like the reference
     (python/paddle/nn/functional/common.py linear)."""
+    from ...ops.linalg import _mxu_precision
     x, weight = ensure_tensor(x), ensure_tensor(weight)
     if bias is not None:
         bias = ensure_tensor(bias)
-        return apply_op("linear", lambda a, w, b: jnp.matmul(a, w) + b,
-                        (x, weight, bias), {})
-    return apply_op("linear", jnp.matmul, (x, weight), {})
+        return apply_op(
+            "linear",
+            lambda a, w, b: jnp.matmul(
+                a, w, precision=_mxu_precision(a, w)) + b,
+            (x, weight, bias), {})
+    return apply_op(
+        "linear",
+        lambda a, w: jnp.matmul(a, w, precision=_mxu_precision(a, w)),
+        (x, weight), {})
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",

@@ -57,10 +57,12 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n_spatial,
         tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, out_spec))
 
     def fn(a, w, *maybe_b):
+        from ...ops.linalg import _mxu_precision
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=stride, padding=pad,
             rhs_dilation=dilation, dimension_numbers=dn,
             feature_group_count=groups,
+            precision=_mxu_precision(a, w),
             preferred_element_type=None)
         if maybe_b:
             b = maybe_b[0]
@@ -129,11 +131,13 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
                          .reshape((groups, cin // groups, cog) + k)
                          .transpose((1, 0, 2) + tuple(range(3, 3 + n_spatial)))
                          .reshape((cin // groups, groups * cog) + k))
+        from ...ops.linalg import _mxu_precision
         out = jax.lax.conv_general_dilated(
             a, w_flipped, window_strides=(1,) * n_spatial,
             padding=pad_cfg if not isinstance(pad_cfg, str) else pad_cfg,
             lhs_dilation=stride, rhs_dilation=dilation,
-            dimension_numbers=dn, feature_group_count=groups)
+            dimension_numbers=dn, feature_group_count=groups,
+            precision=_mxu_precision(a, w_flipped))
         if maybe_b:
             b = maybe_b[0]
             shape = [1] * out.ndim

@@ -22,6 +22,22 @@ from ..autograd.tape import GradNode
 _OP_REGISTRY: Dict[str, Callable] = {}
 
 
+def _maybe_check_finite(name, out):
+    """FLAGS_check_nan_inf forward pass (reference nan_inf_utils_detail:
+    per-op output scan). Debug-only: forces a host sync per op."""
+    from ..flags import flag_value
+    if not flag_value("check_nan_inf"):
+        return
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    for i, a in enumerate(outs):
+        if (hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.inexact)
+                and not isinstance(a, jax.core.Tracer)):
+            if bool(jnp.any(~jnp.isfinite(a))):
+                raise FloatingPointError(
+                    f"nan/inf in FORWARD output {i} of op '{name}' "
+                    f"(FLAGS_check_nan_inf is enabled)")
+
+
 def _harmonize_placements(tensors) -> tuple:
     """When a device mesh is active, promote single-device-committed payloads
     to mesh-replicated so eager ops can mix them with mesh-sharded operands
@@ -101,10 +117,12 @@ def apply_op(name: str, fn: Callable, tensors: Sequence[Tensor],
                           for t in tensors))
     if not needs_grad:
         out = fn(*arrays, **kwargs) if kwargs else fn(*arrays)
+        _maybe_check_finite(name, out)
         return _wrap_outputs(name, out, False, None)
 
     closed = (lambda *xs: fn(*xs, **kwargs)) if kwargs else fn
     out, vjp_fn = jax.vjp(closed, *arrays)
+    _maybe_check_finite(name, out)
 
     def node_builder(outs):
         inputs = list(tensors)

@@ -317,6 +317,11 @@ def test_dataloader_multiprocess_shm():
             return (np.full((4, 4), i, np.float32),
                     {"label": np.int64(i), "name": f"s{i}"})
 
+    try:
+        from paddle2_tpu.io.native import load_shm_ring
+        load_shm_ring()
+    except RuntimeError:
+        pytest.skip("no C++ toolchain for the native shm ring")
     dl = DataLoader(Heavy(), batch_size=4, num_workers=3,
                     use_shared_memory=True)
     from paddle2_tpu.io.shm_loader import ShmProcessIter

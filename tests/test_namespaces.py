@@ -256,3 +256,29 @@ def test_hessian_and_vjp_jvp():
     ys, t_out = ag.jvp(lambda t: t * t, x,
                        paddle.to_tensor(np.ones(2, "float32")))
     np.testing.assert_allclose(t_out.numpy(), [2.0, 4.0], rtol=1e-6)
+
+
+def test_forward_nan_inf_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], "float32"))
+        with pytest.raises(FloatingPointError, match="FORWARD"):
+            _ = paddle.to_tensor(np.array([1.0], "float32")) / x
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_autotune_config_and_block_cache():
+    from paddle2_tpu.incubate import autotune
+    assert not autotune.kernel_tuning_enabled()
+    autotune.set_config({"kernel": {"enable": True}})
+    try:
+        assert autotune.kernel_tuning_enabled()
+        bq, bk = autotune.best_flash_blocks((1, 128, 2, 32), (1, 128, 2, 32),
+                                            True, (64, 64))
+        assert bq >= 64 and bk >= 64
+        # cached second call
+        assert autotune.best_flash_blocks(
+            (1, 128, 2, 32), (1, 128, 2, 32), True, (64, 64)) == (bq, bk)
+    finally:
+        autotune.set_config({"kernel": {"enable": False}})
