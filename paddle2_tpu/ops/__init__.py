@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import creation, linalg, logic, manipulation, math, random
+from . import creation, extra, linalg, logic, manipulation, math, random
 from .dispatch import apply_op, ensure_tensor, rebind_inplace
 from ..framework.tensor import Tensor
 
@@ -25,6 +25,7 @@ from .manipulation import *  # noqa: F401,F403
 from .linalg import *        # noqa: F401,F403
 from .logic import *         # noqa: F401,F403
 from .random import *        # noqa: F401,F403
+from .extra import *         # noqa: F401,F403
 
 
 # ---------------------------------------------------------------------------
@@ -106,7 +107,8 @@ def _patch():
     T.__invert__ = lambda s: math.bitwise_not(s)
 
     # method forms — mirror paddle Tensor methods
-    _method_sources = [math, creation, manipulation, linalg, logic, random]
+    _method_sources = [math, creation, manipulation, linalg, logic,
+                       random, extra]
     skip = {"to_tensor", "as_tensor", "pow"}
     for mod in _method_sources:
         for name in getattr(mod, "__all__", []):

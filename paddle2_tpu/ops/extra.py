@@ -1,0 +1,466 @@
+"""Long-tail tensor functions (reference python/paddle/tensor/{math,
+manipulation,linalg,search,stat}.py surface widening — the ops the core
+modules don't cover)."""
+
+from __future__ import annotations
+
+import builtins
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .dispatch import apply_op, ensure_tensor, rebind_inplace
+
+__all__ = [
+    "histogramdd", "trapezoid", "cumulative_trapezoid", "nanmedian",
+    "nanquantile", "take", "diagonal", "real", "imag",
+    "bitwise_left_shift", "bitwise_right_shift", "frexp", "polygamma",
+    "multigammaln", "gammaln", "gammainc", "gammaincc", "vander",
+    "cartesian_prod", "combinations", "column_stack", "row_stack",
+    "hstack", "vstack", "dstack", "tensor_split", "hsplit", "vsplit",
+    "dsplit", "block_diag", "unflatten", "positive", "negative",
+    "signbit", "isneginf", "isposinf", "isreal", "aminmax",
+    "float_power", "addcdiv", "addcmul", "baddbmm", "cdist", "pdist",
+    "flipud", "fliplr", "logaddexp2", "sinc", "xlogy", "exp2",
+    "clip_by_norm", "sgn", "fix", "fmod", "isin", "vecdot", "vdot",
+    "slice_scatter", "select_scatter", "top_p_sampling",
+]
+
+
+def _u(name, fn, *ts, **kw):
+    return apply_op(name, fn, tuple(ensure_tensor(t) for t in ts), kw)
+
+
+# ------------------------------------------------------------- elementwise
+
+def positive(x, name=None):
+    return _u("positive", lambda a: +a, x)
+
+
+def negative(x, name=None):
+    return _u("negative", jnp.negative, x)
+
+
+def signbit(x, name=None):
+    return _u("signbit", jnp.signbit, x)
+
+
+def isneginf(x, name=None):
+    return _u("isneginf", jnp.isneginf, x)
+
+
+def isposinf(x, name=None):
+    return _u("isposinf", jnp.isposinf, x)
+
+
+def isreal(x, name=None):
+    return _u("isreal", jnp.isreal, x)
+
+
+def float_power(x, y, name=None):
+    return _u("float_power", lambda a, b: jnp.float_power(a, b), x, y)
+
+
+def logaddexp2(x, y, name=None):
+    return _u("logaddexp2", jnp.logaddexp2, x, y)
+
+
+def sinc(x, name=None):
+    return _u("sinc", jnp.sinc, x)
+
+
+def xlogy(x, y, name=None):
+    from jax.scipy.special import xlogy as _xlogy
+    return _u("xlogy", _xlogy, x, y)
+
+
+def exp2(x, name=None):
+    return _u("exp2", jnp.exp2, x)
+
+
+def sgn(x, name=None):
+    """sign for real; unit phasor for complex (tensor/math.py sgn)."""
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.maximum(mag, 1e-30))
+        return jnp.sign(a)
+    return _u("sgn", f, x)
+
+
+def fix(x, name=None):
+    return _u("fix", jnp.fix, x)
+
+
+def fmod(x, y, name=None):
+    return _u("fmod", jnp.fmod, x, y)
+
+
+def frexp(x, name=None):
+    x = ensure_tensor(x)
+    return apply_op("frexp", jnp.frexp, (x,), {})
+
+
+def polygamma(x, n, name=None):
+    from jax.scipy.special import polygamma as _pg
+    return _u("polygamma", lambda a: _pg(int(n), a), x)
+
+
+def gammaln(x, name=None):
+    from jax.scipy.special import gammaln as _g
+    return _u("gammaln", _g, x)
+
+
+def multigammaln(x, p, name=None):
+    from jax.scipy.special import multigammaln as _mg
+    return _u("multigammaln", lambda a: _mg(a, int(p)), x)
+
+
+def gammainc(x, y, name=None):
+    from jax.scipy.special import gammainc as _gi
+    return _u("gammainc", _gi, x, y)
+
+
+def gammaincc(x, y, name=None):
+    from jax.scipy.special import gammaincc as _gic
+    return _u("gammaincc", _gic, x, y)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    # left shifts are identical arithmetic vs logical
+    return _u("bitwise_left_shift", jnp.left_shift, x, y)
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    if is_arithmetic:
+        return _u("bitwise_right_shift", jnp.right_shift, x, y)
+
+    def f(a, b):  # logical shift: reinterpret as unsigned, shift, back
+        if jnp.issubdtype(a.dtype, jnp.signedinteger):
+            u = {jnp.int8: jnp.uint8, jnp.int16: jnp.uint16,
+                 jnp.int32: jnp.uint32, jnp.int64: jnp.uint64}[
+                jnp.dtype(a.dtype).type]
+            return jax.lax.bitcast_convert_type(
+                jnp.right_shift(jax.lax.bitcast_convert_type(a, u),
+                                b.astype(u)), a.dtype)
+        return jnp.right_shift(a, b)
+    return _u("bitwise_right_shift_logical", f, x, y)
+
+
+def addcdiv(input, tensor1, tensor2, value=1.0, name=None):
+    return _u("addcdiv", lambda a, b, c: a + value * b / c, input, tensor1,
+              tensor2)
+
+
+def addcmul(input, tensor1, tensor2, value=1.0, name=None):
+    return _u("addcmul", lambda a, b, c: a + value * b * c, input, tensor1,
+              tensor2)
+
+
+# ------------------------------------------------------------ reductions
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return _u("nanmedian",
+              lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return _u("nanquantile",
+              lambda a: jnp.nanquantile(a, q, axis=axis, keepdims=keepdim),
+              x)
+
+
+def aminmax(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply_op("aminmax",
+                    lambda a: (jnp.min(a, axis=axis, keepdims=keepdim),
+                               jnp.max(a, axis=axis, keepdims=keepdim)),
+                    (x,), {})
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+    if x is not None:
+        return apply_op("trapezoid",
+                        lambda a, b: jnp.trapezoid(a, b, axis=axis),
+                        (y, ensure_tensor(x)), {})
+    return apply_op("trapezoid",
+                    lambda a: jnp.trapezoid(a, dx=dx or 1.0, axis=axis),
+                    (y,), {})
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+
+    def f(a, *rest):
+        b = rest[0] if rest else None
+        sl1 = [slice(None)] * a.ndim
+        sl2 = [slice(None)] * a.ndim
+        sl1[axis] = slice(1, None)
+        sl2[axis] = slice(None, -1)
+        avg = (a[tuple(sl1)] + a[tuple(sl2)]) / 2.0
+        if b is not None:
+            d = b[tuple(sl1)] - b[tuple(sl2)]
+        else:
+            d = dx or 1.0
+        return jnp.cumsum(avg * d, axis=axis)
+    ts = (y,) if x is None else (y, ensure_tensor(x))
+    return apply_op("cumulative_trapezoid", f, ts, {})
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    x_np = np.asarray(ensure_tensor(x).numpy())
+    w_np = np.asarray(ensure_tensor(weights).numpy()) \
+        if weights is not None else None
+    hist, edges = np.histogramdd(x_np, bins=bins, range=ranges,
+                                 density=density, weights=w_np)
+    return (Tensor(jnp.asarray(hist)),
+            [Tensor(jnp.asarray(e)) for e in edges])
+
+
+# --------------------------------------------------------- index / select
+
+def take(x, index, mode="raise", name=None):
+    xt, it = ensure_tensor(x), ensure_tensor(index)
+    if mode == "raise" and not isinstance(it._data, jax.core.Tracer):
+        n = int(np.prod(xt.shape))
+        idx_np = np.asarray(it.numpy())
+        if idx_np.size and (idx_np.min() < -n or idx_np.max() >= n):
+            raise IndexError(
+                f"take(mode='raise'): index out of range for tensor with "
+                f"{n} elements (got [{idx_np.min()}, {idx_np.max()}])")
+
+    def f(a, i):
+        flat = a.reshape(-1)
+        if mode == "wrap":
+            i = i % flat.shape[0]
+        elif mode == "clip":
+            i = jnp.clip(i, 0, flat.shape[0] - 1)
+        return flat[i]
+    return apply_op("take", f, (xt, it), {})
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _u("diagonal",
+              lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                     axis2=axis2), x)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return _u("isin",
+              lambda a, b: jnp.isin(a, b, invert=invert), x, test_x)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, st, en, sr in zip(axes, starts, ends, strides):
+            idx[ax] = slice(st, en, sr)
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+    return _u("slice_scatter", f, x, value)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def f(a, v):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = index
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+    return _u("select_scatter", f, x, values)
+
+
+# ----------------------------------------------------------- composition
+
+def vander(x, n=None, increasing=False, name=None):
+    return _u("vander",
+              lambda a: jnp.vander(a, N=n, increasing=increasing), x)
+
+
+def cartesian_prod(x, name=None):
+    ts = [ensure_tensor(t) for t in (x if isinstance(x, (list, tuple))
+                                     else [x])]
+
+    def f(*arrays):
+        grids = jnp.meshgrid(*arrays, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return apply_op("cartesian_prod", f, tuple(ts), {})
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    x = ensure_tensor(x)
+    n = int(x.shape[0])
+    gen = (itertools.combinations_with_replacement if with_replacement
+           else itertools.combinations)
+    idx = np.asarray(list(gen(range(n), r)), np.int32).reshape(-1, r)
+
+    def f(a):
+        return a[jnp.asarray(idx)]
+    return apply_op("combinations", f, (x,), {})
+
+
+def _stack_list(name, fn, xs):
+    ts = tuple(ensure_tensor(t) for t in xs)
+    return apply_op(name, lambda *a: fn(a), ts, {})
+
+
+def column_stack(x, name=None):
+    return _stack_list("column_stack", jnp.column_stack, x)
+
+
+def row_stack(x, name=None):
+    return _stack_list("row_stack", jnp.vstack, x)
+
+
+def hstack(x, name=None):
+    return _stack_list("hstack", jnp.hstack, x)
+
+
+def vstack(x, name=None):
+    return _stack_list("vstack", jnp.vstack, x)
+
+
+def dstack(x, name=None):
+    return _stack_list("dstack", jnp.dstack, x)
+
+
+def _split_list(name, fn, x, arg, axis=None):
+    x = ensure_tensor(x)
+    kw = {} if axis is None else {"axis": axis}
+
+    def f(a):
+        return tuple(fn(a, arg, **kw))
+    return list(apply_op(name, f, (x,), {}))
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return _split_list("tensor_split", jnp.array_split, x, num_or_indices,
+                       axis)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return _split_list("hsplit", jnp.hsplit, x, num_or_indices)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return _split_list("vsplit", jnp.vsplit, x, num_or_indices)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return _split_list("dsplit", jnp.dsplit, x, num_or_indices)
+
+
+def block_diag(inputs, name=None):
+    from jax.scipy.linalg import block_diag as _bd
+    return _stack_list("block_diag", lambda a: _bd(*a), inputs)
+
+
+def unflatten(x, axis, shape, name=None):
+    def f(a):
+        ax = axis % a.ndim  # normalize negative axes
+        s = list(a.shape)
+        new = list(shape)
+        if -1 in new:
+            known = int(np.prod([d for d in new if d != -1]))
+            new[new.index(-1)] = s[ax] // known
+        return a.reshape(s[:ax] + new + s[ax + 1:])
+    return _u("unflatten", f, x)
+
+
+def flipud(x, name=None):
+    return _u("flipud", jnp.flipud, x)
+
+
+def fliplr(x, name=None):
+    return _u("fliplr", jnp.fliplr, x)
+
+
+# ----------------------------------------------------------------- linalg
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    from .linalg import _mxu_precision
+    return _u("baddbmm",
+              lambda i, a, b: beta * i + alpha * jnp.matmul(
+                  a, b, precision=_mxu_precision(a, b)), input, x, y)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return _u("vecdot",
+              lambda a, b: jnp.sum(jnp.conj(a) * b, axis=axis), x, y)
+
+
+def vdot(x, y, name=None):
+    return _u("vdot", lambda a, b: jnp.vdot(a, b), x, y)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def f(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(d * d, -1), 1e-30))
+        return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+    return _u("cdist", f, x, y)
+
+
+def pdist(x, p=2.0, name=None):
+    x = ensure_tensor(x)
+    n = int(x.shape[0])
+    iu = np.triu_indices(n, k=1)
+
+    def f(a):
+        d = a[:, None, :] - a[None, :, :]
+        if p == 2.0:
+            m = jnp.sqrt(jnp.maximum(jnp.sum(d * d, -1), 1e-30))
+        else:
+            m = jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+        return m[iu]
+    return apply_op("pdist", f, (x,), {})
+
+
+def clip_by_norm(x, max_norm, name=None):
+    def f(a):
+        norm = jnp.sqrt(jnp.maximum(jnp.sum(a * a), 1e-30))
+        return jnp.where(norm > max_norm, a * (max_norm / norm), a)
+    return _u("clip_by_norm", f, x)
+
+
+# ----------------------------------------------------------------- complex
+
+def real(x, name=None):
+    return _u("real", jnp.real, x)
+
+
+def imag(x, name=None):
+    return _u("imag", jnp.imag, x)
+
+
+# ----------------------------------------------------------------- search
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling over the last axis (tensor/search.py
+    top_p_sampling): keeps the smallest prefix of sorted probs whose mass
+    reaches ps, renormalizes, samples one index per row."""
+    from ..framework import random as fr
+    x = ensure_tensor(x)
+    ps_t = ensure_tensor(ps)
+    key = (jax.random.PRNGKey(int(seed)) if seed not in (None, -1)
+           else fr.next_key())
+
+    def f(probs, p):
+        order = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, order, -1)
+        cum = jnp.cumsum(sorted_p, -1)
+        keep = cum - sorted_p < p[..., None]
+        filt = jnp.where(keep, sorted_p, 0.0)
+        filt = filt / jnp.maximum(filt.sum(-1, keepdims=True), 1e-30)
+        idx_sorted = jax.random.categorical(key, jnp.log(
+            jnp.maximum(filt, 1e-30)))
+        picked = jnp.take_along_axis(order, idx_sorted[..., None], -1)
+        return picked
+    ids = apply_op("top_p_sampling", f, (x, ps_t), {},
+                   differentiable=False)
+    return ids, None

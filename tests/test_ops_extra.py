@@ -1,0 +1,192 @@
+"""Long-tail tensor ops (ops/extra.py) — values vs numpy, OpTest-style."""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_elementwise_family():
+    x = np.array([-1.5, 0.0, 2.5], "float32")
+    np.testing.assert_allclose(paddle.negative(_t(x)).numpy(), -x)
+    np.testing.assert_allclose(paddle.positive(_t(x)).numpy(), x)
+    np.testing.assert_array_equal(paddle.signbit(_t(x)).numpy(),
+                                  np.signbit(x))
+    np.testing.assert_allclose(paddle.exp2(_t(x)).numpy(), 2.0 ** x)
+    np.testing.assert_allclose(paddle.sinc(_t(x)).numpy(), np.sinc(x),
+                               rtol=1e-6)
+    np.testing.assert_allclose(paddle.fix(_t(x)).numpy(), np.fix(x))
+    np.testing.assert_allclose(
+        paddle.fmod(_t(x), _t(np.array([2.0, 3.0, 2.0], "float32"))).numpy(),
+        np.fmod(x, [2.0, 3.0, 2.0]))
+    np.testing.assert_allclose(paddle.sgn(_t(x)).numpy(), np.sign(x))
+    a = np.array([1.0, 2.0], "float32")
+    np.testing.assert_allclose(
+        paddle.logaddexp2(_t(a), _t(a)).numpy(), np.logaddexp2(a, a),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.xlogy(_t(a), _t(a)).numpy(), a * np.log(a), rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.float_power(_t(a), _t(a)).numpy(), a ** a)
+
+
+def test_gamma_family_and_shifts():
+    x = np.array([0.5, 2.0, 5.0], "float32")
+    from scipy import special as sp
+    np.testing.assert_allclose(paddle.gammaln(_t(x)).numpy(),
+                               sp.gammaln(x), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.gammainc(_t(x), _t(x)).numpy(), sp.gammainc(x, x),
+        rtol=1e-5)
+    i = np.array([1, 2, 4], "int32")
+    np.testing.assert_array_equal(
+        paddle.bitwise_left_shift(_t(i), _t(np.ones(3, "int32"))).numpy(),
+        i << 1)
+    np.testing.assert_array_equal(
+        paddle.bitwise_right_shift(_t(i), _t(np.ones(3, "int32"))).numpy(),
+        i >> 1)
+    m, e = paddle.frexp(_t(x))
+    np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), x, rtol=1e-6)
+
+
+def test_addc_baddbmm():
+    rs = np.random.RandomState(0)
+    a, b, c = (rs.randn(3, 4).astype("float32") for _ in range(3))
+    np.testing.assert_allclose(
+        paddle.addcmul(_t(a), _t(b), _t(c), value=0.5).numpy(),
+        a + 0.5 * b * c, rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.addcdiv(_t(a), _t(b), _t(np.abs(c) + 1), value=2.0).numpy(),
+        a + 2.0 * b / (np.abs(c) + 1), rtol=1e-5)
+    x = rs.randn(2, 3, 4).astype("float32")
+    y = rs.randn(2, 4, 5).astype("float32")
+    i = rs.randn(2, 3, 5).astype("float32")
+    np.testing.assert_allclose(
+        paddle.baddbmm(_t(i), _t(x), _t(y), beta=0.5, alpha=2.0).numpy(),
+        0.5 * i + 2.0 * (x @ y), rtol=1e-4, atol=1e-5)
+
+
+def test_reductions_and_integration():
+    x = np.array([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]], "float32")
+    np.testing.assert_allclose(paddle.nanmedian(_t(x)).numpy(),
+                               np.nanmedian(x))
+    y = np.array([1.0, 2.0, 4.0, 7.0], "float32")
+    np.testing.assert_allclose(paddle.trapezoid(_t(y)).numpy(),
+                               np.trapezoid(y) if hasattr(np, "trapezoid")
+                               else np.trapz(y))
+    from scipy.integrate import cumulative_trapezoid as sp_ct
+    ct = paddle.cumulative_trapezoid(_t(y)).numpy()
+    np.testing.assert_allclose(ct, sp_ct(y), rtol=1e-6)
+    mn, mx = paddle.aminmax(_t(y))
+    assert float(mn.numpy()) == 1.0 and float(mx.numpy()) == 7.0
+    hist, edges = paddle.histogramdd(_t(np.random.rand(50, 2)), bins=4)
+    assert hist.shape == [4, 4] and len(edges) == 2
+
+
+def test_stack_split_layout():
+    rs = np.random.RandomState(0)
+    a, b = rs.randn(3, 4).astype("float32"), rs.randn(3, 4).astype("float32")
+    np.testing.assert_allclose(paddle.hstack([_t(a), _t(b)]).numpy(),
+                               np.hstack([a, b]))
+    np.testing.assert_allclose(paddle.vstack([_t(a), _t(b)]).numpy(),
+                               np.vstack([a, b]))
+    np.testing.assert_allclose(paddle.column_stack([_t(a), _t(b)]).numpy(),
+                               np.column_stack([a, b]))
+    parts = paddle.tensor_split(_t(a), 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [3, 2]
+    np.testing.assert_allclose(np.concatenate(
+        [p.numpy() for p in paddle.hsplit(_t(a), 2)], 1), a)
+    bd = paddle.block_diag([_t(np.eye(2, dtype="float32")),
+                            _t(np.ones((1, 1), "float32"))])
+    assert bd.shape == [3, 3] and bd.numpy()[2, 2] == 1.0
+    u = paddle.unflatten(_t(a.reshape(12)), 0, [3, 4])
+    np.testing.assert_allclose(u.numpy(), a.reshape(3, 4))
+    np.testing.assert_allclose(paddle.flipud(_t(a)).numpy(), a[::-1])
+    np.testing.assert_allclose(paddle.fliplr(_t(a)).numpy(), a[:, ::-1])
+
+
+def test_take_diag_scatter():
+    a = np.arange(12, dtype="float32").reshape(3, 4)
+    np.testing.assert_allclose(
+        paddle.take(_t(a), _t(np.array([0, 5, 11]))).numpy(), [0, 5, 11])
+    np.testing.assert_allclose(
+        paddle.take(_t(a), _t(np.array([-1, 12])), mode="wrap").numpy(),
+        [11, 0])
+    np.testing.assert_allclose(paddle.diagonal(_t(a)).numpy(),
+                               np.diagonal(a))
+    v = np.zeros((3, 2), "float32")
+    out = paddle.slice_scatter(_t(a), _t(v), [1], [1], [3], [1])
+    assert out.numpy()[:, 1:3].sum() == 0
+    out2 = paddle.select_scatter(_t(a), _t(np.zeros(4, "float32")), 0, 1)
+    assert out2.numpy()[1].sum() == 0
+    np.testing.assert_array_equal(
+        paddle.isin(_t(a), _t(np.array([0.0, 5.0]))).numpy(),
+        np.isin(a, [0, 5]))
+
+
+def test_geometry_and_distance():
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 3).astype("float32")
+    y = rs.randn(5, 3).astype("float32")
+    from scipy.spatial.distance import cdist as sp_cdist, pdist as sp_pdist
+    np.testing.assert_allclose(paddle.cdist(_t(x), _t(y)).numpy(),
+                               sp_cdist(x, y), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(paddle.pdist(_t(x)).numpy(), sp_pdist(x),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(paddle.vecdot(_t(x), _t(x)).numpy(),
+                               (x * x).sum(-1), rtol=1e-5)
+    v = np.array([1.0, 2.0, 3.0], "float32")
+    np.testing.assert_allclose(paddle.vander(_t(v), n=3).numpy(),
+                               np.vander(v, 3), rtol=1e-6)
+    cp = paddle.cartesian_prod([_t(np.array([1, 2])),
+                                _t(np.array([3, 4]))])
+    assert cp.numpy().tolist() == [[1, 3], [1, 4], [2, 3], [2, 4]]
+    cb = paddle.combinations(_t(np.array([1, 2, 3])), r=2)
+    assert cb.numpy().tolist() == [[1, 2], [1, 3], [2, 3]]
+    big = np.array([3.0, 4.0], "float32")
+    np.testing.assert_allclose(paddle.clip_by_norm(_t(big), 1.0).numpy(),
+                               big / 5.0, rtol=1e-6)
+
+
+def test_grad_flow_on_extras():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+    x.stop_gradient = False
+    y = paddle.addcmul(x, x, x).sum() + paddle.cdist(
+        x.reshape([3, 1]), x.reshape([3, 1])).sum()
+    y.backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+def test_top_p_sampling():
+    paddle.seed(0)
+    probs = np.array([[0.05, 0.05, 0.9], [0.4, 0.5, 0.1]], "float32")
+    ids, _ = paddle.top_p_sampling(_t(probs),
+                                   _t(np.array([0.5, 0.5], "float32")))
+    assert ids.numpy()[0, 0] == 2          # only index 2 survives p=0.5
+    assert ids.numpy()[1, 0] in (0, 1)
+
+
+def test_review_regressions():
+    # unflatten with negative axis
+    a = np.arange(12, dtype="float32").reshape(2, 6)
+    u = paddle.unflatten(_t(a), -1, [2, 3])
+    assert u.shape == [2, 2, 3]
+    # take(mode='raise') really raises
+    with pytest.raises(IndexError):
+        paddle.take(_t(np.arange(4.0)), _t(np.array([10])))
+    # logical right shift on negative ints
+    r = paddle.bitwise_right_shift(_t(np.array([-8], "int32")),
+                                   _t(np.array([1], "int32")),
+                                   is_arithmetic=False)
+    assert r.numpy()[0] == 2147483644
+    # seeded top-p sampling is reproducible
+    probs = np.array([[0.3, 0.3, 0.4]], "float32")
+    a1, _ = paddle.top_p_sampling(_t(probs), _t(np.array([0.9], "float32")),
+                                  seed=7)
+    a2, _ = paddle.top_p_sampling(_t(probs), _t(np.array([0.9], "float32")),
+                                  seed=7)
+    assert a1.numpy().tolist() == a2.numpy().tolist()
