@@ -61,6 +61,18 @@ from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import sparse  # noqa: F401
 from . import quantization  # noqa: F401
+from . import inference  # noqa: F401
+from . import signal  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import hub  # noqa: F401
+from . import regularizer  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+from . import version  # noqa: F401
+from .framework.dtype_info import iinfo, finfo  # noqa: F401
+from . import audio  # noqa: F401
+from . import geometric  # noqa: F401
+from . import text  # noqa: F401
+from . import utils  # noqa: F401
 
 disable_static = lambda place=None: None  # dygraph is the default & only eager mode
 enable_static = lambda: None  # static graphs are served by jit.to_static

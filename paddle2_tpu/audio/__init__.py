@@ -1,0 +1,11 @@
+"""paddle.audio (reference python/paddle/audio/: features + functional).
+
+Mel/MFCC front-ends as differentiable jnp pipelines over paddle.signal's
+stft — the TPU runs feature extraction fused with the model when jitted.
+Backends (file IO) are out of scope offline; features are complete.
+"""
+
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+
+__all__ = ["functional", "features"]

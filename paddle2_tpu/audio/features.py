@@ -1,0 +1,89 @@
+"""paddle.audio.features (reference audio/features/layers.py: Spectrogram,
+MelSpectrogram, LogMelSpectrogram, MFCC)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import nn
+from ..framework.tensor import Tensor
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(nn.Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = AF.get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x):
+        from ..signal import stft
+        from ..ops.dispatch import apply_op
+        import jax.numpy as jnp
+        spec = stft(x, self.n_fft, self.hop_length, self.win_length,
+                    window=self.window, center=self.center,
+                    pad_mode=self.pad_mode)
+        return apply_op("spec_power",
+                        lambda a: jnp.abs(a) ** self.power, (spec,), {})
+
+
+class MelSpectrogram(nn.Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode,
+                                       dtype)
+        self.fbank = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min,
+                                             f_max, htk, norm, dtype)
+
+    def forward(self, x):
+        from ..ops.linalg import matmul
+        spec = self.spectrogram(x)          # [..., bins, frames]
+        return matmul(self.fbank, spec)     # [..., n_mels, frames]
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr: int = 22050, ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 **mel_kwargs):
+        super().__init__()
+        self.mel = MelSpectrogram(sr=sr, **mel_kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(nn.Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_mels: int = 64,
+                 **mel_kwargs):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, n_mels=n_mels, **mel_kwargs)
+        self.dct = AF.create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        from ..ops.linalg import matmul
+        from ..ops.manipulation import transpose
+        logmel = self.log_mel(x)            # [..., n_mels, frames]
+        # dct: [n_mels, n_mfcc] -> out [..., n_mfcc, frames]
+        return matmul(transpose(self.dct, [1, 0]), logmel)

@@ -1,0 +1,125 @@
+"""paddle.audio.functional (reference audio/functional/functional.py:
+hz_to_mel/mel_to_hz/mel_frequencies/fft_frequencies/compute_fbank_matrix/
+power_to_db/create_dct + window functions)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply_op, ensure_tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    scalar = not hasattr(freq, "shape")
+    f = jnp.asarray(getattr(freq, "_data", freq), jnp.float32)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:  # slaney
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                              / min_log_hz) / logstep,
+                        mels)
+    return float(out) if scalar else Tensor(out)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    scalar = not hasattr(mel, "shape")
+    m = jnp.asarray(getattr(mel, "_data", mel), jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel,
+                        min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                        freqs)
+    return float(out) if scalar else Tensor(out)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype="float32") -> Tensor:
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return Tensor(mel_to_hz(Tensor(mels), htk)._data.astype(dtype))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype="float32") -> Tensor:
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney",
+                         dtype="float32") -> Tensor:
+    f_max = f_max or sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)._data
+    melfreqs = mel_frequencies(n_mels + 2, f_min, f_max, htk)._data
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    t = ensure_tensor(spect)
+
+    def f(a):
+        db = 10.0 * jnp.log10(jnp.maximum(amin, a))
+        db = db - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+        if top_db is not None:
+            db = jnp.maximum(db, db.max() - top_db)
+        return db
+    return apply_op("power_to_db", f, (t,), {})
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype="float32") -> Tensor:
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[:, None]
+    dct = jnp.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct = dct * jnp.sqrt(2.0 / n_mels)
+        dct = dct.at[0].multiply(1.0 / jnp.sqrt(2.0))
+    return Tensor(dct.T.astype(dtype))  # [n_mels, n_mfcc]
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True,
+               dtype="float32") -> Tensor:
+    N = win_length if fftbins else win_length - 1
+    n = jnp.arange(win_length, dtype=jnp.float32)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * n / N)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * n / N)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * n / N)
+             + 0.08 * jnp.cos(4 * math.pi * n / N))
+    elif window in ("rect", "rectangular", "boxcar", "ones"):
+        w = jnp.ones((win_length,), jnp.float32)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(w.astype(dtype))

@@ -1,0 +1,27 @@
+"""paddle.iinfo / paddle.finfo parity."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class iinfo:
+    def __init__(self, dtype):
+        info = np.iinfo(np.dtype(str(dtype).replace("paddle.", "")))
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+
+class finfo:
+    def __init__(self, dtype):
+        d = str(dtype).replace("paddle.", "")
+        info = jnp.finfo(jnp.dtype(d))
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+        self.bits = int(info.bits)
+        self.dtype = d

@@ -1,0 +1,111 @@
+"""paddle.geometric (reference python/paddle/geometric/: graph message
+passing + segment reductions).
+
+TPU-native: the reference's fused CUDA send/recv kernels become
+jax.ops.segment_* reductions (XLA scatter-reduce) — static-shape friendly
+and differentiable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply_op, ensure_tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "send_uv"]
+
+
+def _seg(name, reducer, data, ids, num=None):
+    d, i = ensure_tensor(data), ensure_tensor(ids)
+    n = num if num is not None else int(jnp.max(i._data)) + 1
+
+    def f(a, idx):
+        return reducer(a, idx.astype(jnp.int32), num_segments=n)
+    return apply_op(name, f, (d, i), {})
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _seg("segment_sum", jax.ops.segment_sum, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    d, i = ensure_tensor(data), ensure_tensor(segment_ids)
+    n = int(jnp.max(i._data)) + 1
+
+    def f(a, idx):
+        return _mean_reduce(a, idx.astype(jnp.int32), n)
+    return apply_op("segment_mean", f, (d, i), {})
+
+
+def segment_max(data, segment_ids, name=None):
+    return _seg("segment_max", jax.ops.segment_max, data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _seg("segment_min", jax.ops.segment_min, data, segment_ids)
+
+
+_REDUCERS = {"sum": jax.ops.segment_sum, "add": jax.ops.segment_sum,
+             "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+
+
+def _mean_reduce(msgs, di, n):
+    tot = jax.ops.segment_sum(msgs, di, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones_like(msgs), di, num_segments=n)
+    return tot / jnp.maximum(cnt, 1.0)
+
+
+def _reduce(msgs, di, n, reduce_op):
+    if reduce_op == "mean":
+        return _mean_reduce(msgs, di, n)
+    return _REDUCERS[reduce_op](msgs, di, num_segments=n)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size=None, name=None):
+    """geometric/message_passing/send_recv.py send_u_recv: gather source
+    features along edges, reduce at destinations."""
+    xt = ensure_tensor(x)
+    s, d = ensure_tensor(src_index), ensure_tensor(dst_index)
+    n = int(out_size) if out_size is not None else int(xt.shape[0])
+
+    def f(a, si, di):
+        msgs = a[si.astype(jnp.int32)]
+        return _reduce(msgs, di.astype(jnp.int32), n, reduce_op)
+    return apply_op("send_u_recv", f, (xt, s, d), {})
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size=None, name=None):
+    """Edge-featured variant: combine node features with edge features."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    s, d = ensure_tensor(src_index), ensure_tensor(dst_index)
+    n = int(out_size) if out_size is not None else int(xt.shape[0])
+
+    if message_op not in ("add", "sub", "mul", "div"):
+        raise ValueError(f"unknown message_op {message_op!r}")
+
+    def f(a, e, si, di):
+        u = a[si.astype(jnp.int32)]
+        msgs = {"add": u + e, "sub": u - e, "mul": u * e,
+                "div": u / e}[message_op]
+        return _reduce(msgs, di.astype(jnp.int32), n, reduce_op)
+    return apply_op("send_ue_recv", f, (xt, yt, s, d), {})
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
+    """Per-edge messages from both endpoints (no reduction)."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    s, d = ensure_tensor(src_index), ensure_tensor(dst_index)
+
+    def f(a, b, si, di):
+        u = a[si.astype(jnp.int32)]
+        v = b[di.astype(jnp.int32)]
+        return {"add": u + v, "sub": u - v, "mul": u * v,
+                "div": u / v}[message_op]
+    return apply_op("send_uv", f, (xt, yt, s, d), {})

@@ -1,0 +1,42 @@
+"""paddle.hub parity (local-source only — this build has no network)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_ENTRY = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _ENTRY)
+    if not os.path.exists(path):
+        raise ValueError(f"no {_ENTRY} in {repo_dir!r}; paddle.hub in this "
+                         "offline build supports source='local' only")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
+    if source != "local":
+        raise ValueError("offline build: only source='local'")
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False):
+    return getattr(_load_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    if source != "local":
+        raise ValueError("offline build: only source='local'")
+    return getattr(_load_hubconf(repo_dir), model)(**kwargs)

@@ -1,0 +1,129 @@
+"""paddle.signal (reference python/paddle/signal.py: frame, overlap_add,
+stft, istft) — jnp implementation through the op dispatcher."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .framework.tensor import Tensor
+from .ops.dispatch import apply_op, ensure_tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """signal.py frame: slide windows of frame_length every hop_length."""
+    t = ensure_tensor(x)
+
+    def f(a):
+        n = a.shape[axis]
+        n_frames = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        moved = jnp.moveaxis(a, axis, -1)
+        framed = moved[..., idx]                       # [..., F, L]
+        if axis != 0:
+            # paddle layout axis=-1: [..., frame_length, num_frames]
+            return jnp.swapaxes(framed, -1, -2)
+        # paddle layout axis=0: [num_frames, frame_length, ...]
+        return jnp.moveaxis(framed, (-2, -1), (0, 1))
+    return apply_op("frame", f, (t,), {})
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """signal.py overlap_add: inverse of frame (axis=-1 layout
+    [..., frame_length, n_frames])."""
+    t = ensure_tensor(x)
+
+    def f(a):
+        last_axis = axis != 0
+        if not last_axis:
+            # paddle axis=0 layout [F, L, ...] -> [..., L, F]
+            a = jnp.moveaxis(a, (0, 1), (-1, -2))
+        L, F = a.shape[-2], a.shape[-1]
+        n = (F - 1) * hop_length + L
+        idx = (jnp.arange(F) * hop_length)[:, None] + jnp.arange(L)[None]
+        out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
+        # one scatter-add over the [F, L] index matrix
+        out = out.at[..., idx].add(jnp.swapaxes(a, -1, -2))
+        if not last_axis:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+    return apply_op("overlap_add", f, (t,), {})
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """signal.py stft parity; returns [..., n_fft//2+1, n_frames] complex."""
+    t = ensure_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = ensure_tensor(window)._data if window is not None \
+        else jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (pad, n_fft - win_length - pad))
+
+    def f(a):
+        if center:
+            widths = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, widths, mode=pad_mode)
+        n = a.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = a[..., idx] * win                      # [..., F, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.float32(n_fft))
+        return jnp.swapaxes(spec, -1, -2)               # [..., bins, F]
+    return apply_op("stft", f, (t,), {})
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """signal.py istft parity (overlap-add with window-square norm)."""
+    t = ensure_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = ensure_tensor(window)._data if window is not None \
+        else jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (pad, n_fft - win_length - pad))
+
+    def f(a):
+        spec = jnp.swapaxes(a, -1, -2)                  # [..., F, bins]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.float32(n_fft))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * win
+        F = frames.shape[-2]
+        n = (F - 1) * hop_length + n_fft
+        idx = (jnp.arange(F) * hop_length)[:, None] \
+            + jnp.arange(n_fft)[None]
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        out = out.at[..., idx].add(frames)      # one scatter-add
+        norm = jnp.zeros((n,), jnp.float32).at[idx].add(win * win)
+        out = out / jnp.maximum(norm, 1e-8)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            cur = out.shape[-1]
+            if cur < length:  # frame grid rarely lands exactly on `length`
+                widths = [(0, 0)] * (out.ndim - 1) + [(0, length - cur)]
+                out = jnp.pad(out, widths)
+            else:
+                out = out[..., :length]
+        return out
+    return apply_op("istft", f, (t,), {})

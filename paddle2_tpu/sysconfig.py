@@ -1,0 +1,12 @@
+"""paddle.sysconfig parity."""
+
+import os
+
+
+def get_include():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "include")
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "libs")

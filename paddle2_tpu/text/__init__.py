@@ -1,0 +1,163 @@
+"""paddle.text (reference python/paddle/text/: datasets + viterbi_decode).
+
+viterbi_decode / ViterbiDecoder are fully implemented (lax.scan dynamic
+program). Datasets read LOCAL files only (offline build): Imdb consumes
+the aclImdb tarball, UCIHousing the housing.data file.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+from typing import List, Optional
+
+import numpy as np
+
+from ..io.dataloader import Dataset
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply_op, ensure_tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "UCIHousing"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """text/viterbi_decode.py parity: returns (scores, paths).
+
+    potentials: [B, T, N] emissions; transition_params: [N, N] (+2 rows/
+    cols for BOS/EOS when include_bos_eos_tag); lengths: [B].
+    """
+    import jax
+    import jax.numpy as jnp
+    pot = ensure_tensor(potentials)
+    trans = ensure_tensor(transition_params)
+    lens = ensure_tensor(lengths)
+
+    def f(em, tr, ln):
+        B, T, N = em.shape
+        if include_bos_eos_tag:
+            # last two tags are BOS, EOS (reference convention)
+            bos, eos = N - 2, N - 1
+            start = em[:, 0] + tr[bos][None, :]
+        else:
+            start = em[:, 0]
+
+        def step(carry, t):
+            alpha, history_dummy = carry
+            # alpha: [B, N]; score via best previous tag
+            scores = alpha[:, :, None] + tr[None, :, :] + em[:, t][:, None, :]
+            best_prev = jnp.argmax(scores, axis=1)            # [B, N]
+            alpha_new = jnp.max(scores, axis=1)               # [B, N]
+            # frozen past end-of-sequence
+            active = (t < ln)[:, None]
+            alpha_new = jnp.where(active, alpha_new, alpha)
+            best_prev = jnp.where(active, best_prev,
+                                  jnp.arange(N)[None, :])
+            return (alpha_new, None), best_prev
+
+        (alpha, _), history = jax.lax.scan(
+            step, (start, None), jnp.arange(1, T))
+        if include_bos_eos_tag:
+            alpha = alpha + tr[:, eos][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last = jnp.argmax(alpha, axis=-1)                     # [B]
+
+        def backtrack(carry, bp):
+            tag = carry
+            prev = jnp.take_along_axis(bp, tag[:, None], 1)[:, 0]
+            return prev, tag
+
+        # reverse scan emits tags for times 1..T-1; the final carry is
+        # the tag at time 0
+        first, path_rev = jax.lax.scan(backtrack, last, history,
+                                       reverse=True)
+        paths = jnp.concatenate([first[:, None],
+                                 jnp.swapaxes(path_rev, 0, 1)],
+                                axis=1)                       # [B, T]
+        return scores, paths
+    return apply_op("viterbi_decode", f, (pot, trans, lens), {},
+                    differentiable=False)
+
+
+class ViterbiDecoder:
+    """nn-style wrapper (text/viterbi_decode.py ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        self.transitions = ensure_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+def _require(path, what):
+    if path is None or not os.path.exists(path):
+        raise ValueError(
+            f"{what}: file not found ({path!r}); this offline build cannot "
+            "download datasets — pass the local path")
+    return path
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (text/datasets/imdb.py parity; local aclImdb tar)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        data_file = _require(data_file, "Imdb")
+        # vocabulary spans BOTH splits (reference imdb.py builds word_idx
+        # over train|test) so train/test token ids agree
+        pat_vocab = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        docs: List[List[str]] = []
+        labels: List[int] = []
+        freq = {}
+        with tarfile.open(data_file) as tar:
+            for m in tar.getmembers():
+                match = pat_vocab.match(m.name)
+                if not match:
+                    continue
+                text = tar.extractfile(m).read().decode(
+                    "utf-8", "ignore").lower()
+                toks = re.findall(r"[a-z']+", text)
+                for t in toks:
+                    freq[t] = freq.get(t, 0) + 1
+                if match.group(1) == mode:
+                    docs.append(toks)
+                    labels.append(0 if match.group(2) == "neg" else 1)
+        vocab = [w for w, c in sorted(freq.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))
+                 if c > cutoff]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.asarray([self.word_idx.get(t, unk) for t in d],
+                                np.int64) for d in docs]
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, i):
+        return self.docs[i], int(self.labels[i])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    """Boston housing (text/datasets/uci_housing.py parity; local file)."""
+
+    def __init__(self, data_file=None, mode="train"):
+        data_file = _require(data_file, "UCIHousing")
+        raw = np.loadtxt(data_file).astype("float32")
+        x, y = raw[:, :-1], raw[:, -1:]
+        x = (x - x.mean(0)) / np.maximum(x.std(0), 1e-6)
+        n = int(len(x) * 0.8)
+        if mode == "train":
+            self.x, self.y = x[:n], y[:n]
+        else:
+            self.x, self.y = x[n:], y[n:]
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)

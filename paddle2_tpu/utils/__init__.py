@@ -1,0 +1,21 @@
+"""paddle.utils (reference python/paddle/utils/)."""
+
+from . import unique_name  # noqa: F401
+from . import cpp_extension  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+from .deprecated import deprecated  # noqa: F401
+
+__all__ = ["unique_name", "cpp_extension", "try_import", "deprecated",
+           "run_check"]
+
+
+def run_check():
+    """paddle.utils.run_check parity: verifies the accelerator works."""
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    assert float(y[0, 0]) == 128.0
+    print(f"PaddlePaddle (TPU-native) works on {len(devs)} "
+          f"{devs[0].platform} device(s).")

@@ -1,0 +1,131 @@
+"""paddle.utils.cpp_extension (reference utils/cpp_extension/: the custom
+C++ operator path).
+
+TPU-native custom-op contract: device compute belongs in JAX/Pallas (see
+kernels/), but HOST-side custom ops — tokenizers, samplers, feature
+decoders — compile here with g++ into a shared library bound via ctypes
+(no pybind11 in this image). ``load()`` builds and returns a
+CustomOpLibrary whose ``wrap()`` lifts a C function with the flat ABI
+
+    void op(const float* in, int64_t n, float* out)
+
+into a paddle op: eager calls run directly on numpy buffers; under
+jit.to_static the op crosses into the graph as a jax.pure_callback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["CppExtension", "CUDAExtension", "load", "setup",
+           "CustomOpLibrary", "get_build_directory"]
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.expanduser("~/.cache/paddle2_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def CppExtension(sources: Sequence[str], **kwargs):
+    return {"sources": list(sources), "kind": "cpp", **kwargs}
+
+
+def CUDAExtension(sources: Sequence[str], **kwargs):
+    # no CUDA on TPU hosts: .cu sources are rejected, .cc/.cpp compile
+    cpp = [s for s in sources if not s.endswith((".cu", ".cuh"))]
+    if len(cpp) != len(sources):
+        raise ValueError(
+            "CUDAExtension on the TPU build: CUDA sources have no target; "
+            "express device compute in JAX/Pallas and keep host code in "
+            "C++ (.cc/.cpp)")
+    return {"sources": cpp, "kind": "cpp", **kwargs}
+
+
+def setup(name: str = "", ext_modules=None, **kwargs):
+    """setup() parity: builds each extension into the cache dir."""
+    exts = ext_modules if isinstance(ext_modules, list) else [ext_modules]
+    return [load(name or f"ext{i}", e["sources"])
+            for i, e in enumerate(exts) if e]
+
+
+class CustomOpLibrary:
+    def __init__(self, name: str, path: str):
+        self.name = name
+        self.path = path
+        self._lib = ctypes.CDLL(path)
+
+    def raw(self) -> ctypes.CDLL:
+        return self._lib
+
+    def wrap(self, fn_name: str, out_shape: Optional[Callable] = None,
+             dtype="float32") -> Callable:
+        """Lift `void fn(const T* in, int64_t n, T* out)` into a paddle op.
+
+        out_shape(in_shape) -> output shape (default: same shape).
+        """
+        cfn = getattr(self._lib, fn_name)
+        cfn.restype = None
+        np_dt = np.dtype(dtype)
+        cptr = ctypes.POINTER({
+            "float32": ctypes.c_float, "float64": ctypes.c_double,
+            "int32": ctypes.c_int32, "int64": ctypes.c_int64,
+        }[str(np_dt)])
+        cfn.argtypes = [cptr, ctypes.c_int64, cptr]
+
+        def host_call(arr: np.ndarray) -> np.ndarray:
+            arr = np.ascontiguousarray(arr, np_dt)
+            shape = out_shape(arr.shape) if out_shape else arr.shape
+            out = np.empty(shape, np_dt)
+            cfn(arr.ctypes.data_as(cptr), arr.size,
+                out.ctypes.data_as(cptr))
+            return out
+
+        def op(x):
+            import jax
+            import jax.numpy as jnp
+            from paddle2_tpu.ops.dispatch import apply_op, ensure_tensor
+            t = ensure_tensor(x)
+
+            def f(a):
+                shape = out_shape(a.shape) if out_shape else a.shape
+                return jax.pure_callback(
+                    host_call, jax.ShapeDtypeStruct(shape, np_dt), a)
+            return apply_op(f"custom_{fn_name}", f, (t,), {},
+                            differentiable=False)
+
+        op.__name__ = fn_name
+        return op
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
+         extra_cuda_cflags=None, extra_ldflags=None,
+         extra_include_paths=None, build_directory=None,
+         verbose: bool = False, **kwargs) -> CustomOpLibrary:
+    """utils/cpp_extension/extension_utils.py load() parity: just-in-time
+    g++ build, content-hashed cache."""
+    build_dir = build_directory or get_build_directory()
+    blobs = []
+    for s in sources:
+        with open(s, "rb") as f:
+            blobs.append(f.read())
+    tag = hashlib.sha256(b"".join(blobs)
+                         + repr(extra_cxx_flags).encode()).hexdigest()[:16]
+    out = os.path.join(build_dir, f"{name}_{tag}.so")
+    if not os.path.exists(out):
+        cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+               + [f"-I{p}" for p in (extra_include_paths or [])]
+               + (extra_cxx_flags or []) + list(sources)
+               + ["-o", out + f".{os.getpid()}.tmp"]
+               + (extra_ldflags or []))
+        subprocess.run(cmd, check=True,
+                       capture_output=not verbose)
+        os.replace(out + f".{os.getpid()}.tmp", out)
+    return CustomOpLibrary(name, out)

@@ -1,0 +1,20 @@
+"""paddle.utils.deprecated decorator parity."""
+
+import functools
+import warnings
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 1):
+    def decorator(func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            msg = (f"API {func.__name__!r} is deprecated since {since}"
+                   + (f", use {update_to!r} instead" if update_to else "")
+                   + (f": {reason}" if reason else ""))
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+        return wrapper
+    return decorator

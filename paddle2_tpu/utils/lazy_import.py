@@ -1,0 +1,12 @@
+"""paddle.utils.lazy_import parity."""
+
+import importlib
+
+
+def try_import(module_name: str, err_msg: str = None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or
+                          f"required module {module_name!r} is not "
+                          "installed (offline build: no pip available)")

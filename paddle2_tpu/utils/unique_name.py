@@ -1,0 +1,33 @@
+"""paddle.utils.unique_name parity."""
+
+import contextlib
+import threading
+
+_tls = threading.local()
+
+
+def _counters():
+    if not hasattr(_tls, "c"):
+        _tls.c = {}
+    return _tls.c
+
+
+def generate(key: str) -> str:
+    c = _counters()
+    c[key] = c.get(key, -1) + 1
+    return f"{key}_{c[key]}"
+
+
+def switch(new_generator=None):
+    old = dict(_counters())
+    _tls.c = new_generator or {}
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        _tls.c = old

@@ -1,0 +1,25 @@
+"""paddle.version parity (this framework's own versioning)."""
+
+full_version = "3.0.0-tpu.2"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+istaged = True
+commit = "tpu-native"
+with_pip_cuda_libraries = "OFF"
+
+
+def show():
+    print(f"paddle2_tpu {full_version} (commit {commit}, TPU/XLA backend)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -1,0 +1,194 @@
+"""signal / audio / geometric / text / inference / utils.cpp_extension /
+hub / version / iinfo-finfo (SURVEY §2.2 domain APIs)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.nn as nn
+
+
+# ---------------------------------------------------------------- signal
+
+def test_stft_istft_roundtrip_and_frame():
+    paddle.seed(0)
+    x = paddle.randn([2, 1024])
+    S = paddle.signal.stft(x, n_fft=256, hop_length=64)
+    assert tuple(S.shape) == (2, 129, 17)  # 1+(1024+256-256)//64
+    back = paddle.signal.istft(S, n_fft=256, hop_length=64, length=1024)
+    np.testing.assert_allclose(back.numpy()[:, 128:-128],
+                               x.numpy()[:, 128:-128], atol=1e-4)
+    fr = paddle.signal.frame(x, 128, 64)
+    assert tuple(fr.shape) == (2, 128, 15)
+    ola = paddle.signal.overlap_add(fr, 64)
+    assert tuple(ola.shape) == (2, 1024)
+
+
+def test_stft_differentiable():
+    x = paddle.randn([1, 512])
+    x.stop_gradient = False
+    S = paddle.signal.stft(x, n_fft=128)
+    import jax.numpy as jnp
+    from paddle2_tpu.ops.dispatch import apply_op
+    power = apply_op("p", lambda a: (jnp.abs(a) ** 2).sum(), (S,), {})
+    power.backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+# ---------------------------------------------------------------- audio
+
+def test_audio_mel_mfcc_shapes_and_fbank():
+    from paddle2_tpu.audio import functional as AF
+    fb = AF.compute_fbank_matrix(16000, 256, n_mels=32)
+    assert tuple(fb.shape) == (32, 129)
+    assert float(fb.numpy().min()) >= 0.0
+    # mel scale monotonic + invertible
+    hz = AF.mel_to_hz(AF.hz_to_mel(paddle.to_tensor([440.0])))
+    np.testing.assert_allclose(hz.numpy(), [440.0], rtol=1e-4)
+    mel = paddle.audio.features.MelSpectrogram(sr=16000, n_fft=256,
+                                               n_mels=32)
+    m = mel(paddle.randn([2, 4000]))
+    assert tuple(m.shape)[:2] == (2, 32)
+    mfcc = paddle.audio.features.MFCC(sr=16000, n_mfcc=13, n_mels=32,
+                                      n_fft=256)
+    assert tuple(mfcc(paddle.randn([2, 4000])).shape)[:2] == (2, 13)
+    db = AF.power_to_db(paddle.to_tensor([[1.0, 100.0]]))
+    np.testing.assert_allclose(db.numpy(), [[0.0, 20.0]], atol=1e-5)
+
+
+# ------------------------------------------------------------- geometric
+
+def test_geometric_segments_and_message_passing():
+    x = paddle.to_tensor(np.arange(8, dtype="float32").reshape(4, 2))
+    seg = paddle.to_tensor(np.array([0, 0, 1, 1]))
+    np.testing.assert_allclose(
+        paddle.geometric.segment_sum(x, seg).numpy(), [[2, 4], [10, 12]])
+    np.testing.assert_allclose(
+        paddle.geometric.segment_mean(x, seg).numpy(), [[1, 2], [5, 6]])
+    np.testing.assert_allclose(
+        paddle.geometric.segment_max(x, seg).numpy(), [[2, 3], [6, 7]])
+    src = paddle.to_tensor(np.array([0, 1, 2, 3]))
+    dst = paddle.to_tensor(np.array([1, 1, 0, 0]))
+    out = paddle.geometric.send_u_recv(x, src, dst, "sum")
+    np.testing.assert_allclose(out.numpy()[:2], [[10, 12], [2, 4]])
+    e = paddle.ones([4, 2])
+    out2 = paddle.geometric.send_ue_recv(x, e, src, dst, "add", "sum")
+    np.testing.assert_allclose(out2.numpy()[:2], [[12, 14], [4, 6]])
+    uv = paddle.geometric.send_uv(x, x, src, dst, "add")
+    assert tuple(uv.shape) == (4, 2)
+    # grads flow through segment reductions
+    x.stop_gradient = False
+    paddle.geometric.segment_sum(x, seg).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((4, 2)))
+
+
+# ------------------------------------------------------------------ text
+
+def test_viterbi_decode_chain():
+    # 3 tags + bos/eos = 5; strong diagonal transitions force 0->1->2
+    N = 5
+    trans = np.full((N, N), -1.0, "float32")
+    trans[0, 1] = trans[1, 2] = 2.0
+    trans[3, 0] = 2.0   # BOS -> 0
+    trans[2, 4] = 2.0   # 2 -> EOS
+    em = np.full((1, 3, N), 0.0, "float32")
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(em), paddle.to_tensor(trans),
+        paddle.to_tensor(np.array([3])))
+    assert paths.numpy()[0].tolist() == [0, 1, 2]
+    assert np.isfinite(scores.numpy()).all()
+
+
+def test_text_datasets_require_local_files():
+    with pytest.raises(ValueError, match="offline"):
+        paddle.text.Imdb()
+    with pytest.raises(ValueError, match="offline"):
+        paddle.text.UCIHousing()
+
+
+def test_uci_housing_from_local_file(tmp_path):
+    rs = np.random.RandomState(0)
+    data = np.hstack([rs.rand(50, 13), rs.rand(50, 1) * 50])
+    f = tmp_path / "housing.data"
+    np.savetxt(str(f), data)
+    ds = paddle.text.UCIHousing(str(f), mode="train")
+    assert len(ds) == 40 and ds[0][0].shape == (13,)
+
+
+# ------------------------------------------------------------- inference
+
+def test_inference_predictor_roundtrip(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 4), nn.Tanh())
+    net.eval()
+    prefix = str(tmp_path / "deploy" / "model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[paddle.jit.InputSpec([None, 8])])
+    cfg = paddle.inference.Config(prefix)
+    assert os.path.exists(cfg.prog_file())
+    pred = paddle.inference.create_predictor(cfg)
+    x = np.random.RandomState(0).randn(3, 8).astype("float32")
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(x)
+    outs = pred.run()
+    np.testing.assert_allclose(outs[0], net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+    out_h = pred.get_output_handle(pred.get_output_names()[0])
+    np.testing.assert_allclose(out_h.copy_to_cpu(), outs[0])
+
+
+# ------------------------------------------------- utils / cpp_extension
+
+def test_cpp_extension_custom_op(tmp_path):
+    src = tmp_path / "myop.cc"
+    src.write_text(
+        "#include <cstdint>\n"
+        'extern "C" void double_it(const float* in, int64_t n, '
+        "float* out) {\n"
+        "  for (int64_t i = 0; i < n; ++i) out[i] = in[i] * 2.0f;\n"
+        "}\n")
+    from paddle2_tpu.utils import cpp_extension
+    try:
+        lib = cpp_extension.load("myop", [str(src)],
+                                 build_directory=str(tmp_path))
+    except (RuntimeError, FileNotFoundError):
+        pytest.skip("no C++ toolchain")
+    op = lib.wrap("double_it")
+    x = paddle.to_tensor(np.arange(4, dtype="float32"))
+    np.testing.assert_allclose(op(x).numpy(), [0, 2, 4, 6])
+    # works under jit via pure_callback
+    st = paddle.jit.to_static(lambda t: op(t) + 1.0)
+    np.testing.assert_allclose(st(x).numpy(), [1, 3, 5, 7])
+
+
+def test_utils_misc_and_versions(tmp_path):
+    from paddle2_tpu.utils import unique_name, deprecated, try_import
+    assert unique_name.generate("fc") == "fc_0"
+    assert unique_name.generate("fc") == "fc_1"
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"
+    assert unique_name.generate("fc") == "fc_2"
+
+    @deprecated(since="2.0", update_to="paddle.new")
+    def old():
+        return 42
+    with pytest.warns(DeprecationWarning):
+        assert old() == 42
+    with pytest.raises(ImportError):
+        try_import("definitely_not_a_module_xyz")
+
+    assert paddle.version.full_version
+    assert paddle.iinfo("int32").max == 2**31 - 1
+    assert paddle.finfo("bfloat16").bits == 16
+    assert paddle.sysconfig.get_include().endswith("include")
+
+    # hub local source
+    repo = tmp_path / "hubrepo"
+    repo.mkdir()
+    (repo / "hubconf.py").write_text(
+        "def toy(k=1):\n    'doc'\n    return k * 2\n")
+    assert "toy" in paddle.hub.list(str(repo))
+    assert paddle.hub.load(str(repo), "toy", k=3) == 6
+    assert paddle.hub.help(str(repo), "toy") == "doc"
