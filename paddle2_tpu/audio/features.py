@@ -19,8 +19,9 @@ class Spectrogram(nn.Layer):
                  pad_mode: str = "reflect", dtype: str = "float32"):
         super().__init__()
         self.n_fft = n_fft
-        self.hop_length = hop_length or n_fft // 4
         self.win_length = win_length or n_fft
+        # reference layers.py default: win_length // 4 (not n_fft // 4)
+        self.hop_length = hop_length or self.win_length // 4
         self.power = power
         self.center = center
         self.pad_mode = pad_mode

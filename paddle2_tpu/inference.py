@@ -132,8 +132,13 @@ class Predictor:
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         if inputs is None:
-            inputs = [self._feed[k] for k in self.get_input_names()
-                      if k in self._feed]
+            names = self.get_input_names()
+            missing = [k for k in names if k not in self._feed]
+            if missing:
+                raise ValueError(
+                    f"inputs not fed: {missing}; call copy_from_cpu on "
+                    "every input handle before run()")
+            inputs = [self._feed[k] for k in names]
         outs = self._loaded(*[np.asarray(a) for a in inputs])
         outs = outs if isinstance(outs, (list, tuple)) else [outs]
         self._fetch = {f"out{i}": np.asarray(o.numpy())

@@ -37,8 +37,9 @@ def viterbi_decode(potentials, transition_params, lengths,
     def f(em, tr, ln):
         B, T, N = em.shape
         if include_bos_eos_tag:
-            # last two tags are BOS, EOS (reference convention)
-            bos, eos = N - 2, N - 1
+            # reference convention (viterbi_decode.py:47): LAST row/col is
+            # the start tag, second-to-last is the stop tag
+            bos, eos = N - 1, N - 2
             start = em[:, 0] + tr[bos][None, :]
         else:
             start = em[:, 0]
@@ -124,7 +125,8 @@ class Imdb(Dataset):
                     freq[t] = freq.get(t, 0) + 1
                 if match.group(1) == mode:
                     docs.append(toks)
-                    labels.append(0 if match.group(2) == "neg" else 1)
+                    # reference imdb.py:170-175: pos -> 0, neg -> 1
+                    labels.append(0 if match.group(2) == "pos" else 1)
         vocab = [w for w, c in sorted(freq.items(),
                                       key=lambda kv: (-kv[1], kv[0]))
                  if c > cutoff]

@@ -116,8 +116,18 @@ def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
     for s in sources:
         with open(s, "rb") as f:
             blobs.append(f.read())
+    # headers in the source dirs + include paths participate in the hash
+    # so edits trigger rebuilds
+    hdr_dirs = {os.path.dirname(os.path.abspath(s)) for s in sources}
+    hdr_dirs.update(extra_include_paths or [])
+    for d in sorted(hdr_dirs):
+        for fname in sorted(os.listdir(d)):
+            if fname.endswith((".h", ".hpp", ".hh", ".cuh")):
+                with open(os.path.join(d, fname), "rb") as f:
+                    blobs.append(f.read())
+    key = repr((extra_cxx_flags, extra_ldflags, extra_include_paths))
     tag = hashlib.sha256(b"".join(blobs)
-                         + repr(extra_cxx_flags).encode()).hexdigest()[:16]
+                         + key.encode()).hexdigest()[:16]
     out = os.path.join(build_dir, f"{name}_{tag}.so")
     if not os.path.exists(out):
         cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]

@@ -11,6 +11,13 @@ from .small import (LeNet, AlexNet, SqueezeNet, alexnet, squeezenet1_0,
 from .mobilenet import (MobileNetV1, MobileNetV2, MobileNetV3Small,
                         MobileNetV3Large, mobilenet_v1, mobilenet_v2,
                         mobilenet_v3_small, mobilenet_v3_large)
+from .densenet import (DenseNet, densenet121, densenet161, densenet169,
+                       densenet201, densenet264)
+from .inception_shuffle import (GoogLeNet, googlenet, InceptionV3,
+                                inception_v3, ShuffleNetV2,
+                                shufflenet_v2_x0_25, shufflenet_v2_x0_5,
+                                shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+                                shufflenet_v2_x2_0, shufflenet_v2_swish)
 
 __all__ = [
     "ResNet", "BasicBlock", "BottleneckBlock", "resnet18", "resnet34",
@@ -22,4 +29,9 @@ __all__ = [
     "squeezenet1_1", "MobileNetV1", "MobileNetV2", "MobileNetV3Small",
     "MobileNetV3Large", "mobilenet_v1", "mobilenet_v2",
     "mobilenet_v3_small", "mobilenet_v3_large",
+    "DenseNet", "densenet121", "densenet161", "densenet169",
+    "densenet201", "densenet264", "GoogLeNet", "googlenet",
+    "InceptionV3", "inception_v3", "ShuffleNetV2",
+    "shufflenet_v2_x0_25", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+    "shufflenet_v2_x1_5", "shufflenet_v2_x2_0", "shufflenet_v2_swish",
 ]

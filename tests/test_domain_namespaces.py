@@ -87,12 +87,12 @@ def test_geometric_segments_and_message_passing():
 # ------------------------------------------------------------------ text
 
 def test_viterbi_decode_chain():
-    # 3 tags + bos/eos = 5; strong diagonal transitions force 0->1->2
+    # 3 tags + eos(N-2)/bos(N-1) = 5 (reference: LAST row is start tag)
     N = 5
     trans = np.full((N, N), -1.0, "float32")
     trans[0, 1] = trans[1, 2] = 2.0
-    trans[3, 0] = 2.0   # BOS -> 0
-    trans[2, 4] = 2.0   # 2 -> EOS
+    trans[4, 0] = 2.0   # BOS (last row) -> 0
+    trans[2, 3] = 2.0   # 2 -> EOS (second-to-last col)
     em = np.full((1, 3, N), 0.0, "float32")
     scores, paths = paddle.text.viterbi_decode(
         paddle.to_tensor(em), paddle.to_tensor(trans),

@@ -161,3 +161,19 @@ def test_early_stopping():
     assert not es.model.stop_training  # one bad epoch < patience
     es.on_epoch_end(2, {"loss": 1.3})
     assert es.model.stop_training
+
+
+def test_model_zoo_round2():
+    """DenseNet/GoogLeNet/InceptionV3/ShuffleNetV2 construct; the light
+    ones forward on small inputs."""
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 3, 64, 64).astype("float32"))
+    m = models.shufflenet_v2_x0_25(num_classes=3)
+    m.eval()
+    assert tuple(m(x).shape) == (1, 3)
+    for fn in (models.densenet121, models.googlenet, models.inception_v3,
+               models.shufflenet_v2_swish):
+        net = fn(num_classes=2)
+        assert len(net.parameters()) > 0
+    with pytest.raises(ValueError):
+        models.densenet121(pretrained=True)
