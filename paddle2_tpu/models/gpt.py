@@ -299,6 +299,56 @@ class GPTForCausalLM(nn.Layer):
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())
 
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 1.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 eos_token_id: Optional[int] = None):
+        """Autoregressive decoding (PaddleNLP generate() capability).
+
+        Greedy when temperature == 0, otherwise temperature/top-k/top-p
+        sampling through the framework RNG (seeded by paddle.seed). Each
+        step re-runs the jit-cached forward on the grown sequence —
+        position-stable because the prompt is left-aligned; a static-shape
+        KV-cache decode loop is the next optimization.
+        """
+        from ..framework import core
+        from ..framework import random as fr
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(jnp.asarray(input_ids, jnp.int32))
+        arr = ids._data.astype(jnp.int32)
+        if arr.ndim == 1:
+            arr = arr[None]
+        max_pos = self.cfg.max_position_embeddings
+        finished = jnp.zeros((arr.shape[0],), bool)
+        with core.no_grad():
+            for _ in range(max_new_tokens):
+                window = arr[:, -max_pos:]
+                logits = self(Tensor(window))
+                step = logits._data[:, -1].astype(jnp.float32)  # [B, V]
+                if temperature == 0.0:
+                    nxt = jnp.argmax(step, axis=-1)
+                else:
+                    step = step / max(temperature, 1e-6)
+                    if top_k is not None:
+                        kth = jnp.sort(step, axis=-1)[:, -int(top_k)]
+                        step = jnp.where(step < kth[:, None], -jnp.inf,
+                                         step)
+                    if top_p is not None:
+                        from ..ops.extra import nucleus_filter_logits
+                        step = nucleus_filter_logits(
+                            step, jnp.full((step.shape[0],), top_p,
+                                           jnp.float32))
+                    nxt = jax.random.categorical(fr.next_key(), step)
+                if eos_token_id is not None:
+                    # finished rows pad with eos (PaddleNLP semantics)
+                    nxt = jnp.where(finished, eos_token_id, nxt)
+                    finished = finished | (nxt == eos_token_id)
+                arr = jnp.concatenate(
+                    [arr, nxt[:, None].astype(jnp.int32)], axis=1)
+                if eos_token_id is not None and bool(jnp.all(finished)):
+                    break
+        return Tensor(arr, stop_gradient=True)
+
 
 def gpt3_1p3b(**overrides) -> GPTConfig:
     """BASELINE config 4 geometry (GPT-3 1.3B)."""

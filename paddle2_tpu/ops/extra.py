@@ -440,6 +440,24 @@ def imag(x, name=None):
 
 # ----------------------------------------------------------------- search
 
+def _nucleus_keep_mask(sorted_probs, p):
+    """Keep-mask over DESC-sorted probs: smallest prefix reaching mass p
+    (the single source of the nucleus boundary rule)."""
+    cum = jnp.cumsum(sorted_probs, -1)
+    return cum - sorted_probs < p[..., None]
+
+
+def nucleus_filter_logits(logits, p):
+    """Mask logits outside the top-p nucleus to -inf (per row)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    order = jnp.argsort(-probs, axis=-1)
+    sp = jnp.take_along_axis(probs, order, -1)
+    keep_sorted = _nucleus_keep_mask(sp, p)
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(logits.shape[0])[:, None], order].set(keep_sorted)
+    return jnp.where(keep, logits, -jnp.inf)
+
+
 def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
     """Nucleus sampling over the last axis (tensor/search.py
     top_p_sampling): keeps the smallest prefix of sorted probs whose mass
@@ -453,8 +471,7 @@ def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
     def f(probs, p):
         order = jnp.argsort(-probs, axis=-1)
         sorted_p = jnp.take_along_axis(probs, order, -1)
-        cum = jnp.cumsum(sorted_p, -1)
-        keep = cum - sorted_p < p[..., None]
+        keep = _nucleus_keep_mask(sorted_p, p)
         filt = jnp.where(keep, sorted_p, 0.0)
         filt = filt / jnp.maximum(filt.sum(-1, keepdims=True), 1e-30)
         idx_sorted = jax.random.categorical(key, jnp.log(

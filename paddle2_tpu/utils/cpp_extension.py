@@ -119,8 +119,10 @@ def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
     # headers in the source dirs + include paths participate in the hash
     # so edits trigger rebuilds
     hdr_dirs = {os.path.dirname(os.path.abspath(s)) for s in sources}
-    hdr_dirs.update(extra_include_paths or [])
+    hdr_dirs.update(os.path.abspath(p) for p in (extra_include_paths or []))
     for d in sorted(hdr_dirs):
+        if not os.path.isdir(d):
+            continue  # g++ ignores missing -I dirs; so does the hash
         for fname in sorted(os.listdir(d)):
             if fname.endswith((".h", ".hpp", ".hh", ".cuh")):
                 with open(os.path.join(d, fname), "rb") as f:

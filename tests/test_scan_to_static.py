@@ -86,3 +86,25 @@ def test_discovery_via_partial_and_method():
     loss = st(ids)
     loss.backward()
     assert all(p.grad is not None for p in m.parameters())
+
+
+def test_gpt_generate_greedy_and_sampling():
+    paddle.seed(0)
+    m = _mk(True)
+    m.eval()
+    prompt = paddle.to_tensor(np.array([[1, 2, 3]], "int32"))
+    out = m.generate(prompt, max_new_tokens=5, temperature=0.0)
+    assert tuple(out.shape) == (1, 8)
+    # greedy is deterministic
+    out2 = m.generate(prompt, max_new_tokens=5, temperature=0.0)
+    np.testing.assert_array_equal(out.numpy(), out2.numpy())
+    # sampling with top-k/top-p produces valid token ids
+    s = m.generate(prompt, max_new_tokens=4, temperature=0.8, top_k=10,
+                   top_p=0.9)
+    assert tuple(s.shape) == (1, 7)
+    assert (s.numpy() >= 0).all() and (s.numpy() < 128).all()
+    # eos early stop
+    first_greedy = int(out.numpy()[0, 3])
+    e = m.generate(prompt, max_new_tokens=5, temperature=0.0,
+                   eos_token_id=first_greedy)
+    assert e.shape[1] == 4  # stopped right after emitting eos
