@@ -1,0 +1,72 @@
+"""paddle.summary (reference python/paddle/hapi/model_summary.py:28):
+layer-by-layer table of output shapes and parameter counts, produced by a
+forward pass with hooks."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..nn.layer.layers import Layer
+
+__all__ = ["summary"]
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Prints the per-layer table; returns {'total_params', 'trainable_params'}."""
+    from .. import zeros, to_tensor
+
+    rows = []
+    hooks = []
+
+    def register(layer: Layer, prefix=""):
+        for name, child in layer.named_children():
+            full = f"{prefix}{name}"
+            if list(child.named_children()):
+                register(child, full + ".")
+            else:
+                def hook(l, inputs, output=None, _full=full):
+                    out = output
+                    shape = list(getattr(out, "shape", [])) \
+                        if not isinstance(out, (tuple, list)) \
+                        else [list(getattr(o, "shape", [])) for o in out]
+                    n = sum(int(np.prod(p.shape)) for p in
+                            l.parameters(include_sublayers=False))
+                    rows.append((f"{type(l).__name__} ({_full})",
+                                 shape, n))
+                hooks.append(child.register_forward_post_hook(
+                    lambda l, i, o, _f=full: hook(l, i, o, _f)))
+
+    register(net)
+    try:
+        if input is not None:
+            x = input if isinstance(input, (tuple, list)) else [input]
+            net(*x)
+        elif input_size is not None:
+            sizes = input_size if isinstance(input_size, list) and \
+                isinstance(input_size[0], (list, tuple)) else [input_size]
+            dts = dtypes or ["float32"] * len(sizes)
+            args = [zeros([d if d is not None and d > 0 else 1
+                           for d in s], dtype=dt)
+                    for s, dt in zip(sizes, dts)]
+            net(*args)
+        else:
+            raise ValueError("summary needs input_size or input")
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    width = max([len(r[0]) for r in rows] + [20])
+    print(f"{'Layer (type)':<{width}}  {'Output Shape':<24} {'Params':>12}")
+    print("-" * (width + 40))
+    for name, shape, n in rows:
+        print(f"{name:<{width}}  {str(shape):<24} {n:>12,}")
+    print("-" * (width + 40))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

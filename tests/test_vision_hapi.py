@@ -177,3 +177,11 @@ def test_model_zoo_round2():
         assert len(net.parameters()) > 0
     with pytest.raises(ValueError):
         models.densenet121(pretrained=True)
+
+
+def test_paddle_summary(capsys):
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    info = paddle.summary(net, (4, 8))
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
+    out = capsys.readouterr().out
+    assert "Total params" in out and "Linear" in out
