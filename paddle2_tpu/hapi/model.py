@@ -322,7 +322,13 @@ class Model:
         self.network.eval()
 
     def summary(self, input_size=None, dtype=None):
-        """model.py:2200: parameter-count summary dict."""
+        """model.py:2200 parity: per-layer table + parameter tallies
+        (delegates to hapi.model_summary)."""
+        if input_size is not None or self._inputs is not None:
+            from .model_summary import summary as _summary
+            return _summary(self.network,
+                            input_size if input_size is not None
+                            else self._inputs, dtypes=dtype)
         total = 0
         trainable = 0
         for p in self.network.parameters():

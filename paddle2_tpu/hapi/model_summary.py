@@ -13,30 +13,56 @@ from ..nn.layer.layers import Layer
 __all__ = ["summary"]
 
 
+def _to_shape_list(input_size):
+    """Normalize input_size (tuple | list | InputSpec | list thereof) to a
+    list of concrete shape lists."""
+    from ..jit.api import InputSpec
+
+    def one(s):
+        if isinstance(s, InputSpec):
+            return [d if isinstance(d, int) and d > 0 else 1
+                    for d in s.shape]
+        return [d if d is not None and d > 0 else 1 for d in s]
+
+    if isinstance(input_size, InputSpec):
+        return [one(input_size)]
+    if isinstance(input_size, list) and input_size and \
+            isinstance(input_size[0], (list, tuple, InputSpec)):
+        return [one(s) for s in input_size]
+    return [one(input_size)]
+
+
 def summary(net: Layer, input_size=None, dtypes=None, input=None):
     """Prints the per-layer table; returns {'total_params', 'trainable_params'}."""
-    from .. import zeros, to_tensor
+    from .. import zeros
 
     rows = []
     hooks = []
 
+    def make_hook(full):
+        def hook(l, inputs, output=None):
+            shape = list(getattr(output, "shape", [])) \
+                if not isinstance(output, (tuple, list)) \
+                else [list(getattr(o, "shape", [])) for o in output]
+            n = sum(int(np.prod(p.shape)) for p in
+                    l.parameters(include_sublayers=False))
+            rows.append((f"{type(l).__name__} ({full})", shape, n))
+        return hook
+
     def register(layer: Layer, prefix=""):
-        for name, child in layer.named_children():
+        children = list(layer.named_children())
+        if not children and prefix == "":
+            # leaf model: the root itself is the one table row
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(type(layer).__name__.lower())))
+            return
+        for name, child in children:
             full = f"{prefix}{name}"
             if list(child.named_children()):
                 register(child, full + ".")
             else:
-                def hook(l, inputs, output=None, _full=full):
-                    out = output
-                    shape = list(getattr(out, "shape", [])) \
-                        if not isinstance(out, (tuple, list)) \
-                        else [list(getattr(o, "shape", [])) for o in out]
-                    n = sum(int(np.prod(p.shape)) for p in
-                            l.parameters(include_sublayers=False))
-                    rows.append((f"{type(l).__name__} ({_full})",
-                                 shape, n))
                 hooks.append(child.register_forward_post_hook(
-                    lambda l, i, o, _f=full: hook(l, i, o, _f)))
+                    make_hook(full)))
 
     register(net)
     try:
@@ -44,12 +70,18 @@ def summary(net: Layer, input_size=None, dtypes=None, input=None):
             x = input if isinstance(input, (tuple, list)) else [input]
             net(*x)
         elif input_size is not None:
-            sizes = input_size if isinstance(input_size, list) and \
-                isinstance(input_size[0], (list, tuple)) else [input_size]
-            dts = dtypes or ["float32"] * len(sizes)
-            args = [zeros([d if d is not None and d > 0 else 1
-                           for d in s], dtype=dt)
-                    for s, dt in zip(sizes, dts)]
+            sizes = _to_shape_list(input_size)
+            if dtypes is None:
+                dts = ["float32"] * len(sizes)
+            elif isinstance(dtypes, str):
+                dts = [dtypes] * len(sizes)  # one dtype broadcasts
+            else:
+                dts = list(dtypes)
+                if len(dts) != len(sizes):
+                    raise ValueError(
+                        f"dtypes has {len(dts)} entries for "
+                        f"{len(sizes)} inputs")
+            args = [zeros(s, dtype=dt) for s, dt in zip(sizes, dts)]
             net(*args)
         else:
             raise ValueError("summary needs input_size or input")
