@@ -28,7 +28,8 @@ import numpy as np
 from ...framework.tensor import Tensor
 from .pp_layers import PipelineLayer
 
-__all__ = ["PipelineParallel", "schedule_1f1b", "schedule_gpipe"]
+__all__ = ["PipelineParallel", "schedule_1f1b", "schedule_gpipe",
+           "schedule_zb"]
 
 
 # --------------------------------------------------------------------------
@@ -65,14 +66,45 @@ def schedule_gpipe(num_stages: int, num_micro: int) -> List[List[Tuple[str, int]
             for _ in range(num_stages)]
 
 
+def schedule_zb(num_stages: int, num_micro: int) -> List[List[Tuple[str, int]]]:
+    """Zero-bubble (ZB-H1 family, reference zero_bubble pipeline): the
+    backward splits into B (input/activation grad — the only part the
+    PREVIOUS stage waits on) and W (weight grad — free to fill bubbles).
+
+    Per stage: 1F1B-style warmup + F/B steady state, with each W slotted
+    one position after its B once the stage is past its warmup debt, and
+    remaining Ws draining at the end — B releases the upstream dependency
+    immediately, so the cooldown bubble of 1F1B fills with W work.
+    """
+    S, M = num_stages, num_micro
+    out = []
+    for s in range(S):
+        warm = min(S - 1 - s, M)
+        ops: List[Tuple[str, int]] = [("F", i) for i in range(warm)]
+        nf, nb, nw = warm, 0, 0
+        while nf < M:
+            ops.append(("F", nf)); nf += 1
+            ops.append(("B", nb)); nb += 1
+            if nb - nw > warm:  # stage is past its warmup debt: emit a W
+                ops.append(("W", nw)); nw += 1
+        while nb < M:
+            ops.append(("B", nb)); nb += 1
+            if nw < nb:
+                ops.append(("W", nw)); nw += 1
+        while nw < M:
+            ops.append(("W", nw)); nw += 1
+        out.append(ops)
+    return out
+
+
 def _tick_trace(per_stage: List[List[Tuple[str, int]]],
                 num_stages: int) -> List[Tuple[int, int, str, int]]:
     """Execute per-stage programs under dataflow constraints, returning the
     global order [(tick, stage, op, mb)].
 
-    F(s, m) needs F(s-1, m) done; B(s, m) needs F(s, m) and B(s+1, m) done.
-    Each stage runs at most one op per tick — the single-controller stand-in
-    for real per-rank concurrency.
+    F(s, m) needs F(s-1, m) done; B(s, m) needs F(s, m) and B(s+1, m) done;
+    W(s, m) needs B(s, m) done. Each stage runs at most one op per tick —
+    the single-controller stand-in for real per-rank concurrency.
     """
     S = num_stages
     ptr = [0] * S
@@ -87,7 +119,8 @@ def _tick_trace(per_stage: List[List[Tuple[str, int]]],
                 continue
             op, m = per_stage[s][ptr[s]]
             need = (("F", s - 1, m) if op == "F" and s > 0 else None,
-                    ("B", s + 1, m) if op == "B" and s < S - 1 else None)
+                    ("B", s + 1, m) if op == "B" and s < S - 1 else None,
+                    ("B", s, m) if op == "W" else None)
             if all(n is None or n in done for n in need):
                 fired.append((s, op, m))
         if not fired:
@@ -125,9 +158,12 @@ class PipelineParallel:
             self.schedule = "1F1B"
         elif norm in ("GPIPE", "FTHENB"):  # reference name: F-then-B
             self.schedule = "GPIPE"
+        elif norm in ("ZB", "ZBH1", "ZEROBUBBLE"):
+            self.schedule = "ZB"
         else:
             raise ValueError(f"unknown pipeline schedule {schedule!r}; "
-                             "expected '1F1B' or 'GPipe'/'F-then-B'")
+                             "expected '1F1B', 'GPipe'/'F-then-B', or "
+                             "'ZB'/'ZBH1'")
         self.schedule_log: List[Tuple[int, int, str, int]] = []
         self.peak_live_fwd: Dict[int, int] = {}
         self._boundary_grad: Dict[Tuple[int, int], Tensor] = {}
@@ -215,7 +251,8 @@ class PipelineParallel:
 
         S, V = self.num_stages, layers._vpp
         n_parts = S * V
-        gen = schedule_gpipe if self.schedule == "GPIPE" else schedule_1f1b
+        gen = {"GPIPE": schedule_gpipe, "ZB": schedule_zb}.get(
+            self.schedule, schedule_1f1b)
         # virtual parts execute as a longer pipeline for scheduling purposes
         per_stage = gen(n_parts, M)
         trace = _tick_trace(per_stage, n_parts)
@@ -227,6 +264,7 @@ class PipelineParallel:
         live = [0] * n_parts
         peak = [0] * n_parts
         self._boundary_grad = {}
+        self._pending_w: Dict[Tuple[int, int], Tensor] = {}
 
         for tick, part, op, m in trace:
             stage, chunk = part % S, part // S
@@ -246,8 +284,8 @@ class PipelineParallel:
                 saved[(part, m)] = (x_in, out)
                 live[part] += 1
                 peak[part] = max(peak[part], live[part])
-            else:  # backward
-                x_in, out = saved.pop((part, m))
+            elif op == "B":
+                x_in, out = saved[(part, m)]
                 if part == n_parts - 1:
                     seed = Tensor(jnp.full(out.shape or (),
                                            1.0 / M, out._data.dtype))
@@ -259,14 +297,44 @@ class PipelineParallel:
                 else:
                     nxt_in_grad = self._boundary_grad.pop((part + 1, m))
                     seed = nxt_in_grad
-                out.backward(grad_tensor=seed, retain_graph=False)
-                if x_in is not None:
-                    g = x_in.grad
-                    if g is None:
-                        raise RuntimeError(
-                            f"stage boundary {part} produced no input grad")
-                    self._boundary_grad[(part, m)] = g
+                if self.schedule == "ZB":
+                    # zero-bubble split: B produces ONLY the input grad
+                    # (what the upstream stage waits on); weight grads are
+                    # the deferred W op. The graph is retained until W.
+                    from ...autograd.tape import grad as tape_grad
+                    if x_in is not None:
+                        (g,) = tape_grad([out], [x_in],
+                                         grad_outputs=[seed],
+                                         retain_graph=True,
+                                         allow_unused=True)
+                        if g is None:
+                            raise RuntimeError(
+                                f"stage boundary {part} produced no "
+                                f"input grad")
+                        self._boundary_grad[(part, m)] = g
+                    self._pending_w[(part, m)] = seed
+                else:
+                    saved.pop((part, m))
+                    out.backward(grad_tensor=seed, retain_graph=False)
+                    if x_in is not None:
+                        g = x_in.grad
+                        if g is None:
+                            raise RuntimeError(
+                                f"stage boundary {part} produced no "
+                                f"input grad")
+                        self._boundary_grad[(part, m)] = g
                 live[part] -= 1
+            else:  # "W": deferred weight-grad half of the zero-bubble split
+                from ...autograd.tape import grad as tape_grad
+                x_in, out = saved.pop((part, m))
+                seed = self._pending_w.pop((part, m))
+                params = [p for l in layers.stage_layers(stage, chunk)
+                          for p in l.parameters() if not p.stop_gradient]
+                gs = tape_grad([out], params, grad_outputs=[seed],
+                               retain_graph=False, allow_unused=True)
+                for p, g in zip(params, gs):
+                    if g is not None:
+                        p._accumulate_grad(g._data)
 
         self.peak_live_fwd = {p: peak[p] for p in range(n_parts)}
 
