@@ -20,6 +20,7 @@ from ..sharding import ShardedOptimizer, group_sharded_parallel
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
 from .pipeline_parallel import PipelineParallel
 from .elastic import ElasticManager, ElasticStatus
+from .spmd_pipeline import pipeline_spmd
 
 __all__ = ["init", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
@@ -29,7 +30,8 @@ __all__ = ["init", "DistributedStrategy", "distributed_model",
            "ShardedOptimizer", "group_sharded_parallel", "worker_index",
            "worker_num", "is_first_worker", "meta_parallel",
            "LayerDesc", "SharedLayerDesc", "PipelineLayer",
-           "PipelineParallel", "ElasticManager", "ElasticStatus"]
+           "PipelineParallel", "ElasticManager", "ElasticStatus",
+           "pipeline_spmd"]
 
 
 class DistributedStrategy:

@@ -1,0 +1,116 @@
+"""Compiled SPMD pipeline: the whole microbatch pipeline as ONE XLA
+program over the 'pp' mesh axis.
+
+The eager executor in pipeline_parallel.py emulates per-rank schedules in
+Python; this module is the TPU-native execution path for HOMOGENEOUS
+stages (e.g. a transformer block stack): stage parameters live stacked on
+a leading axis sharded over 'pp' (each device holds its stage), and a
+single `shard_map`-ped scan runs the classic GPipe wavefront — every tick
+each device applies its stage and `lax.ppermute`s the activation to the
+next device over ICI. Forward AND backward are differentiated/compiled by
+XLA as one program, so there is no per-microbatch Python dispatch at all.
+
+Parity target: the reference's per-rank NCCL p2p pipeline
+(fleet/meta_parallel/pipeline_parallel.py) — re-expressed as a collective
+program the way the scaling-book prescribes for TPU pipelining.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .. import mesh as mesh_mod
+
+__all__ = ["pipeline_spmd"]
+
+
+def _local_body(params, x_micro, *, stage_fn, n_stages, n_micro, axis):
+    """Per-device program. params: this device's stage params (leading
+    stage axis already sliced to size 1 by shard_map). x_micro:
+    [M, B, ...] microbatches (stage 0's input; other stages ignore it).
+    Returns [M, B, ...] outputs (valid on the LAST stage's shard)."""
+    s = jax.lax.axis_index(axis)
+    S, M = n_stages, n_micro
+    T = M + S - 1
+    p_local = jax.tree_util.tree_map(lambda a: a[0], params)
+    zero = jnp.zeros_like(x_micro[0])
+    outs0 = jnp.zeros_like(x_micro)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        act, outs = carry
+        m = t - s                       # microbatch index at this stage
+        valid = (m >= 0) & (m < M)
+        m_c = jnp.clip(m, 0, M - 1)
+        inp = jnp.where(s == 0, x_micro[jnp.clip(t, 0, M - 1)], act)
+        y = stage_fn(p_local, inp)
+        y = jnp.where(valid, y, zero)
+        outs = jnp.where(valid & (s == S - 1),
+                         outs.at[m_c].set(y), outs)
+        act_next = jax.lax.ppermute(y, axis, perm)
+        return (act_next, outs), None
+
+    # the carry becomes device-varying (ppermute / stage writes): mark the
+    # replicated initial values as varying so scan's carry types match
+    def _varying(v):
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(v, (axis,), to="varying")
+        return jax.lax.pvary(v, (axis,))
+
+    (act, outs), _ = jax.lax.scan(tick, (_varying(zero), _varying(outs0)),
+                                  jnp.arange(T))
+    # only the LAST stage wrote outputs; everyone else holds zeros — the
+    # psum replicates the result across the ring (one all-reduce of the
+    # final activations, the cross-stage "gather" of the reference's p2p)
+    return jax.lax.psum(outs, axis)
+
+
+def pipeline_spmd(stage_fn: Callable, stacked_params, x_micro,
+                  mesh_axis: str = "pp"):
+    """Run `stage_fn(stage_params, x) -> y` as a compiled GPipe pipeline.
+
+    stacked_params: pytree whose leaves have a leading stage axis of size
+    S (the 'pp' mesh degree) — sharded over `mesh_axis` inside the
+    program, so each device computes with ONLY its stage's weights.
+    x_micro: [M, B, ...] microbatches. Returns [M, B, ...] outputs of the
+    last stage. Differentiable end-to-end (scan + ppermute transpose).
+    """
+    mesh = mesh_mod.get_mesh()
+    S = int(mesh.shape[mesh_axis])
+    M = int(x_micro.shape[0])
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != S:
+            raise ValueError(
+                f"stacked param leading axis {leaf.shape[0]} != pipeline "
+                f"degree {S} (mesh axis {mesh_axis!r}); each device must "
+                "hold exactly one stage")
+
+    # compiled-program cache (repo pattern: collective.py _kernel_cache) —
+    # repeat calls with the same geometry reuse the jitted executable
+    treedef = jax.tree_util.tree_structure(stacked_params)
+    avals = tuple((tuple(l.shape), str(l.dtype))
+                  for l in jax.tree_util.tree_leaves(stacked_params))
+    key = (id(mesh), mesh_axis, stage_fn, treedef, avals,
+           tuple(x_micro.shape), str(x_micro.dtype))
+    fn = _PIPE_CACHE.get(key)
+    if fn is None:
+        param_specs = jax.tree_util.tree_map(
+            lambda a: P(mesh_axis, *([None] * (a.ndim - 1))),
+            stacked_params)
+        body = partial(_local_body, stage_fn=stage_fn, n_stages=S,
+                       n_micro=M, axis=mesh_axis)
+        fn = jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(param_specs, P()),
+            out_specs=P()))
+        _PIPE_CACHE[key] = fn
+    return fn(stacked_params, x_micro)
+
+
+_PIPE_CACHE: Dict[Tuple, Any] = {}
