@@ -125,12 +125,24 @@ class GPTAttention(nn.Layer):
         data = data._data if isinstance(data, Tensor) else jnp.asarray(data)
         w._replace_data(jax.device_put(data, w._data.sharding))
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
+        """cache: optional (k, v) of past tokens [b, s_past, H, D] —
+        autoregressive decode appends this step's k/v and attends over the
+        full prefix (causal stays correct: our sdpa is bottom-right
+        aligned for s_q < s_k). Returns out, or (out, new_cache) when a
+        cache (possibly empty tuple) is passed."""
         cfg = self.cfg
         b, s, h = x.shape
         qkv = self.qkv(x)  # [b, s, 3h] (mp-sharded when TP)
         qkv = qkv.reshape([b, s, 3, cfg.num_heads, cfg.head_dim])
         q, k, v = qkv.unbind(axis=2)
+        new_cache = None
+        if cache is not None:
+            if len(cache) == 2:
+                from ..ops.manipulation import concat
+                k = concat([cache[0], k], axis=1)
+                v = concat([cache[1], v], axis=1)
+            new_cache = (k, v)
         if cfg.context_parallel != "none":
             if cfg.attention_dropout_prob > 0.0 and self.training:
                 raise ValueError(
@@ -146,7 +158,8 @@ class GPTAttention(nn.Layer):
                 q, k, v, is_causal=True,
                 dropout_p=cfg.attention_dropout_prob, training=self.training)
         out = out.reshape([b, s, h])
-        return self.out_proj(out)
+        out = self.out_proj(out)
+        return (out, new_cache) if cache is not None else out
 
 
 class GPTMLP(nn.Layer):
@@ -170,7 +183,12 @@ class GPTBlock(nn.Layer):
         self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln_1(x), cache=cache)
+            x = x + self.dropout(a)
+            x = x + self.dropout(self.mlp(self.ln_2(x)))
+            return _seq_constrain(x, self.cfg), new_cache
         x = x + self.dropout(self.attn(self.ln_1(x)))
         x = x + self.dropout(self.mlp(self.ln_2(x)))
         return _seq_constrain(x, self.cfg)
@@ -269,6 +287,21 @@ class GPTModel(nn.Layer):
                 x = block(x)
         return x
 
+    def decode_step(self, input_ids, caches, position_offset: int):
+        """KV-cached decode: run only the NEW tokens through the trunk,
+        appending to per-layer (k, v) caches. caches: list of per-block
+        tuples (() on the first/prefill call)."""
+        b, s = input_ids.shape
+        pos = Tensor(jnp.arange(position_offset, position_offset + s,
+                                dtype=jnp.int32)[None, :])
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = _seq_constrain(self.drop(x), self.cfg)
+        new_caches = []
+        for block, cache in zip(self.h, caches):
+            x, c = block(x, cache=cache)
+            new_caches.append(c)
+        return self.ln_f(x), new_caches
+
 
 class GPTForCausalLM(nn.Layer):
     """Trunk + LM head (tied to wte by default, like the reference zoo)."""
@@ -283,12 +316,14 @@ class GPTForCausalLM(nn.Layer):
                                          cfg.initializer_range),
                                      bias_attr=False)
 
+    def _head(self, hidden):
+        if self.cfg.tie_word_embeddings:
+            return F.linear(hidden, self.gpt.wte.weight.T)
+        return self.lm_head(hidden)
+
     def forward(self, input_ids, labels=None):
         hidden = self.gpt(input_ids)
-        if self.cfg.tie_word_embeddings:
-            logits = F.linear(hidden, self.gpt.wte.weight.T)
-        else:
-            logits = self.lm_head(hidden)
+        logits = self._head(hidden)
         if labels is None:
             return logits
         loss = F.cross_entropy(
@@ -306,10 +341,12 @@ class GPTForCausalLM(nn.Layer):
         """Autoregressive decoding (PaddleNLP generate() capability).
 
         Greedy when temperature == 0, otherwise temperature/top-k/top-p
-        sampling through the framework RNG (seeded by paddle.seed). Each
-        step re-runs the jit-cached forward on the grown sequence —
-        position-stable because the prompt is left-aligned; a static-shape
-        KV-cache decode loop is the next optimization.
+        sampling through the framework RNG (seeded by paddle.seed).
+        Decoding runs through per-layer KV caches (prefill once, then one
+        new token per step); past max_position_embeddings — or when
+        context_parallel attention is active, whose ring/ulysses paths
+        need full equal-length sequences — it falls back to windowed full
+        forwards.
         """
         from ..framework import core
         from ..framework import random as fr
@@ -320,10 +357,24 @@ class GPTForCausalLM(nn.Layer):
             arr = arr[None]
         max_pos = self.cfg.max_position_embeddings
         finished = jnp.zeros((arr.shape[0],), bool)
+        caches = ([() for _ in range(self.cfg.num_layers)]
+                  if self.cfg.context_parallel == "none" else None)
+        pos = 0
         with core.no_grad():
             for _ in range(max_new_tokens):
-                window = arr[:, -max_pos:]
-                logits = self(Tensor(window))
+                if arr.shape[1] > max_pos:
+                    # context overflow: fall back to windowed full forward
+                    caches = None
+                if caches is not None:
+                    new_tok = arr[:, pos:]        # prefill, then 1/step
+                    hidden, caches = self.gpt.decode_step(
+                        Tensor(new_tok), caches, pos)
+                    pos = arr.shape[1]
+                    # only the LAST position feeds sampling: skip the
+                    # [s, vocab] prefill logits entirely
+                    logits = self._head(hidden[:, -1:])
+                else:
+                    logits = self._head(self.gpt(Tensor(arr[:, -max_pos:])))
                 step = logits._data[:, -1].astype(jnp.float32)  # [B, V]
                 if temperature == 0.0:
                     nxt = jnp.argmax(step, axis=-1)

@@ -108,3 +108,35 @@ def test_gpt_generate_greedy_and_sampling():
     e = m.generate(prompt, max_new_tokens=5, temperature=0.0,
                    eos_token_id=first_greedy)
     assert e.shape[1] == 4  # stopped right after emitting eos
+
+
+def test_generate_kv_cache_matches_full_recompute():
+    """decode_step's per-layer KV cache must reproduce the full-forward
+    greedy path token-for-token."""
+    import jax.numpy as jnp
+    from paddle2_tpu.framework import core
+    from paddle2_tpu.framework.tensor import Tensor
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=64, use_scan=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    prompt = paddle.to_tensor(np.array([[5, 9, 2, 7]], "int32"))
+    cached = m.generate(prompt, max_new_tokens=6, temperature=0.0)
+    arr = prompt._data
+    with core.no_grad():
+        for _ in range(6):
+            logits = m(Tensor(arr))
+            nxt = jnp.argmax(logits._data[:, -1], -1)
+            arr = jnp.concatenate([arr, nxt[:, None].astype(jnp.int32)], 1)
+    np.testing.assert_array_equal(cached.numpy(), np.asarray(arr))
+    # overflow past max_position_embeddings falls back without crashing
+    paddle.seed(1)
+    small = GPTForCausalLM(GPTConfig(vocab_size=64, hidden_size=16,
+                                     num_layers=1, num_heads=2,
+                                     max_position_embeddings=8,
+                                     use_scan=False))
+    small.eval()
+    out = small.generate(paddle.to_tensor(np.array([[1, 2, 3]], "int32")),
+                         max_new_tokens=10, temperature=0.0)
+    assert out.shape[1] == 13
