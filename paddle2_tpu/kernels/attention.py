@@ -45,7 +45,12 @@ def _sdpa_xla(q, k, v, bias=None, causal=False, scale=None, dropout_p=0.0,
     return jnp.swapaxes(out, 1, 2)
 
 
+FLASH_ENABLED = True  # sdp_kernel(enable_flash=False) clears this
+
+
 def use_pallas(q_shape) -> bool:
+    if not FLASH_ENABLED:
+        return False
     try:
         dev = jax.devices()[0]
     except Exception:

@@ -8,6 +8,8 @@ from .norm import *         # noqa: F401,F403
 from .loss import *         # noqa: F401,F403
 
 from ...kernels.attention import scaled_dot_product_attention  # noqa: F401
+from .flash_attention import (flash_attention, flash_attn_qkvpacked,  # noqa
+                              flash_attn_unpadded, sdp_kernel)
 
 # sequence mask helper used widely in NLP codebases
 import jax.numpy as _jnp

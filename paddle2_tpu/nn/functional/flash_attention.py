@@ -1,0 +1,157 @@
+"""paddle.nn.functional.flash_attention (reference
+python/paddle/nn/functional/flash_attention.py:195 flash_attention,
+:593 flash_attn_unpadded, plus scaled_dot_product_attention re-export).
+
+All paths route through the kernel selector in kernels/attention.py: the
+Pallas flash kernel on TPU for long sequences, the XLA fused path
+otherwise. Layout is the reference's (batch, seq, heads, head_dim).
+
+``flash_attn_unpadded`` (varlen, cu_seqlens) is served by densifying into
+a padded batch with a length mask — static shapes for jit; the packed
+CUDA layout has no XLA analog, and padded+masked is the TPU-idiomatic
+equivalent.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...kernels.attention import (_sdpa_xla,
+                                  scaled_dot_product_attention)
+from ...ops.dispatch import apply_op, ensure_tensor
+
+__all__ = ["flash_attention", "flash_attn_unpadded", "flash_attn_qkvpacked",
+           "scaled_dot_product_attention", "sdp_kernel"]
+
+
+def flash_attention(query, key, value, dropout: float = 0.0,
+                    causal: bool = False, return_softmax: bool = False,
+                    *, fixed_seed_offset=None, rng_name: str = "",
+                    training: bool = True, name=None):
+    """flash_attention.py:195 parity: returns (out, softmax) — softmax is
+    None unless return_softmax (which forces the XLA path: the flash
+    kernel never materializes probabilities, that is its point)."""
+    out = scaled_dot_product_attention(query, key, value,
+                                       dropout_p=dropout, is_causal=causal,
+                                       training=training)
+    softmax = None
+    if return_softmax:
+        q, k = ensure_tensor(query), ensure_tensor(key)
+
+        def probs(qa, ka):
+            import math
+            qh = jnp.swapaxes(qa, 1, 2).astype(jnp.float32)
+            kh = jnp.swapaxes(ka, 1, 2).astype(jnp.float32)
+            s = jnp.einsum("bhsd,bhtd->bhst", qh, kh) \
+                / math.sqrt(qa.shape[-1])
+            if causal:
+                t_q, t_k = s.shape[-2], s.shape[-1]
+                mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+                s = jnp.where(mask, s, -jnp.inf)
+            return jax.nn.softmax(s, axis=-1)
+        softmax = apply_op("flash_softmax", probs, (q, k), {},
+                           differentiable=False)
+    return out, softmax
+
+
+def flash_attn_qkvpacked(qkv, dropout: float = 0.0, causal: bool = False,
+                         return_softmax: bool = False, **kwargs):
+    """Packed [b, s, 3, h, d] variant (flash_attention.py qkvpacked)."""
+    t = ensure_tensor(qkv)
+    q, k, v = t[:, :, 0], t[:, :, 1], t[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, **kwargs)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q: int, max_seqlen_k: int, scale: float,
+                        dropout: float = 0.0, causal: bool = False,
+                        return_softmax: bool = False, *,
+                        fixed_seed_offset=None, rng_name: str = "",
+                        training: bool = True, name=None):
+    """Varlen attention over packed sequences (flash_attention.py:593).
+
+    query/key/value: [total_tokens, heads, dim] packed rows;
+    cu_seqlens_*: [batch+1] cumulative offsets. Densified to a padded
+    [b, max_seqlen, h, d] batch; padding keys are masked out of the
+    softmax and padded query rows are zeroed on output re-packing.
+    """
+    import numpy as np
+    if return_softmax:
+        raise NotImplementedError(
+            "flash_attn_unpadded(return_softmax=True): the varlen path "
+            "never materializes probabilities; use flash_attention")
+    q = ensure_tensor(query)
+    k = ensure_tensor(key)
+    v = ensure_tensor(value)
+    cu_q = np.asarray(ensure_tensor(cu_seqlens_q).numpy()).astype(np.int64)
+    cu_k = np.asarray(ensure_tensor(cu_seqlens_k).numpy()).astype(np.int64)
+    B = len(cu_q) - 1
+    Sq, Sk = int(max_seqlen_q), int(max_seqlen_k)
+    len_q = cu_q[1:] - cu_q[:-1]
+    len_k = cu_k[1:] - cu_k[:-1]
+    drop_key = None
+    if dropout > 0.0 and training:
+        from ...framework import random as fr
+        drop_key = fr.next_key()
+
+    def run(qa, ka, va):
+        # densify: rows -> [B, S, H, D] with zero padding
+        def pad_one(arr, cu, lens, S):
+            out = jnp.zeros((B, S) + arr.shape[1:], arr.dtype)
+            for i in range(B):
+                out = out.at[i, :int(lens[i])].set(
+                    arr[int(cu[i]):int(cu[i + 1])])
+            return out
+        qp = pad_one(qa, cu_q, len_q, Sq)
+        kp = pad_one(ka, cu_k, len_k, Sk)
+        vp = pad_one(va, cu_k, len_k, Sk)
+        # per-sequence mask: key must be real, and under causal each
+        # query position may only see keys up to its own bottom-right
+        # aligned diagonal len_k[i] - len_q[i] + qpos (PER ROW — the
+        # padded maxes differ from each sequence's true lengths)
+        lk = jnp.asarray(len_k)[:, None, None]            # [B,1,1]
+        lq = jnp.asarray(len_q)[:, None, None]
+        qpos = jnp.arange(Sq)[None, :, None]              # [1,Sq,1]
+        kpos = jnp.arange(Sk)[None, None, :]              # [1,1,Sk]
+        allowed = kpos < lk
+        if causal:
+            allowed = allowed & (kpos <= qpos + (lk - lq))
+        bias = jnp.where(allowed, 0.0, -jnp.inf)[:, None]  # [B,1,Sq,Sk]
+        out = _sdpa_xla(qp, kp, vp, bias=bias, causal=False,
+                        scale=scale,
+                        dropout_p=dropout if drop_key is not None else 0.0,
+                        dropout_key=drop_key)
+        # re-pack valid query rows
+        rows = []
+        for i in range(B):
+            rows.append(out[i, :int(len_q[i])])
+        return jnp.concatenate(rows, axis=0)
+    out = apply_op("flash_attn_unpadded", run, (q, k, v), {})
+    return out, None
+
+
+class sdp_kernel:
+    """Kernel-selection context (reference sdp_kernel): toggles the
+    Pallas flash path — enable_flash=False forces the XLA/math backend
+    inside the block."""
+
+    def __init__(self, enable_math: bool = True, enable_flash: bool = True,
+                 enable_mem_efficient: bool = True):
+        self.enable_flash = enable_flash
+        self._prev = None
+
+    def __enter__(self):
+        from ...kernels import attention as _att
+        self._prev = _att.FLASH_ENABLED
+        _att.FLASH_ENABLED = bool(self.enable_flash)
+        return self
+
+    def __exit__(self, *exc):
+        from ...kernels import attention as _att
+        _att.FLASH_ENABLED = self._prev
+        return False

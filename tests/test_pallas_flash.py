@@ -116,3 +116,77 @@ def test_sdpa_api_routes_and_grads():
     out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
     out.sum().backward()
     assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
+
+
+def test_functional_flash_attention_api():
+    """F.flash_attention / qkvpacked / unpadded (reference
+    flash_attention.py:195/:593 surface)."""
+    import paddle2_tpu as paddle
+    import paddle2_tpu.nn.functional as F
+    rs = np.random.RandomState(0)
+    q = paddle.to_tensor(rs.randn(2, 16, 2, 8).astype("float32"))
+    out, sm = F.flash_attention(q, q, q, causal=True)
+    assert tuple(out.shape) == (2, 16, 2, 8) and sm is None
+    out2, sm2 = F.flash_attention(q, q, q, causal=True,
+                                  return_softmax=True)
+    assert tuple(sm2.shape) == (2, 2, 16, 16)
+    np.testing.assert_allclose(sm2.numpy().sum(-1), 1.0, rtol=1e-5)
+
+    qkv = paddle.to_tensor(rs.randn(2, 16, 3, 2, 8).astype("float32"))
+    o3, _ = F.flash_attn_qkvpacked(qkv, causal=True)
+    assert tuple(o3.shape) == (2, 16, 2, 8)
+
+    # varlen: two sequences of lengths 5 and 9 packed into 14 rows —
+    # must equal per-sequence dense attention
+    lens = [5, 9]
+    total = sum(lens)
+    packed = paddle.to_tensor(rs.randn(total, 2, 8).astype("float32"))
+    cu = paddle.to_tensor(np.array([0, 5, 14], "int32"))
+    out_v, _ = F.flash_attn_unpadded(packed, packed, packed, cu, cu,
+                                     max_seqlen_q=9, max_seqlen_k=9,
+                                     scale=1.0 / np.sqrt(8), causal=True)
+    assert tuple(out_v.shape) == (total, 2, 8)
+    from paddle2_tpu.kernels.attention import _sdpa_xla
+    start = 0
+    for L in lens:
+        seq = packed._data[start:start + L][None]
+        ref = _sdpa_xla(seq, seq, seq, causal=True)[0]
+        np.testing.assert_allclose(
+            np.asarray(out_v._data[start:start + L]), np.asarray(ref),
+            rtol=1e-5, atol=1e-5)
+        start += L
+
+    with F.sdp_kernel(enable_flash=False):
+        pass
+
+
+def test_flash_unpadded_per_sequence_causal():
+    """Regression: causal masking must use each sequence's OWN lengths,
+    not the padded maxima (q/k length deltas differ per row)."""
+    import paddle2_tpu as paddle
+    import paddle2_tpu.nn.functional as F
+    rs = np.random.RandomState(1)
+    # seq0: len_q=2,len_k=2 (delta 0); seq1: len_q=2,len_k=5 (delta 3)
+    q = paddle.to_tensor(rs.randn(4, 2, 8).astype("float32"))
+    kv = paddle.to_tensor(rs.randn(7, 2, 8).astype("float32"))
+    cu_q = paddle.to_tensor(np.array([0, 2, 4], "int32"))
+    cu_k = paddle.to_tensor(np.array([0, 2, 7], "int32"))
+    out, _ = F.flash_attn_unpadded(q, kv, kv, cu_q, cu_k, 2, 5,
+                                   scale=1.0 / np.sqrt(8), causal=True)
+    starts_q, starts_k, lens_q, lens_k = [0, 2], [0, 2], [2, 2], [2, 5]
+    for i in range(2):
+        qs = q._data[starts_q[i]:starts_q[i] + lens_q[i]][None]
+        ks = kv._data[starts_k[i]:starts_k[i] + lens_k[i]][None]
+        ref = _sdpa_xla(qs, ks, ks, causal=True)[0]
+        np.testing.assert_allclose(
+            np.asarray(out._data[starts_q[i]:starts_q[i] + lens_q[i]]),
+            np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_sdp_kernel_disables_flash():
+    import paddle2_tpu.nn.functional as F
+    from paddle2_tpu.kernels import attention as att
+    assert att.FLASH_ENABLED
+    with F.sdp_kernel(enable_flash=False):
+        assert not att.use_pallas((1, 4096, 8, 64))
+    assert att.FLASH_ENABLED
