@@ -55,6 +55,7 @@ from .distributed.parallel import DataParallel  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi.model_summary import summary  # noqa: F401
+from .nn.layer.layers import ParamAttr  # noqa: F401
 from . import incubate  # noqa: F401
 from . import static  # noqa: F401
 from . import profiler  # noqa: F401

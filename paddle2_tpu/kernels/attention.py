@@ -45,11 +45,21 @@ def _sdpa_xla(q, k, v, bias=None, causal=False, scale=None, dropout_p=0.0,
     return jnp.swapaxes(out, 1, 2)
 
 
-FLASH_ENABLED = True  # sdp_kernel(enable_flash=False) clears this
+import threading
+
+_flash_tls = threading.local()  # sdp_kernel toggles per-thread
+
+
+def flash_enabled() -> bool:
+    return getattr(_flash_tls, "enabled", True)
+
+
+def set_flash_enabled(flag: bool) -> None:
+    _flash_tls.enabled = bool(flag)
 
 
 def use_pallas(q_shape) -> bool:
-    if not FLASH_ENABLED:
+    if not flash_enabled():
         return False
     try:
         dev = jax.devices()[0]

@@ -99,17 +99,29 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         from ...framework import random as fr
         drop_key = fr.next_key()
 
+    def _row_index(cu, lens, S):
+        # [B, S] gather map into the packed rows; out-of-range positions
+        # point at a sentinel zero row appended to the source
+        idx = np.zeros((B, S), np.int64)
+        for i in range(B):
+            L = int(lens[i])
+            idx[i, :L] = np.arange(int(cu[i]), int(cu[i]) + L)
+            idx[i, L:] = -1  # sentinel (last row after the append below)
+        return jnp.asarray(idx)
+
+    iq_map = _row_index(cu_q, len_q, Sq)
+    ik_map = _row_index(cu_k, len_k, Sk)
+
     def run(qa, ka, va):
-        # densify: rows -> [B, S, H, D] with zero padding
-        def pad_one(arr, cu, lens, S):
-            out = jnp.zeros((B, S) + arr.shape[1:], arr.dtype)
-            for i in range(B):
-                out = out.at[i, :int(lens[i])].set(
-                    arr[int(cu[i]):int(cu[i + 1])])
-            return out
-        qp = pad_one(qa, cu_q, len_q, Sq)
-        kp = pad_one(ka, cu_k, len_k, Sk)
-        vp = pad_one(va, cu_k, len_k, Sk)
+        # one gather per tensor (sentinel row = zeros) instead of B
+        # sequential full-buffer scatter copies
+        def pad_one(arr, idx):
+            with_sentinel = jnp.concatenate(
+                [arr, jnp.zeros((1,) + arr.shape[1:], arr.dtype)], axis=0)
+            return with_sentinel[idx]
+        qp = pad_one(qa, iq_map)
+        kp = pad_one(ka, ik_map)
+        vp = pad_one(va, ik_map)
         # per-sequence mask: key must be real, and under causal each
         # query position may only see keys up to its own bottom-right
         # aligned diagonal len_k[i] - len_q[i] + qpos (PER ROW — the
@@ -138,20 +150,26 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 class sdp_kernel:
     """Kernel-selection context (reference sdp_kernel): toggles the
     Pallas flash path — enable_flash=False forces the XLA/math backend
-    inside the block."""
+    inside the block (thread-local, like the selection itself). The math
+    backend cannot be disabled: it is the guaranteed-shape fallback, so
+    enable_math=False raises instead of silently not applying."""
 
     def __init__(self, enable_math: bool = True, enable_flash: bool = True,
                  enable_mem_efficient: bool = True):
+        if not enable_math:
+            raise ValueError(
+                "sdp_kernel(enable_math=False): the XLA math path is the "
+                "guaranteed fallback on TPU and cannot be disabled")
         self.enable_flash = enable_flash
         self._prev = None
 
     def __enter__(self):
         from ...kernels import attention as _att
-        self._prev = _att.FLASH_ENABLED
-        _att.FLASH_ENABLED = bool(self.enable_flash)
+        self._prev = _att.flash_enabled()
+        _att.set_flash_enabled(bool(self.enable_flash))
         return self
 
     def __exit__(self, *exc):
         from ...kernels import attention as _att
-        _att.FLASH_ENABLED = self._prev
+        _att.set_flash_enabled(self._prev)
         return False

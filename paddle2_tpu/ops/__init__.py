@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import creation, extra, linalg, logic, manipulation, math, random
+from . import creation, extra, linalg, logic, manipulation, math, misc, random
 from .dispatch import apply_op, ensure_tensor, rebind_inplace
 from ..framework.tensor import Tensor
 
@@ -26,6 +26,7 @@ from .linalg import *        # noqa: F401,F403
 from .logic import *         # noqa: F401,F403
 from .random import *        # noqa: F401,F403
 from .extra import *         # noqa: F401,F403
+from .misc import *          # noqa: F401,F403
 
 
 # ---------------------------------------------------------------------------
@@ -109,6 +110,11 @@ def _patch():
     # method forms — mirror paddle Tensor methods
     _method_sources = [math, creation, manipulation, linalg, logic,
                        random, extra]
+    # misc holds non-tensor utilities too: attach ONLY tensor methods
+    for _nm in ("rank", "is_complex", "is_integer", "is_floating_point",
+                "reduce_as", "as_strided", "diagonal_scatter"):
+        if not hasattr(T, _nm):
+            setattr(T, _nm, getattr(misc, _nm))
     skip = {"to_tensor", "as_tensor", "pow"}
     for mod in _method_sources:
         for name in getattr(mod, "__all__", []):
@@ -134,3 +140,102 @@ def _patch():
 
 _patch()
 del _patch
+
+
+# ---------------------------------------------------------------------------
+# generated in-place variants (reference `op_` surface): out-of-place op +
+# rebind_inplace keeps the autograd edge (unlike raw copy_)
+# ---------------------------------------------------------------------------
+
+_INPLACE_BASES = [
+    "addmm", "t", "cumsum", "cumprod", "logit", "equal", "cos",
+    "tan", "logical_and", "less_than", "floor_divide", "remainder",
+    "logical_or", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "bitwise_not", "less_equal", "triu", "sin", "tril", "pow", "acos",
+    "expm1", "sinh", "sinc", "neg", "lgamma", "gammaincc", "gammainc",
+    "square", "divide", "gammaln", "atan", "gcd", "lcm", "cast",
+    # NOTE: no "where" (in-place target is x, not the condition) and no
+    "greater_equal", "erf", "greater_than", "tanh", "transpose",
+    "flatten", "multiply", "log", "log2", "log10", "trunc", "frac",
+    "digamma", "renorm", "multigammaln", "nan_to_num", "ldexp", "i0",
+    "polygamma", "copysign", "bitwise_left_shift", "bitwise_right_shift",
+    "masked_fill", "masked_scatter", "hypot", "abs", "exp", "sqrt",
+    "rsqrt", "floor", "ceil", "round", "reciprocal", "logical_not",
+    "unsqueeze", "squeeze", "reshape", "floor_mod", "cosh", "asin",
+    "asinh", "acosh", "atanh",
+]  # uniform/normal/exponential have hand-written in-place forms
+
+
+def _gen_inplace():
+    import sys
+    mod = sys.modules[__name__]
+
+    def make(base_fn, nm):
+        def f(x, *args, **kwargs):
+            x = ensure_tensor(x)
+            return rebind_inplace(x, base_fn(x, *args, **kwargs))
+        f.__name__ = nm
+        f.__doc__ = f"In-place {base_fn.__name__} (reference {nm})."
+        return f
+
+    for base_name in _INPLACE_BASES:
+        base = getattr(mod, base_name, None)
+        if base is None or not callable(base):
+            continue
+        nm = base_name + "_"
+        if hasattr(mod, nm):   # a hand-written in-place form wins
+            continue
+        fn = make(base, nm)
+        setattr(mod, nm, fn)
+        if not hasattr(Tensor, nm):
+            setattr(Tensor, nm, fn)
+
+
+_gen_inplace()
+
+# aliases whose base has a different name
+import sys as _sys
+_mod = _sys.modules[__name__]
+if hasattr(_mod, "remainder_"):
+    mod_ = _mod.remainder_
+    Tensor.mod_ = mod_
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """In-place Bernoulli fill (reference bernoulli_)."""
+    from ..framework import random as fr
+    import jax as _jax
+    x = ensure_tensor(x)
+    u = _jax.random.uniform(fr.next_key(), tuple(x.shape))
+    out = apply_op("bernoulli", lambda a: (u < p).astype(a.dtype), (x,),
+                   {}, differentiable=False)
+    return rebind_inplace(x, out)
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """In-place log-normal fill (reference log_normal_)."""
+    from ..framework import random as fr
+    import jax as _jax
+    import jax.numpy as _jnp
+    x = ensure_tensor(x)
+    eps = _jax.random.normal(fr.next_key(), tuple(x.shape))
+    out = apply_op("log_normal",
+                   lambda a: _jnp.exp(mean + std * eps).astype(a.dtype),
+                   (x,), {}, differentiable=False)
+    return rebind_inplace(x, out)
+
+
+Tensor.bernoulli_ = bernoulli_
+Tensor.log_normal_ = log_normal_
+
+
+def where_(condition, x, y, name=None):
+    """In-place where: writes the selected values into X (reference
+    where_ contract — the condition is a read-only mask)."""
+    x = ensure_tensor(x)
+    out = logic.where(condition, x, y)
+    return rebind_inplace(x, out)
+
+
+Tensor.where_ = lambda self, condition, y, name=None: where_(condition,
+                                                             self, y)

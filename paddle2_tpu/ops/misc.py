@@ -1,0 +1,226 @@
+"""Remaining top-level API surface (reference python/paddle/__init__.py
+__all__ diff): dtype aliases, small tensor utilities, rng-state shims."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .dispatch import apply_op, ensure_tensor
+
+__all__ = ["dtype", "float8_e4m3fn", "float8_e5m2", "rank", "shape",
+           "add_n", "reverse", "histogram_bin_edges", "is_complex",
+           "is_integer", "is_floating_point", "get_cuda_rng_state",
+           "set_cuda_rng_state", "set_printoptions",
+           "disable_signal_handler", "CUDAPinnedPlace", "create_parameter",
+           "check_shape", "reduce_as", "as_strided", "diagonal_scatter",
+           "LazyGuard", "batch", "flops"]
+
+# paddle.dtype: accepts "float32"/np dtypes; jnp's dtype object is the
+# TPU-native datatype descriptor
+dtype = jnp.dtype
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+
+def rank(input, name=None) -> Tensor:
+    """Number of dimensions as a 0-D tensor (tensor/attribute.py rank)."""
+    return Tensor(jnp.asarray(ensure_tensor(input).ndim, jnp.int32))
+
+
+def shape(input, name=None) -> Tensor:
+    return Tensor(jnp.asarray(tuple(ensure_tensor(input).shape),
+                              jnp.int32))
+
+
+def add_n(inputs, name=None) -> Tensor:
+    ts = tuple(ensure_tensor(t) for t in
+               (inputs if isinstance(inputs, (list, tuple)) else [inputs]))
+    return apply_op("add_n", lambda *xs: sum(xs[1:], xs[0]), ts, {})
+
+
+def reverse(x, axis, name=None) -> Tensor:
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply_op("reverse", lambda a: jnp.flip(a, ax),
+                    (ensure_tensor(x),), {})
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None) -> Tensor:
+    arr = np.asarray(ensure_tensor(input).numpy())
+    rng = None if (min == 0 and max == 0) else (min, max)
+    return Tensor(jnp.asarray(np.histogram_bin_edges(arr, bins=bins,
+                                                     range=rng)))
+
+
+def is_complex(x) -> bool:
+    return jnp.issubdtype(ensure_tensor(x)._data.dtype,
+                          jnp.complexfloating)
+
+
+def is_integer(x) -> bool:
+    return jnp.issubdtype(ensure_tensor(x)._data.dtype, jnp.integer)
+
+
+def is_floating_point(x) -> bool:
+    return jnp.issubdtype(ensure_tensor(x)._data.dtype, jnp.floating)
+
+
+def get_cuda_rng_state():
+    """Accelerator RNG state (maps onto the framework key chain)."""
+    from ..framework import random as fr
+    return [fr.get_state()] if hasattr(fr, "get_state") else []
+
+
+def set_cuda_rng_state(state):
+    from ..framework import random as fr
+    if state and hasattr(fr, "set_state"):
+        fr.set_state(state[0])
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """No-op: the reference installs C++ crash handlers; PJRT has none."""
+
+
+class CUDAPinnedPlace:
+    """Place alias (host staging memory is PJRT-managed on TPU)."""
+
+    def __repr__(self):
+        return "CUDAPinnedPlace"
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.create_parameter (tensor/creation.py): standalone Parameter."""
+    from ..nn.layer.layers import Layer
+    holder = Layer()
+    return holder.create_parameter(list(shape), attr=attr, dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+def check_shape(x):
+    return tuple(ensure_tensor(x).shape)
+
+
+def reduce_as(x, target, name=None) -> Tensor:
+    """Sum-reduce x to target's (broadcast-compatible) shape."""
+    xt, tt = ensure_tensor(x), ensure_tensor(target)
+    tgt = tuple(tt.shape)
+
+    def f(a):
+        extra = a.ndim - len(tgt)
+        if extra > 0:
+            a = jnp.sum(a, axis=tuple(range(extra)))
+        axes = tuple(i for i, (d, t) in enumerate(zip(a.shape, tgt))
+                     if d != t and t == 1)
+        if axes:
+            a = jnp.sum(a, axis=axes, keepdims=True)
+        return a
+    return apply_op("reduce_as", f, (xt,), {})
+
+
+def as_strided(x, shape, stride, offset=0, name=None) -> Tensor:
+    """Strided view re-expressed as a gather (XLA arrays have no strides;
+    the index matrix reproduces the reference's aliasing READ semantics —
+    writes do not alias back)."""
+    xt = ensure_tensor(x)
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+    idx = np.full(shape, int(offset), np.int64)
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        ar = np.arange(s) * st
+        idx += ar.reshape((1,) * d + (s,) + (1,) * (len(shape) - d - 1))
+
+    def f(a):
+        return a.reshape(-1)[jnp.asarray(idx)]
+    return apply_op("as_strided", f, (xt,), {})
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None) -> Tensor:
+    def f(a, b):
+        n1, n2 = a.shape[axis1], a.shape[axis2]
+        k = min(n1, n2 - offset) if offset >= 0 else min(n1 + offset, n2)
+        i = jnp.arange(k) + (-offset if offset < 0 else 0)
+        j = jnp.arange(k) + (offset if offset >= 0 else 0)
+        idx = [slice(None)] * a.ndim
+        idx[axis1], idx[axis2] = i, j
+        return a.at[tuple(idx)].set(b.astype(a.dtype))
+    return apply_op("diagonal_scatter", f,
+                    (ensure_tensor(x), ensure_tensor(y)), {})
+
+
+class LazyGuard:
+    """lazy init guard (reference LazyGuard defers parameter
+    materialization; this runtime materializes eagerly — the guard exists
+    so reference scripts run, with identical results and eager memory)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader combinator (paddle.batch)."""
+
+    def gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return gen
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False) -> int:
+    """paddle.flops (hapi/dynamic_flops.py): rough multiply-add count via
+    forward hooks on Linear/Conv layers."""
+    from .. import nn, zeros
+    total = {"flops": 0}
+    hooks = []
+
+    def conv_hook(l, inputs, output):
+        out_el = int(np.prod(output.shape[1:]))
+        kernel = int(np.prod(l._kernel_size)) * (l._in_channels
+                                                 // l._groups)
+        total["flops"] += out_el * (2 * kernel - 1)
+
+    def linear_hook(l, inputs, output):
+        total["flops"] += 2 * int(np.prod(output.shape[1:])) \
+            * int(l.weight.shape[0])
+
+    for layer in net.sublayers(include_self=True):
+        if isinstance(layer, nn.Conv2D):
+            hooks.append(layer.register_forward_post_hook(conv_hook))
+        elif isinstance(layer, nn.Linear):
+            hooks.append(layer.register_forward_post_hook(linear_hook))
+    try:
+        net(zeros(list(input_size)))
+    finally:
+        for h in hooks:
+            h.remove()
+    return total["flops"]

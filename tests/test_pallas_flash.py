@@ -186,7 +186,10 @@ def test_flash_unpadded_per_sequence_causal():
 def test_sdp_kernel_disables_flash():
     import paddle2_tpu.nn.functional as F
     from paddle2_tpu.kernels import attention as att
-    assert att.FLASH_ENABLED
+    assert att.flash_enabled()
     with F.sdp_kernel(enable_flash=False):
         assert not att.use_pallas((1, 4096, 8, 64))
-    assert att.FLASH_ENABLED
+    assert att.flash_enabled()
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        F.sdp_kernel(enable_math=False)
