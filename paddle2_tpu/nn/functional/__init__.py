@@ -9,7 +9,32 @@ from .loss import *         # noqa: F401,F403
 
 from ...kernels.attention import scaled_dot_product_attention  # noqa: F401
 from .flash_attention import (flash_attention, flash_attn_qkvpacked,  # noqa
-                              flash_attn_unpadded, sdp_kernel)
+                              flash_attn_unpadded,
+                              flash_attn_varlen_qkvpacked, sdp_kernel)
+from .extra_losses import *   # noqa: F401,F403
+from .vision_ops import *     # noqa: F401,F403
+
+# in-place activation variants (reference elu_/tanh_/... surface):
+# out-of-place op + rebind keeps the autograd edge
+from ...ops.dispatch import rebind_inplace as _rebind
+from ...ops.dispatch import ensure_tensor as _ensure
+
+
+def _mk_act_inplace(_base, _nm):
+    def f(x, *a, **k):
+        x = _ensure(x)
+        return _rebind(x, _base(x, *a, **k))
+    f.__name__ = _nm
+    return f
+
+
+import sys as _sys
+_self = _sys.modules[__name__]
+for _b in ("elu", "hardtanh", "leaky_relu", "tanh", "thresholded_relu",
+           "relu", "relu6", "softmax", "sigmoid"):
+    _fn = getattr(_self, _b, None)
+    if _fn is not None and not hasattr(_self, _b + "_"):
+        setattr(_self, _b + "_", _mk_act_inplace(_fn, _b + "_"))
 
 # sequence mask helper used widely in NLP codebases
 import jax.numpy as _jnp

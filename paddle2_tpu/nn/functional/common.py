@@ -14,7 +14,7 @@ from ...framework import random as fr
 from ...framework.tensor import Tensor
 from ...ops.dispatch import apply_op, ensure_tensor
 
-__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+__all__ = ["feature_alpha_dropout", "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
            "embedding", "one_hot", "pad", "zeropad2d", "unfold", "fold",
            "interpolate", "upsample", "pixel_shuffle", "pixel_unshuffle",
            "channel_shuffle", "cosine_similarity", "bilinear", "label_smooth",
@@ -348,3 +348,30 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     from ...kernels.attention import scaled_dot_product_attention
     return scaled_dot_product_attention(query, key, value, causal=causal,
                                         dropout_p=dropout)
+
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole feature maps (functional parity): drops
+    entire channels to the SELU saturation value, preserving mean/var."""
+    if not 0.0 <= p <= 1.0:
+        raise ValueError(f"dropout probability must be in [0, 1], got {p}")
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x
+    from ...framework import random as fr
+    import jax as _jax
+    alpha_p = -1.7580993408473766  # -scale * alpha of SELU
+    if p == 1.0:  # everything dropped: the affine of the constant
+        return apply_op("feature_alpha_dropout",
+                        lambda a: jnp.full_like(a, 0.0), (x,), {})
+    key = fr.next_key()
+    mask_shape = tuple(x.shape[:2]) + (1,) * (x.ndim - 2)
+    keep = _jax.random.bernoulli(key, 1.0 - p, mask_shape)
+
+    def f(a):
+        a_ = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+        b_ = -a_ * alpha_p * p
+        out = jnp.where(keep, a, alpha_p)
+        return out * a_ + b_
+    return apply_op("feature_alpha_dropout", f, (x,), {})

@@ -1,0 +1,179 @@
+"""Additional loss/distance functionals (reference
+python/paddle/nn/functional/loss.py + distance.py surface widening)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...ops.dispatch import apply_op, ensure_tensor
+
+__all__ = ["pairwise_distance", "soft_margin_loss",
+           "multi_label_soft_margin_loss", "multi_margin_loss",
+           "gaussian_nll_loss", "triplet_margin_with_distance_loss",
+           "dice_loss", "npair_loss", "gather_tree", "temporal_shift"]
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """distance.py pairwise_distance."""
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+    return apply_op("pairwise_distance", f,
+                    (ensure_tensor(x), ensure_tensor(y)), {})
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def f(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+    return apply_op("soft_margin_loss", f,
+                    (ensure_tensor(input), ensure_tensor(label)), {})
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    ts = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        ts.append(ensure_tensor(weight))
+
+    def f(x, y, *w):
+        loss = -(y * jax.nn.log_sigmoid(x)
+                 + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            loss = loss * w[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+    return apply_op("multi_label_soft_margin_loss", f, tuple(ts), {})
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    ts = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        ts.append(ensure_tensor(weight))
+
+    def f(x, y, *w):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), 1)
+        m = jnp.maximum(0.0, margin - correct + x) ** p
+        if w:
+            m = m * w[0][y.astype(jnp.int32)][:, None]
+        mask = jax.nn.one_hot(y.astype(jnp.int32), c) == 0
+        return _reduce(jnp.sum(m * mask, axis=1) / c, reduction)
+    return apply_op("multi_margin_loss", f, tuple(ts), {})
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(loss, reduction)
+    return apply_op("gaussian_nll_loss", f,
+                    (ensure_tensor(input), ensure_tensor(label),
+                     ensure_tensor(variance)), {})
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    a = ensure_tensor(input)
+    p_ = ensure_tensor(positive)
+    n_ = ensure_tensor(negative)
+    dist = distance_function or (lambda u, v: pairwise_distance(u, v))
+    dp = ensure_tensor(dist(a, p_))
+    dn = ensure_tensor(dist(a, n_))
+    if swap:
+        dpn = ensure_tensor(dist(p_, n_))
+        dn = apply_op("min", lambda u, v: jnp.minimum(u, v), (dn, dpn), {})
+
+    def f(u, v):
+        return _reduce(jnp.maximum(0.0, u - v + margin), reduction)
+    return apply_op("triplet_margin_with_distance_loss", f, (dp, dn), {})
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """loss.py dice_loss: input [N, ..., C] probs, label [N, ..., 1]."""
+    def f(x, y):
+        c = x.shape[-1]
+        oh = jax.nn.one_hot(y[..., 0].astype(jnp.int32), c, dtype=x.dtype)
+        inter = jnp.sum(x * oh, axis=tuple(range(1, x.ndim)))
+        union = jnp.sum(x + oh, axis=tuple(range(1, x.ndim)))
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_op("dice_loss", f,
+                    (ensure_tensor(input), ensure_tensor(label)), {})
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """loss.py npair_loss (improved deep metric learning)."""
+    def f(a, p, y):
+        sim = a @ p.T                              # [N, N]
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = same / jnp.maximum(same.sum(1, keepdims=True), 1.0)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        xent = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1))
+                        + jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return xent + reg
+    return apply_op("npair_loss", f,
+                    (ensure_tensor(anchor), ensure_tensor(positive),
+                     ensure_tensor(labels)), {})
+
+
+def gather_tree(ids, parents):
+    """functional/extension.py gather_tree: beam-search backtrace.
+    ids/parents: [T, B, beam]."""
+    def f(i, par):
+        T = i.shape[0]
+
+        def step(carry, t):
+            beams = carry                       # [B, beam] current beam idx
+            tok = jnp.take_along_axis(i[t], beams, axis=1)
+            beams = jnp.take_along_axis(par[t], beams, axis=1)
+            return beams, tok
+        init = jnp.broadcast_to(jnp.arange(i.shape[2])[None, :],
+                                i.shape[1:])
+        _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return jnp.flip(toks, axis=0)
+    return apply_op("gather_tree", f,
+                    (ensure_tensor(ids), ensure_tensor(parents)), {},
+                    differentiable=False)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """functional/extension.py temporal_shift (TSM video models)."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"unknown data_format {data_format!r}")
+
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        back = jnp.concatenate([v[:, 1:, :fold],
+                                jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                               v[:, :-1, fold:2 * fold]], axis=1)
+        keep = v[:, :, 2 * fold:]
+        out = jnp.concatenate([back, fwd, keep],
+                              axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return apply_op("temporal_shift", f, (ensure_tensor(x),), {})

@@ -25,6 +25,7 @@ from ...kernels.attention import (_sdpa_xla,
 from ...ops.dispatch import apply_op, ensure_tensor
 
 __all__ = ["flash_attention", "flash_attn_unpadded", "flash_attn_qkvpacked",
+           "flash_attn_varlen_qkvpacked",
            "scaled_dot_product_attention", "sdp_kernel"]
 
 
@@ -173,3 +174,18 @@ class sdp_kernel:
         from ...kernels import attention as _att
         _att.set_flash_enabled(self._prev)
         return False
+
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale,
+                                dropout: float = 0.0, causal: bool = False,
+                                return_softmax: bool = False, **kwargs):
+    """Varlen packed-QKV variant (flash_attention.py
+    flash_attn_varlen_qkvpacked): qkv [total_tokens, 3, h, d]."""
+    t = ensure_tensor(qkv)
+    q, k, v = t[:, 0], t[:, 1], t[:, 2]
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale,
+                               dropout=dropout, causal=causal,
+                               return_softmax=return_softmax, **kwargs)

@@ -11,7 +11,7 @@ import numpy as np
 from ...framework.tensor import Tensor
 from ...ops.dispatch import apply_op, ensure_tensor
 
-__all__ = ["cross_entropy", "softmax_with_cross_entropy", "nll_loss",
+__all__ = ["margin_cross_entropy", "cross_entropy", "softmax_with_cross_entropy", "nll_loss",
            "binary_cross_entropy", "binary_cross_entropy_with_logits",
            "mse_loss", "l1_loss", "smooth_l1_loss", "kl_div", "margin_ranking_loss",
            "hinge_embedding_loss", "cosine_embedding_loss", "ctc_loss",
@@ -368,3 +368,32 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return loss
     return apply_op("ctc_loss", fn,
                     (log_probs, labels, input_lengths, label_lengths), {})
+
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace-family margin softmax (loss.py margin_cross_entropy):
+    cos(m1*theta + m2) - m3 applied to the target logit, then scaled
+    cross entropy. Logits are cosine similarities in [-1, 1]."""
+    import jax as _jax
+    lg, lb = ensure_tensor(logits), ensure_tensor(label)
+
+    def f(x, y):
+        yi = y.astype(jnp.int32).reshape(-1)
+        tgt = jnp.take_along_axis(x, yi[:, None], 1)[:, 0]
+        theta = jnp.arccos(jnp.clip(tgt, -1 + 1e-7, 1 - 1e-7))
+        tgt_m = jnp.cos(margin1 * theta + margin2) - margin3
+        x_m = x.at[jnp.arange(x.shape[0]), yi].set(tgt_m)
+        logp = _jax.nn.log_softmax(x_m * scale, axis=-1)
+        loss = -jnp.take_along_axis(logp, yi[:, None], 1)[:, 0]
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jnp.exp(logp)
+        return loss
+    out = apply_op("margin_cross_entropy", f, (lg, lb), {})
+    return out

@@ -1,0 +1,70 @@
+"""affine_grid + grid_sample (reference nn/functional/vision.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply_op, ensure_tensor
+
+__all__ = ["affine_grid", "grid_sample"]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """vision.py affine_grid: theta [N, 2, 3] -> grid [N, H, W, 2]."""
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    n, c, h, w = [int(v) for v in out_shape]
+
+    def f(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)      # [H, W, 3]
+        return jnp.einsum("hwk,nik->nhwi", base, th)   # [N, H, W, 2]
+    return apply_op("affine_grid", f, (ensure_tensor(theta),), {})
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """vision.py grid_sample: x [N,C,H,W], grid [N,Ho,Wo,2] in [-1,1]."""
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def pix(yy, xx):
+            """[N,Ho,Wo] int coords -> [N,C,Ho,Wo] values with zero pad."""
+            inside = ((yy >= 0) & (yy < h) & (xx >= 0) & (xx < w))
+            yc = jnp.clip(yy, 0, h - 1)
+            xc = jnp.clip(xx, 0, w - 1)
+            batch = jnp.arange(n)[:, None, None]
+            vals = a[batch, :, yc, xc]                 # [N,Ho,Wo,C]
+            vals = jnp.moveaxis(vals, -1, 1)           # [N,C,Ho,Wo]
+            if padding_mode == "zeros":
+                vals = vals * inside[:, None].astype(vals.dtype)
+            return vals
+
+        if mode == "nearest":
+            return pix(jnp.round(fy).astype(jnp.int32),
+                       jnp.round(fx).astype(jnp.int32))
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        wx = (fx - x0)[:, None]
+        wy = (fy - y0)[:, None]
+        return (pix(y0, x0) * (1 - wy) * (1 - wx)
+                + pix(y0, x0 + 1) * (1 - wy) * wx
+                + pix(y0 + 1, x0) * wy * (1 - wx)
+                + pix(y0 + 1, x0 + 1) * wy * wx)
+    return apply_op("grid_sample", f,
+                    (ensure_tensor(x), ensure_tensor(grid)), {})

@@ -13,7 +13,8 @@ from .. import functional as F
 from .. import initializer as I
 from .layers import Layer, ParamAttr
 
-__all__ = ["Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
+__all__ = ["FeatureAlphaDropout", "Softmax2D", "Unflatten", "ZeroPad1D", "ZeroPad3D",
+           "PairwiseDistance", "Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
            "AlphaDropout", "Flatten", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
            "Upsample", "UpsamplingBilinear2D", "UpsamplingNearest2D",
            "PixelShuffle", "PixelUnshuffle", "ChannelShuffle",
@@ -261,3 +262,74 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.args)
+
+
+
+class Softmax2D(Layer):
+    """nn.Softmax2D: softmax over the channel dim of NCHW (layer/
+    activation.py Softmax2D parity)."""
+
+    def forward(self, x):
+        from .. import functional as F
+        return F.softmax(x, axis=-3)
+
+
+class Unflatten(Layer):
+    """nn.Unflatten (common.py Unflatten parity)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = shape
+
+    def forward(self, x):
+        from ...ops.extra import unflatten
+        return unflatten(x, self.axis, self.shape)
+
+
+class _ZeroPadNd(Layer):
+    n_spatial = 1
+
+    def __init__(self, padding, data_format=None, name=None):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding, padding] * self.n_spatial
+        self.padding = list(padding)
+
+    def forward(self, x):
+        from .. import functional as F
+        return F.pad(x, self.padding, mode="constant", value=0.0)
+
+
+class ZeroPad1D(_ZeroPadNd):
+    n_spatial = 1
+
+
+class ZeroPad3D(_ZeroPadNd):
+    n_spatial = 3
+
+
+class PairwiseDistance(Layer):
+    """nn.PairwiseDistance (distance.py parity)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from .. import functional as F
+        return F.pairwise_distance(x, y, self.p, self.epsilon,
+                                   self.keepdim)
+
+
+
+class FeatureAlphaDropout(Layer):
+    """nn.FeatureAlphaDropout: alpha dropout over whole channels."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        from .. import functional as F
+        return F.feature_alpha_dropout(x, self.p, self.training)

@@ -9,7 +9,7 @@ from typing import Iterable
 from ...framework.tensor import Parameter
 from .layers import Layer
 
-__all__ = ["Sequential", "LayerList", "LayerDict", "ParameterList"]
+__all__ = ["ParameterDict", "Sequential", "LayerList", "LayerDict", "ParameterList"]
 
 
 class Sequential(Layer):
@@ -151,3 +151,39 @@ class ParameterList(Layer):
     def append(self, parameter):
         self.add_parameter(str(len(self)), parameter)
         return self
+
+
+
+class ParameterDict(Layer):
+    """nn.ParameterDict (container.py parity): string-keyed parameters."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters:
+            for k, v in (parameters.items()
+                         if isinstance(parameters, dict) else parameters):
+                self.add_parameter(k, v)
+
+    def __getitem__(self, key):
+        return getattr(self, key)
+
+    def __setitem__(self, key, param):
+        self.add_parameter(key, param)
+
+    def __len__(self):
+        return len(list(self.named_parameters(include_sublayers=False)))
+
+    def __contains__(self, key):
+        return any(k == key for k, _ in
+                   self.named_parameters(include_sublayers=False))
+
+    def keys(self):
+        return [k for k, _ in
+                self.named_parameters(include_sublayers=False)]
+
+    def items(self):
+        return list(self.named_parameters(include_sublayers=False))
+
+    def values(self):
+        return [v for _, v in
+                self.named_parameters(include_sublayers=False)]

@@ -18,7 +18,7 @@ from ...ops.dispatch import apply_op, ensure_tensor
 from .. import initializer as I
 from .layers import Layer
 
-__all__ = ["SimpleRNNCell", "GRUCell", "LSTMCell", "RNN", "SimpleRNN", "GRU",
+__all__ = ["RNNCellBase", "SimpleRNNCell", "GRUCell", "LSTMCell", "RNN", "SimpleRNN", "GRU",
            "LSTM", "BiRNN"]
 
 

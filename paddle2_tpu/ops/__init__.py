@@ -154,7 +154,8 @@ _INPLACE_BASES = [
     "bitwise_not", "less_equal", "triu", "sin", "tril", "pow", "acos",
     "expm1", "sinh", "sinc", "neg", "lgamma", "gammaincc", "gammainc",
     "square", "divide", "gammaln", "atan", "gcd", "lcm", "cast",
-    # NOTE: no "where" (in-place target is x, not the condition) and no
+    # NOTE: "where" is excluded (its in-place target is x, not the
+    # condition mask) — see the explicit where_ below
     "greater_equal", "erf", "greater_than", "tanh", "transpose",
     "flatten", "multiply", "log", "log2", "log10", "trunc", "frac",
     "digamma", "renorm", "multigammaln", "nan_to_num", "ldexp", "i0",
@@ -233,7 +234,7 @@ def where_(condition, x, y, name=None):
     """In-place where: writes the selected values into X (reference
     where_ contract — the condition is a read-only mask)."""
     x = ensure_tensor(x)
-    out = logic.where(condition, x, y)
+    out = manipulation.where(condition, x, y)
     return rebind_inplace(x, out)
 
 

@@ -22,7 +22,8 @@ __all__ = ["matmul", "bmm", "mm", "mv", "dot", "norm", "dist", "cond",
            "pinv", "det", "slogdet", "solve", "triangular_solve", "lstsq",
            "eig", "eigh", "eigvals", "eigvalsh", "matrix_power", "matrix_rank",
            "multi_dot", "corrcoef", "cov", "householder_product", "lu",
-           "lu_unpack", "einsum"]
+           "lu_unpack", "einsum", "vector_norm", "matrix_norm",
+           "cholesky_inverse", "matrix_exp", "svd_lowrank", "ormqr"]
 
 
 
@@ -315,3 +316,78 @@ def einsum(equation, *operands) -> Tensor:
                     lambda *xs: jnp.einsum(
                         equation, *xs, precision=_mxu_precision(*xs)),
                     tuple(ts), {})
+
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None) -> Tensor:
+    """linalg.vector_norm parity."""
+    x = ensure_tensor(x)
+
+    def f(a):
+        if axis is None:
+            out = jnp.linalg.norm(a.reshape(-1), ord=p)
+            if keepdim:
+                out = out.reshape((1,) * a.ndim)
+            return out
+        return jnp.linalg.norm(a, ord=p, axis=axis, keepdims=keepdim)
+    return apply_op("vector_norm", f, (x,), {})
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None) -> Tensor:
+    """linalg.matrix_norm parity."""
+    x = ensure_tensor(x)
+    return apply_op("matrix_norm",
+                    lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis),
+                                              keepdims=keepdim), (x,), {})
+
+
+def cholesky_inverse(x, upper=False, name=None) -> Tensor:
+    """linalg.cholesky_inverse: inverse from a Cholesky factor."""
+    x = ensure_tensor(x)
+
+    def f(L):
+        A = L.T @ L if upper else L @ L.T
+        return jnp.linalg.inv(A)
+    return apply_op("cholesky_inverse", f, (x,), {})
+
+
+def matrix_exp(x, name=None) -> Tensor:
+    """linalg.matrix_exp via jax.scipy.linalg.expm."""
+    from jax.scipy.linalg import expm
+    return apply_op("matrix_exp", expm, (ensure_tensor(x),), {})
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """linalg.svd_lowrank: randomized range finder + small SVD."""
+    import numpy as _np
+    x = ensure_tensor(x)
+    if M is not None:
+        from .math import subtract
+        x = subtract(x, ensure_tensor(M))
+    m, n = int(x.shape[-2]), int(x.shape[-1])
+    k = min(q, m, n)
+    omega = jnp.asarray(_np.random.RandomState(0).randn(n, k),
+                        x._data.dtype)
+
+    def f(a):
+        aT = jnp.swapaxes(a, -2, -1)  # batched-safe transpose
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (aT @ y)
+        Q, _ = jnp.linalg.qr(y)
+        B = jnp.swapaxes(Q, -2, -1) @ a
+        u, s, vt = jnp.linalg.svd(B, full_matrices=False)
+        return Q @ u, s, jnp.swapaxes(vt, -2, -1)
+    return apply_op("svd_lowrank", f, (x,), {})
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None) -> Tensor:
+    """linalg.ormqr: multiply by Q from a QR (householder) factorization.
+    Materializes Q via householder_product — O(mn^2), fine for the sizes
+    this API is used at."""
+    q = householder_product(x, tau)
+
+    def f(qm, ym):
+        qq = jnp.swapaxes(qm, -2, -1) if transpose else qm
+        return qq @ ym if left else ym @ qq
+    return apply_op("ormqr", f, (ensure_tensor(q), ensure_tensor(y)), {})

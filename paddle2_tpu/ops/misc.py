@@ -164,6 +164,9 @@ def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None) -> Tensor:
         j = jnp.arange(k) + (offset if offset >= 0 else 0)
         idx = [slice(None)] * a.ndim
         idx[axis1], idx[axis2] = i, j
+        # paddle.diagonal puts the diagonal dim LAST in y; numpy advanced
+        # indexing separated by slices puts it FIRST in the set target
+        b = jnp.moveaxis(b, -1, 0)
         return a.at[tuple(idx)].set(b.astype(a.dtype))
     return apply_op("diagonal_scatter", f,
                     (ensure_tensor(x), ensure_tensor(y)), {})

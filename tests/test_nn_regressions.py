@@ -87,3 +87,118 @@ def test_mha_value_defaults_to_query():
     q, k = paddle.randn([1, 3, 8]), paddle.randn([1, 3, 8])
     np.testing.assert_allclose(mha(q, key=k).numpy(),
                                mha(q, key=k, value=q).numpy())
+
+
+def test_extra_losses_and_distance():
+    import paddle2_tpu.nn.functional as F
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(4, 3).astype("float32"))
+    y = paddle.to_tensor(np.sign(rs.randn(4, 3)).astype("float32"))
+    assert float(F.soft_margin_loss(x, y).numpy()) > 0
+    lbl01 = paddle.to_tensor((rs.rand(4, 3) > 0.5).astype("float32"))
+    assert float(F.multi_label_soft_margin_loss(x, lbl01).numpy()) > 0
+    cls = paddle.to_tensor(np.array([0, 1, 2, 0], "int64"))
+    assert float(F.multi_margin_loss(x, cls).numpy()) >= 0
+    var = paddle.to_tensor(np.abs(rs.randn(4, 3)).astype("float32") + 0.1)
+    assert np.isfinite(float(F.gaussian_nll_loss(x, x, var).numpy()))
+    a, p_, n_ = (paddle.to_tensor(rs.randn(4, 3).astype("float32"))
+                 for _ in range(3))
+    t = F.triplet_margin_with_distance_loss(a, p_, n_, margin=0.5)
+    assert float(t.numpy()) >= 0
+    d = F.pairwise_distance(paddle.to_tensor(np.array([[3.0, 4.0]], "float32")),
+                            paddle.to_tensor(np.zeros((1, 2), "float32")))
+    np.testing.assert_allclose(d.numpy(), [5.0], rtol=1e-4)
+    # dice: perfect prediction -> ~0 loss
+    probs = paddle.to_tensor(np.eye(3, dtype="float32")[None])
+    lab = paddle.to_tensor(np.arange(3, dtype="int64").reshape(1, 3, 1))
+    assert float(F.dice_loss(probs, lab).numpy()) < 0.01
+
+
+def test_grid_sample_identity_and_shift():
+    import paddle2_tpu.nn.functional as F
+    rs = np.random.RandomState(0)
+    img = paddle.to_tensor(rs.randn(1, 2, 5, 5).astype("float32"))
+    theta = paddle.to_tensor(
+        np.array([[[1.0, 0, 0], [0, 1.0, 0]]], "float32"))
+    grid = F.affine_grid(theta, [1, 2, 5, 5])
+    out = F.grid_sample(img, grid)
+    np.testing.assert_allclose(out.numpy(), img.numpy(), atol=1e-5)
+    # temporal_shift keeps shape and moves channels across segments
+    ts = F.temporal_shift(paddle.to_tensor(
+        rs.randn(4, 8, 3, 3).astype("float32")), seg_num=2)
+    assert tuple(ts.shape) == (4, 8, 3, 3)
+
+
+def test_new_layers_and_inplace_activations():
+    import paddle2_tpu.nn.functional as F
+    x = paddle.to_tensor(np.array([[-1.0, 2.0]], "float32"))
+    x.stop_gradient = False
+    h = x * 1.0
+    F.relu_(h)
+    np.testing.assert_allclose(h.numpy(), [[0.0, 2.0]])
+    h.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[0.0, 1.0]])
+    u = nn.Unflatten(1, [2, 2])(paddle.ones([3, 4]))
+    assert tuple(u.shape) == (3, 2, 2)
+    zp = nn.ZeroPad1D(1)(paddle.ones([1, 2, 4]))
+    assert tuple(zp.shape) == (1, 2, 6)
+    pd = nn.PairwiseDistance()(paddle.ones([2, 3]), paddle.zeros([2, 3]))
+    assert pd.shape[0] == 2
+
+
+def test_linalg_extras():
+    import paddle2_tpu.ops.linalg as L
+    rs = np.random.RandomState(0)
+    a_np = rs.randn(4, 4).astype("float32")
+    spd = a_np @ a_np.T + 4 * np.eye(4, dtype="float32")
+    chol = np.linalg.cholesky(spd).astype("float32")
+    inv = L.cholesky_inverse(paddle.to_tensor(chol))
+    np.testing.assert_allclose(inv.numpy(), np.linalg.inv(spd), rtol=1e-2,
+                               atol=1e-4)
+    m = paddle.to_tensor(rs.randn(3, 3).astype("float32") * 0.1)
+    from scipy.linalg import expm
+    np.testing.assert_allclose(L.matrix_exp(m).numpy(), expm(m.numpy()),
+                               rtol=1e-4, atol=1e-5)
+    big = paddle.to_tensor(
+        (rs.randn(20, 4) @ rs.randn(4, 10)).astype("float32"))
+    u, s, v = L.svd_lowrank(big, q=4)
+    np.testing.assert_allclose(
+        (u.numpy() * s.numpy()) @ v.numpy().T, big.numpy(), rtol=1e-3,
+        atol=1e-3)
+    np.testing.assert_allclose(
+        float(L.matrix_norm(paddle.ones([2, 2])).numpy()), 2.0, rtol=1e-5)
+
+
+def test_loss_layers_and_containers():
+    import paddle2_tpu.nn.functional as F
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(4, 3).astype("float32"))
+    y = paddle.to_tensor(np.sign(rs.randn(4, 3)).astype("float32"))
+    assert float(nn.SoftMarginLoss()(x, y).numpy()) > 0
+    var = paddle.to_tensor(np.ones((4, 3), "float32"))
+    assert np.isfinite(float(nn.GaussianNLLLoss()(x, x, var).numpy()))
+    pd = nn.ParameterDict({"alpha": paddle.create_parameter([2])})
+    assert "alpha" in pd and pd["alpha"].shape == [2]
+    pd["beta"] = paddle.create_parameter([3])
+    assert sorted(pd.keys()) == ["alpha", "beta"]
+    fa = nn.FeatureAlphaDropout(p=0.5)
+    fa.train()
+    out = fa(paddle.ones([8, 16, 4]))
+    assert tuple(out.shape) == (8, 16, 4)
+    fa.eval()
+    np.testing.assert_allclose(fa(paddle.ones([2, 3, 4])).numpy(), 1.0)
+    # margin cross entropy reduces to plain scaled softmax-CE at 0 margins
+    logits = paddle.to_tensor(rs.rand(4, 8).astype("float32") * 0.5)
+    lbl = paddle.to_tensor(np.array([1, 2, 3, 0]))
+    m0 = F.margin_cross_entropy(logits, lbl, margin1=1.0, margin2=0.0,
+                                margin3=0.0, scale=4.0)
+    ce = F.cross_entropy(logits * 4.0, lbl)
+    np.testing.assert_allclose(float(m0.numpy()), float(ce.numpy()),
+                               rtol=1e-4)
+    # varlen packed qkv wrapper
+    packed = paddle.to_tensor(rs.randn(6, 3, 2, 8).astype("float32"))
+    cu = paddle.to_tensor(np.array([0, 2, 6], "int32"))
+    out, _ = F.flash_attn_varlen_qkvpacked(packed, cu, cu, 4, 4,
+                                           scale=1.0 / np.sqrt(8),
+                                           causal=True)
+    assert tuple(out.shape) == (6, 2, 8)
