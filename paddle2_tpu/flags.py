@@ -95,3 +95,8 @@ define_flag("enable_api_kernel_fallback", True,
 define_flag("eager_vjp_cache", True,
             "Cache per-op linearized VJP computations keyed on shapes/dtypes.")
 define_flag("log_level", 0, "Framework verbosity (VLOG-style).")
+define_flag("donate_optimizer_buffers", True,
+            "Donate parameter/optimizer-state buffers to the fused update "
+            "executable (XLA in-place aliasing; saves ~3x model size of HBM "
+            "traffic per step). Disable if you hold aliases of parameter "
+            "arrays across optimizer steps.")

@@ -1,3 +1,4 @@
 from .api import (InputSpec, TranslatedLayer, enable_to_static,  # noqa: F401
                   ignore_module, load, not_to_static, save, to_static)
 from .functional import TracedProgram  # noqa: F401
+from .train_step import TrainStepProgram, train_step  # noqa: F401

@@ -65,6 +65,36 @@ def _fill_template(template, tensors):
         lambda x: next(it) if x == _SENTINEL else x, template)
 
 
+def _rebound_call(fn, state_tensors, state_arrays, template, arg_arrays,
+                  rng_key, buffers):
+    """Run imperative `fn` functionally: temporarily rebind the given state
+    tensors to (traced) arrays, fill the arg template, call under
+    no_grad + scoped RNG. Returns (out, post_buffer_arrays)."""
+    originals = [t._data for t in state_tensors]
+    for t, a in zip(state_tensors, state_arrays):
+        t._data = a
+    try:
+        with core.no_grad(), fr.scoped_rng(rng_key):
+            call_args, call_kwargs = _fill_template(
+                template, [Tensor(a) for a in arg_arrays])
+            out = fn(*call_args, **call_kwargs)
+        post_buffers = tuple(b._data for b in buffers)
+    finally:
+        for t, a in zip(state_tensors, originals):
+            t._data = a
+    return out, post_buffers
+
+
+def _guard_key(template, arg_arrays, layers):
+    """Shared compile-cache guard: arg treedef + non-tensor leaves +
+    tensor shapes/dtypes + per-layer training mode."""
+    return (jax.tree_util.tree_structure(template),
+            tuple(str(x) for x in jax.tree_util.tree_leaves(template)
+                  if not isinstance(x, (jnp.ndarray,))),
+            tuple((tuple(a.shape), str(a.dtype)) for a in arg_arrays),
+            tuple(getattr(l, "training", False) for l in layers))
+
+
 class TracedProgram:
     """One traced function: guarded cache of compiled executables."""
 
@@ -95,12 +125,8 @@ class TracedProgram:
                                                  jnp.inexact)
                               for t in diff_inputs))
 
-        key = (jax.tree_util.tree_structure(template),
-               tuple(str(x) for x in jax.tree_util.tree_leaves(template)
-                     if not isinstance(x, (jnp.ndarray,))),
-               tuple((tuple(a.shape), str(a.dtype)) for a in arg_arrays),
-               tuple(getattr(l, "training", False) for l in self.layers),
-               core.is_grad_enabled())
+        key = _guard_key(template, arg_arrays, self.layers) + (
+            core.is_grad_enabled(),)
         entry = self._compiled.get(key)
         if entry is None:
             entry = self._build(template, params, buffers, len(args_t))
@@ -150,19 +176,9 @@ class TracedProgram:
         def pure(param_arrays, buffer_arrays, arg_arrays, rng_key):
             """Run the imperative fn functionally.
             Returns (out_arrays tuple, post_buffer_arrays tuple)."""
-            originals = [t._data for t in state_tensors]
-            for t, a in zip(state_tensors, list(param_arrays)
-                            + list(buffer_arrays)):
-                t._data = a
-            try:
-                with core.no_grad(), fr.scoped_rng(rng_key):
-                    call_args, call_kwargs = _fill_template(
-                        template, [Tensor(a) for a in arg_arrays])
-                    out = fn(*call_args, **call_kwargs)
-                post_buffers = tuple(b._data for b in buffers)
-            finally:
-                for t, a in zip(state_tensors, originals):
-                    t._data = a
+            out, post_buffers = _rebound_call(
+                fn, state_tensors, list(param_arrays) + list(buffer_arrays),
+                template, arg_arrays, rng_key, buffers)
             flat, treedef = jax.tree_util.tree_flatten(
                 out, is_leaf=lambda x: isinstance(x, Tensor))
             out_arrays = tuple(o._data if isinstance(o, Tensor)

@@ -101,8 +101,14 @@ class Optimizer:
     def _decoupled_wd(self) -> bool:
         return False  # AdamW overrides
 
-    def _make_update_fn(self, n_params, wd_kind, wd, need_clip_flags,
-                        decay_flags):
+    def _build_update(self, need_clip_flags, decay_flags):
+        """The pure fused update `(params, grads, states, lr, step) ->
+        (new_params, new_states)` over flat lists — the TPU analog of the
+        reference's multi_tensor/fused optimizer kernels
+        (paddle/phi/kernels/fusion/fused_adam_kernel.cu): one traced
+        program updates every parameter. Used jitted-with-donation by
+        step() and inlined by jit.train_step's single-executable path."""
+        wd_kind, wd = self._weight_decay
         decoupled = self._decoupled_wd()
         grad_clip = self._grad_clip
         update_one = self._update_one
@@ -139,7 +145,17 @@ class Optimizer:
                     new_params.append(np_)
                     new_states.append(ns_)
             return new_params, new_states
-        return jax.jit(update)
+        return update
+
+    def _make_update_fn(self, need_clip_flags, decay_flags, donate: bool):
+        # donate the OPTIMIZER STATES (master weights + moments, ~3x model
+        # size in f32): XLA aliases their update in place. Parameter arrays
+        # are NOT donated on this eager path — Tensor.detach()/views may
+        # alias them across steps (jit.train_step, an explicit opt-in API,
+        # donates params too). Grads are never donated — clear_grad owns
+        # their lifetime.
+        return jax.jit(self._build_update(need_clip_flags, decay_flags),
+                       donate_argnums=(2,) if donate else ())
 
     # -- step ------------------------------------------------------------
     @core.no_grad
@@ -161,13 +177,13 @@ class Optimizer:
                             for p in all_params)
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         step = jnp.asarray(self._step_count, jnp.int32)
-        cache_key = (len(params), need_clip, decay_flags,
+        from ..flags import flag_value
+        donate = bool(flag_value("donate_optimizer_buffers"))
+        cache_key = (len(params), need_clip, decay_flags, donate,
                      tuple(p.shape + (str(p.dtype),) for p in params))
         fn = self._jit_cache.get(cache_key)
         if fn is None:
-            wd_kind, wd = self._weight_decay
-            fn = self._make_update_fn(len(params), wd_kind, wd, need_clip,
-                                      decay_flags)
+            fn = self._make_update_fn(need_clip, decay_flags, donate)
             self._jit_cache[cache_key] = fn
         new_params, new_states = fn(params, grads, states, lr, step)
         for p, np_, ns_ in zip(all_params, new_params, new_states):
@@ -197,8 +213,11 @@ class Optimizer:
             for p in group["params"]:
                 key = p.name or f"param_{idx}"
                 if id(p) in self._states:
+                    # snapshot COPIES: live state buffers are donated to the
+                    # next fused update, which would invalidate shared refs
                     out[key] = jax.tree_util.tree_map(
-                        lambda a: Tensor(a) if isinstance(a, jnp.ndarray) else a,
+                        lambda a: Tensor(jnp.array(a, copy=True))
+                        if isinstance(a, jnp.ndarray) else a,
                         self._states[id(p)])
                 idx += 1
         return out
@@ -212,8 +231,11 @@ class Optimizer:
             for p in group["params"]:
                 key = p.name or f"param_{idx}"
                 if key in state_dict:
+                    # copy on load: the restored arrays become donation
+                    # candidates, which must not delete the caller's data
                     self._states[id(p)] = jax.tree_util.tree_map(
-                        lambda a: a._data if isinstance(a, Tensor)
+                        lambda a: jnp.array(a._data, copy=True)
+                        if isinstance(a, Tensor)
                         else jnp.asarray(a) if isinstance(a, np.ndarray) else a,
                         state_dict[key])
                 idx += 1
