@@ -43,14 +43,25 @@ def main():
     if not on_tpu:  # CPU smoke profile so the harness never hangs
         hidden, layers, heads, seq, batch, vocab, steps = 256, 4, 4, 256, 4, 4096, 3
 
+    remat = os.environ.get("BENCH_REMAT", "dots")
     cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
                     num_heads=heads, max_position_embeddings=seq,
                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
                     # scan-over-remat: depth-independent compile and O(1)
-                    # per-layer activation memory (residuals recomputed)
-                    use_recompute=True,
-                    recompute_granularity=os.environ.get(
-                        "BENCH_REMAT", "dots"))
+                    # per-layer activation memory (residuals recomputed);
+                    # BENCH_REMAT=none disables remat entirely (needs the
+                    # fused head loss to fit in HBM)
+                    use_recompute=remat != "none",
+                    recompute_granularity=remat if remat != "none" else "full",
+                    # chunked head+CE: never materializes f32 logits
+                    fused_head_loss=os.environ.get("BENCH_FUSED_CE",
+                                                   "1") == "1")
+    if os.environ.get("BENCH_AUTOTUNE", "0") == "1":
+        from paddle2_tpu.incubate import autotune
+        autotune.set_config({"kernel": {"enable": True}})
+    if os.environ.get("BENCH_FLASH", "1") == "0":
+        from paddle2_tpu.kernels.attention import set_flash_enabled
+        set_flash_enabled(False)
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
@@ -65,8 +76,20 @@ def main():
         return loss
 
     rs = np.random.RandomState(0)
-    ids_np = rs.randint(0, vocab, (batch, seq))
-    ids_dev = paddle.to_tensor(ids_np.astype(np.int32))
+    # distinct batches, cycled: a repeated batch converges to a bf16
+    # fixed point within tens of steps, after which identical inputs +
+    # identical params make steps degenerate (and remote execution layers
+    # may content-cache them) — fresh tokens keep every step real work
+    n_batches = 16
+    batches = [paddle.to_tensor(
+        rs.randint(0, vocab, (batch, seq)).astype(np.int32))
+        for _ in range(n_batches)]
+    it = [0]
+
+    def next_batch():
+        b = batches[it[0] % n_batches]
+        it[0] += 1
+        return b
 
     fused = os.environ.get("BENCH_FUSED", "1") == "1"
     if fused:
@@ -74,12 +97,14 @@ def main():
         fused_step = paddle.jit.train_step(train_fn, o)
 
         def one_step():
-            return fused_step(ids_dev, ids_dev)
+            ids = next_batch()
+            return fused_step(ids, ids)
     else:
         st = paddle.jit.to_static(train_fn)
 
         def one_step():
-            loss = st(ids_dev, ids_dev)
+            ids = next_batch()
+            loss = st(ids, ids)
             loss.backward()
             o.step()
             o.clear_grad()

@@ -9,6 +9,7 @@ from ...nn import Linear as FusedLinear  # noqa
 from ...nn.layer.transformer import (  # noqa
     TransformerEncoderLayer as FusedTransformerEncoderLayer)
 from ..moe import MoELayer  # noqa
+from . import functional  # noqa
 
 __all__ = ["FusedMultiHeadAttention", "FusedLinear",
-           "FusedTransformerEncoderLayer", "MoELayer"]
+           "FusedTransformerEncoderLayer", "MoELayer", "functional"]

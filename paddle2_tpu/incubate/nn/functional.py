@@ -1,0 +1,46 @@
+"""paddle.incubate.nn.functional — genuinely fused TPU kernels.
+
+Unlike the layer aliases in incubate.nn (where XLA's automatic fusion
+covers the reference's fused_* kernels), the ops here are real fusions the
+compiler cannot do on its own."""
+
+from __future__ import annotations
+
+from ...framework.tensor import Tensor
+from ...ops.dispatch import apply_op, ensure_tensor
+
+__all__ = ["fused_linear_cross_entropy"]
+
+
+def fused_linear_cross_entropy(x, weight, label, ignore_index=-100,
+                               reduction="mean", name=None):
+    """Cross-entropy of `softmax(x @ weight)` without materializing the
+    [N, vocab] logits (chunked head+loss; kernels/fused_ce.py). The
+    memory/bandwidth saver for large-vocab LM heads — the analog of the
+    reference's c_softmax_with_cross_entropy fusion
+    (python/paddle/distributed/fleet/layers/mpu/mp_ops.py) for the
+    single-device case.
+
+    x: [N, hidden] (or [B, S, hidden], flattened internally);
+    weight: [hidden, vocab]; label: int [N] / [B, S].
+    reduction: 'mean' over non-ignored tokens | 'sum' | 'none'.
+    """
+    from ...kernels.fused_ce import fused_linear_cross_entropy as kern
+    import jax.numpy as jnp
+
+    x, weight, label = (ensure_tensor(x), ensure_tensor(weight),
+                        ensure_tensor(label))
+
+    def fn(xa, wa, la):
+        hidden = xa.shape[-1]
+        losses, valid = kern(xa.reshape(-1, hidden), wa,
+                             la.reshape(-1).astype(jnp.int32),
+                             int(ignore_index))
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return jnp.sum(losses) / denom
+        if reduction == "sum":
+            return jnp.sum(losses)
+        return losses.reshape(la.shape)
+
+    return apply_op("fused_linear_cross_entropy", fn, (x, weight, label), {})

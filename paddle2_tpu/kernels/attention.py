@@ -50,6 +50,21 @@ import threading
 _flash_tls = threading.local()  # sdp_kernel toggles per-thread
 
 
+def remat_policy(base: str = "dots"):
+    """Rematerialization policy for transformer blocks using this module's
+    attention: the base policy ('dots' = dots_with_no_batch_dims_saveable,
+    'nothing' = full recompute) EXTENDED to always save the flash kernel's
+    named residuals (o, lse), so backward never re-runs the forward pallas
+    kernel. The TPU analog of the reference's recompute_granularity
+    selective lists (fleet recompute 'core_attn' exclusion)."""
+    cp = jax.checkpoint_policies
+    names = cp.save_only_these_names("flash_out", "flash_lse")
+    if base == "dots":
+        return cp.save_from_both_policies(
+            cp.dots_with_no_batch_dims_saveable, names)
+    return names
+
+
 def flash_enabled() -> bool:
     return getattr(_flash_tls, "enabled", True)
 

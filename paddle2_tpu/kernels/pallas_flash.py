@@ -30,10 +30,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# 512-tiles measured best on v5e (grid-step overhead dominates at 128;
-# matches the official jax.experimental flash kernel's throughput)
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+# 1024-tiles measured best on v5e for the GPT bench (scores tile of
+# 1024x1024 f32 = 4MB sits comfortably in VMEM; fewer grid steps beats
+# finer tiling until S is long enough that autotune picks smaller blocks).
+# Env-overridable for per-chip tuning (incubate.autotune searches these).
+import os as _os
+DEFAULT_BLOCK_Q = int(_os.environ.get("FLAGS_flash_block_q", 1024))
+DEFAULT_BLOCK_K = int(_os.environ.get("FLAGS_flash_block_k", 1024))
+# backward kernels may prefer different tiles than forward
+BWD_BLOCK_Q = int(_os.environ.get("FLAGS_flash_bwd_block_q", 0)) or None
+BWD_BLOCK_K = int(_os.environ.get("FLAGS_flash_bwd_block_k", 0)) or None
 NEG_INF = float("-inf")
 
 
@@ -42,6 +48,18 @@ def _interpret_default() -> bool:
         return jax.devices()[0].platform.lower() == "cpu"
     except Exception:
         return True
+
+
+def _fit_block(s: int, want: int):
+    """Largest power-of-two block <= `want` that divides `s`, or None when
+    no 8-row-aligned tiling exists. Requested block sizes are preferences,
+    never correctness hazards: every divisible S gets a valid grid."""
+    b = 1 << (min(want, s).bit_length() - 1)
+    while b >= 8:
+        if s % b == 0:
+            return b
+        b //= 2
+    return None
 
 
 # ---------------------------------------------------------------- forward
@@ -112,7 +130,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     """q,k,v: (B, H, S, D) — returns (o, lse)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    bq, bk = min(block_q, Sq), min(block_k, Sk)
+    bq, bk = _fit_block(Sq, block_q), _fit_block(Sk, block_k)
     nq, nk = Sq // bq, Sk // bk
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
@@ -264,7 +282,9 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
                interpret):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    bq, bk = min(block_q, Sq), min(block_k, Sk)
+    block_q = BWD_BLOCK_Q or block_q
+    block_k = BWD_BLOCK_K or block_k
+    bq, bk = _fit_block(Sq, block_q), _fit_block(Sk, block_k)
     nq, nk = Sq // bq, Sk // bk
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -331,12 +351,17 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
 
 def supported(q_shape, k_shape, block_q=DEFAULT_BLOCK_Q,
               block_k=DEFAULT_BLOCK_K) -> bool:
-    """Kernel shape constraints (reference flash_attn has analogous ones)."""
+    """Kernel shape constraints (reference flash_attn has analogous ones).
+    Block sizes self-fit to the sequence (largest divisor), so any S with
+    an 8-row-aligned tiling is supported regardless of the requested
+    blocks — including the backward-block env overrides."""
     B, Sq, H, D = q_shape
     Sk = k_shape[1]
-    bq, bk = min(block_q, Sq), min(block_k, Sk)
-    return (Sq % bq == 0 and Sk % bk == 0 and D <= 256
-            and k_shape[2] == H)
+    return (_fit_block(Sq, block_q) is not None
+            and _fit_block(Sk, block_k) is not None
+            and _fit_block(Sq, BWD_BLOCK_Q or block_q) is not None
+            and _fit_block(Sk, BWD_BLOCK_K or block_k) is not None
+            and D <= 256 and k_shape[2] == H)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -347,6 +372,13 @@ def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    # name the residuals so rematerialization policies can pin them:
+    # under jax.checkpoint with kernels.attention.remat_policy() the saved
+    # (o, lse) let the backward run WITHOUT re-executing the forward
+    # pallas kernel (the usual flash-under-remat trap)
+    from jax.ad_checkpoint import checkpoint_name
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
 
 

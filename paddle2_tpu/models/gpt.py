@@ -56,6 +56,11 @@ class GPTConfig:
     # TPU recipe for deep transformers. Falls back to the Python loop in
     # eager mode or when dropout makes per-layer RNG streams necessary.
     use_scan: bool = True
+    # compute the LM loss through the chunked fused head+CE kernel
+    # (incubate.nn.functional.fused_linear_cross_entropy): the [tokens,
+    # vocab] f32 logits are never materialized. forward(labels=...) then
+    # returns (None, loss). Single-device / non-TP path only.
+    fused_head_loss: bool = False
 
     @property
     def ffn_size(self) -> int:
@@ -268,12 +273,14 @@ class GPTModel(nn.Layer):
             return out._data, None
 
         if self.cfg.use_recompute and self.training:
+            from ..kernels.attention import remat_policy
             if self.cfg.recompute_granularity == "dots":
-                body = jax.checkpoint(
-                    body, policy=jax.checkpoint_policies
-                    .dots_with_no_batch_dims_saveable)
+                # dots + pinned flash residuals: backward reuses the saved
+                # flash (o, lse) instead of re-running the kernel
+                body = jax.checkpoint(body, policy=remat_policy("dots"))
             else:
-                body = jax.checkpoint(body)
+                body = jax.checkpoint(body,
+                                      policy=remat_policy("nothing"))
         final, _ = jax.lax.scan(body, x._data, stacked)
         out = Tensor(final, stop_gradient=x.stop_gradient)
         return out
@@ -323,6 +330,13 @@ class GPTForCausalLM(nn.Layer):
 
     def forward(self, input_ids, labels=None):
         hidden = self.gpt(input_ids)
+        if (labels is not None and self.cfg.fused_head_loss
+                and not self.cfg.tensor_parallel):
+            from ..incubate.nn.functional import fused_linear_cross_entropy
+            w = (self.gpt.wte.weight.T if self.cfg.tie_word_embeddings
+                 else self.lm_head.weight)
+            loss = fused_linear_cross_entropy(hidden, w, labels)
+            return None, loss
         logits = self._head(hidden)
         if labels is None:
             return logits

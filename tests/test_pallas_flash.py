@@ -193,3 +193,36 @@ def test_sdp_kernel_disables_flash():
     import pytest as _pytest
     with _pytest.raises(ValueError):
         F.sdp_kernel(enable_math=False)
+
+
+def test_block_sizes_self_fit_to_sequence():
+    """Requested blocks are preferences: any 8-row-divisible S tiles
+    correctly even when the default/bwd-override block does not divide it
+    (regression: silent wrong-grid grads with bwd env overrides)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle2_tpu.kernels import pallas_flash as pf
+    from paddle2_tpu.kernels.attention import _sdpa_xla
+
+    assert pf._fit_block(1536, 1024) == 512
+    assert pf._fit_block(384, 1024) == 128
+    assert pf._fit_block(136, 512) == 8
+    assert pf._fit_block(135, 512) is None
+
+    rs = np.random.RandomState(0)
+    S = 384
+    q = jnp.asarray(rs.randn(1, S, 2, 64) * 0.1, jnp.float32)
+    k = jnp.asarray(rs.randn(1, S, 2, 64) * 0.1, jnp.float32)
+    v = jnp.asarray(rs.randn(1, S, 2, 64) * 0.1, jnp.float32)
+    assert pf.supported(q.shape, k.shape, block_q=1024, block_k=1024)
+    o = pf.flash_attention_bshd(q, k, v, causal=True,
+                                block_q=1024, block_k=1024)
+    ref = _sdpa_xla(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=5e-3, atol=5e-3)
+    g = jax.grad(lambda q: pf.flash_attention_bshd(
+        q, k, v, causal=True, block_q=1024, block_k=1024).sum())(q)
+    gref = jax.grad(lambda q: _sdpa_xla(q, k, v, causal=True).sum())(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
+                               rtol=5e-3, atol=5e-3)
