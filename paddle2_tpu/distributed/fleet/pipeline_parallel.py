@@ -264,7 +264,9 @@ class PipelineParallel:
         live = [0] * n_parts
         peak = [0] * n_parts
         self._boundary_grad = {}
-        self._pending_w: Dict[Tuple[int, int], Tensor] = {}
+        # (part, microbatch) -> (stage params, their stashed grads);
+        # the W op applies these deferred accumulations
+        self._pending_w: Dict[Tuple[int, int], Tuple[list, list]] = {}
 
         for tick, part, op, m in trace:
             stage, chunk = part % S, part // S
@@ -298,21 +300,28 @@ class PipelineParallel:
                     nxt_in_grad = self._boundary_grad.pop((part + 1, m))
                     seed = nxt_in_grad
                 if self.schedule == "ZB":
-                    # zero-bubble split: B produces ONLY the input grad
-                    # (what the upstream stage waits on); weight grads are
-                    # the deferred W op. The graph is retained until W.
+                    # zero-bubble split: B releases the INPUT grad (what
+                    # the upstream stage waits on); the weight grads are
+                    # computed in the same single backward traversal and
+                    # stashed — W later just APPLIES them (deferred
+                    # accumulation), so the subgraph is traversed once,
+                    # not twice
                     from ...autograd.tape import grad as tape_grad
+                    params = [p for l in layers.stage_layers(stage, chunk)
+                              for p in l.parameters()
+                              if not p.stop_gradient]
+                    targets = ([x_in] if x_in is not None else []) + params
+                    gs = tape_grad([out], targets, grad_outputs=[seed],
+                                   retain_graph=False, allow_unused=True)
                     if x_in is not None:
-                        (g,) = tape_grad([out], [x_in],
-                                         grad_outputs=[seed],
-                                         retain_graph=True,
-                                         allow_unused=True)
-                        if g is None:
+                        if gs[0] is None:
                             raise RuntimeError(
                                 f"stage boundary {part} produced no "
                                 f"input grad")
-                        self._boundary_grad[(part, m)] = g
-                    self._pending_w[(part, m)] = seed
+                        self._boundary_grad[(part, m)] = gs[0]
+                        gs = gs[1:]
+                    saved.pop((part, m))
+                    self._pending_w[(part, m)] = (params, gs)
                 else:
                     saved.pop((part, m))
                     out.backward(grad_tensor=seed, retain_graph=False)
@@ -325,13 +334,7 @@ class PipelineParallel:
                         self._boundary_grad[(part, m)] = g
                 live[part] -= 1
             else:  # "W": deferred weight-grad half of the zero-bubble split
-                from ...autograd.tape import grad as tape_grad
-                x_in, out = saved.pop((part, m))
-                seed = self._pending_w.pop((part, m))
-                params = [p for l in layers.stage_layers(stage, chunk)
-                          for p in l.parameters() if not p.stop_gradient]
-                gs = tape_grad([out], params, grad_outputs=[seed],
-                               retain_graph=False, allow_unused=True)
+                params, gs = self._pending_w.pop((part, m))
                 for p, g in zip(params, gs):
                     if g is not None:
                         p._accumulate_grad(g._data)

@@ -179,6 +179,7 @@ class Model:
             cbk.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
+            logs = {}  # an empty loader must still yield epoch logs
             for step, batch in enumerate(loader):
                 cbk.on_train_batch_begin(step)
                 ins, lbs = self._split_batch(batch)
@@ -193,7 +194,7 @@ class Model:
                 if (num_iters is not None and it >= num_iters) or \
                         self.stop_training:
                     break
-            epoch_logs = dict(logs) if loader else {}
+            epoch_logs = dict(logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, verbose=0,
                                           num_workers=num_workers)

@@ -224,18 +224,24 @@ def _export_program(fn_call, input_spec, layers=None):
     layers = layers if layers is not None else [fn_call]
     params, buffers = _collect_state(layers)
     state = params + buffers
-    # names mirror _collect_state's order + id-dedup exactly
+    # names mirror _collect_state's order + id-dedup exactly; with
+    # multiple discovered layers a layer-index prefix keeps keys unique
+    # (two layers may both expose 'fc.weight' — a bare dict would
+    # collapse entries and misalign weight_avals); the single-layer case
+    # keeps bare names so saved keys match the layer's own state_dict
     p_names, b_names = [], []
     seen = set()
-    for l in layers:
+    multi = len(layers) > 1
+    for li, l in enumerate(layers):
+        pre = f"l{li}." if multi else ""
         for n, p2 in l.named_parameters():
             if id(p2) not in seen:
                 seen.add(id(p2))
-                p_names.append(n)
+                p_names.append(pre + n)
         for n, b2 in l.named_buffers():
             if b2 is not None and id(b2) not in seen:
                 seen.add(id(b2))
-                b_names.append(n)
+                b_names.append(pre + n)
     names = p_names + b_names
     trainings = [getattr(l, "training", False) for l in layers]
     for l in layers:

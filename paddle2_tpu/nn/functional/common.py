@@ -18,7 +18,7 @@ __all__ = ["feature_alpha_dropout", "linear", "dropout", "dropout2d", "dropout3d
            "embedding", "one_hot", "pad", "zeropad2d", "unfold", "fold",
            "interpolate", "upsample", "pixel_shuffle", "pixel_unshuffle",
            "channel_shuffle", "cosine_similarity", "bilinear", "label_smooth",
-           "class_center_sample", "flash_attention", "normalize"]
+           "class_center_sample", "normalize"]
 
 
 def linear(x, weight, bias=None, name=None) -> Tensor:
@@ -339,16 +339,6 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     remap[sampled] = np.arange(sampled.size)
     remapped = remap[np.asarray(label._data)]
     return (Tensor(jnp.asarray(remapped)), Tensor(jnp.asarray(sampled)))
-
-
-def flash_attention(query, key, value, dropout=0.0, causal=False,
-                    return_softmax=False, name=None):
-    """Memory-efficient attention entry point; the Pallas TPU kernel lives in
-    paddle2_tpu.kernels.flash_attention (phi flash_attn_kernel.cu parity)."""
-    from ...kernels.attention import scaled_dot_product_attention
-    return scaled_dot_product_attention(query, key, value, causal=causal,
-                                        dropout_p=dropout)
-
 
 
 def feature_alpha_dropout(x, p=0.5, training=True, name=None):

@@ -121,14 +121,19 @@ def test_sdpa_api_routes_and_grads():
 def test_functional_flash_attention_api():
     """F.flash_attention / qkvpacked / unpadded (reference
     flash_attention.py:195/:593 surface)."""
+    import types
     import paddle2_tpu as paddle
     import paddle2_tpu.nn.functional as F
+    # like the reference, F.flash_attention is the SUBMODULE; the function
+    # lives inside it (PaddleNLP idiom: F.flash_attention.flash_attention)
+    assert isinstance(F.flash_attention, types.ModuleType)
+    fa = F.flash_attention.flash_attention
     rs = np.random.RandomState(0)
     q = paddle.to_tensor(rs.randn(2, 16, 2, 8).astype("float32"))
-    out, sm = F.flash_attention(q, q, q, causal=True)
+    out, sm = fa(q, q, q, causal=True)
     assert tuple(out.shape) == (2, 16, 2, 8) and sm is None
-    out2, sm2 = F.flash_attention(q, q, q, causal=True,
-                                  return_softmax=True)
+    out2, sm2 = fa(q, q, q, causal=True,
+                   return_softmax=True)
     assert tuple(sm2.shape) == (2, 2, 16, 16)
     np.testing.assert_allclose(sm2.numpy().sum(-1), 1.0, rtol=1e-5)
 
