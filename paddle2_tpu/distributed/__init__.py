@@ -16,7 +16,14 @@ from .collective import (Group, P2POp, ReduceOp, all_gather,
                          is_initialized, isend, new_group, ppermute, recv,
                          reduce, reduce_scatter, scatter, send, wait)
 from .parallel import DataParallel, init_parallel_env, parallel_initialized
-from .sharding import ShardedOptimizer, group_sharded_parallel, shard_optimizer
+from .sharding import ShardedOptimizer, group_sharded_parallel
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (DistModel, Partial, Placement,  # noqa: F401
+                            ProcessMesh, Replicate, Shard, ShardDataloader,
+                            Strategy, dtensor_from_fn, dtensor_from_local,
+                            reshard, shard_dataloader, shard_layer,
+                            shard_optimizer, shard_tensor, to_static,
+                            unshard_dtensor)
 from . import fleet  # noqa: F401
 from . import launch  # noqa: F401
 from . import sep  # noqa: F401
@@ -35,4 +42,10 @@ __all__ = [
     "get_mesh", "init_mesh", "set_mesh", "constrain", "replicated",
     "axis_size", "world_size", "HYBRID_AXES", "parallel_initialized",
     "launch", "ring_attention", "ulysses_attention", "get_logger",
+    # semi-auto SPMD surface (auto_parallel/api.py parity)
+    "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "shard_layer", "shard_optimizer",
+    "unshard_dtensor", "dtensor_from_fn", "dtensor_from_local",
+    "shard_dataloader", "ShardDataloader", "Strategy", "to_static",
+    "DistModel",
 ]

@@ -1,0 +1,17 @@
+"""paddle.distributed.auto_parallel — semi-automatic SPMD (reference
+python/paddle/distributed/auto_parallel/, 51k LoC): ProcessMesh +
+placements + shard_* APIs, lowered to jax NamedSharding/GSPMD."""
+
+from .placement import (Partial, Placement, Replicate, Shard,  # noqa: F401
+                        placements_to_spec, spec_to_placements)
+from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+from .api import (DistModel, ShardDataloader, Strategy,  # noqa: F401
+                  dtensor_from_fn, dtensor_from_local, reshard,
+                  shard_dataloader, shard_layer, shard_optimizer,
+                  shard_tensor, to_static, unshard_dtensor)
+
+__all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+           "shard_tensor", "reshard", "shard_layer", "shard_optimizer",
+           "unshard_dtensor", "dtensor_from_fn", "dtensor_from_local",
+           "shard_dataloader", "ShardDataloader", "Strategy", "to_static",
+           "DistModel", "get_mesh", "set_mesh"]

@@ -1,0 +1,480 @@
+"""Semi-automatic SPMD user API (reference python/paddle/distributed/
+auto_parallel/api.py:206 shard_tensor, :705 reshard, :806 shard_layer,
+:1591 shard_optimizer, :1829 Strategy, :2693 to_static, :2854
+unshard_dtensor, :3208 shard_dataloader).
+
+TPU-native design: a DistTensor is an ordinary Tensor whose payload array
+carries a NamedSharding — placement IS the jax sharding, and the 113
+C++ SPMD rules of the reference (paddle/phi/infermeta/spmd_rules/) are
+subsumed by XLA's GSPMD sharding propagation: annotate the inputs, and
+the partitioner infers every intermediate placement and inserts the
+collectives. The API here is therefore thin by construction, not by
+omission — its job is placement annotation and state plumbing, with the
+heavy lifting in the compiler (SURVEY.md §7 design stance).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...framework.tensor import Parameter, Tensor
+from ...ops.dispatch import apply_op, ensure_tensor
+from .placement import (Partial, Placement, Replicate, Shard,
+                        placements_to_spec, spec_to_placements)
+from .process_mesh import ProcessMesh
+
+__all__ = ["shard_tensor", "reshard", "shard_layer", "shard_optimizer",
+           "unshard_dtensor", "dtensor_from_fn", "dtensor_from_local",
+           "shard_dataloader", "ShardDataloader", "Strategy", "to_static",
+           "DistModel"]
+
+
+def _named_sharding(mesh: ProcessMesh, placements, ndim: int):
+    spec = placements_to_spec(placements, ndim, mesh.dim_names)
+    return NamedSharding(mesh.to_jax_mesh(), spec)
+
+
+def _place_array(arr, sharding):
+    if isinstance(arr, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(arr, sharding)
+    return jax.device_put(arr, sharding)
+
+
+def _placement_op(sharding):
+    """Differentiable placement: forward re-places the value; backward
+    passes the cotangent through UNCHANGED (placement transposes to
+    placement, but forcing the grad back onto the primal's original
+    devices would reject mesh-computed cotangents — the tape accepts any
+    placement for leaf accumulation)."""
+
+    @jax.custom_vjp
+    def f(a):
+        return _place_array(a, sharding)
+
+    f.defvjp(lambda a: (f(a), None), lambda _res, ct: (ct,))
+    return f
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient: Optional[bool] = None
+                 ) -> Tensor:
+    """Create a distributed Tensor placed on `mesh` per `placements`
+    (api.py:206 contract). Scalars/lists/ndarrays are converted first.
+
+    Parameters are sharded IN PLACE (payload re-placed, same object) so
+    existing optimizer/layer references keep working — the reference
+    mutates the param into a DistTensor the same way.
+    """
+    from ...framework import core
+    if not isinstance(data, Tensor):
+        data = Tensor(core.to_jax_array(
+            data, core.convert_dtype(dtype) if dtype else None))
+    sharding = _named_sharding(mesh, placements, data.ndim)
+
+    if isinstance(data, Parameter):
+        # in-place: dtype cast + placement on the SAME Parameter object
+        arr = data._data
+        if dtype is not None:
+            arr = arr.astype(core.convert_dtype(dtype))
+        data._replace_data(_place_array(arr, sharding))
+        return data
+    if dtype is not None:
+        data = data.astype(dtype)
+
+    out = apply_op("shard_tensor", _placement_op(sharding), (data,), {})
+    if stop_gradient is not None:
+        out.stop_gradient = stop_gradient
+    else:
+        out.stop_gradient = data.stop_gradient
+    return out
+
+
+def reshard(dist_tensor, mesh: ProcessMesh,
+            placements: Sequence[Placement],
+            src_partial: Optional[Sequence[str]] = None) -> Tensor:
+    """Change a tensor's placement (api.py:705). All Shard/Replicate
+    transitions (the reference's r_to_s/s_to_r/s_to_s/cross-mesh reshard
+    function registry) are ONE device_put — XLA plans the all-gather /
+    slice / collective-permute. `src_partial` names mesh axes whose
+    partial values must be summed first (the p_to_r/p_to_s transitions):
+    pass it when converting shard_map outputs."""
+    t = ensure_tensor(dist_tensor)
+    sharding = _named_sharding(mesh, placements, t.ndim)
+    if src_partial:
+        raise NotImplementedError(
+            "partial-source reshard: reduce inside the shard_map that "
+            "produced the partial value (jax.lax.psum over "
+            f"{list(src_partial)}) — an eager array cannot carry partial "
+            "state on TPU")
+    return apply_op("reshard", _placement_op(sharding), (t,), {})
+
+
+def unshard_dtensor(dist_tensor) -> Tensor:
+    """Gather to a fully-replicated plain tensor (api.py:2854)."""
+    t = ensure_tensor(dist_tensor)
+    arr = t._data
+    sh = getattr(arr, "sharding", None)
+    if sh is None or not isinstance(sh, NamedSharding):
+        return t
+    repl = NamedSharding(sh.mesh, PartitionSpec())
+    return apply_op("unshard_dtensor", _placement_op(repl), (t,), {})
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh,
+                    placements: Sequence[Placement], *args, **kwargs
+                    ) -> Tensor:
+    """api.py:665: run a creation fn (paddle.ones, ...) then place."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def dtensor_from_local(local_tensor, mesh: ProcessMesh,
+                       placements: Sequence[Placement]) -> Tensor:
+    """api.py:619: assemble a global DistTensor from this process's local
+    shard (multi-host entry path). Single-process meshes place directly."""
+    t = ensure_tensor(local_tensor)
+    spec = placements_to_spec(placements, t.ndim, mesh.dim_names)
+    jm = mesh.to_jax_mesh()
+    if jax.process_count() == 1:
+        # whole value is visible: local == global modulo layout
+        return shard_tensor(t, mesh, placements)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(jm, spec), np.asarray(t._data))
+    return Tensor(arr, stop_gradient=t.stop_gradient)
+
+
+# --------------------------------------------------------------- layers
+
+def shard_layer(layer, process_mesh: ProcessMesh,
+                shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None):
+    """api.py:806: place every parameter of `layer` on `process_mesh`.
+    Default (no shard_fn) replicates all parameters; `shard_fn(name,
+    layer, mesh)` customizes per-sublayer placement by calling
+    shard_tensor on the params it wants sharded. input_fn/output_fn are
+    registered as forward pre/post hooks."""
+    if process_mesh is None:
+        raise ValueError("process_mesh is required")
+
+    def _default(name, sublayer, mesh):
+        for p in sublayer.parameters(include_sublayers=False):
+            if p is not None:
+                shard_tensor(p, mesh, [Replicate()
+                                       for _ in range(mesh.ndim)])
+
+    fn = shard_fn or _default
+    for name, sublayer in layer.named_sublayers(include_self=True):
+        fn(name, sublayer, process_mesh)
+
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda _l, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda _l, _in, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+# ------------------------------------------------------------ optimizer
+
+class _ShardOptimizer:
+    """api.py:981: distributed view of an optimizer — accumulators are
+    created with their parameter's placement (moments of a Shard(0) param
+    are Shard(0)), optionally customized by `shard_fn(accumulator_name,
+    param, accumulator) -> placed accumulator`."""
+
+    def __init__(self, optimizer, shard_fn=None,
+                 gradient_accumulation_steps: int = 1):
+        self._inner = optimizer
+        self._shard_fn = shard_fn
+        self._k = max(1, int(gradient_accumulation_steps))
+        self._calls = 0
+        from ...optimizer.optimizer import Optimizer
+        if isinstance(optimizer, Optimizer):
+            # patch state creation so fresh accumulators are placed like
+            # their parameter; other wrappers (ZeRO ShardedOptimizer)
+            # own their state placement — only the step gating applies
+            inner_ensure = optimizer._ensure_state
+
+            def ensure_state(p):
+                fresh = id(p) not in optimizer._states
+                state = inner_ensure(p)
+                if fresh:
+                    state = self._place_state(p, state)
+                    optimizer._states[id(p)] = state
+                return state
+
+            optimizer._ensure_state = ensure_state
+
+    def _place_state(self, p, state):
+        sh = getattr(p._data, "sharding", None)
+
+        def place(path, a):
+            if not isinstance(a, jnp.ndarray):
+                return a
+            if self._shard_fn is not None:
+                out = self._shard_fn(path, p, Tensor(a))
+                return out._data if isinstance(out, Tensor) else out
+            if isinstance(sh, NamedSharding) and a.shape == p._data.shape:
+                return jax.device_put(a, sh)
+            return a
+
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, a: place(jax.tree_util.keystr(kp), a), state)
+
+    # -- delegation ------------------------------------------------------
+    def step(self):
+        self._calls += 1
+        if self._calls % self._k == 0:
+            self._inner.step()
+
+    def clear_grad(self, set_to_zero: bool = False):
+        if self._calls % self._k == 0:
+            self._inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def shard_optimizer(optimizer, shard_fn: Optional[Callable] = None,
+                    gradient_accumulation_steps: int = 1) -> _ShardOptimizer:
+    """api.py:1591: wrap the optimizer so accumulators follow their
+    parameter's placement (or `shard_fn`'s decision)."""
+    return _ShardOptimizer(optimizer, shard_fn, gradient_accumulation_steps)
+
+
+# ------------------------------------------------------------ dataloader
+
+class ShardDataloader:
+    """api.py:2931: iterate an inner dataloader, placing each batch on the
+    mesh — batch dim sharded over `shard_dims` (a mesh axis name / index),
+    everything else replicated.
+
+    Multi-mesh (pipeline) routing follows the reference contract: with
+    `meshes=[first_stage_mesh, ..., last_stage_mesh]`, the batch's INPUTS
+    go to the first mesh and the LABELS to the last (stage 0 consumes
+    data, the final stage computes the loss). For dict batches,
+    `input_keys` names which keys are inputs; for (inputs, labels)
+    tuples the first element is inputs and the last is labels."""
+
+    def __init__(self, dataloader, meshes, input_keys=None, shard_dims=None,
+                 is_dataset_splitted: bool = False):
+        self._loader = dataloader
+        self._meshes = list(meshes) if isinstance(meshes, (list, tuple)) \
+            else [meshes]
+        self._input_keys = set(input_keys) if input_keys else None
+        mesh = self._meshes[0]
+        if shard_dims is None:
+            self._axis = None
+        elif isinstance(shard_dims, str):
+            self._axis = shard_dims
+        elif isinstance(shard_dims, int):
+            self._axis = mesh.dim_names[shard_dims]
+        else:
+            self._axis = shard_dims[0] if shard_dims else None
+        self._splitted = is_dataset_splitted
+
+    def _placements(self, mesh, ndim):
+        out = [Replicate() for _ in range(mesh.ndim)]
+        if self._axis is not None and ndim > 0 \
+                and self._axis in mesh.dim_names:
+            out[mesh.dim_names.index(self._axis)] = Shard(0)
+        return out
+
+    def _place_leaf(self, item, mesh):
+        t = ensure_tensor(item)
+        if self._splitted:
+            return dtensor_from_local(t, mesh,
+                                      self._placements(mesh, t.ndim))
+        return shard_tensor(t, mesh, self._placements(mesh, t.ndim))
+
+    def _place(self, item, mesh):
+        if isinstance(item, (list, tuple)):
+            return type(item)(self._place(x, mesh) for x in item)
+        if isinstance(item, dict):
+            return {k: self._place(v, mesh) for k, v in item.items()}
+        if isinstance(item, (Tensor, np.ndarray, jnp.ndarray)):
+            return self._place_leaf(item, mesh)
+        return item
+
+    def _route(self, batch):
+        first, last = self._meshes[0], self._meshes[-1]
+        if len(self._meshes) == 1:
+            return self._place(batch, first)
+        if isinstance(batch, dict):
+            keys = self._input_keys or set(list(batch)[:-1])
+            return {k: self._place(v, first if k in keys else last)
+                    for k, v in batch.items()}
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            placed = [self._place(x, first) for x in batch[:-1]]
+            placed.append(self._place(batch[-1], last))
+            return type(batch)(placed)
+        return self._place(batch, first)
+
+    def __iter__(self):
+        for batch in self._loader:
+            yield self._route(batch)
+
+    def __len__(self):
+        return len(self._loader)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
+                     is_dataset_splitted: bool = False) -> ShardDataloader:
+    """api.py:3208 contract."""
+    return ShardDataloader(dataloader, meshes, input_keys, shard_dims,
+                           is_dataset_splitted)
+
+
+# -------------------------------------------------------------- strategy
+
+class _Config:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+class Strategy:
+    """api.py:1829: bundled distributed-training options consumed by
+    dist.to_static. Field names follow the reference's sub-configs
+    (auto_parallel/strategy.py); TPU semantics noted per field."""
+
+    def __init__(self, config: Optional[dict] = None):
+        cfg = config or {}
+
+        def sub(name, **defaults):
+            defaults.update(cfg.get(name, {}))
+            return _Config(**defaults)
+
+        self.sharding = sub("sharding", enable=False, stage=1, degree=-1)
+        self.amp = sub("amp", enable=False, dtype="bfloat16", level="O2")
+        self.recompute = sub("recompute", enable=False, granularity="full")
+        self.pipeline = sub("pipeline", enable=False, schedule_mode="1F1B",
+                            accumulate_steps=1)
+        self.fused_passes = sub("fused_passes", enable=False,
+                                fused_passes_list=[])
+        self.gradient_merge = sub("gradient_merge", enable=False, k_steps=1)
+
+    def __repr__(self):
+        return (f"Strategy(sharding={self.sharding}, amp={self.amp}, "
+                f"recompute={self.recompute}, pipeline={self.pipeline})")
+
+
+# ------------------------------------------------------------- DistModel
+
+class DistModel:
+    """api.py:2110: the trainable artifact returned by dist.to_static —
+    modes train/eval/predict, __call__ runs one step. On TPU the 'static
+    program' is the jit.train_step fused executable (train) / a
+    TracedProgram (eval, predict); every parameter keeps the placement
+    given by shard_tensor/shard_layer and GSPMD partitions the step."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy: Optional[Strategy] = None):
+        self.network = layer
+        self._loss = loss
+        self._strategy = strategy or Strategy()
+        self._mode = "train" if (loss is not None
+                                 and optimizer is not None) else (
+            "eval" if loss is not None else "predict")
+        opt = optimizer
+        if opt is not None and self._strategy.sharding.enable:
+            from ..sharding import ShardedOptimizer
+            stage = int(self._strategy.sharding.stage)
+            level = {1: "os", 2: "os_g", 3: "p_g_os"}.get(stage, "os")
+            opt = ShardedOptimizer(opt, level=level)
+        self._optimizer = opt
+        k = int(self._strategy.gradient_merge.k_steps) \
+            if self._strategy.gradient_merge.enable else 1
+        if self._mode == "train" and k > 1 and opt is not None:
+            self._optimizer = _ShardOptimizer(opt,
+                                              gradient_accumulation_steps=k)
+        self._train_step = None
+        self._eval_prog = None
+
+    # -- reference mode switches ----------------------------------------
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+
+    def _can_fuse(self) -> bool:
+        """The single-executable fused step drives the optimizer's raw
+        update directly, so it is only valid for a PLAIN optimizer: ZeRO
+        (ShardedOptimizer) and gradient-accumulation (_ShardOptimizer)
+        wrappers apply their policies inside step(), which the fused path
+        bypasses — those run the jitted forward/backward + wrapper.step()
+        path instead."""
+        from ...optimizer.optimizer import Optimizer
+        return (type(self._optimizer) is not _ShardOptimizer
+                and isinstance(self._optimizer, Optimizer))
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            if self._can_fuse():
+                if self._train_step is None:
+                    from ...jit.train_step import train_step as make_step
+
+                    def fn(*batch):
+                        out = self.network(*batch[:-1])
+                        return self._loss(out, batch[-1])
+
+                    self._train_step = make_step(fn, self._optimizer,
+                                                 layers=[self.network])
+                return self._train_step(*args)
+            if self._train_step is None:
+                from ...jit.functional import TracedProgram
+
+                def fn(*batch):
+                    out = self.network(*batch[:-1])
+                    return self._loss(out, batch[-1])
+
+                self._train_step = TracedProgram(fn, [self.network])
+            loss = self._train_step(*args)
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+            return Tensor(loss._data, stop_gradient=True)
+        if self._mode == "eval":
+            from ...jit.functional import TracedProgram
+            if self._eval_prog is None:
+                def efn(*batch):
+                    out = self.network(*batch[:-1])
+                    return self._loss(out, batch[-1])
+                # layers bound explicitly: params stay program ARGUMENTS
+                # (fresh values each call), not baked trace constants
+                self._eval_prog = TracedProgram(efn, [self.network])
+            return self._eval_prog(*args)
+        return self.network(*args)
+
+    def state_dict(self, mode: str = "all"):
+        return self.network.state_dict()
+
+    def set_state_dict(self, state_dict):
+        return self.network.set_state_dict(state_dict)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None,
+              strategy: Optional[Strategy] = None,
+              input_spec=None) -> DistModel:
+    """api.py:2693 contract: returns the DistModel; the loader passes
+    through (wrap it with shard_dataloader for dp-sharded batches)."""
+    if isinstance(optimizer, _ShardOptimizer):
+        optimizer = optimizer._inner
+    return DistModel(layer, loader, loss, optimizer, strategy)

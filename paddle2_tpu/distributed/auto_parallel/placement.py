@@ -1,0 +1,133 @@
+"""Placement types (reference paddle/phi/core/distributed/auto_parallel/
+placement_types.h, bound as paddle.distributed.{Shard,Replicate,Partial}).
+
+A placement list has one entry PER MESH DIMENSION and says what that mesh
+axis does to the tensor: `Shard(d)` splits tensor dim `d` across the axis,
+`Replicate()` copies, `Partial(op)` marks pending-reduction values. On TPU
+these translate to/from `jax.sharding.PartitionSpec` entries — the
+spec is per TENSOR dimension, so conversion transposes the view.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from jax.sharding import PartitionSpec
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial",
+           "placements_to_spec", "spec_to_placements"]
+
+
+class Placement:
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return False
+
+    def is_replicated(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self._dim = int(dim)
+
+    def get_dim(self) -> int:
+        return self._dim
+
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return dim is None or dim == self._dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other._dim == self._dim
+
+    def __hash__(self):
+        return hash(("Shard", self._dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self._dim})"
+
+
+class Replicate(Placement):
+    def is_replicated(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    """Pending-reduction placement. XLA's GSPMD produces/consumes partial
+    values only INSIDE compiled computations (e.g. row-parallel matmul
+    before its all-reduce), so a user-held eager DistTensor cannot be
+    Partial; `reshard` accepts Partial as a SOURCE description when
+    converting shard_map outputs. See reshard()."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self._reduce_type = reduce_type
+
+    @property
+    def reduce_type(self):
+        return self._reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return (isinstance(other, Partial)
+                and other._reduce_type == self._reduce_type)
+
+    def __hash__(self):
+        return hash(("Partial", self._reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self._reduce_type})"
+
+
+def placements_to_spec(placements: Sequence[Placement], ndim: int,
+                       mesh_dim_names: Sequence[str]) -> PartitionSpec:
+    """Per-mesh-dim placements -> per-tensor-dim PartitionSpec."""
+    if len(placements) > len(mesh_dim_names):
+        raise ValueError(
+            f"{len(placements)} placements for a "
+            f"{len(mesh_dim_names)}-dim mesh")
+    parts: List[List[str]] = [[] for _ in range(ndim)]
+    for mdim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.get_dim()
+            if not -ndim <= d < ndim:
+                raise ValueError(
+                    f"Shard({d}) out of range for ndim={ndim}")
+            parts[d % ndim].append(mesh_dim_names[mdim])
+        elif isinstance(pl, Partial):
+            raise ValueError(
+                "Partial placement cannot be materialized as an eager "
+                "DistTensor on TPU: partial values exist only inside "
+                "compiled programs (XLA inserts the reduction). Pass the "
+                "reduced tensor, or use dist.reshard(..., src_partial=...) "
+                "to perform the reduction explicitly.")
+    return PartitionSpec(*[
+        tuple(p) if len(p) > 1 else (p[0] if p else None) for p in parts])
+
+
+def spec_to_placements(spec, ndim: int,
+                       mesh_dim_names: Sequence[str]) -> List[Placement]:
+    """Per-tensor-dim PartitionSpec -> per-mesh-dim placements."""
+    out: List[Placement] = [Replicate() for _ in mesh_dim_names]
+    if spec is None:
+        return out
+    entries: Tuple = tuple(spec)
+    for tdim, entry in enumerate(entries[:ndim]):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        for ax in axes:
+            out[list(mesh_dim_names).index(ax)] = Shard(tdim)
+    return out

@@ -1,0 +1,116 @@
+"""ProcessMesh (reference python/paddle/distributed/auto_parallel/
+process_mesh.py:85) — the Cartesian process topology of the semi-auto API.
+
+On TPU a ProcessMesh IS a jax.sharding.Mesh: the rank ids index
+jax.devices() and the dim names become mesh axis names, so every placement
+lowers to a NamedSharding and XLA compiles the collectives over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh"]
+
+_state = {"global_mesh": None}
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"{len(dim_names)} dim_names for a {arr.ndim}-d mesh")
+        self._ids = arr
+        self._dim_names = list(dim_names)
+        self._jax_mesh: Optional[Mesh] = None
+
+    # -- reference surface ----------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._ids.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [int(i) for i in self._ids.reshape(-1)]
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    def get_dim_size(self, name: str) -> int:
+        return self._ids.shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, name: str, index: Optional[int] = None):
+        """Sub-mesh along `name` moved to the front (reference behavior);
+        with `index` set, the (ndim-1)-d slice at that coordinate."""
+        axis = self._dim_names.index(name)
+        moved = np.moveaxis(self._ids, axis, 0)
+        names = ([self._dim_names[axis]]
+                 + [n for i, n in enumerate(self._dim_names) if i != axis])
+        if index is None:
+            return ProcessMesh(moved, names)
+        return ProcessMesh(moved[index], names[1:])
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._dim_names == other._dim_names
+                and np.array_equal(self._ids, other._ids))
+
+    def __hash__(self):
+        return hash((tuple(self._dim_names), self._ids.tobytes(),
+                     self._ids.shape))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+    # -- TPU lowering ----------------------------------------------------
+    def to_jax_mesh(self) -> Mesh:
+        """The jax.sharding.Mesh this topology lowers to. Rank ids index
+        jax.devices(); built lazily and cached."""
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            if int(self._ids.max()) >= len(devices):
+                raise ValueError(
+                    f"ProcessMesh uses rank {int(self._ids.max())} but only "
+                    f"{len(devices)} devices are visible")
+            dev_arr = np.empty(self._ids.shape, dtype=object)
+            for idx in np.ndindex(self._ids.shape):
+                dev_arr[idx] = devices[int(self._ids[idx])]
+            self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    @staticmethod
+    def from_jax_mesh(mesh: Mesh) -> "ProcessMesh":
+        ids = np.vectorize(lambda d: d.id)(mesh.devices)
+        return ProcessMesh(ids, list(mesh.axis_names))
+
+
+def set_mesh(mesh: ProcessMesh) -> None:
+    """Install the global auto-parallel mesh (reference
+    auto_parallel.set_mesh); also installs the jax mesh for collectives."""
+    _state["global_mesh"] = mesh
+    from .. import mesh as base_mesh
+    base_mesh.set_mesh(mesh.to_jax_mesh())
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _state["global_mesh"]

@@ -88,6 +88,35 @@ class Tensor:
         from .. import ops
         return ops.manipulation.t(self)
 
+    # -- distributed attributes (DistTensor surface, dist_tensor.h:39) --
+    # derived from the payload's jax sharding: placement IS the sharding
+    @property
+    def process_mesh(self):
+        """ProcessMesh this tensor is placed on, or None (api.py parity:
+        dist_tensor.process_mesh)."""
+        sh = getattr(self._data, "sharding", None)
+        from jax.sharding import NamedSharding
+        if not isinstance(sh, NamedSharding) or not sh.mesh.axis_names:
+            return None
+        from ..distributed.auto_parallel.process_mesh import ProcessMesh
+        return ProcessMesh.from_jax_mesh(sh.mesh)
+
+    @property
+    def placements(self):
+        """Per-mesh-dim placements (Shard/Replicate list), or None."""
+        sh = getattr(self._data, "sharding", None)
+        from jax.sharding import NamedSharding
+        if not isinstance(sh, NamedSharding) or not sh.mesh.axis_names:
+            return None
+        from ..distributed.auto_parallel.placement import spec_to_placements
+        return spec_to_placements(sh.spec, self._data.ndim,
+                                  sh.mesh.axis_names)
+
+    def is_dist(self) -> bool:
+        """True when placed on a multi-device mesh (DistTensor check)."""
+        sh = getattr(self._data, "sharding", None)
+        return sh is not None and len(getattr(sh, "device_set", ())) > 1
+
     # -- conversion -----------------------------------------------------
     def _guard_value_read(self, what: str) -> None:
         """Under jit.to_static tracing a Tensor has no concrete value: a

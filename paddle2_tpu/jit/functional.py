@@ -116,6 +116,10 @@ class TracedProgram:
     def _call(self, args, kwargs):
         params, buffers = _collect_state(self.layers)
         template, args_t = _split_tensors(args, kwargs)
+        # mesh-placed params + single-device args cannot share a jit
+        # computation: promote stragglers to mesh-replicated (writes back)
+        from ..ops.dispatch import _harmonize_placements
+        _harmonize_placements(params + buffers + args_t)
         arg_arrays = [t._data for t in args_t]
 
         diff_inputs = params + args_t

@@ -78,6 +78,11 @@ class TrainStepProgram:
         states = [opt._states[id(p)] for p in opt_params]
 
         template, args_t = _split_tensors(args, kwargs)
+        # mesh-placed params + single-device args cannot share a jit
+        # computation: promote stragglers to mesh-replicated (writes back)
+        from ..ops.dispatch import _harmonize_placements
+        _harmonize_placements(list(opt_params) + list(frozen)
+                              + list(buffers) + list(args_t))
         arg_arrays = [t._data for t in args_t]
 
         need_clip = tuple(bool(getattr(p, "need_clip", True))
@@ -159,6 +164,17 @@ def train_step(fn: Callable, optimizer, layers: Optional[Sequence] = None
     """Compile `fn` (returning a scalar loss) plus `optimizer`'s update
     into one donated XLA executable. Layers are discovered from `fn`'s
     closure/globals like `to_static` when not given explicitly."""
+    from ..optimizer.optimizer import Optimizer
+    if not isinstance(optimizer, Optimizer):
+        # __getattr__-delegating wrappers (dist.shard_optimizer,
+        # ShardedOptimizer) apply their policies inside step(), which the
+        # fused path bypasses; attribute writes would also land on the
+        # wrapper and shadow the inner state. Refuse loudly.
+        raise TypeError(
+            f"jit.train_step needs a plain paddle Optimizer, got "
+            f"{type(optimizer).__name__}; pass the wrapped optimizer's "
+            "inner instance, or drive wrapper optimizers through "
+            "forward/backward/step")
     if layers is None:
         from .api import _discover_layers
         layers = _discover_layers(fn)

@@ -39,26 +39,33 @@ def _maybe_check_finite(name, out):
 
 
 def _harmonize_placements(tensors) -> tuple:
-    """When a device mesh is active, promote single-device-committed payloads
-    to mesh-replicated so eager ops can mix them with mesh-sharded operands
-    (XLA refuses computations whose committed device sets differ). The
-    promoted placement is written BACK onto the owning Tensor so the
-    device_put is paid once per tensor, not once per op."""
+    """When any operand lives on a multi-device mesh, promote
+    single-device-committed payloads to mesh-replicated so eager ops can
+    mix them (XLA refuses computations whose committed device sets
+    differ). The mesh comes from the multi-device operand itself (a
+    shard_tensor'd DistTensor carries its ProcessMesh), falling back to
+    the installed global mesh. The promoted placement is written BACK
+    onto the owning Tensor so the device_put is paid once per tensor,
+    not once per op."""
     import sys
+    from jax.sharding import NamedSharding, PartitionSpec
     arrays = tuple(t._data for t in tensors)
-    mesh_mod = sys.modules.get("paddle2_tpu.distributed.mesh")
-    if mesh_mod is None or not mesh_mod.mesh_initialized():
-        return arrays
-    multi = False
+    mesh = None
     for a in arrays:
         s = getattr(a, "sharding", None)
-        if s is not None and len(s.device_set) > 1:
-            multi = True
+        if (isinstance(s, NamedSharding) and len(s.device_set) > 1):
+            mesh = s.mesh
             break
-    if not multi:
-        return arrays
-    from jax.sharding import NamedSharding, PartitionSpec
-    repl = NamedSharding(mesh_mod.get_mesh(), PartitionSpec())
+    if mesh is None:
+        mesh_mod = sys.modules.get("paddle2_tpu.distributed.mesh")
+        if mesh_mod is None or not mesh_mod.mesh_initialized():
+            return arrays
+        if any(getattr(a, "sharding", None) is not None
+               and len(a.sharding.device_set) > 1 for a in arrays):
+            mesh = mesh_mod.get_mesh()
+        else:
+            return arrays
+    repl = NamedSharding(mesh, PartitionSpec())
     out = []
     for t, a in zip(tensors, arrays):
         s = getattr(a, "sharding", None)

@@ -1,0 +1,378 @@
+"""Semi-automatic SPMD API (distributed/auto_parallel/) on the 8-device
+CPU mesh.
+
+Covers the reference surface (auto_parallel/api.py:206 shard_tensor, :705
+reshard, :806 shard_layer, :1591 shard_optimizer, :3208 shard_dataloader)
+plus sharding-propagation assertions in the style of the reference's SPMD
+rule unit tests (test/auto_parallel/spmd_rules/test_matmul_rule.py):
+instead of asserting a hand-written rule's dims_mapping, we run the op
+through the real partitioner and assert the resulting placements.
+"""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.distributed as dist
+from paddle2_tpu import nn
+
+
+def _mesh2d():
+    return dist.ProcessMesh(np.arange(8).reshape(4, 2), dim_names=["x", "y"])
+
+
+def _mesh1d():
+    return dist.ProcessMesh(list(range(8)), dim_names=["x"])
+
+
+class TestPlacementConversion:
+    def test_round_trip(self):
+        from paddle2_tpu.distributed.auto_parallel.placement import (
+            placements_to_spec, spec_to_placements)
+        mesh = _mesh2d()
+        pls = [dist.Shard(0), dist.Shard(1)]
+        spec = placements_to_spec(pls, 2, mesh.dim_names)
+        assert tuple(spec) == ("x", "y")
+        back = spec_to_placements(spec, 2, mesh.dim_names)
+        assert back == pls
+
+    def test_replicate_and_partial(self):
+        from paddle2_tpu.distributed.auto_parallel.placement import (
+            placements_to_spec)
+        mesh = _mesh2d()
+        spec = placements_to_spec([dist.Replicate(), dist.Shard(0)], 2,
+                                  mesh.dim_names)
+        assert tuple(spec) == ("y", None)
+        with pytest.raises(ValueError):
+            placements_to_spec([dist.Partial()], 1, ["x"])
+
+
+class TestShardTensor:
+    def test_basic_placement(self):
+        mesh = _mesh2d()
+        a = paddle.ones([8, 4])
+        d = dist.shard_tensor(a, mesh, [dist.Shard(0), dist.Shard(1)])
+        assert d.placements == [dist.Shard(0), dist.Shard(1)]
+        assert d.process_mesh.shape == [4, 2]
+        assert d.is_dist()
+        np.testing.assert_array_equal(d.numpy(), np.ones((8, 4)))
+
+    def test_shard_gradient_flows_back(self):
+        mesh = _mesh1d()
+        a = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+        a.stop_gradient = False
+        d = dist.shard_tensor(a, mesh, [dist.Shard(0)])
+        loss = (d * d).sum()
+        loss.backward()
+        np.testing.assert_allclose(a.grad.numpy(), 2 * a.numpy(), rtol=1e-6)
+
+    def test_parameter_sharded_in_place(self):
+        mesh = _mesh1d()
+        lin = nn.Linear(8, 8)
+        w = lin.weight
+        out = dist.shard_tensor(w, mesh, [dist.Shard(1)])
+        assert out is w
+        assert w.placements == [dist.Shard(1)]
+
+    def test_reshard_transitions(self):
+        mesh = _mesh1d()
+        a = paddle.to_tensor(np.arange(128, dtype=np.float32).reshape(8, 16))
+        s = dist.shard_tensor(a, mesh, [dist.Shard(0)])
+        r = dist.reshard(s, mesh, [dist.Replicate()])       # s_to_r
+        assert r.placements == [dist.Replicate()]
+        s2 = dist.reshard(r, mesh, [dist.Shard(1)])          # r_to_s
+        assert s2.placements == [dist.Shard(1)]
+        s3 = dist.reshard(s2, mesh, [dist.Shard(0)])         # s_to_s
+        assert s3.placements == [dist.Shard(0)]
+        np.testing.assert_array_equal(s3.numpy(), a.numpy())
+
+    def test_unshard(self):
+        mesh = _mesh1d()
+        a = paddle.ones([8, 2])
+        d = dist.shard_tensor(a, mesh, [dist.Shard(0)])
+        u = dist.unshard_dtensor(d)
+        assert u.placements == [dist.Replicate()]
+
+    def test_dtensor_from_fn(self):
+        mesh = _mesh1d()
+        d = dist.dtensor_from_fn(paddle.ones, mesh, [dist.Shard(0)], [8, 4])
+        assert d.placements == [dist.Shard(0)]
+
+
+class TestSpmdPropagation:
+    """Reference spmd-rule assertions via the real partitioner: committed
+    sharded inputs -> op -> inspect output placements."""
+
+    def test_matmul_row_parallel(self):
+        # x: [B, K] Shard(1) over x-axis; w: [K, N] Shard(0) — the
+        # contraction is sharded; the compiled result materializes the
+        # reduced (replicated) output, matching the matmul rule's
+        # partial-sum-then-allreduce contract
+        mesh = _mesh1d()
+        x = dist.shard_tensor(paddle.ones([4, 8]), mesh, [dist.Shard(1)])
+        w = dist.shard_tensor(paddle.ones([8, 16]), mesh, [dist.Shard(0)])
+        out = paddle.matmul(x, w)
+        np.testing.assert_array_equal(out.numpy(), np.full((4, 16), 8.0))
+
+    def test_matmul_column_parallel_output_sharding(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = _mesh1d()
+        jm = mesh.to_jax_mesh()
+
+        def f(x, w):
+            return x @ w
+
+        x = jax.device_put(np.ones((4, 8), np.float32),
+                           NamedSharding(jm, P()))
+        w = jax.device_put(np.ones((8, 16), np.float32),
+                           NamedSharding(jm, P(None, "x")))
+        out = jax.jit(f)(x, w)
+        # column-parallel matmul keeps the output column-sharded
+        # (reference matmul.cc SPMD rule: [-1,-1] x [-1,0] -> [-1,0])
+        from paddle2_tpu.distributed.auto_parallel.placement import (
+            spec_to_placements)
+        pls = spec_to_placements(out.sharding.spec, 2, jm.axis_names)
+        assert pls == [dist.Shard(1)]
+
+    def test_embedding_vocab_replicated_batch_sharded(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = _mesh1d()
+        jm = mesh.to_jax_mesh()
+        table = jax.device_put(np.random.randn(32, 8).astype(np.float32),
+                               NamedSharding(jm, P()))
+        ids = jax.device_put(np.zeros((8, 4), np.int32),
+                             NamedSharding(jm, P("x", None)))
+        out = jax.jit(lambda t, i: t[i])(table, ids)
+        from paddle2_tpu.distributed.auto_parallel.placement import (
+            spec_to_placements)
+        pls = spec_to_placements(out.sharding.spec, 3, jm.axis_names)
+        # batch sharding propagates through the gather (embedding rule)
+        assert pls == [dist.Shard(0)]
+
+    def test_flash_attention_batch_sharding_propagates(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle2_tpu.kernels.attention import _sdpa_xla
+        mesh = _mesh1d()
+        jm = mesh.to_jax_mesh()
+        q = jax.device_put(np.random.randn(8, 16, 2, 8).astype(np.float32),
+                           NamedSharding(jm, P("x")))
+        out = jax.jit(lambda q: _sdpa_xla(q, q, q, causal=True))(q)
+        from paddle2_tpu.distributed.auto_parallel.placement import (
+            spec_to_placements)
+        pls = spec_to_placements(out.sharding.spec, 4, jm.axis_names)
+        assert pls == [dist.Shard(0)]   # flash_attention.cc rule: dp batch
+
+
+class TestShardLayerOptimizer:
+    def test_shard_layer_default_replicates(self):
+        mesh = _mesh1d()
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        dist.shard_layer(m, mesh)
+        for p in m.parameters():
+            assert p.placements is not None
+            assert all(pl.is_replicated() for pl in p.placements)
+
+    def test_shard_layer_custom_fn_and_hooks(self):
+        mesh = _mesh1d()
+        m = nn.Linear(8, 16)
+
+        def shard_fn(name, layer, pm):
+            if isinstance(layer, nn.Linear):
+                dist.shard_tensor(layer.weight, pm, [dist.Shard(1)])
+
+        seen = {}
+
+        def input_fn(inputs, pm):
+            seen["in"] = True
+            return inputs
+
+        def output_fn(outputs, pm):
+            seen["out"] = True
+            return outputs
+
+        dist.shard_layer(m, mesh, shard_fn, input_fn, output_fn)
+        assert m.weight.placements == [dist.Shard(1)]
+        x = paddle.ones([4, 8])
+        m(x)
+        assert seen == {"in": True, "out": True}
+
+    def test_shard_optimizer_states_follow_params(self):
+        import paddle2_tpu.optimizer as opt
+        mesh = _mesh1d()
+        m = nn.Linear(8, 16)
+        dist.shard_tensor(m.weight, mesh, [dist.Shard(1)])
+        o = dist.shard_optimizer(
+            opt.AdamW(learning_rate=0.1, parameters=m.parameters()))
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        loss = (m(x) ** 2).mean()
+        loss.backward()
+        o.step()
+        st = o._states[id(m.weight)]
+        m_moment = st["m"] if "m" in st else st["inner"]["m"]
+        assert Tensor_placements(m_moment) == [dist.Shard(1)]
+        o.clear_grad()
+        assert m.weight.grad is None
+
+    def test_shard_optimizer_custom_fn(self):
+        import paddle2_tpu.optimizer as opt
+        mesh = _mesh1d()
+        m = nn.Linear(8, 16)
+
+        def shard_fn(name, param, acc):
+            return dist.shard_tensor(acc, mesh, [dist.Replicate()])
+
+        o = dist.shard_optimizer(
+            opt.Momentum(learning_rate=0.1, parameters=m.parameters()),
+            shard_fn=shard_fn)
+        x = paddle.ones([4, 8])
+        (m(x).sum()).backward()
+        o.step()
+        st = o._states[id(m.weight)]
+        assert Tensor_placements(st["velocity"]) == [dist.Replicate()]
+
+    def test_gradient_accumulation_steps(self):
+        import paddle2_tpu.optimizer as opt
+        m = nn.Linear(4, 4)
+        before = m.weight.numpy().copy()
+        o = dist.shard_optimizer(
+            opt.SGD(learning_rate=0.1, parameters=m.parameters()),
+            gradient_accumulation_steps=2)
+        x = paddle.ones([2, 4])
+        (m(x).sum()).backward()
+        o.step()                      # 1st call: deferred
+        np.testing.assert_array_equal(m.weight.numpy(), before)
+        (m(x).sum()).backward()
+        o.step()                      # 2nd call: applies
+        assert not np.array_equal(m.weight.numpy(), before)
+
+
+def Tensor_placements(arr):
+    from jax.sharding import NamedSharding
+    from paddle2_tpu.distributed.auto_parallel.placement import (
+        spec_to_placements)
+    sh = getattr(arr, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return None
+    return spec_to_placements(sh.spec, arr.ndim, sh.mesh.axis_names)
+
+
+class TestShardDataloaderAndDistModel:
+    def test_shard_dataloader(self):
+        from paddle2_tpu.io import DataLoader, TensorDataset
+        mesh = _mesh1d()
+        xs = paddle.to_tensor(np.random.randn(16, 4).astype(np.float32))
+        ys = paddle.to_tensor(np.random.randn(16, 2).astype(np.float32))
+        loader = DataLoader(TensorDataset([xs, ys]), batch_size=8)
+        dl = dist.shard_dataloader(loader, mesh, shard_dims="x")
+        assert len(dl) == len(loader)
+        for bx, by in dl:
+            assert bx.placements[0] == dist.Shard(0)
+            assert by.placements[0] == dist.Shard(0)
+
+    def test_dist_model_train_eval(self):
+        import paddle2_tpu.optimizer as opt
+        from paddle2_tpu.io import DataLoader, TensorDataset
+        mesh = _mesh1d()
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        dist.shard_layer(m, mesh)
+        xs = paddle.to_tensor(np.random.randn(16, 4).astype(np.float32))
+        ys = paddle.to_tensor(np.random.randn(16, 2).astype(np.float32))
+        loader = dist.shard_dataloader(
+            DataLoader(TensorDataset([xs, ys]), batch_size=8),
+            mesh, shard_dims="x")
+        o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+        model = dist.to_static(m, loader, nn.MSELoss(), o,
+                               dist.Strategy())
+        losses = []
+        for _ in range(10):
+            for bx, by in loader:
+                losses.append(float(model(bx, by)))
+        assert losses[-1] < losses[0]
+        model.eval()
+        for bx, by in loader:
+            ev = float(model(bx, by))
+        assert np.isfinite(ev)
+        model.predict()
+        out = model(paddle.ones([2, 4]))
+        assert tuple(out.shape) == (2, 2)
+
+    def test_strategy_fields(self):
+        s = dist.Strategy({"sharding": {"enable": True, "stage": 2},
+                           "pipeline": {"enable": True,
+                                        "schedule_mode": "1F1B"}})
+        assert s.sharding.enable and s.sharding.stage == 2
+        assert s.pipeline.schedule_mode == "1F1B"
+
+
+class TestReviewRegressions:
+    def test_train_step_rejects_optimizer_wrappers(self):
+        import paddle2_tpu.optimizer as opt
+        m = nn.Linear(4, 4)
+        wrapped = dist.shard_optimizer(
+            opt.SGD(learning_rate=0.1, parameters=m.parameters()))
+        with pytest.raises(TypeError):
+            paddle.jit.train_step(lambda x: (m(x) ** 2).mean(), wrapped,
+                                  layers=[m])
+
+    def test_dist_model_gradient_merge_defers_updates(self):
+        import paddle2_tpu.optimizer as opt
+        m = nn.Linear(4, 2)
+        before = m.weight.numpy().copy()
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+        model = dist.to_static(
+            m, None, nn.MSELoss(), o,
+            dist.Strategy({"gradient_merge": {"enable": True,
+                                              "k_steps": 2}}))
+        x = paddle.ones([2, 4])
+        y = paddle.zeros([2, 2])
+        model(x, y)                      # call 1: deferred
+        np.testing.assert_array_equal(m.weight.numpy(), before)
+        model(x, y)                      # call 2: applied
+        assert not np.array_equal(m.weight.numpy(), before)
+
+    def test_shard_tensor_param_dtype_stays_in_place(self):
+        mesh = _mesh1d()
+        lin = nn.Linear(8, 8)
+        w = lin.weight
+        out = dist.shard_tensor(w, mesh, [dist.Shard(1)], dtype="bfloat16")
+        assert out is w
+        assert str(w.dtype).endswith("bfloat16")
+        assert w.placements == [dist.Shard(1)]
+
+    def test_shard_dataloader_multi_mesh_routes_labels(self):
+        from paddle2_tpu.io import DataLoader, TensorDataset
+        m0 = dist.ProcessMesh([0, 1, 2, 3], dim_names=["dp"])
+        m1 = dist.ProcessMesh([4, 5, 6, 7], dim_names=["dp"])
+        xs = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+        ys = paddle.to_tensor(np.random.randn(8, 2).astype(np.float32))
+        dl = dist.shard_dataloader(
+            DataLoader(TensorDataset([xs, ys]), batch_size=4),
+            meshes=[m0, m1], shard_dims="dp")
+        for bx, by in dl:
+            assert bx.process_mesh.process_ids == [0, 1, 2, 3]
+            assert by.process_mesh.process_ids == [4, 5, 6, 7]
+
+    def test_dist_model_sharding_strategy_applies_zero(self):
+        import paddle2_tpu.optimizer as opt
+        import paddle2_tpu.distributed as pdist
+        pdist.init_mesh({"dp": 8})
+        m = nn.Linear(8, 8)
+        o = opt.AdamW(learning_rate=0.01, parameters=m.parameters())
+        model = dist.to_static(
+            m, None, nn.MSELoss(), o,
+            dist.Strategy({"sharding": {"enable": True, "stage": 1}}))
+        x = paddle.ones([8, 8])
+        y = paddle.zeros([8, 8])
+        model(x, y)
+        # ZeRO-1: optimizer moments sharded over dp axis
+        st = model._optimizer._inner._states[id(m.weight)] \
+            if hasattr(model._optimizer, "_inner") \
+            else o._states[id(m.weight)]
+        from jax.sharding import NamedSharding
+        sh = st["m"].sharding
+        assert isinstance(sh, NamedSharding)
+        assert any(s is not None for s in sh.spec)
