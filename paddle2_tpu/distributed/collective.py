@@ -282,6 +282,64 @@ def _check_rank_major(t: Tensor, group: Optional[Group]) -> None:
             f"mesh world size {w}, got shape {t.shape}")
 
 
+def _multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def _run_process_level(kind: str, t: Tensor, extra=()) -> Tensor:
+    """Multi-process (multi-controller) collectives: each PROCESS passes
+    its own local tensor and the group ranks are processes — the
+    reference's ProcessGroup semantics (process_group.h:48). Built on
+    the coordination service's process_allgather, which is correct for
+    ANY local-device count (a v4 host driving 4 chips is still one
+    rank). This is the bootstrap/control-plane path; bulk data parallelism
+    on pods should flow through jit+GSPMD shardings, not eager
+    collectives (module docstring)."""
+    from jax.experimental import multihost_utils as mhu
+    local = np.asarray(t._data)
+    g = mhu.process_allgather(local)            # [P, *S] everywhere
+    pid = jax.process_index()
+    nproc = jax.process_count()
+    if kind == "all_reduce_sum":
+        out = g.sum(axis=0)
+    elif kind == "all_reduce_max":
+        out = g.max(axis=0)
+    elif kind == "all_reduce_min":
+        out = g.min(axis=0)
+    elif kind == "all_reduce_prod":
+        out = g.prod(axis=0)
+    elif kind == "all_reduce_avg":
+        out = g.mean(axis=0)
+    elif kind == "broadcast":
+        out = g[extra[0]]
+    elif kind == "all_gather_cat":
+        out = g.reshape((-1,) + g.shape[2:]) if g.ndim > 1 else g
+    elif kind == "all_gather_stack":
+        out = g
+    elif kind == "reduce":
+        dst, op = extra
+        red = {ReduceOp.MAX: g.max(axis=0), ReduceOp.MIN: g.min(axis=0),
+               ReduceOp.PROD: g.prod(axis=0),
+               ReduceOp.AVG: g.mean(axis=0)}.get(op, g.sum(axis=0))
+        out = red if pid == dst else local
+    elif kind == "scatter":
+        # local is [P, *S] on the src (a list stacked by the caller)
+        out = g[extra[0]][pid]
+    elif kind == "all_to_all":
+        # local [P, *S]: block j of each process goes to process j
+        out = g[:, pid]
+    elif kind == "reduce_scatter":
+        red = g.sum(axis=0)
+        out = np.split(red, nproc, axis=0)[pid]
+    else:
+        raise NotImplementedError(
+            f"collective '{kind}' has no multi-process path (send/recv "
+            "p2p pairs inside one controller only; use ppermute-based "
+            "patterns or the GSPMD path for cross-process p2p)")
+    t._replace_data(jnp.asarray(out))
+    return t
+
+
 def _to_mesh(arr: jax.Array) -> jax.Array:
     """Commit a rank-major array onto the mesh (dim0 split across devices)."""
     mesh = mesh_mod.get_mesh()
@@ -313,6 +371,9 @@ def _run(kind: str, t: Tensor, group: Optional[Group], extra=()) -> Tensor:
 
 def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM,
                group: Optional[Group] = None, sync_op: bool = True):
+    if _multiprocess():
+        _run_process_level(f"all_reduce_{op}", tensor)
+        return _Task(tensor)
     _run(f"all_reduce_{op}", tensor, group)
     return _Task(tensor)
 
@@ -324,6 +385,11 @@ def all_gather(tensor_or_list, tensor: Optional[Tensor] = None,
     ([W, G*S0, ...])."""
     if isinstance(tensor_or_list, list):
         out_list, t = tensor_or_list, tensor
+        if _multiprocess():
+            from jax.experimental import multihost_utils as mhu
+            g = mhu.process_allgather(np.asarray(t._data))
+            out_list.extend(Tensor(jnp.asarray(row)) for row in g)
+            return _Task()
         _check_rank_major(t, group)
         g = group if group is not None else _world_group()
         arr = t._data
@@ -340,6 +406,8 @@ def all_gather(tensor_or_list, tensor: Optional[Tensor] = None,
                 block = block[:, 0]
             out_list.append(Tensor(block))
         return _Task()
+    if _multiprocess():
+        return _run_process_level("all_gather_cat", tensor_or_list)
     return _run("all_gather", tensor_or_list, group)
 
 
@@ -358,6 +426,11 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list=None,
         t = concat(t, axis=1)
     if op != ReduceOp.SUM:
         raise NotImplementedError("reduce_scatter supports SUM on TPU")
+    if _multiprocess():
+        out = _run_process_level("reduce_scatter", t)
+        if t is not tensor:
+            tensor._replace_data(out._data)
+        return _Task(tensor)
     out = _run("reduce_scatter", t, group)
     if t is not tensor:
         tensor._replace_data(out._data)
@@ -368,6 +441,9 @@ def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
               sync_op: bool = True):
     g = group if group is not None else _world_group()
     rel = g.get_group_rank(src) if src in g.ranks else src
+    if _multiprocess():
+        _run_process_level("broadcast", tensor, extra=(int(rel),))
+        return _Task(tensor)
     _run("broadcast", tensor, group, extra=(int(rel),))
     return _Task(tensor)
 
@@ -376,6 +452,9 @@ def reduce(tensor: Tensor, dst: int = 0, op: str = ReduceOp.SUM,
            group: Optional[Group] = None, sync_op: bool = True):
     g = group if group is not None else _world_group()
     rel = g.get_group_rank(dst) if dst in g.ranks else dst
+    if _multiprocess():
+        _run_process_level("reduce", tensor, extra=(int(rel), op))
+        return _Task(tensor)
     _run("reduce", tensor, group, extra=(int(rel), op))
     return _Task(tensor)
 
@@ -385,12 +464,24 @@ def scatter(tensor: Tensor, tensor_list=None, src: int = 0,
     """Rank-major: tensor is [W, G, *S] (row src holds the payload);
     result [W, *S]. With tensor_list, the list is stacked first."""
     g = group if group is not None else _world_group()
+    rel = g.get_group_rank(src) if src in g.ranks else src
+    if _multiprocess():
+        import jax as _jax
+        from ..ops.manipulation import stack as _stack
+        nproc = _jax.process_count()
+        if tensor_list is not None and _jax.process_index() == int(rel):
+            payload = Tensor(jnp.stack([x._data for x in tensor_list]))
+        else:
+            payload = Tensor(jnp.zeros((nproc,) + tuple(tensor.shape),
+                                       tensor._data.dtype))
+        out = _run_process_level("scatter", payload, extra=(int(rel),))
+        tensor._replace_data(out._data)
+        return _Task(tensor)
     if tensor_list is not None:
         from ..ops.manipulation import stack
         payload = stack(tensor_list, axis=1)
     else:
         payload = tensor
-    rel = g.get_group_rank(src) if src in g.ranks else src
     out = _run("scatter", payload, group, extra=(int(rel),))
     if payload is not tensor:
         tensor._replace_data(out._data)
@@ -402,7 +493,15 @@ def all_to_all(out_tensor_list, in_tensor_list=None,
     """paddle signature: (out_tensor_list, in_tensor_list). Also accepts a
     single rank-major [W, G, *S] tensor."""
     if isinstance(out_tensor_list, Tensor):
+        if _multiprocess():
+            return _run_process_level("all_to_all", out_tensor_list)
         return _run("all_to_all", out_tensor_list, group)
+    if _multiprocess():
+        t = Tensor(jnp.stack([x._data for x in in_tensor_list]))
+        out = _run_process_level("all_to_all", t)
+        out_tensor_list.extend(Tensor(out._data[i])
+                               for i in range(out._data.shape[0]))
+        return _Task()
     from ..ops.manipulation import stack
     t = stack(in_tensor_list, axis=1)  # [W, G, *S]
     out = _run("all_to_all", t, group)
@@ -424,6 +523,11 @@ def ppermute(tensor: Tensor, perm: Sequence[Tuple[int, int]],
 
 def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None,
          sync_op: bool = True):
+    if _multiprocess():
+        raise NotImplementedError(
+            "cross-process send/recv is not supported: p2p pairs inside "
+            "one controller only — use ppermute-based patterns or the "
+            "GSPMD path for cross-process transfers")
     g = group if group is not None else _world_group()
     _groups.setdefault(g.id, g)
     g._p2p_queue.append((tensor, dst))
@@ -432,6 +536,11 @@ def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None,
 
 def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
          sync_op: bool = True):
+    if _multiprocess():
+        raise NotImplementedError(
+            "cross-process send/recv is not supported: p2p pairs inside "
+            "one controller only — use ppermute-based patterns or the "
+            "GSPMD path for cross-process transfers")
     g = group if group is not None else _world_group()
     # pair with the oldest pending send (single-controller executes both
     # sides of the reference's rank-to-rank handshake at once)
@@ -474,6 +583,10 @@ def wait(tensor: Tensor, group: Optional[Group] = None, use_calc_stream=True):
 
 
 def barrier(group: Optional[Group] = None):
+    if _multiprocess():
+        from jax.experimental import multihost_utils as mhu
+        mhu.sync_global_devices("paddle2_tpu.distributed.barrier")
+        return _Task()
     mesh = mesh_mod.get_mesh()
     w = mesh_mod.world_size()
     token = Tensor(jnp.zeros((w,), jnp.float32))

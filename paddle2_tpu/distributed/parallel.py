@@ -42,14 +42,45 @@ class _AliasTensor(Tensor):
         self._origin._accumulate_grad(g)
 
 
+def _maybe_init_jax_distributed() -> bool:
+    """Multi-host bootstrap (the reference's TCPStore rendezvous,
+    parallel.py:1134 / tcp_store.h:121): when the launcher exported a
+    coordinator address, join JAX's coordination service so every
+    process's local chips form ONE global device set. Idempotent."""
+    import os
+    addr = (os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or os.environ.get("PADDLE_MASTER"))
+    n = int(os.environ.get("JAX_NUM_PROCESSES")
+            or os.environ.get("PADDLE_TRAINERS_NUM") or 1)
+    if not addr or n <= 1:
+        return False
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        return True
+    try:  # older jax: probe the global client instead
+        from jax._src import distributed as _jd
+        if _jd.global_state.client is not None:
+            return True
+    except Exception:
+        pass
+    pid = int(os.environ.get("JAX_PROCESS_ID")
+              or os.environ.get("PADDLE_TRAINER_ID") or 0)
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=n, process_id=pid)
+    return True
+
+
 def init_parallel_env(mesh_axes: Optional[dict] = None) -> ParallelEnv:
     """Bring up the parallel environment (parallel.py:978 parity).
 
-    The reference rendezvouses ranks over TCPStore and creates
-    ProcessGroupNCCL; on TPU the PJRT client already knows every chip, so
-    this just installs the global mesh (all chips on one 'dp' axis unless
-    ``mesh_axes`` says otherwise) and returns the env descriptor.
+    Multi-host: when the launcher exported PADDLE_MASTER /
+    JAX_COORDINATOR_ADDRESS, this first joins the JAX coordination
+    service (`jax.distributed.initialize` — the TCPStore-rendezvous
+    analog), after which jax.devices() spans every host and the global
+    mesh covers the whole job. Single-host: the PJRT client already
+    knows every chip, so this just installs the global mesh (all chips
+    on one 'dp' axis unless ``mesh_axes`` says otherwise).
     """
+    _maybe_init_jax_distributed()
     if mesh_axes is not None or not mesh_mod.mesh_initialized():
         mesh_mod.init_mesh(mesh_axes)
     _initialized["flag"] = True

@@ -151,9 +151,19 @@ class Place:
         return hash((self.device_type, self.device_id))
 
     def jax_device(self):
-        devs = [d for d in jax.devices() if _platform_matches(d, self.device_type)]
+        # LOCAL devices only: in a multi-process job jax.devices() is the
+        # global list, and device_put onto another process's chip would
+        # make the array unreadable here (reference semantics: a Place is
+        # always a local device, device_context.h:37)
+        devs = [d for d in jax.local_devices()
+                if _platform_matches(d, self.device_type)]
         if not devs:
-            devs = jax.devices("cpu")
+            try:
+                devs = jax.local_devices(backend="cpu")
+            except Exception:
+                devs = [d for d in jax.devices("cpu")
+                        if d.process_index == jax.process_index()] \
+                    or jax.devices("cpu")
         return devs[min(self.device_id, len(devs) - 1)]
 
 
