@@ -286,6 +286,16 @@ def _multiprocess() -> bool:
     return jax.process_count() > 1
 
 
+def _mp_group_guard(group: Optional["Group"]) -> None:
+    """Multi-process collectives run over ALL processes; sub-groups would
+    need coordination-service subgroup gathers (not implemented). Refuse
+    loudly instead of silently widening the group."""
+    if group is not None and group is not _world_group():
+        raise NotImplementedError(
+            "multi-process collectives support only the world group; "
+            "axis-aligned sub-groups are a single-controller feature")
+
+
 def _run_process_level(kind: str, t: Tensor, extra=()) -> Tensor:
     """Multi-process (multi-controller) collectives: each PROCESS passes
     its own local tensor and the group ranks are processes — the
@@ -372,6 +382,7 @@ def _run(kind: str, t: Tensor, group: Optional[Group], extra=()) -> Tensor:
 def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM,
                group: Optional[Group] = None, sync_op: bool = True):
     if _multiprocess():
+        _mp_group_guard(group)
         _run_process_level(f"all_reduce_{op}", tensor)
         return _Task(tensor)
     _run(f"all_reduce_{op}", tensor, group)
@@ -386,6 +397,7 @@ def all_gather(tensor_or_list, tensor: Optional[Tensor] = None,
     if isinstance(tensor_or_list, list):
         out_list, t = tensor_or_list, tensor
         if _multiprocess():
+            _mp_group_guard(group)
             from jax.experimental import multihost_utils as mhu
             g = mhu.process_allgather(np.asarray(t._data))
             out_list.extend(Tensor(jnp.asarray(row)) for row in g)
@@ -407,6 +419,7 @@ def all_gather(tensor_or_list, tensor: Optional[Tensor] = None,
             out_list.append(Tensor(block))
         return _Task()
     if _multiprocess():
+        _mp_group_guard(group)
         return _run_process_level("all_gather_cat", tensor_or_list)
     return _run("all_gather", tensor_or_list, group)
 
@@ -423,10 +436,14 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list=None,
     t = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
     if isinstance(t, list):
         from ..ops.manipulation import concat
-        t = concat(t, axis=1)
+        # process-level layout: per-destination chunks concatenate on
+        # axis 0 (the handler splits axis 0 per process); the
+        # single-controller rank-major layout concatenates on axis 1
+        t = concat(t, axis=0 if _multiprocess() else 1)
     if op != ReduceOp.SUM:
         raise NotImplementedError("reduce_scatter supports SUM on TPU")
     if _multiprocess():
+        _mp_group_guard(group)
         out = _run_process_level("reduce_scatter", t)
         if t is not tensor:
             tensor._replace_data(out._data)
@@ -442,7 +459,8 @@ def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
     g = group if group is not None else _world_group()
     rel = g.get_group_rank(src) if src in g.ranks else src
     if _multiprocess():
-        _run_process_level("broadcast", tensor, extra=(int(rel),))
+        _mp_group_guard(group)
+        _run_process_level("broadcast", tensor, extra=(int(src),))
         return _Task(tensor)
     _run("broadcast", tensor, group, extra=(int(rel),))
     return _Task(tensor)
@@ -453,7 +471,8 @@ def reduce(tensor: Tensor, dst: int = 0, op: str = ReduceOp.SUM,
     g = group if group is not None else _world_group()
     rel = g.get_group_rank(dst) if dst in g.ranks else dst
     if _multiprocess():
-        _run_process_level("reduce", tensor, extra=(int(rel), op))
+        _mp_group_guard(group)
+        _run_process_level("reduce", tensor, extra=(int(dst), op))
         return _Task(tensor)
     _run("reduce", tensor, group, extra=(int(rel), op))
     return _Task(tensor)
@@ -466,15 +485,19 @@ def scatter(tensor: Tensor, tensor_list=None, src: int = 0,
     g = group if group is not None else _world_group()
     rel = g.get_group_rank(src) if src in g.ranks else src
     if _multiprocess():
-        import jax as _jax
-        from ..ops.manipulation import stack as _stack
-        nproc = _jax.process_count()
-        if tensor_list is not None and _jax.process_index() == int(rel):
+        _mp_group_guard(group)
+        nproc = jax.process_count()
+        on_src = jax.process_index() == int(src)
+        if on_src and tensor_list is not None:
             payload = Tensor(jnp.stack([x._data for x in tensor_list]))
+        elif on_src:
+            # single-tensor form: src's tensor IS the [P, *S] payload
+            payload = tensor
         else:
-            payload = Tensor(jnp.zeros((nproc,) + tuple(tensor.shape),
+            out_shape = tuple(tensor.shape)
+            payload = Tensor(jnp.zeros((nproc,) + out_shape,
                                        tensor._data.dtype))
-        out = _run_process_level("scatter", payload, extra=(int(rel),))
+        out = _run_process_level("scatter", payload, extra=(int(src),))
         tensor._replace_data(out._data)
         return _Task(tensor)
     if tensor_list is not None:
@@ -494,9 +517,11 @@ def all_to_all(out_tensor_list, in_tensor_list=None,
     single rank-major [W, G, *S] tensor."""
     if isinstance(out_tensor_list, Tensor):
         if _multiprocess():
+            _mp_group_guard(group)
             return _run_process_level("all_to_all", out_tensor_list)
         return _run("all_to_all", out_tensor_list, group)
     if _multiprocess():
+        _mp_group_guard(group)
         t = Tensor(jnp.stack([x._data for x in in_tensor_list]))
         out = _run_process_level("all_to_all", t)
         out_tensor_list.extend(Tensor(out._data[i])

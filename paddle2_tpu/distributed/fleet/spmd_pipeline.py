@@ -27,7 +27,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import mesh as mesh_mod
 
-__all__ = ["pipeline_spmd"]
+__all__ = ["pipeline_spmd", "pipeline_spmd_1f1b"]
 
 
 def _local_body(params, x_micro, *, stage_fn, n_stages, n_micro, axis):
@@ -114,3 +114,166 @@ def pipeline_spmd(stage_fn: Callable, stacked_params, x_micro,
 
 
 _PIPE_CACHE: Dict[Tuple, Any] = {}
+
+
+# ---------------------------------------------------------------------------
+# compiled 1F1B: hand-scheduled forward+backward in ONE scan
+# ---------------------------------------------------------------------------
+#
+# Closed-form schedule (derived from the reference's 1F1B rank loop,
+# fleet/meta_parallel/pipeline_parallel.py:575, re-indexed as global ticks):
+#   warmup  F_m at stage s: tick t = s + m          (m < S - s)
+#   steady  F_m at stage s: tick t = 2m + s         (m >= S - s)
+#   B_i     at stage s:     tick t = 2S - 1 - s + 2i
+# Properties (checked in tests): at most one op per (stage, tick); a
+# forward activation ppermuted at its producer's tick arrives EXACTLY at
+# the consumer's tick (1-tick stage offset), and likewise for backward
+# cotangents — so no in-flight queues are needed; live activations per
+# stage never exceed S+1 microbatches (the 1F1B memory bound, vs GPipe's
+# M). One exception needs a register: each stage's warmup->steady boundary
+# microbatch (m = S - s) arrives at tick S but is consumed at tick 2S - s,
+# so it is latched into a one-slot `pend` register at arrival. Backward
+# recomputes the stage forward from the saved INPUT (the standard TPU
+# recompute-1F1B), so only inputs are buffered.
+
+def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
+              n_stages, n_micro, axis):
+    s = jax.lax.axis_index(axis)
+    S, M = n_stages, n_micro
+    T = 2 * (M + S) - 2           # last op: B_{M-1} at stage 0, t = 2S+2M-3
+    p_local = jax.tree_util.tree_map(lambda a: a[0], params)
+    zero = jnp.zeros_like(x_micro[0])
+    BUF = S + 1
+
+    def apply_stage(x):
+        return stage_fn(p_local, shared, x, s)
+
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [((i + 1) % S, i) for i in range(S)]
+
+    g0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape[1:], jnp.float32), params)
+
+    def tick(carry, t):
+        x_buf, grads, act_in, ct_in, losses, pend = carry
+        # all switch branches must agree on varying-manual-axes types:
+        # zeros emitted by idle/fwd/bwd are explicitly device-varying
+        vzero = jax.lax.pvary(zero, (axis,))
+        d = t - s
+        # op selection per the closed forms above
+        warm_f = (0 <= d) & (d < jnp.minimum(S - s, M)) & (t < S)
+        m_steady = (t - s) // 2
+        steady_f = ((t >= S) & ((t - s) % 2 == 0)
+                    & (m_steady >= S - s) & (m_steady < M))
+        i_b = (t + s + 1 - 2 * S) // 2
+        is_b = (((t + s) % 2 == 1) & (t >= 2 * S - 1 - s)
+                & (i_b >= 0) & (i_b < M))
+        m_f = jnp.where(warm_f, jnp.clip(d, 0, M - 1),
+                        jnp.clip(m_steady, 0, M - 1))
+        is_f = warm_f | steady_f
+
+        def do_fwd(x_buf, grads, losses):
+            # the boundary microbatch was latched at tick S (see header)
+            src = jnp.where(m_f == S - s, pend, act_in)
+            x = jnp.where(s == 0, x_micro[m_f], src)
+            y = apply_stage(x)
+            x_buf = x_buf.at[m_f % BUF].set(x)
+            return x_buf, grads, losses, y, vzero
+
+        def do_bwd(x_buf, grads, losses):
+            i_c = jnp.clip(i_b, 0, M - 1)
+            x = x_buf[i_c % BUF]
+            is_last = s == S - 1
+
+            # one vjp yields BOTH param and input cotangents; the last
+            # stage seeds from the loss, others from the arriving ct
+            def f(p, x):
+                y = stage_fn(p, shared, x, s)
+                lo = loss_fn(y, labels_micro[i_c])
+                return lo, y
+
+            (lo, _y), vjp = jax.vjp(f, p_local, x)
+            dlo = jnp.where(is_last, 1.0 / M, 0.0).astype(lo.dtype)
+            dy = jnp.where(is_last, jnp.zeros_like(ct_in), ct_in)
+            dp, dx = vjp((dlo, dy))
+            grads = jax.tree_util.tree_map(
+                lambda g, d: g + d.astype(jnp.float32), grads, dp)
+            losses = jnp.where(is_last,
+                               losses.at[i_c].set(lo.astype(jnp.float32)),
+                               losses)
+            return x_buf, grads, losses, vzero, dx
+
+        def do_idle(x_buf, grads, losses):
+            return x_buf, grads, losses, vzero, vzero
+
+        op = jnp.where(is_f, 1, 0) + jnp.where(is_b, 2, 0)
+        x_buf, grads, losses, y_out, dx_out = jax.lax.switch(
+            op, [do_idle, do_fwd, do_bwd], x_buf, grads, losses)
+
+        pend = jnp.where(t == S, act_in, pend)
+        act_next = jax.lax.ppermute(y_out, axis, perm_fwd)
+        ct_next = jax.lax.ppermute(dx_out, axis, perm_bwd)
+        return (x_buf, grads, act_next, ct_next, losses, pend), None
+
+    def _varying(v):
+        return jax.lax.pvary(v, (axis,))
+
+    x_buf0 = jnp.zeros((BUF,) + zero.shape, zero.dtype)
+    losses0 = jnp.zeros((M,), jnp.float32)
+    carry0 = (_varying(x_buf0),
+              jax.tree_util.tree_map(_varying, g0),
+              _varying(zero), _varying(zero), _varying(losses0),
+              _varying(zero))
+    (x_buf, grads, _, _, losses, _p), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(T))
+    # losses live on the last stage, grads on their own stage: reduce the
+    # losses across the ring; grads keep per-stage placement
+    losses = jax.lax.psum(losses, axis)
+    grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+    return jnp.sum(losses) / M, grads
+
+
+def pipeline_spmd_1f1b(stage_fn: Callable, stacked_params, x_micro,
+                       labels_micro, loss_fn: Callable, shared_params=None,
+                       mesh_axis: str = "pp"):
+    """Compiled 1F1B: mean loss + stacked parameter grads in ONE program.
+
+    stage_fn(stage_params, shared_params, x, stage_idx) -> y. Stage
+    heterogeneity (embedding first / LM head last) is expressed inside
+    stage_fn by branching on `stage_idx` and reading `shared_params`
+    (replicated on every stage — e.g. tied embedding tables).
+    loss_fn(y_last, label_micro) -> scalar per-microbatch loss; returns
+    (mean loss over microbatches, stacked f32 grads with the 1F1B
+    activation bound of S+1 in-flight microbatches instead of GPipe's M).
+    """
+    mesh = mesh_mod.get_mesh()
+    S = int(mesh.shape[mesh_axis])
+    M = int(x_micro.shape[0])
+    if shared_params is None:
+        shared_params = ()
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != S:
+            raise ValueError(
+                f"stacked param leading axis {leaf.shape[0]} != pipeline "
+                f"degree {S}")
+
+    treedef = jax.tree_util.tree_structure((stacked_params, shared_params))
+    avals = tuple((tuple(l.shape), str(l.dtype)) for l in
+                  jax.tree_util.tree_leaves((stacked_params, shared_params)))
+    key = ("1f1b", id(mesh), mesh_axis, stage_fn, loss_fn, treedef, avals,
+           tuple(x_micro.shape), str(x_micro.dtype))
+    fn = _PIPE_CACHE.get(key)
+    if fn is None:
+        param_specs = jax.tree_util.tree_map(
+            lambda a: P(mesh_axis, *([None] * (a.ndim - 1))),
+            stacked_params)
+        shared_specs = jax.tree_util.tree_map(lambda a: P(), shared_params)
+        body = partial(_f1b_body, stage_fn=stage_fn, loss_fn=loss_fn,
+                       n_stages=S, n_micro=M, axis=mesh_axis)
+        fn = jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(param_specs, shared_specs, P(), P()),
+            out_specs=(P(), param_specs)))
+        _PIPE_CACHE[key] = fn
+    loss, grads = fn(stacked_params, shared_params, x_micro, labels_micro)
+    return loss, grads
