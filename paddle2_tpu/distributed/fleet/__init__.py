@@ -20,7 +20,7 @@ from ..sharding import ShardedOptimizer, group_sharded_parallel
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
 from .pipeline_parallel import PipelineParallel
 from .elastic import ElasticManager, ElasticStatus
-from .spmd_pipeline import pipeline_spmd
+from .spmd_pipeline import pipeline_spmd, pipeline_spmd_1f1b
 
 __all__ = ["init", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",

@@ -62,6 +62,55 @@ def _fit_block(s: int, want: int):
     return None
 
 
+
+def _online_softmax_step(s, v, acc, m_sc, l_sc):
+    """Shared flash-fwd tile update: online softmax recurrence over the
+    masked score tile `s` (NEG_INF = masked). Mutates acc/m_sc/l_sc."""
+    m_prev = m_sc[:, :1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
+    p = jnp.exp(s - safe_m)
+    p = jnp.where(s == NEG_INF, 0.0, p)
+    alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - safe_m))
+    l_new = alpha * l_sc[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+    acc[:] = acc[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT)
+    m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+    l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+
+def _flash_finalize(o_ref, lse_ref, acc, m_sc, l_sc):
+    """Shared flash-fwd epilogue: normalize and emit (o, lse)."""
+    l = l_sc[:, :1]
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc[:] / safe_l).astype(o_ref.dtype)
+    m = m_sc[:, :1]
+    lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(safe_l))
+    lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _scores(q, k, scale):
+    return jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT) * scale
+
+
+def _bwd_p_ds(s, lse, delta, do, v):
+    """Shared flash-bwd tile math: probabilities p and score cotangent ds
+    from the masked tile `s` and saved (lse, delta)."""
+    p = jnp.exp(s - jnp.where(lse == NEG_INF, 0.0, lse))
+    p = jnp.where((s == NEG_INF) | (lse == NEG_INF), 0.0, p)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT)
+    return p, p * (dp - delta)
+
+
 # ---------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
@@ -89,41 +138,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
         v = v_ref[0, 0]                              # (Bk, D)
         # native-dtype (bf16) MXU matmul with f32 accumulation — casting the
         # operands to f32 would fall off the MXU fast path (~8x slower)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.DEFAULT) * scale   # (Bq, Bk) f32
+        s = _scores(q, k, scale)                      # (Bq, Bk) f32
         if causal:
             rows = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0) + q_start + offset
             cols = jax.lax.broadcasted_iota(jnp.int32,
                                             (block_q, block_k), 1) + k_start
             s = jnp.where(rows >= cols, s, NEG_INF)
-        m_prev = m_sc[:, :1]                          # (Bq, 1)
-        m_cur = jnp.max(s, axis=1, keepdims=True)     # (Bq, 1)
-        m_new = jnp.maximum(m_prev, m_cur)
-        # guard fully-masked rows (m == -inf) from producing nan
-        safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
-        p = jnp.exp(s - safe_m)                       # (Bq, Bk)
-        p = jnp.where(s == NEG_INF, 0.0, p)
-        alpha = jnp.where(m_prev == NEG_INF, 0.0,
-                          jnp.exp(m_prev - safe_m))   # (Bq, 1)
-        l_new = alpha * l_sc[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-        acc[:] = acc[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.DEFAULT)
-        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
-        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+        _online_softmax_step(s, v, acc, m_sc, l_sc)
 
     @pl.when(ik == nk - 1)
     def _finalize():
-        l = l_sc[:, :1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc[:] / safe_l).astype(o_ref.dtype)
-        m = m_sc[:, :1]
-        lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(safe_l))
-        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+        _flash_finalize(o_ref, lse_ref, acc, m_sc, l_sc)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -193,29 +219,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, 0]                               # (Bq, D)
         lse = lse_ref[0, 0][:, :1]                      # (Bq, 1)
         delta = delta_ref[0, 0][:, :1]                  # (Bq, 1)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.DEFAULT) * scale  # (Bq, Bk)
+        s = _scores(q, k, scale)                       # (Bq, Bk)
         if causal:
             rows = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0) + q_start + offset
             cols = jax.lax.broadcasted_iota(jnp.int32,
                                             (block_q, block_k), 1) + k_start
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - jnp.where(lse == NEG_INF, 0.0, lse))
-        p = jnp.where((s == NEG_INF) | (lse == NEG_INF), 0.0, p)
-        # dV += P^T dO
+        p, ds = _bwd_p_ds(s, lse, delta, do, v)
+        # dV += P^T dO ; dK += dS^T Q * scale
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.DEFAULT)
-        # dS = P * (dP - delta);  dK += dS^T Q * scale
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.DEFAULT)          # (Bq, Bk)
-        ds = p * (dp - delta)
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -251,23 +267,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, :1]
         delta = delta_ref[0, 0][:, :1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.DEFAULT) * scale
+        s = _scores(q, k, scale)
         if causal:
             rows = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0) + q_start + offset
             cols = jax.lax.broadcasted_iota(jnp.int32,
                                             (block_q, block_k), 1) + k_start
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - jnp.where(lse == NEG_INF, 0.0, lse))
-        p = jnp.where((s == NEG_INF) | (lse == NEG_INF), 0.0, p)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.DEFAULT)
-        ds = p * (dp - delta)
+        _p, ds = _bwd_p_ds(s, lse, delta, do, v)
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -392,6 +399,339 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+# ------------------------------------------------------------- varlen
+# Packed (cu_seqlens) attention: the whole ragged batch stays ONE packed
+# [T, H, D] sequence (reference flash_attn_unpadded,
+# python/paddle/nn/functional/flash_attention.py:593 — no densify). Each
+# row carries a segment id and a causal offset; the mask is
+#   same-segment AND k_off <= q_off
+# where q_off = local_q_pos + (len_k - len_q) (bottom-right alignment per
+# sequence) and k_off = local_k_pos. Tiles whose segment ranges cannot
+# intersect are SKIPPED dynamically (pl.when on the loaded id blocks) —
+# the varlen analog of the causal triangle skip.
+
+def _mk_varlen_mask(sq, oq, sk, ok):
+    # sq/oq: (Bq, 1) int32; sk/ok: (1, Bk) int32 -> (Bq, Bk) bool.
+    # 2-D operands throughout: 1-D slices would force Mosaic relayouts
+    # that blow the scoped-VMEM budget.
+    return (sq == sk) & (ok <= oq)
+
+
+def _fwd_kernel_varlen(q_ref, k_ref, v_ref, sq_ref, oq_ref, sk_ref, ok_ref,
+                       o_ref, lse_ref, acc, m_sc, l_sc, *, scale, nk):
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    sq = sq_ref[0, 0][:, :1]          # (Bq, 1)
+    sk = sk_ref[0, 0][:1]              # (1, Bk)
+    # dynamic tile skip: segments are sorted, so a tile is dead unless
+    # [min(sk), max(sk)] intersects [min(sq), max(sq)]
+    run = (jnp.min(sk) <= jnp.max(sq)) & (jnp.max(sk) >= jnp.min(sq))
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        oq = oq_ref[0, 0][:, :1]
+        ok = ok_ref[0, 0][:1]
+        s = _scores(q, k, scale)
+        s = jnp.where(_mk_varlen_mask(sq, oq, sk, ok), s, NEG_INF)
+        _online_softmax_step(s, v, acc, m_sc, l_sc)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        _flash_finalize(o_ref, lse_ref, acc, m_sc, l_sc)
+
+
+def _bwd_dkv_kernel_varlen(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           sq_ref, oq_ref, sk_ref, ok_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc, *, scale, nq):
+    iq = pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    sq = sq_ref[0, 0][:, :1]          # (Bq, 1)
+    sk = sk_ref[0, 0][:1]              # (1, Bk)
+    run = (jnp.min(sk) <= jnp.max(sq)) & (jnp.max(sk) >= jnp.min(sq))
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        oq = oq_ref[0, 0][:, :1]
+        ok = ok_ref[0, 0][:1]
+        s = _scores(q, k, scale)
+        s = jnp.where(_mk_varlen_mask(sq, oq, sk, ok), s, NEG_INF)
+        p, ds = _bwd_p_ds(s, lse, delta, do, v)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * scale
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel_varlen(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          sq_ref, oq_ref, sk_ref, ok_ref, dq_ref, dq_acc,
+                          *, scale, nk):
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    sq = sq_ref[0, 0][:, :1]          # (Bq, 1)
+    sk = sk_ref[0, 0][:1]              # (1, Bk)
+    run = (jnp.min(sk) <= jnp.max(sq)) & (jnp.max(sk) >= jnp.min(sq))
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        oq = oq_ref[0, 0][:, :1]
+        ok = ok_ref[0, 0][:1]
+        s = _scores(q, k, scale)
+        s = jnp.where(_mk_varlen_mask(sq, oq, sk, ok), s, NEG_INF)
+        _p, ds = _bwd_p_ds(s, lse, delta, do, v)
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * scale
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _lane(x, T):
+    """[T] int32 -> [1, 1, T, 128] lane-tiled q-side metadata."""
+    return jnp.broadcast_to(x.astype(jnp.int32)[None, None, :, None],
+                            (1, 1, T, 128))
+
+
+def _lane_k(x, T):
+    """[T] int32 -> [1, 1, 8, T] sublane-tiled k-side metadata (read as a
+    (1, bk) lane-major block — no transpose in the kernel)."""
+    return jnp.broadcast_to(x.astype(jnp.int32)[None, None, None, :],
+                            (1, 1, 8, T))
+
+
+def _varlen_fwd(q, k, v, sq, oq, sk, ok, scale, block_q, block_k,
+                interpret):
+    """q,k,v: (1, H, T, D). Returns (o, lse)."""
+    _, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    bq, bk = _fit_block(Tq, block_q), _fit_block(Tk, block_k)
+    nq, nk = Tq // bq, Tk // bk
+    q_meta = pl.BlockSpec((1, 1, bq, 128),
+                          lambda b, h, iq, ik: (0, 0, iq, 0))
+    k_meta = pl.BlockSpec((1, 1, 8, bk),
+                          lambda b, h, iq, ik: (0, 0, 0, ik))
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_varlen, scale=scale, nk=nk),
+        grid=(1, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0)),
+            q_meta, q_meta, k_meta, k_meta,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 128), lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((1, H, Tq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, _lane(sq, Tq), _lane(oq, Tq), _lane_k(sk, Tk),
+      _lane_k(ok, Tk))
+    return o, lse[..., 0]
+
+
+def _varlen_bwd(q, k, v, o, lse, do, sq, oq, sk, ok, scale, block_q,
+                block_k, interpret):
+    _, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    block_q = BWD_BLOCK_Q or block_q
+    block_k = BWD_BLOCK_K or block_k
+    bq, bk = _fit_block(Tq, block_q), _fit_block(Tk, block_k)
+    nq, nk = Tq // bq, Tk // bk
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    lse_b = jnp.broadcast_to(lse[..., None], (1, H, Tq, 128))
+    delta_b = jnp.broadcast_to(delta[..., None], (1, H, Tq, 128))
+    sq_l, oq_l = _lane(sq, Tq), _lane(oq, Tq)
+    sk_l, ok_l = _lane_k(sk, Tk), _lane_k(ok, Tk)
+
+    qm = lambda b, h, ik, iq: (b, h, iq, 0)
+    km = lambda b, h, ik, iq: (b, h, ik, 0)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_varlen, scale=scale, nq=nq),
+        grid=(1, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), qm), pl.BlockSpec((1, 1, bk, D), km),
+            pl.BlockSpec((1, 1, bk, D), km), pl.BlockSpec((1, 1, bq, D), qm),
+            pl.BlockSpec((1, 1, bq, 128), qm),
+            pl.BlockSpec((1, 1, bq, 128), qm),
+            pl.BlockSpec((1, 1, bq, 128),
+                         lambda b, h, ik, iq: (0, 0, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 128),
+                         lambda b, h, ik, iq: (0, 0, iq, 0)),
+            pl.BlockSpec((1, 1, 8, bk),
+                         lambda b, h, ik, iq: (0, 0, 0, ik)),
+            pl.BlockSpec((1, 1, 8, bk),
+                         lambda b, h, ik, iq: (0, 0, 0, ik)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, ik, iq: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, ik, iq: (b, h, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((1, H, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse_b, delta_b, sq_l, oq_l, sk_l, ok_l)
+
+    qn = lambda b, h, iq, ik: (b, h, iq, 0)
+    kn = lambda b, h, iq, ik: (b, h, ik, 0)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_varlen, scale=scale, nk=nk),
+        grid=(1, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), qn), pl.BlockSpec((1, 1, bk, D), kn),
+            pl.BlockSpec((1, 1, bk, D), kn), pl.BlockSpec((1, 1, bq, D), qn),
+            pl.BlockSpec((1, 1, bq, 128), qn),
+            pl.BlockSpec((1, 1, bq, 128), qn),
+            pl.BlockSpec((1, 1, bq, 128),
+                         lambda b, h, iq, ik: (0, 0, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 128),
+                         lambda b, h, iq, ik: (0, 0, iq, 0)),
+            pl.BlockSpec((1, 1, 8, bk),
+                         lambda b, h, iq, ik: (0, 0, 0, ik)),
+            pl.BlockSpec((1, 1, 8, bk),
+                         lambda b, h, iq, ik: (0, 0, 0, ik)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D),
+                               lambda b, h, iq, ik: (b, h, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, H, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse_b, delta_b, sq_l, oq_l, sk_l, ok_l)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _flash_varlen(q, k, v, sq, oq, sk, ok, scale, block_q, block_k,
+                  interpret):
+    o, _ = _varlen_fwd(q, k, v, sq, oq, sk, ok, scale, block_q, block_k,
+                       interpret)
+    return o
+
+
+def _flash_varlen_fwd(q, k, v, sq, oq, sk, ok, scale, block_q, block_k,
+                      interpret):
+    o, lse = _varlen_fwd(q, k, v, sq, oq, sk, ok, scale, block_q, block_k,
+                         interpret)
+    return o, (q, k, v, o, lse, sq, oq, sk, ok)
+
+
+def _flash_varlen_bwd(scale, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse, sq, oq, sk, ok = res
+    dq, dk, dv = _varlen_bwd(q, k, v, o, lse, g, sq, oq, sk, ok, scale,
+                             block_q, block_k, interpret)
+    return dq, dk, dv, None, None, None, None
+
+
+_flash_varlen.defvjp(_flash_varlen_fwd, _flash_varlen_bwd)
+
+
+# eager calls must hit a CACHED jitted entry: rebuilding the pallas_call
+# closure per call would re-trace (and re-run the Mosaic compiler) every
+# time — jit-per-config gives the C++ dispatch fast path instead
+_JIT_CACHE: dict = {}
+
+
+def _cached_jit(key, builder):
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(builder())
+        _JIT_CACHE[key] = fn
+    return fn
+
+
+def flash_attention_varlen_packed(q, k, v, seg_q, off_q, seg_k, off_k,
+                                  scale=None, block_q=None, block_k=None,
+                                  interpret=None):
+    """Packed varlen flash attention.
+
+    q: [Tq, H, D], k/v: [Tk, H, D] packed rows (pad T to a multiple of 8
+    with seg id -1 / -2 rows). seg_*: int32 [T] per-row segment ids
+    (sorted ascending; padding must use ids that never match). off_*:
+    int32 [T] causal offsets — mask keeps (seg equal) & (off_k <= off_q);
+    pass off_q = local_q_pos + (len_k - len_q), off_k = local_k_pos for
+    per-sequence bottom-right-aligned causal, or off_q = +inf-like large
+    values for non-causal. Differentiable (pallas fwd+bwd)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _interpret_default()
+    block_q = block_q or DEFAULT_BLOCK_Q
+    block_k = block_k or DEFAULT_BLOCK_K
+    cfg = (float(scale), int(block_q), int(block_k), bool(interpret))
+    fn = _cached_jit(("varlen",) + cfg, lambda: (
+        lambda q, k, v, sq, oq, sk, ok: jnp.swapaxes(_flash_varlen(
+            jnp.swapaxes(q, 0, 1)[None], jnp.swapaxes(k, 0, 1)[None],
+            jnp.swapaxes(v, 0, 1)[None], sq, oq, sk, ok, *cfg)[0], 0, 1)))
+    return fn(q, k, v, jnp.asarray(seg_q, jnp.int32),
+              jnp.asarray(off_q, jnp.int32),
+              jnp.asarray(seg_k, jnp.int32),
+              jnp.asarray(off_k, jnp.int32))
+
+
 def flash_attention_bshd(q, k, v, causal=False, scale=None,
                          block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                          interpret=None):
@@ -405,9 +745,10 @@ def flash_attention_bshd(q, k, v, causal=False, scale=None,
         return _sdpa_xla(q, k, v, causal=causal, scale=scale)
     if interpret is None:
         interpret = _interpret_default()
-    qh = jnp.swapaxes(q, 1, 2)
-    kh = jnp.swapaxes(k, 1, 2)
-    vh = jnp.swapaxes(v, 1, 2)
-    o = _flash(qh, kh, vh, float(scale), bool(causal), int(block_q),
-               int(block_k), bool(interpret))
-    return jnp.swapaxes(o, 1, 2)
+    cfg = (float(scale), bool(causal), int(block_q), int(block_k),
+           bool(interpret))
+    fn = _cached_jit(("bshd",) + cfg, lambda: (
+        lambda q, k, v: jnp.swapaxes(
+            _flash(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                   jnp.swapaxes(v, 1, 2), *cfg), 1, 2)))
+    return fn(q, k, v)

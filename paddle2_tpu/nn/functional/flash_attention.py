@@ -100,6 +100,19 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         from ...framework import random as fr
         drop_key = fr.next_key()
 
+    # packed pallas path: the ragged batch stays ONE [T, H, D] packed
+    # sequence with per-row segment ids — no O(B*Smax^2) densify
+    from ...kernels.attention import flash_enabled
+    try:
+        on_accel = jax.devices()[0].platform.lower() != "cpu"
+    except Exception:
+        on_accel = False
+    head_dim = int(q.shape[-1])
+    if (on_accel and flash_enabled() and drop_key is None
+            and head_dim <= 256):   # pallas kernel range (supported())
+        return _unpadded_packed(q, k, v, cu_q, cu_k, len_q, len_k,
+                                scale, causal), None
+
     def _row_index(cu, lens, S):
         # [B, S] gather map into the packed rows; out-of-range positions
         # point at a sentinel zero row appended to the source
@@ -146,6 +159,73 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         return jnp.concatenate(rows, axis=0)
     out = apply_op("flash_attn_unpadded", run, (q, k, v), {})
     return out, None
+
+
+_SEG_CACHE: dict = {}
+
+
+def _seg_off_device(cu_q, cu_k, len_q, len_k, causal):
+    """Per-row (segment, causal-offset) metadata as DEVICE arrays, memoized
+    on the cu_seqlens bytes — a bucketed training loop pays the host loop
+    and the four uploads once per bucket, not once per step."""
+    import numpy as np
+    key = (cu_q.tobytes(), cu_k.tobytes(), bool(causal))
+    hit = _SEG_CACHE.get(key)
+    if hit is not None:
+        return hit
+
+    def seg_off(cu, lens, pad_id):
+        T = int(cu[-1])
+        seg = np.full(T, 0, np.int32)
+        off = np.zeros(T, np.int32)
+        for i in range(len(lens)):
+            a, b = int(cu[i]), int(cu[i + 1])
+            seg[a:b] = i
+            off[a:b] = np.arange(b - a)
+        Tp = -(-max(T, 8) // 8) * 8
+        if Tp != T:
+            seg = np.concatenate([seg, np.full(Tp - T, pad_id, np.int32)])
+            off = np.concatenate([off, np.zeros(Tp - T, np.int32)])
+        return seg, off, T, Tp
+
+    seg_q, off_q, Tq, Tqp = seg_off(cu_q, len_q, -1)
+    seg_k, off_k, Tk, Tkp = seg_off(cu_k, len_k, -2)
+    if causal:
+        # bottom-right alignment per sequence: q row allowance shifts by
+        # (len_k - len_q) of its sequence
+        for i in range(len(len_q)):
+            a, b = int(cu_q[i]), int(cu_q[i + 1])
+            off_q[a:b] = off_q[a:b] + int(len_k[i] - len_q[i])
+    else:
+        off_q = np.full_like(off_q, 2 ** 30)
+    out = (jnp.asarray(seg_q), jnp.asarray(off_q), jnp.asarray(seg_k),
+           jnp.asarray(off_k), Tq, Tqp, Tk, Tkp)
+    if len(_SEG_CACHE) > 512:
+        _SEG_CACHE.clear()
+    _SEG_CACHE[key] = out
+    return out
+
+
+def _unpadded_packed(q, k, v, cu_q, cu_k, len_q, len_k, scale, causal):
+    """Packed varlen kernel dispatch (no densify): per-row metadata from
+    the host cu_seqlens (memoized), pallas kernel on the packed rows."""
+    from ...kernels.pallas_flash import flash_attention_varlen_packed
+    sq, oq, sk, ok, Tq, Tqp, Tk, Tkp = _seg_off_device(
+        cu_q, cu_k, len_q, len_k, causal)
+
+    def run(qa, ka, va):
+        def pad_rows(a, Tp):
+            T = a.shape[0]
+            if Tp == T:
+                return a
+            return jnp.concatenate(
+                [a, jnp.zeros((Tp - T,) + a.shape[1:], a.dtype)], axis=0)
+        o = flash_attention_varlen_packed(
+            pad_rows(qa, Tqp), pad_rows(ka, Tkp), pad_rows(va, Tkp),
+            sq, oq, sk, ok, scale=scale)
+        return o[:Tq]
+
+    return apply_op("flash_attn_unpadded_packed", run, (q, k, v), {})
 
 
 class sdp_kernel:

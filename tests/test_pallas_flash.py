@@ -231,3 +231,91 @@ def test_block_sizes_self_fit_to_sequence():
     gref = jax.grad(lambda q: _sdpa_xla(q, k, v, causal=True).sum())(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
                                rtol=5e-3, atol=5e-3)
+
+
+class TestVarlenPacked:
+    """flash_attention_varlen_packed: segment-masked packed kernel vs the
+    per-sequence dense reference, and the flash_attn_unpadded packed
+    dispatch vs the densify path."""
+
+    def _packed_case(self, lens, causal, seed=0):
+        import jax
+        import jax.numpy as jnp
+        from paddle2_tpu.kernels.pallas_flash import (
+            flash_attention_varlen_packed)
+        from paddle2_tpu.kernels.attention import _sdpa_xla
+        rs = np.random.RandomState(seed)
+        H, D = 2, 16
+        T = sum(lens)
+        q = jnp.asarray(rs.randn(T, H, D) * 0.2, jnp.float32)
+        k = jnp.asarray(rs.randn(T, H, D) * 0.2, jnp.float32)
+        v = jnp.asarray(rs.randn(T, H, D) * 0.2, jnp.float32)
+        cu = np.concatenate([[0], np.cumsum(lens)])
+        seg = np.concatenate([np.full(n, i, np.int32)
+                              for i, n in enumerate(lens)])
+        off = np.concatenate([np.arange(n, dtype=np.int32) for n in lens])
+        Tp = -(-T // 8) * 8
+        seg_q = np.concatenate([seg, np.full(Tp - T, -1, np.int32)])
+        seg_k = np.concatenate([seg, np.full(Tp - T, -2, np.int32)])
+        off_p = np.concatenate([off, np.zeros(Tp - T, np.int32)])
+        off_q = off_p if causal else np.full_like(off_p, 2 ** 30)
+
+        def pad(a):
+            return jnp.concatenate(
+                [a, jnp.zeros((Tp - T, H, D), a.dtype)], axis=0)
+
+        def f(q, k, v):
+            return flash_attention_varlen_packed(
+                pad(q), pad(k), pad(v), seg_q, off_q, seg_k, off_p,
+                interpret=True)[:T]
+
+        out = f(q, k, v)
+        refs = [
+            _sdpa_xla(q[None, int(cu[i]):int(cu[i + 1])],
+                      k[None, int(cu[i]):int(cu[i + 1])],
+                      v[None, int(cu[i]):int(cu[i + 1])],
+                      causal=causal)[0]
+            for i in range(len(lens))]
+        ref = jnp.concatenate(refs, axis=0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=5e-3, atol=5e-3)
+        g = jax.grad(lambda q: f(q, k, v).astype(jnp.float32).sum())(q)
+        gref = jax.grad(lambda q: jnp.concatenate([
+            _sdpa_xla(q[None, int(cu[i]):int(cu[i + 1])],
+                      k[None, int(cu[i]):int(cu[i + 1])],
+                      v[None, int(cu[i]):int(cu[i + 1])],
+                      causal=causal)[0]
+            for i in range(len(lens))], axis=0).astype(jnp.float32).sum())(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
+                                   rtol=5e-3, atol=5e-3)
+
+    def test_causal_ragged(self):
+        self._packed_case([5, 12, 3, 8], causal=True)
+
+    def test_noncausal_ragged(self):
+        self._packed_case([7, 2, 15], causal=False)
+
+    def test_unpadded_packed_matches_densify(self):
+        """flash_attn_unpadded's packed dispatch == its densify path."""
+        import jax.numpy as jnp
+        import paddle2_tpu as paddle
+        import paddle2_tpu.nn.functional as F
+        from paddle2_tpu.nn.functional import flash_attention as fa_mod
+        rs = np.random.RandomState(1)
+        lens = [6, 10, 4]
+        T, H, D = sum(lens), 2, 16
+        cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+        q = paddle.to_tensor(rs.randn(T, H, D).astype(np.float32) * 0.3)
+        k = paddle.to_tensor(rs.randn(T, H, D).astype(np.float32) * 0.3)
+        v = paddle.to_tensor(rs.randn(T, H, D).astype(np.float32) * 0.3)
+        cu_t = paddle.to_tensor(cu)
+        dense, _ = F.flash_attn_unpadded(
+            q, k, v, cu_t, cu_t, max(lens), max(lens),
+            scale=1.0 / np.sqrt(D), causal=True)
+        packed = fa_mod._unpadded_packed(
+            q, k, v, cu.astype(np.int64), cu.astype(np.int64),
+            np.diff(cu).astype(np.int64), np.diff(cu).astype(np.int64),
+            1.0 / np.sqrt(D), True)
+        np.testing.assert_allclose(np.asarray(packed._data),
+                                   np.asarray(dense._data),
+                                   rtol=5e-3, atol=5e-3)
