@@ -9,7 +9,167 @@ from __future__ import annotations
 from ...framework.tensor import Tensor
 from ...ops.dispatch import apply_op, ensure_tensor
 
-__all__ = ["fused_linear_cross_entropy"]
+__all__ = ["fused_linear_cross_entropy", "fused_rotary_position_embedding",
+           "fused_rms_norm", "fused_adamw_kernel"]
+
+_ANGLE_CACHE: dict = {}
+
+
+def _angle_table(S, D, base, neox, dtype):
+    """Memoized rotary angle tables (decode loops call per step)."""
+    import numpy as np
+    import jax.numpy as jnp
+    key = (S, D, base, neox, dtype)
+    hit = _ANGLE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    inv = 1.0 / (base ** (np.arange(0, D, 2, dtype=np.float64) / D))
+    ang = np.arange(S, dtype=np.float64)[:, None] * inv[None]
+    full = np.repeat(ang, 2, axis=1) if neox \
+        else np.concatenate([ang, ang], axis=1)
+    out = (jnp.asarray(np.cos(full), dtype), jnp.asarray(np.sin(full), dtype))
+    if len(_ANGLE_CACHE) > 64:
+        _ANGLE_CACHE.clear()
+    _ANGLE_CACHE[key] = out
+    return out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    time_major=False,
+                                    rotary_emb_base=10000.0):
+    """Reference incubate fused_rotary_position_embedding.py:27 parity.
+
+    q/k/v: [B, S, H, D]. On TPU the half-split convention
+    (use_neox_rotary_style=False) runs the pallas fused_rope kernel —
+    measured 2.23x over the XLA elementwise chain on v5e
+    ([8,2048,16,128] bf16; the per-head angle broadcast stays in VMEM). The neox (adjacent-pair) convention and v (which rotary does
+    not rotate in the reference either unless passed) use the XLA path.
+    Returns (q_out, k_out, v_out) with None passthrough.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    first = next(t for t in (q, k, v) if t is not None)
+    first = ensure_tensor(first)
+    if time_major:
+        raise NotImplementedError("time_major=True: transpose to "
+                                  "[batch, seq, heads, dim] first")
+    B, S, H, D = first.shape
+    if sin is None or cos is None:
+        cos_a, sin_a = _angle_table(S, D, float(rotary_emb_base),
+                                    bool(use_neox_rotary_style),
+                                    str(first._data.dtype))
+    else:
+        cos_a = ensure_tensor(cos)._data.reshape(-1, D)
+        sin_a = ensure_tensor(sin)._data.reshape(-1, D)
+        if cos_a.shape[0] != S and position_ids is None:
+            if cos_a.shape[0] > S:
+                # max-position table: positions are 0..S-1 here
+                cos_a, sin_a = cos_a[:S], sin_a[:S]
+            else:
+                raise ValueError(
+                    f"cos/sin table has {cos_a.shape[0]} positions but "
+                    f"seq_len is {S}; pass position_ids or a table with "
+                    "at least seq_len rows")
+    if position_ids is not None:
+        pos = ensure_tensor(position_ids)._data.astype(jnp.int32)
+        cos_a = cos_a[pos].reshape(B * S, D)
+        sin_a = sin_a[pos].reshape(B * S, D)
+
+    try:
+        on_accel = jax.devices()[0].platform.lower() != "cpu"
+    except Exception:
+        on_accel = False
+
+    def rot_one(arr):
+        if not use_neox_rotary_style and on_accel:
+            from ...kernels.pallas_fused import fused_rope
+            return fused_rope(arr, cos_a, sin_a)
+        c = cos_a.reshape(-1, S, 1, D) if cos_a.shape[0] != S \
+            else cos_a[None, :, None, :]
+        s = sin_a.reshape(-1, S, 1, D) if sin_a.shape[0] != S \
+            else sin_a[None, :, None, :]
+        if use_neox_rotary_style:
+            x1 = arr[..., 0::2]
+            x2 = arr[..., 1::2]
+            rot = jnp.stack([-x2, x1], axis=-1).reshape(arr.shape)
+        else:
+            x1 = arr[..., : D // 2]
+            x2 = arr[..., D // 2:]
+            rot = jnp.concatenate([-x2, x1], axis=-1)
+        return arr * c + rot * s
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        t = ensure_tensor(t)
+        outs.append(apply_op("fused_rope", rot_one, (t,), {}))
+    return tuple(outs)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None):
+    """Pallas one-pass RMSNorm (fwd + custom bwd); with `bias`/`residual`
+    the reference's fused add-then-norm: y = norm(x + bias + residual),
+    returning (y, x + bias + residual) like fused_rms_norm's residual
+    form. Quantized variants are not supported. NOTE: measured 0.83x of
+    the XLA-fused chain on v5e ([8192,1024] bf16 fwd+bwd) — provided for
+    reference parity and as a building block; prefer the plain
+    expression under jit."""
+    from ...kernels.pallas_fused import fused_rms_norm as kern
+    x, w = ensure_tensor(x), ensure_tensor(norm_weight)
+    nd = x.ndim
+    if begin_norm_axis not in (-1, nd - 1):
+        raise NotImplementedError(
+            f"fused_rms_norm normalizes the LAST axis only "
+            f"(begin_norm_axis={begin_norm_axis}, ndim={nd}); reshape "
+            "so the normalized dims are flattened into the last axis")
+    tensors = [x, w]
+    if norm_bias is not None:
+        tensors.append(ensure_tensor(norm_bias))
+    if bias is not None:
+        tensors.append(ensure_tensor(bias))
+    if residual is not None:
+        tensors.append(ensure_tensor(residual))
+
+    def fn(xa, wa, *rest):
+        it = iter(rest)
+        nb = next(it) if norm_bias is not None else None
+        ba = next(it) if bias is not None else None
+        ra = next(it) if residual is not None else None
+        pre = xa
+        if ba is not None:
+            pre = pre + ba
+        if ra is not None:
+            pre = pre + ra
+        out = kern(pre, wa, epsilon=epsilon)
+        if nb is not None:
+            out = out + nb
+        if residual is not None:
+            return out, pre
+        return out
+
+    return apply_op("fused_rms_norm", fn, tuple(tensors), {})
+
+
+def fused_adamw_kernel(param, grad, m, v, master, lr, beta1=0.9,
+                       beta2=0.999, epsilon=1e-8, weight_decay=0.01,
+                       step=1):
+    """Single-pass pallas AdamW (fused_adam_kernel.cu parity). NOTE:
+    measured 0.44x of XLA's fused update on v5e (84M f32 donated) — XLA
+    already emits a one-pass loop for the update chain; kept for parity
+    and for runtimes where the update is not under jit."""
+    from ...kernels.pallas_fused import fused_adamw as kern
+    outs = kern(ensure_tensor(param)._data, ensure_tensor(grad)._data,
+                ensure_tensor(m)._data, ensure_tensor(v)._data,
+                ensure_tensor(master)._data, lr, beta1, beta2, epsilon,
+                weight_decay, step)
+    return tuple(Tensor(a, stop_gradient=True) for a in outs)
 
 
 def fused_linear_cross_entropy(x, weight, label, ignore_index=-100,
