@@ -2,6 +2,9 @@
 
 from .gpt import (GPTConfig, GPTModel, GPTForCausalLM, gpt3_1p3b, gpt_small,
                   gpt_tiny)
+from .ernie import (ErnieConfig, ErnieModel, ErnieForSequenceClassification,
+                    ernie3_base, ernie_tiny)
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt3_1p3b",
-           "gpt_small", "gpt_tiny"]
+           "gpt_small", "gpt_tiny", "ErnieConfig", "ErnieModel",
+           "ErnieForSequenceClassification", "ernie3_base", "ernie_tiny"]

@@ -1,0 +1,51 @@
+"""Shared scan-over-homogeneous-layers machinery (gpt/ernie model zoo).
+
+XLA compiles ONE layer body instead of num_layers copies — HLO size and
+compile time stop growing with depth (a 24-layer GPT-2-medium compile
+dropped from >25 min to under a minute on v5e). Per-layer weights stack
+into a leading layer axis at trace time; the runtime pays one stack copy
+per step for a depth-independent compile.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+
+def scan_layer_stack(layers: Sequence, x: Tensor,
+                     wrap_body: Optional[Callable] = None):
+    """Run a homogeneous layer stack as one lax.scan.
+
+    `wrap_body` optionally transforms the scan body (e.g. jax.checkpoint
+    with a remat policy). Returns the output Tensor, or None when the
+    stack is not homogeneous (caller falls back to the Python loop).
+    """
+    tmpl = layers[0]
+    tmpl_params = dict(tmpl.named_parameters())
+    names = sorted(tmpl_params)
+    for layer in layers:
+        if sorted(n for n, _ in layer.named_parameters()) != names:
+            return None
+    stacked = {n: jnp.stack([dict(layer.named_parameters())[n]._data
+                             for layer in layers]) for n in names}
+
+    def body(carry, layer_params):
+        originals = {n: tmpl_params[n]._data for n in names}
+        for n in names:
+            tmpl_params[n]._data = layer_params[n]
+        try:
+            out = tmpl(Tensor(carry))
+        finally:
+            for n in names:
+                tmpl_params[n]._data = originals[n]
+        return out._data, None
+
+    if wrap_body is not None:
+        body = wrap_body(body)
+    final, _ = jax.lax.scan(body, x._data, stacked)
+    return Tensor(final, stop_gradient=x.stop_gradient)

@@ -1,0 +1,74 @@
+"""ERNIE/BERT encoder family (models/ernie.py; BASELINE config 2)."""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.optimizer as opt
+from paddle2_tpu.models import (ErnieForSequenceClassification, ErnieModel,
+                                ernie_tiny)
+
+
+def test_forward_shapes_and_pooler():
+    paddle.seed(0)
+    cfg = ernie_tiny()
+    m = ErnieModel(cfg)
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (2, 16))
+                           .astype(np.int32))
+    seq_out, pooled = m(ids)
+    assert tuple(seq_out.shape) == (2, 16, cfg.hidden_size)
+    assert tuple(pooled.shape) == (2, cfg.hidden_size)
+
+
+def test_attention_mask_zeroes_padding():
+    paddle.seed(0)
+    cfg = ernie_tiny(hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    m = ErnieForSequenceClassification(cfg)
+    m.eval()
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, (1, 8)).astype(np.int32)
+    mask = np.ones((1, 8), np.int32)
+    mask[0, 4:] = 0
+    # changing masked-out tokens must not change the logits
+    l1 = m(paddle.to_tensor(ids), attention_mask=paddle.to_tensor(mask))
+    ids2 = ids.copy()
+    ids2[0, 4:] = (ids2[0, 4:] + 7) % cfg.vocab_size
+    l2 = m(paddle.to_tensor(ids2), attention_mask=paddle.to_tensor(mask))
+    np.testing.assert_allclose(l1.numpy(), l2.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_scan_matches_loop():
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 256, (2, 16)).astype(np.int32))
+    paddle.seed(0)
+    m1 = ErnieForSequenceClassification(
+        ernie_tiny(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                   use_scan=True))
+    paddle.seed(0)
+    m2 = ErnieForSequenceClassification(
+        ernie_tiny(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                   use_scan=False))
+    st1 = paddle.jit.to_static(lambda x: m1(x))
+    st2 = paddle.jit.to_static(lambda x: m2(x))
+    np.testing.assert_allclose(st1(ids).numpy(), st2(ids).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_finetune_step_decreases_loss():
+    paddle.seed(0)
+    cfg = ernie_tiny(hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    m = ErnieForSequenceClassification(cfg)
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+
+    def fn(ids, labels):
+        _, loss = m(ids, labels=labels)
+        return loss
+
+    step = paddle.jit.train_step(fn, o, layers=[m])
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (4, 16))
+                           .astype(np.int32))
+    lbl = paddle.to_tensor(rs.randint(0, 2, (4,)).astype(np.int32))
+    losses = [float(step(ids, lbl)) for _ in range(8)]
+    assert losses[-1] < losses[0]
