@@ -373,15 +373,19 @@ def bench_scaling():
 
 
 def main():
+    mode = os.environ.get("BENCH_MODEL", "gpt")
+    if mode == "scaling":
+        # must run BEFORE anything imports jax: the device-count env var
+        # is read at backend init
+        return bench_scaling()
     if os.environ.get("BENCH_AUTOTUNE", "0") == "1":
         from paddle2_tpu.incubate import autotune
         autotune.set_config({"kernel": {"enable": True}})
     if os.environ.get("BENCH_FLASH", "1") == "0":
         from paddle2_tpu.kernels.attention import set_flash_enabled
         set_flash_enabled(False)
-    mode = os.environ.get("BENCH_MODEL", "gpt")
-    {"gpt": bench_gpt, "ernie": bench_ernie, "resnet50": bench_resnet50,
-     "scaling": bench_scaling}[mode]()
+    {"gpt": bench_gpt, "ernie": bench_ernie,
+     "resnet50": bench_resnet50}[mode]()
 
 
 if __name__ == "__main__":

@@ -162,6 +162,35 @@ def _rank_spec(mesh) -> P:
     return P(tuple(mesh.axis_names))
 
 
+def _gather_cat_over(x, axes):
+    """Concat of the group's blocks along dim0 (paddle all_gather layout)."""
+    out = x
+    for a in axes[::-1]:
+        out = jax.lax.all_gather(out, a, axis=0, tiled=True)
+    return out
+
+
+def _gather_stack_over(x, axes):
+    """Stack of the group's blocks on a NEW leading dim [G, *S]."""
+    return _gather_cat_over(x[None], axes)
+
+
+def _butterfly_prod(x, axes, mesh):
+    """All-reduce product via a log2(G) recursive-doubling butterfly of
+    collective-permutes — O(1) memory per step (the gather-then-prod
+    fallback materializes [G, *S]). Non-power-of-two groups fall back."""
+    ax = axes if len(axes) > 1 else axes[0]
+    g = int(np.prod([mesh.shape[a] for a in axes]))
+    if len(axes) > 1 or g & (g - 1):
+        return jnp.prod(_gather_stack_over(x, axes), axis=0)
+    shift = 1
+    while shift < g:
+        perm = [(i, i ^ shift) for i in range(g)]
+        x = x * jax.lax.ppermute(x, ax, perm=perm)
+        shift <<= 1
+    return x
+
+
 def _kernel(kind: str, axes: Tuple[str, ...], aval, extra=()) -> Any:
     mesh = mesh_mod.get_mesh()
     key = (kind, axes, id(mesh), aval.shape, str(aval.dtype), extra)
@@ -179,15 +208,10 @@ def _kernel(kind: str, axes: Tuple[str, ...], aval, extra=()) -> Any:
         return int(np.prod([mesh.shape[a] for a in axes]))
 
     def _gather_cat(v):
-        # concat of the group's blocks along dim0 (paddle all_gather layout)
-        out = v
-        for a in axes[::-1]:
-            out = jax.lax.all_gather(out, a, axis=0, tiled=True)
-        return out
+        return _gather_cat_over(v, axes)
 
     def _gather_stack(v):
-        # stack of the group's blocks on a NEW leading dim [G, *S]
-        return _gather_cat(v[None])
+        return _gather_stack_over(v, axes)
 
     if kind == "all_reduce_sum":
         body = lambda x: _psum(x)
@@ -196,7 +220,8 @@ def _kernel(kind: str, axes: Tuple[str, ...], aval, extra=()) -> Any:
     elif kind == "all_reduce_min":
         body = lambda x: jax.lax.pmin(x, ax)
     elif kind == "all_reduce_prod":
-        body = lambda x: jnp.prod(_gather_stack(x), axis=0)
+        def body(x):
+            return _butterfly_prod(x, axes, mesh)
     elif kind == "all_reduce_avg":
         body = lambda x: _psum(x) / _group_size()
     elif kind == "all_gather":
@@ -208,7 +233,21 @@ def _kernel(kind: str, axes: Tuple[str, ...], aval, extra=()) -> Any:
         src = extra[0]
 
         def body(x):
-            return _gather_stack(x)[src]
+            # binomial-tree broadcast: ceil(log2 G) collective-permutes,
+            # O(S) memory — no [G, *S] gather materialization
+            # (reference: ncclBroadcast's tree algorithm)
+            if len(axes) > 1:
+                return _gather_stack(x)[src]  # multi-axis fallback
+            g = _group_size()
+            rel = (jax.lax.axis_index(ax) - src) % g
+            shift = 1
+            while shift < g:
+                perm = [((src + r) % g, (src + r + shift) % g)
+                        for r in range(shift) if r + shift < g]
+                recv = jax.lax.ppermute(x, ax, perm=perm)
+                x = jnp.where((rel >= shift) & (rel < 2 * shift), recv, x)
+                shift <<= 1
+            return x
     elif kind == "reduce":
         dst, op = extra
 
@@ -220,7 +259,7 @@ def _kernel(kind: str, axes: Tuple[str, ...], aval, extra=()) -> Any:
             elif op == ReduceOp.AVG:
                 red = _psum(x) / _group_size()
             elif op == ReduceOp.PROD:
-                red = jnp.prod(_gather_stack(x), axis=0)
+                red = _butterfly_prod(x, axes, mesh)
             else:
                 red = _psum(x)
             idx = jax.lax.axis_index(ax)
@@ -229,9 +268,16 @@ def _kernel(kind: str, axes: Tuple[str, ...], aval, extra=()) -> Any:
         src = extra[0]
 
         def body(x):
-            # x: [G, *S] on every rank; only src's row matters
-            g = _gather_stack(x)  # [G, G, *S]
-            return g[src, jax.lax.axis_index(ax)]
+            # x: [G, *S] on every rank; only src's rows matter. One
+            # all-to-all routes row j of every rank to rank j, so rank i
+            # ends with [G, *S] whose row r is rank r's row i — row src
+            # is the scatter payload. O(G*S) per rank, never [G, G, *S].
+            if len(axes) > 1:
+                g = _gather_stack(x)  # multi-axis fallback
+                return g[src, jax.lax.axis_index(ax)]
+            routed = jax.lax.all_to_all(x, ax, split_axis=0, concat_axis=0,
+                                        tiled=True)
+            return routed[src]
     elif kind == "all_to_all":
         def body(x):
             # x: [G, *S]; block j goes to rank j
@@ -371,6 +417,8 @@ def _run(kind: str, t: Tensor, group: Optional[Group], extra=()) -> Tensor:
     out = fn(_to_mesh(arr))
     if lifted and kind != "all_gather":
         out = out[..., 0]
+    from .watchdog import watch as _watch
+    _watch(kind, out)
     t._replace_data(out)
     return t
 
@@ -411,6 +459,8 @@ def all_gather(tensor_or_list, tensor: Optional[Tensor] = None,
         fn = _kernel("all_gather", _axes(group),
                      jax.ShapeDtypeStruct(arr.shape, arr.dtype))
         out = fn(_to_mesh(arr))  # [W, G*S0, ...]
+        from .watchdog import watch as _watch
+        _watch("all_gather", out)
         s0 = arr.shape[1]
         for i in range(g.nranks):
             block = out[:, i * s0:(i + 1) * s0]
@@ -583,7 +633,10 @@ def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
     fn = _kernel("p2p", g.axes,
                  jax.ShapeDtypeStruct(sent._data.shape, sent._data.dtype),
                  extra=(int(src), int(dst)))
-    tensor._replace_data(fn(_to_mesh(sent._data), _to_mesh(tensor._data)))
+    out = fn(_to_mesh(sent._data), _to_mesh(tensor._data))
+    from .watchdog import watch as _watch
+    _watch("p2p", out)
+    tensor._replace_data(out)
     return _Task(tensor)
 
 

@@ -1,0 +1,150 @@
+"""Collective watchdog (reference paddle/phi/core/distributed/
+comm_task_manager.h:37: background CommTaskLoop threads that detect
+timed-out NCCL collectives, log diagnostics, and abort the communicator).
+
+On TPU there is no communicator to abort — a hung collective means a hung
+XLA execution (usually a desynced gang in multi-host). The watchdog
+mirrors the reference's split:
+
+  * a WAITER thread per watched operation blocks on the result buffers;
+  * the MONITOR thread flags operations that outlive their deadline,
+    logging a diagnostic with the op tag (and every other in-flight op,
+    the usual clue for a rank mismatch) and, with
+    FLAGS_collective_abort_on_timeout, killing the process so the
+    launcher's gang supervision (launch/main.py) can restart the job —
+    the moral twin of NCCLCommTask::AbortComm + store error propagation.
+
+Enable with FLAGS_collective_timeout_s > 0 (off by default: the waiter
+threads cost a sync per collective, like the reference's debug watchdog).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..flags import define_flag, flag_value
+from .utils import get_logger
+
+define_flag("collective_timeout_s", 0.0,
+            "Watchdog timeout for dispatched collectives (seconds); 0 "
+            "disables the watchdog entirely.")
+define_flag("collective_abort_on_timeout", False,
+            "Kill the process when a collective times out so the "
+            "launcher can restart the gang (CommTaskManager abort "
+            "semantics).")
+
+logger = get_logger(name=__name__)
+
+
+class CommWatchdog:
+    """Tracks in-flight collectives; singleton via get()."""
+
+    _instance: Optional["CommWatchdog"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._inflight: Dict[int, Dict[str, Any]] = {}
+        self._next_id = 0
+        self._mu = threading.Lock()
+        self._monitor: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    @classmethod
+    def get(cls) -> "CommWatchdog":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = CommWatchdog()
+            return cls._instance
+
+    # -- public ----------------------------------------------------------
+    def enabled(self) -> bool:
+        return float(flag_value("collective_timeout_s")) > 0.0
+
+    def watch(self, tag: str, arrays) -> None:
+        """Register a dispatched collective; a waiter thread blocks on
+        the buffers and clears the entry when they materialize."""
+        if not self.enabled():
+            return
+        timeout = float(flag_value("collective_timeout_s"))
+        with self._mu:
+            op_id = self._next_id
+            self._next_id += 1
+            self._inflight[op_id] = {
+                "tag": tag, "start": time.monotonic(),
+                "deadline": time.monotonic() + timeout, "fired": False,
+            }
+        waiter = threading.Thread(target=self._wait, args=(op_id, arrays),
+                                  daemon=True,
+                                  name=f"comm-waiter-{op_id}")
+        waiter.start()
+        self._ensure_monitor()
+
+    # -- internals -------------------------------------------------------
+    def _wait(self, op_id: int, arrays) -> None:
+        try:
+            import jax
+            jax.block_until_ready(arrays)
+        except Exception as e:  # execution error counts as completion
+            logger.warning("collective %s failed: %s",
+                           self._tag(op_id), e)
+        finally:
+            with self._mu:
+                self._inflight.pop(op_id, None)
+
+    def _tag(self, op_id: int) -> str:
+        with self._mu:
+            entry = self._inflight.get(op_id)
+            return entry["tag"] if entry else f"op{op_id}"
+
+    def _ensure_monitor(self) -> None:
+        # under _mu: pairs with the monitor's park-on-empty exit (which
+        # clears _monitor under the same lock), closing the TOCTOU window
+        # where a fresh op could see a dying-but-alive monitor and end up
+        # unmonitored
+        with self._mu:
+            if self._monitor is None or not self._monitor.is_alive():
+                self._stop.clear()
+                self._monitor = threading.Thread(
+                    target=self._monitor_loop, daemon=True,
+                    name="comm-watchdog")
+                self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(0.25):
+            now = time.monotonic()
+            overdue = []
+            with self._mu:
+                if not self._inflight:
+                    self._monitor = None  # park; next watch() respawns
+                    return
+                for op_id, e in self._inflight.items():
+                    if now > e["deadline"] and not e["fired"]:
+                        e["fired"] = True
+                        overdue.append((op_id, dict(e)))
+                pending = [e["tag"] for e in self._inflight.values()]
+            for op_id, e in overdue:
+                logger.error(
+                    "collective TIMEOUT after %.1fs: %s (in-flight: %s) — "
+                    "likely a desynced gang: some rank never dispatched "
+                    "the matching collective (comm_task_manager.h "
+                    "IsTimeout semantics)",
+                    now - e["start"], e["tag"], pending)
+                if bool(flag_value("collective_abort_on_timeout")):
+                    logger.error("aborting process for gang restart "
+                                 "(AbortComm semantics)")
+                    os._exit(134)
+
+    # test hook ----------------------------------------------------------
+    def inflight_count(self) -> int:
+        with self._mu:
+            return len(self._inflight)
+
+
+def watch(tag: str, arrays) -> None:
+    """Module-level convenience used by collective dispatch."""
+    wd = CommWatchdog.get()
+    if wd.enabled():
+        wd.watch(tag, arrays)

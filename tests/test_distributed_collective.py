@@ -163,3 +163,81 @@ def test_scalar_per_rank_collectives():
     z = paddle.to_tensor(np.arange(W, dtype=np.float32))
     dist.all_gather(z)
     assert z.shape == [W, W]
+
+
+def test_native_broadcast_scatter_prod_parity():
+    """Round-3 native collectives (tree broadcast, a2a scatter, butterfly
+    prod) must match the semantics of the gather-based versions."""
+    import paddle2_tpu as paddle
+    import paddle2_tpu.distributed as dist
+    dist.init_mesh({"dp": 8})
+    W = 8
+    rs = np.random.RandomState(0)
+    # broadcast from a non-zero src
+    x = paddle.to_tensor(np.arange(W * 3, dtype=np.float32).reshape(W, 3))
+    dist.broadcast(x, src=5)
+    np.testing.assert_array_equal(x.numpy(),
+                                  np.tile([15.0, 16.0, 17.0], (W, 1)))
+    # all_reduce prod (butterfly)
+    vals = rs.rand(W, 2).astype(np.float32) + 0.5
+    t = paddle.to_tensor(vals.copy())
+    dist.all_reduce(t, op=dist.ReduceOp.PROD)
+    np.testing.assert_allclose(t.numpy(),
+                               np.tile(vals.prod(axis=0), (W, 1)),
+                               rtol=1e-5)
+    # scatter via all_to_all routing
+    payload = rs.randn(W, W, 4).astype(np.float32)
+    t2 = paddle.to_tensor(payload.copy())
+    dist.scatter(t2, src=3)
+    np.testing.assert_allclose(t2.numpy(), payload[3], rtol=1e-6)
+
+
+def test_comm_watchdog_flags_and_completion():
+    import time
+    import paddle2_tpu as paddle
+    import paddle2_tpu.distributed as dist
+    from paddle2_tpu.distributed.watchdog import CommWatchdog
+    paddle.set_flags({"FLAGS_collective_timeout_s": 30.0})
+    try:
+        dist.init_mesh({"dp": 8})
+        t = paddle.to_tensor(np.ones(8, np.float32))
+        dist.all_reduce(t)
+        wd = CommWatchdog.get()
+        deadline = time.time() + 10
+        while wd.inflight_count() and time.time() < deadline:
+            time.sleep(0.05)
+        assert wd.inflight_count() == 0  # completed ops unregister
+    finally:
+        paddle.set_flags({"FLAGS_collective_timeout_s": 0.0})
+
+
+def test_comm_watchdog_times_out_stuck_op(caplog):
+    import logging
+    import time
+    import paddle2_tpu as paddle
+    from paddle2_tpu.distributed.watchdog import CommWatchdog
+
+    class _Stuck:
+        """block_until_ready on this object hangs (monkey payload)."""
+
+    wd = CommWatchdog.get()
+    from paddle2_tpu.distributed.watchdog import logger as wd_logger
+    wd_logger.propagate = True  # route records into caplog's root handler
+    paddle.set_flags({"FLAGS_collective_timeout_s": 0.3})
+    try:
+        import jax
+        orig = jax.block_until_ready
+        jax.block_until_ready = lambda a: (time.sleep(5) if isinstance(
+            a, _Stuck) else orig(a))
+        with caplog.at_level(logging.ERROR):
+            wd.watch("all_reduce_sum", _Stuck())
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if any("TIMEOUT" in r.getMessage() for r in caplog.records):
+                    break
+                time.sleep(0.1)
+        jax.block_until_ready = orig
+        assert any("TIMEOUT" in r.getMessage() for r in caplog.records)
+    finally:
+        wd_logger.propagate = False
+        paddle.set_flags({"FLAGS_collective_timeout_s": 0.0})
