@@ -31,6 +31,8 @@ from .sep import ring_attention, ulysses_attention  # noqa: F401
 from .utils import get_logger  # noqa: F401
 from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import auto_tuner  # noqa: F401
+from .auto_tuner import AutoTuner  # noqa: F401
 
 __all__ = [
     "ParallelEnv", "get_rank", "get_world_size", "init_parallel_env",
@@ -47,5 +49,5 @@ __all__ = [
     "shard_tensor", "reshard", "shard_layer", "shard_optimizer",
     "unshard_dtensor", "dtensor_from_fn", "dtensor_from_local",
     "shard_dataloader", "ShardDataloader", "Strategy", "to_static",
-    "DistModel",
+    "DistModel", "AutoTuner",
 ]

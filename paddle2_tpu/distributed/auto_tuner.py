@@ -1,0 +1,191 @@
+"""Auto-tuner over parallel configurations (reference
+python/paddle/distributed/auto_tuner/tuner.py:21 AutoTuner + search.py
+GridSearch + prune.py rules).
+
+Searches mesh factorizations dp x mp x pp x sep of the device count,
+prunes infeasible candidates (degree constraints, divisibility against
+the model geometry, memory heuristics), MEASURES each surviving trial
+(the reference launches whole jobs; here a trial is a jitted tiny train
+step over the candidate mesh — single-controller, so trials run in-process
+on the virtual or real mesh), and reports the fastest configuration.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["AutoTuner", "tune"]
+
+
+def _factorizations(n: int, axes: List[str]):
+    """All ways to write n as a product over the named axes (order
+    matters: each axis gets a degree >= 1)."""
+    def divisors(m):
+        return [d for d in range(1, m + 1) if m % d == 0]
+
+    def rec(rem, k):
+        if k == 1:
+            yield (rem,)
+            return
+        for d in divisors(rem):
+            for rest in rec(rem // d, k - 1):
+                yield (d,) + rest
+
+    for combo in rec(n, len(axes)):
+        yield dict(zip(axes, combo))
+
+
+class AutoTuner:
+    """Grid search with pruning over mesh factorizations (tuner.py:21).
+
+    tuner_cfg keys (reference naming):
+      num_devices        total devices to factorize (required)
+      search_axes        axis names, default ["dp", "mp", "pp", "sep"]
+      max_mp/max_pp/...  per-axis degree caps
+      num_heads, hidden_size, num_layers, vocab_size
+                         model geometry for divisibility pruning
+      task_limit         max trials (default 100)
+    """
+
+    def __init__(self, tuner_cfg: Dict[str, Any]):
+        self.tuner_cfg = dict(tuner_cfg)
+        n = int(tuner_cfg["num_devices"])
+        axes = list(tuner_cfg.get("search_axes", ["dp", "mp", "pp", "sep"]))
+        self.axes = axes
+        self.task_limit = int(tuner_cfg.get("task_limit", 100))
+        self.history: List[Dict[str, Any]] = []
+        self._queue = [c for c in _factorizations(n, axes)
+                       if not self._pruned(c)]
+        self._queue = self._queue[: self.task_limit]
+        self._i = 0
+
+    # -- pruning (reference auto_tuner/prune.py rules) -------------------
+    def _pruned(self, cfg: Dict[str, int]) -> bool:
+        t = self.tuner_cfg
+        for ax in self.axes:
+            cap = t.get(f"max_{ax}")
+            if cap is not None and cfg[ax] > int(cap):
+                return True
+        heads = t.get("num_heads")
+        if heads is not None and cfg.get("mp", 1) > 1 \
+                and heads % cfg["mp"] != 0:
+            return True
+        hidden = t.get("hidden_size")
+        if hidden is not None and cfg.get("mp", 1) > 1 \
+                and hidden % cfg["mp"] != 0:
+            return True
+        layers = t.get("num_layers")
+        if layers is not None and cfg.get("pp", 1) > 1 \
+                and layers % cfg["pp"] != 0:
+            return True
+        if heads is not None and cfg.get("sep", 1) > 1 \
+                and heads % cfg["sep"] != 0:
+            return True
+        vocab = t.get("vocab_size")
+        if vocab is not None and cfg.get("mp", 1) > 1 \
+                and vocab % cfg["mp"] != 0:
+            return True
+        batch = t.get("global_batch_size")
+        if batch is not None and cfg.get("dp", 1) > 1 \
+                and batch % cfg["dp"] != 0:
+            return True
+        return False
+
+    # -- search protocol (tuner.py surface) ------------------------------
+    def search_once(self) -> Optional[Dict[str, int]]:
+        """Next candidate to try, or None when exhausted."""
+        if self._i >= len(self._queue):
+            return None
+        cfg = self._queue[self._i]
+        self._i += 1
+        return cfg
+
+    def update(self, cfg: Dict[str, int], metric: float) -> None:
+        """Record a measured trial (lower metric = better, e.g. step s)."""
+        self.history.append({"cfg": dict(cfg), "metric": float(metric)})
+
+    def get_best(self) -> Optional[Dict[str, Any]]:
+        valid = [h for h in self.history
+                 if h["metric"] == h["metric"]]  # drop NaN trials
+        if not valid:
+            return None
+        return min(valid, key=lambda h: h["metric"])
+
+    @property
+    def num_candidates(self) -> int:
+        return len(self._queue)
+
+
+def _default_trial(cfg: Dict[str, int], devices) -> float:
+    """Built-in trial: one jitted tiny-GPT-like train step on a mesh with
+    this factorization; returns measured steady-state step seconds."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    sizes = [max(1, cfg.get(a, 1)) for a in ("dp", "mp", "pp", "sep")]
+    mesh = Mesh(np.array(devices).reshape(sizes), ("dp", "mp", "pp", "sep"))
+    rs = np.random.RandomState(0)
+    H, F = 128, 512
+    W1 = jax.device_put(rs.randn(H, F).astype(np.float32) * 0.05,
+                        NamedSharding(mesh, P(None, "mp")))
+    W2 = jax.device_put(rs.randn(F, H).astype(np.float32) * 0.05,
+                        NamedSharding(mesh, P("mp", None)))
+    B = 8 * cfg.get("dp", 1)
+    x = jax.device_put(rs.randn(B, 64, H).astype(np.float32),
+                       NamedSharding(mesh, P("dp", "sep", None)))
+
+    @jax.jit
+    def step(w1, w2, x):
+        def loss(ws, x):
+            a, b = ws
+            h = jnp.tanh(x @ a) @ b
+            return jnp.mean(h * h)
+        g1, g2 = jax.grad(loss)((w1, w2), x)
+        return w1 - 0.01 * g1, w2 - 0.01 * g2, x * 1.0001
+
+    w1, w2, x = step(W1, W2, x)
+    jax.block_until_ready(w1)
+    t0 = time.perf_counter()
+    iters = 10
+    for _ in range(iters):
+        w1, w2, x = step(w1, w2, x)
+    jax.block_until_ready(w1)
+    return (time.perf_counter() - t0) / iters
+
+
+def tune(tuner_cfg: Dict[str, Any],
+         trial_fn: Optional[Callable[[Dict[str, int]], float]] = None,
+         verbose: bool = True) -> Dict[str, Any]:
+    """Run the full search loop; returns {"cfg", "metric", "history"}.
+
+    trial_fn(cfg) -> step seconds; defaults to the built-in tiny-step
+    trial over the current process's devices."""
+    import sys
+    tuner = AutoTuner(tuner_cfg)
+    if trial_fn is None:
+        import jax
+        devices = jax.devices()[: int(tuner_cfg["num_devices"])]
+        trial_fn = lambda cfg: _default_trial(cfg, devices)
+    while True:
+        cfg = tuner.search_once()
+        if cfg is None:
+            break
+        try:
+            metric = trial_fn(cfg)
+        except Exception as e:   # infeasible trial (e.g. OOM) — skip
+            if verbose:
+                print(f"[auto_tuner] {cfg}: FAILED {e}", file=sys.stderr)
+            continue
+        tuner.update(cfg, metric)
+        if verbose:
+            print(f"[auto_tuner] {cfg}: {metric*1e3:.2f} ms/step",
+                  file=sys.stderr)
+    best = tuner.get_best()
+    if best is None:
+        raise RuntimeError("auto_tuner: every candidate failed")
+    return {"cfg": best["cfg"], "metric": best["metric"],
+            "history": tuner.history}

@@ -92,7 +92,7 @@ def sgn(x, name=None):
 
 
 def fix(x, name=None):
-    return _u("fix", jnp.fix, x)
+    return _u("fix", jnp.trunc, x)  # jnp.fix removed in JAX 0.10; trunc is identical
 
 
 def fmod(x, y, name=None):
