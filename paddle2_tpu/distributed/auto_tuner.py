@@ -58,7 +58,17 @@ class AutoTuner:
         self.history: List[Dict[str, Any]] = []
         self._queue = [c for c in _factorizations(n, axes)
                        if not self._pruned(c)]
-        self._queue = self._queue[: self.task_limit]
+        if len(self._queue) > self.task_limit:
+            import sys
+            print(f"[auto_tuner] truncating {len(self._queue)} candidates "
+                  f"to task_limit={self.task_limit} (most-balanced first)",
+                  file=sys.stderr)
+            # keep the most balanced factorizations: pure enumeration
+            # order would drop the dp-heavy tail wholesale
+            self._queue.sort(
+                key=lambda c: max(c.values()) / max(1, min(
+                    v for v in c.values() if v > 0)))
+            self._queue = self._queue[: self.task_limit]
         self._i = 0
 
     # -- pruning (reference auto_tuner/prune.py rules) -------------------
@@ -120,23 +130,32 @@ class AutoTuner:
 
 def _default_trial(cfg: Dict[str, int], devices) -> float:
     """Built-in trial: one jitted tiny-GPT-like train step on a mesh with
-    this factorization; returns measured steady-state step seconds."""
+    this factorization; returns measured SECONDS PER SAMPLE (normalized
+    by the dp-scaled batch so dp-heavy configs are credited for their
+    extra throughput, not penalized for doing more work per step)."""
     import numpy as np
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    sizes = [max(1, cfg.get(a, 1)) for a in ("dp", "mp", "pp", "sep")]
-    mesh = Mesh(np.array(devices).reshape(sizes), ("dp", "mp", "pp", "sep"))
+    # mesh axes follow the CONFIG's own axes (custom search_axes work);
+    # the canonical four get sharding roles, extras ride as size-1-or-
+    # replicated axes
+    names = tuple(cfg.keys())
+    sizes = [max(1, cfg[a]) for a in names]
+    mesh = Mesh(np.array(devices).reshape(sizes), names)
+
+    def ax(name):
+        return name if name in names else None
     rs = np.random.RandomState(0)
     H, F = 128, 512
     W1 = jax.device_put(rs.randn(H, F).astype(np.float32) * 0.05,
-                        NamedSharding(mesh, P(None, "mp")))
+                        NamedSharding(mesh, P(None, ax("mp"))))
     W2 = jax.device_put(rs.randn(F, H).astype(np.float32) * 0.05,
-                        NamedSharding(mesh, P("mp", None)))
+                        NamedSharding(mesh, P(ax("mp"), None)))
     B = 8 * cfg.get("dp", 1)
     x = jax.device_put(rs.randn(B, 64, H).astype(np.float32),
-                       NamedSharding(mesh, P("dp", "sep", None)))
+                       NamedSharding(mesh, P(ax("dp"), ax("sep"), None)))
 
     @jax.jit
     def step(w1, w2, x):
@@ -154,7 +173,7 @@ def _default_trial(cfg: Dict[str, int], devices) -> float:
     for _ in range(iters):
         w1, w2, x = step(w1, w2, x)
     jax.block_until_ready(w1)
-    return (time.perf_counter() - t0) / iters
+    return (time.perf_counter() - t0) / iters / B   # seconds per sample
 
 
 def tune(tuner_cfg: Dict[str, Any],
@@ -166,6 +185,10 @@ def tune(tuner_cfg: Dict[str, Any],
     trial over the current process's devices."""
     import sys
     tuner = AutoTuner(tuner_cfg)
+    if tuner.num_candidates == 0:
+        raise ValueError(
+            "auto_tuner: pruning left NO feasible candidates — relax the "
+            "max_* caps or the model-geometry divisibility constraints")
     if trial_fn is None:
         import jax
         devices = jax.devices()[: int(tuner_cfg["num_devices"])]
