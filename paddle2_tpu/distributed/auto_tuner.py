@@ -181,8 +181,8 @@ def tune(tuner_cfg: Dict[str, Any],
          verbose: bool = True) -> Dict[str, Any]:
     """Run the full search loop; returns {"cfg", "metric", "history"}.
 
-    trial_fn(cfg) -> step seconds; defaults to the built-in tiny-step
-    trial over the current process's devices."""
+    trial_fn(cfg) -> cost (lower is better; the built-in default trial
+    returns SECONDS PER SAMPLE over the current process's devices)."""
     import sys
     tuner = AutoTuner(tuner_cfg)
     if tuner.num_candidates == 0:
@@ -205,7 +205,7 @@ def tune(tuner_cfg: Dict[str, Any],
             continue
         tuner.update(cfg, metric)
         if verbose:
-            print(f"[auto_tuner] {cfg}: {metric*1e3:.2f} ms/step",
+            print(f"[auto_tuner] {cfg}: metric={metric:.3e}",
                   file=sys.stderr)
     best = tuner.get_best()
     if best is None:

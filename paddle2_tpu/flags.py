@@ -95,6 +95,11 @@ define_flag("enable_api_kernel_fallback", True,
 define_flag("eager_vjp_cache", True,
             "Cache per-op linearized VJP computations keyed on shapes/dtypes.")
 define_flag("log_level", 0, "Framework verbosity (VLOG-style).")
+define_flag("max_program_cache_size", 32,
+            "Guard-miss budget per to_static function: beyond this many "
+            "compiled variants the function falls back to eager "
+            "execution (SOT graph-break analog) instead of retracing "
+            "per distinct value.")
 define_flag("donate_optimizer_buffers", True,
             "Donate parameter/optimizer-state buffers to the fused update "
             "executable (XLA in-place aliasing; saves ~3x model size of HBM "

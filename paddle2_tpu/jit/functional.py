@@ -102,6 +102,7 @@ class TracedProgram:
         self.fn = fn
         self.layers = list(layers)
         self._compiled: Dict[Any, Any] = {}
+        self._warned_fallback = False
 
     # -- public ----------------------------------------------------------
     def __call__(self, *args, **kwargs):
@@ -133,6 +134,27 @@ class TracedProgram:
             core.is_grad_enabled(),)
         entry = self._compiled.get(key)
         if entry is None:
+            # graph-break fallback (the SOT break-and-stay-eager analog,
+            # reference jit/sot/): a function whose guards keep missing —
+            # value-dependent Python control flow retracing per distinct
+            # value — stops compiling and runs eagerly instead of
+            # accumulating one executable per value
+            from ..flags import flag_value
+            limit = int(flag_value("max_program_cache_size"))
+            if len(self._compiled) >= limit:
+                if not self._warned_fallback:
+                    self._warned_fallback = True
+                    import warnings
+                    warnings.warn(
+                        f"to_static({getattr(self.fn, '__name__', '?')}): "
+                        f"{limit} guard misses — likely value-dependent "
+                        "Python control flow; falling back to EAGER "
+                        "execution for this function (the reference's "
+                        "SOT graph-break). Raise "
+                        "FLAGS_max_program_cache_size if the retraces "
+                        "are intentional (e.g. shape buckets).",
+                        RuntimeWarning, stacklevel=3)
+                return self.fn(*args, **kwargs)
             entry = self._build(template, params, buffers, len(args_t))
             self._compiled[key] = entry
         fwd_jit, fwd_vjp_jit, vjp_apply_jit, meta = entry

@@ -20,25 +20,34 @@ from ..ops.dispatch import apply_op
 from .. import nn
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
-           "AbsmaxObserver", "quant_aware", "fake_quant"]
+           "FakeQuanterChannelWiseAbsMaxObserver", "AbsmaxObserver",
+           "ChannelWiseAbsMaxObserver", "QuantedInferenceLinear",
+           "quant_aware", "fake_quant"]
 
 
-def _fake_quant_fn(x, scale, bits):
+def _fake_quant_fn(x, scale, bits, axis=None):
     qmax = float(2 ** (bits - 1) - 1)
     s = jnp.maximum(scale, 1e-8)
+    if axis is not None:
+        shape = [1] * x.ndim
+        shape[axis] = -1
+        s = s.reshape(shape)
     q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
     deq = q * s / qmax
     # straight-through estimator: identity gradient inside the clip range
     return x + jax.lax.stop_gradient(deq - x)
 
 
-def fake_quant(x: Tensor, scale, bits: int = 8) -> Tensor:
+def fake_quant(x: Tensor, scale, bits: int = 8, quant_axis=None) -> Tensor:
+    """Per-tensor (scalar scale) or per-channel (1-D scale + quant_axis)
+    fake quantization with STE gradients."""
     from ..ops.dispatch import ensure_tensor
     t = ensure_tensor(x)
-    s = jnp.asarray(float(scale) if not isinstance(scale, Tensor)
-                    else scale._data)
+    s = jnp.asarray(scale._data if isinstance(scale, Tensor) else scale,
+                    jnp.float32)
     return apply_op("fake_quant",
-                    lambda a: _fake_quant_fn(a, s, bits), (t,), {})
+                    lambda a: _fake_quant_fn(a, s, bits, quant_axis),
+                    (t,), {})
 
 
 class AbsmaxObserver(nn.Layer):
@@ -50,11 +59,16 @@ class AbsmaxObserver(nn.Layer):
         self.moving_rate = moving_rate
         self._absmax = 0.0
         self._seen = False
+        self._frozen = False
+
+    def freeze(self):
+        """Stop scale updates (PTQ.convert 'freeze' semantics)."""
+        self._frozen = True
 
     def forward(self, x: Tensor) -> Tensor:
         import numpy as np
         cur = float(np.abs(np.asarray(x.numpy())).max()) if not \
-            isinstance(x._data, jax.core.Tracer) else None
+            (self._frozen or isinstance(x._data, jax.core.Tracer)) else None
         if cur is not None:
             if self._seen:
                 self._absmax = (self.moving_rate * self._absmax
@@ -66,6 +80,40 @@ class AbsmaxObserver(nn.Layer):
 
     def scale(self) -> float:
         return self._absmax if self._seen else 1.0
+
+
+class ChannelWiseAbsMaxObserver(nn.Layer):
+    """Per-channel PTQ observer (observer/abs_max_weight.py parity):
+    tracks absmax along every channel of `quant_axis`."""
+
+    def __init__(self, quant_bits: int = 8, quant_axis: int = -1,
+                 moving_rate: float = 0.9):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.quant_axis = quant_axis
+        self.moving_rate = moving_rate
+        self._absmax = None
+        self._frozen = False
+
+    def freeze(self):
+        self._frozen = True
+
+    def forward(self, x: Tensor) -> Tensor:
+        import numpy as np
+        if self._frozen or isinstance(x._data, jax.core.Tracer):
+            return x
+        axis = self.quant_axis % x.ndim
+        red = tuple(i for i in range(x.ndim) if i != axis)
+        cur = np.abs(np.asarray(x.numpy())).max(axis=red)
+        if self._absmax is None:
+            self._absmax = cur
+        else:
+            self._absmax = (self.moving_rate * self._absmax
+                            + (1 - self.moving_rate) * cur)
+        return x
+
+    def scale(self):
+        return self._absmax if self._absmax is not None else 1.0
 
 
 class FakeQuanterWithAbsMaxObserver(nn.Layer):
@@ -81,6 +129,30 @@ class FakeQuanterWithAbsMaxObserver(nn.Layer):
     def forward(self, x: Tensor) -> Tensor:
         self.observer(x)
         return fake_quant(x, self.observer.scale(), self.quant_bits)
+
+
+class FakeQuanterChannelWiseAbsMaxObserver(nn.Layer):
+    """Per-channel QAT weight quanter (quanters/abs_max.py channel-wise
+    variant): one scale per output channel — the accuracy saver for
+    weight quantization."""
+
+    def __init__(self, quant_bits: int = 8, quant_axis: int = 0,
+                 moving_rate: float = 0.9, dtype="float32", name=None):
+        # reference default quant_axis=0 (the OUTPUT channel of a Conv2D
+        # weight [out,in,kh,kw]); Linear weights [in,out] need axis 1 —
+        # _QuantedWrapper passes the right axis per layer type
+        super().__init__()
+        self.observer = ChannelWiseAbsMaxObserver(quant_bits, quant_axis,
+                                                  moving_rate)
+        self.quant_bits = quant_bits
+        self.quant_axis = quant_axis
+
+    def forward(self, x: Tensor) -> Tensor:
+        self.observer(x)
+        s = self.observer.scale()
+        axis = self.quant_axis % x.ndim
+        return fake_quant(x, jnp.asarray(s), self.quant_bits,
+                          quant_axis=axis)
 
 
 class QuantConfig:
@@ -110,8 +182,14 @@ class _QuantedWrapper(nn.Layer):
         self.inner = inner
         self.act_quanter = act_quanter() if isinstance(act_quanter, type) \
             else act_quanter
-        self.w_quanter = w_quanter() if isinstance(w_quanter, type) \
-            else w_quanter
+        if isinstance(w_quanter, type):
+            if issubclass(w_quanter, FakeQuanterChannelWiseAbsMaxObserver):
+                # output channel: axis 1 for Linear [in,out], 0 for Conv2D
+                axis = 1 if isinstance(inner, nn.Linear) else 0
+                w_quanter = w_quanter(quant_axis=axis)
+            else:
+                w_quanter = w_quanter()
+        self.w_quanter = w_quanter
 
     def forward(self, x):
         from ..nn import functional as F
@@ -155,11 +233,87 @@ class QAT:
         return _swap(model, self.config)
 
 
+class QuantedInferenceLinear(nn.Layer):
+    """INT8 inference Linear: weights stored int8 with per-channel f32
+    scales; the matmul runs on int8 operands with int32 accumulation
+    (the TPU MXU int8 path — 2x the bf16 rate on v5e), then dequantizes.
+    Produced by PTQ.convert() (reference int8 export,
+    static/quantization post-training pipeline)."""
+
+    def __init__(self, weight_int8, w_scale, bias, act_scale,
+                 quant_bits: int = 8):
+        super().__init__()
+        # buffers, not plain attributes: state_dict()/jit.save must
+        # carry the int8 weights and scales
+        self.register_buffer("weight_int8",
+                             Tensor(jnp.asarray(weight_int8, jnp.int8)))
+        self.register_buffer("w_scale",
+                             Tensor(jnp.asarray(w_scale, jnp.float32)))
+        self.register_buffer(
+            "bias", None if bias is None else Tensor(jnp.asarray(bias)))
+        self.act_scale = float(act_scale)
+        self.qmax = float(2 ** (quant_bits - 1) - 1)
+
+    def forward(self, x):
+        from ..ops.dispatch import ensure_tensor
+        t = ensure_tensor(x)
+
+        def fn(a):
+            s_in = max(self.act_scale, 1e-8)
+            q_in = jnp.clip(jnp.round(a / s_in * self.qmax),
+                            -self.qmax, self.qmax).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                q_in, self.weight_int8._data,
+                (((a.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            deq = acc.astype(jnp.float32) * (
+                s_in / self.qmax) * (self.w_scale._data / self.qmax)
+            if self.bias is not None:
+                deq = deq + self.bias._data
+            return deq.astype(a.dtype)
+
+        return apply_op("quanted_linear", fn, (t,), {})
+
+
 class PTQ(QAT):
-    """ptq.py PTQ parity: same swap with pure observers; convert() freezes
-    observed scales into the fake-quant path."""
+    """ptq.py PTQ parity: same swap with observers; convert() freezes the
+    observed scales into INT8 inference layers (per-channel weights,
+    per-tensor activations)."""
 
     def convert(self, model: nn.Layer, inplace: bool = True) -> nn.Layer:
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        return self._convert_in_place(model)
+
+    def _convert_in_place(self, model: nn.Layer) -> nn.Layer:
+        for name, child in list(model.named_children()):
+            if isinstance(child, _QuantedWrapper) \
+                    and isinstance(child.inner, nn.Linear):
+                import numpy as np
+                w = np.asarray(child.inner.weight.numpy(), np.float32)
+                w_scale = np.maximum(np.abs(w).max(axis=0), 1e-8)  # per out
+                qmax = 2 ** 7 - 1
+                w_int8 = np.clip(np.round(w / w_scale * qmax),
+                                 -qmax, qmax).astype(np.int8)
+                act_scale = 1.0
+                if child.act_quanter is not None and hasattr(
+                        child.act_quanter, "observer"):
+                    act_scale = float(child.act_quanter.observer.scale())
+                bias = None if child.inner.bias is None else \
+                    np.asarray(child.inner.bias.numpy())
+                model.add_sublayer(name, QuantedInferenceLinear(
+                    w_int8, w_scale, bias, act_scale))
+            elif isinstance(child, _QuantedWrapper):
+                # Conv2D (and other quantables): int8 conv lowering is
+                # not implemented — FREEZE the observed scales so the
+                # simulated-quant forward stops drifting at inference
+                for q in (child.act_quanter, child.w_quanter):
+                    obs = getattr(q, "observer", None)
+                    if obs is not None:
+                        obs.freeze()
+            else:
+                self._convert_in_place(child)
         return model
 
 

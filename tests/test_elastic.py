@@ -1,0 +1,77 @@
+"""Elastic manager (fleet/elastic.py; reference elastic/manager.py:125)
+heartbeat/membership semantics, plus the launcher restart path."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle2_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+
+
+def _mgr(tmp_path, rank, world, dead_after=0.5):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(world)
+    m = ElasticManager(store_dir=str(tmp_path), heartbeat_interval=0.0,
+                       dead_after=dead_after)
+    m.rank, m.world = rank, world
+    return m
+
+
+def test_heartbeat_and_membership(tmp_path):
+    m0 = _mgr(tmp_path, 0, 2)
+    m1 = _mgr(tmp_path, 1, 2)
+    m0.heartbeat()
+    m1.heartbeat()
+    assert m0.alive_ranks() == [0, 1]
+    assert not m0.world_changed()
+    assert m0.watch() == ElasticStatus.HOLD
+
+
+def test_dead_rank_triggers_restart(tmp_path):
+    m0 = _mgr(tmp_path, 0, 2, dead_after=0.3)
+    m1 = _mgr(tmp_path, 1, 2, dead_after=0.3)
+    m0.heartbeat()
+    m1.heartbeat()
+    assert m0.watch() == ElasticStatus.HOLD
+    # rank 1 stops beating; after dead_after its heartbeat expires
+    time.sleep(0.4)
+    m0._last_beat = 0.0
+    m0.heartbeat()
+    assert m0.alive_ranks() == [0]
+    assert m0.world_changed()
+    assert m0.watch() == ElasticStatus.RESTART
+
+
+def test_corrupt_heartbeat_files_ignored(tmp_path):
+    m0 = _mgr(tmp_path, 0, 1)
+    m0.heartbeat()
+    (tmp_path / "rank_9.hb").write_text("{not json")
+    assert m0.alive_ranks() == [0]
+
+
+def test_launcher_restarts_failed_worker(tmp_path):
+    """--max_restarts relaunches the gang after a worker failure
+    (manager.py restart loop / ELASTIC_EXIT_CODE semantics)."""
+    script = tmp_path / "flaky.py"
+    marker = tmp_path / "attempts.txt"
+    script.write_text(f"""
+import os, sys
+p = {str(repr(str(marker)))}
+n = int(open(p).read()) if os.path.exists(p) else 0
+open(p, "w").write(str(n + 1))
+sys.exit(1 if n == 0 else 0)   # fail on the first attempt only
+""")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "PADDLE_"))}
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle2_tpu.distributed.launch",
+         "--max_restarts", "2", str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert marker.read_text() == "2"   # first attempt failed, retry passed

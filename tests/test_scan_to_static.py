@@ -140,3 +140,31 @@ def test_generate_kv_cache_matches_full_recompute():
     out = small.generate(paddle.to_tensor(np.array([[1, 2, 3]], "int32")),
                          max_new_tokens=10, temperature=0.0)
     assert out.shape[1] == 13
+
+
+def test_guard_miss_budget_falls_back_to_eager():
+    """Value-dependent retraces beyond FLAGS_max_program_cache_size stop
+    compiling and run eagerly (the SOT break-and-stay-eager analog)."""
+    import warnings
+    import paddle2_tpu as paddle
+
+    paddle.set_flags({"FLAGS_max_program_cache_size": 3})
+    try:
+        calls = {"n": 0}
+
+        def fn(x, k):
+            calls["n"] += 1
+            return (x * k).sum()
+
+        st = paddle.jit.to_static(fn)
+        x = paddle.ones([4])
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            for k in range(6):   # 6 distinct non-tensor guard values
+                out = st(x, float(k))
+                assert float(out) == 4.0 * k
+        assert st.program_cache_size <= 3
+        assert any("EAGER" in str(x.message) for x in w)
+        assert calls["n"] >= 6  # eager fallback re-runs the python body
+    finally:
+        paddle.set_flags({"FLAGS_max_program_cache_size": 32})
