@@ -62,6 +62,13 @@ def remat_policy(base: str = "dots"):
     if base == "dots":
         return cp.save_from_both_policies(
             cp.dots_with_no_batch_dims_saveable, names)
+    if base == "dots_plus":
+        # dots + flash residuals + the tagged gelu output: backward
+        # recomputes only cheap elementwise (ln/adds), at ~+64MB/layer
+        more = cp.save_only_these_names("flash_out", "flash_lse",
+                                        "mlp_gelu")
+        return cp.save_from_both_policies(
+            cp.dots_with_no_batch_dims_saveable, more)
     return names
 
 

@@ -172,9 +172,24 @@ class GPTMLP(nn.Layer):
         super().__init__()
         self.up, self.down = _linear_pair(cfg, cfg.hidden_size, cfg.ffn_size,
                                           cfg.initializer_range)
+        # the gelu residual tag only matters when the dots_plus remat
+        # policy will consume it; other configs skip the extra dispatch
+        self._tag_gelu = (cfg.use_recompute
+                          and cfg.recompute_granularity == "dots_plus")
 
     def forward(self, x):
-        return self.down(F.gelu(self.up(x)))
+        h = F.gelu(self.up(x))
+        if self._tag_gelu:
+            # named residual for the "dots_plus" policy (saves the gelu
+            # output so backward skips its recompute). Routed through
+            # apply_op: the tag must not sever the eager tape (it is a
+            # recorded identity with identity VJP).
+            from jax.ad_checkpoint import checkpoint_name
+            from ..ops.dispatch import apply_op
+            h = apply_op("mlp_gelu_tag",
+                         lambda a: checkpoint_name(a, "mlp_gelu"),
+                         (h,), {})
+        return self.down(h)
 
 
 class GPTBlock(nn.Layer):
@@ -250,9 +265,9 @@ class GPTModel(nn.Layer):
         wrap = None
         if self.cfg.use_recompute and self.training:
             from ..kernels.attention import remat_policy
+            gran = self.cfg.recompute_granularity
             policy = remat_policy(
-                "dots" if self.cfg.recompute_granularity == "dots"
-                else "nothing")
+                gran if gran in ("dots", "dots_plus") else "nothing")
             wrap = lambda body: jax.checkpoint(body, policy=policy)
         out = scan_layer_stack(list(self.h), x, wrap_body=wrap)
         return out if out is not None else self._fallback_loop(x)

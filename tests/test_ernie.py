@@ -9,6 +9,7 @@ from paddle2_tpu.models import (ErnieForSequenceClassification, ErnieModel,
                                 ernie_tiny)
 
 
+
 def test_forward_shapes_and_pooler():
     paddle.seed(0)
     cfg = ernie_tiny()

@@ -11,6 +11,8 @@ import pytest
 import paddle2_tpu as paddle
 from paddle2_tpu.models import GPTForCausalLM, GPTConfig
 
+pytestmark = pytest.mark.slow  # full models / spawned processes
+
 
 def _mk(scan):
     paddle.seed(0)
@@ -168,3 +170,29 @@ def test_guard_miss_budget_falls_back_to_eager():
         assert calls["n"] >= 6  # eager fallback re-runs the python body
     finally:
         paddle.set_flags({"FLAGS_max_program_cache_size": 32})
+
+
+def test_recompute_granularity_dots_plus_matches_dots():
+    """dots_plus (gelu residual pinned) must produce the same grads as
+    dots — it is a memory/speed knob, not a numerics change."""
+    import numpy as np
+    import paddle2_tpu as paddle
+    from paddle2_tpu.models import GPTForCausalLM, gpt_tiny
+
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 128, (2, 16)).astype(np.int32))
+    grads = {}
+    for gran in ("dots", "dots_plus"):
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny(use_recompute=True,
+                                    recompute_granularity=gran))
+        st = paddle.jit.to_static(lambda x: m(x, labels=x)[1])
+        loss = st(ids)
+        loss.backward()
+        g = m.gpt.h[0].mlp.up.weight.grad
+        assert g is not None
+        grads[gran] = (float(loss), np.asarray(g._data).copy())
+    assert grads["dots"][0] == pytest.approx(grads["dots_plus"][0],
+                                             rel=1e-6)
+    np.testing.assert_allclose(grads["dots"][1], grads["dots_plus"][1],
+                               rtol=1e-5, atol=1e-6)

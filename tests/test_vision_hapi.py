@@ -14,6 +14,8 @@ from paddle2_tpu.metric import Accuracy, Precision, Recall, Auc
 from paddle2_tpu.vision import models, transforms
 from paddle2_tpu.vision import ops as vops
 
+pytestmark = pytest.mark.slow  # full models / spawned processes
+
 
 def test_resnet18_forward_backward():
     m = models.resnet18(num_classes=10)
