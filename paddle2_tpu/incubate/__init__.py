@@ -8,3 +8,4 @@ from . import autotune  # noqa: F401
 
 __all__ = ["MoELayer", "SwitchGate", "TopKGate", "moe", "distributed",
            "nn"]
+from . import asp  # noqa: F401

@@ -1,0 +1,159 @@
+"""paddle.incubate.asp — 2:4 structured sparsity (reference
+python/paddle/incubate/asp/: prune_model, decorate, supported_layers).
+
+TPU note: the MXU has no sparse-tensor-core acceleration, so ASP here is
+the TRAINING-side workflow — magnitude-based n:m mask computation,
+masked weights, and an optimizer decorator that re-applies masks after
+every step (the reference's OptimizerWithSparsityGuarantee) — producing
+models whose weights satisfy the 2:4 invariant for deployment on
+hardware that does accelerate it (or for quality studies). Masks are
+plain jnp multiplications; XLA fuses them into the adjacent matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .. import nn
+
+__all__ = ["prune_model", "decorate", "calculate_density",
+           "set_excluded_layers", "reset_excluded_layers",
+           "create_mask", "check_sparsity", "reset_masks"]
+
+import weakref
+
+_excluded: Dict[int, List[str]] = {}
+# id-keyed with weakref.finalize cleanup (Tensor's elementwise __eq__
+# rules out WeakKeyDictionary): the entry dies WITH the parameter, so a
+# recycled id can never alias a stale mask and the store cannot grow
+# unboundedly across prune_model calls
+_masks: Dict[int, "jnp.ndarray"] = {}
+
+
+def _store_mask(param, mask) -> None:
+    pid = id(param)
+    _masks[pid] = mask
+    weakref.finalize(param, _masks.pop, pid, None)
+
+
+def _mask_for(param):
+    return _masks.get(id(param))
+
+
+def reset_masks():
+    """Drop every stored mask (fresh pruning run)."""
+    _masks.clear()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Exclude parameters (by EXACT name) from pruning."""
+    _excluded[0] = list(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.pop(0, None)
+
+
+def create_mask(weight, n: int = 2, m: int = 4, mask_algo: str = "mask_1d"):
+    """n:m magnitude mask along the LAST dim (mask_1d; the reference's
+    default): in every group of m consecutive weights, keep the n
+    largest magnitudes."""
+    if mask_algo != "mask_1d":
+        raise NotImplementedError(
+            f"mask_algo={mask_algo!r}: only 'mask_1d' is implemented "
+            "(the reference's default); 2d permutation search is not")
+    w = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    shape = w.shape
+    if shape[-1] % m != 0:
+        return jnp.ones_like(w)          # unprunable tail — dense
+    g = w.reshape(-1, m)
+    order = jnp.argsort(jnp.abs(g), axis=-1)        # ascending
+    keep = order[:, m - n:]                          # top-n indices
+    mask = jnp.zeros_like(g)
+    mask = mask.at[jnp.arange(g.shape[0])[:, None], keep].set(1.0)
+    return mask.reshape(shape)
+
+
+def calculate_density(t) -> float:
+    a = np.asarray(t._data if isinstance(t, Tensor) else t)
+    return float((a != 0).sum() / a.size)
+
+
+def check_sparsity(t, n: int = 2, m: int = 4) -> bool:
+    """Every m-group has at most n nonzeros (reference check_sparsity)."""
+    a = np.asarray(t._data if isinstance(t, Tensor) else t)
+    if a.shape[-1] % m != 0:
+        return False
+    g = (a.reshape(-1, m) != 0).sum(axis=-1)
+    return bool((g <= n).all())
+
+
+_SUPPORTED = (nn.Linear, nn.Conv2D)
+
+
+def _prunable_params(model: nn.Layer):
+    excl = _excluded.get(0, [])
+    for lname, layer in model.named_sublayers(include_self=True):
+        if not isinstance(layer, _SUPPORTED):
+            continue
+        w = getattr(layer, "weight", None)
+        if w is None:
+            continue
+        pname = f"{lname}.weight" if lname else "weight"
+        if pname in excl or (w.name or "") in excl:
+            continue
+        yield pname, w
+
+
+def prune_model(model: nn.Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d", with_mask: bool = True):
+    """Apply n:m masks to every supported layer's weight (asp.py
+    prune_model contract). Returns {param_name: mask}."""
+    out = {}
+    for pname, w in _prunable_params(model):
+        mask = create_mask(w, n, m, mask_algo)
+        w._replace_data(w._data * mask)
+        if with_mask:
+            _store_mask(w, mask)
+        out[pname] = Tensor(mask, stop_gradient=True)
+    return out
+
+
+class _ASPOptimizer:
+    """decorate() wrapper (OptimizerWithSparsityGuarantee): after every
+    step, re-apply the stored masks so updated weights keep the n:m
+    pattern."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()          # OUR step: masks re-applied
+        return None, None
+
+    def step(self):
+        self._inner.step()
+        for p in self._inner._parameter_list():
+            mask = _mask_for(p)
+            if mask is not None:
+                p._replace_data(p._data * mask)
+                # multi-precision master weights must stay masked too,
+                # or the pattern erodes through the f32 copy
+                st = self._inner._states.get(id(p))
+                if isinstance(st, dict) and "master" in st:
+                    st["master"] = st["master"] * mask
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def decorate(optimizer):
+    """asp.decorate parity: wrap the optimizer so masks survive updates."""
+    return _ASPOptimizer(optimizer)

@@ -179,7 +179,7 @@ class GPTMLP(nn.Layer):
 
     def forward(self, x):
         h = F.gelu(self.up(x))
-        if self._tag_gelu:
+        if self._tag_gelu and self.training:
             # named residual for the "dots_plus" policy (saves the gelu
             # output so backward skips its recompute). Routed through
             # apply_op: the tag must not sever the eager tape (it is a

@@ -1,0 +1,57 @@
+"""2:4 structured sparsity (incubate/asp.py; reference incubate/asp/)."""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.optimizer as opt
+from paddle2_tpu import nn
+from paddle2_tpu.incubate import asp
+
+
+def test_create_mask_keeps_top2_of_4():
+    w = paddle.to_tensor(np.array(
+        [[1.0, -3.0, 0.5, 2.0, 4.0, 0.1, -0.2, 5.0]], np.float32))
+    mask = asp.create_mask(w)
+    np.testing.assert_array_equal(
+        np.asarray(mask), [[0, 1, 0, 1, 1, 0, 0, 1]])
+
+
+def test_prune_model_and_density():
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    masks = asp.prune_model(m)
+    assert len(masks) == 2
+    for lin in (m[0], m[2]):
+        assert asp.check_sparsity(lin.weight)
+        assert asp.calculate_density(lin.weight) <= 0.5 + 1e-6
+
+
+def test_excluded_layers_skipped():
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    asp.set_excluded_layers(["0.weight"])
+    try:
+        masks = asp.prune_model(m)
+        assert "0.weight" not in masks and "1.weight" in masks
+        assert not asp.check_sparsity(m[0].weight)  # left dense
+    finally:
+        asp.reset_excluded_layers()
+
+
+def test_decorated_optimizer_preserves_pattern():
+    paddle.seed(0)
+    m = nn.Linear(16, 16)
+    asp.prune_model(m)
+    o = asp.decorate(opt.AdamW(learning_rate=0.05,
+                               parameters=m.parameters()))
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rs.randn(8, 16).astype(np.float32))
+    for _ in range(5):
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    assert asp.check_sparsity(m.weight)          # 2:4 survives training
+    assert asp.calculate_density(m.weight) <= 0.5 + 1e-6
