@@ -110,7 +110,9 @@ def bench_gpt():
     seq = int(os.environ.get("BENCH_SEQ", 1024))
     batch = int(os.environ.get("BENCH_BATCH", 8))
     vocab = int(os.environ.get("BENCH_VOCAB", 32768))
-    steps = int(os.environ.get("BENCH_STEPS", 10))
+    # 40-step window: the tunnel sync latency (~0.1-1.5s per readback)
+    # inflates a 10-step window by ~6%
+    steps = int(os.environ.get("BENCH_STEPS", 40))
     if not on_tpu:  # CPU smoke profile so the harness never hangs
         hidden, layers, heads, seq, batch, vocab, steps = \
             256, 4, 4, 256, 4, 4096, 3
@@ -187,7 +189,7 @@ def bench_ernie():
     on_tpu = _on_tpu()
     seq = int(os.environ.get("BENCH_SEQ", 128))
     batch = int(os.environ.get("BENCH_BATCH", 32))
-    steps = int(os.environ.get("BENCH_STEPS", 10))
+    steps = int(os.environ.get("BENCH_STEPS", 30))
     if on_tpu:
         cfg = ernie3_base(hidden_dropout_prob=0.0,
                           attention_dropout_prob=0.0)
@@ -250,7 +252,7 @@ def bench_resnet50():
 
     on_tpu = _on_tpu()
     batch = int(os.environ.get("BENCH_BATCH", 128))
-    steps = int(os.environ.get("BENCH_STEPS", 10))
+    steps = int(os.environ.get("BENCH_STEPS", 30))
     size = 224
     paddle.seed(0)
     if on_tpu:
