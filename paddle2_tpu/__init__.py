@@ -64,6 +64,7 @@ from . import fft  # noqa: F401
 from . import sparse  # noqa: F401
 from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
+from . import onnx  # noqa: F401
 from . import signal  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import hub  # noqa: F401

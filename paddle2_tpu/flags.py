@@ -95,6 +95,25 @@ define_flag("enable_api_kernel_fallback", True,
 define_flag("eager_vjp_cache", True,
             "Cache per-op linearized VJP computations keyed on shapes/dtypes.")
 define_flag("log_level", 0, "Framework verbosity (VLOG-style).")
+def _apply_compilation_cache(path: str) -> None:
+    import jax
+    # empty REALLY disables (clears a previously-set directory)
+    jax.config.update("jax_compilation_cache_dir", path or None)
+    if path:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+
+
+define_flag("compilation_cache_dir", os.environ.get(
+    "PADDLE2_TPU_CACHE_DIR", ""),
+    "Persistent XLA compilation cache directory: repeat runs skip the "
+    "30s+ first-compile of large programs (the executor program-cache "
+    "persistence analog). Empty disables.",
+    on_change=_apply_compilation_cache)
+if _REGISTRY["compilation_cache_dir"].value:
+    _apply_compilation_cache(_REGISTRY["compilation_cache_dir"].value)
+
+
 define_flag("max_program_cache_size", 32,
             "Guard-miss budget per to_static function: beyond this many "
             "compiled variants the function falls back to eager "

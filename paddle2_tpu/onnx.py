@@ -1,0 +1,33 @@
+"""paddle.onnx — export surface (reference python/paddle/onnx/export.py,
+which shells out to paddle2onnx).
+
+Decision record (README "Deliberate omissions"): the portable artifact
+of this framework is StableHLO, not ONNX — `paddle.jit.save` writes a
+serialized StableHLO program + weights that any PJRT backend (TPU, GPU,
+CPU) executes with versioned stability guarantees. `export` here keeps
+the reference call sites working by producing that artifact and saying
+so, instead of silently writing nothing.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version=None,
+           **configs):
+    """Reference signature (onnx/export.py:30). Writes the StableHLO
+    artifact via paddle.jit.save and returns its path; `opset_version`
+    does not apply to StableHLO and is ignored with a warning."""
+    from . import jit
+
+    if opset_version is not None:
+        warnings.warn(
+            "paddle2_tpu.onnx.export writes a StableHLO artifact (the "
+            "TPU-native portable format); opset_version is ignored. See "
+            "README 'Deliberate omissions' for the rationale and the "
+            "serving path.", UserWarning, stacklevel=2)
+    jit.save(layer, path, input_spec=input_spec, **configs)
+    return path

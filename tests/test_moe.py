@@ -93,3 +93,45 @@ def test_moe_under_to_static():
     st = paddle.jit.to_static(lambda t: moe(t))
     out = st(x)
     np.testing.assert_allclose(out.numpy(), eager, rtol=1e-4, atol=1e-5)
+
+
+def test_sort_dispatch_matches_dense():
+    """The O(S*M) scatter/gather dispatch must equal the dense GShard
+    einsum formulation — outputs AND gradients."""
+    import paddle2_tpu as paddle
+    from paddle2_tpu import nn
+    from paddle2_tpu.incubate.moe import MoELayer
+
+    def build(mode):
+        paddle.seed(0)
+        experts = [nn.Sequential(nn.Linear(16, 32), nn.GELU(),
+                                 nn.Linear(32, 16)) for _ in range(4)]
+        return MoELayer(d_model=16, experts=experts, top_k=2,
+                        dispatch_mode=mode)
+
+    rs = np.random.RandomState(0)
+    xv = rs.randn(2, 24, 16).astype(np.float32)
+    outs, grads = {}, {}
+    for mode in ("dense", "sort"):
+        m = build(mode)
+        x = paddle.to_tensor(xv.copy())
+        x.stop_gradient = False
+        out = m(x)
+        (out ** 2).sum().backward()
+        outs[mode] = out.numpy()
+        grads[mode] = x.grad.numpy()
+    np.testing.assert_allclose(outs["sort"], outs["dense"],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(grads["sort"], grads["dense"],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dispatch_mode_auto_and_validation():
+    import pytest as _pytest
+    from paddle2_tpu import nn
+    from paddle2_tpu.incubate.moe import MoELayer
+    experts = [nn.Linear(8, 8) for _ in range(2)]
+    with _pytest.raises(ValueError):
+        MoELayer(8, experts, dispatch_mode="bogus")
+    m = MoELayer(8, experts, dispatch_mode="auto")
+    assert m._mode() in ("sort", "dense")
