@@ -23,8 +23,8 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["CppExtension", "CUDAExtension", "load", "setup",
-           "CustomOpLibrary", "get_build_directory"]
+__all__ = ["CppExtension", "CUDAExtension", "load", "load_ffi", "setup",
+           "CustomOpLibrary", "FFIOpLibrary", "get_build_directory"]
 
 
 def get_build_directory() -> str:
@@ -141,3 +141,55 @@ def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
                        capture_output=not verbose)
         os.replace(out + f".{os.getpid()}.tmp", out)
     return CustomOpLibrary(name, out)
+
+
+# ---------------------------------------------------------------- XLA FFI
+
+class FFIOpLibrary(CustomOpLibrary):
+    """Custom ops through the XLA FFI (the modern analog of the
+    reference's phi/capi custom-KERNEL registration, paddle/phi/capi/):
+    the C++ handler compiles against jax.ffi's headers, registers as an
+    XLA custom-call target, and executes INSIDE compiled programs on the
+    cpu platform with zero Python per call — unlike the ctypes
+    pure_callback path, which round-trips the interpreter every
+    invocation. Device (TPU) compute still belongs in Pallas; FFI ops
+    cover host-side pipelines and CPU-backend deployments."""
+
+    def wrap_ffi(self, symbol: str, target: Optional[str] = None,
+                 out_shape: Optional[Callable] = None,
+                 dtype="float32") -> Callable:
+        """Register handler `symbol` (declared with
+        XLA_FFI_DEFINE_HANDLER_SYMBOL) as custom-call target `target`
+        and return a paddle op calling it via jax.ffi.ffi_call."""
+        import jax
+        import jax.numpy as jnp
+
+        target = target or f"{self.name}_{symbol}"
+        handler = getattr(self._lib, symbol)
+        jax.ffi.register_ffi_target(
+            target, jax.ffi.pycapsule(handler), platform="cpu")
+        np_dt = np.dtype(dtype)
+
+        def op(x):
+            from paddle2_tpu.ops.dispatch import apply_op, ensure_tensor
+            t = ensure_tensor(x)
+
+            def f(a):
+                shape = out_shape(a.shape) if out_shape else a.shape
+                return jax.ffi.ffi_call(
+                    target, jax.ShapeDtypeStruct(shape, np_dt))(a)
+            return apply_op(f"ffi_{target}", f, (t,), {},
+                            differentiable=False)
+
+        op.__name__ = symbol
+        return op
+
+
+def load_ffi(name: str, sources: Sequence[str], **kwargs) -> FFIOpLibrary:
+    """Build an XLA-FFI custom-op library (adds jax.ffi's include dir to
+    the compile; same content-hashed cache as load())."""
+    import jax
+    inc = list(kwargs.pop("extra_include_paths", []) or [])
+    inc.append(jax.ffi.include_dir())
+    lib = load(name, sources, extra_include_paths=inc, **kwargs)
+    return FFIOpLibrary(lib.name, lib.path)

@@ -1,0 +1,56 @@
+"""Custom C++ op paths (utils/cpp_extension.py): ctypes host op and the
+XLA FFI target (phi/capi custom-kernel registration analog)."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+from paddle2_tpu.utils.cpp_extension import load, load_ffi
+
+
+def _write(tmp_path, name, code):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(code))
+    return str(p)
+
+
+def test_ctypes_host_op(tmp_path):
+    src = _write(tmp_path, "scale.cc", """
+        #include <cstdint>
+        extern "C" void scale2(const float* in, int64_t n, float* out) {
+            for (int64_t i = 0; i < n; ++i) out[i] = in[i] * 2.0f;
+        }
+    """)
+    lib = load("scale_lib", [src], build_directory=str(tmp_path))
+    op = lib.wrap("scale2")
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    np.testing.assert_allclose(op(x).numpy(), [0, 2, 4, 6])
+
+
+def test_ffi_op_eager_and_jit(tmp_path):
+    src = _write(tmp_path, "sq.cc", """
+        #include "xla/ffi/api/ffi.h"
+        namespace ffi = xla::ffi;
+        static ffi::Error SqImpl(ffi::Buffer<ffi::F32> x,
+                                 ffi::ResultBuffer<ffi::F32> y) {
+          const float* in = x.typed_data();
+          float* out = y->typed_data();
+          for (size_t i = 0; i < x.element_count(); ++i)
+            out[i] = in[i] * in[i];
+          return ffi::Error::Success();
+        }
+        XLA_FFI_DEFINE_HANDLER_SYMBOL(
+            Sq, SqImpl,
+            ffi::Ffi::Bind().Arg<ffi::Buffer<ffi::F32>>()
+                            .Ret<ffi::Buffer<ffi::F32>>());
+    """)
+    lib = load_ffi("sq_lib", [src], build_directory=str(tmp_path))
+    sq = lib.wrap_ffi("Sq")
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(sq(x).numpy(), x.numpy() ** 2)
+    # FFI ops execute INSIDE the compiled program
+    st = paddle.jit.to_static(lambda t: sq(t) + 1.0)
+    np.testing.assert_allclose(st(x).numpy(), x.numpy() ** 2 + 1.0)
