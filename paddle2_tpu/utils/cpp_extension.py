@@ -108,7 +108,7 @@ class CustomOpLibrary:
 def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
          extra_cuda_cflags=None, extra_ldflags=None,
          extra_include_paths=None, build_directory=None,
-         verbose: bool = False, **kwargs) -> CustomOpLibrary:
+         verbose: bool = False, cls=None, **kwargs) -> CustomOpLibrary:
     """utils/cpp_extension/extension_utils.py load() parity: just-in-time
     g++ build, content-hashed cache."""
     build_dir = build_directory or get_build_directory()
@@ -123,10 +123,11 @@ def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
     for d in sorted(hdr_dirs):
         if not os.path.isdir(d):
             continue  # g++ ignores missing -I dirs; so does the hash
-        for fname in sorted(os.listdir(d)):
-            if fname.endswith((".h", ".hpp", ".hh", ".cuh")):
-                with open(os.path.join(d, fname), "rb") as f:
-                    blobs.append(f.read())
+        for root, _dirs, files in sorted(os.walk(d)):
+            for fname in sorted(files):
+                if fname.endswith((".h", ".hpp", ".hh", ".cuh")):
+                    with open(os.path.join(root, fname), "rb") as f:
+                        blobs.append(f.read())
     key = repr((extra_cxx_flags, extra_ldflags, extra_include_paths))
     tag = hashlib.sha256(b"".join(blobs)
                          + key.encode()).hexdigest()[:16]
@@ -140,7 +141,7 @@ def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
         subprocess.run(cmd, check=True,
                        capture_output=not verbose)
         os.replace(out + f".{os.getpid()}.tmp", out)
-    return CustomOpLibrary(name, out)
+    return (cls or CustomOpLibrary)(name, out)
 
 
 # ---------------------------------------------------------------- XLA FFI
@@ -157,21 +158,33 @@ class FFIOpLibrary(CustomOpLibrary):
 
     def wrap_ffi(self, symbol: str, target: Optional[str] = None,
                  out_shape: Optional[Callable] = None,
-                 dtype="float32") -> Callable:
+                 dtype="float32", platform: str = "cpu") -> Callable:
         """Register handler `symbol` (declared with
         XLA_FFI_DEFINE_HANDLER_SYMBOL) as custom-call target `target`
-        and return a paddle op calling it via jax.ffi.ffi_call."""
+        for `platform` and return a paddle op calling it via
+        jax.ffi.ffi_call. A CPU-only handler invoked while another
+        backend is active raises a clear error up front (a TPU custom
+        call would otherwise fail with an opaque 'target not found';
+        device compute belongs in Pallas)."""
         import jax
-        import jax.numpy as jnp
 
         target = target or f"{self.name}_{symbol}"
         handler = getattr(self._lib, symbol)
         jax.ffi.register_ffi_target(
-            target, jax.ffi.pycapsule(handler), platform="cpu")
+            target, jax.ffi.pycapsule(handler), platform=platform)
         np_dt = np.dtype(dtype)
 
         def op(x):
             from paddle2_tpu.ops.dispatch import apply_op, ensure_tensor
+            active = jax.devices()[0].platform.lower()
+            if active != platform.lower():
+                raise RuntimeError(
+                    f"FFI op {target!r} is registered for platform "
+                    f"{platform!r} but the active backend is {active!r}."
+                    " Host-side FFI ops run on the cpu backend; express "
+                    "TPU device compute in Pallas (kernels/), or use "
+                    "CustomOpLibrary.wrap() for a host callback that "
+                    "works from any backend.")
             t = ensure_tensor(x)
 
             def f(a):
@@ -191,5 +204,5 @@ def load_ffi(name: str, sources: Sequence[str], **kwargs) -> FFIOpLibrary:
     import jax
     inc = list(kwargs.pop("extra_include_paths", []) or [])
     inc.append(jax.ffi.include_dir())
-    lib = load(name, sources, extra_include_paths=inc, **kwargs)
-    return FFIOpLibrary(lib.name, lib.path)
+    return load(name, sources, extra_include_paths=inc, cls=FFIOpLibrary,
+                **kwargs)
