@@ -28,7 +28,26 @@ import time
 import numpy as np
 
 A100_AT_HALF_MFU = 0.5 * 312e12
-V5E_PEAK = 197e12
+
+# nominal bf16 dense peak per chip generation (TF/s); used for the MFU
+# denominator, keyed on the detected device kind with v5e as fallback
+_CHIP_PEAKS = {
+    "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
+    "v4": 275e12, "v5p": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12, "trillium": 918e12,
+}
+
+
+def _chip_peak():
+    """(peak_flops, chip_label) for the device the bench actually runs
+    on — a hardcoded v5e constant would mislabel MFU on any other
+    generation (ADVICE r3)."""
+    import jax
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for key, peak in _CHIP_PEAKS.items():
+        if key in kind:
+            return peak, key
+    return 197e12, f"v5e-assumed({kind or 'unknown'})"
 
 
 def log(*a):
@@ -162,13 +181,15 @@ def bench_gpt():
     tokens_per_sec = batch * seq / dt
     flops_per_token = 6 * n_params + 12 * layers * seq * hidden
     model_flops = tokens_per_sec * flops_per_token
+    peak, chip = _chip_peak()
     print(json.dumps({
         "metric": "gpt_lm_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(model_flops / A100_AT_HALF_MFU, 3),
         "step_time_s": round(dt, 4),
-        "mfu_vs_v5e_peak": round(model_flops / V5E_PEAK, 3),
+        "mfu_vs_chip_peak": round(model_flops / peak, 3),
+        "chip": chip,
         "sustained_matmul_tf": _sustained_matmul_tf(),
         "model_params_m": round(n_params / 1e6, 1),
         "config": {"hidden": hidden, "layers": layers, "seq": seq,
@@ -228,13 +249,15 @@ def bench_ernie():
     flops_per_token = 6 * n_params + 12 * cfg.num_layers * seq * \
         cfg.hidden_size
     model_flops = tokens_per_sec * flops_per_token
+    peak, chip = _chip_peak()
     print(json.dumps({
         "metric": "ernie_sst2_finetune_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(model_flops / A100_AT_HALF_MFU, 3),
         "step_time_s": round(dt, 4),
-        "mfu_vs_v5e_peak": round(model_flops / V5E_PEAK, 3),
+        "mfu_vs_chip_peak": round(model_flops / peak, 3),
+        "chip": chip,
         "model_params_m": round(n_params / 1e6, 1),
         "config": {"seq": seq, "batch": batch,
                    "hidden": cfg.hidden_size, "layers": cfg.num_layers},
@@ -293,13 +316,15 @@ def bench_resnet50():
     # runs ResNet-18@64 (~1.8G @224 scaled by the pixel ratio)
     fwd_flops = 4.1e9 if on_tpu else 1.8e9 * (size / 224) ** 2
     model_flops = ips * 3 * fwd_flops
+    peak, chip = _chip_peak()
     print(json.dumps({
         "metric": "resnet50_imagenet_images_per_sec",
         "value": round(ips, 1),
         "unit": "images/s",
         "vs_baseline": round(model_flops / A100_AT_HALF_MFU, 3),
         "step_time_s": round(dt, 4),
-        "mfu_vs_v5e_peak": round(model_flops / V5E_PEAK, 3),
+        "mfu_vs_chip_peak": round(model_flops / peak, 3),
+        "chip": chip,
         "model_params_m": round(n_params / 1e6, 1),
         "config": {"batch": batch, "image": size},
         "device": str(jax.devices()[0]),

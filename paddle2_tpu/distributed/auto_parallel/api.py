@@ -82,6 +82,8 @@ def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
         if dtype is not None:
             arr = arr.astype(core.convert_dtype(dtype))
         data._replace_data(_place_array(arr, sharding))
+        if stop_gradient is not None:
+            data.stop_gradient = stop_gradient
         return data
     if dtype is not None:
         data = data.astype(dtype)
@@ -189,10 +191,11 @@ class _ShardOptimizer:
     param, accumulator) -> placed accumulator`."""
 
     def __init__(self, optimizer, shard_fn=None,
-                 gradient_accumulation_steps: int = 1):
+                 gradient_accumulation_steps: int = 1, avg: bool = True):
         self._inner = optimizer
         self._shard_fn = shard_fn
         self._k = max(1, int(gradient_accumulation_steps))
+        self._avg = bool(avg)
         self._calls = 0
         from ...optimizer.optimizer import Optimizer
         if isinstance(optimizer, Optimizer):
@@ -228,9 +231,23 @@ class _ShardOptimizer:
             lambda kp, a: place(jax.tree_util.keystr(kp), a), state)
 
     # -- delegation ------------------------------------------------------
+    def _scale_grads(self, scale):
+        """Average the k accumulated microbatch grads (reference
+        GradientMergeOptimizer defaults avg=True — applying the raw SUM
+        would make the effective update k-fold larger)."""
+        opt = self._inner
+        while not hasattr(opt, "_parameter_list") \
+                and hasattr(opt, "_inner"):
+            opt = opt._inner
+        for p in opt._parameter_list():
+            if p is not None and p.grad is not None:
+                p.grad._replace_data(p.grad._data * scale)
+
     def step(self):
         self._calls += 1
         if self._calls % self._k == 0:
+            if self._avg and self._k > 1:
+                self._scale_grads(1.0 / self._k)
             self._inner.step()
 
     def clear_grad(self, set_to_zero: bool = False):
@@ -244,10 +261,12 @@ class _ShardOptimizer:
 
 
 def shard_optimizer(optimizer, shard_fn: Optional[Callable] = None,
-                    gradient_accumulation_steps: int = 1) -> _ShardOptimizer:
+                    gradient_accumulation_steps: int = 1,
+                    avg: bool = True) -> _ShardOptimizer:
     """api.py:1591: wrap the optimizer so accumulators follow their
     parameter's placement (or `shard_fn`'s decision)."""
-    return _ShardOptimizer(optimizer, shard_fn, gradient_accumulation_steps)
+    return _ShardOptimizer(optimizer, shard_fn, gradient_accumulation_steps,
+                           avg=avg)
 
 
 # ------------------------------------------------------------ dataloader
@@ -362,7 +381,8 @@ class Strategy:
                             accumulate_steps=1)
         self.fused_passes = sub("fused_passes", enable=False,
                                 fused_passes_list=[])
-        self.gradient_merge = sub("gradient_merge", enable=False, k_steps=1)
+        self.gradient_merge = sub("gradient_merge", enable=False, k_steps=1,
+                                  avg=True)
 
     def __repr__(self):
         return (f"Strategy(sharding={self.sharding}, amp={self.amp}, "
@@ -396,8 +416,10 @@ class DistModel:
         k = int(self._strategy.gradient_merge.k_steps) \
             if self._strategy.gradient_merge.enable else 1
         if self._mode == "train" and k > 1 and opt is not None:
-            self._optimizer = _ShardOptimizer(opt,
-                                              gradient_accumulation_steps=k)
+            self._optimizer = _ShardOptimizer(
+                opt, gradient_accumulation_steps=k,
+                avg=bool(getattr(self._strategy.gradient_merge, "avg",
+                                 True)))
         self._train_step = None
         self._eval_prog = None
 

@@ -23,11 +23,16 @@ def _angle_table(S, D, base, neox, dtype):
     hit = _ANGLE_CACHE.get(key)
     if hit is not None:
         return hit
+    import jax
     inv = 1.0 / (base ** (np.arange(0, D, 2, dtype=np.float64) / D))
     ang = np.arange(S, dtype=np.float64)[:, None] * inv[None]
     full = np.repeat(ang, 2, axis=1) if neox \
         else np.concatenate([ang, ang], axis=1)
-    out = (jnp.asarray(np.cos(full), dtype), jnp.asarray(np.sin(full), dtype))
+    # concrete even under an active jit trace — otherwise the memo cache
+    # would capture DynamicJaxprTracers and poison later eager calls
+    with jax.ensure_compile_time_eval():
+        out = (jnp.asarray(np.cos(full), dtype),
+               jnp.asarray(np.sin(full), dtype))
     if len(_ANGLE_CACHE) > 64:
         _ANGLE_CACHE.clear()
     _ANGLE_CACHE[key] = out
@@ -59,7 +64,23 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                   "[batch, seq, heads, dim] first")
     B, S, H, D = first.shape
     if sin is None or cos is None:
-        cos_a, sin_a = _angle_table(S, D, float(rotary_emb_base),
+        rows = S
+        if position_ids is not None \
+                and not isinstance(ensure_tensor(position_ids)._data,
+                                   jax.core.Tracer):
+            # positions may exceed seq_len (decode loops index absolute
+            # positions); JAX gathers clamp out-of-range indices, so an
+            # S-row table would silently mis-rotate — size it to cover
+            # the actual max position. Rows are bucketed to the next
+            # multiple of 1024 so a decode loop reuses one memoized
+            # table instead of rebuilding it every step. Traced
+            # position_ids keep the S-row table (in-range by contract;
+            # out-of-range needs explicit sin/cos sized to max position).
+            pid = ensure_tensor(position_ids)._data
+            max_pos = int(np.asarray(pid).max())
+            if max_pos >= S:
+                rows = -(-(max_pos + 1) // 1024) * 1024
+        cos_a, sin_a = _angle_table(rows, D, float(rotary_emb_base),
                                     bool(use_neox_rotary_style),
                                     str(first._data.dtype))
     else:

@@ -54,8 +54,17 @@ def _harmonize_placements(tensors) -> tuple:
     for a in arrays:
         s = getattr(a, "sharding", None)
         if (isinstance(s, NamedSharding) and len(s.device_set) > 1):
-            mesh = s.mesh
-            break
+            if mesh is None:
+                mesh = s.mesh
+            elif s.mesh != mesh:
+                raise ValueError(
+                    "operands are committed to DIFFERENT meshes "
+                    f"({mesh.axis_names}{mesh.devices.shape} vs "
+                    f"{s.mesh.axis_names}{s.mesh.devices.shape}); "
+                    "reshard one side explicitly (dist.reshard) — eager "
+                    "ops will not silently re-place across meshes (the "
+                    "multi-mesh pipeline dataloader routes inputs and "
+                    "labels to different stage meshes on purpose)")
     if mesh is None:
         mesh_mod = sys.modules.get("paddle2_tpu.distributed.mesh")
         if mesh_mod is None or not mesh_mod.mesh_initialized():

@@ -334,6 +334,53 @@ class TestReviewRegressions:
         model(x, y)                      # call 2: applied
         assert not np.array_equal(m.weight.numpy(), before)
 
+    def test_gradient_merge_averages_not_sums(self):
+        """ADVICE r3: the reference GradientMergeOptimizer defaults
+        avg=True — the k accumulated microbatch grads must be AVERAGED,
+        else the effective update is k-fold larger than a single step."""
+        import paddle2_tpu.optimizer as opt
+
+        def run(k_steps):
+            paddle.seed(0)
+            m = nn.Linear(4, 2)
+            before = m.weight.numpy().copy()
+            o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+            model = dist.to_static(
+                m, None, nn.MSELoss(), o,
+                dist.Strategy({"gradient_merge": {"enable": True,
+                                                  "k_steps": k_steps}}))
+            x = paddle.ones([2, 4])
+            y = paddle.zeros([2, 2])
+            for _ in range(k_steps):
+                model(x, y)
+            return m.weight.numpy() - before
+
+        delta1 = run(1)
+        delta2 = run(2)  # same batch twice: avg grad == single-step grad
+        np.testing.assert_allclose(delta2, delta1, rtol=1e-5, atol=1e-6)
+
+    def test_shard_tensor_param_applies_stop_gradient(self):
+        """ADVICE r3: the in-place Parameter branch must honor
+        stop_gradient like the non-Parameter path does."""
+        mesh = _mesh1d()
+        lin = nn.Linear(4, 4)
+        w = lin.weight
+        assert not w.stop_gradient
+        out = dist.shard_tensor(w, mesh, [dist.Replicate()],
+                                stop_gradient=True)
+        assert out is w
+        assert w.stop_gradient
+
+    def test_eager_ops_reject_conflicting_meshes(self):
+        """ADVICE r3: operands committed to two DIFFERENT meshes must
+        raise, not silently re-place onto whichever mesh came first."""
+        m0 = dist.ProcessMesh([0, 1, 2, 3], dim_names=["dp"])
+        m1 = dist.ProcessMesh([4, 5, 6, 7], dim_names=["dp"])
+        a = dist.shard_tensor(paddle.ones([8, 4]), m0, [dist.Shard(0)])
+        b = dist.shard_tensor(paddle.ones([8, 4]), m1, [dist.Shard(0)])
+        with pytest.raises(ValueError, match="DIFFERENT meshes"):
+            _ = a + b
+
     def test_shard_tensor_param_dtype_stays_in_place(self):
         mesh = _mesh1d()
         lin = nn.Linear(8, 8)

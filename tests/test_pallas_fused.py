@@ -139,3 +139,28 @@ class TestFusedRopeAPI:
         qo.sum().backward()
         assert q.grad is not None
         assert np.isfinite(q.grad.numpy()).all()
+
+    def test_position_ids_beyond_seq_len(self):
+        """ADVICE r3: positions >= seq_len (decode-loop use) must index a
+        table sized to max(position_ids)+1 — with an S-row table JAX's
+        clamped gather silently reuses the last row's rotation."""
+        rs = np.random.RandomState(2)
+        from paddle2_tpu.incubate.nn.functional import (
+            fused_rotary_position_embedding)
+        B, S, H, D = 1, 4, 2, 16
+        offset = 100  # absolute positions far past seq_len
+        q = paddle.to_tensor(rs.randn(B, S, H, D).astype(np.float32))
+        pos = paddle.to_tensor(
+            (np.arange(S)[None] + offset).astype(np.int64))
+        qo, _, _ = fused_rotary_position_embedding(
+            q, position_ids=pos, use_neox_rotary_style=False)
+        # reference: rotate a longer sequence and slice the same window
+        big_S = offset + S
+        qbig = paddle.to_tensor(np.concatenate(
+            [np.zeros((B, offset, H, D), np.float32), np.asarray(q._data)],
+            axis=1))
+        ref, _, _ = fused_rotary_position_embedding(
+            qbig, use_neox_rotary_style=False)
+        np.testing.assert_allclose(np.asarray(qo._data),
+                                   np.asarray(ref._data)[:, offset:],
+                                   rtol=1e-4, atol=1e-5)
