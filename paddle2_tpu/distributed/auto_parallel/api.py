@@ -96,23 +96,110 @@ def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
     return out
 
 
+_PARTIAL_RESHARD_CACHE: dict = {}
+
+
+def _resolve_partial(arr, mesh: ProcessMesh, placements, src_partial):
+    """p_to_r / p_to_s (reference reshard registry p_to_r_reshard_function
+    .cc, p_to_s_reshard_function.cc): an eager "partial" array is a
+    shard_map(check_vma=False) output whose per-device buffers along the
+    named axes hold unreduced contributions while the sharding spec leaves
+    those axes unmentioned. Lower the reduction to a shard_map program:
+    psum_scatter for axes the target shards (p_to_s — reduction and
+    scatter fused on ICI), psum for the rest (p_to_r); non-partial axes
+    pass through for the caller's final device_put."""
+    jm = mesh.to_jax_mesh()
+    # (axis, reduce_op) list; entries are axis names or Partial-tagged
+    ops = {}
+    for entry in src_partial:
+        if isinstance(entry, tuple):
+            ax, red = entry
+        else:
+            ax, red = entry, "sum"
+        if ax not in mesh.dim_names:
+            raise ValueError(f"src_partial axis {ax!r} not in mesh axes "
+                             f"{list(mesh.dim_names)}")
+        if red not in ("sum", "avg", "max", "min"):
+            raise ValueError(f"unsupported partial reduce {red!r}")
+        ops[ax] = red
+
+    cur = getattr(arr, "sharding", None)
+    in_spec = cur.spec if isinstance(cur, NamedSharding) \
+        and cur.mesh.shape == jm.shape else PartitionSpec()
+    used = {a for e in in_spec for a in
+            ((e,) if isinstance(e, str) else (e or ()))}
+    overlap = used & set(ops)
+    if overlap:
+        raise ValueError(
+            f"axes {sorted(overlap)} already shard the source tensor — a "
+            "mesh axis cannot be both Shard and Partial")
+
+    # partial axes the target wants sharded -> fused psum_scatter (sum/avg
+    # only; max/min reduce fully then let the final placement shard)
+    scatter = {}
+    for mdim, pl in enumerate(placements):
+        name = mesh.dim_names[mdim]
+        if name in ops and isinstance(pl, Shard) \
+                and ops[name] in ("sum", "avg"):
+            scatter[name] = pl.get_dim()
+    plain = [a for a in ops if a not in scatter]
+
+    out_parts = [list((e,) if isinstance(e, str) else (e or ()))
+                 for e in tuple(in_spec) + ((),) * (arr.ndim - len(in_spec))]
+    for a, d in scatter.items():
+        out_parts[d].append(a)
+    out_spec = PartitionSpec(*[
+        tuple(p) if len(p) > 1 else (p[0] if p else None)
+        for p in out_parts])
+
+    key = (id(jm), in_spec, out_spec, tuple(sorted(ops.items())),
+           tuple(sorted(scatter.items())), arr.shape, str(arr.dtype))
+    fn = _PARTIAL_RESHARD_CACHE.get(key)
+    if fn is None:
+        def body(x):
+            for a, d in scatter.items():
+                x = jax.lax.psum_scatter(x, a, scatter_dimension=d,
+                                         tiled=True)
+                if ops[a] == "avg":
+                    x = x / jm.shape[a]
+            for a in plain:
+                red = ops[a]
+                if red == "max":
+                    x = jax.lax.pmax(x, a)
+                elif red == "min":
+                    x = jax.lax.pmin(x, a)
+                else:
+                    x = jax.lax.psum(x, a)
+                    if red == "avg":
+                        x = x / jm.shape[a]
+            return x
+
+        fn = jax.jit(jax.shard_map(body, mesh=jm, in_specs=in_spec,
+                                   out_specs=out_spec, check_vma=False))
+        if len(_PARTIAL_RESHARD_CACHE) > 256:
+            _PARTIAL_RESHARD_CACHE.clear()
+        _PARTIAL_RESHARD_CACHE[key] = fn
+    return fn(arr)
+
+
 def reshard(dist_tensor, mesh: ProcessMesh,
             placements: Sequence[Placement],
-            src_partial: Optional[Sequence[str]] = None) -> Tensor:
+            src_partial: Optional[Sequence] = None) -> Tensor:
     """Change a tensor's placement (api.py:705). All Shard/Replicate
     transitions (the reference's r_to_s/s_to_r/s_to_s/cross-mesh reshard
     function registry) are ONE device_put — XLA plans the all-gather /
     slice / collective-permute. `src_partial` names mesh axes whose
-    partial values must be summed first (the p_to_r/p_to_s transitions):
-    pass it when converting shard_map outputs."""
+    per-device values are unreduced contributions (shard_map outputs with
+    check_vma=False): those are resolved first — psum_scatter onto axes
+    the target shards (p_to_s), psum for the rest (p_to_r). Entries are
+    axis names (sum) or (axis, op) with op in sum/avg/max/min."""
     t = ensure_tensor(dist_tensor)
     sharding = _named_sharding(mesh, placements, t.ndim)
     if src_partial:
-        raise NotImplementedError(
-            "partial-source reshard: reduce inside the shard_map that "
-            "produced the partial value (jax.lax.psum over "
-            f"{list(src_partial)}) — an eager array cannot carry partial "
-            "state on TPU")
+        def fn(arr):
+            resolved = _resolve_partial(arr, mesh, placements, src_partial)
+            return _place_array(resolved, sharding)
+        return apply_op("reshard_p", fn, (t,), {})
     return apply_op("reshard", _placement_op(sharding), (t,), {})
 
 

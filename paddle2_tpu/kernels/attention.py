@@ -69,6 +69,14 @@ def remat_policy(base: str = "dots"):
                                         "mlp_gelu")
         return cp.save_from_both_policies(
             cp.dots_with_no_batch_dims_saveable, more)
+    if base == "dots_plus_ln":
+        # also pin the layernorm outputs (tagged "ln_out"): backward skips
+        # the LN re-reduction (2 reduce passes over [tokens, H] each), at
+        # +2 activation tensors (~32MB/layer at the GPT bench shape)
+        more = cp.save_only_these_names("flash_out", "flash_lse",
+                                        "mlp_gelu", "ln_out")
+        return cp.save_from_both_policies(
+            cp.dots_with_no_batch_dims_saveable, more)
     return names
 
 

@@ -99,11 +99,17 @@ def _scores(q, k, scale):
         precision=jax.lax.Precision.DEFAULT) * scale
 
 
-def _bwd_p_ds(s, lse, delta, do, v):
+def _bwd_p_ds(s, lse, delta, do, v, guarded=True):
     """Shared flash-bwd tile math: probabilities p and score cotangent ds
-    from the masked tile `s` and saved (lse, delta)."""
-    p = jnp.exp(s - jnp.where(lse == NEG_INF, 0.0, lse))
-    p = jnp.where((s == NEG_INF) | (lse == NEG_INF), 0.0, p)
+    from the masked tile `s` and saved (lse, delta). `guarded=False`
+    skips the fully-masked-row selects (two VPU passes over the tile) —
+    valid whenever every row has at least one unmasked column, i.e.
+    causal with Sk >= Sq or no mask (the single-block fused path)."""
+    if guarded:
+        p = jnp.exp(s - jnp.where(lse == NEG_INF, 0.0, lse))
+        p = jnp.where((s == NEG_INF) | (lse == NEG_INF), 0.0, p)
+    else:
+        p = jnp.exp(s - lse)              # masked: exp(-inf - finite) = 0
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -112,6 +118,37 @@ def _bwd_p_ds(s, lse, delta, do, v):
 
 
 # ---------------------------------------------------------------- forward
+
+def _fwd_kernel_1blk(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                     offset):
+    """Single-block specialization (nq == nk == 1): the whole row fits in
+    one tile, so the online-softmax recurrence, VMEM scratch, and init/
+    finalize predication all collapse into a direct softmax — measured
+    ~30% faster than the general kernel at the GPT bench shape
+    (B8 S1024 H16 D64 on v5e). scale folds into the q tile in VMEM (an
+    XLA-side pre-scale would cost a full extra HBM pass on q).
+    Requires offset >= 0 when causal (every row has a valid column, so
+    the row max is finite and no masked-row guards are needed)."""
+    q = q_ref[0, 0] * jnp.asarray(scale, q_ref.dtype)
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.DEFAULT)
+    if causal:
+        bq, bk = s.shape
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + offset
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    m = jnp.max(s, axis=1, keepdims=True)
+    p = jnp.exp(s - m)                    # masked: exp(-inf - finite) = 0
+    l = jnp.sum(p, axis=1, keepdims=True)
+    o = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.DEFAULT)
+    o_ref[0, 0] = (o / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l), lse_ref.shape[2:])
+
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
                 *, scale, causal, block_q, block_k, nk, offset):
@@ -159,6 +196,27 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     bq, bk = _fit_block(Sq, block_q), _fit_block(Sk, block_k)
     nq, nk = Sq // bq, Sk // bk
 
+    if nq == 1 and nk == 1 and (not causal or Sk >= Sq):
+        o, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel_1blk, scale=scale, causal=causal,
+                              offset=Sk - Sq),
+            grid=(B, H),
+            in_specs=[pl.BlockSpec((1, 1, Sq, D),
+                                   lambda b, h: (b, h, 0, 0)),
+                      pl.BlockSpec((1, 1, Sk, D),
+                                   lambda b, h: (b, h, 0, 0)),
+                      pl.BlockSpec((1, 1, Sk, D),
+                                   lambda b, h: (b, h, 0, 0))],
+            out_specs=[pl.BlockSpec((1, 1, Sq, D),
+                                    lambda b, h: (b, h, 0, 0)),
+                       pl.BlockSpec((1, 1, Sq, 128),
+                                    lambda b, h: (b, h, 0, 0))],
+            out_shape=[jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+                       jax.ShapeDtypeStruct((B, H, Sq, 128), jnp.float32)],
+            interpret=interpret,
+        )(q, k, v)
+        return o, lse[..., 0]
+
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=bq, block_k=bk, nk=nk,
                                offset=Sk - Sq)
@@ -193,6 +251,46 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 
 # --------------------------------------------------------------- backward
+
+def _bwd_fused_1blk_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dq_ref, dk_ref, dv_ref, *, scale, causal,
+                           offset):
+    """Single-block fused backward (nq == nk == 1): dQ, dK, dV from ONE
+    score/probability computation — the two-kernel flash-2 split exists
+    only to order the tile accumulations, which a single tile does not
+    need. Saves one QK^T, one dO V^T, and one mask+exp pass vs the split
+    (measured 2.45 -> 1.70 ms/layer at the GPT bench shape on v5e).
+    Requires offset >= 0 when causal (no fully-masked rows, lse finite)."""
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0][:, :1]
+    delta = delta_ref[0, 0][:, :1]
+    qs = q * jnp.asarray(scale, q.dtype)
+    s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.DEFAULT)
+    if causal:
+        bq, bk = s.shape
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + offset
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    p, ds_f = _bwd_p_ds(s, lse, delta, do, v, guarded=False)
+    ds = ds_f.astype(q.dtype)
+    dv_ref[0, 0] = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT).astype(dv_ref.dtype)
+    dk_ref[0, 0] = jax.lax.dot_general(
+        ds, qs, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT).astype(dk_ref.dtype)
+    dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=jax.lax.Precision.DEFAULT)
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
@@ -298,6 +396,23 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
                     axis=-1)                              # (B, H, Sq)
     lse_b = jnp.broadcast_to(lse[..., None], (B, H, Sq, 128))
     delta_b = jnp.broadcast_to(delta[..., None], (B, H, Sq, 128))
+
+    if nq == 1 and nk == 1 and (not causal or Sk >= Sq):
+        spec_q = pl.BlockSpec((1, 1, Sq, D), lambda b, h: (b, h, 0, 0))
+        spec_k = pl.BlockSpec((1, 1, Sk, D), lambda b, h: (b, h, 0, 0))
+        spec_r = pl.BlockSpec((1, 1, Sq, 128), lambda b, h: (b, h, 0, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_1blk_kernel, scale=scale,
+                              causal=causal, offset=Sk - Sq),
+            grid=(B, H),
+            in_specs=[spec_q, spec_k, spec_k, spec_q, spec_r, spec_r],
+            out_specs=[spec_q, spec_k, spec_k],
+            out_shape=[jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+                       jax.ShapeDtypeStruct((B, H, Sk, D), k.dtype),
+                       jax.ShapeDtypeStruct((B, H, Sk, D), v.dtype)],
+            interpret=interpret,
+        )(q, k, v, do, lse_b, delta_b)
+        return dq, dk, dv
 
     q_spec_kmaj = pl.BlockSpec((1, 1, bq, D),
                                lambda b, h, ik, iq: (b, h, iq, 0))

@@ -175,7 +175,8 @@ class GPTMLP(nn.Layer):
         # the gelu residual tag only matters when the dots_plus remat
         # policy will consume it; other configs skip the extra dispatch
         self._tag_gelu = (cfg.use_recompute
-                          and cfg.recompute_granularity == "dots_plus")
+                          and cfg.recompute_granularity in
+                          ("dots_plus", "dots_plus_ln"))
 
     def forward(self, x):
         h = F.gelu(self.up(x))
@@ -202,15 +203,29 @@ class GPTBlock(nn.Layer):
         self.ln_2 = nn.LayerNorm(cfg.hidden_size, epsilon=eps)
         self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self._tag_ln = (cfg.use_recompute
+                        and cfg.recompute_granularity == "dots_plus_ln")
+
+    def _ln(self, norm, x):
+        out = norm(x)
+        if self._tag_ln and self.training:
+            # named residual for the "dots_plus_ln" policy (saves the LN
+            # output so backward skips its re-reduction)
+            from jax.ad_checkpoint import checkpoint_name
+            from ..ops.dispatch import apply_op
+            out = apply_op("ln_out_tag",
+                           lambda a: checkpoint_name(a, "ln_out"),
+                           (out,), {})
+        return out
 
     def forward(self, x, cache=None):
         if cache is not None:
-            a, new_cache = self.attn(self.ln_1(x), cache=cache)
+            a, new_cache = self.attn(self._ln(self.ln_1, x), cache=cache)
             x = x + self.dropout(a)
-            x = x + self.dropout(self.mlp(self.ln_2(x)))
+            x = x + self.dropout(self.mlp(self._ln(self.ln_2, x)))
             return _seq_constrain(x, self.cfg), new_cache
-        x = x + self.dropout(self.attn(self.ln_1(x)))
-        x = x + self.dropout(self.mlp(self.ln_2(x)))
+        x = x + self.dropout(self.attn(self._ln(self.ln_1, x)))
+        x = x + self.dropout(self.mlp(self._ln(self.ln_2, x)))
         return _seq_constrain(x, self.cfg)
 
 
@@ -267,7 +282,8 @@ class GPTModel(nn.Layer):
             from ..kernels.attention import remat_policy
             gran = self.cfg.recompute_granularity
             policy = remat_policy(
-                gran if gran in ("dots", "dots_plus") else "nothing")
+                gran if gran in ("dots", "dots_plus", "dots_plus_ln")
+                else "nothing")
             wrap = lambda body: jax.checkpoint(body, policy=policy)
         out = scan_layer_stack(list(self.h), x, wrap_body=wrap)
         return out if out is not None else self._fallback_loop(x)

@@ -93,6 +93,88 @@ class TestShardTensor:
         u = dist.unshard_dtensor(d)
         assert u.placements == [dist.Replicate()]
 
+    def _partial_tensor(self, mesh, shape=(8, 16)):
+        """Build an eager 'partial' array the way users get one: a
+        shard_map(check_vma=False) whose output skips the psum — each
+        device along 'x' holds its unreduced contribution."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        vals = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+
+        def body():
+            r = jax.lax.axis_index("x").astype(np.float32)
+            return jax.numpy.asarray(vals) * (r + 1.0)
+
+        f = jax.jit(jax.shard_map(body, mesh=mesh.to_jax_mesh(),
+                                  in_specs=(), out_specs=P(),
+                                  check_vma=False))
+        t = paddle.to_tensor(np.zeros(shape, np.float32))
+        t._data = f()
+        # true sum over ranks r=0..7 of vals*(r+1) = vals * 36
+        return t, vals * 36.0
+
+    def test_reshard_p_to_r(self):
+        """Mirror of reference test/auto_parallel/reshard_p_to_r.py."""
+        mesh = _mesh1d()
+        t, want = self._partial_tensor(mesh)
+        r = dist.reshard(t, mesh, [dist.Replicate()], src_partial=["x"])
+        assert r.placements == [dist.Replicate()]
+        np.testing.assert_allclose(r.numpy(), want, rtol=1e-6)
+
+    def test_reshard_p_to_s(self):
+        """Mirror of reference test/auto_parallel/reshard_p_to_s.py:
+        partial -> Shard(0) lowers to a fused psum_scatter."""
+        mesh = _mesh1d()
+        t, want = self._partial_tensor(mesh)
+        s = dist.reshard(t, mesh, [dist.Shard(0)], src_partial=["x"])
+        assert s.placements == [dist.Shard(0)]
+        np.testing.assert_allclose(s.numpy(), want, rtol=1e-6)
+        # scatter on the non-leading dim too
+        t2, want2 = self._partial_tensor(mesh)
+        s2 = dist.reshard(t2, mesh, [dist.Shard(1)], src_partial=["x"])
+        assert s2.placements == [dist.Shard(1)]
+        np.testing.assert_allclose(s2.numpy(), want2, rtol=1e-6)
+
+    def test_reshard_partial_avg_and_max(self):
+        mesh = _mesh1d()
+        t, want_sum = self._partial_tensor(mesh)
+        a = dist.reshard(t, mesh, [dist.Replicate()],
+                         src_partial=[("x", "avg")])
+        np.testing.assert_allclose(a.numpy(), want_sum / 8.0, rtol=1e-6)
+        t2, _ = self._partial_tensor(mesh)
+        base = np.arange(128, dtype=np.float32).reshape(8, 16)
+        mx = dist.reshard(t2, mesh, [dist.Replicate()],
+                          src_partial=[("x", "max")])
+        np.testing.assert_allclose(mx.numpy(), base * 8.0, rtol=1e-6)
+
+    def test_reshard_partial_on_2d_mesh_keeps_other_axis(self):
+        """Partial over 'y' while 'x' shards dim 0: the reduction must
+        not disturb the existing sharding."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        mesh = _mesh2d()
+        vals = np.arange(64, dtype=np.float32).reshape(8, 8)
+
+        def body(blk):
+            r = jax.lax.axis_index("y").astype(np.float32)
+            return blk * (r + 1.0)
+
+        f = jax.jit(jax.shard_map(body, mesh=mesh.to_jax_mesh(),
+                                  in_specs=P("x", None),
+                                  out_specs=P("x", None), check_vma=False))
+        t = paddle.to_tensor(np.zeros((8, 8), np.float32))
+        t._data = f(jax.numpy.asarray(vals))
+        out = dist.reshard(t, mesh, [dist.Shard(0), dist.Replicate()],
+                           src_partial=["y"])
+        assert out.placements == [dist.Shard(0), dist.Replicate()]
+        np.testing.assert_allclose(out.numpy(), vals * 3.0, rtol=1e-6)
+
+    def test_reshard_partial_rejects_sharded_axis(self):
+        mesh = _mesh1d()
+        a = dist.shard_tensor(paddle.ones([8, 4]), mesh, [dist.Shard(0)])
+        with pytest.raises(ValueError, match="both Shard and Partial"):
+            dist.reshard(a, mesh, [dist.Replicate()], src_partial=["x"])
+
     def test_dtensor_from_fn(self):
         mesh = _mesh1d()
         d = dist.dtensor_from_fn(paddle.ones, mesh, [dist.Shard(0)], [8, 4])
