@@ -47,13 +47,50 @@ __all__ = ["train_step", "TrainStepProgram"]
 
 
 class TrainStepProgram:
-    """Guarded cache of compiled fused-train-step executables."""
+    """Guarded cache of compiled fused-train-step executables.
+
+    Optimizer wrappers fuse too (round-3 verdict lifted the restriction):
+    - ZeRO ``ShardedOptimizer`` — its whole policy is buffer placement;
+      states (and params at stage 3) are placed once after creation and
+      the executable's ``out_shardings`` pin them there, so the donated
+      single-program path IS the sharded step (GSPMD inserts the gathers
+      and reduce-scatters the placements imply).
+    - gradient-accumulation ``_ShardOptimizer`` — grads accumulate into a
+      donated f32 buffer for k-1 calls (params/states pass through), and
+      the k-th call folds the average into the fused update. Two compiled
+      variants (accumulate / apply) share the cache entry.
+    """
 
     def __init__(self, fn: Callable, optimizer, layers: Sequence = ()):
         self.fn = fn
         self.optimizer = optimizer
+        # unwrap the wrapper chain down to the plain Optimizer that owns
+        # update math and state storage
+        self._accum_k = 1
+        self._accum_avg = True
+        self._zero = None
+        inner = optimizer
+        from ..optimizer.optimizer import Optimizer
+        while not isinstance(inner, Optimizer):
+            kind = type(inner).__name__
+            if kind == "_ShardOptimizer":
+                self._accum_k = max(1, int(inner._k))
+                self._accum_avg = bool(getattr(inner, "_avg", True))
+            elif kind == "ShardedOptimizer":
+                self._zero = inner
+            else:
+                raise TypeError(
+                    f"jit.train_step cannot fuse optimizer wrapper "
+                    f"{kind}; supported: plain Optimizer, "
+                    "dist.shard_optimizer (gradient accumulation), "
+                    "sharding.ShardedOptimizer (ZeRO)")
+            inner = inner._inner
+        self.inner_optimizer = inner
         self.layers = list(layers)
         self._compiled: Dict[Any, Any] = {}
+        self._micro_calls = 0
+        self._accum_buffers: Optional[list] = None
+        self._zero_placed = False
 
     @property
     def program_cache_size(self):
@@ -65,7 +102,7 @@ class TrainStepProgram:
 
     # -- internals -------------------------------------------------------
     def _call(self, args, kwargs):
-        opt = self.optimizer
+        opt = self.inner_optimizer
         all_params, buffers = _collect_state(self.layers)
         opt_params = [p for p in opt._parameter_list()
                       if p is not None and p.trainable]
@@ -75,6 +112,12 @@ class TrainStepProgram:
         frozen = [p for p in all_params if id(p) not in opt_ids]
         for p in opt_params:
             opt._ensure_state(p)
+        if self._zero is not None and not self._zero_placed:
+            # ZeRO is placement: shard the freshly-created states (and
+            # stage-3 params) once; out_shardings keep them there
+            self._zero._shard_states()
+            self._zero._place_params_and_grads()
+            self._zero_placed = True
         states = [opt._states[id(p)] for p in opt_params]
 
         template, args_t = _split_tensors(args, kwargs)
@@ -91,25 +134,53 @@ class TrainStepProgram:
                             for p in opt_params)
         from ..flags import flag_value
         donate = bool(flag_value("donate_optimizer_buffers"))
+
+        k = self._accum_k
+        self._micro_calls += 1
+        apply_update = k == 1 or (self._micro_calls % k == 0)
+        if k > 1 and self._accum_buffers is None:
+            self._accum_buffers = [
+                jnp.zeros(p._data.shape, jnp.float32) for p in opt_params]
+            if self._zero is not None:
+                # accumulated grads follow the ZeRO GRAD placement: at
+                # stage >= 2 grads are sharded even though params are
+                # replicated — a param-placed bank would hold a full
+                # f32 grad copy per device
+                from ..distributed.sharding import _place, _shard_spec
+                axis = self._zero._axis
+                if self._zero._level >= 2:
+                    self._accum_buffers = [
+                        _place(a, _shard_spec(a, axis))
+                        for a in self._accum_buffers]
+                else:
+                    self._accum_buffers = [
+                        jax.device_put(a, p._data.sharding)
+                        if hasattr(p._data, "sharding") else a
+                        for a, p in zip(self._accum_buffers, opt_params)]
+        accum = self._accum_buffers if k > 1 else []
+
         key = _guard_key(template, arg_arrays, self.layers) + (
-            len(opt_params), need_clip, decay_flags, donate)
+            len(opt_params), need_clip, decay_flags, donate, k,
+            apply_update, self._accum_avg)
         entry = self._compiled.get(key)
         if entry is None:
             entry = self._build(template, opt_params, frozen, buffers,
-                                need_clip, decay_flags, donate)
+                                need_clip, decay_flags, donate,
+                                apply_update, states, accum)
             self._compiled[key] = entry
 
-        opt._step_count += 1
+        if apply_update:
+            opt._step_count += 1
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
-        step_no = jnp.asarray(opt._step_count, jnp.int32)
+        step_no = jnp.asarray(max(1, opt._step_count), jnp.int32)
         rng_key = fr.next_key()
 
-        loss, new_params, new_states, post_buffers = entry(
+        loss, new_params, new_states, post_buffers, new_accum = entry(
             [p._data for p in opt_params],
             states,
             [p._data for p in frozen],
             [b._data for b in buffers],
-            arg_arrays, rng_key, lr, step_no)
+            arg_arrays, rng_key, lr, step_no, accum)
 
         for p, a in zip(opt_params, new_params):
             p._replace_data(a)
@@ -117,12 +188,15 @@ class TrainStepProgram:
             opt._states[id(p)] = s
         for b, a in zip(buffers, post_buffers):
             b._replace_data(a)
+        if k > 1:
+            self._accum_buffers = list(new_accum)
         return Tensor(loss, stop_gradient=True)
 
     def _build(self, template, opt_params, frozen, buffers, need_clip,
-               decay_flags, donate):
+               decay_flags, donate, apply_update, states, accum):
         fn = self.fn
-        update = self.optimizer._build_update(need_clip, decay_flags)
+        k, avg = self._accum_k, self._accum_avg
+        update = self.inner_optimizer._build_update(need_clip, decay_flags)
         state_tensors = list(opt_params) + list(frozen) + list(buffers)
 
         def run_model(param_arrays, frozen_arrays, buffer_arrays,
@@ -144,37 +218,61 @@ class TrainStepProgram:
             return loss, post_buffers
 
         def pure_step(param_arrays, states, frozen_arrays, buffer_arrays,
-                      arg_arrays, rng_key, lr, step_no):
+                      arg_arrays, rng_key, lr, step_no, accum):
             def loss_of(p_arrays):
                 loss, post_b = run_model(p_arrays, frozen_arrays,
                                          buffer_arrays, arg_arrays, rng_key)
                 return loss.astype(jnp.float32), post_b
             (loss, post_buffers), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(list(param_arrays))
+            if k > 1:
+                totals = [a + g.astype(jnp.float32)
+                          for a, g in zip(accum, grads)]
+                if not apply_update:
+                    # accumulation-only microstep: params/states ride
+                    # through untouched, grads bank into the f32 buffer
+                    return (loss, list(param_arrays), states, post_buffers,
+                            totals)
+                scale = 1.0 / k if avg else 1.0
+                grads = [(t * scale).astype(g.dtype)
+                         for t, g in zip(totals, grads)]
+                new_accum = [jnp.zeros_like(a) for a in accum]
+            else:
+                new_accum = []
             new_params, new_states = update(list(param_arrays), grads,
                                             states, lr, step_no)
-            return loss, new_params, new_states, post_buffers
+            return loss, new_params, new_states, post_buffers, new_accum
 
+        out_shardings = None
+        if self._zero is not None:
+            # pin the ZeRO placements across steps: without this, GSPMD
+            # may choose to materialize updated states replicated and the
+            # memory savings silently evaporate after step 1
+            sh = lambda a: getattr(a, "sharding", None)
+            out_shardings = (
+                None,
+                [sh(p._data) for p in opt_params],
+                jax.tree_util.tree_map(sh, states),
+                None,
+                [sh(a) for a in accum] if accum else [],
+            )
         return jax.jit(pure_step,
-                       donate_argnums=(0, 1, 3) if donate else ())
+                       donate_argnums=(0, 1, 3, 8) if donate else (),
+                       out_shardings=out_shardings)
 
 
 def train_step(fn: Callable, optimizer, layers: Optional[Sequence] = None
                ) -> TrainStepProgram:
     """Compile `fn` (returning a scalar loss) plus `optimizer`'s update
     into one donated XLA executable. Layers are discovered from `fn`'s
-    closure/globals like `to_static` when not given explicitly."""
-    from ..optimizer.optimizer import Optimizer
-    if not isinstance(optimizer, Optimizer):
-        # __getattr__-delegating wrappers (dist.shard_optimizer,
-        # ShardedOptimizer) apply their policies inside step(), which the
-        # fused path bypasses; attribute writes would also land on the
-        # wrapper and shadow the inner state. Refuse loudly.
-        raise TypeError(
-            f"jit.train_step needs a plain paddle Optimizer, got "
-            f"{type(optimizer).__name__}; pass the wrapped optimizer's "
-            "inner instance, or drive wrapper optimizers through "
-            "forward/backward/step")
+    closure/globals like `to_static` when not given explicitly.
+
+    Accepts a plain Optimizer, a ZeRO ``ShardedOptimizer``, or a
+    gradient-accumulation ``dist.shard_optimizer`` wrapper (in any
+    nesting) — wrapper policies are folded INTO the donated executable:
+    ZeRO as buffer placements + pinned out_shardings, accumulation as a
+    donated f32 grad bank with a k-th-call fused update. Unknown wrapper
+    types raise."""
     if layers is None:
         from .api import _discover_layers
         layers = _discover_layers(fn)

@@ -391,14 +391,102 @@ class TestShardDataloaderAndDistModel:
 
 
 class TestReviewRegressions:
-    def test_train_step_rejects_optimizer_wrappers(self):
+    def test_train_step_fuses_known_wrappers_rejects_unknown(self):
         import paddle2_tpu.optimizer as opt
         m = nn.Linear(4, 4)
         wrapped = dist.shard_optimizer(
-            opt.SGD(learning_rate=0.1, parameters=m.parameters()))
-        with pytest.raises(TypeError):
-            paddle.jit.train_step(lambda x: (m(x) ** 2).mean(), wrapped,
-                                  layers=[m])
+            opt.SGD(learning_rate=0.1, parameters=m.parameters()),
+            gradient_accumulation_steps=2)
+        step = paddle.jit.train_step(lambda x: (m(x) ** 2).mean(), wrapped,
+                                     layers=[m])
+        assert step._accum_k == 2
+
+        class Mystery:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, n):
+                return getattr(self._inner, n)
+
+        with pytest.raises(TypeError, match="cannot fuse"):
+            paddle.jit.train_step(
+                lambda x: (m(x) ** 2).mean(),
+                Mystery(opt.SGD(learning_rate=0.1,
+                                parameters=m.parameters())), layers=[m])
+
+    def test_fused_grad_accumulation_matches_single_big_batch(self):
+        """k accumulated microbatches through the FUSED path must match
+        one step on the averaged gradient (round-3 verdict item 4)."""
+        import paddle2_tpu.optimizer as opt
+
+        def run(k):
+            paddle.seed(7)
+            m = nn.Linear(6, 3)
+            o = opt.SGD(learning_rate=0.2, parameters=m.parameters())
+            if k > 1:
+                o = dist.shard_optimizer(o, gradient_accumulation_steps=k)
+            loss_fn = nn.MSELoss()
+            step = paddle.jit.train_step(
+                lambda x, y: loss_fn(m(x), y), o, layers=[m])
+            x = paddle.to_tensor(np.linspace(-1, 1, 24)
+                                 .reshape(4, 6).astype(np.float32))
+            y = paddle.zeros([4, 3])
+            for _ in range(max(1, k)):
+                step(x, y)
+            return m.weight.numpy()
+
+        np.testing.assert_allclose(run(3), run(1), rtol=1e-5, atol=1e-6)
+
+    def test_fused_grad_accumulation_defers_params(self):
+        import paddle2_tpu.optimizer as opt
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        before = m.weight.numpy().copy()
+        o = dist.shard_optimizer(
+            opt.SGD(learning_rate=0.1, parameters=m.parameters()),
+            gradient_accumulation_steps=3)
+        loss_fn = nn.MSELoss()
+        step = paddle.jit.train_step(lambda x, y: loss_fn(m(x), y), o,
+                                     layers=[m])
+        x, y = paddle.ones([2, 4]), paddle.zeros([2, 2])
+        step(x, y)
+        step(x, y)
+        np.testing.assert_array_equal(m.weight.numpy(), before)
+        step(x, y)   # k-th call applies
+        assert not np.array_equal(m.weight.numpy(), before)
+
+    def test_dist_model_zero_runs_single_executable_path(self):
+        """DistModel with sharding stage 1-3 must take the fused donated
+        path (round-3 verdict item 4) with states staying sharded."""
+        import jax
+        import paddle2_tpu.optimizer as opt
+        import paddle2_tpu.distributed as pdist
+        from jax.sharding import NamedSharding
+        pdist.init_mesh({"dp": 8})
+        for stage in (1, 2, 3):
+            paddle.seed(0)
+            m = nn.Linear(8, 8)
+            o = opt.AdamW(learning_rate=0.01, parameters=m.parameters())
+            model = dist.to_static(
+                m, None, nn.MSELoss(), o,
+                dist.Strategy({"sharding": {"enable": True,
+                                            "stage": stage}}))
+            x = paddle.ones([8, 8])
+            y = paddle.zeros([8, 8])
+            l0 = float(np.asarray(model(x, y)._data))
+            l1 = float(np.asarray(model(x, y)._data))
+            assert l1 < l0  # training happens
+            # fused path engaged (TrainStepProgram, not eager fallback)
+            from paddle2_tpu.jit.train_step import TrainStepProgram
+            assert isinstance(model._train_step, TrainStepProgram), stage
+            # optimizer moments sharded over dp and STAY sharded after
+            # the second donated step
+            st = o._states[id(m.weight)]
+            leaf = st["m"] if isinstance(st, dict) and "m" in st \
+                else next(iter(jax.tree_util.tree_leaves(st)))
+            sh = leaf.sharding
+            assert isinstance(sh, NamedSharding), stage
+            assert any(s is not None for s in sh.spec), stage
 
     def test_dist_model_gradient_merge_defers_updates(self):
         import paddle2_tpu.optimizer as opt
@@ -505,3 +593,194 @@ class TestReviewRegressions:
         sh = st["m"].sharding
         assert isinstance(sh, NamedSharding)
         assert any(s is not None for s in sh.spec)
+
+
+class TestStrategyPasses:
+    """Round-3 verdict item 3: Strategy.amp / recompute / pipeline must
+    change execution (or raise) — never parse-and-vanish."""
+
+    def test_amp_o2_casts_params(self):
+        import paddle2_tpu.optimizer as opt
+        m = nn.Linear(4, 4)
+        assert str(m.weight.dtype).endswith("float32")
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+        model = dist.to_static(
+            m, None, nn.MSELoss(), o,
+            dist.Strategy({"amp": {"enable": True, "level": "O2",
+                                   "dtype": "bfloat16"}}))
+        assert str(m.weight.dtype).endswith("bfloat16")
+        loss = model(paddle.ones([2, 4]), paddle.zeros([2, 4]))
+        assert np.isfinite(float(np.asarray(loss._data)))
+
+    def test_amp_o1_autocasts_traced_ops(self):
+        import paddle2_tpu.optimizer as opt
+
+        seen = {}
+
+        class Probe(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, x):
+                out = self.lin(x)
+                seen["dtype"] = str(out.dtype)
+                return out
+
+        m = Probe()
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+        model = dist.to_static(
+            m, None, nn.MSELoss(), o,
+            dist.Strategy({"amp": {"enable": True, "level": "O1",
+                                   "dtype": "bfloat16"}}))
+        model(paddle.ones([2, 4]), paddle.zeros([2, 4]))
+        assert seen["dtype"].endswith("bfloat16")
+        # params stayed f32 (O1 casts per-op, not storage)
+        assert str(m.lin.weight.dtype).endswith("float32")
+
+    def test_recompute_wraps_children_and_matches_grads(self):
+        import paddle2_tpu.optimizer as opt
+
+        def build():
+            paddle.seed(3)
+            return nn.Sequential(nn.Linear(6, 6), nn.GELU(),
+                                 nn.Linear(6, 6))
+
+        def run(recompute_on):
+            m = build()
+            o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+            cfg = {"recompute": {"enable": True}} if recompute_on else {}
+            model = dist.to_static(m, None, nn.MSELoss(), o,
+                                   dist.Strategy(cfg))
+            if recompute_on:
+                wrapped = [getattr(c.forward, "_recompute_wrapped", False)
+                           for c in m.children() if c.parameters()]
+                assert wrapped and all(wrapped)
+            x = paddle.to_tensor(np.linspace(-1, 1, 12)
+                                 .reshape(2, 6).astype(np.float32))
+            y = paddle.zeros([2, 6])
+            model(x, y)
+            return m[0].weight.numpy()
+
+        np.testing.assert_allclose(run(True), run(False),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_pipeline_strategy_runs_compiled_1f1b(self):
+        import paddle2_tpu.optimizer as opt
+        import paddle2_tpu.distributed as pdist
+        pdist.init_mesh({"pp": 4, "dp": 2})
+
+        def build():
+            paddle.seed(5)
+            return nn.Sequential(*[nn.Linear(8, 8) for _ in range(4)])
+
+        def run(pipeline_on):
+            m = build()
+            o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+            cfg = {"pipeline": {"enable": True, "schedule_mode": "1F1B",
+                                "accumulate_steps": 4}} if pipeline_on \
+                else {}
+            model = dist.to_static(m, None, nn.MSELoss(), o,
+                                   dist.Strategy(cfg))
+            rs = np.random.RandomState(0)
+            x = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+            y = paddle.zeros([8, 8])
+            losses = [float(np.asarray(model(x, y)._data))
+                      for _ in range(3)]
+            return losses, m[0].weight.numpy()
+
+        lp, wp = run(True)
+        le, we = run(False)
+        assert lp[-1] < lp[0]          # pipeline path trains
+        np.testing.assert_allclose(lp[0], le[0], rtol=1e-4)
+        np.testing.assert_allclose(wp, we, rtol=1e-3, atol=1e-5)
+
+    def test_pipeline_gpipe_schedule_matches_1f1b(self):
+        import paddle2_tpu.optimizer as opt
+        import paddle2_tpu.distributed as pdist
+        pdist.init_mesh({"pp": 4, "dp": 2})
+
+        def run(mode):
+            paddle.seed(11)
+            m = nn.Sequential(*[nn.Linear(8, 8) for _ in range(4)])
+            o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+            model = dist.to_static(
+                m, None, nn.MSELoss(), o,
+                dist.Strategy({"pipeline": {"enable": True,
+                                            "schedule_mode": mode,
+                                            "accumulate_steps": 4}}))
+            rs = np.random.RandomState(1)
+            x = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+            y = paddle.zeros([8, 8])
+            loss = float(np.asarray(model(x, y)._data))
+            return loss, m[0].weight.numpy()
+
+        l1, w1 = run("1F1B")
+        l2, w2 = run("GPipe")
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+        np.testing.assert_allclose(w1, w2, rtol=1e-4, atol=1e-6)
+
+    def test_pipeline_rejects_heterogeneous_blocks(self):
+        import paddle2_tpu.optimizer as opt
+        import paddle2_tpu.distributed as pdist
+        pdist.init_mesh({"pp": 4, "dp": 2})
+
+        class Scaled(nn.Linear):
+            def forward(self, x):
+                return super().forward(x) * 2.0
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8),
+                          nn.Linear(8, 8), Scaled(8, 8))
+        o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+        model = dist.to_static(
+            m, None, nn.MSELoss(), o,
+            dist.Strategy({"pipeline": {"enable": True,
+                                        "accumulate_steps": 4}}))
+        with pytest.raises(NotImplementedError, match="identical"):
+            model(paddle.ones([8, 8]), paddle.zeros([8, 8]))
+
+    def test_unknown_wrapper_routes_to_eager_path(self):
+        import paddle2_tpu.optimizer as opt
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+
+        class EMA:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, n):
+                return getattr(self._inner, n)
+
+            def step(self):
+                self._inner.step()
+
+        model = dist.to_static(
+            m, None, nn.MSELoss(),
+            opt.SGD(learning_rate=0.1, parameters=m.parameters()))
+        model._optimizer = EMA(model._optimizer)
+        assert not model._can_fuse()
+        before = m.weight.numpy().copy()
+        model(paddle.ones([2, 4]), paddle.zeros([2, 2]))
+        assert not np.array_equal(m.weight.numpy(), before)
+
+    def test_strategy_unimplemented_raises(self):
+        import paddle2_tpu.optimizer as opt
+        m = nn.Linear(4, 4)
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+        with pytest.raises(NotImplementedError):
+            dist.to_static(m, None, nn.MSELoss(), o, dist.Strategy(
+                {"fused_passes": {"enable": True}}))
+        with pytest.raises(NotImplementedError):
+            dist.to_static(m, None, nn.MSELoss(), o, dist.Strategy(
+                {"amp": {"enable": True, "level": "O3"}}))
+        with pytest.raises(NotImplementedError):
+            dist.to_static(m, None, nn.MSELoss(), o, dist.Strategy(
+                {"pipeline": {"enable": True,
+                              "schedule_mode": "ZBH-9"}}))
+        with pytest.raises(NotImplementedError):
+            model = dist.to_static(m, None, nn.MSELoss(), o, dist.Strategy(
+                {"pipeline": {"enable": True}}))
+            import paddle2_tpu.distributed as pdist
+            pdist.init_mesh({"pp": 4, "dp": 2})
+            model(paddle.ones([4, 4]), paddle.zeros([4, 4]))
