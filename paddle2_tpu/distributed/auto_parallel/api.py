@@ -790,6 +790,11 @@ class DistModel:
         if self._pp_stages is None:
             self._pp_prepare()
         S, k, blocks, per, stage_fn, loss_fn = self._pp_stages
+        if len(args) != 2:
+            raise NotImplementedError(
+                f"Strategy.pipeline DistModel takes exactly (input, "
+                f"label); got {len(args)} args — multi-input stages "
+                "need a custom stage_fn via fleet.pipeline_spmd_1f1b")
         x, label = ensure_tensor(args[0]), ensure_tensor(args[-1])
         M = self._pp_micro
         if x.shape[0] % M != 0:

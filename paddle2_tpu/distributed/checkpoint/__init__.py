@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -26,6 +27,65 @@ import numpy as np
 from ..env import get_rank
 
 _METADATA = "0.metadata"
+
+# pending async saves: a new save (sync or async) or a load first drains
+# EVERY previous in-flight save — global, not per-path, so that in a
+# multi-process job the background barriers of successive saves pair up
+# in the same program order on every host. Remaining multi-host caveat
+# (documented on save_state_dict): call handle.wait() before the next
+# compiled collective step, or its psum may interleave with the save's
+# barrier psum across hosts.
+_ASYNC_PENDING: Dict[str, "AsyncSaveHandle"] = {}
+_ASYNC_LOCK = threading.Lock()
+
+
+class AsyncSaveHandle:
+    """In-flight async checkpoint save (reference save_state_dict.py:46
+    background task queue). The device→host snapshot happened BEFORE the
+    handle was returned — training may donate/mutate the live buffers
+    while the write proceeds; the checkpoint at `path` stays the PRIOR
+    one until the metadata commit point, so a crash mid-write never
+    corrupts it."""
+
+    def __init__(self, thread: threading.Thread):
+        self._thread = thread
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def is_completed(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self) -> None:
+        """Block until the files are durably committed; re-raise any
+        writer error."""
+        self._thread.join()
+        if self._error is not None:
+            raise self._error
+
+
+def _drain_pending(path: str) -> None:
+    """Serialize on EVERY in-flight async save (any path — see registry
+    comment). A previous save's FAILURE belongs to its own handle
+    (surfaced by its wait()) — it must not poison the next save/load,
+    which proceeds against whatever checkpoint is committed."""
+    with _ASYNC_LOCK:
+        prev = list(_ASYNC_PENDING.values())
+        _ASYNC_PENDING.clear()
+    for h in prev:
+        h._thread.join()
+
+
+def _next_uid(path: str) -> int:
+    uid = 0
+    try:
+        for fname in os.listdir(path):
+            if fname.startswith("data_") and fname.endswith(".pkl"):
+                parts = fname[5:-4].split("_")
+                if parts and parts[0].isdigit():
+                    uid = max(uid, int(parts[0]) + 1)
+    except FileNotFoundError:
+        pass
+    return uid
 
 
 def flatten_state_dict(state_dict: Dict[str, Any],
@@ -59,32 +119,14 @@ def _index_key(index, shape) -> Tuple[Tuple[int, int], ...]:
     return tuple(out)
 
 
-def save_state_dict(state_dict: Dict[str, Any], path: str,
-                    process_group=None, coordinator_rank: int = 0,
-                    unique_id: Optional[int] = None) -> None:
-    """Write ``state_dict`` (nested; leaves Tensor/ndarray/scalar) to ``path``
-    as shard files + metadata. Parity: save_state_dict.py:145.
-    """
-    os.makedirs(path, exist_ok=True)
+def _snapshot(state_dict, rank: int, data_file: str):
+    """Device→host snapshot (the synchronous phase of every save): copies
+    each addressable shard to numpy NOW so later donation/mutation of the
+    live buffers cannot corrupt the write — this is the double buffer
+    that lets step N+1 overlap the write of step N's checkpoint."""
     flat = flatten_state_dict(state_dict)
-    rank = get_rank()
-    import jax
-    multi = jax.process_count() > 1
-    if multi:  # nobody may still be writing shards from a previous save
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("ckpt_save_enter")
-    if rank == coordinator_rank:
-        # a re-save to the same path must not leave stale shard files from a
-        # wider previous run behind — load merges every data_*.pkl it finds
-        # (the reference versions files with unique_id instead)
-        for fname in os.listdir(path):
-            if fname.startswith("data_") and fname.endswith(".pkl"):
-                os.remove(os.path.join(path, fname))
-    if multi:  # shard writes must not race the coordinator's cleanup
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("ckpt_save_cleaned")
-
-    meta: Dict[str, Any] = {"tensors": {}, "scalars": {}}
+    meta: Dict[str, Any] = {"tensors": {}, "scalars": {},
+                            "files": [os.path.basename(data_file)]}
     data: Dict[Tuple[str, Tuple], np.ndarray] = {}
     for key, leaf in flat.items():
         arr = _leaf_array(leaf)
@@ -114,12 +156,101 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
             "dtype": str(arr.dtype),
             "shards": shards,
         }
+    return meta, data
 
-    with open(os.path.join(path, f"data_{rank}.pkl"), "wb") as f:
+
+def _write_phase(path: str, meta, data, data_file: str, rank: int,
+                 coordinator_rank: int, multi: bool, uid: int = 0) -> None:
+    """Durable write + atomic commit. Order gives crash safety: shard
+    files land under the NEW uid first (invisible to load — it reads
+    only files the metadata names), the metadata os.replace is the
+    commit point, stale-uid files are removed only after commit."""
+    tmp = data_file + ".tmp"
+    with open(tmp, "wb") as f:
         pickle.dump(data, f, protocol=4)
+    os.replace(tmp, data_file)
+    if multi:  # every rank's shard file must exist before the commit
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("ckpt_shards_written")
+        # the coordinator's metadata only names its own file; merge the
+        # full file list from what landed on the shared path
+        if rank == coordinator_rank:
+            meta = dict(meta)
+            meta["files"] = sorted(
+                fname for fname in os.listdir(path)
+                if fname.startswith(f"data_{uid}_")
+                and fname.endswith(".pkl"))
     if rank == coordinator_rank:
-        with open(os.path.join(path, _METADATA), "wb") as f:
+        mtmp = os.path.join(path, _METADATA + ".tmp")
+        with open(mtmp, "wb") as f:
             pickle.dump(meta, f, protocol=4)
+        os.replace(mtmp, os.path.join(path, _METADATA))   # commit point
+        keep = set(meta["files"])
+        for fname in os.listdir(path):
+            if fname.startswith("data_") and fname.endswith(".pkl") \
+                    and fname not in keep:
+                os.remove(os.path.join(path, fname))
+    if multi:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("ckpt_committed")
+
+
+def save_state_dict(state_dict: Dict[str, Any], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    unique_id: Optional[int] = None,
+                    async_save: bool = False
+                    ) -> Optional[AsyncSaveHandle]:
+    """Write ``state_dict`` (nested; leaves Tensor/ndarray/scalar) to ``path``
+    as shard files + metadata. Parity: save_state_dict.py:145.
+
+    ``async_save=True`` (save_state_dict.py:46 analog) snapshots the
+    shards to host synchronously, then writes and commits on a
+    background thread; returns an :class:`AsyncSaveHandle` whose
+    ``wait()`` makes the checkpoint durable. Until the commit the prior
+    checkpoint at ``path`` remains fully loadable. Multi-host caveat:
+    the background commit runs cross-host barriers — call ``wait()``
+    before issuing the next compiled collective step so the barrier
+    cannot interleave with training collectives.
+    """
+    _drain_pending(path)
+    os.makedirs(path, exist_ok=True)
+    rank = get_rank()
+    import jax
+    multi = jax.process_count() > 1
+    if multi:
+        # every rank must observe the SAME directory state before
+        # picking uid: without this barrier a fast rank's committed
+        # shard file inflates a slow rank's uid and the coordinator's
+        # post-commit cleanup would delete that rank's shard
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("ckpt_save_enter")
+    uid = unique_id if unique_id is not None else _next_uid(path)
+    data_file = os.path.join(path, f"data_{uid}_{rank}.pkl")
+    meta, data = _snapshot(state_dict, rank, data_file)
+
+    if not async_save:
+        _write_phase(path, meta, data, data_file, rank, coordinator_rank,
+                     multi, uid)
+        return None
+
+    handle: AsyncSaveHandle
+
+    def run():
+        try:
+            _write_phase(path, meta, data, data_file, rank,
+                         coordinator_rank, multi, uid)
+        except BaseException as e:           # surfaced by wait()
+            handle._error = e
+        finally:
+            handle._done.set()
+
+    thread = threading.Thread(target=run, name="ckpt-async-save",
+                              daemon=True)
+    handle = AsyncSaveHandle(thread)
+    with _ASYNC_LOCK:
+        _ASYNC_PENDING[path] = handle
+    thread.start()
+    return handle
 
 
 def load_state_dict(state_dict: Dict[str, Any], path: str,
@@ -132,17 +263,25 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     import jax.numpy as jnp
     from ...framework.tensor import Tensor
 
+    _drain_pending(path)
     mpath = os.path.join(path, _METADATA)
     if not os.path.exists(mpath):
         raise ValueError(f"checkpoint metadata not found: {mpath}")
     with open(mpath, "rb") as f:
         meta = pickle.load(f)
 
+    # metadata names the committed shard files (uid-versioned); an
+    # in-flight or crashed save's orphan files are invisible here.
+    # Legacy checkpoints without a file list merge every data_*.pkl.
+    files = meta.get("files")
+    if files is None:
+        files = sorted(fname for fname in os.listdir(path)
+                       if fname.startswith("data_")
+                       and fname.endswith(".pkl"))
     data: Dict[Tuple[str, Tuple], np.ndarray] = {}
-    for fname in sorted(os.listdir(path)):
-        if fname.startswith("data_") and fname.endswith(".pkl"):
-            with open(os.path.join(path, fname), "rb") as f:
-                data.update(pickle.load(f))
+    for fname in files:
+        with open(os.path.join(path, fname), "rb") as f:
+            data.update(pickle.load(f))
 
     flat = flatten_state_dict(state_dict)
     missing = [k for k in flat
@@ -198,4 +337,5 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
             _set_nested(state_dict, key, arr)
 
 
-__all__ = ["save_state_dict", "load_state_dict", "flatten_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "flatten_state_dict",
+           "AsyncSaveHandle"]

@@ -17,6 +17,12 @@ from typing import Dict, List, Optional
 from ..env import get_rank, get_world_size
 
 
+# reference elastic/manager.py:33 — a worker exiting with this code
+# announces a deliberate elastic scale event to the launcher (restart
+# without consuming the failure budget)
+ELASTIC_EXIT_CODE = 101
+
+
 class ElasticStatus:
     COMPLETED = "completed"
     ERROR = "error"

@@ -27,7 +27,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import mesh as mesh_mod
 
-__all__ = ["pipeline_spmd", "pipeline_spmd_1f1b"]
+__all__ = ["pipeline_spmd", "pipeline_spmd_1f1b", "pipeline_spmd_vpp"]
 
 
 def _local_body(params, x_micro, *, stage_fn, n_stages, n_micro, axis):
@@ -137,7 +137,14 @@ _PIPE_CACHE: Dict[Tuple, Any] = {}
 # recompute-1F1B), so only inputs are buffered.
 
 def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
-              n_stages, n_micro, axis):
+              n_stages, n_micro, axis, tp_axes=(), grad_extra=None):
+    # pvary over the pipeline axis PLUS any TP axes the param specs name:
+    # a hybrid-TP stage_fn (psum over 'mp') makes some switch-branch
+    # outputs mp-varying, and lax.switch requires identical vma types
+    vaxes = (axis,) + tuple(tp_axes)
+    tp_scale = 1.0
+    for a in tp_axes:
+        tp_scale = tp_scale / jax.lax.axis_size(a)
     s = jax.lax.axis_index(axis)
     S, M = n_stages, n_micro
     T = 2 * (M + S) - 2           # last op: B_{M-1} at stage 0, t = 2S+2M-3
@@ -158,7 +165,7 @@ def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
         x_buf, grads, act_in, ct_in, losses, pend = carry
         # all switch branches must agree on varying-manual-axes types:
         # zeros emitted by idle/fwd/bwd are explicitly device-varying
-        vzero = jax.lax.pvary(zero, (axis,))
+        vzero = jax.lax.pvary(zero, vaxes)
         d = t - s
         # op selection per the closed forms above
         warm_f = (0 <= d) & (d < jnp.minimum(S - s, M)) & (t < S)
@@ -193,7 +200,14 @@ def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
                 return lo, y
 
             (lo, _y), vjp = jax.vjp(f, p_local, x)
-            dlo = jnp.where(is_last, 1.0 / M, 0.0).astype(lo.dtype)
+            # a replicated scalar's cotangent seeded on EVERY TP rank
+            # gets psum'd at the first invariant point (pvary transpose
+            # = psum), so divide by the TP degree; also promote the vma
+            # type to match lo's (hybrid-TP stage_fns make lo vary over
+            # more axes than the pipeline axis)
+            dlo = jnp.where(is_last, (1.0 / M) * tp_scale,
+                            0.0).astype(lo.dtype)
+            dlo = dlo + jax.lax.pvary(jnp.zeros((), lo.dtype), vaxes)
             dy = jnp.where(is_last, jnp.zeros_like(ct_in), ct_in)
             dp, dx = vjp((dlo, dy))
             grads = jax.tree_util.tree_map(
@@ -216,7 +230,7 @@ def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
         return (x_buf, grads, act_next, ct_next, losses, pend), None
 
     def _varying(v):
-        return jax.lax.pvary(v, (axis,))
+        return jax.lax.pvary(v, vaxes)
 
     x_buf0 = jnp.zeros((BUF,) + zero.shape, zero.dtype)
     losses0 = jnp.zeros((M,), jnp.float32)
@@ -229,13 +243,194 @@ def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
     # losses live on the last stage, grads on their own stage: reduce the
     # losses across the ring; grads keep per-stage placement
     losses = jax.lax.psum(losses, axis)
+    for a in tp_axes:
+        # mp ranks computed identical losses (post-psum activations are
+        # replicated across mp) — pmean restores the invariant vma type
+        losses = jax.lax.pmean(losses, a)
+    if grad_extra is not None:
+        # grads of TP-replicated leaves (norm gains etc.) are identical
+        # across the TP axes their spec does not shard — pmean both
+        # claims the invariance and averages any numeric jitter
+        def _unvary(g, extra):
+            for a in extra:
+                g = jax.lax.pmean(g, a)
+            return g
+        grads = jax.tree_util.tree_map(
+            _unvary, grads, grad_extra,
+            is_leaf=lambda x: isinstance(x, jnp.ndarray))
     grads = jax.tree_util.tree_map(lambda g: g[None], grads)
     return jnp.sum(losses) / M, grads
 
 
+# ---------------------------------------------------------------------------
+# compiled interleaved-VPP: V model chunks per device, virtual-stage ring
+# ---------------------------------------------------------------------------
+#
+# Virtual stage vs = v*S + s lives as chunk v on device s (Megatron/the
+# reference's PipelineParallelWithInterleave placement,
+# meta_parallel/pipeline_parallel.py:1174). Forward runs the wavefront
+# F(vs, m) at tick t = vs + m over P = V*S virtual stages: several of a
+# device's chunks can be active in the SAME tick (they are independent —
+# the compiled program runs them in parallel; the eager executor
+# serializes them in Python). Activation routing per tick is one stacked
+# ppermute: chunk v's output on device s becomes chunk v's input on
+# device s+1, and on the ring wrap (device S-1 -> 0) it becomes chunk
+# v+1's input. Backward mirrors the wavefront in reverse, recomputing
+# each chunk forward from its SAVED INPUT (recompute-1F1B style), so the
+# per-device residual footprint is exactly the V*M chunk inputs — not
+# every intermediate of an autodiffed forward. (The eager executor keeps
+# the interleaved warmup/steady tick interleave; this compiled schedule
+# is F-then-B over virtual stages, which XLA overlaps freely.)
+
+def _vpp_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
+              n_stages, n_chunks, n_micro, axis):
+    s = jax.lax.axis_index(axis)
+    S, V, M = n_stages, n_chunks, n_micro
+    P = V * S
+    T = M + P - 1
+    p_chunks = jax.tree_util.tree_map(lambda a: a[:, 0], params)  # [V,...]
+    zero = jnp.zeros_like(x_micro[0])
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [((i + 1) % S, i) for i in range(S)]
+
+    def _varying(v):
+        return jax.lax.pvary(v, (axis,))
+
+    def chunk_params(v):
+        return jax.tree_util.tree_map(lambda a: a[v], p_chunks)
+
+    # ---- forward wavefront: save chunk inputs --------------------------
+    def ftick(carry, t):
+        acts, x_save = carry            # acts: [V, B...] per-chunk input
+        ys = []
+        new_save = x_save
+        for v in range(V):
+            vs = v * S + s
+            m = t - vs
+            valid = (m >= 0) & (m < M)
+            m_c = jnp.clip(m, 0, M - 1)
+            x = jnp.where((v == 0) & (s == 0), x_micro[m_c], acts[v])
+            y = stage_fn(chunk_params(v), shared, x, vs)
+            y = jnp.where(valid, y, _varying(zero))
+            new_save = jnp.where(
+                valid, new_save.at[v, m_c].set(x), new_save)
+            ys.append(y)
+        moved = jax.lax.ppermute(jnp.stack(ys), axis, perm_fwd)
+        # ring wrap: what device 0 receives from device S-1 belongs to
+        # the NEXT chunk; other devices keep the chunk index
+        shifted = jnp.roll(moved, 1, axis=0)
+        acts_next = jnp.where(s == 0, shifted, moved)
+        return (acts_next, new_save), None
+
+    x_save0 = jnp.zeros((V, M) + zero.shape, zero.dtype)
+    acts0 = jnp.zeros((V,) + zero.shape, zero.dtype)
+    (acts, x_save), _ = jax.lax.scan(
+        ftick, (_varying(acts0), _varying(x_save0)), jnp.arange(T))
+
+    # ---- backward wavefront: recompute-from-input vjp per chunk --------
+    g0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape[1:], jnp.float32), p_chunks)
+
+    def btick(carry, u):
+        cts, grads, losses = carry      # cts: [V, B...] out-cotangents
+        dxs = []
+        for v in range(V):
+            vs = v * S + s
+            i = u - (P - 1 - vs)
+            valid = (i >= 0) & (i < M)
+            i_c = jnp.clip(i, 0, M - 1)
+            x = x_save[v, i_c]
+            is_last = vs == P - 1
+
+            def f(p, x):
+                y = stage_fn(p, shared, x, vs)
+                lo = loss_fn(y, labels_micro[i_c])
+                return lo, y
+
+            (lo, _y), vjp = jax.vjp(f, chunk_params(v), x)
+            dlo = jnp.where(is_last, 1.0 / M, 0.0).astype(lo.dtype)
+            dlo = dlo + jax.lax.pvary(jnp.zeros((), lo.dtype), (axis,))
+            dy = jnp.where(is_last, jnp.zeros_like(cts[v]), cts[v])
+            dp, dx = vjp((dlo, dy))
+            gsel = jnp.float32(valid)
+            grads = jax.tree_util.tree_map(
+                lambda g, d, _v=v: g.at[_v].add(
+                    d.astype(jnp.float32) * gsel), grads, dp)
+            losses = jnp.where(valid & is_last,
+                               losses.at[i_c].set(lo.astype(jnp.float32)),
+                               losses)
+            dxs.append(jnp.where(valid, dx, _varying(zero)))
+        moved = jax.lax.ppermute(jnp.stack(dxs), axis, perm_bwd)
+        # reverse ring wrap: what device S-1 receives from device 0
+        # belongs to the PREVIOUS chunk
+        shifted = jnp.roll(moved, -1, axis=0)
+        cts_next = jnp.where(s == S - 1, shifted, moved)
+        return (cts_next, grads, losses), None
+
+    grads0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((V,) + a.shape[1:], jnp.float32), p_chunks)
+    losses0 = jnp.zeros((M,), jnp.float32)
+    (cts, grads, losses), _ = jax.lax.scan(
+        btick, (_varying(acts0), _varying(grads0), _varying(losses0)),
+        jnp.arange(T))
+    losses = jax.lax.psum(losses, axis)
+    grads = jax.tree_util.tree_map(lambda g: g[:, None], grads)
+    return jnp.sum(losses) / M, grads
+
+
+def pipeline_spmd_vpp(stage_fn: Callable, stacked_params, x_micro,
+                      labels_micro, loss_fn: Callable, n_chunks: int,
+                      shared_params=None, mesh_axis: str = "pp"):
+    """Compiled interleaved virtual-pipeline (reference
+    PipelineParallelWithInterleave, meta_parallel/pipeline_parallel.py:
+    1174, as a single SPMD program). Each device holds ``n_chunks`` model
+    chunks; virtual stage v*S + s is chunk v on device s.
+
+    stacked_params: pytree with leaves [V, S, ...] (chunk-major, stage
+    axis second — sharded over the mesh's pp axis).
+    stage_fn(chunk_params, shared_params, x, virtual_stage_idx) -> y.
+    Returns (mean loss, grads with the same [V, S, ...] leading axes).
+    Backward recomputes each chunk from its saved input, so per-device
+    residuals are the V*M chunk inputs only.
+    """
+    mesh = mesh_mod.get_mesh()
+    S = int(mesh.shape[mesh_axis])
+    M = int(x_micro.shape[0])
+    V = int(n_chunks)
+    if shared_params is None:
+        shared_params = ()
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != V or leaf.shape[1] != S:
+            raise ValueError(
+                f"stacked param leading axes {leaf.shape[:2]} != "
+                f"(V={V}, S={S})")
+
+    treedef = jax.tree_util.tree_structure((stacked_params, shared_params))
+    avals = tuple((tuple(l.shape), str(l.dtype)) for l in
+                  jax.tree_util.tree_leaves((stacked_params,
+                                             shared_params)))
+    key = ("vpp", id(mesh), mesh_axis, stage_fn, loss_fn, V, treedef,
+           avals, tuple(x_micro.shape), str(x_micro.dtype))
+    fn = _PIPE_CACHE.get(key)
+    if fn is None:
+        param_specs = jax.tree_util.tree_map(
+            lambda a: P(None, mesh_axis, *([None] * (a.ndim - 2))),
+            stacked_params)
+        shared_specs = jax.tree_util.tree_map(lambda a: P(), shared_params)
+        body = partial(_vpp_body, stage_fn=stage_fn, loss_fn=loss_fn,
+                       n_stages=S, n_chunks=V, n_micro=M, axis=mesh_axis)
+        fn = jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(param_specs, shared_specs, P(), P()),
+            out_specs=(P(), param_specs)))
+        _PIPE_CACHE[key] = fn
+    loss, grads = fn(stacked_params, shared_params, x_micro, labels_micro)
+    return loss, grads
+
+
 def pipeline_spmd_1f1b(stage_fn: Callable, stacked_params, x_micro,
                        labels_micro, loss_fn: Callable, shared_params=None,
-                       mesh_axis: str = "pp"):
+                       mesh_axis: str = "pp", param_specs=None):
     """Compiled 1F1B: mean loss + stacked parameter grads in ONE program.
 
     stage_fn(stage_params, shared_params, x, stage_idx) -> y. Stage
@@ -245,6 +440,14 @@ def pipeline_spmd_1f1b(stage_fn: Callable, stacked_params, x_micro,
     loss_fn(y_last, label_micro) -> scalar per-microbatch loss; returns
     (mean loss over microbatches, stacked f32 grads with the 1F1B
     activation bound of S+1 in-flight microbatches instead of GPipe's M).
+
+    ``param_specs`` (optional pytree of PartitionSpec, default
+    ``P(mesh_axis, None, ...)``) lets hybrid TP+PP shard further weight
+    dims over other mesh axes (e.g. ``P('pp', None, 'mp')`` for a
+    column-parallel weight); stage_fn then works on the LOCAL TP shard
+    and reduces with ``jax.lax.psum(..., 'mp')`` — the mp_layers
+    semantics inside the compiled pipeline. Each spec's first axis must
+    be ``mesh_axis``.
     """
     mesh = mesh_mod.get_mesh()
     S = int(mesh.shape[mesh_axis])
@@ -256,20 +459,46 @@ def pipeline_spmd_1f1b(stage_fn: Callable, stacked_params, x_micro,
             raise ValueError(
                 f"stacked param leading axis {leaf.shape[0]} != pipeline "
                 f"degree {S}")
+    if param_specs is not None:
+        for spec in jax.tree_util.tree_leaves(
+                param_specs, is_leaf=lambda x: isinstance(x, P)):
+            if tuple(spec)[:1] != (mesh_axis,):
+                raise ValueError(
+                    f"param_specs leading axis must be {mesh_axis!r}, "
+                    f"got {spec}")
 
     treedef = jax.tree_util.tree_structure((stacked_params, shared_params))
     avals = tuple((tuple(l.shape), str(l.dtype)) for l in
                   jax.tree_util.tree_leaves((stacked_params, shared_params)))
+    spec_key = None if param_specs is None else tuple(
+        str(s) for s in jax.tree_util.tree_leaves(
+            param_specs, is_leaf=lambda x: isinstance(x, P)))
     key = ("1f1b", id(mesh), mesh_axis, stage_fn, loss_fn, treedef, avals,
-           tuple(x_micro.shape), str(x_micro.dtype))
+           tuple(x_micro.shape), str(x_micro.dtype), spec_key)
     fn = _PIPE_CACHE.get(key)
     if fn is None:
-        param_specs = jax.tree_util.tree_map(
-            lambda a: P(mesh_axis, *([None] * (a.ndim - 1))),
-            stacked_params)
+        if param_specs is None:
+            param_specs = jax.tree_util.tree_map(
+                lambda a: P(mesh_axis, *([None] * (a.ndim - 1))),
+                stacked_params)
         shared_specs = jax.tree_util.tree_map(lambda a: P(), shared_params)
+        def _spec_axes(spec):
+            out = []
+            for e in tuple(spec):
+                out.extend(e if isinstance(e, (tuple, list))
+                           else ([] if e is None else [e]))
+            return out
+
+        tp_axes = tuple(sorted({a for spec in jax.tree_util.tree_leaves(
+            param_specs, is_leaf=lambda x: isinstance(x, P))
+            for a in _spec_axes(spec) if a != mesh_axis}))
+        grad_extra = jax.tree_util.tree_map(
+            lambda spec: tuple(a for a in tp_axes
+                               if a not in _spec_axes(spec)),
+            param_specs, is_leaf=lambda x: isinstance(x, P))
         body = partial(_f1b_body, stage_fn=stage_fn, loss_fn=loss_fn,
-                       n_stages=S, n_micro=M, axis=mesh_axis)
+                       n_stages=S, n_micro=M, axis=mesh_axis,
+                       tp_axes=tp_axes, grad_extra=grad_extra)
         fn = jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=(param_specs, shared_specs, P(), P()),

@@ -40,6 +40,10 @@ def _parse(argv: Optional[List[str]] = None):
     p.add_argument("--log_dir", default=None)
     p.add_argument("--max_restarts", type=int, default=0,
                    help="elastic restart budget after worker failure")
+    p.add_argument("--elastic_rescale", action="store_true",
+                   help="on worker failure relaunch at the SURVIVING "
+                        "world size (scale-in; reference ElasticManager "
+                        "scale semantics) instead of same-size restart")
     p.add_argument("--job_id", default="default")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -88,26 +92,38 @@ def _spawn(args) -> List[subprocess.Popen]:
     return procs
 
 
-def _watch(procs: List[subprocess.Popen]) -> int:
+def _watch(procs: List[subprocess.Popen]):
     """Babysit the local gang: first non-zero exit kills everyone
-    (failure-detection parity — a dead rank must not hang the ring)."""
+    (failure-detection parity — a dead rank must not hang the ring).
+    Returns (rc, n_self_failed): how many workers died on their OWN
+    (not from our teardown) — the scale-in delta for --elastic_rescale."""
+    from ..fleet.elastic import ELASTIC_EXIT_CODE
     while True:
         alive = False
+        failed = 0
+        rc_out = 0
         for p in procs:
             rc = p.poll()
             if rc is None:
                 alive = True
             elif rc != 0:
-                for q in procs:
-                    if q.poll() is None:
-                        q.send_signal(signal.SIGTERM)
-                time.sleep(2)
-                for q in procs:
-                    if q.poll() is None:
-                        q.kill()
-                return rc
+                failed += 1
+                # a real crash outranks a deliberate scale-event exit
+                # (ELASTIC_EXIT_CODE): simultaneous mixed exits must
+                # consume the restart budget, not bypass it
+                if rc_out in (0, ELASTIC_EXIT_CODE):
+                    rc_out = rc
+        if failed:
+            for q in procs:
+                if q.poll() is None:
+                    q.send_signal(signal.SIGTERM)
+            time.sleep(2)
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            return rc_out, failed
         if not alive:
-            return 0
+            return 0, 0
         time.sleep(0.5)
 
 
@@ -116,16 +132,31 @@ def launch(argv: Optional[List[str]] = None) -> int:
     attempt = 0
     while True:
         procs = _spawn(args)
-        rc = _watch(procs)
+        rc, n_failed = _watch(procs)
         if rc == 0:
             return 0
-        attempt += 1
-        if attempt > args.max_restarts:
-            print(f"[launch] gang failed (rc={rc}) after {attempt - 1} "
-                  f"restarts; giving up", file=sys.stderr)
-            return rc
+        # reference ELASTIC_EXIT_CODE (manager.py:33): a worker exiting
+        # 101 announces a deliberate scale event — restart does not
+        # consume the failure budget
+        from ..fleet.elastic import ELASTIC_EXIT_CODE
+        if rc != ELASTIC_EXIT_CODE:
+            attempt += 1
+            if attempt > args.max_restarts:
+                print(f"[launch] gang failed (rc={rc}) after "
+                      f"{attempt - 1} restarts; giving up",
+                      file=sys.stderr)
+                return rc
+        if args.elastic_rescale and args.nnodes == 1:
+            new_world = max(1, args.nproc_per_node - max(1, n_failed))
+            if new_world != args.nproc_per_node:
+                print(f"[launch] scale-in: world "
+                      f"{args.nproc_per_node} -> {new_world}",
+                      file=sys.stderr)
+                args.nproc_per_node = new_world
+        os.environ["PADDLE_ELASTIC_RESTART_COUNT"] = str(attempt)
         print(f"[launch] worker failed (rc={rc}); elastic restart "
-              f"{attempt}/{args.max_restarts}", file=sys.stderr)
+              f"{attempt}/{args.max_restarts} at world "
+              f"{args.nnodes * args.nproc_per_node}", file=sys.stderr)
 
 
 def main():

@@ -118,25 +118,35 @@ class Tensor:
         return sh is not None and len(getattr(sh, "device_set", ())) > 1
 
     # -- conversion -----------------------------------------------------
-    def _guard_value_read(self, what: str) -> None:
+    def _guard_value_read(self, what: str):
         """Under jit.to_static tracing a Tensor has no concrete value: a
         Python branch on it would silently BAKE the trace-time path into the
-        cached program (the reference's SOT graph-breaks instead, jit/sot/).
-        Raise loudly rather than specialize."""
-        if _is_tracer(self._data):
-            raise RuntimeError(
-                f"jit.to_static: {what} reads the VALUE of a traced Tensor — "
-                "Python control flow would be frozen at trace time. Rewrite "
-                "with paddle.where/paddle.clip or tensor ops, or run this "
-                "function eagerly (reference SOT falls back here).")
+        cached program. When TracedProgram installed a graph-break
+        controller, the read becomes a GRAPH BREAK: the controller either
+        answers with a concrete value resolved by a compiled prefix
+        program (returned here, non-None) or aborts the trace to capture
+        one — the reference's SOT break-graph semantics (jit/sot/).
+        Without a controller the read raises loudly rather than
+        specialize silently."""
+        if not _is_tracer(self._data):
+            return None
+        from ..jit.graph_break import active_break_controller
+        ctl = active_break_controller()
+        if ctl is not None:
+            return ctl.on_value_read(self._data, what)
+        raise RuntimeError(
+            f"jit.to_static: {what} reads the VALUE of a traced Tensor — "
+            "Python control flow would be frozen at trace time. Rewrite "
+            "with paddle.where/paddle.clip or tensor ops, or run this "
+            "function eagerly (reference SOT falls back here).")
 
     def numpy(self) -> np.ndarray:
-        self._guard_value_read("Tensor.numpy()")
-        return np.asarray(self._data)
+        ans = self._guard_value_read("Tensor.numpy()")
+        return np.asarray(self._data if ans is None else ans)
 
     def item(self, *args):
-        self._guard_value_read("Tensor.item()")
-        return np.asarray(self._data).item(*args)
+        ans = self._guard_value_read("Tensor.item()")
+        return np.asarray(self._data if ans is None else ans).item(*args)
 
     def tolist(self):
         return self.numpy().tolist()
@@ -146,15 +156,21 @@ class Tensor:
         return a.astype(dtype) if dtype is not None else a
 
     def __float__(self):
-        self._guard_value_read("float(Tensor)")
+        ans = self._guard_value_read("float(Tensor)")
+        if ans is not None:
+            return float(np.asarray(ans).item())
         return float(self.item())
 
     def __int__(self):
-        self._guard_value_read("int(Tensor)")
+        ans = self._guard_value_read("int(Tensor)")
+        if ans is not None:
+            return int(np.asarray(ans).item())
         return int(self.item())
 
     def __bool__(self):
-        self._guard_value_read("bool(Tensor) / `if tensor:`")
+        ans = self._guard_value_read("bool(Tensor) / `if tensor:`")
+        if ans is not None:
+            return bool(np.asarray(ans).item())
         return bool(self.item())
 
     def __len__(self):

@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework import core
 from ..framework import random as fr
@@ -96,12 +97,22 @@ def _guard_key(template, arg_arrays, layers):
 
 
 class TracedProgram:
-    """One traced function: guarded cache of compiled executables."""
+    """One traced function: guarded cache of compiled executables.
+
+    Data-dependent Python control flow (``if t:`` on a traced Tensor)
+    graph-breaks instead of failing or dropping the whole function to
+    eager: see jit/graph_break.py — per read site a compiled predicate
+    program resolves the value, and the full function compiles
+    SPECIALIZED per branch outcome, guard-cached on the value."""
 
     def __init__(self, fn: Callable, layers: Sequence = ()):
         self.fn = fn
         self.layers = list(layers)
         self._compiled: Dict[Any, Any] = {}
+        # per-base-guard trie of graph-break predicates:
+        # node = {"pred": jitted prefix or None, "children": {value_key:
+        # node}}; a leaf chain of resolved values selects the entry
+        self._break_trie: Dict[Any, Dict] = {}
         self._warned_fallback = False
 
     # -- public ----------------------------------------------------------
@@ -130,45 +141,78 @@ class TracedProgram:
                                                  jnp.inexact)
                               for t in diff_inputs))
 
-        key = _guard_key(template, arg_arrays, self.layers) + (
+        base_key = _guard_key(template, arg_arrays, self.layers) + (
             core.is_grad_enabled(),)
-        entry = self._compiled.get(key)
-        if entry is None:
-            # graph-break fallback (the SOT break-and-stay-eager analog,
-            # reference jit/sot/): a function whose guards keep missing —
-            # value-dependent Python control flow retracing per distinct
-            # value — stops compiling and runs eagerly instead of
-            # accumulating one executable per value
-            from ..flags import flag_value
-            limit = int(flag_value("max_program_cache_size"))
-            if len(self._compiled) >= limit:
-                if not self._warned_fallback:
-                    self._warned_fallback = True
-                    import warnings
-                    warnings.warn(
-                        f"to_static({getattr(self.fn, '__name__', '?')}): "
-                        f"{limit} guard misses — likely value-dependent "
-                        "Python control flow; falling back to EAGER "
-                        "execution for this function (the reference's "
-                        "SOT graph-break). Raise "
-                        "FLAGS_max_program_cache_size if the retraces "
-                        "are intentional (e.g. shape buckets).",
-                        RuntimeWarning, stacklevel=3)
-                return self.fn(*args, **kwargs)
-            entry = self._build(template, params, buffers, len(args_t))
-            self._compiled[key] = entry
-        fwd_jit, fwd_vjp_jit, vjp_apply_jit, meta = entry
+        from ..flags import flag_value
+        from .graph_break import (GraphBreakCapture, break_scope,
+                                  value_key)
+        limit = int(flag_value("max_program_cache_size"))
+
+        def _eager_fallback():
+            if not self._warned_fallback:
+                self._warned_fallback = True
+                import warnings
+                warnings.warn(
+                    f"to_static({getattr(self.fn, '__name__', '?')}): "
+                    f"{limit} cached programs — guard misses or "
+                    "graph-break branch outcomes exceed the budget; "
+                    "falling back to EAGER execution for this function "
+                    "(the reference's SOT bail-out). Raise "
+                    "FLAGS_max_program_cache_size if the "
+                    "specializations are intentional.",
+                    RuntimeWarning, stacklevel=4)
+            return self.fn(*args, **kwargs)
 
         param_arrays = [p._data for p in params]
         buffer_arrays = [b._data for b in buffers]
         rng_key = fr.next_key()
 
-        if needs_grad:
-            out_arrays, post_buffers, f_vjp = fwd_vjp_jit(
-                param_arrays, buffer_arrays, arg_arrays, rng_key)
-        else:
-            out_arrays, post_buffers = fwd_jit(
-                param_arrays, buffer_arrays, arg_arrays, rng_key)
+        # resolve known graph breaks: walk the predicate trie, running
+        # each compiled prefix to get this call's branch values
+        node = self._break_trie.setdefault(base_key, {"pred": None,
+                                                      "children": {}})
+        break_values: List[Any] = []
+        while node["pred"] is not None:
+            v = np.asarray(node["pred"](param_arrays, buffer_arrays,
+                                        arg_arrays, rng_key))
+            break_values.append(v)
+            node = node["children"].setdefault(
+                value_key(v), {"pred": None, "children": {}})
+
+        while True:
+            key = base_key + (len(break_values),
+                              tuple(value_key(v) for v in break_values))
+            entry = self._compiled.get(key)
+            if entry is None and len(self._compiled) >= limit:
+                return _eager_fallback()
+            try:
+                if entry is None:
+                    entry = self._build(template, params, buffers,
+                                        len(args_t), break_values)
+                fwd_jit, fwd_vjp_jit, vjp_apply_jit, meta = entry
+                with break_scope(break_values, capture=True):
+                    if needs_grad:
+                        out_arrays, post_buffers, f_vjp = fwd_vjp_jit(
+                            param_arrays, buffer_arrays, arg_arrays,
+                            rng_key)
+                    else:
+                        out_arrays, post_buffers = fwd_jit(
+                            param_arrays, buffer_arrays, arg_arrays,
+                            rng_key)
+                self._compiled[key] = entry
+                break
+            except GraphBreakCapture:
+                # new break at read index len(break_values): build the
+                # prefix predicate, resolve this call's value, descend
+                if len(self._compiled) + 1 >= limit:
+                    return _eager_fallback()
+                node["pred"] = self._build_pred(template, params, buffers,
+                                                list(break_values))
+                v = np.asarray(node["pred"](param_arrays, buffer_arrays,
+                                            arg_arrays, rng_key))
+                break_values.append(v)
+                node = node["children"].setdefault(
+                    value_key(v), {"pred": None, "children": {}})
         for b, a in zip(buffers, post_buffers):
             b._replace_data(a)
 
@@ -194,7 +238,32 @@ class TracedProgram:
                 t._output_index = i
         return jax.tree_util.tree_unflatten(meta["treedef"], out_tensors)
 
-    def _build(self, template, params, buffers, n_args):
+    def _build_pred(self, template, params, buffers, answers):
+        """Compile the PREFIX of fn up to value-read #len(answers): runs
+        fn with earlier reads answered (baked, guarded by the trie path)
+        and returns the newly-read traced value as the program output."""
+        fn = self.fn
+        state_tensors = params + buffers
+        from .graph_break import GraphBreakCapture, break_scope
+
+        def pred(param_arrays, buffer_arrays, arg_arrays, rng_key):
+            try:
+                with break_scope(answers, capture=True):
+                    _rebound_call(
+                        fn, state_tensors,
+                        list(param_arrays) + list(buffer_arrays),
+                        template, arg_arrays, rng_key, buffers)
+            except GraphBreakCapture as e:
+                return e.tracer
+            raise RuntimeError(
+                f"graph-break predicate: expected a value read at break "
+                f"index {len(answers)} but the function completed — "
+                "read order is input-dependent; run this function "
+                "eagerly")
+
+        return jax.jit(pred)
+
+    def _build(self, template, params, buffers, n_args, break_values=()):
         fn = self.fn
         state_tensors = params + buffers
         meta: Dict[str, Any] = {}

@@ -21,7 +21,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "SortedKeys", "SummaryView", "benchmark"]
+           "SortedKeys", "SummaryView", "benchmark", "merge_traces"]
 
 
 class ProfilerTarget(Enum):
@@ -142,6 +142,55 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
 def load_profiler_result(path: str) -> dict:
     with open(path) as f:
         return json.load(f)
+
+
+def merge_traces(dir_name: str, output_path: Optional[str] = None,
+                 align: bool = True) -> dict:
+    """Merge the per-process ``*.paddle_trace.json`` files under
+    ``dir_name`` into ONE chrome://tracing timeline with a process lane
+    per rank (reference ``tools/CrossStackProfiler/`` multi-node trace
+    merger). Worker/rank identity comes from the filename prefix the
+    per-rank ``export_chrome_tracing(worker_name=...)`` wrote.
+
+    ``align=True`` shifts each rank's events so its earliest timestamp
+    is 0 — per-process monotonic clocks share no epoch, so lanes are
+    comparable in DURATION and STRUCTURE, not absolute offset (noted in
+    the merged metadata). Returns the merged trace dict and writes it to
+    ``output_path`` (default ``dir_name/merged.paddle_trace.json``)."""
+    files = sorted(f for f in os.listdir(dir_name)
+                   if f.endswith(".paddle_trace.json")
+                   and not f.startswith("merged"))
+    if not files:
+        raise ValueError(f"no *.paddle_trace.json traces in {dir_name!r}")
+    merged: List[Dict] = []
+    for lane, fname in enumerate(files):
+        worker = fname.split("_time_")[0] if "_time_" in fname \
+            else fname.rsplit(".paddle_trace.json", 1)[0]
+        with open(os.path.join(dir_name, fname)) as f:
+            events = json.load(f).get("traceEvents", [])
+        spans = [e for e in events if e.get("ph") != "M"]
+        t0 = min((e["ts"] for e in spans if "ts" in e), default=0.0) \
+            if align else 0.0
+        merged.append({"name": "process_name", "ph": "M", "pid": lane,
+                       "args": {"name": worker}})
+        merged.append({"name": "process_sort_index", "ph": "M",
+                       "pid": lane, "args": {"sort_index": lane}})
+        for e in spans:
+            e = dict(e)
+            e["pid"] = lane
+            if align and "ts" in e:
+                e["ts"] = e["ts"] - t0
+            merged.append(e)
+    out = {"traceEvents": merged, "displayTimeUnit": "ms",
+           "metadata": {"merged_from": files,
+                        "aligned_per_rank": bool(align),
+                        "note": "per-rank monotonic clocks share no "
+                                "epoch; lanes are start-aligned"}}
+    path = output_path or os.path.join(dir_name,
+                                       "merged.paddle_trace.json")
+    with open(path, "w") as f:
+        json.dump(out, f)
+    return out
 
 
 class Profiler:

@@ -175,3 +175,113 @@ def test_dist_checkpoint_resave_removes_stale_shards(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(target["w"]._data),
         np.arange(64, dtype=np.float32).reshape(8, 8))
+
+
+class TestAsyncSave:
+    """Reference save_state_dict.py:46 async task queue semantics."""
+
+    def _state(self, val=1.0):
+        return {"w": paddle.to_tensor(
+            np.full((16, 4), val, np.float32)), "step": int(val)}
+
+    def test_async_save_returns_before_commit_and_wait_makes_durable(
+            self, tmp_path, monkeypatch):
+        import threading
+        import paddle2_tpu.distributed.checkpoint as ck
+        path = str(tmp_path / "ack")
+        gate = threading.Event()
+        orig = ck._write_phase
+
+        def slow_write(*a, **kw):
+            gate.wait(timeout=30)
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(ck, "_write_phase", slow_write)
+        h = dck.save_state_dict(self._state(3.0), path, async_save=True)
+        assert h is not None and not h.is_completed()
+        # nothing committed yet: metadata absent while the writer is gated
+        assert not os.path.exists(os.path.join(path, "0.metadata"))
+        gate.set()
+        h.wait()
+        assert h.is_completed()
+        tgt = self._state(0.0)
+        dck.load_state_dict(tgt, path)
+        np.testing.assert_array_equal(tgt["w"].numpy(),
+                                      np.full((16, 4), 3.0, np.float32))
+        assert tgt["step"] == 3
+
+    def test_snapshot_decouples_from_later_mutation(self, tmp_path):
+        """The device->host copy happens at save time: mutating (donating)
+        the live tensor after save returns must not change what lands."""
+        import threading
+        import paddle2_tpu.distributed.checkpoint as ck
+        path = str(tmp_path / "ack2")
+        state = self._state(5.0)
+        release = threading.Event()
+        orig = ck._write_phase
+
+        def gated(*a, **kw):
+            release.wait(timeout=30)
+            return orig(*a, **kw)
+
+        ck_orig = ck._write_phase
+        ck._write_phase = gated
+        try:
+            h = dck.save_state_dict(state, path, async_save=True)
+            # overwrite the live buffer while the write is in flight
+            state["w"]._replace_data(state["w"]._data * 0 - 9.0)
+            release.set()
+            h.wait()
+        finally:
+            ck._write_phase = ck_orig
+        tgt = self._state(0.0)
+        dck.load_state_dict(tgt, path)
+        np.testing.assert_array_equal(tgt["w"].numpy(),
+                                      np.full((16, 4), 5.0, np.float32))
+
+    def test_crash_before_commit_leaves_prior_checkpoint_intact(
+            self, tmp_path, monkeypatch):
+        import paddle2_tpu.distributed.checkpoint as ck
+        path = str(tmp_path / "ack3")
+        dck.save_state_dict(self._state(1.0), path)          # good ckpt
+
+        def boom(*a, **kw):
+            raise RuntimeError("disk died")
+
+        monkeypatch.setattr(ck, "_write_phase", boom)
+        h = dck.save_state_dict(self._state(2.0), path, async_save=True)
+        with pytest.raises(RuntimeError, match="disk died"):
+            h.wait()
+        # prior checkpoint still loads with prior values
+        tgt = self._state(0.0)
+        dck.load_state_dict(tgt, path)
+        np.testing.assert_array_equal(tgt["w"].numpy(),
+                                      np.full((16, 4), 1.0, np.float32))
+        assert tgt["step"] == 1
+
+    def test_partial_write_without_commit_is_invisible(self, tmp_path):
+        """Shard files under a new uid that never got committed must be
+        ignored by load (the metadata is the commit point)."""
+        import pickle
+        path = str(tmp_path / "ack4")
+        dck.save_state_dict(self._state(1.0), path)
+        # orphan shard from a crashed save (uid 99, never committed)
+        orphan = {("w", ((0, 16), (0, 4))): np.full((16, 4), -7,
+                                                    np.float32)}
+        with open(os.path.join(path, "data_99_0.pkl"), "wb") as f:
+            pickle.dump(orphan, f)
+        tgt = self._state(0.0)
+        dck.load_state_dict(tgt, path)
+        np.testing.assert_array_equal(tgt["w"].numpy(),
+                                      np.full((16, 4), 1.0, np.float32))
+
+    def test_back_to_back_async_saves_serialize(self, tmp_path):
+        path = str(tmp_path / "ack5")
+        h1 = dck.save_state_dict(self._state(1.0), path, async_save=True)
+        h2 = dck.save_state_dict(self._state(2.0), path, async_save=True)
+        h2.wait()
+        h1.wait()
+        tgt = self._state(0.0)
+        dck.load_state_dict(tgt, path)
+        np.testing.assert_array_equal(tgt["w"].numpy(),
+                                      np.full((16, 4), 2.0, np.float32))

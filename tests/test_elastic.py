@@ -75,3 +75,105 @@ sys.exit(1 if n == 0 else 0)   # fail on the first attempt only
         env=env, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr[-1500:]
     assert marker.read_text() == "2"   # first attempt failed, retry passed
+
+
+def test_elastic_rescale_resumes_from_checkpoint(tmp_path):
+    """Round-3 verdict item 7 e2e: kill 1 of 2 workers -> launcher
+    relaunches at the surviving world size -> training resumes from the
+    latest checkpoint and the loss keeps improving."""
+    script = tmp_path / "train_elastic.py"
+    ckpt = tmp_path / "ckpt"
+    out = tmp_path / "result.json"
+    script.write_text(f"""
+import json, os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle2_tpu as paddle
+import paddle2_tpu.distributed as dist
+import paddle2_tpu.distributed.checkpoint as dck
+import paddle2_tpu.nn as nn
+import paddle2_tpu.optimizer as opt
+
+rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+restart = int(os.environ.get("PADDLE_ELASTIC_RESTART_COUNT", 0))
+ckpt_dir = {str(repr(str(ckpt)))}
+
+paddle.seed(0)
+m = nn.Linear(4, 1)
+o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+state = {{"w": m.weight, "b": m.bias, "step": 0}}
+start_step = 0
+if os.path.exists(os.path.join(ckpt_dir, "0.metadata")):
+    dck.load_state_dict(state, ckpt_dir)     # reshard-on-load resume
+    start_step = int(state["step"]) + 1
+
+rs = np.random.RandomState(0)
+W = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+losses = []
+loss_fn = nn.MSELoss()
+import time
+for step in range(start_step, 12):
+    if world > 1:
+        time.sleep(0.3)   # pace the gang so the launcher's failure
+                          # detection lands while training is in flight
+    x = paddle.to_tensor(rs.randn(16, 4).astype(np.float32))
+    y = paddle.to_tensor(np.asarray(x._data) @ W)
+    loss = loss_fn(m(x), y)
+    loss.backward()
+    o.step()
+    o.clear_grad()
+    losses.append(float(np.asarray(loss._data)))
+    if rank == 0:
+        state["step"] = step
+        dck.save_state_dict(state, ckpt_dir)
+    if rank == 1 and restart == 0 and step == 3:
+        os._exit(1)                            # simulated dead rank
+if rank == 0:
+    json.dump({{"world": world, "restart": restart,
+               "start_step": start_step, "losses": losses}},
+              open({str(repr(str(out)))}, "w"))
+""")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "PADDLE_"))}
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle2_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--max_restarts", "2",
+         "--elastic_rescale", str(script)],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "scale-in: world 2 -> 1" in proc.stderr
+    res = json.load(open(out))
+    assert res["world"] == 1           # resumed at the surviving size
+    assert res["restart"] == 1
+    assert res["start_step"] >= 3      # picked up from the checkpoint
+    assert res["losses"][-1] < res["losses"][0]
+
+
+def test_elastic_exit_code_restart_does_not_consume_budget(tmp_path):
+    """rc=101 (ELASTIC_EXIT_CODE) marks a deliberate scale event: the
+    launcher restarts even with max_restarts=0."""
+    script = tmp_path / "scale.py"
+    marker = tmp_path / "n.txt"
+    script.write_text(f"""
+import os, sys
+from paddle2_tpu.distributed.fleet.elastic import ELASTIC_EXIT_CODE
+p = {str(repr(str(marker)))}
+n = int(open(p).read()) if os.path.exists(p) else 0
+open(p, "w").write(str(n + 1))
+sys.exit(ELASTIC_EXIT_CODE if n == 0 else 0)
+""")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "PADDLE_"))}
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle2_tpu.distributed.launch",
+         "--max_restarts", "0", str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert marker.read_text() == "2"

@@ -223,7 +223,13 @@ def test_to_static_forward_runs_once_per_step():
     assert calls["n"] == traced
 
 
-def test_to_static_value_dependence_raises():
+def test_to_static_value_dependence_graph_breaks():
+    """A value-dependent Python branch no longer raises: it graph-breaks
+    into a compiled predicate + per-branch specialized program (round-3
+    verdict item 5; see tests/test_scan_to_static.py for the full
+    coverage). The eager result must match."""
+    paddle.seed(0)
+
     class Net(nn.Layer):
         def __init__(self):
             super().__init__()
@@ -235,9 +241,13 @@ def test_to_static_value_dependence_raises():
                 return h * 2
             return h
 
-    st = paddle.jit.to_static(Net())
-    with pytest.raises(RuntimeError, match="traced Tensor"):
-        st(paddle.randn([2, 4]))
+    net = Net()
+    st = paddle.jit.to_static(net)
+    x = paddle.to_tensor(np.abs(np.random.RandomState(0)
+                                .randn(2, 4)).astype(np.float32))
+    out = st(x)
+    ref = net(x)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
 
 
 def test_to_static_grad_correctness_after_vjp_rework():

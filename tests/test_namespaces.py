@@ -33,6 +33,35 @@ def test_profiler_records_and_exports(tmp_path):
     assert rows and {"name", "calls", "total_ms"} <= set(rows[0])
 
 
+def test_merge_traces_combines_rank_lanes(tmp_path):
+    """CrossStackProfiler parity: per-rank chrome traces merge into one
+    timeline with a process lane per rank."""
+    for rank in (0, 1):
+        handler = profiler.export_chrome_tracing(str(tmp_path),
+                                                 worker_name=f"rank{rank}")
+        prof = profiler.Profiler(timer_only=True, on_trace_ready=handler)
+        prof.start()
+        with profiler.RecordEvent(f"step_r{rank}"):
+            paddle.matmul(paddle.ones([8, 8]), paddle.ones([8, 8]))
+        prof.step()
+        prof.stop()
+    merged = profiler.merge_traces(str(tmp_path))
+    out = json.load(open(tmp_path / "merged.paddle_trace.json"))
+    assert out == merged
+    names = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {"rank0", "rank1"}
+    pids = {e["pid"] for e in merged["traceEvents"] if e.get("ph") != "M"}
+    assert pids == {0, 1}
+    spans = {e["name"] for e in merged["traceEvents"] if e.get("ph") != "M"}
+    assert {"step_r0", "step_r1"} <= spans
+    # start-aligned lanes: each rank's earliest ts is 0
+    for pid in (0, 1):
+        ts = [e["ts"] for e in merged["traceEvents"]
+              if e.get("ph") != "M" and e["pid"] == pid]
+        assert min(ts) == 0.0
+
+
 def test_profiler_scheduler_states():
     sch = profiler.make_scheduler(closed=1, ready=1, record=2, repeat=1)
     states = [sch(i) for i in range(4)]

@@ -511,3 +511,183 @@ def test_compiled_1f1b_transformer_stages_with_head():
     for k in params:
         np.testing.assert_allclose(np.asarray(grads[k]), np.asarray(rg[k]),
                                    rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+class TestCompiledVPP:
+    """pipeline_spmd_vpp: compiled interleaved virtual-pipeline — V model
+    chunks per device, virtual stage v*S+s on device s — vs a sequential
+    reference (round-3 verdict item 9; reference
+    PipelineParallelWithInterleave, pipeline_parallel.py:1174)."""
+
+    def _run(self, M, V=2, S=4):
+        import jax
+        import jax.numpy as jnp
+        import paddle2_tpu.distributed as dist
+        from paddle2_tpu.distributed.fleet.spmd_pipeline import (
+            pipeline_spmd_vpp)
+        dist.init_mesh({"pp": S, "dp": 8 // S})
+        B, H = 2, 8
+        P = V * S
+        rs = np.random.RandomState(0)
+        W = jnp.asarray(rs.randn(V, S, H, H) * 0.3, jnp.float32)
+        b = jnp.asarray(rs.randn(V, S, H) * 0.1, jnp.float32)
+        x = jnp.asarray(rs.randn(M, B, H), jnp.float32)
+        y = jnp.asarray(rs.randn(M, B, H), jnp.float32)
+
+        def stage_fn(p, shared, x, vs):
+            w, bb = p
+            return jnp.tanh(x @ w + bb)
+
+        def loss_fn(out, label):
+            return jnp.mean((out - label) ** 2)
+
+        loss, grads = pipeline_spmd_vpp(stage_fn, (W, b), x, y, loss_fn,
+                                        n_chunks=V)
+
+        def ref(params):
+            Wr, br = params
+            tot = 0.0
+            for m in range(M):
+                h = x[m]
+                for vs in range(P):
+                    v, s = vs // S, vs % S
+                    h = jnp.tanh(h @ Wr[v, s] + br[v, s])
+                tot = tot + jnp.mean((h - y[m]) ** 2)
+            return tot / M
+
+        rl, rg = jax.value_and_grad(ref)((W, b))
+        np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(grads[0]), np.asarray(rg[0]),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(grads[1]), np.asarray(rg[1]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_vpp_parity_m_gt_s(self):
+        self._run(8)
+
+    def test_vpp_parity_m_eq_s(self):
+        self._run(4)
+
+    def test_vpp_parity_m_lt_s(self):
+        self._run(2)
+
+    def test_vpp_three_chunks(self):
+        self._run(4, V=3, S=2)
+
+    def test_vpp_matches_eager_interleave(self):
+        """Same virtual-stage placement as the eager VPP executor at
+        pp=4, V=2: both must equal the plain sequential model, so they
+        equal each other."""
+        self._run(4, V=2, S=4)
+
+    def test_vpp_activation_memory_bounded_by_chunk_inputs(self):
+        """The compiled VPP saves exactly the V*M chunk INPUTS and
+        recomputes each chunk in backward — its compiled temp footprint
+        must undercut autodiff-through-forward (which saves every
+        intermediate of every virtual stage)."""
+        import jax
+        import jax.numpy as jnp
+        import paddle2_tpu.distributed as dist
+        from paddle2_tpu.distributed.fleet.spmd_pipeline import (
+            _PIPE_CACHE, pipeline_spmd_vpp)
+        dist.init_mesh({"pp": 4, "dp": 2})
+        V, S, M, B, H = 2, 4, 8, 4, 64
+        rs = np.random.RandomState(0)
+        W = jnp.asarray(rs.randn(V, S, H, H) * 0.1, jnp.float32)
+        b = jnp.asarray(rs.randn(V, S, H) * 0.1, jnp.float32)
+        x = jnp.asarray(rs.randn(M, B, H), jnp.float32)
+        y = jnp.asarray(rs.randn(M, B, H), jnp.float32)
+
+        # deep chunk: many intermediates per stage for autodiff to save
+        def stage_fn(p, shared, xx, vs):
+            w, bb = p
+            for _ in range(6):
+                xx = jnp.tanh(xx @ w + bb)
+            return xx
+
+        def loss_fn(out, label):
+            return jnp.mean((out - label) ** 2)
+
+        loss, _ = pipeline_spmd_vpp(stage_fn, (W, b), x, y, loss_fn,
+                                    n_chunks=V)
+        assert np.isfinite(float(loss))
+        vpp_fn = next(v for k, v in _PIPE_CACHE.items() if k[0] == "vpp")
+        vpp_mem = vpp_fn.lower((W, b), (), x, y).compile() \
+            .memory_analysis().temp_size_in_bytes
+
+        # autodiff-through-forward baseline at the same geometry
+        def fwd_all(params, xm):
+            Wr, br = params
+            outs = []
+            for m in range(M):
+                h = xm[m]
+                for vs in range(V * S):
+                    h = stage_fn((Wr[vs // S, vs % S],
+                                  br[vs // S, vs % S]), (), h, vs)
+                outs.append(loss_fn(h, y[m]))
+            return sum(outs) / M
+
+        naive = jax.jit(jax.value_and_grad(fwd_all))
+        naive_mem = naive.lower((W, b), x).compile() \
+            .memory_analysis().temp_size_in_bytes
+        assert vpp_mem < naive_mem, (vpp_mem, naive_mem)
+
+
+def test_compiled_1f1b_hybrid_tp_pp_param_specs():
+    """pipeline_spmd_1f1b param_specs: TP weight dims sharded over 'mp'
+    inside the compiled pipeline (column/row-parallel + psum) must match
+    the dense sequential reference — BASELINE config 4's structure."""
+    import jax
+    import jax.numpy as jnp
+    import paddle2_tpu.distributed as dist
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle2_tpu.distributed.fleet.spmd_pipeline import (
+        pipeline_spmd_1f1b)
+
+    mesh = dist.init_mesh({"pp": 4, "mp": 2})
+    S_pp, MP, M, B, H = 4, 2, 4, 2, 8
+    FF = 4 * H
+    rs = np.random.RandomState(0)
+    up = jnp.asarray(rs.randn(S_pp, H, FF) * 0.2, jnp.float32)
+    down = jnp.asarray(rs.randn(S_pp, FF, H) * 0.2, jnp.float32)
+    x = jnp.asarray(rs.randn(M, B, H), jnp.float32)
+    y = jnp.asarray(rs.randn(M, B, H), jnp.float32)
+
+    specs = {"up": P("pp", None, "mp"), "down": P("pp", "mp", None)}
+    params = {
+        "up": jax.device_put(up, NamedSharding(mesh, specs["up"])),
+        "down": jax.device_put(down, NamedSharding(mesh, specs["down"])),
+    }
+
+    def stage_fn(p, shared, xx, sidx):
+        # vma-aware vjp handles the TP transposes: no identity/allreduce
+        # PyLayer pair needed (the 1F1B body seeds the loss cotangent
+        # with the 1/TP-degree factor the replicated scalar requires)
+        h = jnp.tanh(xx @ p["up"])          # column-parallel: local cols
+        part = h @ p["down"]                # row-parallel: partial sums
+        return xx + jax.lax.psum(part, "mp")
+
+    def loss_fn(out, label):
+        return jnp.mean((out - label) ** 2)
+
+    loss, grads = pipeline_spmd_1f1b(stage_fn, params, x, y, loss_fn,
+                                     param_specs=specs)
+
+    def ref(pr):
+        tot = 0.0
+        for m in range(M):
+            h = x[m]
+            for s_i in range(S_pp):
+                h = h + jnp.tanh(h @ pr["up"][s_i]) @ pr["down"][s_i]
+            tot = tot + jnp.mean((h - y[m]) ** 2)
+        return tot / M
+
+    rl, rg = jax.value_and_grad(ref)({"up": up, "down": down})
+    np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["up"]),
+                               np.asarray(rg["up"]), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["down"]),
+                               np.asarray(rg["down"]), rtol=1e-4,
+                               atol=1e-5)
+    # grads really are TP-sharded in the result
+    assert "mp" in str(grads["up"].sharding.spec)
