@@ -328,9 +328,6 @@ def _vpp_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
         ftick, (_varying(acts0), _varying(x_save0)), jnp.arange(T))
 
     # ---- backward wavefront: recompute-from-input vjp per chunk --------
-    g0 = jax.tree_util.tree_map(
-        lambda a: jnp.zeros(a.shape[1:], jnp.float32), p_chunks)
-
     def btick(carry, u):
         cts, grads, losses = carry      # cts: [V, B...] out-cotangents
         dxs = []

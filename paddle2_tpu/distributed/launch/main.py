@@ -146,6 +146,11 @@ def launch(argv: Optional[List[str]] = None) -> int:
                       f"{attempt - 1} restarts; giving up",
                       file=sys.stderr)
                 return rc
+        if args.elastic_rescale and args.nnodes > 1:
+            print("[launch] --elastic_rescale only rescales the local "
+                  "gang (nnodes == 1); multi-node membership needs the "
+                  "coordination service — restarting at full size",
+                  file=sys.stderr)
         if args.elastic_rescale and args.nnodes == 1:
             new_world = max(1, args.nproc_per_node - max(1, n_failed))
             if new_world != args.nproc_per_node:

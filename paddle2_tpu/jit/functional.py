@@ -168,7 +168,11 @@ class TracedProgram:
         rng_key = fr.next_key()
 
         # resolve known graph breaks: walk the predicate trie, running
-        # each compiled prefix to get this call's branch values
+        # each compiled prefix to get this call's branch values. Once the
+        # specialization budget is spent, unknown values go straight to
+        # eager WITHOUT growing the trie (else a per-value read leaks a
+        # node + pays a predicate dispatch per call forever)
+        at_limit = len(self._compiled) >= limit
         node = self._break_trie.setdefault(base_key, {"pred": None,
                                                       "children": {}})
         break_values: List[Any] = []
@@ -176,8 +180,13 @@ class TracedProgram:
             v = np.asarray(node["pred"](param_arrays, buffer_arrays,
                                         arg_arrays, rng_key))
             break_values.append(v)
-            node = node["children"].setdefault(
-                value_key(v), {"pred": None, "children": {}})
+            child = node["children"].get(value_key(v))
+            if child is None:
+                if at_limit:
+                    return _eager_fallback()
+                child = {"pred": None, "children": {}}
+                node["children"][value_key(v)] = child
+            node = child
 
         while True:
             key = base_key + (len(break_values),
@@ -188,7 +197,7 @@ class TracedProgram:
             try:
                 if entry is None:
                     entry = self._build(template, params, buffers,
-                                        len(args_t), break_values)
+                                        len(args_t))
                 fwd_jit, fwd_vjp_jit, vjp_apply_jit, meta = entry
                 with break_scope(break_values, capture=True):
                     if needs_grad:
@@ -263,7 +272,11 @@ class TracedProgram:
 
         return jax.jit(pred)
 
-    def _build(self, template, params, buffers, n_args, break_values=()):
+    def _build(self, template, params, buffers, n_args):
+        # NOTE: branch specialization is NOT baked here — the break_scope
+        # installed around the entry's first execution answers the value
+        # reads at trace time; the entry is valid only under the values
+        # its cache key names
         fn = self.fn
         state_tensors = params + buffers
         meta: Dict[str, Any] = {}

@@ -83,10 +83,6 @@ def break_scope(answers: List[np.ndarray], capture: bool = True) -> _Scope:
     return _Scope(BreakController(answers, capture))
 
 
-def no_break_scope() -> _Scope:
-    return _Scope(None)
-
-
 def active_break_controller() -> Optional[BreakController]:
     return getattr(_TLS, "ctl", None)
 
