@@ -25,29 +25,31 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _interpret_default() -> bool:
-    try:
-        return jax.devices()[0].platform.lower() == "cpu"
-    except Exception:
-        return True
+from .pallas_flash import _interpret_default
+
+# keep the backward's working set (x, do, dx blocks in f32 + row stats)
+# well under a core's VMEM: blk * H * 4B * 3 <= ~6 MiB
+_VMEM_ROW_BUDGET = 512 * 1024
 
 
-def _pick_block(n: int) -> int:
+def _pick_block(n: int, h: int) -> int:
+    cap = max(8, _VMEM_ROW_BUDGET // max(h, 1))
     for b in (512, 256, 128, 64, 32, 16, 8):
-        if n % b == 0:
+        if b <= cap and n % b == 0:
             return b
     return 0
 
 
 def supported(shape) -> bool:
-    """Last-axis LN over [*, H]: H lane-aligned, rows tileable."""
+    """Last-axis LN over [*, H]: H lane-aligned, rows tileable within
+    the VMEM budget."""
     if len(shape) < 2:
         return False
     h = shape[-1]
     n = 1
     for d in shape[:-1]:
         n *= d
-    return h % 128 == 0 and h <= 8192 and _pick_block(n) >= 8
+    return h % 128 == 0 and h <= 8192 and _pick_block(n, h) >= 8
 
 
 def _fwd_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
@@ -103,7 +105,7 @@ def _run_fwd(x, weight, bias, eps):
     h = shape[-1]
     x2 = x.reshape(-1, h)
     n = x2.shape[0]
-    blk = _pick_block(n)
+    blk = _pick_block(n, h)
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
         grid=(n // blk,),
@@ -128,7 +130,7 @@ def _bwd_rule(eps, res, do):
     x2 = x.reshape(-1, h)
     do2 = do.reshape(-1, h)
     n = x2.shape[0]
-    blk = _pick_block(n)
+    blk = _pick_block(n, h)
     nblk = n // blk
     dx, dg, db = pl.pallas_call(
         functools.partial(_bwd_kernel, eps=eps, nblk=nblk),
