@@ -81,15 +81,46 @@ def _spawn(args) -> List[subprocess.Popen]:
         cmd = [sys.executable, args.training_script] \
             + args.training_script_args
         stdout = stderr = None
+        log_path = None
         if args.log_dir:
             os.makedirs(args.log_dir, exist_ok=True)
             rank = args.node_rank * args.nproc_per_node + lr
-            f = open(os.path.join(args.log_dir,
-                                  f"workerlog.{rank}"), "ab")
+            log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
+            f = open(log_path, "ab")
             stdout = stderr = f
-        procs.append(subprocess.Popen(cmd, env=_worker_env(args, lr),
-                                      stdout=stdout, stderr=stderr))
+        p = subprocess.Popen(cmd, env=_worker_env(args, lr),
+                             stdout=stdout, stderr=stderr)
+        p.log_path = log_path
+        procs.append(p)
     return procs
+
+
+def _surface_failure_logs(procs, n_tail: int = 30) -> None:
+    """Reference launch/watcher.py behavior: on gang failure, surface the
+    tail of each failed worker's log so the operator sees WHY without
+    digging through per-rank files."""
+    from ..fleet.elastic import ELASTIC_EXIT_CODE
+    for i, p in enumerate(procs):
+        rc = p.poll()
+        # only workers that died on their OWN with a real error: skip
+        # survivors our teardown SIGTERM'd (negative rc) and deliberate
+        # scale-event exits — their tails would bury the actual cause
+        if rc is None or rc <= 0 or rc == ELASTIC_EXIT_CODE \
+                or not getattr(p, "log_path", None):
+            continue
+        try:
+            with open(p.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 8192))
+                tail = f.read().decode("utf-8", "replace")
+            lines = tail.splitlines()[-n_tail:]
+            print(f"[launch] ---- worker {i} (rc={rc}) log tail "
+                  f"({p.log_path}) ----", file=sys.stderr)
+            for ln in lines:
+                print(f"[launch] | {ln}", file=sys.stderr)
+        except OSError:
+            pass
 
 
 def _watch(procs: List[subprocess.Popen]):
@@ -135,6 +166,7 @@ def launch(argv: Optional[List[str]] = None) -> int:
         rc, n_failed = _watch(procs)
         if rc == 0:
             return 0
+        _surface_failure_logs(procs)
         # reference ELASTIC_EXIT_CODE (manager.py:33): a worker exiting
         # 101 announces a deliberate scale event — restart does not
         # consume the failure budget

@@ -152,11 +152,12 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         scale=scale,
                         dropout_p=dropout if drop_key is not None else 0.0,
                         dropout_key=drop_key)
-        # re-pack valid query rows
-        rows = []
-        for i in range(B):
-            rows.append(out[i, :int(len_q[i])])
-        return jnp.concatenate(rows, axis=0)
+        # re-pack valid query rows with ONE gather (a per-sequence slice
+        # loop would emit B dynamic-slices + concatenate)
+        seq_of_row = np.repeat(np.arange(B), len_q.astype(np.int64))
+        pos_of_row = (np.arange(int(cu_q[-1]))
+                      - np.repeat(cu_q[:-1], len_q.astype(np.int64)))
+        return out[jnp.asarray(seq_of_row), jnp.asarray(pos_of_row)]
     out = apply_op("flash_attn_unpadded", run, (q, k, v), {})
     return out, None
 

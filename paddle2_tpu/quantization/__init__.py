@@ -66,10 +66,13 @@ class AbsmaxObserver(nn.Layer):
         self._frozen = True
 
     def forward(self, x: Tensor) -> Tensor:
-        import numpy as np
-        cur = float(np.abs(np.asarray(x.numpy())).max()) if not \
-            (self._frozen or isinstance(x._data, jax.core.Tracer)) else None
-        if cur is not None:
+        if not (self._frozen or isinstance(x._data, jax.core.Tracer)):
+            # stays ON DEVICE: no per-forward host sync — calibration
+            # over a real dataset would otherwise serialize on D2H
+            # transfers (round-3 review). The value is fetched once in
+            # scale().
+            import jax.numpy as jnp
+            cur = jnp.max(jnp.abs(x._data)).astype(jnp.float32)
             if self._seen:
                 self._absmax = (self.moving_rate * self._absmax
                                 + (1 - self.moving_rate) * cur)
@@ -78,8 +81,18 @@ class AbsmaxObserver(nn.Layer):
                 self._seen = True
         return x
 
-    def scale(self) -> float:
+    def raw_scale(self):
+        """Device-resident scale (jnp scalar or python float) — the QAT
+        fake-quant path consumes this so an eager training step never
+        blocks on a D2H sync."""
         return self._absmax if self._seen else 1.0
+
+    def scale(self) -> float:
+        if not self._seen:
+            return 1.0
+        if not isinstance(self._absmax, float):
+            self._absmax = float(self._absmax)    # one sync at read time
+        return self._absmax
 
 
 class ChannelWiseAbsMaxObserver(nn.Layer):
@@ -128,7 +141,7 @@ class FakeQuanterWithAbsMaxObserver(nn.Layer):
 
     def forward(self, x: Tensor) -> Tensor:
         self.observer(x)
-        return fake_quant(x, self.observer.scale(), self.quant_bits)
+        return fake_quant(x, self.observer.raw_scale(), self.quant_bits)
 
 
 class FakeQuanterChannelWiseAbsMaxObserver(nn.Layer):

@@ -177,3 +177,25 @@ sys.exit(ELASTIC_EXIT_CODE if n == 0 else 0)
         env=env, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr[-1500:]
     assert marker.read_text() == "2"
+
+
+def test_launcher_surfaces_failed_worker_log(tmp_path):
+    """watcher.py parity: the failing worker's log tail appears in the
+    launcher's stderr."""
+    script = tmp_path / "boom.py"
+    script.write_text("""
+import sys
+print("the-needle-in-the-log: cuda? no, tpu!")
+sys.exit(3)
+""")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "PADDLE_"))}
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle2_tpu.distributed.launch",
+         "--log_dir", str(tmp_path / "logs"), str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 3
+    assert "the-needle-in-the-log" in proc.stderr
+    assert "log tail" in proc.stderr
