@@ -20,7 +20,8 @@ from ..sharding import ShardedOptimizer, group_sharded_parallel
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
 from .pipeline_parallel import PipelineParallel
 from .elastic import ElasticManager, ElasticStatus
-from .spmd_pipeline import pipeline_spmd, pipeline_spmd_1f1b
+from .spmd_pipeline import (pipeline_spmd, pipeline_spmd_1f1b,
+                            pipeline_spmd_vpp)
 
 __all__ = ["init", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
@@ -31,7 +32,7 @@ __all__ = ["init", "DistributedStrategy", "distributed_model",
            "worker_num", "is_first_worker", "meta_parallel",
            "LayerDesc", "SharedLayerDesc", "PipelineLayer",
            "PipelineParallel", "ElasticManager", "ElasticStatus",
-           "pipeline_spmd"]
+           "pipeline_spmd", "pipeline_spmd_1f1b", "pipeline_spmd_vpp"]
 
 
 class DistributedStrategy:

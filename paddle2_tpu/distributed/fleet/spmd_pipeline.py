@@ -13,6 +13,16 @@ XLA as one program, so there is no per-microbatch Python dispatch at all.
 Parity target: the reference's per-rank NCCL p2p pipeline
 (fleet/meta_parallel/pipeline_parallel.py) — re-expressed as a collective
 program the way the scaling-book prescribes for TPU pipelining.
+
+Compiled schedules: GPipe wavefront (pipeline_spmd), hand-scheduled 1F1B
+(pipeline_spmd_1f1b, closed-form ticks, S+1 activation bound, hybrid
+TP+PP via param_specs), interleaved virtual-pipeline
+(pipeline_spmd_vpp). Zero-bubble (ZB-H1) ships on the EAGER executor
+only (pipeline_parallel.py schedule="ZB"): its point — filling bubbles
+with deferred weight-grad W ops — is a scheduling freedom XLA's
+latency-hiding scheduler already exercises inside a single compiled
+program, so a hand-scheduled compiled ZB would re-derive what the
+compiler does; the eager version remains the semantics reference.
 """
 
 from __future__ import annotations
