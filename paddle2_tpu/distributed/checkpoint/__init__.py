@@ -169,17 +169,48 @@ def _write_phase(path: str, meta, data, data_file: str, rank: int,
     with open(tmp, "wb") as f:
         pickle.dump(data, f, protocol=4)
     os.replace(tmp, data_file)
-    if multi:  # every rank's shard file must exist before the commit
+    if multi:
+        # each rank also writes a metadata sidecar: the coordinator only
+        # sees ITS OWN addressable shards (and its own scalar keys), so
+        # the global metadata must merge every rank's bounds + scalars
+        # (otherwise load raises "shards do not cover" / "lacks keys")
+        side = os.path.join(path, f"shards_{uid}_{rank}.pkl")
+        with open(side + ".tmp", "wb") as f:
+            pickle.dump({"tensors": meta["tensors"],
+                         "scalars": meta["scalars"]}, f, protocol=4)
+        os.replace(side + ".tmp", side)
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("ckpt_shards_written")
-        # the coordinator's metadata only names its own file; merge the
-        # full file list from what landed on the shared path
         if rank == coordinator_rank:
             meta = dict(meta)
             meta["files"] = sorted(
                 fname for fname in os.listdir(path)
                 if fname.startswith(f"data_{uid}_")
                 and fname.endswith(".pkl"))
+            merged = {k: dict(v, shards=list(v["shards"]))
+                      for k, v in meta["tensors"].items()}
+            merged_scalars = dict(meta["scalars"])
+            for fname in sorted(os.listdir(path)):
+                if not (fname.startswith(f"shards_{uid}_")
+                        and fname.endswith(".pkl")):
+                    continue
+                with open(os.path.join(path, fname), "rb") as f:
+                    side_meta = pickle.load(f)
+                for key, val in side_meta.get("scalars", {}).items():
+                    merged_scalars.setdefault(key, val)
+                for key, info in side_meta.get("tensors", {}).items():
+                    if key not in merged:
+                        merged[key] = dict(info,
+                                           shards=list(info["shards"]))
+                        continue
+                    seen_b = {tuple(s["bounds"])
+                              for s in merged[key]["shards"]}
+                    for s in info["shards"]:
+                        if tuple(s["bounds"]) not in seen_b:
+                            merged[key]["shards"].append(s)
+                            seen_b.add(tuple(s["bounds"]))
+            meta["tensors"] = merged
+            meta["scalars"] = merged_scalars
     if rank == coordinator_rank:
         mtmp = os.path.join(path, _METADATA + ".tmp")
         with open(mtmp, "wb") as f:
@@ -187,8 +218,9 @@ def _write_phase(path: str, meta, data, data_file: str, rank: int,
         os.replace(mtmp, os.path.join(path, _METADATA))   # commit point
         keep = set(meta["files"])
         for fname in os.listdir(path):
-            if fname.startswith("data_") and fname.endswith(".pkl") \
-                    and fname not in keep:
+            if fname.endswith(".pkl") and fname not in keep \
+                    and (fname.startswith("data_")
+                         or fname.startswith("shards_")):
                 os.remove(os.path.join(path, fname))
     if multi:
         from jax.experimental import multihost_utils
@@ -218,13 +250,21 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     import jax
     multi = jax.process_count() > 1
     if multi:
-        # every rank must observe the SAME directory state before
-        # picking uid: without this barrier a fast rank's committed
-        # shard file inflates a slow rank's uid and the coordinator's
-        # post-commit cleanup would delete that rank's shard
+        # ranks must AGREE on uid: a fast rank's background write can
+        # land in the directory before a slow rank scans it, skewing an
+        # independently-derived uid (and the coordinator's post-commit
+        # cleanup would then delete the skewed rank's shard). Barrier,
+        # then broadcast the coordinator's scan.
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("ckpt_save_enter")
-    uid = unique_id if unique_id is not None else _next_uid(path)
+        if unique_id is not None:
+            uid = unique_id        # caller-agreed: no broadcast needed
+        else:
+            uid = int(multihost_utils.broadcast_one_to_all(
+                np.int64(_next_uid(path)),
+                is_source=rank == coordinator_rank))
+    else:
+        uid = unique_id if unique_id is not None else _next_uid(path)
     data_file = os.path.join(path, f"data_{uid}_{rank}.pkl")
     meta, data = _snapshot(state_dict, rank, data_file)
 
