@@ -152,7 +152,29 @@ def _resolve_partial(arr, mesh: ProcessMesh, placements, src_partial):
         tuple(p) if len(p) > 1 else (p[0] if p else None)
         for p in out_parts])
 
-    key = (id(jm), in_spec, out_spec, tuple(sorted(ops.items())),
+    # a scattered dim must split evenly over its axis, or psum_scatter
+    # surfaces an opaque Mosaic/XLA shape error deep in lowering — and
+    # the scatter runs on the per-shard BLOCK inside shard_map, so the
+    # check divides out any in_spec axes already sharding that dim
+    in_entries = tuple(in_spec) + ((),) * (arr.ndim - len(in_spec))
+    for a, d in scatter.items():
+        e = in_entries[d]
+        shard_axes = (e,) if isinstance(e, str) else tuple(e or ())
+        local = arr.shape[d]
+        for sa in shard_axes:
+            local //= jm.shape[sa]
+        if local % jm.shape[a] != 0:
+            raise ValueError(
+                f"p_to_s reshard: dim {d} local extent {local} (global "
+                f"{arr.shape[d]} over {shard_axes or 'no axes'}) is not "
+                f"divisible by mesh axis {a!r} (size {jm.shape[a]})")
+
+    # key the cache on the mesh's identity-free description — id(jm) can
+    # be reused after GC and would hand back a program bound to a dead
+    # device layout
+    mesh_key = (tuple(jm.shape.items()),
+                tuple(d.id for d in jm.devices.flat))
+    key = (mesh_key, in_spec, out_spec, tuple(sorted(ops.items())),
            tuple(sorted(scatter.items())), arr.shape, str(arr.dtype))
     fn = _PARTIAL_RESHARD_CACHE.get(key)
     if fn is None:

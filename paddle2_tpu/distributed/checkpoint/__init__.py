@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..env import get_rank
+from ..env import get_rank, get_world_size
 
 _METADATA = "0.metadata"
 
@@ -159,26 +159,92 @@ def _snapshot(state_dict, rank: int, data_file: str):
     return meta, data
 
 
+def _write_side_meta(path: str, uid: int, rank: int, meta) -> None:
+    """Per-rank metadata sidecar: which bounds/scalars THIS rank wrote.
+    The coordinator (multi-host) or load (launcher-mode) merges them."""
+    side = os.path.join(path, f"shards_{uid}_{rank}.pkl")
+    with open(side + ".tmp", "wb") as f:
+        pickle.dump({"tensors": meta["tensors"],
+                     "scalars": meta["scalars"]}, f, protocol=4)
+    os.replace(side + ".tmp", side)
+
+
+def _merge_side_meta(tensors, scalars, side,
+                     keep_existing_scalars: bool = False) -> None:
+    """Merge one sidecar's tensors/scalars into the global metadata,
+    deduping shard bounds and skipping entries whose global_shape
+    disagrees with the committed one (a stale sidecar from a rank that
+    stopped saving must not corrupt the assembly)."""
+    for key, val in side.get("scalars", {}).items():
+        if keep_existing_scalars:
+            scalars.setdefault(key, val)
+        else:
+            scalars[key] = val
+    for key, info in side.get("tensors", {}).items():
+        if key not in tensors:
+            tensors[key] = dict(info, shards=list(info["shards"]))
+            continue
+        cur = tensors[key]
+        if tuple(info["global_shape"]) != tuple(cur["global_shape"]):
+            continue                     # stale sidecar, different shape
+        seen_b = {tuple(s["bounds"]) for s in cur["shards"]}
+        for s in info["shards"]:
+            if tuple(s["bounds"]) not in seen_b:
+                cur["shards"].append(s)
+                seen_b.add(tuple(s["bounds"]))
+
+
 def _write_phase(path: str, meta, data, data_file: str, rank: int,
-                 coordinator_rank: int, multi: bool, uid: int = 0) -> None:
+                 coordinator_rank: int, multi: bool, uid: int = 0,
+                 legacy_merge: bool = False) -> None:
     """Durable write + atomic commit. Order gives crash safety: shard
     files land under the NEW uid first (invisible to load — it reads
     only files the metadata names), the metadata os.replace is the
-    commit point, stale-uid files are removed only after commit."""
+    commit point, stale-uid files are removed only after commit.
+
+    ``legacy_merge`` (launcher-mode: PADDLE_TRAINERS_NUM > 1 but the JAX
+    distributed runtime is NOT initialized, so no cross-process barriers
+    exist) keeps every rank's data file: the metadata carries no ``files``
+    narrowing and the post-commit sweep is skipped, so load falls back to
+    merging every ``data_*.pkl`` — other ranks' shards are never deleted
+    out from under them."""
     tmp = data_file + ".tmp"
     with open(tmp, "wb") as f:
         pickle.dump(data, f, protocol=4)
     os.replace(tmp, data_file)
+    if legacy_merge:
+        # barrier-free sidecar: load merges these so tensor/scalar keys
+        # held ONLY by non-coordinator ranks stay visible even though the
+        # coordinator's metadata can't wait for them
+        _write_side_meta(path, uid, rank, meta)
+        # sweep this rank's OWN stale files (no other process writes
+        # these names, so no barrier is needed) — bounds directory and
+        # load-cost growth across repeated saves
+        for fname in os.listdir(path):
+            for prefix in ("data_", "shards_"):
+                if fname.startswith(prefix) and fname.endswith(
+                        f"_{rank}.pkl"):
+                    mid = fname[len(prefix):-4].split("_")
+                    if len(mid) == 2 and mid[0].isdigit() \
+                            and int(mid[0]) < uid:
+                        try:
+                            os.remove(os.path.join(path, fname))
+                        except OSError:
+                            pass
+        if rank == coordinator_rank:
+            meta = dict(meta)
+            meta.pop("files", None)      # load merges every data_*.pkl
+            mtmp = os.path.join(path, _METADATA + ".tmp")
+            with open(mtmp, "wb") as f:
+                pickle.dump(meta, f, protocol=4)
+            os.replace(mtmp, os.path.join(path, _METADATA))
+        return
     if multi:
         # each rank also writes a metadata sidecar: the coordinator only
         # sees ITS OWN addressable shards (and its own scalar keys), so
         # the global metadata must merge every rank's bounds + scalars
         # (otherwise load raises "shards do not cover" / "lacks keys")
-        side = os.path.join(path, f"shards_{uid}_{rank}.pkl")
-        with open(side + ".tmp", "wb") as f:
-            pickle.dump({"tensors": meta["tensors"],
-                         "scalars": meta["scalars"]}, f, protocol=4)
-        os.replace(side + ".tmp", side)
+        _write_side_meta(path, uid, rank, meta)
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("ckpt_shards_written")
         if rank == coordinator_rank:
@@ -196,19 +262,8 @@ def _write_phase(path: str, meta, data, data_file: str, rank: int,
                     continue
                 with open(os.path.join(path, fname), "rb") as f:
                     side_meta = pickle.load(f)
-                for key, val in side_meta.get("scalars", {}).items():
-                    merged_scalars.setdefault(key, val)
-                for key, info in side_meta.get("tensors", {}).items():
-                    if key not in merged:
-                        merged[key] = dict(info,
-                                           shards=list(info["shards"]))
-                        continue
-                    seen_b = {tuple(s["bounds"])
-                              for s in merged[key]["shards"]}
-                    for s in info["shards"]:
-                        if tuple(s["bounds"]) not in seen_b:
-                            merged[key]["shards"].append(s)
-                            seen_b.add(tuple(s["bounds"]))
+                _merge_side_meta(merged, merged_scalars, side_meta,
+                                 keep_existing_scalars=True)
             meta["tensors"] = merged
             meta["scalars"] = merged_scalars
     if rank == coordinator_rank:
@@ -249,6 +304,22 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     rank = get_rank()
     import jax
     multi = jax.process_count() > 1
+    # Launcher-mode: PADDLE_TRAINERS_NUM ranks as independent processes
+    # WITHOUT jax.distributed — no global barriers, arrays are process-
+    # local. Never narrow/sweep files here: rank 0's sweep would delete
+    # the other ranks' freshly written shards. Fall back to the legacy
+    # merge-all layout and say so.
+    legacy_merge = (not multi) and get_world_size() > 1
+    if legacy_merge:
+        import warnings
+        warnings.warn(
+            "distributed.checkpoint: world size "
+            f"{get_world_size()} via launcher env but the JAX distributed "
+            "runtime is single-process; writing per-rank files with "
+            "legacy merge-on-load semantics. Ranks holding DIFFERENT "
+            "values under the SAME key will collide on load — initialize "
+            "the distributed runtime (init_parallel_env) for sharded "
+            "checkpoints.", stacklevel=2)
     if multi:
         # ranks must AGREE on uid: a fast rank's background write can
         # land in the directory before a slow rank scans it, skewing an
@@ -270,7 +341,7 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
 
     if not async_save:
         _write_phase(path, meta, data, data_file, rank, coordinator_rank,
-                     multi, uid)
+                     multi, uid, legacy_merge)
         return None
 
     handle: AsyncSaveHandle
@@ -278,7 +349,7 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     def run():
         try:
             _write_phase(path, meta, data, data_file, rank,
-                         coordinator_rank, multi, uid)
+                         coordinator_rank, multi, uid, legacy_merge)
         except BaseException as e:           # surfaced by wait()
             handle._error = e
         finally:
@@ -314,14 +385,43 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     # in-flight or crashed save's orphan files are invisible here.
     # Legacy checkpoints without a file list merge every data_*.pkl.
     files = meta.get("files")
-    if files is None:
-        files = sorted(fname for fname in os.listdir(path)
-                       if fname.startswith("data_")
-                       and fname.endswith(".pkl"))
+    legacy = files is None
+    if legacy:
+        # legacy / launcher-mode layout: merge every data_*.pkl, ordered
+        # numerically by (uid, rank) so a later save's shards win any
+        # (key, bounds) collision with stale files (lexical sort would
+        # put data_10 before data_2); filename breaks ties
+        # deterministically.
+        def _uid_rank(fname):
+            parts = fname.split("_", 1)[1][:-4].split("_")
+            try:
+                return (tuple(int(p) for p in parts), fname)
+            except ValueError:
+                return ((0,), fname)
+        files = sorted((fname for fname in os.listdir(path)
+                        if fname.startswith("data_")
+                        and fname.endswith(".pkl")), key=_uid_rank)
+        # launcher-mode sidecars carry the metadata of ranks the
+        # coordinator could not barrier-wait for: merge their tensor
+        # bounds and scalars so rank-unique keys resolve
+        for fname in sorted((f for f in os.listdir(path)
+                             if f.startswith("shards_")
+                             and f.endswith(".pkl")), key=_uid_rank):
+            try:
+                with open(os.path.join(path, fname), "rb") as f:
+                    side = pickle.load(f)
+            except (OSError, pickle.PickleError):
+                continue
+            _merge_side_meta(meta["tensors"], meta["scalars"], side)
     data: Dict[Tuple[str, Tuple], np.ndarray] = {}
     for fname in files:
-        with open(os.path.join(path, fname), "rb") as f:
-            data.update(pickle.load(f))
+        try:
+            with open(os.path.join(path, fname), "rb") as f:
+                data.update(pickle.load(f))
+        except FileNotFoundError:
+            if not legacy:
+                raise      # a concurrent legacy-mode save swept it
+
 
     flat = flatten_state_dict(state_dict)
     missing = [k for k in flat

@@ -103,9 +103,13 @@ def _surface_failure_logs(procs, n_tail: int = 30) -> None:
     for i, p in enumerate(procs):
         rc = p.poll()
         # only workers that died on their OWN with a real error: skip
-        # survivors our teardown SIGTERM'd (negative rc) and deliberate
-        # scale-event exits — their tails would bury the actual cause
-        if rc is None or rc <= 0 or rc == ELASTIC_EXIT_CODE \
+        # survivors our teardown signalled (_torn_down, set by _watch)
+        # and deliberate scale-event exits — their tails would bury the
+        # actual cause. A worker killed by an EXTERNAL signal (SIGSEGV,
+        # OOM SIGKILL → negative rc) IS the original failure and must
+        # surface its tail.
+        if rc is None or rc == 0 or rc == ELASTIC_EXIT_CODE \
+                or getattr(p, "_torn_down", False) \
                 or not getattr(p, "log_path", None):
             continue
         try:
@@ -147,6 +151,7 @@ def _watch(procs: List[subprocess.Popen]):
         if failed:
             for q in procs:
                 if q.poll() is None:
+                    q._torn_down = True   # our teardown, not its failure
                     q.send_signal(signal.SIGTERM)
             time.sleep(2)
             for q in procs:

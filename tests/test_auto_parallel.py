@@ -175,6 +175,14 @@ class TestShardTensor:
         with pytest.raises(ValueError, match="both Shard and Partial"):
             dist.reshard(a, mesh, [dist.Replicate()], src_partial=["x"])
 
+    def test_reshard_p_to_s_indivisible_dim_raises(self):
+        """Scatter dim not divisible by the axis size must raise a clear
+        ValueError, not an opaque lowering error (advisor r4)."""
+        mesh = _mesh1d()
+        t, _ = self._partial_tensor(mesh, shape=(6, 16))
+        with pytest.raises(ValueError, match="not divisible"):
+            dist.reshard(t, mesh, [dist.Shard(0)], src_partial=["x"])
+
     def test_dtensor_from_fn(self):
         mesh = _mesh1d()
         d = dist.dtensor_from_fn(paddle.ones, mesh, [dist.Shard(0)], [8, 4])

@@ -177,6 +177,74 @@ def test_dist_checkpoint_resave_removes_stale_shards(tmp_path):
         np.arange(64, dtype=np.float32).reshape(8, 8))
 
 
+def test_launcher_mode_save_keeps_other_rank_files(tmp_path, monkeypatch):
+    """PADDLE_TRAINERS_NUM > 1 without the JAX distributed runtime
+    (process_count == 1): the coordinator must NOT narrow the metadata to
+    its own file nor sweep the other ranks' freshly written shards
+    (advisor r4). Falls back to warn + legacy merge-all layout."""
+    import pickle
+    path = str(tmp_path / "lm")
+    state = {"w": paddle.to_tensor(np.ones((4, 4), np.float32))}
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    with pytest.warns(UserWarning, match="legacy merge"):
+        dck.save_state_dict(state, path)
+    rank1_files = [f for f in os.listdir(path)
+                   if f.startswith("data_") and f.endswith("_1.pkl")]
+    assert rank1_files
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    with pytest.warns(UserWarning, match="legacy merge"):
+        dck.save_state_dict(state, path)
+    # rank 1's shard file survived rank 0's commit
+    assert all(f in os.listdir(path) for f in rank1_files)
+    with open(os.path.join(path, "0.metadata"), "rb") as f:
+        meta = pickle.load(f)
+    assert "files" not in meta
+    tgt = {"w": paddle.to_tensor(np.zeros((4, 4), np.float32))}
+    dck.load_state_dict(tgt, path)
+    np.testing.assert_array_equal(tgt["w"].numpy(),
+                                  np.ones((4, 4), np.float32))
+
+
+def test_launcher_mode_rank_unique_keys_loadable(tmp_path, monkeypatch):
+    """Keys held ONLY by a non-coordinator rank must still resolve on
+    load: the coordinator can't barrier-wait, so load merges the
+    barrier-free per-rank sidecar metadata."""
+    path = str(tmp_path / "lmk")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    with pytest.warns(UserWarning, match="legacy merge"):
+        dck.save_state_dict(
+            {"r1_only": paddle.to_tensor(np.full((3,), 5.0, np.float32)),
+             "r1_scalar": 42}, path)
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    with pytest.warns(UserWarning, match="legacy merge"):
+        dck.save_state_dict(
+            {"w": paddle.to_tensor(np.ones((2, 2), np.float32))}, path)
+    tgt = {"w": paddle.to_tensor(np.zeros((2, 2), np.float32)),
+           "r1_only": paddle.to_tensor(np.zeros((3,), np.float32)),
+           "r1_scalar": 0}
+    dck.load_state_dict(tgt, path)
+    np.testing.assert_array_equal(tgt["r1_only"].numpy(),
+                                  np.full((3,), 5.0, np.float32))
+    assert tgt["r1_scalar"] == 42
+
+
+def test_launcher_mode_resave_sweeps_own_stale_files(tmp_path,
+                                                     monkeypatch):
+    """Repeated launcher-mode saves must not grow the directory without
+    bound: each rank sweeps its OWN prior-uid files (barrier-free)."""
+    path = str(tmp_path / "lms")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    state = {"w": paddle.to_tensor(np.ones((2, 2), np.float32))}
+    for _ in range(3):
+        with pytest.warns(UserWarning, match="legacy merge"):
+            dck.save_state_dict(state, path)
+    data_files = [f for f in os.listdir(path) if f.startswith("data_")]
+    assert len(data_files) == 1, data_files
+
+
 class TestAsyncSave:
     """Reference save_state_dict.py:46 async task queue semantics."""
 

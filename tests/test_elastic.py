@@ -199,3 +199,27 @@ sys.exit(3)
     assert proc.returncode == 3
     assert "the-needle-in-the-log" in proc.stderr
     assert "log tail" in proc.stderr
+
+
+def test_launcher_surfaces_signal_killed_worker_log(tmp_path):
+    """A worker killed by an external signal (SIGSEGV/OOM SIGKILL —
+    negative returncode) is the hard-crash class the feature exists for;
+    its log tail must surface (advisor r4). Only survivors our own
+    teardown SIGTERM'd are skipped."""
+    script = tmp_path / "sigkill.py"
+    script.write_text("""
+import os, signal
+print("oom-killer-was-here", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+""")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "PADDLE_"))}
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle2_tpu.distributed.launch",
+         "--log_dir", str(tmp_path / "logs"), str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0
+    assert "oom-killer-was-here" in proc.stderr
+    assert "log tail" in proc.stderr

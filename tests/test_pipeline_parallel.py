@@ -653,10 +653,17 @@ def test_compiled_1f1b_hybrid_tp_pp_param_specs():
     x = jnp.asarray(rs.randn(M, B, H), jnp.float32)
     y = jnp.asarray(rs.randn(M, B, H), jnp.float32)
 
-    specs = {"up": P("pp", None, "mp"), "down": P("pp", "mp", None)}
+    # `gain` is a TP-REPLICATED leaf (an LN-gain analog, spec P('pp',)
+    # only): its grad path avoids the pvary-transpose psum, so parity
+    # here pins the interplay of the 1/TP loss-seed scaling with the
+    # grad_extra pmean for replicated leaves (advisor r4)
+    gain = jnp.asarray(rs.randn(S_pp, H) * 0.3 + 1.0, jnp.float32)
+    specs = {"up": P("pp", None, "mp"), "down": P("pp", "mp", None),
+             "gain": P("pp", None)}
     params = {
         "up": jax.device_put(up, NamedSharding(mesh, specs["up"])),
         "down": jax.device_put(down, NamedSharding(mesh, specs["down"])),
+        "gain": jax.device_put(gain, NamedSharding(mesh, specs["gain"])),
     }
 
     def stage_fn(p, shared, xx, sidx):
@@ -665,7 +672,7 @@ def test_compiled_1f1b_hybrid_tp_pp_param_specs():
         # with the 1/TP-degree factor the replicated scalar requires)
         h = jnp.tanh(xx @ p["up"])          # column-parallel: local cols
         part = h @ p["down"]                # row-parallel: partial sums
-        return xx + jax.lax.psum(part, "mp")
+        return xx + p["gain"] * jax.lax.psum(part, "mp")
 
     def loss_fn(out, label):
         return jnp.mean((out - label) ** 2)
@@ -678,16 +685,23 @@ def test_compiled_1f1b_hybrid_tp_pp_param_specs():
         for m in range(M):
             h = x[m]
             for s_i in range(S_pp):
-                h = h + jnp.tanh(h @ pr["up"][s_i]) @ pr["down"][s_i]
+                h = h + pr["gain"][s_i] \
+                    * (jnp.tanh(h @ pr["up"][s_i]) @ pr["down"][s_i])
             tot = tot + jnp.mean((h - y[m]) ** 2)
         return tot / M
 
-    rl, rg = jax.value_and_grad(ref)({"up": up, "down": down})
+    rl, rg = jax.value_and_grad(ref)(
+        {"up": up, "down": down, "gain": gain})
     np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(grads["up"]),
                                np.asarray(rg["up"]), rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(grads["down"]),
                                np.asarray(rg["down"]), rtol=1e-4,
                                atol=1e-5)
+    # the replicated leaf's grad must NOT be scaled by the TP degree
+    np.testing.assert_allclose(np.asarray(grads["gain"]),
+                               np.asarray(rg["gain"]), rtol=1e-4,
+                               atol=1e-5)
     # grads really are TP-sharded in the result
     assert "mp" in str(grads["up"].sharding.spec)
+    assert "mp" not in str(grads["gain"].sharding.spec)
