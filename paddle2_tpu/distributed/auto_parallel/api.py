@@ -157,17 +157,25 @@ def _resolve_partial(arr, mesh: ProcessMesh, placements, src_partial):
     # the scatter runs on the per-shard BLOCK inside shard_map, so the
     # check divides out any in_spec axes already sharding that dim
     in_entries = tuple(in_spec) + ((),) * (arr.ndim - len(in_spec))
+    dims_scattered: dict = {}
     for a, d in scatter.items():
+        dims_scattered.setdefault(d, []).append(a)
+    for d, axes in dims_scattered.items():
         e = in_entries[d]
         shard_axes = (e,) if isinstance(e, str) else tuple(e or ())
         local = arr.shape[d]
         for sa in shard_axes:
             local //= jm.shape[sa]
-        if local % jm.shape[a] != 0:
+        # ALL scatter axes targeting this dim split it jointly
+        factor = 1
+        for a in axes:
+            factor *= jm.shape[a]
+        if local % factor != 0:
             raise ValueError(
                 f"p_to_s reshard: dim {d} local extent {local} (global "
                 f"{arr.shape[d]} over {shard_axes or 'no axes'}) is not "
-                f"divisible by mesh axis {a!r} (size {jm.shape[a]})")
+                f"divisible by scatter axes {sorted(axes)} (total size "
+                f"{factor})")
 
     # key the cache on the mesh's identity-free description — id(jm) can
     # be reused after GC and would hand back a program bound to a dead

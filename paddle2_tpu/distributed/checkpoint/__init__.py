@@ -75,14 +75,30 @@ def _drain_pending(path: str) -> None:
         h._thread.join()
 
 
+def _parse_shard_name(fname: str):
+    """``data_{uid}_{rank}.pkl`` / ``shards_{uid}_{rank}.pkl`` →
+    (prefix, uid, rank), or (prefix, uid, None) for the pre-rank legacy
+    layout, or None for anything else. The ONE parser for the on-disk
+    naming scheme (sweep, ordering, and uid scan all go through it)."""
+    for prefix in ("data_", "shards_"):
+        if fname.startswith(prefix) and fname.endswith(".pkl"):
+            parts = fname[len(prefix):-4].split("_")
+            if len(parts) == 2 and parts[0].isdigit() \
+                    and parts[1].isdigit():
+                return prefix, int(parts[0]), int(parts[1])
+            if len(parts) == 1 and parts[0].isdigit():
+                return prefix, int(parts[0]), None
+            return prefix, None, None
+    return None
+
+
 def _next_uid(path: str) -> int:
     uid = 0
     try:
         for fname in os.listdir(path):
-            if fname.startswith("data_") and fname.endswith(".pkl"):
-                parts = fname[5:-4].split("_")
-                if parts and parts[0].isdigit():
-                    uid = max(uid, int(parts[0]) + 1)
+            parsed = _parse_shard_name(fname)
+            if parsed and parsed[0] == "data_" and parsed[1] is not None:
+                uid = max(uid, parsed[1] + 1)
     except FileNotFoundError:
         pass
     return uid
@@ -169,17 +185,21 @@ def _write_side_meta(path: str, uid: int, rank: int, meta) -> None:
     os.replace(side + ".tmp", side)
 
 
-def _merge_side_meta(tensors, scalars, side,
-                     keep_existing_scalars: bool = False) -> None:
-    """Merge one sidecar's tensors/scalars into the global metadata,
-    deduping shard bounds and skipping entries whose global_shape
-    disagrees with the committed one (a stale sidecar from a rank that
-    stopped saving must not corrupt the assembly)."""
+def _bounds_overlap(a, b) -> bool:
+    return all(lo1 < hi2 and lo2 < hi1
+               for (lo1, hi1), (lo2, hi2) in zip(a, b))
+
+
+def _merge_side_meta(tensors, scalars, side) -> None:
+    """Merge one sidecar's tensors/scalars into the global metadata.
+    Scalars: first writer wins — callers merge NEWEST sidecar first.
+    Tensors: skip entries whose global_shape disagrees with the committed
+    one, dedupe identical bounds, and DROP bounds that overlap an
+    already-merged shard non-identically (a stale sidecar from a rank
+    that resharded/departed must not overwrite newer data — legitimate
+    multi-rank shards are disjoint or identical)."""
     for key, val in side.get("scalars", {}).items():
-        if keep_existing_scalars:
-            scalars.setdefault(key, val)
-        else:
-            scalars[key] = val
+        scalars.setdefault(key, val)
     for key, info in side.get("tensors", {}).items():
         if key not in tensors:
             tensors[key] = dict(info, shards=list(info["shards"]))
@@ -187,11 +207,16 @@ def _merge_side_meta(tensors, scalars, side,
         cur = tensors[key]
         if tuple(info["global_shape"]) != tuple(cur["global_shape"]):
             continue                     # stale sidecar, different shape
-        seen_b = {tuple(s["bounds"]) for s in cur["shards"]}
+        seen_b = [tuple(tuple(b) for b in s["bounds"])
+                  for s in cur["shards"]]
         for s in info["shards"]:
-            if tuple(s["bounds"]) not in seen_b:
-                cur["shards"].append(s)
-                seen_b.add(tuple(s["bounds"]))
+            nb = tuple(tuple(b) for b in s["bounds"])
+            if nb in seen_b:
+                continue
+            if any(_bounds_overlap(nb, eb) for eb in seen_b):
+                continue                 # stale conflicting layout
+            cur["shards"].append(s)
+            seen_b.append(nb)
 
 
 def _write_phase(path: str, meta, data, data_file: str, rank: int,
@@ -221,16 +246,13 @@ def _write_phase(path: str, meta, data, data_file: str, rank: int,
         # these names, so no barrier is needed) — bounds directory and
         # load-cost growth across repeated saves
         for fname in os.listdir(path):
-            for prefix in ("data_", "shards_"):
-                if fname.startswith(prefix) and fname.endswith(
-                        f"_{rank}.pkl"):
-                    mid = fname[len(prefix):-4].split("_")
-                    if len(mid) == 2 and mid[0].isdigit() \
-                            and int(mid[0]) < uid:
-                        try:
-                            os.remove(os.path.join(path, fname))
-                        except OSError:
-                            pass
+            parsed = _parse_shard_name(fname)
+            if parsed and parsed[1] is not None and parsed[1] < uid \
+                    and parsed[2] == rank:
+                try:
+                    os.remove(os.path.join(path, fname))
+                except OSError:
+                    pass
         if rank == coordinator_rank:
             meta = dict(meta)
             meta.pop("files", None)      # load merges every data_*.pkl
@@ -262,8 +284,7 @@ def _write_phase(path: str, meta, data, data_file: str, rank: int,
                     continue
                 with open(os.path.join(path, fname), "rb") as f:
                     side_meta = pickle.load(f)
-                _merge_side_meta(merged, merged_scalars, side_meta,
-                                 keep_existing_scalars=True)
+                _merge_side_meta(merged, merged_scalars, side_meta)
             meta["tensors"] = merged
             meta["scalars"] = merged_scalars
     if rank == coordinator_rank:
@@ -393,20 +414,23 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
         # put data_10 before data_2); filename breaks ties
         # deterministically.
         def _uid_rank(fname):
-            parts = fname.split("_", 1)[1][:-4].split("_")
-            try:
-                return (tuple(int(p) for p in parts), fname)
-            except ValueError:
-                return ((0,), fname)
+            parsed = _parse_shard_name(fname)
+            uid = parsed[1] if parsed and parsed[1] is not None else -1
+            rk = parsed[2] if parsed and parsed[2] is not None else -1
+            return (uid, rk, fname)
         files = sorted((fname for fname in os.listdir(path)
                         if fname.startswith("data_")
                         and fname.endswith(".pkl")), key=_uid_rank)
         # launcher-mode sidecars carry the metadata of ranks the
         # coordinator could not barrier-wait for: merge their tensor
-        # bounds and scalars so rank-unique keys resolve
+        # bounds and scalars so rank-unique keys resolve. NEWEST first:
+        # _merge_side_meta keeps the first-seen scalar and drops
+        # overlapping stale bounds, so later (older) sidecars cannot
+        # overwrite fresher state.
         for fname in sorted((f for f in os.listdir(path)
                              if f.startswith("shards_")
-                             and f.endswith(".pkl")), key=_uid_rank):
+                             and f.endswith(".pkl")),
+                            key=_uid_rank, reverse=True):
             try:
                 with open(os.path.join(path, fname), "rb") as f:
                     side = pickle.load(f)

@@ -309,6 +309,42 @@ class TestGraphBreakCapture:
         finally:
             flags.set_flags({"FLAGS_max_program_cache_size": old})
 
+    def test_expensive_prefix_predicate_warns_once(self):
+        """r4 verdict #10: a value read AFTER heavy compute re-executes
+        the prefix every call (predicate + specialized program) — warn."""
+        def heavy(x):
+            h = x
+            for _ in range(4):
+                h = paddle.matmul(h, h)        # the expensive prefix
+            if h.mean() > 0:                   # read site after it
+                h = h * 2.0
+            return h.sum()
+
+        st = paddle.jit.to_static(heavy)
+        x = paddle.to_tensor(np.full((64, 64), 0.01, np.float32))
+        with pytest.warns(RuntimeWarning, match="re-executes"):
+            st(x)
+        # one-time: steady-state calls don't warn again
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("error", RuntimeWarning)
+            st(x)
+
+    def test_cheap_scalar_predicate_does_not_warn(self):
+        def cheap(x):
+            if x.mean() > 0:                   # read before the compute
+                x = x * 2.0
+            for _ in range(4):
+                x = paddle.matmul(x, x)
+            return x.sum()
+
+        st = paddle.jit.to_static(cheap)
+        x = paddle.to_tensor(np.full((64, 64), 0.01, np.float32))
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("error", RuntimeWarning)
+            st(x)
+
     def test_value_read_without_tracer_still_raises_outside(self):
         """Plain eager value reads keep working; train_step (no break
         controller) still raises loudly on traced reads."""
