@@ -256,6 +256,7 @@ def _write_phase(path: str, meta, data, data_file: str, rank: int,
         if rank == coordinator_rank:
             meta = dict(meta)
             meta.pop("files", None)      # load merges every data_*.pkl
+            meta["uid"] = uid            # lets load order it vs sidecars
             mtmp = os.path.join(path, _METADATA + ".tmp")
             with open(mtmp, "wb") as f:
                 pickle.dump(meta, f, protocol=4)
@@ -422,21 +423,27 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
                         if fname.startswith("data_")
                         and fname.endswith(".pkl")), key=_uid_rank)
         # launcher-mode sidecars carry the metadata of ranks the
-        # coordinator could not barrier-wait for: merge their tensor
-        # bounds and scalars so rank-unique keys resolve. NEWEST first:
-        # _merge_side_meta keeps the first-seen scalar and drops
-        # overlapping stale bounds, so later (older) sidecars cannot
-        # overwrite fresher state.
-        for fname in sorted((f for f in os.listdir(path)
-                             if f.startswith("shards_")
-                             and f.endswith(".pkl")),
-                            key=_uid_rank, reverse=True):
+        # coordinator could not barrier-wait for. Merge ALL sources —
+        # the committed metadata (under its recorded uid) AND every
+        # sidecar — strictly NEWEST first: _merge_side_meta keeps the
+        # first-seen scalar and drops overlapping stale bounds, so a
+        # coordinator that crashed before committing save N cannot pin
+        # save N-1 scalars onto save-N tensors.
+        sources = [((meta.get("uid", -1), -1, ""),
+                    {"tensors": meta["tensors"],
+                     "scalars": meta["scalars"]})]
+        for fname in (f for f in os.listdir(path)
+                      if f.startswith("shards_") and f.endswith(".pkl")):
             try:
                 with open(os.path.join(path, fname), "rb") as f:
-                    side = pickle.load(f)
+                    sources.append((_uid_rank(fname), pickle.load(f)))
             except (OSError, pickle.PickleError):
                 continue
-            _merge_side_meta(meta["tensors"], meta["scalars"], side)
+        tensors: Dict[str, Any] = {}
+        scalars: Dict[str, Any] = {}
+        for _, side in sorted(sources, key=lambda t: t[0], reverse=True):
+            _merge_side_meta(tensors, scalars, side)
+        meta["tensors"], meta["scalars"] = tensors, scalars
     data: Dict[Tuple[str, Tuple], np.ndarray] = {}
     for fname in files:
         try:

@@ -277,13 +277,23 @@ class TracedProgram:
             except Exception:
                 return None
 
-        full = _flops(full_jit, break_values)
-        if not full:
-            return
+        # retracing the FULL program just for cost analysis is expensive
+        # (it was already traced+compiled this call): only pay it when a
+        # predicate is heavy in ABSOLUTE terms (cheap scalar predicates —
+        # the common case — never trigger it), and cache the result
+        _HEAVY_PRED_FLOPS = 1e7
+        full = None
         for read_idx, p in new_preds:
             pf = _flops(p, ())    # pred bakes its own earlier answers
-            if pf is None:
+            if pf is None or pf < _HEAVY_PRED_FLOPS:
                 continue
+            if full is None:
+                full = getattr(self, "_full_flops", None)
+                if full is None:
+                    full = _flops(full_jit, break_values)
+                    self._full_flops = full
+            if not full:
+                return
             frac = pf / full
             if frac >= 0.1:
                 self._warned_pred_cost = True

@@ -51,14 +51,24 @@ def fake_quant(x: Tensor, scale, bits: int = 8, quant_axis=None) -> Tensor:
 
 
 class AbsmaxObserver(nn.Layer):
-    """PTQ observer: tracks running absmax (observer/abs_max.py parity)."""
+    """PTQ observer: tracks running absmax (observer/abs_max.py parity).
+
+    State lives in registered BUFFERS, so the moving average (a) stays
+    on device — no per-forward host sync (round-3 review), and (b)
+    records under ``jit.to_static`` tracing: buffer mutations thread
+    through the compiled program as extra outputs, exactly like
+    BatchNorm running stats (r4 verdict #8)."""
 
     def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
         super().__init__()
         self.quant_bits = quant_bits
         self.moving_rate = moving_rate
-        self._absmax = 0.0
-        self._seen = False
+        # non-persistable: pre-r5 checkpoints have no observer keys, and
+        # load_state_dict would refuse them otherwise
+        self.register_buffer("_absmax", Tensor(jnp.zeros((), jnp.float32)),
+                             persistable=False)
+        self.register_buffer("_seen", Tensor(jnp.zeros((), jnp.float32)),
+                             persistable=False)
         self._frozen = False
 
     def freeze(self):
@@ -66,67 +76,96 @@ class AbsmaxObserver(nn.Layer):
         self._frozen = True
 
     def forward(self, x: Tensor) -> Tensor:
-        if not (self._frozen or isinstance(x._data, jax.core.Tracer)):
-            # stays ON DEVICE: no per-forward host sync — calibration
-            # over a real dataset would otherwise serialize on D2H
-            # transfers (round-3 review). The value is fetched once in
-            # scale().
-            import jax.numpy as jnp
+        # record only in training mode (BatchNorm running-stat
+        # semantics): model.eval() before jit.save/export keeps the
+        # calibrated scale CONSTANT in the exported program instead of
+        # baking an input-dependent update into serving
+        if not self._frozen and self.training:
             cur = jnp.max(jnp.abs(x._data)).astype(jnp.float32)
-            if self._seen:
-                self._absmax = (self.moving_rate * self._absmax
-                                + (1 - self.moving_rate) * cur)
-            else:
-                self._absmax = cur
-                self._seen = True
+            prev, seen = self._absmax._data, self._seen._data
+            new = jnp.where(seen > 0,
+                            self.moving_rate * prev
+                            + (1 - self.moving_rate) * cur, cur)
+            self._absmax._replace_data(new)
+            self._seen._replace_data(jnp.ones((), jnp.float32))
         return x
 
     def raw_scale(self):
-        """Device-resident scale (jnp scalar or python float) — the QAT
-        fake-quant path consumes this so an eager training step never
-        blocks on a D2H sync."""
-        return self._absmax if self._seen else 1.0
+        """Device-resident scale (jnp scalar) — the QAT fake-quant path
+        consumes this so an eager training step never blocks on D2H."""
+        return jnp.where(self._seen._data > 0, self._absmax._data, 1.0)
 
     def scale(self) -> float:
-        if not self._seen:
-            return 1.0
-        if not isinstance(self._absmax, float):
-            self._absmax = float(self._absmax)    # one sync at read time
-        return self._absmax
+        return float(self.raw_scale())       # one sync at read time
 
 
 class ChannelWiseAbsMaxObserver(nn.Layer):
     """Per-channel PTQ observer (observer/abs_max_weight.py parity):
-    tracks absmax along every channel of `quant_axis`."""
+    tracks absmax along every channel of `quant_axis`.
+
+    Buffer-backed and fully on device like :class:`AbsmaxObserver` — the
+    per-forward reduction is a jnp op (no ``.numpy()`` host sync), and
+    calibration records under tracing. ``channels`` (the extent of
+    ``quant_axis``) sizes the buffer at construction; if omitted it is
+    created lazily on the first EAGER forward — a first call under
+    tracing would lose the update, so that case warns."""
 
     def __init__(self, quant_bits: int = 8, quant_axis: int = -1,
-                 moving_rate: float = 0.9):
+                 moving_rate: float = 0.9, channels: Optional[int] = None):
         super().__init__()
         self.quant_bits = quant_bits
         self.quant_axis = quant_axis
         self.moving_rate = moving_rate
-        self._absmax = None
         self._frozen = False
+        if channels is not None:
+            self._make_buffers(channels)
+
+    def _make_buffers(self, channels: int):
+        self.register_buffer(
+            "_absmax", Tensor(jnp.zeros((channels,), jnp.float32)),
+            persistable=False)
+        self.register_buffer("_seen", Tensor(jnp.zeros((), jnp.float32)),
+                             persistable=False)
 
     def freeze(self):
         self._frozen = True
 
     def forward(self, x: Tensor) -> Tensor:
-        import numpy as np
-        if self._frozen or isinstance(x._data, jax.core.Tracer):
+        if self._frozen or not self.training:
             return x
         axis = self.quant_axis % x.ndim
+        if not hasattr(self, "_absmax"):
+            if isinstance(x._data, jax.core.Tracer):
+                import warnings
+                warnings.warn(
+                    "ChannelWiseAbsMaxObserver: first forward is inside "
+                    "a traced program but the channel buffer does not "
+                    "exist yet, so this update cannot be recorded. Pass "
+                    "channels= at construction or run one eager forward "
+                    "first.", RuntimeWarning, stacklevel=2)
+                return x
+            self._make_buffers(int(x.shape[axis]))
         red = tuple(i for i in range(x.ndim) if i != axis)
-        cur = np.abs(np.asarray(x.numpy())).max(axis=red)
-        if self._absmax is None:
-            self._absmax = cur
-        else:
-            self._absmax = (self.moving_rate * self._absmax
-                            + (1 - self.moving_rate) * cur)
+        cur = jnp.max(jnp.abs(x._data), axis=red).astype(jnp.float32)
+        prev, seen = self._absmax._data, self._seen._data
+        new = jnp.where(seen > 0,
+                        self.moving_rate * prev
+                        + (1 - self.moving_rate) * cur, cur)
+        self._absmax._replace_data(new)
+        self._seen._replace_data(jnp.ones((), jnp.float32))
         return x
 
+    def raw_scale(self):
+        """Device-resident per-channel scales (jnp array)."""
+        if not hasattr(self, "_absmax"):
+            return jnp.ones((), jnp.float32)
+        return jnp.where(self._seen._data > 0, self._absmax._data, 1.0)
+
     def scale(self):
-        return self._absmax if self._absmax is not None else 1.0
+        import numpy as np
+        if not hasattr(self, "_absmax"):
+            return 1.0
+        return np.asarray(self.raw_scale())  # one sync at read time
 
 
 class FakeQuanterWithAbsMaxObserver(nn.Layer):
@@ -150,21 +189,23 @@ class FakeQuanterChannelWiseAbsMaxObserver(nn.Layer):
     weight quantization."""
 
     def __init__(self, quant_bits: int = 8, quant_axis: int = 0,
-                 moving_rate: float = 0.9, dtype="float32", name=None):
+                 moving_rate: float = 0.9, dtype="float32", name=None,
+                 channels: Optional[int] = None):
         # reference default quant_axis=0 (the OUTPUT channel of a Conv2D
         # weight [out,in,kh,kw]); Linear weights [in,out] need axis 1 —
-        # _QuantedWrapper passes the right axis per layer type
+        # _QuantedWrapper passes the right axis + channel count per
+        # layer type
         super().__init__()
         self.observer = ChannelWiseAbsMaxObserver(quant_bits, quant_axis,
-                                                  moving_rate)
+                                                  moving_rate,
+                                                  channels=channels)
         self.quant_bits = quant_bits
         self.quant_axis = quant_axis
 
     def forward(self, x: Tensor) -> Tensor:
         self.observer(x)
-        s = self.observer.scale()
         axis = self.quant_axis % x.ndim
-        return fake_quant(x, jnp.asarray(s), self.quant_bits,
+        return fake_quant(x, self.observer.raw_scale(), self.quant_bits,
                           quant_axis=axis)
 
 
@@ -199,7 +240,8 @@ class _QuantedWrapper(nn.Layer):
             if issubclass(w_quanter, FakeQuanterChannelWiseAbsMaxObserver):
                 # output channel: axis 1 for Linear [in,out], 0 for Conv2D
                 axis = 1 if isinstance(inner, nn.Linear) else 0
-                w_quanter = w_quanter(quant_axis=axis)
+                channels = int(inner.weight.shape[axis])
+                w_quanter = w_quanter(quant_axis=axis, channels=channels)
             else:
                 w_quanter = w_quanter()
         self.w_quanter = w_quanter

@@ -53,6 +53,63 @@ def test_qat_channelwise_weight_quanter_trains():
         assert p.grad is not None
 
 
+def test_observers_record_under_to_static(recwarn):
+    """r4 verdict #8: calibration inside a COMPILED program must update
+    the observer scales — observer state is buffer-backed and threads
+    through jit.to_static like BatchNorm running stats."""
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 4))
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                      weight=FakeQuanterChannelWiseAbsMaxObserver)
+    QAT(cfg).quantize(m)
+    from paddle2_tpu.quantization import _QuantedWrapper
+    wrapper = next(l for _, l in m.named_sublayers()
+                   if isinstance(l, _QuantedWrapper))
+    st = paddle.jit.to_static(wrapper)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor((rs.randn(4, 8) * 3).astype(np.float32))
+    st(x)
+    act_obs = wrapper.act_quanter.observer
+    w_obs = wrapper.w_quanter.observer
+    assert float(act_obs.scale()) > 1.5          # saw |x| stats
+    w_scale = np.asarray(w_obs.scale())
+    assert w_scale.shape == (4,)                 # per OUTPUT channel
+    assert (w_scale > 0).all() and not np.allclose(w_scale, 1.0)
+    # repeated compiled calls keep updating the moving average
+    x2 = paddle.to_tensor((rs.randn(4, 8) * 30).astype(np.float32))
+    st(x2)
+    assert float(act_obs.scale()) > 4.0
+    # eval() stops recording (export must bake a CONSTANT scale)
+    wrapper.eval()
+    frozen = float(act_obs.scale())
+    st(paddle.to_tensor((rs.randn(4, 8) * 1000).astype(np.float32)))
+    assert float(act_obs.scale()) == frozen
+    # observer state is non-persistable: pre-r5 checkpoints stay loadable
+    assert not any("_absmax" in k or "_seen" in k
+                   for k in wrapper.state_dict())
+
+
+def test_channelwise_observer_stays_on_device():
+    """The per-forward reduction must be a jnp op on the device buffer —
+    no host .numpy() sync per calibration step (r4 weak #3)."""
+    import jax.numpy as jnp
+    obs = ChannelWiseAbsMaxObserver(quant_axis=1, channels=2)
+    obs(paddle.to_tensor(np.array([[1.0, -5.0], [-2.0, 3.0]], np.float32)))
+    assert isinstance(obs._absmax._data, jnp.ndarray)
+    np.testing.assert_allclose(np.asarray(obs.scale()), [2.0, 5.0])
+
+
+def test_channelwise_lazy_buffer_under_trace_warns():
+    obs = ChannelWiseAbsMaxObserver(quant_axis=1)    # no channels
+
+    def fn(x):
+        return obs(x) * 2.0
+
+    st = paddle.jit.to_static(fn)
+    with pytest.warns(RuntimeWarning, match="cannot be recorded"):
+        st(paddle.to_tensor(np.ones((2, 3), np.float32)))
+
+
 def test_ptq_convert_produces_int8_linear_close_to_fp():
     paddle.seed(0)
     rs = np.random.RandomState(0)

@@ -321,7 +321,7 @@ class TestGraphBreakCapture:
             return h.sum()
 
         st = paddle.jit.to_static(heavy)
-        x = paddle.to_tensor(np.full((64, 64), 0.01, np.float32))
+        x = paddle.to_tensor(np.full((160, 160), 0.005, np.float32))
         with pytest.warns(RuntimeWarning, match="re-executes"):
             st(x)
         # one-time: steady-state calls don't warn again
@@ -339,7 +339,7 @@ class TestGraphBreakCapture:
             return x.sum()
 
         st = paddle.jit.to_static(cheap)
-        x = paddle.to_tensor(np.full((64, 64), 0.01, np.float32))
+        x = paddle.to_tensor(np.full((160, 160), 0.005, np.float32))
         import warnings as _w
         with _w.catch_warnings():
             _w.simplefilter("error", RuntimeWarning)
