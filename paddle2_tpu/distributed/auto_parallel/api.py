@@ -567,15 +567,37 @@ class DistModel:
             self._pp_stages = None  # built lazily on first train call
 
         opt = optimizer
+        self._zero_pp_axis = None
         if opt is not None and st.sharding.enable:
-            if self._pp_enabled:
-                raise NotImplementedError(
-                    "Strategy: sharding + pipeline in one DistModel is "
-                    "not implemented; shard within stages via mesh axes")
             from ..sharding import ShardedOptimizer
             stage = int(st.sharding.stage)
+            if self._pp_enabled:
+                # ZeRO-1/2 over the dp axis of a dp×pp mesh, composed
+                # with the compiled 1F1B (reference topology.py:195-199 —
+                # the sharding axis coexists with pipe; r4 verdict #5).
+                # Stage 3 would need per-stage param gathers INSIDE the
+                # pipeline scan: not supported.
+                if stage not in (1, 2):
+                    raise NotImplementedError(
+                        "Strategy: sharding.stage=3 (p_g_os) cannot "
+                        "compose with Strategy.pipeline; use stage 1/2 "
+                        "or group_sharded_parallel without a pipeline")
+                if self._pp_mode != "1F1B":
+                    raise NotImplementedError(
+                        "Strategy: sharding + pipeline runs on the "
+                        "compiled 1F1B schedule; set "
+                        "pipeline.schedule_mode='1F1B'")
             level = {1: "os", 2: "os_g", 3: "p_g_os"}.get(stage, "os")
             opt = ShardedOptimizer(opt, level=level)
+            if self._pp_enabled:
+                if opt._axis == "pp":
+                    raise NotImplementedError(
+                        "Strategy: sharding + pipeline needs a mesh "
+                        "with a 'dp' or 'sharding' axis distinct from "
+                        "'pp' (e.g. init_mesh({'pp': S, 'dp': D})); the "
+                        "current mesh offers only the pipeline axis to "
+                        "shard over")
+                self._zero_pp_axis = opt._axis
         self._optimizer = opt
         k = int(st.gradient_merge.k_steps) \
             if st.gradient_merge.enable else 1
@@ -831,6 +853,14 @@ class DistModel:
             raise ValueError(
                 f"batch {x.shape[0]} not divisible by accumulate_steps "
                 f"{M}")
+        if self._zero_pp_axis is not None:
+            from .. import mesh as mesh_mod0
+            D = int(mesh_mod0.get_mesh().shape[self._zero_pp_axis])
+            if (x.shape[0] // M) % D != 0:
+                raise ValueError(
+                    f"microbatch size {x.shape[0] // M} not divisible "
+                    f"by {self._zero_pp_axis!r} degree {D} (batch "
+                    f"{x.shape[0]}, accumulate_steps {M})")
         x_micro = x._data.reshape((M, x.shape[0] // M) + tuple(x.shape[1:]))
         l_micro = label._data.reshape(
             (M, label.shape[0] // M) + tuple(label.shape[1:]))
@@ -855,12 +885,17 @@ class DistModel:
              for i in range(len(per[0]))]
             for j in range(k)
         ]
-        x_micro = jax.device_put(x_micro, repl)
-        l_micro = jax.device_put(l_micro, repl)
+        # ZeRO+PP: microbatches shard their batch dim over the sharding/
+        # dp axis; the compiled program dp-means loss and grads
+        data_sh = repl if self._zero_pp_axis is None else NamedSharding(
+            jm, PartitionSpec(None, self._zero_pp_axis))
+        x_micro = jax.device_put(x_micro, data_sh)
+        l_micro = jax.device_put(l_micro, data_sh)
 
         if self._pp_mode == "1F1B":
             loss, grads = pipeline_spmd_1f1b(stage_fn, stacked, x_micro,
-                                             l_micro, loss_fn)
+                                             l_micro, loss_fn,
+                                             dp_axis=self._zero_pp_axis)
         else:                                    # GPIPE / FTHENB
             loss, grads = self._pp_gpipe_step(stacked, x_micro, l_micro)
         # write grads back per block (unstack the stage axis) and step

@@ -429,7 +429,13 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
         # first-seen scalar and drops overlapping stale bounds, so a
         # coordinator that crashed before committing save N cannot pin
         # save N-1 scalars onto save-N tensors.
-        sources = [((meta.get("uid", -1), -1, ""),
+        # pre-upgrade metadata has no uid: rank it NEWEST, not oldest —
+        # it is the committed state, and a leftover sidecar from some
+        # older save must not override its scalars
+        meta_uid = meta.get("uid")
+        if meta_uid is None:
+            meta_uid = float("inf")
+        sources = [((meta_uid, -1, ""),
                     {"tensors": meta["tensors"],
                      "scalars": meta["scalars"]})]
         for fname in (f for f in os.listdir(path)

@@ -147,14 +147,30 @@ _PIPE_CACHE: Dict[Tuple, Any] = {}
 # recompute-1F1B), so only inputs are buffered.
 
 def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
-              n_stages, n_micro, axis, tp_axes=(), grad_extra=None):
-    # pvary over the pipeline axis PLUS any TP axes the param specs name:
-    # a hybrid-TP stage_fn (psum over 'mp') makes some switch-branch
+              n_stages, n_micro, axis, tp_axes=(), grad_extra=None,
+              dp_axis=None):
+    # pvary over the pipeline axis PLUS any TP axes the param specs name
+    # PLUS the data-parallel axis when batches are dp-sharded: a
+    # hybrid-TP stage_fn (psum over 'mp') makes some switch-branch
     # outputs mp-varying, and lax.switch requires identical vma types
-    vaxes = (axis,) + tuple(tp_axes)
+    vaxes = (axis,) + tuple(tp_axes) + ((dp_axis,) if dp_axis else ())
+
+    def _vary(v):
+        """pvary only the axes v is not ALREADY varying over (dp-sharded
+        inputs arrive dp-varying; pvary rejects redundant axes)."""
+        cur = getattr(jax.typeof(v), "vma", frozenset())
+        missing = tuple(a for a in vaxes if a not in cur)
+        return jax.lax.pvary(v, missing) if missing else v
+
     tp_scale = 1.0
     for a in tp_axes:
         tp_scale = tp_scale / jax.lax.axis_size(a)
+    if dp_axis is not None:
+        # params are dp-INVARIANT while data is dp-varying: the vjp
+        # auto-inserts a dp-psum into their cotangents (pvary transpose),
+        # so seed each dp shard with 1/D to make that psum the dp-MEAN
+        # of the per-shard grads — the reference's averaged allreduce
+        tp_scale = tp_scale / jax.lax.axis_size(dp_axis)
     s = jax.lax.axis_index(axis)
     S, M = n_stages, n_micro
     T = 2 * (M + S) - 2           # last op: B_{M-1} at stage 0, t = 2S+2M-3
@@ -175,7 +191,7 @@ def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
         x_buf, grads, act_in, ct_in, losses, pend = carry
         # all switch branches must agree on varying-manual-axes types:
         # zeros emitted by idle/fwd/bwd are explicitly device-varying
-        vzero = jax.lax.pvary(zero, vaxes)
+        vzero = _vary(zero)
         d = t - s
         # op selection per the closed forms above
         warm_f = (0 <= d) & (d < jnp.minimum(S - s, M)) & (t < S)
@@ -217,7 +233,7 @@ def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
             # more axes than the pipeline axis)
             dlo = jnp.where(is_last, (1.0 / M) * tp_scale,
                             0.0).astype(lo.dtype)
-            dlo = dlo + jax.lax.pvary(jnp.zeros((), lo.dtype), vaxes)
+            dlo = dlo + _vary(jnp.zeros((), lo.dtype))
             dy = jnp.where(is_last, jnp.zeros_like(ct_in), ct_in)
             dp, dx = vjp((dlo, dy))
             grads = jax.tree_util.tree_map(
@@ -239,15 +255,12 @@ def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
         ct_next = jax.lax.ppermute(dx_out, axis, perm_bwd)
         return (x_buf, grads, act_next, ct_next, losses, pend), None
 
-    def _varying(v):
-        return jax.lax.pvary(v, vaxes)
-
     x_buf0 = jnp.zeros((BUF,) + zero.shape, zero.dtype)
     losses0 = jnp.zeros((M,), jnp.float32)
-    carry0 = (_varying(x_buf0),
-              jax.tree_util.tree_map(_varying, g0),
-              _varying(zero), _varying(zero), _varying(losses0),
-              _varying(zero))
+    carry0 = (_vary(x_buf0),
+              jax.tree_util.tree_map(_vary, g0),
+              _vary(zero), _vary(zero), _vary(losses0),
+              _vary(zero))
     (x_buf, grads, _, _, losses, _p), _ = jax.lax.scan(
         tick, carry0, jnp.arange(T))
     # losses live on the last stage, grads on their own stage: reduce the
@@ -268,6 +281,14 @@ def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
         grads = jax.tree_util.tree_map(
             _unvary, grads, grad_extra,
             is_leaf=lambda x: isinstance(x, jnp.ndarray))
+    if dp_axis is not None:
+        # each dp shard holds the local-mean losses; the global loss is
+        # their dp-mean. Grads are already the dp-mean via the scaled
+        # seed + auto-psum above — the pmean only claims the (equal-
+        # valued) dp invariance for the out_specs.
+        losses = jax.lax.pmean(losses, dp_axis)
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, dp_axis), grads)
     grads = jax.tree_util.tree_map(lambda g: g[None], grads)
     return jnp.sum(losses) / M, grads
 
@@ -437,7 +458,8 @@ def pipeline_spmd_vpp(stage_fn: Callable, stacked_params, x_micro,
 
 def pipeline_spmd_1f1b(stage_fn: Callable, stacked_params, x_micro,
                        labels_micro, loss_fn: Callable, shared_params=None,
-                       mesh_axis: str = "pp", param_specs=None):
+                       mesh_axis: str = "pp", param_specs=None,
+                       dp_axis: str = None):
     """Compiled 1F1B: mean loss + stacked parameter grads in ONE program.
 
     stage_fn(stage_params, shared_params, x, stage_idx) -> y. Stage
@@ -455,12 +477,29 @@ def pipeline_spmd_1f1b(stage_fn: Callable, stacked_params, x_micro,
     and reduces with ``jax.lax.psum(..., 'mp')`` — the mp_layers
     semantics inside the compiled pipeline. Each spec's first axis must
     be ``mesh_axis``.
+
+    ``dp_axis`` composes data parallelism (and therefore ZeRO sharding
+    of the optimizer states over that axis — reference
+    fleet/base/topology.py: the sharding axis coexists with pipe):
+    microbatches shard their batch dim over ``dp_axis``, each dp shard
+    pipelines its sub-batch, and the returned loss/grads are dp-means —
+    the grad all-reduce over the dp group, fused into the same program.
     """
     mesh = mesh_mod.get_mesh()
     S = int(mesh.shape[mesh_axis])
     M = int(x_micro.shape[0])
     if shared_params is None:
         shared_params = ()
+    if dp_axis is not None:
+        if dp_axis not in mesh.shape or dp_axis == mesh_axis:
+            raise ValueError(
+                f"dp_axis {dp_axis!r} must name a mesh axis distinct "
+                f"from {mesh_axis!r}; mesh has {tuple(mesh.shape)}")
+        D = int(mesh.shape[dp_axis])
+        if x_micro.shape[1] % D != 0:
+            raise ValueError(
+                f"microbatch size {x_micro.shape[1]} not divisible by "
+                f"{dp_axis!r} degree {D}")
     for leaf in jax.tree_util.tree_leaves(stacked_params):
         if leaf.shape[0] != S:
             raise ValueError(
@@ -481,7 +520,7 @@ def pipeline_spmd_1f1b(stage_fn: Callable, stacked_params, x_micro,
         str(s) for s in jax.tree_util.tree_leaves(
             param_specs, is_leaf=lambda x: isinstance(x, P)))
     key = ("1f1b", id(mesh), mesh_axis, stage_fn, loss_fn, treedef, avals,
-           tuple(x_micro.shape), str(x_micro.dtype), spec_key)
+           tuple(x_micro.shape), str(x_micro.dtype), spec_key, dp_axis)
     fn = _PIPE_CACHE.get(key)
     if fn is None:
         if param_specs is None:
@@ -505,10 +544,12 @@ def pipeline_spmd_1f1b(stage_fn: Callable, stacked_params, x_micro,
             param_specs, is_leaf=lambda x: isinstance(x, P))
         body = partial(_f1b_body, stage_fn=stage_fn, loss_fn=loss_fn,
                        n_stages=S, n_micro=M, axis=mesh_axis,
-                       tp_axes=tp_axes, grad_extra=grad_extra)
+                       tp_axes=tp_axes, grad_extra=grad_extra,
+                       dp_axis=dp_axis)
+        data_spec = P() if dp_axis is None else P(None, dp_axis)
         fn = jax.jit(shard_map(
             body, mesh=mesh,
-            in_specs=(param_specs, shared_specs, P(), P()),
+            in_specs=(param_specs, shared_specs, data_spec, data_spec),
             out_specs=(P(), param_specs)))
         _PIPE_CACHE[key] = fn
     loss, grads = fn(stacked_params, shared_params, x_micro, labels_micro)

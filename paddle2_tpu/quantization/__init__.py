@@ -69,25 +69,36 @@ class AbsmaxObserver(nn.Layer):
                              persistable=False)
         self.register_buffer("_seen", Tensor(jnp.zeros((), jnp.float32)),
                              persistable=False)
+        # frozen-ness is a BUFFER, not a python flag alone: a compiled
+        # program traced before freeze() must stop updating afterwards
+        # without retracing
+        self.register_buffer("_frozen_buf",
+                             Tensor(jnp.zeros((), jnp.float32)),
+                             persistable=False)
         self._frozen = False
 
     def freeze(self):
         """Stop scale updates (PTQ.convert 'freeze' semantics)."""
         self._frozen = True
+        self._frozen_buf._replace_data(jnp.ones((), jnp.float32))
 
     def forward(self, x: Tensor) -> Tensor:
-        # record only in training mode (BatchNorm running-stat
-        # semantics): model.eval() before jit.save/export keeps the
-        # calibrated scale CONSTANT in the exported program instead of
-        # baking an input-dependent update into serving
-        if not self._frozen and self.training:
-            cur = jnp.max(jnp.abs(x._data)).astype(jnp.float32)
-            prev, seen = self._absmax._data, self._seen._data
-            new = jnp.where(seen > 0,
-                            self.moving_rate * prev
-                            + (1 - self.moving_rate) * cur, cur)
-            self._absmax._replace_data(new)
-            self._seen._replace_data(jnp.ones((), jnp.float32))
+        # record until frozen, in train AND eval (reference observer
+        # semantics — the standard PTQ recipe calibrates under eval()).
+        # Call freeze() / PTQ.convert() before jit.save: exporting an
+        # UNFROZEN observer bakes the scale update into the serving
+        # program, making its output drift with input statistics.
+        if self._frozen and not isinstance(x._data, jax.core.Tracer):
+            return x
+        cur = jnp.max(jnp.abs(x._data)).astype(jnp.float32)
+        prev, seen = self._absmax._data, self._seen._data
+        frozen = self._frozen_buf._data > 0
+        new = jnp.where(seen > 0,
+                        self.moving_rate * prev
+                        + (1 - self.moving_rate) * cur, cur)
+        self._absmax._replace_data(jnp.where(frozen, prev, new))
+        self._seen._replace_data(
+            jnp.where(frozen, seen, jnp.ones((), jnp.float32)))
         return x
 
     def raw_scale(self):
@@ -126,12 +137,18 @@ class ChannelWiseAbsMaxObserver(nn.Layer):
             persistable=False)
         self.register_buffer("_seen", Tensor(jnp.zeros((), jnp.float32)),
                              persistable=False)
+        self.register_buffer("_frozen_buf",
+                             Tensor(jnp.zeros((), jnp.float32)),
+                             persistable=False)
 
     def freeze(self):
         self._frozen = True
+        if hasattr(self, "_frozen_buf"):
+            self._frozen_buf._replace_data(jnp.ones((), jnp.float32))
 
     def forward(self, x: Tensor) -> Tensor:
-        if self._frozen or not self.training:
+        # records in train AND eval until frozen (AbsmaxObserver.forward)
+        if self._frozen and not isinstance(x._data, jax.core.Tracer):
             return x
         axis = self.quant_axis % x.ndim
         if not hasattr(self, "_absmax"):
@@ -148,11 +165,13 @@ class ChannelWiseAbsMaxObserver(nn.Layer):
         red = tuple(i for i in range(x.ndim) if i != axis)
         cur = jnp.max(jnp.abs(x._data), axis=red).astype(jnp.float32)
         prev, seen = self._absmax._data, self._seen._data
+        frozen = self._frozen_buf._data > 0
         new = jnp.where(seen > 0,
                         self.moving_rate * prev
                         + (1 - self.moving_rate) * cur, cur)
-        self._absmax._replace_data(new)
-        self._seen._replace_data(jnp.ones((), jnp.float32))
+        self._absmax._replace_data(jnp.where(frozen, prev, new))
+        self._seen._replace_data(
+            jnp.where(frozen, seen, jnp.ones((), jnp.float32)))
         return x
 
     def raw_scale(self):

@@ -728,6 +728,67 @@ class TestStrategyPasses:
         np.testing.assert_allclose(l1, l2, rtol=1e-5)
         np.testing.assert_allclose(w1, w2, rtol=1e-4, atol=1e-6)
 
+    def test_zero_sharding_composes_with_compiled_pipeline(self):
+        """r4 verdict #5: Strategy sharding(stage 2) + pipeline(1F1B) on
+        a dp×pp mesh — optimizer states shard over dp, microbatches
+        shard over dp, training matches the plain eager reference."""
+        import jax
+        import paddle2_tpu.optimizer as opt
+        import paddle2_tpu.distributed as pdist
+        pdist.init_mesh({"pp": 4, "dp": 2})
+
+        def build():
+            paddle.seed(7)
+            return nn.Sequential(*[nn.Linear(8, 8) for _ in range(4)])
+
+        rs = np.random.RandomState(2)
+        xs = [rs.randn(8, 8).astype(np.float32) for _ in range(3)]
+
+        def run(zero_pp):
+            m = build()
+            o = opt.Adam(learning_rate=0.01, parameters=m.parameters())
+            cfg = {"sharding": {"enable": True, "stage": 2},
+                   "pipeline": {"enable": True, "schedule_mode": "1F1B",
+                                "accumulate_steps": 4}} if zero_pp else {}
+            model = dist.to_static(m, None, nn.MSELoss(), o,
+                                   dist.Strategy(cfg))
+            losses = []
+            for x_np in xs:
+                x = paddle.to_tensor(x_np)
+                y = paddle.zeros([8, 8])
+                losses.append(float(np.asarray(model(x, y)._data)))
+            return losses, m[0].weight.numpy(), model._optimizer
+
+        lz, wz, oz = run(True)
+        le, we, _ = run(False)
+        np.testing.assert_allclose(lz, le, rtol=2e-4)
+        np.testing.assert_allclose(wz, we, rtol=1e-3, atol=1e-5)
+        # optimizer states really are ZeRO-sharded over dp
+        from paddle2_tpu.distributed.sharding import ShardedOptimizer
+        inner = oz
+        while not hasattr(inner, "_states"):
+            inner = inner._inner
+        specs = [str(a.sharding.spec)
+                 for st in inner._states.values()
+                 for a in jax.tree_util.tree_leaves(st)
+                 if hasattr(a, "sharding")
+                 and hasattr(a.sharding, "spec")]
+        assert any("dp" in s for s in specs), specs
+
+    def test_zero3_plus_pipeline_raises(self):
+        import paddle2_tpu.optimizer as opt
+        import paddle2_tpu.distributed as pdist
+        pdist.init_mesh({"pp": 4, "dp": 2})
+        paddle.seed(0)
+        m = nn.Sequential(*[nn.Linear(8, 8) for _ in range(4)])
+        o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+        with pytest.raises(NotImplementedError, match="stage=3"):
+            dist.to_static(
+                m, None, nn.MSELoss(), o,
+                dist.Strategy({"sharding": {"enable": True, "stage": 3},
+                               "pipeline": {"enable": True,
+                                            "accumulate_steps": 4}}))
+
     def test_pipeline_rejects_heterogeneous_blocks(self):
         import paddle2_tpu.optimizer as opt
         import paddle2_tpu.distributed as pdist

@@ -633,6 +633,52 @@ class TestCompiledVPP:
         assert vpp_mem < naive_mem, (vpp_mem, naive_mem)
 
 
+def test_compiled_1f1b_dp_sharded_batches_parity():
+    """pipeline_spmd_1f1b(dp_axis=...): microbatches shard over 'dp',
+    loss/grads come back as dp-means — must equal the dense sequential
+    reference on the full batch (ZeRO+PP composition, r4 verdict #5)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle2_tpu.distributed as dist
+    from paddle2_tpu.distributed.fleet.spmd_pipeline import (
+        pipeline_spmd_1f1b)
+
+    dist.init_mesh({"pp": 4, "dp": 2})
+    S_pp, M, B, H = 4, 4, 4, 8           # B=4 splits 2-way over dp
+    rs = np.random.RandomState(0)
+    W = jnp.asarray(rs.randn(S_pp, H, H) * 0.3, jnp.float32)
+    b = jnp.asarray(rs.randn(S_pp, H) * 0.3, jnp.float32)
+    x = jnp.asarray(rs.randn(M, B, H), jnp.float32)
+    y = jnp.asarray(rs.randn(M, B, H), jnp.float32)
+
+    def stage_fn(p, shared, xx, sidx):
+        w, bb = p
+        return jnp.tanh(xx @ w + bb)
+
+    def loss_fn(out, label):
+        return jnp.mean((out - label) ** 2)
+
+    loss, grads = pipeline_spmd_1f1b(stage_fn, (W, b), x, y, loss_fn,
+                                     dp_axis="dp")
+
+    def ref(params):
+        Wr, br = params
+        tot = 0.0
+        for m in range(M):
+            h = x[m]
+            for s_i in range(S_pp):
+                h = jnp.tanh(h @ Wr[s_i] + br[s_i])
+            tot = tot + jnp.mean((h - y[m]) ** 2)
+        return tot / M
+
+    rl, rg = jax.value_and_grad(ref)((W, b))
+    np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads[0]), np.asarray(rg[0]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads[1]), np.asarray(rg[1]),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_compiled_1f1b_hybrid_tp_pp_param_specs():
     """pipeline_spmd_1f1b param_specs: TP weight dims sharded over 'mp'
     inside the compiled pipeline (column/row-parallel + psum) must match

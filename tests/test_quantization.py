@@ -79,10 +79,15 @@ def test_observers_record_under_to_static(recwarn):
     x2 = paddle.to_tensor((rs.randn(4, 8) * 30).astype(np.float32))
     st(x2)
     assert float(act_obs.scale()) > 4.0
-    # eval() stops recording (export must bake a CONSTANT scale)
+    # eval() still records (the standard PTQ recipe calibrates in eval);
+    # freeze() stops it (what PTQ.convert calls before export)
     wrapper.eval()
-    frozen = float(act_obs.scale())
+    before = float(act_obs.scale())
     st(paddle.to_tensor((rs.randn(4, 8) * 1000).astype(np.float32)))
+    assert float(act_obs.scale()) > before
+    act_obs.freeze()
+    frozen = float(act_obs.scale())
+    st(paddle.to_tensor((rs.randn(4, 8) * 5000).astype(np.float32)))
     assert float(act_obs.scale()) == frozen
     # observer state is non-persistable: pre-r5 checkpoints stay loadable
     assert not any("_absmax" in k or "_seen" in k
