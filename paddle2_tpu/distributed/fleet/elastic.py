@@ -45,6 +45,11 @@ class ElasticManager:
         self.world = get_world_size()
         os.makedirs(self.store_dir, exist_ok=True)
         self._last_beat = 0.0
+        # scale-up detection only trusts heartbeats WRITTEN AFTER this
+        # manager started: leftover rank_N.hb files from a previous
+        # larger run must not restart-thrash the smaller job until they
+        # expire
+        self._started = time.time()
 
     def _path(self, rank: int) -> str:
         return os.path.join(self.store_dir, f"rank_{rank}.hb")
@@ -58,7 +63,7 @@ class ElasticManager:
                        "world": self.world}, f)
         self._last_beat = now
 
-    def alive_ranks(self) -> List[int]:
+    def _alive_entries(self) -> List[dict]:
         now = time.time()
         out = []
         for fname in os.listdir(self.store_dir):
@@ -68,20 +73,34 @@ class ElasticManager:
                 with open(os.path.join(self.store_dir, fname)) as f:
                     d = json.load(f)
                 if now - d["ts"] <= self.dead_after:
-                    out.append(int(d["rank"]))
+                    out.append(d)
             except Exception:
                 continue
-        return sorted(out)
+        return out
+
+    def alive_ranks(self) -> List[int]:
+        return sorted(int(d["rank"]) for d in self._alive_entries())
 
     def world_changed(self) -> bool:
         return len(self.alive_ranks()) != self.world
 
     def watch(self) -> str:
-        """One poll of the reference manager's watch loop."""
+        """One poll of the reference manager's watch loop. MORE alive
+        ranks than the current world is a scale-UP event (a node
+        rejoined — reference manager.py:177 fault-tolerance level): it
+        triggers RESTART just like scale-in, so the job re-forms at the
+        larger size instead of ignoring the newcomer forever."""
         self.heartbeat()
-        alive = self.alive_ranks()
+        entries = self._alive_entries()
+        alive = sorted(int(d["rank"]) for d in entries)
         if len(alive) == self.world:
             return ElasticStatus.HOLD
         if len(alive) < self.world:
             return ElasticStatus.RESTART
-        return ElasticStatus.HOLD
+        # surplus ranks: a JOIN only counts if its heartbeat is fresher
+        # than this manager's start — stale files from a previous larger
+        # run hold instead of restart-thrashing until they expire
+        fresh_join = any(int(d["rank"]) >= self.world
+                         and float(d["ts"]) > self._started
+                         for d in entries)
+        return ElasticStatus.RESTART if fresh_join else ElasticStatus.HOLD

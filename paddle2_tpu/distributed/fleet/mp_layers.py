@@ -5,10 +5,23 @@ identity/allreduce PyLayers (mpu/mp_ops.py). TPU-native: the SAME layer code
 holds one logical weight committed with a NamedSharding over the 'mp' mesh
 axis; XLA's SPMD partitioner inserts the all-reduce (RowParallel contraction)
 / all-gather (gather_output) — the GSPMD formulation of Megatron TP.
+
+Two execution modes, one layer code:
+  * GSPMD (default): logical full-size weights + sharding constraints;
+    XLA partitions and inserts collectives.
+  * MANUAL (``with manual_mp("mp"):``): inside a ``shard_map`` program —
+    the compiled pipelines — the layer sees its LOCAL weight shard and
+    issues the reference's explicit collectives itself (psum for the
+    RowParallel contraction, all_gather for gather_output, masked
+    lookup + psum for the vocab shard). This is what lets
+    ``fleet.pipeline_spmd_1f1b(param_specs=...)`` run MODEL code built
+    from these layers rather than hand-written TP math.
 """
 
 from __future__ import annotations
 
+import threading
+from contextlib import contextmanager
 from typing import Optional
 
 import jax
@@ -16,13 +29,34 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ...framework.tensor import Tensor  # noqa: F401 (re-export convenience)
+from ...ops.dispatch import apply_op
 from ..mesh import constrain, get_mesh
 from ...nn.layer.layers import Layer
 
 P = PartitionSpec
 
 __all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
-           "RowParallelLinear", "ParallelCrossEntropy"]
+           "RowParallelLinear", "ParallelCrossEntropy", "manual_mp"]
+
+_MANUAL = threading.local()
+
+
+def _manual_axis() -> Optional[str]:
+    return getattr(_MANUAL, "axis", None)
+
+
+@contextmanager
+def manual_mp(axis: str = "mp"):
+    """Run enclosed mp_layers in MANUAL-collective mode: weights are the
+    per-device shards a ``shard_map`` body receives, and reductions are
+    explicit ``lax.psum``/``all_gather`` over ``axis`` (the reference's
+    mp_ops.py collectives, verbatim semantics)."""
+    prev = getattr(_MANUAL, "axis", None)
+    _MANUAL.axis = axis
+    try:
+        yield
+    finally:
+        _MANUAL.axis = prev
 
 
 def _mp_axis() -> str:
@@ -65,9 +99,14 @@ class ColumnParallelLinear(Layer):
 
     def forward(self, x):
         from ...nn import functional as F
-        y = F.linear(x, self.weight, self.bias)
+        y = F.linear(x, self.weight, self.bias)  # local shard in manual
         if self.gather_output:
-            y = _constrain_tensor(y, P(*([None] * y.ndim)))
+            ax = _manual_axis()
+            if ax is not None:
+                y = apply_op("mp_all_gather", lambda a: jax.lax.all_gather(
+                    a, ax, axis=a.ndim - 1, tiled=True), (y,), {})
+            else:
+                y = _constrain_tensor(y, P(*([None] * y.ndim)))
         return y
 
 
@@ -99,6 +138,17 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         from ...nn import functional as F
+        ax = _manual_axis()
+        if ax is not None:
+            # manual shard_map mode: x is the local column block (an
+            # upstream ColumnParallel output); the explicit psum IS the
+            # reference's allreduce after the local matmul
+            y = F.linear(x, self.weight)
+            y = apply_op("mp_psum", lambda a: jax.lax.psum(a, ax),
+                         (y,), {})
+            if self.bias is not None:
+                y = y + self.bias
+            return y
         if not self.input_is_parallel:
             spec = P(*([None] * (x.ndim - 1) + [self._axis]))
             x = _constrain_tensor(x, spec)
@@ -128,6 +178,19 @@ class VocabParallelEmbedding(Layer):
 
     def forward(self, x):
         from ...nn import functional as F
+        ax = _manual_axis()
+        if ax is not None:
+            # manual mode: the weight is this device's vocab slice —
+            # masked local lookup + psum (mp_layers.py:49 c_embedding)
+            def fn(ids, w):
+                v_local = w.shape[0]
+                r = jax.lax.axis_index(ax)
+                loc = ids - r * v_local
+                valid = (loc >= 0) & (loc < v_local)
+                e = jnp.take(w, jnp.clip(loc, 0, v_local - 1), axis=0)
+                e = jnp.where(valid[..., None], e, 0)
+                return jax.lax.psum(e, ax)
+            return apply_op("mp_vocab_embed", fn, (x, self.weight), {})
         y = F.embedding(x, self.weight)
         return _constrain_tensor(y, P(*([None] * y.ndim)))
 
@@ -146,6 +209,33 @@ class ParallelCrossEntropy(Layer):
 
     def forward(self, input, label, soft_label=False):
         from ...nn import functional as F
+        ax = _manual_axis()
+        if ax is not None:
+            if soft_label:
+                raise NotImplementedError(
+                    "ParallelCrossEntropy manual mode: soft_label is "
+                    "not supported")
+            ignore = self.ignore_index
+
+            def fn(lg, lbl):
+                # local logits [., V/mp]: global LSE via pmax+psum, the
+                # target logit via masked local pick + psum — exactly
+                # the reference's hand-rolled c_softmax_with_ce
+                v_local = lg.shape[-1]
+                r = jax.lax.axis_index(ax)
+                m = jax.lax.pmax(jnp.max(lg, -1), ax)
+                s = jax.lax.psum(
+                    jnp.sum(jnp.exp(lg - m[..., None]), -1), ax)
+                lse = m + jnp.log(s)
+                loc = lbl - r * v_local
+                valid = (loc >= 0) & (loc < v_local)
+                pick_l = jnp.take_along_axis(
+                    lg, jnp.clip(loc, 0, v_local - 1)[..., None],
+                    -1)[..., 0]
+                pick = jax.lax.psum(jnp.where(valid, pick_l, 0.0), ax)
+                out = lse - pick
+                return jnp.where(lbl == ignore, 0.0, out)
+            return apply_op("mp_parallel_ce", fn, (input, label), {})
         spec = P(*([None] * (input.ndim - 1) + [self._axis]))
         logits = _constrain_tensor(input, spec)
         return F.cross_entropy(logits, label, soft_label=soft_label,
@@ -169,8 +259,12 @@ def _constrain_tensor(t, spec: P):
     Eager: a real device_put (placement-only change; the result shares the
     producer's grad edge — or, for a leaf, aliases its grad accumulation —
     so backward is the implicit identity). Traced (to_static): records
-    with_sharding_constraint for GSPMD.
+    with_sharding_constraint for GSPMD. Manual (shard_map): no-op —
+    sharding constraints are illegal inside manual regions; the layers
+    issue explicit collectives instead.
     """
+    if _manual_axis() is not None:
+        return t
     if isinstance(t._data, jax.core.Tracer):
         from ...ops.dispatch import apply_op
         return apply_op("sharding_constraint",

@@ -20,6 +20,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import List, Optional
 
@@ -45,6 +46,19 @@ def _parse(argv: Optional[List[str]] = None):
                         "world size (scale-in; reference ElasticManager "
                         "scale semantics) instead of same-size restart")
     p.add_argument("--job_id", default="default")
+    p.add_argument("--rdzv_master", default=None,
+                   help="rendezvous master endpoint (host:port). Enables "
+                        "the multi-node elastic agent: pods join/leave, "
+                        "a version bump rescales every node's gang "
+                        "(reference launch/controllers/master.py)")
+    p.add_argument("--rdzv_serve", action="store_true",
+                   help="host the rendezvous master in THIS launcher "
+                        "(typically node_rank 0)")
+    p.add_argument("--rdzv_beat", type=float, default=5.0,
+                   help="agent heartbeat / version-poll interval (s)")
+    p.add_argument("--rdzv_dead", type=float, default=30.0,
+                   help="pod heartbeat timeout before the master sweeps "
+                        "it (s)")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -163,8 +177,192 @@ def _watch(procs: List[subprocess.Popen]):
         time.sleep(0.5)
 
 
+def _spawn_layout(args, layout: dict, me: dict,
+                  attempt: int) -> List[subprocess.Popen]:
+    """Spawn the local gang for one rendezvous layout: global ranks are
+    the master-assigned offset + local rank, world is the layout's."""
+    procs = []
+    for lr in range(args.nproc_per_node):
+        # one shared env builder (_worker_env: devices, master, job id),
+        # then override the rank/world vars with the MASTER-ASSIGNED
+        # layout instead of the static nnodes*nproc derivation
+        env = _worker_env(args, lr)
+        rank = me["rank_offset"] + lr
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(layout["world"]),
+            "PADDLE_NNODES": str(layout["nnodes"]),
+            "PADDLE_NODE_RANK": str(me["node_rank"]),
+            "PADDLE_JOB_VERSION": str(layout["version"]),
+            "PADDLE_ELASTIC_RESTART_COUNT": str(attempt),
+        })
+        if args.master:
+            env.update({
+                "JAX_NUM_PROCESSES": str(layout["world"]),
+                "JAX_PROCESS_ID": str(rank),
+            })
+        cmd = [sys.executable, args.training_script] \
+            + args.training_script_args
+        stdout = stderr = None
+        log_path = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
+            f = open(log_path, "ab")
+            stdout = stderr = f
+        p = subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr)
+        p.log_path = log_path
+        procs.append(p)
+    return procs
+
+
+def _teardown(procs):
+    for q in procs:
+        if q.poll() is None:
+            q._torn_down = True
+            q.send_signal(signal.SIGTERM)
+    deadline = time.time() + 3
+    while time.time() < deadline and any(q.poll() is None for q in procs):
+        time.sleep(0.1)
+    for q in procs:
+        if q.poll() is None:
+            q.kill()
+
+
+def _watch_with_master(procs, client, node_id: str, version: int,
+                       beat: float):
+    """Babysit the local gang AND the job version: a version bump means
+    the membership changed — tear down and respawn at the new layout."""
+    from .master import UnknownPodError
+    from ..fleet.elastic import ELASTIC_EXIT_CODE
+    last_beat = 0.0
+    while True:
+        alive = False
+        failed = 0
+        rc_out = 0
+        for p in procs:
+            rc = p.poll()
+            if rc is None:
+                alive = True
+            elif rc != 0:
+                failed += 1
+                if rc_out in (0, ELASTIC_EXIT_CODE):
+                    rc_out = rc
+        if failed:
+            _teardown(procs)
+            return "failed", rc_out, failed
+        if not alive:
+            return "done", 0, 0
+        if time.time() - last_beat >= beat:
+            last_beat = time.time()
+            try:
+                r = client.beat(node_id)
+                if int(r.get("version", version)) != version:
+                    _teardown(procs)
+                    return "rescale", 0, 0
+            except UnknownPodError:
+                _teardown(procs)          # master swept us: re-join
+                return "rescale", 0, 0
+            except ConnectionError:
+                pass                      # master briefly unreachable
+        time.sleep(min(0.2, beat / 4))
+
+
+def _elastic_agent(args) -> int:
+    """Multi-node elastic launcher: join the rendezvous job, spawn the
+    local gang at the agreed layout, respawn on every membership change
+    — scale-IN when the master sweeps a dead pod, scale-UP when a node
+    (re)joins (reference ElasticManager + master watch loop)."""
+    import socket
+    from .master import MasterClient, RendezvousMaster
+    master = None
+    if args.rdzv_serve:
+        port = int(str(args.rdzv_master).rsplit(":", 1)[1])
+        master = RendezvousMaster(port, job=args.job_id,
+                                  dead_after=args.rdzv_dead).start()
+        print(f"[launch] rendezvous master serving on :{port}",
+              file=sys.stderr)
+    client = MasterClient(args.rdzv_master)
+    node_id = f"node-{args.node_rank}"
+    host = socket.gethostname()
+    attempt = 0
+    beat_thread_stop = threading.Event()
+
+    def _beat_during_settle():
+        # keep the pod alive while (re)joining/settling
+        while not beat_thread_stop.is_set():
+            try:
+                client.beat(node_id)
+            except Exception:
+                pass
+            beat_thread_stop.wait(args.rdzv_beat)
+
+    try:
+        while True:
+            layout = client.join(node_id, host, args.nproc_per_node)
+            # settle: let concurrent joins land, then read the final
+            # layout all agents will agree on
+            beat_thread_stop.clear()
+            settler = threading.Thread(target=_beat_during_settle,
+                                       daemon=True)
+            settler.start()
+            time.sleep(max(0.2, args.rdzv_beat))
+            layout = client.layout()
+            beat_thread_stop.set()
+            me = next((nd for nd in layout["nodes"]
+                       if nd["node_id"] == node_id), None)
+            if me is None:
+                continue                      # swept mid-settle: re-join
+            version = int(layout["version"])
+            print(f"[launch] job v{version}: world={layout['world']} "
+                  f"nnodes={layout['nnodes']} node_rank="
+                  f"{me['node_rank']}", file=sys.stderr)
+            procs = _spawn_layout(args, layout, me, attempt)
+            state, rc, _n = _watch_with_master(procs, client, node_id,
+                                               version, args.rdzv_beat)
+            if state == "done":
+                try:
+                    client.leave(node_id)
+                except Exception:
+                    pass
+                return 0
+            if state == "rescale":
+                print("[launch] membership changed — rescaling",
+                      file=sys.stderr)
+                continue
+            # local failure
+            _surface_failure_logs(procs)
+            from ..fleet.elastic import ELASTIC_EXIT_CODE
+            if rc != ELASTIC_EXIT_CODE:
+                attempt += 1
+                if attempt > args.max_restarts:
+                    print(f"[launch] gang failed (rc={rc}) after "
+                          f"{attempt - 1} restarts; leaving job",
+                          file=sys.stderr)
+                    try:
+                        client.leave(node_id)
+                    except Exception:
+                        pass
+                    return rc
+            # leave+rejoin bumps the version twice so OTHER nodes
+            # rescale around our restart instead of hanging on dead
+            # collectives
+            try:
+                client.leave(node_id)
+            except Exception:
+                pass
+            print(f"[launch] worker failed (rc={rc}); elastic restart "
+                  f"{attempt}/{args.max_restarts}", file=sys.stderr)
+    finally:
+        beat_thread_stop.set()
+        if master is not None:
+            master.shutdown()
+
+
 def launch(argv: Optional[List[str]] = None) -> int:
     args = _parse(argv)
+    if args.rdzv_master:
+        return _elastic_agent(args)
     attempt = 0
     while True:
         procs = _spawn(args)

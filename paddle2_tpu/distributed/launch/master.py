@@ -1,0 +1,235 @@
+"""Rendezvous master: pod/job membership over HTTP.
+
+TPU-native analog of the reference launcher's coordination plane
+(``launch/controllers/master.py:73`` HTTPMaster / ``:186`` ETCDMaster,
+pod model ``launch/job/pod.py``): one small HTTP service — hosted by the
+node-0 launcher, no etcd dependency — tracks which NODES (pods) are
+members of the job, detects dead pods by heartbeat timeout, and bumps a
+job VERSION on every membership change. Launcher agents poll the
+version; a bump means "the world changed — tear down your local gang
+and respawn at the new layout". That gives multi-node elastic scale-IN
+(dead node swept) and scale-UP (node [re]joins) with one mechanism.
+
+The data plane stays JAX: workers re-run ``jax.distributed.initialize``
+/ collectives at the new world size after every rescale; this module
+only decides WHO is in the job.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Pod:
+    """One node's launcher (reference launch/job/pod.py)."""
+    node_id: str
+    host: str
+    nproc: int
+    joined_at: float = field(default_factory=time.time)
+    last_beat: float = field(default_factory=time.time)
+    status: str = "ready"
+
+
+class Job:
+    """Pod membership + versioned layout (reference launch/job/job.py)."""
+
+    def __init__(self, name: str = "default"):
+        self.name = name
+        self.version = 0
+        self.pods: Dict[str, Pod] = {}
+
+    def layout(self) -> dict:
+        """Deterministic node_rank / global-rank assignment: pods sorted
+        by (joined_at, node_id) so every agent derives the same world."""
+        pods = sorted(self.pods.values(),
+                      key=lambda p: (p.joined_at, p.node_id))
+        nodes = []
+        offset = 0
+        for i, p in enumerate(pods):
+            nodes.append({"node_id": p.node_id, "host": p.host,
+                          "nproc": p.nproc, "node_rank": i,
+                          "rank_offset": offset})
+            offset += p.nproc
+        return {"version": self.version, "job": self.name,
+                "world": offset, "nnodes": len(pods), "nodes": nodes}
+
+
+class RendezvousMaster:
+    """The HTTP coordination service. Endpoints (all JSON):
+
+    POST /join   {node_id, host, nproc}  -> layout (bumps version)
+    POST /leave  {node_id}               -> {version}
+    POST /beat   {node_id}               -> {version} (404 if unknown —
+                                            the agent must re-join)
+    GET  /layout                         -> layout
+    """
+
+    def __init__(self, port: int, job: str = "default",
+                 dead_after: float = 30.0, host: str = "0.0.0.0"):
+        self.job = Job(job)
+        self.dead_after = dead_after
+        self._lock = threading.Lock()
+        master = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):      # keep launcher stderr clean
+                pass
+
+            def _reply(self, code: int, obj: dict):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.rstrip("/") == "/layout":
+                    with master._lock:
+                        master._sweep()
+                        self._reply(200, master.job.layout())
+                else:
+                    self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    return self._reply(400, {"error": "bad json"})
+                path = self.path.rstrip("/")
+                with master._lock:
+                    master._sweep()
+                    if path == "/join":
+                        self._reply(200, master._join(req))
+                    elif path == "/leave":
+                        master._leave(req.get("node_id", ""))
+                        self._reply(200,
+                                    {"version": master.job.version})
+                    elif path == "/beat":
+                        pod = master.job.pods.get(
+                            req.get("node_id", ""))
+                        if pod is None:
+                            self._reply(404, {"error": "unknown pod"})
+                        else:
+                            pod.last_beat = time.time()
+                            self._reply(200,
+                                        {"version": master.job.version})
+                    else:
+                        self._reply(404, {"error": "unknown path"})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="rdzv-master",
+            daemon=True)
+
+    # -- membership (all called under _lock) ----------------------------
+    def _join(self, req: dict) -> dict:
+        node_id = str(req.get("node_id", ""))
+        prev = self.job.pods.get(node_id)
+        pod = Pod(node_id=node_id, host=str(req.get("host", "")),
+                  nproc=int(req.get("nproc", 1)))
+        if prev is not None:
+            pod.joined_at = prev.joined_at   # re-join keeps its slot
+            if (prev.host, prev.nproc) == (pod.host, pod.nproc):
+                # idempotent re-join of an unchanged member: refresh the
+                # beat WITHOUT bumping the version — agents re-join after
+                # every rescale, and a bump here would invalidate every
+                # other node's captured version and ping-pong the fleet
+                # through redundant teardown rounds
+                self.job.pods[node_id] = pod
+                return self.job.layout()
+        self.job.pods[node_id] = pod
+        self.job.version += 1
+        return self.job.layout()
+
+    def _leave(self, node_id: str):
+        if node_id in self.job.pods:
+            del self.job.pods[node_id]
+            self.job.version += 1
+
+    def _sweep(self):
+        """Drop pods whose heartbeat expired (failure detection — the
+        reference master's pod watchdog)."""
+        now = time.time()
+        dead = [nid for nid, p in self.job.pods.items()
+                if now - p.last_beat > self.dead_after]
+        for nid in dead:
+            del self.job.pods[nid]
+        if dead:
+            self.job.version += 1
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "RendezvousMaster":
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class MasterClient:
+    """Agent-side client for :class:`RendezvousMaster`."""
+
+    def __init__(self, endpoint: str, timeout: float = 5.0,
+                 retries: int = 20, retry_wait: float = 0.5):
+        if not endpoint.startswith("http"):
+            endpoint = "http://" + endpoint
+        self.endpoint = endpoint.rstrip("/")
+        self.timeout = timeout
+        self.retries = retries
+        self.retry_wait = retry_wait
+
+    def _req(self, path: str, body: Optional[dict] = None,
+             retries: Optional[int] = None) -> dict:
+        last: Optional[Exception] = None
+        for _ in range(retries if retries is not None else self.retries):
+            try:
+                data = None if body is None else json.dumps(body).encode()
+                r = urllib.request.Request(
+                    self.endpoint + path, data=data,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(r, timeout=self.timeout) as f:
+                    return json.loads(f.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 404 and path == "/beat":
+                    raise UnknownPodError()   # must re-join
+                last = e
+            except Exception as e:   # conn refused while master boots
+                last = e
+            time.sleep(self.retry_wait)
+        raise ConnectionError(
+            f"rendezvous master unreachable at {self.endpoint}{path}: "
+            f"{last}")
+
+    def join(self, node_id: str, host: str, nproc: int) -> dict:
+        return self._req("/join", {"node_id": node_id, "host": host,
+                                   "nproc": nproc})
+
+    def leave(self, node_id: str) -> dict:
+        return self._req("/leave", {"node_id": node_id}, retries=2)
+
+    def beat(self, node_id: str) -> dict:
+        return self._req("/beat", {"node_id": node_id}, retries=2)
+
+    def layout(self) -> dict:
+        return self._req("/layout")
+
+
+class UnknownPodError(Exception):
+    """The master swept this pod (e.g. a long GC pause outlived
+    dead_after); the agent must re-join and respawn."""
+
+
+__all__ = ["Pod", "Job", "RendezvousMaster", "MasterClient",
+           "UnknownPodError"]

@@ -56,6 +56,16 @@ class GPTConfig:
     # TPU recipe for deep transformers. Falls back to the Python loop in
     # eager mode or when dropout makes per-layer RNG streams necessary.
     use_scan: bool = True
+    # store the block stack's parameters PRE-STACKED as [L, ...] leaves
+    # (models/_scan.py StackedLayerStack): the scan consumes them with
+    # zero per-step restacking. Measured on v5e (r5): the per-step
+    # dynamic-update-slice stack of 24 layers' weights (+ the matching
+    # grad unstack) is ~GBs of pure HBM traffic — the bulk of the
+    # "framework tax" vs a bare-JAX probe. Trade-off: per-block
+    # sub-layers (model.gpt.h[i]) are not addressable and eager
+    # *training* must run under jit (to_static / train_step); eager
+    # inference works.
+    stacked_blocks: bool = False
     # compute the LM loss through the chunked fused head+CE kernel
     # (incubate.nn.functional.fused_linear_cross_entropy): the [tokens,
     # vocab] f32 logits are never materialized. forward(labels=...) then
@@ -73,6 +83,21 @@ class GPTConfig:
 
 def _init_attr(std):
     return nn.ParamAttr(initializer=nn.initializer.Normal(mean=0.0, std=std))
+
+
+def convert_pre_r5_qkv_weight(w, num_heads: int, head_dim: int):
+    """Permute a fused qkv weight/bias from the pre-r5 column layout
+    ``[.., (q|k|v), heads, d]`` to the current HEAD-MAJOR layout
+    ``[.., heads, (q|k|v), d]`` (see GPTAttention.forward — the change
+    makes mp shards split at head boundaries). Apply to ``qkv.weight``
+    ([in, 3h]) and ``qkv.bias`` ([3h]) when loading a checkpoint saved
+    before the layout change; shapes are unchanged, so the load itself
+    cannot detect the mismatch."""
+    arr = w._data if isinstance(w, Tensor) else jnp.asarray(w)
+    lead = arr.shape[:-1]
+    out = arr.reshape(lead + (3, num_heads, head_dim))
+    out = jnp.swapaxes(out, -3, -2).reshape(arr.shape)
+    return Tensor(out) if isinstance(w, Tensor) else out
 
 
 def _linear_pair(cfg: GPTConfig, d_in, d_mid, std):
@@ -139,8 +164,14 @@ class GPTAttention(nn.Layer):
         cfg = self.cfg
         b, s, h = x.shape
         qkv = self.qkv(x)  # [b, s, 3h] (mp-sharded when TP)
-        qkv = qkv.reshape([b, s, 3, cfg.num_heads, cfg.head_dim])
-        q, k, v = qkv.unbind(axis=2)
+        # HEAD-MAJOR fused layout [heads, (q|k|v), head_dim]: an mp shard
+        # of the output dim then splits at head boundaries, so the
+        # manual-mp local block reshapes to whole heads (num_heads/mp of
+        # them — hence -1) and GSPMD avoids a reshard on this reshape.
+        # A (3, heads, d) layout would hand rank 0 "all of q + half of
+        # k" under TP.
+        qkv = qkv.reshape([b, s, -1, 3, cfg.head_dim])
+        q, k, v = qkv.unbind(axis=3)
         new_cache = None
         if cache is not None:
             if len(cache) == 2:
@@ -162,7 +193,7 @@ class GPTAttention(nn.Layer):
             out = scaled_dot_product_attention(
                 q, k, v, is_causal=True,
                 dropout_p=cfg.attention_dropout_prob, training=self.training)
-        out = out.reshape([b, s, h])
+        out = out.reshape([b, s, -1])   # h, or h/mp under manual-mp
         out = self.out_proj(out)
         return (out, new_cache) if cache is not None else out
 
@@ -246,7 +277,12 @@ class GPTModel(nn.Layer):
         self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size,
                                 weight_attr=_init_attr(std))
         self.drop = nn.Dropout(cfg.hidden_dropout_prob)
-        self.h = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        blocks = [GPTBlock(cfg) for _ in range(cfg.num_layers)]
+        if cfg.stacked_blocks:
+            from ._scan import StackedLayerStack
+            self.h = StackedLayerStack(blocks)
+        else:
+            self.h = nn.LayerList(blocks)
         self.ln_f = nn.LayerNorm(cfg.hidden_size,
                                  epsilon=cfg.layer_norm_epsilon)
 
@@ -263,7 +299,7 @@ class GPTModel(nn.Layer):
 
     def _can_scan(self, x) -> bool:
         cfg = self.cfg
-        return (cfg.use_scan and len(self.h) > 1
+        return (cfg.use_scan and cfg.num_layers > 1
                 and isinstance(x._data, jax.core.Tracer)
                 and (cfg.hidden_dropout_prob == 0.0
                      and cfg.attention_dropout_prob == 0.0
@@ -285,10 +321,16 @@ class GPTModel(nn.Layer):
                 gran if gran in ("dots", "dots_plus", "dots_plus_ln")
                 else "nothing")
             wrap = lambda body: jax.checkpoint(body, policy=policy)
+        if self.cfg.stacked_blocks:
+            return self.h(x, wrap_body=wrap)
         out = scan_layer_stack(list(self.h), x, wrap_body=wrap)
         return out if out is not None else self._fallback_loop(x)
 
     def _fallback_loop(self, x: Tensor) -> Tensor:
+        if self.cfg.stacked_blocks:
+            # allow_scan=False: this path is taken exactly when _can_scan
+            # said no (eager, or dropout needs per-layer rng streams)
+            return self.h(x, allow_scan=False)
         for block in self.h:
             if self.cfg.use_recompute and self.training:
                 from ..distributed.recompute import recompute
@@ -307,9 +349,14 @@ class GPTModel(nn.Layer):
         x = self.wte(input_ids) + self.wpe(pos)
         x = _seq_constrain(self.drop(x), self.cfg)
         new_caches = []
-        for block, cache in zip(self.h, caches):
-            x, c = block(x, cache=cache)
-            new_caches.append(c)
+        if self.cfg.stacked_blocks:
+            for i, cache in enumerate(caches):
+                x, c = self.h.layer_slice_call(i, x, cache=cache)
+                new_caches.append(c)
+        else:
+            for block, cache in zip(self.h, caches):
+                x, c = block(x, cache=cache)
+                new_caches.append(c)
         return self.ln_f(x), new_caches
 
 

@@ -80,7 +80,8 @@ class AbsmaxObserver(nn.Layer):
     def freeze(self):
         """Stop scale updates (PTQ.convert 'freeze' semantics)."""
         self._frozen = True
-        self._frozen_buf._replace_data(jnp.ones((), jnp.float32))
+        if hasattr(self, "_frozen_buf"):   # pre-r5 pickled instances
+            self._frozen_buf._replace_data(jnp.ones((), jnp.float32))
 
     def forward(self, x: Tensor) -> Tensor:
         # record until frozen, in train AND eval (reference observer
@@ -92,7 +93,8 @@ class AbsmaxObserver(nn.Layer):
             return x
         cur = jnp.max(jnp.abs(x._data)).astype(jnp.float32)
         prev, seen = self._absmax._data, self._seen._data
-        frozen = self._frozen_buf._data > 0
+        fb = getattr(self, "_frozen_buf", None)
+        frozen = fb._data > 0 if fb is not None else jnp.asarray(False)
         new = jnp.where(seen > 0,
                         self.moving_rate * prev
                         + (1 - self.moving_rate) * cur, cur)
@@ -165,7 +167,8 @@ class ChannelWiseAbsMaxObserver(nn.Layer):
         red = tuple(i for i in range(x.ndim) if i != axis)
         cur = jnp.max(jnp.abs(x._data), axis=red).astype(jnp.float32)
         prev, seen = self._absmax._data, self._seen._data
-        frozen = self._frozen_buf._data > 0
+        fb = getattr(self, "_frozen_buf", None)
+        frozen = fb._data > 0 if fb is not None else jnp.asarray(False)
         new = jnp.where(seen > 0,
                         self.moving_rate * prev
                         + (1 - self.moving_rate) * cur, cur)

@@ -1,0 +1,169 @@
+"""Rendezvous master + multi-node elastic agent (reference
+launch/controllers/master.py:73,186 + elastic/manager.py:125): pod
+join/leave/sweep semantics, and the 2-"node" e2e — kill one node ->
+the job rescales IN; the node rejoins -> the job scales back UP."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle2_tpu.distributed.launch.master import (MasterClient,
+                                                   RendezvousMaster)
+
+pytestmark = pytest.mark.slow
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestMasterUnit:
+    def test_join_layout_version_and_rejoin_keeps_slot(self):
+        m = RendezvousMaster(0, dead_after=30).start()
+        try:
+            c = MasterClient(f"127.0.0.1:{m.port}")
+            l1 = c.join("a", "hosta", 2)
+            assert l1["world"] == 2 and l1["nnodes"] == 1
+            l2 = c.join("b", "hostb", 2)
+            assert l2["world"] == 4
+            assert l2["version"] > l1["version"]
+            # deterministic ranks: a joined first -> node_rank 0
+            ranks = {n["node_id"]: n["node_rank"] for n in l2["nodes"]}
+            offs = {n["node_id"]: n["rank_offset"] for n in l2["nodes"]}
+            assert ranks == {"a": 0, "b": 1}
+            assert offs == {"a": 0, "b": 2}
+            # re-join keeps the original slot ordering
+            l3 = c.join("a", "hosta", 2)
+            ranks3 = {n["node_id"]: n["node_rank"] for n in l3["nodes"]}
+            assert ranks3 == {"a": 0, "b": 1}
+            c.leave("b")
+            assert c.layout()["world"] == 2
+        finally:
+            m.shutdown()
+
+    def test_dead_pod_swept_and_beat_404_after_sweep(self):
+        from paddle2_tpu.distributed.launch.master import UnknownPodError
+        m = RendezvousMaster(0, dead_after=0.5).start()
+        try:
+            c = MasterClient(f"127.0.0.1:{m.port}")
+            c.join("a", "h", 1)
+            c.join("b", "h", 1)
+            v2 = c.layout()["version"]
+            deadline = time.time() + 5
+            # only 'a' keeps beating; 'b' must get swept
+            while time.time() < deadline:
+                c.beat("a")
+                lay = c.layout()
+                if lay["world"] == 1:
+                    break
+                time.sleep(0.2)
+            lay = c.layout()
+            assert lay["world"] == 1
+            assert lay["nodes"][0]["node_id"] == "a"
+            assert lay["version"] > v2
+            with pytest.raises(UnknownPodError):
+                c.beat("b")
+        finally:
+            m.shutdown()
+
+
+def _worker_script(tmp_path):
+    script = tmp_path / "elastic_worker.py"
+    script.write_text("""
+import json, os, sys, time
+out = sys.argv[1] + ".node" + os.environ.get("PADDLE_NODE_RANK", "?")
+while True:
+    with open(out, "a") as f:
+        f.write(json.dumps({
+            "world": int(os.environ["PADDLE_TRAINERS_NUM"]),
+            "version": int(os.environ.get("PADDLE_JOB_VERSION", -1)),
+            "rank": int(os.environ["PADDLE_TRAINER_ID"]),
+            "ts": time.time()}) + "\\n")
+    time.sleep(0.2)
+""")
+    return script
+
+
+def _launcher(script, marker, port, node_rank, serve, tmp_path):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "PADDLE_"))}
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    cmd = [sys.executable, "-m", "paddle2_tpu.distributed.launch",
+           "--rdzv_master", f"127.0.0.1:{port}",
+           "--rdzv_beat", "0.4", "--rdzv_dead", "2.5",
+           "--node_rank", str(node_rank), "--nproc_per_node", "1",
+           "--max_restarts", "5", str(script), str(marker)]
+    if serve:
+        cmd.insert(3, "--rdzv_serve")
+    # own process group: killing the agent must also kill its worker
+    return subprocess.Popen(cmd, env=env, start_new_session=True,
+                            stderr=open(
+                                str(tmp_path / f"agent{node_rank}.err"),
+                                "ab"))
+
+
+def _wait_world(marker_file, want_world, timeout=30.0, after_ts=0.0):
+    """Poll the worker's jsonl until a line with the wanted world size
+    (written after `after_ts`) appears; returns that line."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with open(marker_file) as f:
+                for line in f.read().splitlines():
+                    d = json.loads(line)
+                    if d["world"] == want_world and d["ts"] > after_ts:
+                        return d
+        except FileNotFoundError:
+            pass
+        time.sleep(0.2)
+    raise AssertionError(
+        f"no world={want_world} line after ts={after_ts} in "
+        f"{marker_file}")
+
+
+def _killpg(proc):
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    proc.wait(timeout=10)
+
+
+def test_two_node_elastic_scale_in_and_up(tmp_path):
+    script = _worker_script(tmp_path)
+    marker = tmp_path / "m"
+    port = _free_port()
+    a = b = b2 = None
+    try:
+        a = _launcher(script, marker, port, 0, True, tmp_path)
+        _wait_world(str(marker) + ".node0", 1)       # solo world first
+        b = _launcher(script, marker, port, 1, False, tmp_path)
+        t_joined = time.time()
+        _wait_world(str(marker) + ".node0", 2)       # scaled UP to 2
+        _wait_world(str(marker) + ".node1", 2)
+
+        _killpg(b)                                   # node 1 dies hard
+        d = _wait_world(str(marker) + ".node0", 1,
+                        after_ts=t_joined)           # scaled IN to 1
+        t_scaled_in = d["ts"]
+
+        b2 = _launcher(script, marker, port, 1, False, tmp_path)
+        _wait_world(str(marker) + ".node0", 2,
+                    after_ts=t_scaled_in)            # scaled UP again
+        _wait_world(str(marker) + ".node1", 2,
+                    after_ts=t_scaled_in)
+    finally:
+        for p in (a, b, b2):
+            if p is not None and p.poll() is None:
+                _killpg(p)

@@ -633,6 +633,95 @@ class TestCompiledVPP:
         assert vpp_mem < naive_mem, (vpp_mem, naive_mem)
 
 
+def test_compiled_1f1b_runs_framework_gpt_blocks_with_manual_mp():
+    """r4 verdict #3: the compiled hybrid TP+PP pipeline must run the
+    FRAMEWORK's model code — GPTBlock built from fleet.mp_layers — not
+    hand-written TP math. manual_mp() switches the layers to explicit
+    shard_map collectives; parity vs the eager GSPMD forward/backward."""
+    import jax
+    import jax.numpy as jnp
+    import paddle2_tpu as paddle
+    import paddle2_tpu.distributed as dist
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle2_tpu.framework import core
+    from paddle2_tpu.framework.tensor import Tensor
+    from paddle2_tpu.models.gpt import GPTBlock, GPTConfig
+    from paddle2_tpu.distributed.fleet.mp_layers import manual_mp
+    from paddle2_tpu.distributed.fleet.spmd_pipeline import (
+        pipeline_spmd_1f1b)
+
+    mesh = dist.init_mesh({"pp": 4, "mp": 2})
+    S, M, B, T, H = 4, 4, 2, 4, 16
+    cfg = GPTConfig(vocab_size=64, hidden_size=H, num_layers=S,
+                    num_heads=2, max_position_embeddings=T,
+                    tensor_parallel=True, hidden_dropout_prob=0.0,
+                    attention_dropout_prob=0.0)
+    paddle.seed(0)
+    blocks = [GPTBlock(cfg) for _ in range(S)]
+    for blk in blocks:
+        blk.eval()
+    template = blocks[0]
+    names = [n for n, _ in template.named_parameters()]
+    tparams = [dict(template.named_parameters())[n] for n in names]
+
+    def stacked_spec(p):
+        orig = tuple(p._data.sharding.spec) \
+            if hasattr(p._data.sharding, "spec") else ()
+        orig = orig + (None,) * (p._data.ndim - len(orig))
+        return P("pp", *orig)
+
+    specs = [stacked_spec(p) for p in tparams]
+    stacked = [
+        jax.device_put(
+            jnp.stack([np.asarray(
+                dict(blocks[s].named_parameters())[n]._data)
+                for s in range(S)]),
+            NamedSharding(mesh, spec))
+        for n, spec in zip(names, specs)]
+
+    def stage_fn(p_stack, shared, x, sidx):
+        orig = [t._data for t in tparams]
+        for t, a in zip(tparams, p_stack):
+            t._data = a
+        try:
+            with core.no_grad(), manual_mp("mp"):
+                out = template(Tensor(x))
+            return out._data
+        finally:
+            for t, o in zip(tparams, orig):
+                t._data = o
+
+    def loss_fn(y, lbl):
+        return jnp.mean((y - lbl) ** 2)
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(M, B, T, H), jnp.float32)
+    y = jnp.asarray(rs.randn(M, B, T, H), jnp.float32)
+    loss, grads = pipeline_spmd_1f1b(stage_fn, stacked, x, y, loss_fn,
+                                     param_specs=specs)
+
+    # eager GSPMD reference over the same blocks, full batch
+    tot = None
+    for m in range(M):
+        h = Tensor(x[m])
+        for blk in blocks:
+            h = blk(h)
+        l_m = ((h - Tensor(y[m])) ** 2).mean()
+        tot = l_m if tot is None else tot + l_m
+    ref_loss = tot / M
+    ref_loss.backward()
+    np.testing.assert_allclose(float(np.asarray(loss)),
+                               float(np.asarray(ref_loss._data)),
+                               rtol=1e-6)
+    for i, n in enumerate(names):
+        got = np.asarray(grads[i])
+        want = np.stack([np.asarray(
+            dict(blocks[s].named_parameters())[n].grad._data)
+            for s in range(S)])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6,
+                                   err_msg=n)
+
+
 def test_compiled_1f1b_dp_sharded_batches_parity():
     """pipeline_spmd_1f1b(dp_axis=...): microbatches shard over 'dp',
     loss/grads come back as dp-means — must equal the dense sequential
