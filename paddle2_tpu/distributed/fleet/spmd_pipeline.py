@@ -16,13 +16,21 @@ program the way the scaling-book prescribes for TPU pipelining.
 
 Compiled schedules: GPipe wavefront (pipeline_spmd), hand-scheduled 1F1B
 (pipeline_spmd_1f1b, closed-form ticks, S+1 activation bound, hybrid
-TP+PP via param_specs), interleaved virtual-pipeline
-(pipeline_spmd_vpp). Zero-bubble (ZB-H1) ships on the EAGER executor
-only (pipeline_parallel.py schedule="ZB"): its point — filling bubbles
-with deferred weight-grad W ops — is a scheduling freedom XLA's
-latency-hiding scheduler already exercises inside a single compiled
-program, so a hand-scheduled compiled ZB would re-derive what the
-compiler does; the eager version remains the semantics reference.
+TP+PP via param_specs, dp_axis data parallelism), interleaved
+virtual-pipeline (pipeline_spmd_vpp). Zero-bubble (ZB-H1) ships on the
+EAGER executor only (pipeline_parallel.py schedule="ZB"): its point —
+filling bubbles with deferred weight-grad W ops — is a scheduling
+freedom XLA's latency-hiding scheduler already holds inside the
+compiled program. That claim is pinned structurally (r5):
+test_compiled_1f1b_cotangent_send_independent_of_weight_grads walks the
+1F1B backward-branch jaxpr and asserts the upstream cotangent dx (what
+the ppermute sends) neither produces nor consumes the weight-grad
+accumulation — the compiler is free to issue the send first and slot dW
+into the bubble, which is ZB-H1's whole schedule. Wall-clock bubble
+A/B is not measurable in this environment (one host core timeshares
+the 8 virtual devices, and the single real chip cannot run pp>1);
+revisit with a hand-scheduled compiled ZB only if a multi-chip profile
+ever shows dx sends serialized behind dW.
 """
 
 from __future__ import annotations
@@ -296,6 +304,13 @@ def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
 # ---------------------------------------------------------------------------
 # compiled interleaved-VPP: V model chunks per device, virtual-stage ring
 # ---------------------------------------------------------------------------
+#
+# Measured note (r5, virtual mesh, matched per-device work at V=2/S=4/
+# M=8): compiled-VPP temp footprint 0.16 MB vs compiled-1F1B 0.18 MB —
+# the "V*M chunk inputs vs S+1 in-flight buffers" residual distinction
+# is second-order next to the vjp residuals of the stage body itself;
+# pick VPP for bubble shape, not memory. (Step-time bubble A/B is not
+# measurable here: one host core timeshares all virtual devices.)
 #
 # Virtual stage vs = v*S + s lives as chunk v on device s (Megatron/the
 # reference's PipelineParallelWithInterleave placement,

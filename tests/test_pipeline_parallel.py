@@ -633,6 +633,126 @@ class TestCompiledVPP:
         assert vpp_mem < naive_mem, (vpp_mem, naive_mem)
 
 
+def test_compiled_1f1b_cotangent_send_independent_of_weight_grads():
+    """r4 verdict #7 (compiled-ZB stance, measured structurally): the
+    zero-bubble insight is that the NEXT stage only waits on the input
+    cotangent dx, never on this stage's weight grads dW — so dW may
+    defer into bubbles. In the compiled 1F1B tick body that freedom
+    must exist in the DATA DEPENDENCES: the dx the backward branch
+    emits (what the ppermute sends upstream) must not be an ancestor of
+    — nor descend from — the weight-grad accumulation. XLA's scheduler
+    can then order the send before the dW work, which is exactly what
+    ZB-H1 hand-schedules. This test walks the lowered jaxpr and asserts
+    that independence; wall-clock bubbles cannot be observed on this
+    host (the 8 'devices' timeshare one core)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle2_tpu.distributed as dist
+    from functools import partial
+    from jax.sharding import PartitionSpec as P
+    from paddle2_tpu.distributed.fleet.spmd_pipeline import _f1b_body
+
+    dist.init_mesh({"pp": 4, "dp": 2})
+    S, M, B, H = 4, 4, 2, 8
+    W = jnp.zeros((S, H, H), jnp.float32)
+    b = jnp.zeros((S, H), jnp.float32)
+    x = jnp.zeros((M, B, H), jnp.float32)
+    y = jnp.zeros((M, B, H), jnp.float32)
+
+    def stage_fn(p, shared, xx, sidx):
+        w, bb = p
+        return jnp.tanh(xx @ w + bb)
+
+    def loss_fn(out, label):
+        return jnp.mean((out - label) ** 2)
+
+    body = partial(_f1b_body, stage_fn=stage_fn, loss_fn=loss_fn,
+                   n_stages=S, n_micro=M, axis="pp")
+    mesh = dist.get_mesh()
+    sm = jax.shard_map(body, mesh=mesh,
+                       in_specs=(P("pp"), P(), P(), P()),
+                       out_specs=(P(), P("pp")))
+    jaxpr = jax.make_jaxpr(sm)((W, b), (), x, y)
+
+    def find_eqns(jx, prim):
+        out = []
+        for eqn in jx.eqns:
+            if eqn.primitive.name == prim:
+                out.append(eqn)
+            for v in eqn.params.values():
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None:
+                    out.append(None)  # placeholder; descend explicitly
+        return [e for e in out if e is not None]
+
+    # descend: shard_map -> scan -> cond(switch)
+    def descend(jx, prim):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == prim:
+                return eqn
+            for v in eqn.params.values():
+                inner = getattr(v, "jaxpr", None)
+                if inner is None and isinstance(v, (list, tuple)):
+                    continue
+                if inner is not None:
+                    got = descend(inner, prim)
+                    if got is not None:
+                        return got
+        return None
+
+    sm_eqn = descend(jaxpr.jaxpr, "shard_map")
+    assert sm_eqn is not None
+    scan_eqn = descend(sm_eqn.params["jaxpr"], "scan")
+    assert scan_eqn is not None
+    body_jx = scan_eqn.params["jaxpr"].jaxpr
+    switch_eqn = next(e for e in body_jx.eqns
+                      if e.primitive.name == "cond")
+    branches = switch_eqn.params["branches"]
+    assert len(branches) == 3                  # idle / fwd / bwd
+    bwd = branches[2].jaxpr
+
+    # branch outputs: x_buf, grad leaves..., losses, y_out, dx_out
+    outs = list(bwd.outvars)
+    dx_var = outs[-1]
+    grad_vars = outs[1:-3]
+    assert grad_vars, "expected weight-grad outputs in the bwd branch"
+
+    # ancestors of dx: transitive producer eqns
+    producers = {}
+    for eqn in bwd.eqns:
+        for ov in eqn.outvars:
+            producers[ov] = eqn
+    def ancestors(var, seen):
+        eqn = producers.get(var)
+        if eqn is None or id(eqn) in seen:
+            return
+        seen.add(id(eqn))
+        for iv in eqn.invars:
+            if type(iv).__name__ != "Literal":
+                ancestors(iv, seen)
+    dx_anc = set()
+    ancestors(dx_var, dx_anc)
+    # positive controls: dx really is computed (its ancestry contains
+    # the transpose matmul) and the weight-grad path really exists
+    anc_prims = {e.primitive.name for e in bwd.eqns
+                 if id(e) in dx_anc}
+    assert "dot_general" in anc_prims, anc_prims
+    for gv in grad_vars:
+        g_eqn = producers.get(gv)
+        assert g_eqn is not None
+        g_anc = set()
+        for iv in g_eqn.invars:
+            if type(iv).__name__ != "Literal":
+                ancestors(iv, g_anc)
+        g_anc.add(id(g_eqn))
+        g_prims = {e.primitive.name for e in bwd.eqns if id(e) in g_anc}
+        assert "dot_general" in g_prims or "add" in g_prims, g_prims
+        # the final weight-grad accumulation is NOT on dx's path
+        assert id(g_eqn) not in dx_anc, (
+            "dx (the upstream cotangent send) depends on the weight-"
+            "grad accumulation — the ZB W-deferral freedom is absent")
+
+
 def test_compiled_1f1b_runs_framework_gpt_blocks_with_manual_mp():
     """r4 verdict #3: the compiled hybrid TP+PP pipeline must run the
     FRAMEWORK's model code — GPTBlock built from fleet.mp_layers — not
