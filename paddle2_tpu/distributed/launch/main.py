@@ -382,9 +382,10 @@ def launch(argv: Optional[List[str]] = None) -> int:
                       file=sys.stderr)
                 return rc
         if args.elastic_rescale and args.nnodes > 1:
-            print("[launch] --elastic_rescale only rescales the local "
-                  "gang (nnodes == 1); multi-node membership needs the "
-                  "coordination service — restarting at full size",
+            print("[launch] --elastic_rescale without a rendezvous "
+                  "master only rescales the local gang; for multi-node "
+                  "membership run with --rdzv_master host:port "
+                  "(--rdzv_serve on node 0) — restarting at full size",
                   file=sys.stderr)
         if args.elastic_rescale and args.nnodes == 1:
             new_world = max(1, args.nproc_per_node - max(1, n_failed))

@@ -21,6 +21,51 @@ from ..autograd.tape import GradNode
 
 _OP_REGISTRY: Dict[str, Callable] = {}
 
+# optional build-then-run recorder (paddle.static Program capture): when
+# set, every dispatched op reports (name, fn, kwargs, inputs, outputs) —
+# one attribute check on the hot path, None in normal eager execution
+_STATIC_RECORDER = None
+
+
+def set_static_recorder(cb) -> None:
+    global _STATIC_RECORDER
+    _STATIC_RECORDER = cb
+
+
+def _replay_fn(name: str, fn: Callable, kwargs: Dict[str, Any]):
+    """The callable a static Program replays for this op: kwargs bound,
+    and the autocast decision BAKED at record time — build-time
+    execution ran through cast_inputs_for_op under the thread's amp
+    state, which will not exist at replay, so the resolved target dtype
+    is frozen into the node (or an auto_cast-built program would
+    silently replay fp32)."""
+    st = getattr(core._tls(), "amp_state", None)
+    target = None
+    if st is not None and getattr(st, "enable", False):
+        from ..amp import amp_lists
+        white = (name in amp_lists.WHITE_LIST
+                 or name in st.custom_white) \
+            and name not in st.custom_black
+        black = (name in amp_lists.BLACK_LIST
+                 or name in st.custom_black) \
+            and name not in st.custom_white
+        if st.level == "O2":
+            target = jnp.float32 if black else st.dtype
+        elif white:
+            target = st.dtype
+        elif black:
+            target = jnp.float32
+    if target is None and not kwargs:
+        return fn
+
+    def replay(*xs):
+        if target is not None:
+            xs = tuple(a.astype(target)
+                       if jnp.issubdtype(a.dtype, jnp.floating)
+                       and a.dtype != target else a for a in xs)
+        return fn(*xs, **kwargs) if kwargs else fn(*xs)
+    return replay
+
 
 def _maybe_check_finite(name, out):
     """FLAGS_check_nan_inf forward pass (reference nan_inf_utils_detail:
@@ -134,7 +179,11 @@ def apply_op(name: str, fn: Callable, tensors: Sequence[Tensor],
     if not needs_grad:
         out = fn(*arrays, **kwargs) if kwargs else fn(*arrays)
         _maybe_check_finite(name, out)
-        return _wrap_outputs(name, out, False, None)
+        res = _wrap_outputs(name, out, False, None)
+        if _STATIC_RECORDER is not None:
+            _STATIC_RECORDER(name, _replay_fn(name, fn, kwargs), {},
+                             tensors, res)
+        return res
 
     closed = (lambda *xs: fn(*xs, **kwargs)) if kwargs else fn
     out, vjp_fn = jax.vjp(closed, *arrays)
@@ -174,7 +223,11 @@ def apply_op(name: str, fn: Callable, tensors: Sequence[Tensor],
                         out_is_tuple=isinstance(out, (tuple, list)),
                         fwd_fn=closed)
 
-    return _wrap_outputs(name, out, True, node_builder)
+    res = _wrap_outputs(name, out, True, node_builder)
+    if _STATIC_RECORDER is not None:
+        _STATIC_RECORDER(name, _replay_fn(name, fn, kwargs), {},
+                         tensors, res)
+    return res
 
 
 class _ShadowTensor(Tensor):

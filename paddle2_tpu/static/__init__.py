@@ -1,17 +1,33 @@
 """paddle.static (reference python/paddle/static/__init__.py).
 
-TPU-native position: the reference's build-then-run Program/Executor stack
-(SURVEY §2.2 static graph API) is subsumed by jit.to_static — one traced,
-XLA-compiled program. This module keeps the static surface importable:
-InputSpec and the inference-model save/load are fully functional (they map
-onto the StableHLO export); Program/Executor shims run imperatively so
-simple reference scripts keep working.
+TPU-native position: the reference's build-then-run Program/Executor
+stack (SURVEY §2.2 static graph API; ProgramDesc + the L4 graph
+interpreter) maps onto RECORD-THEN-JIT: under ``program_guard`` every
+dispatched op (all ops flow through ``ops.dispatch.apply_op``) is
+recorded into the active :class:`Program` as a replayable node;
+``Executor.run(program, feed, fetch_list)`` replays the recording as
+ONE pure function of the feeds — compiled by XLA via ``jax.jit`` and
+cached — reading parameter values LIVE at run time (so updates between
+runs are visible, which is what the reference's scope-variable
+semantics give). ``static.data`` placeholders are the feed points.
+
+Scope (decision record): forward/inference programs. Static-graph
+TRAINING (append_backward + optimizer ops inside the program) stays on
+``jit.to_static`` / ``jit.train_step`` — on TPU the differentiated,
+donated training step IS the compiled program, and rebuilding the
+reference's op-level backward builder would duplicate it for no
+benefit. ``static.gradients`` works OUTSIDE recording via the eager
+tape.
 """
 
 from __future__ import annotations
 
+import threading
 import warnings
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
 
 from ..jit.api import InputSpec  # full-featured (symbolic-dim export)
 from ..framework.tensor import Tensor
@@ -22,19 +38,148 @@ __all__ = ["InputSpec", "Program", "default_main_program",
            "load_inference_model", "data", "gradients", "py_func", "nn",
            "amp", "device_guard"]
 
+_TLS = threading.local()
+
 
 class Program:
-    """Shim: eager/jit execution has no separate program object; this
-    records nothing and exists so reference-style code constructs."""
+    """Recorded op graph (reference Program/ProgramDesc analog).
+
+    Nodes are (op_name, fn, kwargs, input_ids, output_ids) where fn is
+    the SAME pure JAX function eager dispatch ran (autocast baked in at
+    record time) — replay feeds new arrays through it, so one
+    definition serves eager, jit, and static execution. Inputs that are
+    not produced inside the program (parameters, captured constants)
+    are read from the live Tensor at run() time. The Program holds
+    strong references to every build-time tensor (id-keyed graph needs
+    them alive): build with small placeholder shapes — run() shapes are
+    pinned to the build shapes anyway.
+    """
 
     def __init__(self):
         self.random_seed = 0
+        self._nodes: List[tuple] = []
+        self._feeds: Dict[str, Tensor] = {}
+        self._live: Dict[int, Tensor] = {}   # id -> Tensor keepalive
+        self._version = 0
+        self._exec_cache: Dict[Any, Any] = {}
 
+    # -- recording ------------------------------------------------------
+    def _record(self, name, fn, kwargs, in_tensors, out):
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        in_ids = []
+        for t in in_tensors:
+            in_ids.append(id(t))
+            self._live[id(t)] = t
+        out_ids = []
+        for t in outs:
+            out_ids.append(id(t))
+            self._live[id(t)] = t
+        self._nodes.append((name, fn, dict(kwargs), tuple(in_ids),
+                            tuple(out_ids)))
+        self._version += 1
+
+    def _add_feed(self, name: str, t: Tensor):
+        self._feeds[name] = t
+        self._live[id(t)] = t
+        self._version += 1
+
+    # -- execution ------------------------------------------------------
+    def _execute(self, feed: Dict[str, Any], fetch_list) -> List:
+        import numpy as np
+        if not self._nodes:
+            raise ValueError(
+                "Program is empty — build it under "
+                "`with static.program_guard(prog):` (ops dispatched "
+                "there are recorded)")
+        missing = [n for n in self._feeds if n not in feed]
+        if missing:
+            raise ValueError(f"run() missing feeds {missing}")
+        for n, v in feed.items():
+            ph = self._feeds.get(n)
+            if ph is None:
+                raise ValueError(
+                    f"run() fed unknown placeholder {n!r}; program "
+                    f"feeds are {sorted(self._feeds)}")
+            got = tuple(getattr(v, "shape", np.shape(v)))
+            want = tuple(ph.shape)
+            if got != want:
+                raise ValueError(
+                    f"feed {n!r} shape {got} != built shape {want} — "
+                    "recorded nodes bake build-time dims, so run() "
+                    "shapes must match static.data's (build with the "
+                    "real batch size; -1 dims become 1)")
+        fetches = fetch_list if isinstance(fetch_list, (list, tuple)) \
+            else [fetch_list]
+        fetch_ids = tuple(id(t) for t in fetches)
+        unknown = [i for i, t in zip(fetch_ids, fetches)
+                   if i not in self._live]
+        if unknown:
+            raise ValueError(
+                "fetch_list contains tensors the program did not "
+                "produce")
+
+        feed_arrays = {n: (v._data if isinstance(v, Tensor)
+                           else jnp.asarray(v))
+                       for n, v in feed.items()}
+        # external inputs: ids consumed but never produced and not feeds
+        produced = {i for node in self._nodes for i in node[4]}
+        feed_ids = {id(t): n for n, t in self._feeds.items()}
+        ext_ids = []
+        for node in self._nodes:
+            for i in node[3]:
+                if i not in produced and i not in feed_ids \
+                        and i not in ext_ids:
+                    ext_ids.append(i)
+        ext_arrays = [self._live[i]._data for i in ext_ids]
+
+        key = (self._version, fetch_ids,
+               tuple(sorted((n, tuple(a.shape), str(a.dtype))
+                            for n, a in feed_arrays.items())))
+        fn = self._exec_cache.get(key)
+        if fn is None:
+            nodes = list(self._nodes)
+            feed_name_by_id = dict(feed_ids)
+            ext_index = {i: k for k, i in enumerate(ext_ids)}
+
+            def replay(feed_vals: Dict[str, Any], ext_vals):
+                env: Dict[int, Any] = {}
+                for i, n in feed_name_by_id.items():
+                    env[i] = feed_vals[n]
+                for i, k in ext_index.items():
+                    env[i] = ext_vals[k]
+
+                def val(i):
+                    if i in env:
+                        return env[i]
+                    return self._live[i]._data   # baked const (rare)
+
+                for name, f, kw, in_ids, out_ids in nodes:
+                    args = [val(i) for i in in_ids]
+                    out = f(*args, **kw) if kw else f(*args)
+                    outs = list(out) if isinstance(out, (tuple, list)) \
+                        else [out]
+                    for i, o in zip(out_ids, outs):
+                        env[i] = o
+                return [env[i] for i in fetch_ids]
+
+            fn = jax.jit(replay)
+            if len(self._exec_cache) > 64:
+                self._exec_cache.clear()
+            self._exec_cache[key] = fn
+        outs = fn(feed_arrays, ext_arrays)
+        return [np.asarray(o) for o in outs]
+
+    # -- reference surface ---------------------------------------------
     def global_block(self):
         return self
 
     def clone(self, for_test=False):
-        return Program()
+        p = Program()
+        p._nodes = list(self._nodes)
+        p._feeds = dict(self._feeds)
+        p._live = dict(self._live)
+        p._version = self._version
+        return p
 
 
 _main = Program()
@@ -49,14 +194,50 @@ def default_startup_program() -> Program:
     return _startup
 
 
+def _active_program() -> Optional[Program]:
+    return getattr(_TLS, "program", None)
+
+
+_GUARD_LOCK = threading.Lock()
+_GUARD_COUNT = 0
+
+
+def _recorder(name, fn, kw, ins, out):
+    prog = getattr(_TLS, "program", None)
+    if prog is not None:
+        prog._record(name, fn, kw, ins, out)
+
+
 class program_guard:
+    """Route op recording into `main_program` (reference
+    static.program_guard build-then-run contract). The dispatch hook is
+    installed while ANY thread has an open guard (refcounted) and reads
+    the thread-local program, so concurrent guards on different threads
+    record independently."""
+
     def __init__(self, main_program=None, startup_program=None):
-        pass
+        self.program = main_program if main_program is not None else _main
 
     def __enter__(self):
+        global _GUARD_COUNT
+        from ..ops import dispatch
+        self._prev = getattr(_TLS, "program", None)
+        _TLS.program = self.program
+        if self._prev is None:      # outermost guard on this thread
+            with _GUARD_LOCK:
+                _GUARD_COUNT += 1
+                dispatch.set_static_recorder(_recorder)
         return self
 
     def __exit__(self, *exc):
+        global _GUARD_COUNT
+        from ..ops import dispatch
+        _TLS.program = self._prev
+        if self._prev is None:
+            with _GUARD_LOCK:
+                _GUARD_COUNT -= 1
+                if _GUARD_COUNT == 0:
+                    dispatch.set_static_recorder(None)
         return False
 
 
@@ -82,23 +263,38 @@ class device_guard:
         return False
 
 
-def data(name: str, shape, dtype="float32", lod_level=0) -> InputSpec:
-    """static.data returns an InputSpec placeholder (eager feed model)."""
-    return InputSpec(shape, dtype, name)
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """Feed placeholder. Under an active ``program_guard`` this is a
+    real placeholder Tensor registered as the program's feed point
+    (-1 dims become 1 for build-time shapes; run() feeds must match the
+    built shapes). Outside a guard it stays an InputSpec for the
+    jit.save export path."""
+    prog = _active_program()
+    if prog is None:
+        return InputSpec(shape, dtype, name)
+    concrete = tuple(1 if (d is None or int(d) < 0) else int(d)
+                     for d in shape)
+    t = Tensor(jnp.zeros(concrete, dtype), stop_gradient=True)
+    prog._add_feed(name, t)
+    return t
 
 
 class Executor:
-    """Shim executor: run() calls a python program eagerly. The reference's
-    graph interpreter (SURVEY §1 L4) has no counterpart because jit
-    compiles the whole step; this keeps run()-style scripts alive."""
+    """Executor.run replays a recorded Program as one jitted function
+    of the feeds (reference's L4 graph interpreter, re-expressed as XLA
+    compile-and-cache). Callables still run directly, so both styles of
+    reference script work."""
 
     def __init__(self, place=None):
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
-        if callable(program):
+        if callable(program) and not isinstance(program, Program):
             out = program(**(feed or {}))
             return out if isinstance(out, (list, tuple)) else [out]
+        prog = program if isinstance(program, Program) else _main
+        if prog._nodes or prog._feeds:
+            return prog._execute(feed or {}, fetch_list or [])
         if fetch_list:
             return list(fetch_list)
         return []

@@ -182,6 +182,72 @@ def test_static_shims_and_inference_model(tmp_path):
                                rtol=1e-5, atol=1e-6)
 
 
+def test_static_program_build_then_run():
+    """r4 coverage row 22: Program/Executor are REAL build-then-run —
+    ops dispatched under program_guard record into the Program; run()
+    replays them as one jitted function of the feeds, reading parameter
+    values live."""
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 3))
+    net.eval()
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+        y = net(x)
+        z = (y * 2.0).sum(axis=-1)
+    exe = static.Executor()
+    x_np = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    out_y, out_z = exe.run(prog, feed={"x": x_np}, fetch_list=[y, z])
+    ref = net(paddle.to_tensor(x_np))
+    np.testing.assert_allclose(out_y, ref.numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out_z, (ref.numpy() * 2.0).sum(-1),
+                               rtol=1e-5, atol=1e-6)
+    # parameter values are read LIVE: updating the layer between runs
+    # changes the program's output without rebuilding
+    net[0].weight.set_value(net[0].weight * 0.0)
+    out_y2, = exe.run(prog, feed={"x": x_np}, fetch_list=[y])
+    ref2 = net(paddle.to_tensor(x_np))
+    np.testing.assert_allclose(out_y2, ref2.numpy(), rtol=1e-5,
+                               atol=1e-6)
+    assert not np.allclose(out_y2, out_y)
+    # validation: missing feed and foreign fetch raise clearly
+    with pytest.raises(ValueError, match="missing feeds"):
+        exe.run(prog, feed={}, fetch_list=[y])
+    foreign = paddle.ones([2])
+    with pytest.raises(ValueError, match="did not produce"):
+        exe.run(prog, feed={"x": x_np}, fetch_list=[foreign])
+    # ops outside the guard are NOT recorded
+    n_nodes = len(prog._nodes)
+    _ = net(paddle.to_tensor(x_np))
+    assert len(prog._nodes) == n_nodes
+    # feed shape must match the built shape (dims are baked)
+    with pytest.raises(ValueError, match="built shape"):
+        exe.run(prog, feed={"x": np.zeros((2, 8), np.float32)},
+                fetch_list=[y])
+
+
+def test_static_program_feeds_without_ops_and_amp_build():
+    exe = static.Executor()
+    # feeds registered but nothing recorded: loud error, not zeros
+    empty = static.Program()
+    with static.program_guard(empty):
+        x0 = static.data("x", [2, 2], "float32")
+    with pytest.raises(ValueError, match="empty"):
+        exe.run(empty, feed={"x": np.ones((2, 2), np.float32)},
+                fetch_list=[x0])
+    # a program BUILT under auto_cast replays with the baked cast
+    prog = static.Program()
+    with paddle.amp.auto_cast(True, level="O1", dtype="bfloat16"):
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            y = paddle.matmul(x, paddle.ones([8, 4]))   # white-list op
+    out, = exe.run(prog, feed={"x": np.ones((4, 8), np.float32)},
+                   fetch_list=[y])
+    assert "bfloat16" in str(out.dtype)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.full((4, 4), 8.0, np.float32))
+
+
 # ------------------------------------------------------------- quantization
 
 def test_qat_quantize_and_train():

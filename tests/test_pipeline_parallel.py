@@ -674,17 +674,6 @@ def test_compiled_1f1b_cotangent_send_independent_of_weight_grads():
                        out_specs=(P(), P("pp")))
     jaxpr = jax.make_jaxpr(sm)((W, b), (), x, y)
 
-    def find_eqns(jx, prim):
-        out = []
-        for eqn in jx.eqns:
-            if eqn.primitive.name == prim:
-                out.append(eqn)
-            for v in eqn.params.values():
-                inner = getattr(v, "jaxpr", None)
-                if inner is not None:
-                    out.append(None)  # placeholder; descend explicitly
-        return [e for e in out if e is not None]
-
     # descend: shard_map -> scan -> cond(switch)
     def descend(jx, prim):
         for eqn in jx.eqns:
