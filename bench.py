@@ -229,12 +229,15 @@ def bench_ernie():
     seq = int(os.environ.get("BENCH_SEQ", 128))
     batch = int(os.environ.get("BENCH_BATCH", 32))
     steps = int(os.environ.get("BENCH_STEPS", 30))
+    stacked = os.environ.get("BENCH_STACKED", "1") == "1"
     if on_tpu:
         cfg = ernie3_base(hidden_dropout_prob=0.0,
-                          attention_dropout_prob=0.0)
+                          attention_dropout_prob=0.0,
+                          stacked_blocks=stacked)
     else:
         cfg = ernie_tiny(hidden_dropout_prob=0.0,
-                         attention_dropout_prob=0.0)
+                         attention_dropout_prob=0.0,
+                         stacked_blocks=stacked)
         seq, batch, steps = 32, 4, 3
     paddle.seed(0)
     model = ErnieForSequenceClassification(cfg)

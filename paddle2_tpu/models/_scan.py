@@ -143,7 +143,19 @@ class StackedLayerStack(*_layer_base()):
         return out
 
     def layer_slice_call(self, i: int, x, **kwargs):
-        """Run block i on x (decode/cache paths; no_grad only)."""
+        """Run block i on x (decode/cache/attn-bias paths). Traced or
+        no_grad only: eager differentiable execution cannot route grads
+        back to the stacked leaves through the rebound template."""
+        import jax
+        from ..framework import core
+        data = getattr(x, "_data", x)
+        if not isinstance(data, jax.core.Tracer) \
+                and core.is_grad_enabled() \
+                and not getattr(x, "stop_gradient", True):
+            raise RuntimeError(
+                "stacked_blocks: eager differentiable execution is not "
+                "supported — run under jit.to_static / jit.train_step, "
+                "or use no_grad for inference")
         stacked = [self.stacked_leaf(n)._data for n in self._names]
         originals = self._rebind([s[i] for s in stacked])
         try:

@@ -39,6 +39,11 @@ class ErnieConfig:
     layer_norm_epsilon: float = 1e-12
     num_classes: int = 2
     use_scan: bool = True
+    # [L, ...] stacked parameter storage for the encoder stack (see
+    # GPTConfig.stacked_blocks / models/_scan.py StackedLayerStack):
+    # removes the per-step restack of the scan operands. Per-layer
+    # sublayers stop being addressable; eager training requires jit.
+    stacked_blocks: bool = False
 
     @property
     def ffn_size(self) -> int:
@@ -106,8 +111,12 @@ class ErnieModel(nn.Layer):
                                      weight_attr=_attr(std))
         self.emb_ln = nn.LayerNorm(h, epsilon=cfg.layer_norm_epsilon)
         self.drop = nn.Dropout(cfg.hidden_dropout_prob)
-        self.layers = nn.LayerList([ErnieLayer(cfg)
-                                    for _ in range(cfg.num_layers)])
+        blocks = [ErnieLayer(cfg) for _ in range(cfg.num_layers)]
+        if cfg.stacked_blocks:
+            from ._scan import StackedLayerStack
+            self.layers = StackedLayerStack(blocks)
+        else:
+            self.layers = nn.LayerList(blocks)
         self.pooler = nn.Linear(h, h, weight_attr=_attr(std))
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
@@ -133,6 +142,13 @@ class ErnieModel(nn.Layer):
                           neg).astype(x._data.dtype))
         if self._can_scan(x, attn_bias):
             x = self._scan_layers(x)
+        elif self.cfg.stacked_blocks:
+            if attn_bias is None:
+                x = self.layers(x, allow_scan=False)
+            else:
+                for i in range(self.cfg.num_layers):
+                    x = self.layers.layer_slice_call(i, x,
+                                                     attn_bias=attn_bias)
         else:
             for layer in self.layers:
                 x = layer(x, attn_bias)
@@ -141,7 +157,7 @@ class ErnieModel(nn.Layer):
 
     def _can_scan(self, x, attn_bias) -> bool:
         cfg = self.cfg
-        return (cfg.use_scan and len(self.layers) > 1 and attn_bias is None
+        return (cfg.use_scan and cfg.num_layers > 1 and attn_bias is None
                 and isinstance(x._data, jax.core.Tracer)
                 and (not self.training
                      or (cfg.hidden_dropout_prob == 0.0
@@ -150,6 +166,8 @@ class ErnieModel(nn.Layer):
     def _scan_layers(self, x: Tensor) -> Tensor:
         """Depth-independent compile: one scanned block body (shared
         machinery in models/_scan.py)."""
+        if self.cfg.stacked_blocks:
+            return self.layers(x)
         from ._scan import scan_layer_stack
         out = scan_layer_stack(list(self.layers), x)
         if out is not None:

@@ -56,6 +56,46 @@ def test_scan_matches_loop():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_stacked_blocks_matches_per_block_and_masked_path():
+    """ErnieConfig.stacked_blocks parity ([L,...] leaves, r5): same
+    outputs as per-block storage, trainable via train_step, and the
+    attention-mask path (unscannable) runs through the slice loop."""
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 256, (2, 16)).astype(np.int32))
+    paddle.seed(0)
+    ma = ErnieForSequenceClassification(
+        ernie_tiny(hidden_dropout_prob=0.0, attention_dropout_prob=0.0))
+    paddle.seed(0)
+    mb = ErnieForSequenceClassification(
+        ernie_tiny(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                   stacked_blocks=True))
+    assert sum(p.size for p in ma.parameters()) \
+        == sum(p.size for p in mb.parameters())
+    sa = paddle.jit.to_static(lambda x: ma(x))
+    sb = paddle.jit.to_static(lambda x: mb(x))
+    np.testing.assert_allclose(sa(ids).numpy(), sb(ids).numpy(),
+                               rtol=1e-4, atol=1e-5)
+    # masked (non-scan) path parity
+    mask = paddle.to_tensor(
+        np.array([[1] * 16, [1] * 9 + [0] * 7], np.int32))
+    sa_m = paddle.jit.to_static(lambda x, mk: ma(x, attention_mask=mk))
+    sb_m = paddle.jit.to_static(lambda x, mk: mb(x, attention_mask=mk))
+    np.testing.assert_allclose(sa_m(ids, mask).numpy(),
+                               sb_m(ids, mask).numpy(),
+                               rtol=1e-4, atol=1e-5)
+    # trains through the fused step
+    o = opt.AdamW(learning_rate=1e-3, parameters=mb.parameters())
+
+    def fn(i, l):
+        _, loss = mb(i, labels=l)
+        return loss
+
+    step = paddle.jit.train_step(fn, o, layers=[mb])
+    lbl = paddle.to_tensor(rs.randint(0, 2, (2,)).astype(np.int32))
+    losses = [float(step(ids, lbl)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+
+
 def test_finetune_step_decreases_loss():
     paddle.seed(0)
     cfg = ernie_tiny(hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
