@@ -67,6 +67,10 @@ def _fwd_chunks(x, weight, labels, ignore_index, chunk):
         loss = jnp.where(ok, lse - picked, 0.0)
         return carry, (loss, ok, lse)
 
+    if nchunk == 1:          # no scan machinery for the whole-batch chunk
+        _, (loss, ok, lse) = body(0, (xs[0], ls[0]))
+        return loss, ok, lse
+
     _, (losses, valid, lses) = jax.lax.scan(body, 0, (xs, ls))
     return (losses.reshape(n), valid.reshape(n), lses.reshape(n))
 
@@ -106,6 +110,11 @@ def _fle_bwd(ignore_index, chunk, res, cts):
             xc, dlogits, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dw_acc, dx
+
+    if nchunk == 1:
+        dw, dx = body(jnp.zeros((h, v), jnp.float32),
+                      (xs[0], ls[0], gs[0], lse_s[0]))
+        return dx, dw.astype(weight.dtype), None
 
     dw, dxs = jax.lax.scan(
         body, jnp.zeros((h, v), jnp.float32), (xs, ls, gs, lse_s))

@@ -189,10 +189,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
         _flash_finalize(o_ref, lse_ref, acc, m_sc, l_sc)
 
 
+def _clamp_blocks_for_dtype(dtype, block_q, block_k):
+    """Non-bf16 inputs double the VMEM a tile needs: the 1024x1024
+    defaults that fit bf16 blow the scoped-vmem budget for f32 (compile
+    fails with a stack OOM). Halve the blocks for >=4-byte dtypes."""
+    if jnp.dtype(dtype).itemsize >= 4:
+        return min(block_q, 512), min(block_k, 512)
+    return block_q, block_k
+
+
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     """q,k,v: (B, H, S, D) — returns (o, lse)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
+    block_q, block_k = _clamp_blocks_for_dtype(q.dtype, block_q, block_k)
     bq, bk = _fit_block(Sq, block_q), _fit_block(Sk, block_k)
     nq, nk = Sq // bq, Sk // bk
 
@@ -389,6 +399,7 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
     Sk = k.shape[2]
     block_q = BWD_BLOCK_Q or block_q
     block_k = BWD_BLOCK_K or block_k
+    block_q, block_k = _clamp_blocks_for_dtype(q.dtype, block_q, block_k)
     bq, bk = _fit_block(Sq, block_q), _fit_block(Sk, block_k)
     nq, nk = Sq // bq, Sk // bk
 
