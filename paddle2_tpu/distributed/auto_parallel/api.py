@@ -495,7 +495,7 @@ class Strategy:
         self.amp = sub("amp", enable=False, dtype="bfloat16", level="O2")
         self.recompute = sub("recompute", enable=False, granularity="full")
         self.pipeline = sub("pipeline", enable=False, schedule_mode="1F1B",
-                            accumulate_steps=1)
+                            accumulate_steps=1, vpp_degree=1)
         self.fused_passes = sub("fused_passes", enable=False,
                                 fused_passes_list=[])
         self.gradient_merge = sub("gradient_merge", enable=False, k_steps=1,
@@ -557,13 +557,25 @@ class DistModel:
         self._pp_enabled = bool(st.pipeline.enable)
         if self._pp_enabled:
             mode = str(getattr(st.pipeline, "schedule_mode", "1F1B"))
-            if mode.upper() not in ("1F1B", "FTHENB", "GPIPE"):
+            if mode.upper() not in ("1F1B", "FTHENB", "GPIPE", "VPP"):
                 raise NotImplementedError(
                     f"Strategy.pipeline.schedule_mode={mode!r}: compiled "
-                    "schedules are 1F1B and GPipe(FThenB)")
+                    "schedules are 1F1B, GPipe(FThenB), and VPP")
             self._pp_mode = mode.upper()
             self._pp_micro = max(1, int(getattr(st.pipeline,
                                                 "accumulate_steps", 1)))
+            self._pp_vpp = max(1, int(getattr(st.pipeline,
+                                              "vpp_degree", 1)))
+            if self._pp_mode == "VPP" and self._pp_vpp < 2:
+                raise ValueError(
+                    "Strategy.pipeline.schedule_mode='VPP' needs "
+                    "vpp_degree >= 2 (chunks per device); with 1 chunk "
+                    "use schedule_mode='1F1B'")
+            if self._pp_mode != "VPP" and self._pp_vpp > 1:
+                raise ValueError(
+                    f"Strategy.pipeline.vpp_degree={self._pp_vpp} only "
+                    "applies to schedule_mode='VPP' — it would be "
+                    f"silently ignored under {self._pp_mode!r}")
             self._pp_stages = None  # built lazily on first train call
 
         opt = optimizer
@@ -758,10 +770,12 @@ class DistModel:
                 f"homogeneous blocks; got {type(self.network).__name__}. "
                 "For heterogeneous models call fleet.pipeline_spmd_1f1b "
                 "directly with a stage_fn")
-        if len(blocks) % S != 0:
+        V = getattr(self, "_pp_vpp", 1) if self._pp_mode == "VPP" else 1
+        if len(blocks) % (S * V) != 0:
             raise ValueError(
-                f"{len(blocks)} blocks do not partition into pp={S} "
-                "stages")
+                f"{len(blocks)} blocks do not partition into pp={S}"
+                + (f" x vpp_degree={V} virtual stages" if V > 1
+                   else " stages"))
         per = [[p for _, p in b.named_parameters()] for b in blocks]
         # every stage executes blocks[0]'s forward with swapped-in params,
         # so homogeneity must cover class and buffers, not just params
@@ -777,7 +791,7 @@ class DistModel:
                 "(same class, params, buffers — each stage runs block "
                 f"0's forward); block {bad} differs: {sig[bad]} vs "
                 f"{sig[0]}")
-        k = len(blocks) // S
+        k = len(blocks) // (S * V)
         loss_layer = self._loss
         amp_cfg = self._amp_cfg
 
@@ -810,7 +824,7 @@ class DistModel:
             return (res._data if isinstance(res, Tensor) else res
                     ).astype(jnp.float32)
 
-        self._pp_stages = (S, k, blocks, per, stage_fn, loss_fn)
+        self._pp_stages = (S, k, V, blocks, per, stage_fn, loss_fn)
         self._pp_gpipe_cache = {}
 
     def _pp_gpipe_step(self, stacked, x_micro, l_micro):
@@ -818,7 +832,7 @@ class DistModel:
         pipeline (pipeline_spmd is differentiable end-to-end); cached
         jitted value_and_grad per geometry."""
         from ..fleet.spmd_pipeline import pipeline_spmd
-        S, k, blocks, per, stage_fn, loss_fn = self._pp_stages
+        S, k, _V, blocks, per, stage_fn, loss_fn = self._pp_stages
         key = (tuple(x_micro.shape), str(x_micro.dtype),
                tuple(l_micro.shape))
         fn = self._pp_gpipe_cache.get(key)
@@ -841,7 +855,7 @@ class DistModel:
         from ..fleet.spmd_pipeline import pipeline_spmd_1f1b
         if self._pp_stages is None:
             self._pp_prepare()
-        S, k, blocks, per, stage_fn, loss_fn = self._pp_stages
+        S, k, V, blocks, per, stage_fn, loss_fn = self._pp_stages
         if len(args) != 2:
             raise NotImplementedError(
                 f"Strategy.pipeline DistModel takes exactly (input, "
@@ -879,12 +893,28 @@ class DistModel:
                 jm, PartitionSpec("pp", *([None] * (a.ndim - 1)))))
 
         repl = NamedSharding(jm, PartitionSpec())
-        stacked = [
-            [place_stage(jnp_.stack([per[s * k + j][i]._data
-                                     for s in range(S)]))
-             for i in range(len(per[0]))]
-            for j in range(k)
-        ]
+        if V > 1:
+            # [V, S, ...] leaves: virtual stage v*S + s = chunk v on
+            # device s covers blocks [(v*S+s)*k, (v*S+s+1)*k)
+            def place_chunk(a):
+                return jax.device_put(a, NamedSharding(
+                    jm, PartitionSpec(None, "pp",
+                                      *([None] * (a.ndim - 2)))))
+            stacked = [
+                [place_chunk(jnp_.stack([
+                    jnp_.stack([per[(v * S + s) * k + j][i]._data
+                                for s in range(S)])
+                    for v in range(V)]))
+                 for i in range(len(per[0]))]
+                for j in range(k)
+            ]
+        else:
+            stacked = [
+                [place_stage(jnp_.stack([per[s * k + j][i]._data
+                                         for s in range(S)]))
+                 for i in range(len(per[0]))]
+                for j in range(k)
+            ]
         # ZeRO+PP: microbatches shard their batch dim over the sharding/
         # dp axis; the compiled program dp-means loss and grads
         data_sh = repl if self._zero_pp_axis is None else NamedSharding(
@@ -896,19 +926,27 @@ class DistModel:
             loss, grads = pipeline_spmd_1f1b(stage_fn, stacked, x_micro,
                                              l_micro, loss_fn,
                                              dp_axis=self._zero_pp_axis)
+        elif self._pp_mode == "VPP":
+            from ..fleet.spmd_pipeline import pipeline_spmd_vpp
+            loss, grads = pipeline_spmd_vpp(stage_fn, stacked, x_micro,
+                                            l_micro, loss_fn,
+                                            n_chunks=V)
         else:                                    # GPIPE / FTHENB
             loss, grads = self._pp_gpipe_step(stacked, x_micro, l_micro)
-        # write grads back per block (unstack the stage axis) and step
+        # write grads back per block (unstack the stage/chunk axes) and
+        # step
         for j in range(k):
             for i in range(len(per[0])):
                 g = grads[j][i]
                 for s in range(S):
-                    p = per[s * k + j][i]
-                    gp = g[s].astype(p._data.dtype)
-                    if p.grad is None:
-                        p.grad = Tensor(gp)
-                    else:
-                        p.grad._replace_data(p.grad._data + gp)
+                    for v in range(V):
+                        p = per[(v * S + s) * k + j][i]
+                        gp = (g[v][s] if V > 1 else g[s]).astype(
+                            p._data.dtype)
+                        if p.grad is None:
+                            p.grad = Tensor(gp)
+                        else:
+                            p.grad._replace_data(p.grad._data + gp)
         self._optimizer.step()
         self._optimizer.clear_grad()
         return Tensor(loss, stop_gradient=True)

@@ -789,6 +789,48 @@ class TestStrategyPasses:
                                "pipeline": {"enable": True,
                                             "accumulate_steps": 4}}))
 
+    def test_pipeline_vpp_schedule_matches_1f1b(self):
+        """r4 weak #9: compiled interleaved-VPP is reachable from
+        Strategy (schedule_mode='VPP', vpp_degree) and trains
+        identically to 1F1B — both compute the same sequential model."""
+        import paddle2_tpu.optimizer as opt
+        import paddle2_tpu.distributed as pdist
+        pdist.init_mesh({"pp": 4, "dp": 2})
+
+        def run(mode, vpp):
+            paddle.seed(13)
+            m = nn.Sequential(*[nn.Linear(8, 8) for _ in range(8)])
+            o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+            model = dist.to_static(
+                m, None, nn.MSELoss(), o,
+                dist.Strategy({"pipeline": {"enable": True,
+                                            "schedule_mode": mode,
+                                            "vpp_degree": vpp,
+                                            "accumulate_steps": 4}}))
+            rs = np.random.RandomState(2)
+            x = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+            y = paddle.zeros([8, 8])
+            loss = float(np.asarray(model(x, y)._data))
+            return loss, m[0].weight.numpy()
+
+        l1, w1 = run("1F1B", 1)
+        l2, w2 = run("VPP", 2)
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+        np.testing.assert_allclose(w1, w2, rtol=1e-4, atol=1e-6)
+
+    def test_pipeline_vpp_needs_degree(self):
+        import paddle2_tpu.optimizer as opt
+        import paddle2_tpu.distributed as pdist
+        pdist.init_mesh({"pp": 4, "dp": 2})
+        paddle.seed(0)
+        m = nn.Sequential(*[nn.Linear(8, 8) for _ in range(8)])
+        o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+        with pytest.raises(ValueError, match="vpp_degree"):
+            dist.to_static(m, None, nn.MSELoss(), o,
+                           dist.Strategy({"pipeline": {
+                               "enable": True,
+                               "schedule_mode": "VPP"}}))
+
     def test_pipeline_rejects_heterogeneous_blocks(self):
         import paddle2_tpu.optimizer as opt
         import paddle2_tpu.distributed as pdist
