@@ -590,6 +590,9 @@ class TestCompiledVPP:
         import paddle2_tpu.distributed as dist
         from paddle2_tpu.distributed.fleet.spmd_pipeline import (
             _PIPE_CACHE, pipeline_spmd_vpp)
+        # the cache is global and other tests create vpp entries with
+        # different geometries — this test must read ITS OWN program
+        _PIPE_CACHE.clear()
         dist.init_mesh({"pp": 4, "dp": 2})
         V, S, M, B, H = 2, 4, 8, 4, 64
         rs = np.random.RandomState(0)
