@@ -52,6 +52,21 @@ class StackedLayerStack(*_layer_base()):
         for n in names:
             stackedv = jnp.stack([per[i][n]._data
                                   for i in range(len(blocks))])
+            src = per[0][n]._data
+            src_sharding = getattr(src, "sharding", None)
+            if src_sharding is not None \
+                    and getattr(src_sharding, "spec", None) is not None \
+                    and len(getattr(src_sharding, "device_set", ())) > 1:
+                # TP-sharded source params (mp_layers): keep the shard
+                # spec on the stacked leaf (layer axis replicated) —
+                # jnp.stack would otherwise silently re-place
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec
+                spec = tuple(src_sharding.spec)
+                spec = spec + (None,) * (src.ndim - len(spec))
+                stackedv = jax.device_put(
+                    stackedv, NamedSharding(src_sharding.mesh,
+                                            PartitionSpec(None, *spec)))
             p = Parameter(stackedv)
             # carry regularization/clip attrs from the template leaf
             for attr in ("need_clip", "no_weight_decay"):

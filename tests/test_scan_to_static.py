@@ -215,6 +215,27 @@ def test_stacked_blocks_matches_per_block_storage():
     np.testing.assert_array_equal(out.numpy(), out_a.numpy())
 
 
+def test_stacked_blocks_preserves_tp_sharding():
+    """stacked_blocks + tensor_parallel: jnp.stack would silently
+    re-place mp-sharded weights; the stacked leaf must carry
+    P(None, <orig spec>) — layer axis replicated, TP dims sharded."""
+    import paddle2_tpu.distributed as pdist
+    pdist.init_mesh({"dp": 4, "mp": 2})
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=3,
+                    num_heads=2, max_position_embeddings=32,
+                    tensor_parallel=True, stacked_blocks=True)
+    m = GPTForCausalLM(cfg)
+    qkv = dict(m.named_parameters())["gpt.h.stacked_attn__qkv__weight"]
+    assert "mp" in str(qkv._data.sharding.spec)
+    ids = _ids()
+    st = paddle.jit.to_static(lambda i: m(i, labels=i)[1])
+    loss = st(ids)
+    loss.backward()
+    assert np.isfinite(float(loss.numpy()))
+    assert qkv.grad is not None
+
+
 def test_convert_pre_r5_qkv_weight_roundtrip():
     """The r5 head-major qkv layout converter: a weight stored in the
     pre-r5 (q|k|v)-major column order maps onto head-major exactly."""
