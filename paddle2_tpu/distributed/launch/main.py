@@ -10,7 +10,12 @@ TCPStore rendezvous analog). The launcher:
   * spawns + babysits worker processes, streaming logs per rank,
   * on a worker failure kills the gang (comm-watchdog parity,
     SURVEY §5.3) and, with --max_restarts > 0, relaunches the remaining
-    gang — the elastic manager's restart loop (fleet/elastic/manager.py).
+    gang — the elastic manager's restart loop (fleet/elastic/manager.py),
+  * with --rdzv_master (+ --rdzv_serve on node 0) joins the HTTP
+    rendezvous job (launch/master.py — the reference's
+    controllers/master.py pod/job membership): every membership change
+    rescales every node's gang, giving multi-node elastic scale-IN
+    (dead-pod sweep) and scale-UP (node rejoin).
 """
 
 from __future__ import annotations
