@@ -141,21 +141,27 @@ class StackedLayerStack(*_layer_base()):
             return out
         # eager: python loop over layer slices. Reads are device views;
         # grads cannot route back to the stacked leaves through the
-        # rebound template, so eager TRAINING is rejected loudly.
-        if core.is_grad_enabled() and not x.stop_gradient:
+        # rebound template, so eager TRAINING is rejected loudly. In
+        # eval mode the loop runs under no_grad and DETACHES the output
+        # — a later backward then fails cleanly instead of silently
+        # omitting the block grads.
+        if self._template.training and core.is_grad_enabled() \
+                and not x.stop_gradient:
             raise RuntimeError(
                 "stacked_blocks: eager differentiable execution is not "
                 "supported — run under jit.to_static / jit.train_step, "
                 "or use no_grad for inference (set stacked_blocks=False "
                 "for eager training)")
         out = x
-        for i in range(self.n_layers):
-            originals = self._rebind([s[i] for s in stacked])
-            try:
-                out = self._template(out)
-            finally:
-                self._restore(originals)
-        return out
+        with core.no_grad():
+            for i in range(self.n_layers):
+                originals = self._rebind([s[i] for s in stacked])
+                try:
+                    out = self._template(out)
+                finally:
+                    self._restore(originals)
+        return Tensor(out._data, stop_gradient=True) \
+            if isinstance(out, Tensor) else out
 
     def layer_slice_call(self, i: int, x, **kwargs):
         """Run block i on x (decode/cache/attn-bias paths). Traced or

@@ -208,6 +208,21 @@ def test_stacked_blocks_matches_per_block_storage():
     out = mb.generate(paddle.to_tensor(np.array([[1, 2, 3]], "int32")),
                       max_new_tokens=4, temperature=0.0)
     assert tuple(out.shape) == (1, 7)
+    # plain eval-mode eager forward works (detached output) and the
+    # jit.save/load + state_dict roundtrips hold for stacked storage
+    logits = mb(ids)        # trunk runs detached; head may re-attach
+    st_eval = paddle.jit.to_static(lambda i: mb(i))
+    np.testing.assert_allclose(logits.numpy(), st_eval(ids).numpy(),
+                               rtol=1e-5, atol=1e-5)
+    import tempfile
+    import os as _os
+    d = tempfile.mkdtemp()
+    paddle.jit.save(mb, _os.path.join(d, "g"),
+                    input_spec=[paddle.static.InputSpec(
+                        list(ids.shape), "int32")])
+    loaded = paddle.jit.load(_os.path.join(d, "g"))
+    np.testing.assert_allclose(loaded(ids).numpy(), logits.numpy(),
+                               rtol=1e-5, atol=1e-5)
     # and matches the per-block model's greedy decode
     ma.eval()
     out_a = ma.generate(paddle.to_tensor(np.array([[1, 2, 3]], "int32")),
