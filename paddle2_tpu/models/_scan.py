@@ -22,6 +22,42 @@ def _layer_base():
     return (Layer,)
 
 
+def _poison_for_grad(out):
+    """Mark eager slice-path outputs so a backward that reaches them
+    RAISES: grads through the rebound template cannot reach the stacked
+    leaves, and a plain detach would let downstream trainable params
+    (e.g. a tied LM head) re-attach and train on silently-partial
+    grads. Pure inference (no backward) pays nothing."""
+    from ..framework import core
+    if not core.is_grad_enabled():
+        return out
+
+    def wrap(t):
+        if not isinstance(t, Tensor):
+            return t
+        from ..autograd.tape import GradNode
+
+        def boom(_cts):
+            raise RuntimeError(
+                "stacked_blocks: a backward pass reached the output of "
+                "the eager slice path — gradients cannot flow to the "
+                "stacked leaves here; run the forward under "
+                "jit.to_static / jit.train_step (or no_grad if you did "
+                "not want gradients)")
+        nt = Tensor(t._data, stop_gradient=False)
+        nt._grad_node = GradNode(
+            "stacked_poison", boom, [],
+            [(tuple(t._data.shape), t._data.dtype)])
+        nt._output_index = 0
+        return nt
+
+    if isinstance(out, tuple):
+        return tuple(wrap(t) for t in out)
+    if isinstance(out, list):
+        return [wrap(t) for t in out]
+    return wrap(out)
+
+
 class StackedLayerStack(*_layer_base()):
     """Homogeneous block stack whose parameters LIVE stacked: one
     ``[L, ...]`` Parameter per template leaf, consumed by ``lax.scan``
@@ -141,12 +177,12 @@ class StackedLayerStack(*_layer_base()):
             return out
         # eager: python loop over layer slices. Reads are device views;
         # grads cannot route back to the stacked leaves through the
-        # rebound template, so eager TRAINING is rejected loudly. In
-        # eval mode the loop runs under no_grad and DETACHES the output
-        # — a later backward then fails cleanly instead of silently
-        # omitting the block grads.
-        if self._template.training and core.is_grad_enabled() \
-                and not x.stop_gradient:
+        # rebound template. Training mode rejects up front; otherwise
+        # the loop runs under no_grad and the output is POISONED: a
+        # later backward that reaches it raises instead of silently
+        # producing partial grads (e.g. head-only paths re-attaching
+        # after a plain detach).
+        if self._template.training and core.is_grad_enabled():
             raise RuntimeError(
                 "stacked_blocks: eager differentiable execution is not "
                 "supported — run under jit.to_static / jit.train_step, "
@@ -160,19 +196,21 @@ class StackedLayerStack(*_layer_base()):
                     out = self._template(out)
                 finally:
                     self._restore(originals)
-        return Tensor(out._data, stop_gradient=True) \
-            if isinstance(out, Tensor) else out
+        return _poison_for_grad(out)
 
     def layer_slice_call(self, i: int, x, **kwargs):
-        """Run block i on x (decode/cache/attn-bias paths). Traced or
-        no_grad only: eager differentiable execution cannot route grads
-        back to the stacked leaves through the rebound template."""
+        """Run block i on x (decode/cache/attn-bias paths). Traced
+        execution differentiates through the slices; EAGER execution
+        runs under no_grad with a poisoned output — grads cannot route
+        back to the stacked leaves through the rebound template, and a
+        backward that reaches the output must fail loudly rather than
+        silently dropping them."""
         import jax
         from ..framework import core
         data = getattr(x, "_data", x)
-        if not isinstance(data, jax.core.Tracer) \
-                and core.is_grad_enabled() \
-                and not getattr(x, "stop_gradient", True):
+        tracing = isinstance(data, jax.core.Tracer)
+        if not tracing and self._template.training \
+                and core.is_grad_enabled():
             raise RuntimeError(
                 "stacked_blocks: eager differentiable execution is not "
                 "supported — run under jit.to_static / jit.train_step, "
@@ -180,7 +218,11 @@ class StackedLayerStack(*_layer_base()):
         stacked = [self.stacked_leaf(n)._data for n in self._names]
         originals = self._rebind([s[i] for s in stacked])
         try:
-            return self._template(x, **kwargs)
+            if tracing:
+                return self._template(x, **kwargs)
+            with core.no_grad():
+                out = self._template(x, **kwargs)
+            return _poison_for_grad(out)
         finally:
             self._restore(originals)
 

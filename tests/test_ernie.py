@@ -83,6 +83,15 @@ def test_stacked_blocks_matches_per_block_and_masked_path():
     np.testing.assert_allclose(sa_m(ids, mask).numpy(),
                                sb_m(ids, mask).numpy(),
                                rtol=1e-4, atol=1e-5)
+    # eval-mode EAGER forward with a mask works (slice loop, poisoned
+    # output — no grads through the eager path)
+    ma.eval()
+    mb.eval()
+    np.testing.assert_allclose(mb(ids, attention_mask=mask).numpy(),
+                               ma(ids, attention_mask=mask).numpy(),
+                               rtol=1e-4, atol=1e-5)
+    ma.train()
+    mb.train()
     # trains through the fused step
     o = opt.AdamW(learning_rate=1e-3, parameters=mb.parameters())
 

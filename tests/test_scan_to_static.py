@@ -144,7 +144,7 @@ def test_generate_kv_cache_matches_full_recompute():
     assert out.shape[1] == 13
 
 
-def test_stacked_blocks_matches_per_block_storage():
+def test_stacked_blocks_matches_per_block_storage(tmp_path):
     """cfg.stacked_blocks: [L,...] parameter storage must be numerically
     identical to per-block storage (same seed/init), trainable through
     jit.train_step, and reject eager differentiable execution loudly
@@ -210,17 +210,20 @@ def test_stacked_blocks_matches_per_block_storage():
     assert tuple(out.shape) == (1, 7)
     # plain eval-mode eager forward works (detached output) and the
     # jit.save/load + state_dict roundtrips hold for stacked storage
-    logits = mb(ids)        # trunk runs detached; head may re-attach
+    logits = mb(ids)        # eager slice loop, poisoned output
     st_eval = paddle.jit.to_static(lambda i: mb(i))
     np.testing.assert_allclose(logits.numpy(), st_eval(ids).numpy(),
                                rtol=1e-5, atol=1e-5)
-    import tempfile
-    import os as _os
-    d = tempfile.mkdtemp()
-    paddle.jit.save(mb, _os.path.join(d, "g"),
+    # a backward that reaches the eager slice path raises instead of
+    # training downstream params on silently-partial grads (the tied
+    # head re-attaches the graph after the trunk)
+    with pytest.raises(RuntimeError, match="backward pass reached"):
+        mb(ids).sum().backward()
+    path = str(tmp_path / "g")
+    paddle.jit.save(mb, path,
                     input_spec=[paddle.static.InputSpec(
                         list(ids.shape), "int32")])
-    loaded = paddle.jit.load(_os.path.join(d, "g"))
+    loaded = paddle.jit.load(path)
     np.testing.assert_allclose(loaded(ids).numpy(), logits.numpy(),
                                rtol=1e-5, atol=1e-5)
     # and matches the per-block model's greedy decode
