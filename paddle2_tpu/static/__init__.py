@@ -36,7 +36,7 @@ __all__ = ["InputSpec", "Program", "default_main_program",
            "default_startup_program", "program_guard", "name_scope",
            "Executor", "global_scope", "save_inference_model",
            "load_inference_model", "data", "gradients", "py_func", "nn",
-           "amp", "device_guard"]
+           "amp", "device_guard", "append_backward"]
 
 _TLS = threading.local()
 
@@ -325,6 +325,20 @@ def load_inference_model(path_prefix: str, executor=None, **kwargs):
     from .. import jit
     loaded = jit.load(path_prefix)
     return [loaded, [], []]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Reference static.append_backward builds op-level backward into
+    the Program. Decision record (module docstring): static-graph
+    TRAINING maps onto ``jit.train_step`` / ``jit.to_static`` — the
+    differentiated, donated training step IS the compiled program on
+    TPU. Use those, or ``static.gradients`` on eager tensors."""
+    raise NotImplementedError(
+        "paddle.static.append_backward: static-graph training maps onto "
+        "jit.train_step / jit.to_static on this framework (see "
+        "paddle2_tpu/static/__init__.py decision record); "
+        "static.gradients works on eager tensors")
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
