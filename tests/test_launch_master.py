@@ -94,7 +94,7 @@ while True:
     return script
 
 
-def _launcher(script, marker, port, node_rank, serve, tmp_path):
+def _launcher(script, marker, port, node_rank, serve, tmp_path, nproc=1):
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("JAX_", "PADDLE_"))}
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
@@ -102,7 +102,7 @@ def _launcher(script, marker, port, node_rank, serve, tmp_path):
     cmd = [sys.executable, "-m", "paddle2_tpu.distributed.launch",
            "--rdzv_master", f"127.0.0.1:{port}",
            "--rdzv_beat", "0.4", "--rdzv_dead", "2.5",
-           "--node_rank", str(node_rank), "--nproc_per_node", "1",
+           "--node_rank", str(node_rank), "--nproc_per_node", str(nproc),
            "--max_restarts", "5", str(script), str(marker)]
     if serve:
         cmd.insert(3, "--rdzv_serve")
@@ -165,5 +165,47 @@ def test_two_node_elastic_scale_in_and_up(tmp_path):
                     after_ts=t_scaled_in)
     finally:
         for p in (a, b, b2):
+            if p is not None and p.poll() is None:
+                _killpg(p)
+
+
+def test_two_node_two_proc_rank_offsets(tmp_path):
+    """nproc_per_node=2 across 2 nodes: the master-assigned rank
+    offsets must produce global ranks 0..3 with node 1 offset by 2."""
+    script = tmp_path / "ranks.py"
+    script.write_text("""
+import json, os, sys, time
+out = sys.argv[1] + ".node" + os.environ["PADDLE_NODE_RANK"]
+for _ in range(50):
+    with open(out, "a") as f:
+        f.write(json.dumps({
+            "world": int(os.environ["PADDLE_TRAINERS_NUM"]),
+            "rank": int(os.environ["PADDLE_TRAINER_ID"]),
+            "local": int(os.environ["PADDLE_LOCAL_RANK"]),
+            "ts": time.time()}) + "\\n")
+    time.sleep(0.2)
+""")
+    marker = tmp_path / "r"
+    port = _free_port()
+    a = b = None
+    try:
+        a = _launcher(script, marker, port, 0, True, tmp_path, nproc=2)
+        b = _launcher(script, marker, port, 1, False, tmp_path, nproc=2)
+        deadline = time.time() + 40
+        got = {}
+        while time.time() < deadline and len(got) < 4:
+            for node in (0, 1):
+                try:
+                    with open(str(marker) + f".node{node}") as f:
+                        for line in f.read().splitlines():
+                            d = json.loads(line)
+                            if d["world"] == 4:
+                                got[(node, d["local"])] = d["rank"]
+                except FileNotFoundError:
+                    pass
+            time.sleep(0.3)
+        assert got == {(0, 0): 0, (0, 1): 1, (1, 0): 2, (1, 1): 3}, got
+    finally:
+        for p in (a, b):
             if p is not None and p.poll() is None:
                 _killpg(p)
