@@ -103,8 +103,12 @@ class StackedLayerStack(*_layer_base()):
                 stackedv = jax.device_put(
                     stackedv, NamedSharding(src_sharding.mesh,
                                             PartitionSpec(None, *spec)))
-            p = Parameter(stackedv)
+            p = Parameter(stackedv,
+                          name="stacked_" + n.replace(".", "__"))
             # carry regularization/clip attrs from the template leaf
+            # (homogeneous per name across blocks, so the template's
+            # attrs are the right ones — e.g. apply_decay_param_fun
+            # name-matching sees the stacked_<name> leaf name)
             for attr in ("need_clip", "no_weight_decay"):
                 if hasattr(per[0][n], attr):
                     setattr(p, attr, getattr(per[0][n], attr))
