@@ -32,6 +32,7 @@ from .utils import get_logger  # noqa: F401
 from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import auto_tuner  # noqa: F401
+from . import ps  # noqa: F401
 from .auto_tuner import AutoTuner  # noqa: F401
 
 __all__ = [

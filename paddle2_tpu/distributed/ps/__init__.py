@@ -1,0 +1,339 @@
+"""paddle.distributed.ps — the parameter-server vertical, TPU-native.
+
+The reference scales sparse embedding tables across commodity CPU hosts
+with brpc parameter servers: workers ``pull`` rows and ``push`` gradients,
+and the server applies a server-side sparse optimizer per touched row
+(``paddle/fluid/distributed/ps/table/memory_sparse_table.cc``, update
+rules ``sparse_sgd_rule.cc:47,96,211``, dense tables
+``memory_dense_table.cc``; Python runtime
+``python/paddle/distributed/ps/the_one_ps.py``).
+
+On a TPU pod there are no heterogeneous server hosts — the pod IS the
+parameter store. A table here is an array row-sharded over a mesh axis,
+resident in HBM:
+
+- ``pull``  = gather. Under jit GSPMD lowers the row lookup on a sharded
+  table to the same masked-local-lookup + collective pattern
+  ``VocabParallelEmbedding`` uses, riding ICI instead of brpc/NIC.
+- ``push``  = SelectedRows-style merge (duplicate ids summed — the
+  reference's merge-add before the table update) followed by the sparse
+  optimizer rule applied ONLY to touched rows via scatter — one donated
+  XLA executable, no host round-trip.
+- server-side optimizer state (AdaGrad g2sum, Adam moments and per-row
+  beta powers) lives beside the rows with the same sharding.
+- frequency-gated entry (the accessor's show-count threshold,
+  ``ctr_accessor.cc`` Show/Click): rows pull zeros until their access
+  count passes ``entry_threshold``.
+
+Modes: sync is exact. ``geo``/``async`` push-pull have no TPU analog by
+design — the hardware's strength is synchronous SPMD; both raise with
+the migration path (README "Deliberate omissions" decision record).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .. import mesh as mesh_mod
+
+P = PartitionSpec
+
+__all__ = ["SparseTable", "DenseTable", "init_server", "run_server",
+           "init_worker", "stop_worker", "is_server", "is_worker"]
+
+_RULES = ("naive", "adagrad", "adam")
+
+
+def _row_spec(num_rows: int, axis: Optional[str]) -> P:
+    """Row-shard over the given (or first available) mesh axis when the
+    row count divides; otherwise replicate."""
+    mesh = mesh_mod.get_mesh()
+    if axis is None:
+        for name in ("sharding", "dp"):
+            if name in mesh.axis_names:
+                axis = name
+                break
+        else:
+            axis = mesh.axis_names[0]
+    if num_rows % int(mesh.shape[axis]) == 0:
+        return P(axis, None)
+    return P()
+
+
+def _place(arr, spec: P):
+    return jax.device_put(arr, NamedSharding(mesh_mod.get_mesh(), spec))
+
+
+def _merge_push(ids, grads, sentinel: int):
+    """SelectedRows merge-add: sum gradients of duplicate ids.
+
+    Returns (uids, summed) of the same static length as ``ids``; slots
+    beyond the unique count carry ``sentinel`` (dropped by the scatter).
+    """
+    n = ids.shape[0]
+    uids, inv = jnp.unique(ids, return_inverse=True, size=n,
+                           fill_value=sentinel)
+    summed = jax.ops.segment_sum(grads, inv, num_segments=n)
+    return uids, summed
+
+
+class SparseTable:
+    """HBM-resident row-sharded sparse table with a server-side rule.
+
+    Rules (``sparse_sgd_rule.cc``):
+      - ``naive``   (:47):  w -= lr * g
+      - ``adagrad`` (:96):  w -= lr * g * sqrt(g0 / (g0 + g2sum));
+                            g2sum += mean(g^2)   (scalar per row)
+      - ``adam``    (:211): per-row moments AND per-row beta powers, so
+                            bias correction tracks each row's own update
+                            count — the property that makes sparse Adam
+                            different from dense Adam.
+    Weight bounds clip after every update (BoundValue).
+    """
+
+    def __init__(self, num_rows: int, dim: int, rule: str = "adagrad",
+                 lr: float = 0.05, initial_range: float = 0.0,
+                 initial_g2sum: float = 3e-6,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8,
+                 weight_bounds: Optional[Tuple[float, float]] = None,
+                 entry_threshold: int = 0, mesh_axis: Optional[str] = None,
+                 mode: str = "sync", seed: int = 0):
+        if rule not in _RULES:
+            raise ValueError(f"rule must be one of {_RULES}, got {rule!r}")
+        if mode != "sync":
+            raise NotImplementedError(
+                f"mode={mode!r}: asynchronous/geo push-pull has no TPU "
+                "analog by design — the pod is a synchronous SPMD "
+                "machine. Use sync tables (this class) or sharded "
+                "nn.Embedding + collective mode; see README 'Deliberate "
+                "omissions'.")
+        self.num_rows, self.dim, self.rule = int(num_rows), int(dim), rule
+        self.lr, self.initial_g2sum = float(lr), float(initial_g2sum)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.bounds = weight_bounds
+        self.entry_threshold = int(entry_threshold)
+        spec = _row_spec(self.num_rows, mesh_axis)
+        if initial_range:
+            key = jax.random.PRNGKey(seed)
+            w = jax.random.uniform(key, (self.num_rows, self.dim),
+                                   jnp.float32, -initial_range,
+                                   initial_range)
+        else:
+            w = jnp.zeros((self.num_rows, self.dim), jnp.float32)
+        self.weight = _place(w, spec)
+        self._spec = spec
+        row0 = P(spec[0]) if len(spec) else P()
+        if rule == "adagrad":
+            self.g2sum = _place(jnp.zeros((self.num_rows,), jnp.float32),
+                                row0)
+        elif rule == "adam":
+            z = jnp.zeros((self.num_rows, self.dim), jnp.float32)
+            self.gsum = _place(z, spec)
+            self.g2sum = _place(z, spec)
+            # beta powers START at beta (sparse_sgd_rule.cc:260-262) and
+            # decay on each touch of that row
+            self.beta1_pow = _place(
+                jnp.full((self.num_rows,), beta1, jnp.float32), row0)
+            self.beta2_pow = _place(
+                jnp.full((self.num_rows,), beta2, jnp.float32), row0)
+        self.counts = _place(jnp.zeros((self.num_rows,), jnp.int32), row0)
+
+    # -- pull ----------------------------------------------------------
+    def pull(self, ids, update_show: bool = True):
+        """Gather rows; rows below the entry threshold read as zeros."""
+        ids = jnp.asarray(ids, jnp.int32)
+        if self.entry_threshold and update_show:
+            self.counts = _pull_count(self.counts, ids)
+        rows = _pull(self.weight, self.counts, ids,
+                     self.entry_threshold)
+        return rows
+
+    # -- push ----------------------------------------------------------
+    def push(self, ids, grads, scale: float = 1.0):
+        """Apply the table's rule to the touched rows (merged over
+        duplicate ids). ``scale`` divides the gradient (the reference's
+        show-scale hook, sparse_sgd_rule.cc:102)."""
+        ids = jnp.asarray(ids, jnp.int32)
+        grads = jnp.asarray(grads, jnp.float32)
+        if ids.ndim != 1:
+            raise ValueError(f"push ids must be 1-D, got shape {ids.shape}")
+        if grads.shape != ids.shape + (self.dim,):
+            raise ValueError(
+                f"push grads shape {grads.shape} != {(ids.shape[0], self.dim)}")
+        if ids.shape[0] == 0:
+            return
+        bounds = self.bounds if self.bounds is not None else (0.0, 0.0)
+        if self.rule == "naive":
+            self.weight = _push_naive(
+                self.weight, ids, grads, self.lr, float(scale),
+                self.bounds is not None, *bounds)
+        elif self.rule == "adagrad":
+            self.weight, self.g2sum = _push_adagrad(
+                self.weight, self.g2sum, ids, grads, self.lr,
+                self.initial_g2sum, float(scale),
+                self.bounds is not None, *bounds)
+        else:
+            (self.weight, self.gsum, self.g2sum, self.beta1_pow,
+             self.beta2_pow) = _push_adam(
+                self.weight, self.gsum, self.g2sum, self.beta1_pow,
+                self.beta2_pow, ids, grads, self.lr, self.beta1,
+                self.beta2, self.epsilon, float(scale),
+                self.bounds is not None, *bounds)
+
+    def state_dict(self):
+        out = {"weight": self.weight, "counts": self.counts}
+        for name in ("g2sum", "gsum", "beta1_pow", "beta2_pow"):
+            if hasattr(self, name):
+                out[name] = getattr(self, name)
+        return out
+
+    def set_state_dict(self, state):
+        for k, v in state.items():
+            setattr(self, k, _place(jnp.asarray(v),
+                                    self._spec if jnp.ndim(v) == 2
+                                    else P(self._spec[0])
+                                    if len(self._spec) else P()))
+
+
+def _clip(w, do_bound, lo, hi):
+    return jnp.clip(w, lo, hi) if do_bound else w
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _pull_count(counts, ids):
+    return counts.at[ids.reshape(-1)].add(1)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _pull(weight, counts, ids, threshold):
+    rows = jnp.take(weight, ids, axis=0)
+    if threshold:
+        live = (jnp.take(counts, ids, axis=0) >= threshold)
+        rows = rows * live[..., None].astype(rows.dtype)
+    return rows
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnums=(5, 6, 7))
+def _push_naive(weight, ids, grads, lr, scale, do_bound, lo, hi):
+    uids, g = _merge_push(ids, grads / scale, weight.shape[0])
+    cur = jnp.take(weight, jnp.clip(uids, 0, weight.shape[0] - 1), axis=0)
+    new = _clip(cur - lr * g, do_bound, lo, hi)
+    return weight.at[uids].set(new, mode="drop")
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnums=(7, 8, 9))
+def _push_adagrad(weight, g2sum, ids, grads, lr, g0, scale,
+                  do_bound, lo, hi):
+    n_rows = weight.shape[0]
+    uids, g = _merge_push(ids, grads / scale, n_rows)
+    safe = jnp.clip(uids, 0, n_rows - 1)
+    cur_w = jnp.take(weight, safe, axis=0)
+    cur_s = jnp.take(g2sum, safe, axis=0)
+    new_w = cur_w - lr * g * jnp.sqrt(g0 / (g0 + cur_s))[:, None]
+    new_w = _clip(new_w, do_bound, lo, hi)
+    new_s = cur_s + jnp.mean(g * g, axis=-1)
+    return (weight.at[uids].set(new_w, mode="drop"),
+            g2sum.at[uids].set(new_s, mode="drop"))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4),
+                   static_argnums=(11, 12, 13))
+def _push_adam(weight, gsum, g2sum, b1p, b2p, ids, grads, lr, b1, b2,
+               eps, scale, do_bound, lo, hi):
+    n_rows = weight.shape[0]
+    uids, g = _merge_push(ids, grads / scale, n_rows)
+    safe = jnp.clip(uids, 0, n_rows - 1)
+    w = jnp.take(weight, safe, axis=0)
+    m = jnp.take(gsum, safe, axis=0)
+    v = jnp.take(g2sum, safe, axis=0)
+    p1 = jnp.take(b1p, safe, axis=0)
+    p2 = jnp.take(b2p, safe, axis=0)
+    lr_t = lr * jnp.sqrt(1.0 - p2) / (1.0 - p1)
+    m = b1 * m + (1.0 - b1) * g
+    v = b2 * v + (1.0 - b2) * g * g
+    w = _clip(w - lr_t[:, None] * (m / (jnp.sqrt(v) + eps)),
+              do_bound, lo, hi)
+    return (weight.at[uids].set(w, mode="drop"),
+            gsum.at[uids].set(m, mode="drop"),
+            g2sum.at[uids].set(v, mode="drop"),
+            b1p.at[uids].set(p1 * b1, mode="drop"),
+            b2p.at[uids].set(p2 * b2, mode="drop"))
+
+
+class DenseTable:
+    """Replicated dense parameter block with a server-side rule
+    (``memory_dense_table.cc``: sgd / adam / summary)."""
+
+    def __init__(self, shape, rule: str = "sgd", lr: float = 0.01,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8, summary_decay: float = 0.999999):
+        if rule not in ("sgd", "adam", "summary"):
+            raise ValueError(f"unknown dense rule {rule!r}")
+        self.rule, self.lr = rule, float(lr)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.summary_decay = summary_decay
+        self.value = _place(jnp.zeros(tuple(shape), jnp.float32), P())
+        if rule == "adam":
+            self.m = jnp.zeros_like(self.value)
+            self.v = jnp.zeros_like(self.value)
+            self.t = 0
+
+    def pull(self):
+        return self.value
+
+    def push(self, grad):
+        grad = jnp.asarray(grad, jnp.float32)
+        if self.rule == "sgd":
+            self.value = self.value - self.lr * grad
+        elif self.rule == "summary":
+            # summary accumulates pushed statistics with decay
+            self.value = self.value * self.summary_decay + grad
+        else:
+            self.t += 1
+            self.m = self.beta1 * self.m + (1 - self.beta1) * grad
+            self.v = self.beta2 * self.v + (1 - self.beta2) * grad * grad
+            lr_t = self.lr * np.sqrt(1 - self.beta2 ** self.t) \
+                / (1 - self.beta1 ** self.t)
+            self.value = self.value - lr_t * (
+                self.m / (jnp.sqrt(self.v) + self.epsilon))
+
+
+# -- the_one_ps runtime facade ----------------------------------------
+# In the reference, fleet PS mode splits processes into TRAINING_ROLE=
+# PSERVER (run_server blocks serving tables) and TRAINER (init_worker
+# connects). Single-controller SPMD has no server processes: every host
+# runs the same program and the tables live sharded in HBM. The facade
+# keeps reference scripts runnable: servers don't exist, so is_server()
+# is always False and server entry points are no-ops.
+
+def is_server() -> bool:
+    return False
+
+
+def is_worker() -> bool:
+    return True
+
+
+def init_server(*args, **kwargs) -> None:
+    """No-op: tables are mesh-resident (see module docstring)."""
+
+
+def run_server() -> None:
+    """No-op: there is no server process to block in."""
+
+
+def init_worker(scopes=None) -> None:
+    """No-op: every SPMD process is a worker already."""
+
+
+def stop_worker() -> None:
+    """No-op counterpart of init_worker."""

@@ -1,0 +1,167 @@
+"""Parameter-server vertical, TPU-native (reference
+paddle/fluid/distributed/ps/table/: memory_sparse_table.cc merge-add +
+sparse_sgd_rule.cc rules; the_one_ps runtime facade)."""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+from paddle2_tpu.distributed import ps
+from paddle2_tpu.distributed import mesh as mesh_mod
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    mesh_mod.init_mesh({"dp": 8})
+    yield
+
+
+def test_pull_gathers_rows_and_table_is_row_sharded():
+    t = ps.SparseTable(64, 8, rule="naive", initial_range=0.1, seed=3)
+    ids = np.array([0, 5, 63, 5], np.int32)
+    rows = np.asarray(t.pull(ids))
+    w = np.asarray(t.weight)
+    np.testing.assert_allclose(rows, w[ids], rtol=1e-6)
+    # row-sharded over dp: 64 rows / 8 devices
+    spec = t.weight.sharding.spec
+    assert spec[0] == "dp"
+
+
+def test_push_naive_merges_duplicates_and_updates_only_touched():
+    t = ps.SparseTable(32, 4, rule="naive", lr=0.5, initial_range=0.2)
+    before = np.asarray(t.weight).copy()
+    ids = np.array([3, 7, 3], np.int32)
+    g = np.arange(12, dtype=np.float32).reshape(3, 4)
+    t.push(ids, g)
+    after = np.asarray(t.weight)
+    exp = before.copy()
+    exp[3] -= 0.5 * (g[0] + g[2])  # duplicate ids merge-add first
+    exp[7] -= 0.5 * g[1]
+    np.testing.assert_allclose(after, exp, rtol=1e-5)
+    untouched = [i for i in range(32) if i not in (3, 7)]
+    np.testing.assert_array_equal(after[untouched], before[untouched])
+
+
+def test_adagrad_rule_matches_reference_math():
+    g0 = 3e-6
+    t = ps.SparseTable(16, 4, rule="adagrad", lr=0.1, initial_g2sum=g0,
+                       initial_range=0.1, seed=1)
+    before = np.asarray(t.weight).copy()
+    ids = np.array([2, 9], np.int32)
+    g = np.array([[1, -2, 3, -4], [0.5, 0.5, -0.5, -0.5]], np.float32)
+    t.push(ids, g)
+    t.push(ids, g)  # second step sees accumulated g2sum
+    w = before.copy()
+    g2 = np.zeros(16, np.float32)
+    for _ in range(2):
+        scale = np.sqrt(g0 / (g0 + g2[ids]))
+        w[ids] -= 0.1 * g * scale[:, None]
+        g2[ids] += (g * g).mean(axis=-1)  # scalar per row, mean over dim
+    np.testing.assert_allclose(np.asarray(t.weight)[ids], w[ids],
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(t.g2sum)[ids], g2[ids],
+                               rtol=1e-5)
+
+
+def test_sparse_adam_bias_correction_is_per_row():
+    t = ps.SparseTable(8, 2, rule="adam", lr=0.01)
+    # row 1 is touched twice, row 5 once -> different beta powers
+    t.push(np.array([1], np.int32), np.ones((1, 2), np.float32))
+    t.push(np.array([1, 5], np.int32), np.ones((2, 2), np.float32))
+    b1p = np.asarray(t.beta1_pow)
+    assert np.isclose(b1p[1], 0.9 ** 3)   # starts at beta1, decays per touch
+    assert np.isclose(b1p[5], 0.9 ** 2)
+    assert np.isclose(b1p[0], 0.9)        # untouched rows keep the init
+    # the math: single fresh push == full-correction first Adam step
+    m = 0.1 * 1.0
+    v = 0.001 * 1.0
+    lr_t = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    exp = -lr_t * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(np.asarray(t.weight)[5], exp, rtol=1e-5)
+
+
+def test_entry_threshold_gates_cold_rows():
+    t = ps.SparseTable(8, 2, rule="naive", initial_range=0.3,
+                       entry_threshold=2, seed=5)
+    ids = np.array([4], np.int32)
+    first = np.asarray(t.pull(ids))
+    np.testing.assert_array_equal(first, 0.0)    # count 1 < 2: cold
+    second = np.asarray(t.pull(ids))             # count 2: live
+    assert np.abs(second).sum() > 0
+    np.testing.assert_allclose(second[0], np.asarray(t.weight)[4])
+
+
+def test_weight_bounds_clip_after_update():
+    t = ps.SparseTable(4, 2, rule="naive", lr=1.0,
+                       weight_bounds=(-0.5, 0.5))
+    t.push(np.array([0], np.int32), np.array([[-10.0, 10.0]], np.float32))
+    np.testing.assert_allclose(np.asarray(t.weight)[0], [0.5, -0.5])
+
+
+def test_pull_train_push_loop_under_jit_reduces_loss():
+    import jax
+    import jax.numpy as jnp
+    t = ps.SparseTable(32, 8, rule="naive", lr=4.0, initial_range=0.1,
+                       seed=7)
+    target = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    ids = np.array([1, 9, 17, 25], np.int32)
+
+    def loss_fn(rows):
+        return jnp.mean((rows - target) ** 2)
+
+    losses = []
+    for _ in range(10):
+        rows = t.pull(ids)
+        loss, grads = jax.value_and_grad(loss_fn)(rows)
+        t.push(ids, grads)
+        losses.append(float(loss))
+    assert losses[-1] < 0.2 * losses[0]
+
+
+def test_dense_table_rules():
+    d = ps.DenseTable([3], rule="sgd", lr=0.1)
+    d.push(np.array([1.0, 2.0, 3.0], np.float32))
+    np.testing.assert_allclose(np.asarray(d.pull()), [-0.1, -0.2, -0.3],
+                               rtol=1e-6)
+    s = ps.DenseTable([2], rule="summary", summary_decay=0.5)
+    s.push(np.array([2.0, 4.0], np.float32))
+    s.push(np.array([2.0, 4.0], np.float32))
+    np.testing.assert_allclose(np.asarray(s.pull()), [3.0, 6.0])
+    a = ps.DenseTable([1], rule="adam", lr=0.1)
+    a.push(np.array([1.0], np.float32))
+    # first adam step with full bias correction: delta = -lr * g/|g|
+    np.testing.assert_allclose(np.asarray(a.pull()), [-0.1], rtol=1e-4)
+
+
+def test_async_and_geo_modes_raise_with_decision_record():
+    with pytest.raises(NotImplementedError, match="no TPU analog"):
+        ps.SparseTable(8, 2, mode="async")
+    with pytest.raises(NotImplementedError, match="no TPU analog"):
+        ps.SparseTable(8, 2, mode="geo")
+
+
+def test_the_one_ps_facade_roles():
+    assert ps.is_worker() and not ps.is_server()
+    ps.init_server()   # no-op by design: tables are mesh-resident
+    ps.run_server()    # no server process to block in
+    ps.init_worker()
+    ps.stop_worker()
+
+
+def test_state_dict_roundtrip():
+    t = ps.SparseTable(16, 4, rule="adam", initial_range=0.1, seed=2)
+    t.push(np.array([3], np.int32), np.ones((1, 4), np.float32))
+    state = {k: np.asarray(v) for k, v in t.state_dict().items()}
+    t2 = ps.SparseTable(16, 4, rule="adam")
+    t2.set_state_dict(state)
+    for k, v in t2.state_dict().items():
+        np.testing.assert_allclose(np.asarray(v), state[k])
+
+
+def test_push_empty_and_bad_rank_ids():
+    t = ps.SparseTable(8, 2, rule="adagrad", initial_range=0.1, seed=4)
+    before = np.asarray(t.weight).copy()
+    t.push(np.zeros((0,), np.int32), np.zeros((0, 2), np.float32))
+    np.testing.assert_array_equal(np.asarray(t.weight), before)
+    with pytest.raises(ValueError, match="1-D"):
+        t.push(np.array([[1], [2]], np.int32), np.ones((2, 1, 2), np.float32))
