@@ -33,6 +33,8 @@ from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import ps  # noqa: F401
+from . import communication  # noqa: F401
+from .collective import alltoall_single, gather  # noqa: F401
 from .auto_tuner import AutoTuner  # noqa: F401
 
 __all__ = [

@@ -36,7 +36,7 @@ __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
            "reduce", "scatter", "all_to_all", "alltoall", "send", "recv",
            "isend", "irecv", "barrier", "ppermute", "wait",
            "batch_isend_irecv", "P2POp", "is_initialized",
-           "destroy_process_group"]
+           "destroy_process_group", "gather", "alltoall_single"]
 
 
 class ReduceOp:
@@ -587,6 +587,41 @@ def all_to_all(out_tensor_list, in_tensor_list=None,
 
 
 alltoall = all_to_all
+
+
+def gather(tensor: Tensor, gather_list=None, dst: int = 0,
+           group: Optional[Group] = None, sync_op: bool = True):
+    """communication/gather.py parity. Every rank contributes ``tensor``;
+    ``gather_list`` receives the per-rank tensors. Single-controller SPMD
+    has no rank-private host memory, so the gathered list materializes
+    identically everywhere — a superset of the reference's dst-only
+    guarantee (NCCL gather is allgather + discard off-dst anyway)."""
+    if gather_list is None:
+        raise ValueError("gather_list must be provided (the reference "
+                         "requires it on the dst rank; every rank is dst "
+                         "in single-controller mode)")
+    all_gather(gather_list, tensor, group=group, sync_op=sync_op)
+    return _Task()
+
+
+def alltoall_single(out_tensor: Tensor, in_tensor: Tensor,
+                    in_split_sizes=None, out_split_sizes=None,
+                    group: Optional[Group] = None, sync_op: bool = True):
+    """communication/all_to_all.py alltoall_single parity: dim0 of the
+    rank-major payload splits evenly across ranks and blocks exchange.
+    Unequal splits would need ragged all-to-all, which XLA lowers only
+    for equal tiles — raise loudly rather than densify silently."""
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "alltoall_single with unequal split sizes: XLA all-to-all "
+            "exchanges equal tiles; pad to equal splits or use "
+            "all_to_all with an explicit tensor list")
+    # exchange a fresh wrapper: the single-tensor all_to_all path
+    # replaces its argument's buffer, and the reference contract leaves
+    # in_tensor untouched
+    out = all_to_all(Tensor(in_tensor._data), group=group)
+    out_tensor._replace_data(out._data)
+    return _Task(out_tensor)
 
 
 def ppermute(tensor: Tensor, perm: Sequence[Tuple[int, int]],

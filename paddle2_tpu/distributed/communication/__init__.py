@@ -1,0 +1,20 @@
+"""paddle.distributed.communication — the collective API package
+(python/paddle/distributed/communication/ parity).
+
+The reference splits each collective into an eager wrapper and a
+``.stream`` variant exposing stream placement knobs. Both route to the
+same compiled XLA collectives here; ``stream`` documents the mapping.
+"""
+
+from ..collective import (all_gather, all_gather_object, all_reduce,
+                          all_to_all, alltoall, alltoall_single,
+                          barrier, batch_isend_irecv, broadcast, gather,
+                          irecv, isend, recv, reduce, reduce_scatter,
+                          scatter, send, wait, P2POp, ReduceOp)
+from . import stream  # noqa: F401
+
+__all__ = ["all_gather", "all_gather_object", "all_reduce", "all_to_all",
+           "alltoall", "alltoall_single", "barrier", "batch_isend_irecv",
+           "broadcast", "gather", "irecv", "isend", "recv", "reduce",
+           "reduce_scatter", "scatter", "send", "wait", "P2POp",
+           "ReduceOp", "stream"]

@@ -1,0 +1,52 @@
+"""paddle.distributed.communication.stream — stream-variant collectives
+(python/paddle/distributed/communication/stream/ parity).
+
+The reference's stream API adds ``use_calc_stream``: run the collective
+on the compute stream (skip the comm-stream event chain,
+``process_group_nccl.h:253-256``) when the caller knows the dependency
+is already ordered. On TPU there are no user-visible streams: XLA emits
+async collectives (``all-reduce-start``/``-done``) and its latency-
+hiding scheduler overlaps them with compute — the compiler decides what
+the reference made the caller decide. ``use_calc_stream`` is therefore
+accepted and recorded, and ``sync_op=False`` returns the usual task
+whose ``wait()`` blocks on the result buffer.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..collective import (all_gather as _all_gather,
+                          all_reduce as _all_reduce,
+                          all_to_all as _all_to_all,
+                          alltoall_single as _alltoall_single,
+                          broadcast as _broadcast, gather as _gather,
+                          recv as _recv, reduce as _reduce,
+                          reduce_scatter as _reduce_scatter,
+                          scatter as _scatter, send as _send)
+
+__all__ = ["all_gather", "all_reduce", "all_to_all", "alltoall_single",
+           "broadcast", "gather", "recv", "reduce", "reduce_scatter",
+           "scatter", "send"]
+
+
+def _stream_variant(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, use_calc_stream: bool = False, **kwargs):
+        # stream placement is XLA's decision on TPU (module docstring);
+        # the knob is accepted for source compatibility
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+all_gather = _stream_variant(_all_gather)
+all_reduce = _stream_variant(_all_reduce)
+all_to_all = _stream_variant(_all_to_all)
+alltoall_single = _stream_variant(_alltoall_single)
+broadcast = _stream_variant(_broadcast)
+gather = _stream_variant(_gather)
+recv = _stream_variant(_recv)
+reduce = _stream_variant(_reduce)
+reduce_scatter = _stream_variant(_reduce_scatter)
+scatter = _stream_variant(_scatter)
+send = _stream_variant(_send)

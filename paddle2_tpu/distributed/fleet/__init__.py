@@ -22,6 +22,7 @@ from .pipeline_parallel import PipelineParallel
 from .elastic import ElasticManager, ElasticStatus
 from .spmd_pipeline import (pipeline_spmd, pipeline_spmd_1f1b,
                             pipeline_spmd_vpp)
+from . import utils  # noqa: F401
 
 __all__ = ["init", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",

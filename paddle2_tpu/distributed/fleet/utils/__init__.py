@@ -1,0 +1,10 @@
+"""fleet.utils (reference python/paddle/distributed/fleet/utils/):
+filesystem clients + the recompute helpers re-exported where reference
+users import them from."""
+
+from .fs import (FSFileExistsError, FSFileNotExistsError, HDFSClient,
+                 LocalFS)
+from ...recompute import recompute, recompute_sequential
+
+__all__ = ["LocalFS", "HDFSClient", "FSFileExistsError",
+           "FSFileNotExistsError", "recompute", "recompute_sequential"]

@@ -1,0 +1,177 @@
+"""fleet.utils filesystem clients (reference
+python/paddle/distributed/fleet/utils/fs.py: FS base :74, LocalFS :134,
+HDFSClient :504).
+
+Checkpointing on TPU pods writes to GCS/NFS mounts that look like local
+paths, so LocalFS is the primary client; HDFSClient shells out to the
+``hadoop fs`` CLI exactly like the reference and raises early when no
+hadoop binary is configured.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Tuple
+
+__all__ = ["LocalFS", "HDFSClient", "FSFileExistsError",
+           "FSFileNotExistsError"]
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class LocalFS:
+    """Local/mounted filesystem client (fs.py:134)."""
+
+    def ls_dir(self, fs_path: str) -> Tuple[List[str], List[str]]:
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def list_dirs(self, fs_path: str) -> List[str]:
+        return self.ls_dir(fs_path)[0]
+
+    def is_file(self, fs_path: str) -> bool:
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path: str) -> bool:
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path: str) -> bool:
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path: str) -> None:
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path: str) -> None:
+        if self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+        elif self.is_file(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, fs_src_path: str, fs_dst_path: str) -> None:
+        os.rename(fs_src_path, fs_dst_path)
+
+    def mv(self, src_path: str, dst_path: str, overwrite: bool = False,
+           test_exists: bool = False) -> None:
+        if not overwrite and self.is_exist(dst_path):
+            raise FSFileExistsError(dst_path)
+        if test_exists and not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        shutil.move(src_path, dst_path)
+
+    def touch(self, fs_path: str, exist_ok: bool = True) -> None:
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        with open(fs_path, "a"):
+            pass
+
+    def upload(self, local_path: str, fs_path: str) -> None:
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path: str, local_path: str) -> None:
+        shutil.copy(fs_path, local_path)
+
+    def need_upload_download(self) -> bool:
+        return False
+
+    def cat(self, fs_path: str = None) -> str:
+        with open(fs_path) as f:
+            return f.read()
+
+
+class HDFSClient:
+    """``hadoop fs`` CLI wrapper (fs.py:504). Requires a hadoop binary;
+    TPU deployments normally mount GCS/NFS and use LocalFS instead."""
+
+    def __init__(self, hadoop_home: str, configs=None, time_out=5 * 60,
+                 sleep_inter=1000):
+        self._base = [os.path.join(hadoop_home, "bin", "hadoop"), "fs"]
+        if configs:
+            for k, v in configs.items():
+                self._base += ["-D", f"{k}={v}"]
+        if not os.path.exists(self._base[0]):
+            raise FSFileNotExistsError(
+                f"hadoop binary not found at {self._base[0]}; on TPU "
+                "deployments mount the store (GCS fuse/NFS) and use "
+                "LocalFS")
+        self._timeout = time_out
+
+    def _run(self, *args) -> str:
+        out = subprocess.run(self._base + list(args), capture_output=True,
+                             text=True, timeout=self._timeout)
+        if out.returncode != 0:
+            raise RuntimeError(out.stderr)
+        return out.stdout
+
+    def is_exist(self, fs_path: str) -> bool:
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except RuntimeError:
+            return False
+
+    def is_dir(self, fs_path: str) -> bool:
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except RuntimeError:
+            return False
+
+    def is_file(self, fs_path: str) -> bool:
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def ls_dir(self, fs_path: str) -> Tuple[List[str], List[str]]:
+        lines = self._run("-ls", fs_path).splitlines()
+        dirs, files = [], []
+        for line in lines:
+            parts = line.split()
+            if len(parts) != 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path: str) -> None:
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path: str) -> None:
+        self._run("-rm", "-r", "-f", fs_path)
+
+    def mv(self, src_path: str, dst_path: str, overwrite: bool = False,
+           test_exists: bool = True) -> None:
+        if test_exists and not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        self._run("-mv", src_path, dst_path)
+
+    def upload(self, local_path: str, fs_path: str) -> None:
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path: str, local_path: str) -> None:
+        self._run("-get", fs_path, local_path)
+
+    def touch(self, fs_path: str, exist_ok: bool = True) -> None:
+        if self.is_exist(fs_path) and not exist_ok:
+            raise FSFileExistsError(fs_path)
+        self._run("-touchz", fs_path)
+
+    def need_upload_download(self) -> bool:
+        return True
+
+    def cat(self, fs_path: str = None) -> str:
+        return self._run("-cat", fs_path)

@@ -7,5 +7,7 @@ from . import nn  # noqa: F401
 from . import autotune  # noqa: F401
 
 __all__ = ["MoELayer", "SwitchGate", "TopKGate", "moe", "distributed",
-           "nn"]
+           "nn", "LookAhead", "ModelAverage"]
 from . import asp  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,176 @@
+"""paddle.incubate.optimizer — LookAhead and ModelAverage
+(python/paddle/incubate/optimizer/lookahead.py:36, modelaverage.py).
+
+Both are wrappers around an inner optimizer's parameters; the per-param
+auxiliary arrays (slow weights, accumulation sums) live as device
+arrays updated by small jitted expressions — no host loops over weights.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import core
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+@jax.jit
+def _slow_update(slow, fast, alpha):
+    new_slow = [s + alpha * (f - s) for s, f in zip(slow, fast)]
+    return new_slow
+
+
+class LookAhead:
+    """lookahead.py:36: the inner optimizer updates fast weights every
+    step; every ``k`` steps the slow weights move toward them
+    (slow += alpha * (fast - slow)) and the fast weights reset to slow.
+    """
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5,
+                 name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        if k < 1:
+            raise ValueError("k should be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._k_step = 0
+        self._slow: Dict[int, jax.Array] = {}
+
+    def _params(self):
+        return [p for g in self.inner_optimizer._param_groups
+                for p in g["params"] if p is not None and p.trainable]
+
+    @core.no_grad
+    def step(self):
+        # slow weights start at the param value BEFORE its first fast
+        # update (reference _create_accumulators timing)
+        for p in self._params():
+            if id(p) not in self._slow:
+                self._slow[id(p)] = p._data
+        self.inner_optimizer.step()
+        self._k_step += 1
+        if self._k_step % self.k != 0:
+            return
+        params = self._params()
+        fast = [p._data for p in params]
+        slow = [self._slow[id(p)] for p in params]
+        new_slow = _slow_update(slow, fast, jnp.float32(self.alpha))
+        for p, s in zip(params, new_slow):
+            self._slow[id(p)] = s
+            p._replace_data(s.astype(p._data.dtype))
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kwargs):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        state = self.inner_optimizer.state_dict()
+        state["@lookahead_k_step"] = self._k_step
+        # slow weights keyed by parameter position (ids don't survive a
+        # process restart)
+        params = self._params()
+        state["@lookahead_slow"] = {
+            i: self._slow[id(p)] for i, p in enumerate(params)
+            if id(p) in self._slow}
+        return state
+
+    def set_state_dict(self, state):
+        self._k_step = state.pop("@lookahead_k_step", 0)
+        slow = state.pop("@lookahead_slow", {})
+        params = self._params()
+        self._slow = {id(params[int(i)]): jnp.asarray(v)
+                      for i, v in slow.items()}
+        self.inner_optimizer.set_state_dict(state)
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+
+class ModelAverage:
+    """modelaverage.py: accumulate parameter values over a sliding
+    window; ``apply()`` swaps in the window average for evaluation,
+    ``restore()`` swaps the live weights back.
+
+    Window reset rule (modelaverage.py:63): when num_accumulates >=
+    min_average_window and >= min(max_average_window,
+    num_updates * average_window_rate), the current sum rolls into the
+    previous-window sum and restarts.
+    """
+
+    def __init__(self, average_window_rate: float, parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        if parameters is None:
+            raise ValueError("parameters is required (pass "
+                             "model.parameters())")
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._params = [p for p in parameters if p is not None]
+        self._sum_cur = {id(p): jnp.zeros_like(p._data, jnp.float32)
+                         for p in self._params}
+        self._sum_prev = {id(p): jnp.zeros_like(p._data, jnp.float32)
+                          for p in self._params}
+        self._num_accumulates = 0
+        self._old_num_accumulates = 0
+        self._num_updates = 0
+        self._saved = None
+
+    @core.no_grad
+    def step(self):
+        """Accumulate the current parameter values (call after the inner
+        optimizer's step)."""
+        self._num_updates += 1
+        self._num_accumulates += 1
+        for p in self._params:
+            self._sum_cur[id(p)] = (self._sum_cur[id(p)]
+                                    + p._data.astype(jnp.float32))
+        window = min(self.max_average_window,
+                     self._num_updates * self.average_window)
+        if (self._num_accumulates >= self.min_average_window
+                and self._num_accumulates >= window):
+            for p in self._params:
+                self._sum_prev[id(p)] = self._sum_cur[id(p)]
+                self._sum_cur[id(p)] = jnp.zeros_like(p._data, jnp.float32)
+            self._old_num_accumulates = self._num_accumulates
+            self._num_accumulates = 0
+
+    @core.no_grad
+    def apply(self, executor=None, need_restore: bool = True):
+        """Swap the window-averaged weights in (for evaluation). With
+        ``need_restore=False`` the live weights are NOT backed up and a
+        later restore() is a no-op (the averaged weights become final —
+        the reference's deploy path)."""
+        total = self._num_accumulates + self._old_num_accumulates
+        if total == 0:
+            return
+        self._saved = ({id(p): p._data for p in self._params}
+                       if need_restore else None)
+        for p in self._params:
+            avg = (self._sum_cur[id(p)] + self._sum_prev[id(p)]) / total
+            p._replace_data(avg.astype(p._data.dtype))
+
+    @core.no_grad
+    def restore(self, executor=None):
+        """Swap the live (non-averaged) weights back."""
+        if self._saved is None:
+            return
+        for p in self._params:
+            p._replace_data(self._saved[id(p)])
+        self._saved = None
+
+    def minimize(self, loss, **kwargs):
+        self.step()
+        return None, None

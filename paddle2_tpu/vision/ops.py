@@ -4,12 +4,16 @@ mask-matrix algorithm instead of the reference's CUDA kernel)."""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
 from ..ops.dispatch import ensure_tensor
 
-__all__ = ["nms", "box_area", "box_iou", "roi_align", "RoIAlign"]
+__all__ = ["nms", "box_area", "box_iou", "roi_align", "RoIAlign",
+           "deform_conv2d", "DeformConv2D", "psroi_pool", "PSRoIPool",
+           "box_coder", "distribute_fpn_proposals", "generate_proposals",
+           "read_file", "decode_jpeg"]
 
 
 def box_area(boxes):
@@ -122,3 +126,362 @@ class RoIAlign:
     def __call__(self, x, boxes, boxes_num):
         return roi_align(x, boxes, boxes_num, self.output_size,
                          self.spatial_scale)
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _bilinear_sample(img, ys, xs):
+    """Zero-padded bilinear sampling. img [C, H, W]; ys/xs any shape S.
+    Returns [C, *S]. Out-of-bounds corners contribute zero (the
+    deformable-conv border convention, deformable_conv_kernel.cu)."""
+    H, W = img.shape[-2:]
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    wy = ys - y0
+    wx = xs - x0
+
+    def corner(yc, xc, w):
+        valid = (yc >= 0) & (yc < H) & (xc >= 0) & (xc < W)
+        v = img[:, jnp.clip(yc, 0, H - 1), jnp.clip(xc, 0, W - 1)]
+        return v * (w * valid)[None]
+
+    return (corner(y0, x0, (1 - wy) * (1 - wx))
+            + corner(y0, x0 + 1, (1 - wy) * wx)
+            + corner(y0 + 1, x0, wy * (1 - wx))
+            + corner(y0 + 1, x0 + 1, wy * wx))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference ops.py:766; CUDA kernel
+    deformable_conv_kernel). Each kernel tap samples the input at its
+    grid position plus a learned offset (bilinear), optionally scaled by
+    a modulation mask (v2), then contracts with the weights — expressed
+    here as gather-based sampling + one einsum so XLA fuses it and the
+    tape differentiates it."""
+    from ..ops.dispatch import apply_op
+
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    tensors = [ensure_tensor(x), ensure_tensor(offset),
+               ensure_tensor(weight)]
+    has_mask = mask is not None
+    has_bias = bias is not None
+    if has_mask:
+        tensors.append(ensure_tensor(mask))
+    if has_bias:
+        tensors.append(ensure_tensor(bias))
+
+    def fn(xd, od, wd, *rest):
+        md = rest[0] if has_mask else None
+        bd = rest[-1] if has_bias else None
+        N, Cin, H, W = xd.shape
+        Cout, Cin_g, kh, kw = wd.shape
+        K = kh * kw
+        dg = deformable_groups
+        Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        # base sampling grid per tap
+        ys0 = (jnp.arange(Ho) * sh - ph)[None, :, None] \
+            + (jnp.arange(kh) * dh).repeat(kw)[:, None, None]
+        xs0 = (jnp.arange(Wo) * sw - pw)[None, None, :] \
+            + jnp.tile(jnp.arange(kw) * dw, kh)[:, None, None]
+        off = od.reshape(N, dg, K, 2, Ho, Wo)
+        ys = ys0[None, None] + off[:, :, :, 0]        # [N, dg, K, Ho, Wo]
+        xs = xs0[None, None] + off[:, :, :, 1]
+        xg = xd.reshape(N, dg, Cin // dg, H, W)
+
+        samp = jax.vmap(jax.vmap(_bilinear_sample))(xg, ys, xs)
+        # [N, dg, C/dg, K, Ho, Wo]
+        if md is not None:
+            samp = samp * md.reshape(N, dg, 1, K, Ho, Wo)
+        samp = samp.reshape(N, groups, Cin // groups, K, Ho, Wo)
+        wg = wd.reshape(groups, Cout // groups, Cin_g, K)
+        out = jnp.einsum("gock,ngckij->ngoij", wg, samp,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(N, Cout, Ho, Wo).astype(xd.dtype)
+        if bd is not None:
+            out = out + bd[None, :, None, None]
+        return out
+
+    return apply_op("deform_conv2d", fn, tuple(tensors), {})
+
+
+def _layer_base():
+    from ..nn import Layer
+    return Layer
+
+
+class DeformConv2D(_layer_base()):
+    """Layer form of deform_conv2d (reference ops.py DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size,
+                 stride=1, padding=0, dilation=1,
+                 deformable_groups=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        kh, kw = _pair(kernel_size)
+        self._attrs = (stride, padding, dilation,
+                       deformable_groups, groups)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw],
+            attr=weight_attr)
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([out_channels], attr=bias_attr,
+                                  is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self._attrs
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=s, padding=p, dilation=d,
+                             deformable_groups=dg, groups=g, mask=mask)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive ROI pooling (reference ops.py:1441,
+    psroi_pool_kernel): input channels C = out_c * ph * pw; output bin
+    (i, j) of channel c average-pools its DEDICATED input channel
+    c*ph*pw + i*pw + j over the bin's region."""
+    import numpy as np
+    xd = ensure_tensor(x)._data
+    bx = ensure_tensor(boxes)._data
+    ph, pw = _pair(output_size)
+    C = xd.shape[1]
+    if C % (ph * pw) != 0:
+        raise ValueError(
+            f"psroi_pool input channels {C} must be divisible by "
+            f"output_size {ph}x{pw}")
+    out_c = C // (ph * pw)
+    H, W = xd.shape[2], xd.shape[3]
+    n_num = [int(v) for v in ensure_tensor(boxes_num).numpy()]
+    batch_idx = np.repeat(np.arange(len(n_num)), n_num)
+    outs = []
+    for r in range(bx.shape[0]):
+        img = xd[int(batch_idx[r])]  # [C, H, W]
+        x1, y1, x2, y2 = [bx[r, i] * spatial_scale for i in range(4)]
+        bin_h = (y2 - y1) / ph
+        bin_w = (x2 - x1) / pw
+        chans = jnp.arange(out_c * ph * pw).reshape(out_c, ph, pw)
+        rows = []
+        for i in range(ph):
+            cols = []
+            for j in range(pw):
+                hs = jnp.clip(jnp.floor(y1 + i * bin_h), 0, H).astype(int)
+                he = jnp.clip(jnp.ceil(y1 + (i + 1) * bin_h), 0, H).astype(int)
+                ws = jnp.clip(jnp.floor(x1 + j * bin_w), 0, W).astype(int)
+                we = jnp.clip(jnp.ceil(x1 + (j + 1) * bin_w), 0, W).astype(int)
+                # dynamic extents: mask-average instead of slicing
+                ii = jnp.arange(H)[:, None]
+                jj = jnp.arange(W)[None, :]
+                m = ((ii >= hs) & (ii < he) & (jj >= ws) & (jj < we))
+                area = jnp.maximum(m.sum(), 1)
+                vals = (img[chans[:, i, j]] * m[None]).sum((-2, -1)) / area
+                empty = (he <= hs) | (we <= ws)
+                cols.append(jnp.where(empty, 0.0, vals))
+            rows.append(jnp.stack(cols, -1))
+        outs.append(jnp.stack(rows, -2))  # [out_c, ph, pw]
+    return Tensor(jnp.stack(outs)) if outs else Tensor(
+        jnp.zeros((0, out_c, ph, pw), xd.dtype))
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode target boxes against prior (anchor) boxes
+    (reference ops.py:584, phi box_coder kernel)."""
+    pb = ensure_tensor(prior_box)._data.astype(jnp.float32)
+    tb = ensure_tensor(target_box)._data.astype(jnp.float32)
+    if isinstance(prior_box_var, (list, tuple)):
+        pbv = jnp.asarray(prior_box_var, jnp.float32)
+    elif prior_box_var is None:
+        pbv = jnp.ones((4,), jnp.float32)
+    else:
+        pbv = ensure_tensor(prior_box_var)._data.astype(jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    px = pb[:, 0] + pw * 0.5
+    py = pb[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        # tb [N, 4] targets vs priors [M, 4] -> [N, M, 4] (the kernel's
+        # row = target, col = prior orientation, box_coder kernel)
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tx = tb[:, 0] + tw * 0.5
+        ty = tb[:, 1] + th * 0.5
+        ox = (tx[:, None] - px[None, :]) / pw[None, :]
+        oy = (ty[:, None] - py[None, :]) / ph[None, :]
+        ow = jnp.log(tw[:, None] / pw[None, :])
+        oh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        out = out / (pbv.reshape(-1, 4)[None, :] if pbv.ndim == 2
+                     else pbv[None, None])
+        return Tensor(out)
+    if code_type != "decode_center_size":
+        raise ValueError(f"unknown code_type {code_type!r}")
+    # decode: tb [N, M, 4] deltas; priors broadcast ALONG `axis` (axis=0:
+    # PriorBox [M, 4] tiles over dim 0, i.e. priors vary on dim 1)
+    if tb.ndim == 2:
+        tb = tb[:, None]
+    if axis == 0:
+        px_, py_, pw_, ph_ = (px[None, :], py[None, :],
+                              pw[None, :], ph[None, :])
+        var = pbv.reshape(-1, 4)[None, :] if pbv.ndim == 2 \
+            else pbv[None, None]
+    else:
+        px_, py_, pw_, ph_ = (px[:, None], py[:, None],
+                              pw[:, None], ph[:, None])
+        var = pbv.reshape(-1, 4)[:, None] if pbv.ndim == 2 \
+            else pbv[None, None]
+    d = tb * var
+    ox = d[..., 0] * pw_ + px_
+    oy = d[..., 1] * ph_ + py_
+    ow = jnp.exp(d[..., 2]) * pw_
+    oh = jnp.exp(d[..., 3]) * ph_
+    out = jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
+                     ox + ow * 0.5 - norm, oy + oh * 0.5 - norm],
+                    axis=-1)
+    return Tensor(out)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Route each ROI to its FPN level by scale (reference ops.py:1200):
+    level = floor(refer_level + log2(sqrt(area) / refer_scale)), clipped
+    to [min_level, max_level]. Output sizes are data-dependent, so this
+    runs eagerly on host values (the reference's is a CPU/GPU kernel with
+    dynamic outputs for the same reason)."""
+    import numpy as np
+    rois = np.asarray(ensure_tensor(fpn_rois).numpy(), np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8) + refer_level)
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    num_levels = max_level - min_level + 1
+    multi_rois, restore_parts, rois_num_per_level = [], [], []
+    for i in range(num_levels):
+        idx = np.nonzero(lvl == min_level + i)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+        restore_parts.append(idx)
+        rois_num_per_level.append(Tensor(jnp.asarray([len(idx)],
+                                                     jnp.int32)))
+    order = np.concatenate(restore_parts) if restore_parts else \
+        np.zeros((0,), np.int64)
+    restore_ind = np.empty_like(order)
+    restore_ind[order] = np.arange(len(order))
+    restore = Tensor(jnp.asarray(restore_ind.reshape(-1, 1), jnp.int32))
+    if rois_num is not None:
+        return multi_rois, restore, rois_num_per_level
+    return multi_rois, restore, None
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference ops.py:2159, phi
+    generate_proposals kernel): per image, top-k anchors by score ->
+    decode deltas -> clip to image -> drop tiny boxes -> NMS -> top-k.
+    Output counts are data-dependent: host-eager like the reference's
+    kernel launch + dynamic output."""
+    import numpy as np
+    sc = np.asarray(ensure_tensor(scores).numpy(), np.float32)
+    bd = np.asarray(ensure_tensor(bbox_deltas).numpy(), np.float32)
+    ims = np.asarray(ensure_tensor(img_size).numpy(), np.float32)
+    an = np.asarray(ensure_tensor(anchors).numpy(),
+                    np.float32).reshape(-1, 4)
+    va = np.asarray(ensure_tensor(variances).numpy(),
+                    np.float32).reshape(-1, 4)
+    N = sc.shape[0]
+    off = 1.0 if pixel_offset else 0.0
+    rois_out, scores_out, num_out = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)          # [H*W*A]
+        d = bd[n].transpose(1, 2, 0).reshape(-1, 4)
+        k = min(int(pre_nms_top_n), s.shape[0])
+        top = np.argsort(-s)[:k]
+        s_t, d_t, a_t, v_t = s[top], d[top], an[top % an.shape[0]] \
+            if an.shape[0] != s.shape[0] else an[top], va[top % va.shape[0]] \
+            if va.shape[0] != s.shape[0] else va[top]
+        aw = a_t[:, 2] - a_t[:, 0] + off
+        ah = a_t[:, 3] - a_t[:, 1] + off
+        ax = a_t[:, 0] + aw * 0.5
+        ay = a_t[:, 1] + ah * 0.5
+        dv = d_t * v_t
+        cx = dv[:, 0] * aw + ax
+        cy = dv[:, 1] * ah + ay
+        bw = np.exp(np.minimum(dv[:, 2], np.log(1000. / 16.))) * aw
+        bh = np.exp(np.minimum(dv[:, 3], np.log(1000. / 16.))) * ah
+        boxes = np.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - off, cy + bh * 0.5 - off], -1)
+        h_im, w_im = ims[n, 0], ims[n, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, w_im - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, h_im - off)
+        ws = boxes[:, 2] - boxes[:, 0] + off
+        hs = boxes[:, 3] - boxes[:, 1] + off
+        keep = (ws >= min_size) & (hs >= min_size)
+        boxes, s_t = boxes[keep], s_t[keep]
+        if boxes.shape[0]:
+            kept = nms(Tensor(jnp.asarray(boxes)), nms_thresh,
+                       scores=Tensor(jnp.asarray(s_t)),
+                       top_k=int(post_nms_top_n))
+            kept = np.asarray(kept.numpy())
+            boxes, s_t = boxes[kept], s_t[kept]
+        rois_out.append(boxes)
+        scores_out.append(s_t[:, None])
+        num_out.append(boxes.shape[0])
+    rois = Tensor(jnp.asarray(np.concatenate(rois_out, 0)
+                              if rois_out else np.zeros((0, 4))))
+    scr = Tensor(jnp.asarray(np.concatenate(scores_out, 0)
+                             if scores_out else np.zeros((0, 1))))
+    if return_rois_num:
+        return rois, scr, Tensor(jnp.asarray(num_out, jnp.int32))
+    return rois, scr, None
+
+
+def read_file(filename, name=None):
+    """Read raw bytes into a uint8 tensor (reference ops.py read_file)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    import numpy as np
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference ops.py
+    decode_jpeg; nvjpeg on GPU — PIL on host here, feeding the device
+    tensor)."""
+    import io as _io
+    import numpy as np
+    from PIL import Image
+    data = bytes(np.asarray(ensure_tensor(x).numpy(), np.uint8))
+    img = Image.open(_io.BytesIO(data))
+    if mode.lower() in ("unchanged", "rgb") and img.mode != "RGB":
+        img = img.convert("RGB") if mode.lower() == "rgb" else img
+    elif mode.lower() in ("gray", "grayscale", "l"):
+        img = img.convert("L")
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

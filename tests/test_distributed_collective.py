@@ -241,3 +241,53 @@ def test_comm_watchdog_times_out_stuck_op(caplog):
     finally:
         wd_logger.propagate = False
         paddle.set_flags({"FLAGS_collective_timeout_s": 0.0})
+
+
+def test_gather_fills_list():
+    x = paddle.to_tensor(_ranks())
+    out = []
+    dist.gather(x, out, dst=0)
+    assert len(out) == W
+    for i in range(W):
+        # element i = rank i's tensor, replicated in every rank row
+        np.testing.assert_allclose(out[i].numpy(),
+                                   np.tile(_ranks()[i], (W, 1)))
+
+
+def test_alltoall_single_exchanges_rank_major_blocks():
+    base = _ranks((W, 3))            # [W, W, 3] rank-major payload
+    x = paddle.to_tensor(base.copy())
+    out = paddle.to_tensor(np.zeros_like(base))
+    task = dist.alltoall_single(out, x)
+    task.wait()
+    np.testing.assert_allclose(out.numpy(), base.transpose(1, 0, 2))
+
+
+def test_alltoall_single_unequal_splits_raise():
+    x = paddle.to_tensor(_ranks((W, 2)))
+    out = paddle.to_tensor(np.zeros((W, W, 2), np.float32))
+    with pytest.raises(NotImplementedError, match="equal"):
+        dist.alltoall_single(out, x, in_split_sizes=[1] * W)
+
+
+def test_communication_stream_variants_route_to_collectives():
+    from paddle2_tpu.distributed.communication import stream
+    x = paddle.to_tensor(_ranks())
+    task = stream.all_reduce(x, use_calc_stream=True)
+    task.wait()
+    np.testing.assert_allclose(x.numpy(), np.tile(_ranks().sum(0), (W, 1)))
+    y = paddle.to_tensor(_ranks((W, 2)))
+    out = paddle.to_tensor(np.zeros((W, W, 2), np.float32))
+    stream.alltoall_single(out, y, use_calc_stream=False)
+    np.testing.assert_allclose(out.numpy(),
+                               _ranks((W, 2)).transpose(1, 0, 2))
+
+
+def test_alltoall_single_leaves_input_untouched():
+    base = _ranks((W, 3))
+    x = paddle.to_tensor(base.copy())
+    out = paddle.to_tensor(np.zeros_like(base))
+    dist.alltoall_single(out, x)
+    np.testing.assert_allclose(x.numpy(), base)  # reference contract
+    with pytest.raises(ValueError, match="gather_list"):
+        dist.gather(x, None)

@@ -1,0 +1,250 @@
+"""Detection ops (reference python/paddle/vision/ops.py: deform_conv2d,
+psroi_pool, box_coder, distribute_fpn_proposals, generate_proposals,
+read_file/decode_jpeg) + incubate LookAhead/ModelAverage."""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+from paddle2_tpu.vision import ops as vops
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 8, 8), np.float32)
+    out = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                             paddle.to_tensor(w), padding=1)
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-4,
+                               atol=1e-4)
+
+
+def test_deform_conv2d_half_pixel_offset_bilinear():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    w = np.ones((1, 1, 1, 1), np.float32)
+    off = np.zeros((1, 2, 4, 4), np.float32)
+    off[:, 1] = 0.5  # dx = +0.5 everywhere
+    out = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                             paddle.to_tensor(w)).numpy()[0, 0]
+    img = x[0, 0]
+    exp = img.copy()
+    exp[:, :3] = 0.5 * (img[:, :3] + img[:, 1:])
+    exp[:, 3] = 0.5 * img[:, 3]  # out-of-bounds corner contributes zero
+    np.testing.assert_allclose(out, exp, rtol=1e-5)
+
+
+def test_deform_conv2d_mask_and_grad():
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(1, 2, 5, 5).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(2, 2, 3, 3).astype(np.float32),
+                         stop_gradient=False)
+    off = paddle.to_tensor(np.zeros((1, 18, 5, 5), np.float32),
+                           stop_gradient=False)
+    full = vops.deform_conv2d(x, off.detach(), w.detach(), padding=1)
+    mask = paddle.to_tensor(np.full((1, 9, 5, 5), 0.5, np.float32))
+    half = vops.deform_conv2d(x, off.detach(), w.detach(), padding=1,
+                              mask=mask)
+    np.testing.assert_allclose(half.numpy(), 0.5 * full.numpy(),
+                               rtol=1e-5)
+    y = vops.deform_conv2d(x, off, w, padding=1)
+    y.sum().backward()
+    assert w.grad is not None and np.isfinite(w.grad.numpy()).all()
+    assert off.grad is not None  # offsets are learnable
+
+
+def test_deform_conv2d_layer_shapes():
+    layer = vops.DeformConv2D(3, 6, 3, padding=1, bias_attr=None)
+    x = paddle.randn([2, 3, 7, 7])
+    off = paddle.zeros([2, 18, 7, 7])
+    y = layer(x, off)
+    assert tuple(y.shape) == (2, 6, 7, 7)
+
+
+def test_psroi_pool_position_sensitive_channels():
+    ph = pw = 2
+    out_c = 2
+    C = out_c * ph * pw
+    x = np.zeros((1, C, 4, 4), np.float32)
+    # fill channel k with constant k+1 so each bin reveals which channel
+    # it pooled from
+    for k in range(C):
+        x[0, k] = k + 1
+    boxes = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+    out = vops.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.array([1], np.int32)),
+                          (ph, pw)).numpy()
+    # bin (i, j) of output channel c pools input channel c*ph*pw+i*pw+j
+    for c in range(out_c):
+        for i in range(ph):
+            for j in range(pw):
+                assert out[0, c, i, j] == c * ph * pw + i * pw + j + 1
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(2)
+    priors = np.abs(rng.rand(5, 4).astype(np.float32))
+    priors[:, 2:] = priors[:, :2] + 0.5 + priors[:, 2:]
+    targets = np.abs(rng.rand(3, 4).astype(np.float32))
+    targets[:, 2:] = targets[:, :2] + 0.5 + targets[:, 2:]
+    var = [0.1, 0.1, 0.2, 0.2]
+    enc = vops.box_coder(paddle.to_tensor(priors), var,
+                         paddle.to_tensor(targets),
+                         code_type="encode_center_size")
+    # kernel orientation: [num_targets, num_priors, 4]
+    assert tuple(enc.shape) == (3, 5, 4)
+    dec = vops.box_coder(paddle.to_tensor(priors), var, enc,
+                         code_type="decode_center_size", axis=0)
+    # decoding the encodings recovers each target against every prior
+    for j in range(3):
+        for i in range(5):
+            np.testing.assert_allclose(dec.numpy()[j, i], targets[j],
+                                       rtol=1e-4, atol=1e-4)
+
+
+def test_distribute_fpn_proposals_levels_and_restore():
+    rois = np.array([
+        [0, 0, 224, 224],     # refer scale -> refer level (4)
+        [0, 0, 28, 28],       # small -> min level (2)
+        [0, 0, 1000, 1000],   # huge -> max level (5)
+        [0, 0, 112, 112],     # half scale -> level 3
+    ], np.float32)
+    multi, restore, per_level = vops.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224,
+        rois_num=paddle.to_tensor(np.array([4], np.int32)))
+    sizes = [int(m.shape[0]) for m in multi]
+    assert sizes == [1, 1, 1, 1]
+    np.testing.assert_allclose(multi[0].numpy()[0], rois[1])  # level 2
+    np.testing.assert_allclose(multi[3].numpy()[0], rois[2])  # level 5
+    # restore index maps concatenated-by-level order back to input order
+    cat = np.concatenate([m.numpy() for m in multi])
+    np.testing.assert_allclose(cat[restore.numpy().reshape(-1)], rois)
+    assert [int(n.numpy()[0]) for n in per_level] == sizes
+
+
+def test_generate_proposals_smoke():
+    rng = np.random.RandomState(3)
+    N, A, H, W = 1, 3, 4, 4
+    scores = rng.rand(N, A, H, W).astype(np.float32)
+    deltas = (rng.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for i in range(H):
+        for j in range(W):
+            for a in range(A):
+                s = 8 * (a + 1)
+                anchors[i, j, a] = [j * 8 - s / 2, i * 8 - s / 2,
+                                    j * 8 + s / 2, i * 8 + s / 2]
+    variances = np.ones_like(anchors)
+    rois, s_out, num = vops.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(np.array([[32.0, 32.0]], np.float32)),
+        paddle.to_tensor(anchors.reshape(-1, 4)),
+        paddle.to_tensor(variances.reshape(-1, 4)),
+        pre_nms_top_n=20, post_nms_top_n=5, nms_thresh=0.7,
+        min_size=1.0, return_rois_num=True)
+    r = rois.numpy()
+    assert r.shape[0] == int(num.numpy()[0]) <= 5
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 32).all()
+    sc = s_out.numpy().reshape(-1)
+    assert (np.diff(sc) <= 1e-6).all()  # descending scores
+
+
+def test_read_file_decode_jpeg_roundtrip(tmp_path):
+    from PIL import Image
+    arr = np.full((10, 12, 3), (200, 30, 90), np.uint8)
+    p = str(tmp_path / "img.jpg")
+    Image.fromarray(arr).save(p, quality=95)
+    raw = vops.read_file(p)
+    assert raw.numpy().dtype == np.uint8
+    img = vops.decode_jpeg(raw)
+    assert tuple(img.shape) == (3, 10, 12)
+    # JPEG is lossy; a constant image survives within a few counts
+    np.testing.assert_allclose(img.numpy().mean(axis=(1, 2)),
+                               [200, 30, 90], atol=6)
+
+
+def test_lookahead_slow_fast_math():
+    import paddle2_tpu.optimizer as opt
+    w = paddle.to_tensor(np.array([1.0], np.float32),
+                         stop_gradient=False)
+    w.trainable = True
+    sgd = opt.SGD(learning_rate=0.1, parameters=[w])
+    la = paddle.incubate.LookAhead(sgd, alpha=0.5, k=2)
+    for _ in range(4):
+        loss = w.sum()          # grad = 1
+        loss.backward()
+        la.step()
+        la.clear_grad()
+    # fast: 1.0 -> .9 -> .8 | sync: slow = 1 + .5(.8-1) = .9
+    # fast: .9 -> .8 -> .7   | sync: slow = .9 + .5(.7-.9) = .8
+    np.testing.assert_allclose(w.numpy(), [0.8], rtol=1e-5)
+
+
+def test_model_average_window_apply_restore():
+    import paddle2_tpu.optimizer as opt
+    w = paddle.to_tensor(np.array([10.0], np.float32),
+                         stop_gradient=False)
+    w.trainable = True
+    sgd = opt.SGD(learning_rate=1.0, parameters=[w])
+    ma = paddle.incubate.ModelAverage(1.0, parameters=[w],
+                                      min_average_window=2,
+                                      max_average_window=4)
+    for _ in range(4):          # w: 9, 8 (roll), 7, 6
+        loss = w.sum()
+        loss.backward()
+        sgd.step()
+        sgd.clear_grad()
+        ma.step()
+    ma.apply()
+    np.testing.assert_allclose(w.numpy(), [(9 + 8 + 7 + 6) / 4],
+                               rtol=1e-6)
+    ma.restore()
+    np.testing.assert_allclose(w.numpy(), [6.0], rtol=1e-6)
+
+
+def test_lookahead_state_dict_roundtrips_slow_weights():
+    import paddle2_tpu.optimizer as opt
+    w = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    w.trainable = True
+    la = paddle.incubate.LookAhead(
+        opt.SGD(learning_rate=0.1, parameters=[w]), alpha=0.5, k=3)
+    for _ in range(2):          # mid-window: slow holds the w0 snapshot
+        w.sum().backward()
+        la.step()
+        la.clear_grad()
+    state = la.state_dict()
+    # fresh wrapper around the CURRENT (post-2-step) weights
+    w2 = paddle.to_tensor(w.numpy(), stop_gradient=False)
+    w2.trainable = True
+    la2 = paddle.incubate.LookAhead(
+        opt.SGD(learning_rate=0.1, parameters=[w2]), alpha=0.5, k=3)
+    la2.set_state_dict(state)
+    w2.sum().backward()
+    la2.step()                   # third step -> sync against restored slow
+    la2.clear_grad()
+    # uninterrupted: fast 1->.9->.8->.7; slow=1+.5(.7-1)=.85
+    np.testing.assert_allclose(w2.numpy(), [0.85], rtol=1e-5)
+
+
+def test_model_average_need_restore_false():
+    import paddle2_tpu.optimizer as opt
+    w = paddle.to_tensor(np.array([4.0], np.float32), stop_gradient=False)
+    w.trainable = True
+    ma = paddle.incubate.ModelAverage(1.0, parameters=[w],
+                                      min_average_window=1,
+                                      max_average_window=100)
+    sgd = opt.SGD(learning_rate=1.0, parameters=[w])
+    for _ in range(2):          # w: 3, 2
+        w.sum().backward()
+        sgd.step()
+        sgd.clear_grad()
+        ma.step()
+    ma.apply(need_restore=False)
+    np.testing.assert_allclose(w.numpy(), [2.5])
+    ma.restore()                 # no-op by contract
+    np.testing.assert_allclose(w.numpy(), [2.5])
