@@ -12,4 +12,5 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
 
 
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401,E402
+from .saved_tensors_hooks import saved_tensors_hooks  # noqa: F401,E402
 from .functional import jacobian, hessian, vjp, jvp  # noqa: F401,E402

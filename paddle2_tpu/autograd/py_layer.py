@@ -26,9 +26,20 @@ class PyLayerContext:
         self.materialize_grads = True
 
     def save_for_backward(self, *tensors):
-        self._saved = tuple(tensors)
+        from .saved_tensors_hooks import current_hooks
+        hooks = current_hooks()
+        if hooks is not None:
+            self._saved = tuple(hooks[0](t) for t in tensors)
+            self._packed = True
+            self._unpack = hooks[1]
+        else:
+            self._saved = tuple(tensors)
+            self._packed = False
 
     def saved_tensor(self):
+        if getattr(self, "_packed", False):
+            # the unpack hook captured at pack time survives the context
+            return tuple(self._unpack(t) for t in self._saved)
         return self._saved
 
     # paddle also exposes arbitrary attribute stashing on ctx

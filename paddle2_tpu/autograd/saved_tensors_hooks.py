@@ -1,0 +1,39 @@
+"""paddle.autograd.saved_tensors_hooks (reference
+autograd/saved_tensors_hooks.py:27).
+
+Registers a (pack, unpack) pair applied to tensors a PyLayer saves for
+backward — the reference's use case is offloading activations to
+host/disk between forward and backward. Scope note: on this stack the
+implicit per-op residuals live inside XLA-managed VJP closures (HBM
+residuals the compiler already schedules); the framework-level lever
+for those is rematerialization (`paddle.distributed.recompute` /
+scan-over-remat), so the hooks intercept exactly what user code saves
+explicitly via ``ctx.save_for_backward``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["saved_tensors_hooks"]
+
+_TLS = threading.local()
+
+
+def current_hooks():
+    return getattr(_TLS, "hooks", None)
+
+
+class saved_tensors_hooks:
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        self._prev = getattr(_TLS, "hooks", None)
+        _TLS.hooks = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.hooks = self._prev
+        return False

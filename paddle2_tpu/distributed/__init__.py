@@ -34,7 +34,20 @@ from . import checkpoint  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import ps  # noqa: F401
 from . import communication  # noqa: F401
-from .collective import alltoall_single, gather  # noqa: F401
+from .collective import (alltoall_single, broadcast_object_list,  # noqa: F401
+                         gather, scatter_object_list)
+from .parallel import (ParallelMode, get_backend, gloo_barrier,  # noqa: F401
+                       gloo_init_parallel_env, gloo_release, is_available)
+from .entry_attr import (CountFilterEntry, ProbabilityEntry,  # noqa: F401
+                         ShowClickEntry)
+from .spawn import spawn  # noqa: F401
+from . import io  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from .checkpoint import (load_state_dict, save_state_dict)  # noqa: F401
+from .auto_parallel.api import (DistAttr, ReduceType,  # noqa: F401
+                                ShardingStage1, ShardingStage2,
+                                ShardingStage3, shard_scaler)
+from .fleet.mp_layers import split  # noqa: F401
 from .auto_tuner import AutoTuner  # noqa: F401
 
 __all__ = [

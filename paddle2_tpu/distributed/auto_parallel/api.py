@@ -966,3 +966,108 @@ def to_static(layer, loader=None, loss=None, optimizer=None,
     if isinstance(optimizer, _ShardOptimizer):
         optimizer = optimizer._inner
     return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+# ---------------------------------------------------------------- stages
+
+class _ShardingStageBase:
+    """Builtin shard_fn family for shard_optimizer (reference
+    api.py:1270): decides the placement of optimizer accumulators (and,
+    for stage 3, of the parameters themselves)."""
+
+    def __init__(self, mesh=None, sharding_mesh_dim=None):
+        self._mesh = mesh
+        self._dim = sharding_mesh_dim
+
+    def _axis(self):
+        from .. import mesh as mesh_mod
+        m = mesh_mod.get_mesh()
+        if isinstance(self._dim, str) and self._dim in m.axis_names:
+            return self._dim
+        for name in ("sharding", "dp"):
+            if name in m.axis_names:
+                return name
+        return m.axis_names[0]
+
+    def _place_sharded(self, t):
+        from .. import mesh as mesh_mod
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        m = mesh_mod.get_mesh()
+        a = self._axis()
+        arr = t._data if hasattr(t, "_data") else t
+        if arr.ndim > 0 and arr.shape[0] % int(m.shape[a]) == 0:
+            spec = P(a, *([None] * (arr.ndim - 1)))
+        else:
+            spec = P()
+        from ...framework.tensor import Tensor
+        return Tensor(jax.device_put(arr, NamedSharding(m, spec)))
+
+
+class ShardingStage1(_ShardingStageBase):
+    """api.py:1301 — optimizer states sharded over the axis."""
+
+    def __call__(self, path, param, accumulator):
+        return self._place_sharded(accumulator)
+
+
+class ShardingStage2(_ShardingStageBase):
+    """api.py ShardingStage2 — states sharded; gradient reduce-scatter is
+    the compiled step's placement consequence (sharding.py stage os_g)."""
+
+    def __call__(self, path, param, accumulator):
+        return self._place_sharded(accumulator)
+
+
+class ShardingStage3(_ShardingStageBase):
+    """api.py ShardingStage3 — parameters stored sharded too; forward
+    re-gather is GSPMD's job (XLA latency-hiding scheduler overlaps)."""
+
+    def __call__(self, path, param, accumulator):
+        if getattr(param, "_data", None) is not None:
+            placed = self._place_sharded(param)
+            param._replace_data(placed._data)
+        return self._place_sharded(accumulator)
+
+
+def shard_scaler(scaler):
+    """api.py:1642 — distributed view of a GradScaler. The reference
+    all-reduces found_inf across ranks; under single-controller GSPMD the
+    unscale/isfinite reduction already runs over the GLOBAL (sharded)
+    gradient arrays, so the global view is what the scaler computes —
+    returned as-is with this decision recorded."""
+    return scaler
+
+
+class ReduceType:
+    """api.py ReduceType: reduction kinds for Partial placements /
+    local_map."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """Legacy dist_attr surface (pre-Placement API): mesh +
+    per-dim sharding_specs, convertible to Placement lists."""
+
+    def __init__(self, mesh, sharding_specs):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs)
+
+    def to_placements(self):
+        from . import Shard, Replicate
+        out = []
+        for spec in self.sharding_specs:
+            if spec is None:
+                out.append(Replicate())
+            else:
+                mesh_dim = (self.process_mesh.dim_names.index(spec)
+                            if hasattr(self.process_mesh, "dim_names")
+                            else int(spec))
+                out.append(Shard(mesh_dim))
+        return out

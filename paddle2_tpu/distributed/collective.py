@@ -36,7 +36,8 @@ __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
            "reduce", "scatter", "all_to_all", "alltoall", "send", "recv",
            "isend", "irecv", "barrier", "ppermute", "wait",
            "batch_isend_irecv", "P2POp", "is_initialized",
-           "destroy_process_group", "gather", "alltoall_single"]
+           "destroy_process_group", "gather", "alltoall_single",
+           "broadcast_object_list", "scatter_object_list"]
 
 
 class ReduceOp:
@@ -705,4 +706,28 @@ def barrier(group: Optional[Group] = None):
     token = Tensor(jnp.zeros((w,), jnp.float32))
     _run("all_reduce_sum", token, group)
     token.numpy()
+    return _Task()
+
+
+def broadcast_object_list(object_list, src: int = 0,
+                          group: Optional[Group] = None):
+    """communication/broadcast.py broadcast_object_list: single-controller
+    SPMD holds one copy of every host object already, so rank src's list
+    IS the list (all_gather_object's dual)."""
+    return _Task()
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src: int = 0,
+                        group: Optional[Group] = None):
+    """communication/scatter.py scatter_object_list: every logical rank
+    receives its slot of src's list; single-controller materializes the
+    whole per-rank view."""
+    g = group if group is not None else _world_group()
+    if in_object_list is None:
+        raise ValueError("in_object_list must be provided on src")
+    if len(in_object_list) != g.nranks:
+        raise ValueError(
+            f"in_object_list has {len(in_object_list)} entries for "
+            f"{g.nranks} ranks")
+    out_object_list.extend(in_object_list)
     return _Task()

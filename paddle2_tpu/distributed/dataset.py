@@ -1,0 +1,97 @@
+"""InMemoryDataset / QueueDataset (reference
+python/paddle/distributed/fleet/dataset/dataset.py) — the PS pipeline's
+file-fed datasets. The reference pipes files through an external parser
+binary into the C++ DataFeed; here files feed Python-side parsing into
+the framework's DataLoader-compatible iterable, which is what the TPU
+input pipeline consumes (io/dataloader.py + the shm ring own the
+multiprocess path)."""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, List, Optional, Sequence
+
+__all__ = ["InMemoryDataset", "QueueDataset"]
+
+
+class _FileDataset:
+    def __init__(self):
+        self._filelist: List[str] = []
+        self._parse_fn: Optional[Callable[[str], object]] = None
+        self._batch_size = 1
+        self._thread_num = 1
+
+    def init(self, batch_size=1, thread_num=1, pipe_command=None,
+             use_var=None, parse_fn=None, **kwargs):
+        """``pipe_command`` (an external parser binary) is replaced by
+        ``parse_fn``: line -> sample. Default: whitespace-split floats."""
+        self._batch_size = int(batch_size)
+        self._thread_num = int(thread_num)
+        if pipe_command is not None and parse_fn is None:
+            raise NotImplementedError(
+                "pipe_command spawns the reference's C++ DataFeed parser; "
+                "pass parse_fn=line->sample instead (decision record: "
+                "README deliberate omissions, PS stack)")
+        self._parse_fn = parse_fn or (
+            lambda line: [float(v) for v in line.split()])
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def _iter_lines(self):
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield self._parse_fn(line)
+
+
+class InMemoryDataset(_FileDataset):
+    """dataset.py InMemoryDataset: load files into host memory, shuffle
+    globally, then batch."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples: List[object] = []
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_lines())
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        random.Random(seed).shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=None,
+                       seed: Optional[int] = None):
+        # single-controller: global == local
+        self.local_shuffle(seed)
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._samples)
+
+    def release_memory(self):
+        self._samples = []
+
+    def __iter__(self):
+        batch = []
+        for s in self._samples:
+            batch.append(s)
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+
+class QueueDataset(_FileDataset):
+    """dataset.py QueueDataset: stream files without materializing."""
+
+    def __iter__(self):
+        batch = []
+        for s in self._iter_lines():
+            batch.append(s)
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch

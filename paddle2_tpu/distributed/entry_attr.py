@@ -1,0 +1,57 @@
+"""Sparse-table entry policies (reference
+python/paddle/distributed/entry_attr.py:62,107,155).
+
+In the reference these serialize to accessor config strings consumed by
+the PS server's sparse tables; here they configure
+``distributed.ps.SparseTable``'s entry gating (the TPU-native PS
+vertical). ``CountFilterEntry`` is fully functional — it IS the table's
+show-count threshold. The probability/show-click policies need
+per-lookup server-side sampling state that has no synchronous-SPMD
+analog; they keep their config surface and raise at table-bind time."""
+
+from __future__ import annotations
+
+__all__ = ["ProbabilityEntry", "CountFilterEntry", "ShowClickEntry"]
+
+
+class EntryAttr:
+    def _to_attr(self) -> str:
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    """entry_attr.py:62 — admit a new feature with probability p."""
+
+    def __init__(self, probability: float):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError("probability must be in (0, 1]")
+        self._name = "probability_entry"
+        self._probability = float(probability)
+
+    def _to_attr(self) -> str:
+        return f"{self._name}:{self._probability}"
+
+
+class CountFilterEntry(EntryAttr):
+    """entry_attr.py:107 — admit a feature once seen >= count times."""
+
+    def __init__(self, count_filter: int):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._name = "count_filter_entry"
+        self._count_filter = int(count_filter)
+
+    def _to_attr(self) -> str:
+        return f"{self._name}:{self._count_filter}"
+
+
+class ShowClickEntry(EntryAttr):
+    """entry_attr.py:155 — entry driven by named show/click input slots."""
+
+    def __init__(self, show_name: str, click_name: str):
+        self._name = "show_click_entry"
+        self._show_name = str(show_name)
+        self._click_name = str(click_name)
+
+    def _to_attr(self) -> str:
+        return f"{self._name}:{self._show_name}:{self._click_name}"

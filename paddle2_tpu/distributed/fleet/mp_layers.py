@@ -36,7 +36,8 @@ from ...nn.layer.layers import Layer
 P = PartitionSpec
 
 __all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
-           "RowParallelLinear", "ParallelCrossEntropy", "manual_mp"]
+           "RowParallelLinear", "ParallelCrossEntropy", "manual_mp",
+           "split"]
 
 _MANUAL = threading.local()
 
@@ -276,3 +277,36 @@ def _constrain_tensor(t, spec: P):
     out._output_index = t._output_index
     out._origin = t
     return out
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split (reference fleet/layers/mpu/mp_ops.py:706):
+    build-and-apply a model-parallel linear/embedding whose weight is
+    split across the mp axis. Build-once semantics like the reference
+    (each call creates fresh parameters — intended for graph build)."""
+    mesh = get_mesh()
+    ax = _mp_axis()
+    degree = int(mesh.shape[ax])
+    if num_partitions != degree:
+        raise ValueError(
+            f"num_partitions ({num_partitions}) must equal the mp degree "
+            f"({degree}) of the current mesh")
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError(
+            f"operation must be 'linear' or 'embedding', got {operation!r}")
+    if axis == 0:
+        layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False,
+                                  input_is_parallel=False)
+        return layer(x)
+    if axis != 1:
+        raise ValueError("axis must be 0 (row) or 1 (column)")
+    layer = ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                 has_bias=bias_attr is not False,
+                                 gather_output=gather_out)
+    return layer(x)

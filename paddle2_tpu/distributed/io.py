@@ -1,0 +1,42 @@
+"""paddle.distributed.io (reference python/paddle/distributed/io.py) —
+persistables save/load for distributed programs. On the TPU stack the
+persistable set is a state_dict; these wrappers keep the reference entry
+points callable over `paddle.save/load` and the sharded checkpoint."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables",
+           "is_persistable"]
+
+
+def is_persistable(var) -> bool:
+    """Parameters and buffers persist; activations don't."""
+    from ..framework.tensor import Tensor
+    if not isinstance(var, Tensor):
+        return False
+    return getattr(var, "persistable", True) and not getattr(
+        var, "stop_gradient", False) or getattr(var, "is_buffer", False)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Reference signature kept; ``main_program`` here is a Layer (or a
+    static Program whose parameters are live Tensors)."""
+    from ..framework import io_state
+    state = {}
+    if main_program is not None and hasattr(main_program, "state_dict"):
+        state = main_program.state_dict()
+    os.makedirs(dirname, exist_ok=True)
+    io_state.save(state, os.path.join(dirname,
+                                      filename or "__persistables__"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..framework import io_state
+    state = io_state.load(os.path.join(dirname,
+                                       filename or "__persistables__"))
+    if main_program is not None and hasattr(main_program,
+                                            "set_state_dict"):
+        main_program.set_state_dict(state)
+    return state

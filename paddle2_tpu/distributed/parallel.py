@@ -26,6 +26,8 @@ from .collective import Group, _world_group
 P = PartitionSpec
 
 __all__ = ["init_parallel_env", "DataParallel", "ParallelEnv", "get_rank",
+           "ParallelMode", "get_backend", "is_available", "gloo_barrier",
+           "gloo_init_parallel_env", "gloo_release",
            "get_world_size"]
 
 _initialized = {"flag": False}
@@ -166,3 +168,39 @@ class DataParallel(Layer):
         return out
 
     set_dict = set_state_dict
+
+
+class ParallelMode:
+    """fleet/base/topology.py ParallelMode constants."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+def get_backend() -> str:
+    """parallel.py get_backend: the comm backend name. All collectives
+    compile to XLA HLO over ICI/DCN here."""
+    return "xla"
+
+
+def is_available() -> bool:
+    """distributed.is_available (reference parallel.py)."""
+    return True
+
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int,
+                           server_endpoint: str) -> None:
+    """Reference gloo bootstrap (CPU barrier service). The coordination
+    service behind init_parallel_env covers it; kept callable."""
+    init_parallel_env()
+
+
+def gloo_barrier() -> None:
+    from .collective import barrier
+    barrier()
+
+
+def gloo_release() -> None:
+    """No gloo store to tear down (coordination service owns lifetime)."""

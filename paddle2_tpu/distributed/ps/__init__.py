@@ -103,10 +103,21 @@ class SparseTable:
                  beta1: float = 0.9, beta2: float = 0.999,
                  epsilon: float = 1e-8,
                  weight_bounds: Optional[Tuple[float, float]] = None,
-                 entry_threshold: int = 0, mesh_axis: Optional[str] = None,
+                 entry_threshold: int = 0, entry=None,
+                 mesh_axis: Optional[str] = None,
                  mode: str = "sync", seed: int = 0):
         if rule not in _RULES:
             raise ValueError(f"rule must be one of {_RULES}, got {rule!r}")
+        if entry is not None:
+            from ..entry_attr import CountFilterEntry
+            if isinstance(entry, CountFilterEntry):
+                entry_threshold = entry._count_filter
+            else:
+                raise NotImplementedError(
+                    f"{type(entry).__name__}: probabilistic/show-click "
+                    "entry needs server-side sampling state with no "
+                    "synchronous-SPMD analog; use CountFilterEntry "
+                    "(see entry_attr.py decision record)")
         if mode != "sync":
             raise NotImplementedError(
                 f"mode={mode!r}: asynchronous/geo push-pull has no TPU "

@@ -11,3 +11,63 @@ __all__ = ["MoELayer", "SwitchGate", "TopKGate", "moe", "distributed",
 from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from .graph_ops import (graph_khop_sampler, graph_reindex,  # noqa: F401
+                        graph_sample_neighbors, graph_send_recv)
+from ..geometric import (segment_max, segment_mean,  # noqa: F401
+                         segment_min, segment_sum)
+
+
+def identity_loss(x, reduction="none"):
+    """incubate identity_loss (reference marks a loss for the IPU
+    backend; here the reduction semantics are kept: 0/'sum', 1/'mean',
+    2/'none')."""
+    import jax.numpy as jnp
+    from ..ops.dispatch import apply_op, ensure_tensor
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    if red == "sum":
+        return apply_op("identity_loss", jnp.sum, (ensure_tensor(x),), {})
+    if red == "mean":
+        return apply_op("identity_loss", jnp.mean, (ensure_tensor(x),), {})
+    return ensure_tensor(x)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """incubate softmax_mask_fuse: softmax(x + mask) in one kernel
+    (fused_softmax_mask op) — XLA fuses the jnp expression."""
+    import jax
+    from ..ops.dispatch import apply_op, ensure_tensor
+    return apply_op("softmax_mask_fuse",
+                    lambda a, m: jax.nn.softmax(a + m, axis=-1),
+                    (ensure_tensor(x), ensure_tensor(mask)), {})
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """incubate softmax_mask_fuse_upper_triangle: causal-masked softmax
+    for [B, H, S, S] scores in one fused expression."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.dispatch import apply_op, ensure_tensor
+
+    def fn(a):
+        s = a.shape[-1]
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(causal, a, -1e9), axis=-1)
+
+    return apply_op("softmax_mask_fuse_upper_triangle", fn,
+                    (ensure_tensor(x),), {})
+
+
+class inference:
+    """paddle.incubate.inference namespace shim: the reference's
+    inference decorators map onto jit.to_static + paddle.inference."""
+
+    @staticmethod
+    def enable(model=None, **kwargs):
+        from .. import jit
+        return jit.to_static(model) if model is not None else jit.to_static
+
+
+__all__ += ["graph_send_recv", "graph_reindex", "graph_sample_neighbors",
+            "graph_khop_sampler", "identity_loss", "softmax_mask_fuse",
+            "softmax_mask_fuse_upper_triangle", "segment_sum",
+            "segment_mean", "segment_max", "segment_min", "inference"]

@@ -1,0 +1,134 @@
+"""Graph-learning operators (reference python/paddle/incubate/operators/
+graph_send_recv.py:46, graph_reindex.py:35, graph_sample_neighbors.py:77,
+graph_khop_sampler.py:63).
+
+Sampling produces data-dependent shapes, so — like the reference's CPU
+kernels — the samplers run host-side on numpy; the dense message-passing
+(`graph_send_recv`) runs as XLA segment reductions.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import ensure_tensor
+
+__all__ = ["graph_send_recv", "graph_reindex", "graph_sample_neighbors",
+           "graph_khop_sampler"]
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Gather x at src, segment-reduce onto dst (the message-passing
+    primitive; geometric.send_u_recv is the stable twin)."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Relabel nodes to a dense 0..K-1 id space: x first, then unseen
+    neighbors in first-appearance order. Returns (reindexed_src,
+    reindexed_dst, out_nodes)."""
+    xs = np.asarray(ensure_tensor(x).numpy()).reshape(-1)
+    nb = np.asarray(ensure_tensor(neighbors).numpy()).reshape(-1)
+    ct = np.asarray(ensure_tensor(count).numpy()).reshape(-1)
+    mapping = {}
+    out_nodes: List[int] = []
+    for v in xs.tolist():
+        if v not in mapping:
+            mapping[v] = len(out_nodes)
+            out_nodes.append(v)
+    for v in nb.tolist():
+        if v not in mapping:
+            mapping[v] = len(out_nodes)
+            out_nodes.append(v)
+    reindex_src = np.array([mapping[v] for v in nb.tolist()], xs.dtype)
+    # dst: node i of x repeated count[i] times (edge list orientation)
+    dst = np.repeat(np.arange(len(xs), dtype=xs.dtype), ct)
+    return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(np.asarray(out_nodes, xs.dtype))))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Uniformly sample up to ``sample_size`` in-neighbors per input node
+    from the CSC graph. Returns (neighbors, count[, eids])."""
+    r = np.asarray(ensure_tensor(row).numpy()).reshape(-1)
+    cp = np.asarray(ensure_tensor(colptr).numpy()).reshape(-1)
+    nodes = np.asarray(ensure_tensor(input_nodes).numpy()).reshape(-1)
+    eid = (np.asarray(ensure_tensor(eids).numpy()).reshape(-1)
+           if eids is not None else None)
+    rng = np.random.default_rng()
+    out_nb, out_ct, out_eid = [], [], []
+    for n in nodes.tolist():
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(lo, hi)
+        else:
+            sel = lo + rng.choice(deg, size=sample_size, replace=False)
+        out_nb.append(r[sel])
+        out_ct.append(len(sel))
+        if eid is not None:
+            out_eid.append(eid[sel])
+    nb = np.concatenate(out_nb) if out_nb else np.zeros((0,), r.dtype)
+    ct = np.asarray(out_ct, np.int32)
+    res = (Tensor(jnp.asarray(nb)), Tensor(jnp.asarray(ct)))
+    if return_eids:
+        if eid is None:
+            raise ValueError("return_eids=True requires eids")
+        res = res + (Tensor(jnp.asarray(
+            np.concatenate(out_eid) if out_eid
+            else np.zeros((0,), r.dtype))),)
+    return res
+
+
+def graph_khop_sampler(row, colptr, input_nodes,
+                       sample_sizes: Sequence[int], sorted_eids=None,
+                       return_eids=False, name=None):
+    """Multi-hop neighbor sampling + reindex (graph_khop_sampler.py:63).
+    Returns (edge_src, edge_dst, sample_index, reindex_nodes)."""
+    frontier = ensure_tensor(input_nodes)
+    all_nb, all_ct = [], []
+    seeds = np.asarray(frontier.numpy()).reshape(-1)
+    cur = seeds
+    for size in sample_sizes:
+        nb, ct = graph_sample_neighbors(row, colptr, Tensor(jnp.asarray(cur)),
+                                        sample_size=size)
+        all_nb.append(np.asarray(nb.numpy()))
+        all_ct.append((cur, np.asarray(ct.numpy())))
+        cur = np.unique(np.asarray(nb.numpy()))
+    # flatten all hops into one edge list rooted at each hop's sources
+    srcs, dsts = [], []
+    mapping = {}
+    order: List[int] = []
+
+    def idx(v):
+        if v not in mapping:
+            mapping[v] = len(order)
+            order.append(v)
+        return mapping[v]
+
+    for v in seeds.tolist():
+        idx(v)
+    for nb, (src_nodes, ct) in zip(all_nb, all_ct):
+        pos = 0
+        for s, c in zip(src_nodes.tolist(), ct.tolist()):
+            si = idx(s)
+            for v in nb[pos:pos + c].tolist():
+                srcs.append(idx(v))
+                dsts.append(si)
+            pos += c
+    dtype = seeds.dtype
+    return (Tensor(jnp.asarray(np.asarray(srcs, dtype))),
+            Tensor(jnp.asarray(np.asarray(dsts, dtype))),
+            Tensor(jnp.asarray(seeds)),
+            Tensor(jnp.asarray(np.asarray(order, dtype))))

@@ -87,6 +87,7 @@ class LookAhead:
         return state
 
     def set_state_dict(self, state):
+        state = dict(state)   # leave the caller's dict reusable
         self._k_step = state.pop("@lookahead_k_step", 0)
         slow = state.pop("@lookahead_slow", {})
         params = self._params()
@@ -95,6 +96,8 @@ class LookAhead:
         self.inner_optimizer.set_state_dict(state)
 
     def __getattr__(self, name):
+        if name == "inner_optimizer":   # unpickle/deepcopy guard
+            raise AttributeError(name)
         return getattr(self.inner_optimizer, name)
 
 

@@ -2,3 +2,26 @@ from .api import (InputSpec, TranslatedLayer, enable_to_static,  # noqa: F401
                   ignore_module, load, not_to_static, save, to_static)
 from .functional import TracedProgram  # noqa: F401
 from .train_step import TrainStepProgram, train_step  # noqa: F401
+
+
+_SOT_LOG = {"code_level": 0, "verbosity": 0}
+
+
+def set_code_level(level=100):
+    """jit/sot set_code_level (reference jit/__init__.py): controls how
+    much generated-code logging SOT emits. The graph-break tracer logs
+    through the standard logger; the level is recorded and applied."""
+    import logging
+    _SOT_LOG["code_level"] = int(level)
+    logging.getLogger("paddle2_tpu.jit").setLevel(
+        logging.DEBUG if level > 0 else logging.WARNING)
+
+
+def set_verbosity(level=0, also_to_stderr=False):
+    """jit/sot set_verbosity parity."""
+    import logging
+    _SOT_LOG["verbosity"] = int(level)
+    lg = logging.getLogger("paddle2_tpu.jit")
+    lg.setLevel(logging.DEBUG if level > 0 else logging.WARNING)
+    if also_to_stderr and not lg.handlers:
+        lg.addHandler(logging.StreamHandler())

@@ -12,6 +12,7 @@ from .layer.container import *    # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 from .layer.loss import *         # noqa: F401,F403
 from .layer.rnn import *          # noqa: F401,F403
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .utils_mod import clip_grad_norm_, clip_grad_value_  # noqa: F401

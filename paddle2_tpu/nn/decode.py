@@ -1,0 +1,164 @@
+"""Seq2seq decoding infrastructure (reference python/paddle/nn/decode.py:
+Decoder :39, BeamSearchDecoder :161, dynamic_decode :~1200).
+
+The step loop runs eagerly on host (decode lengths are data-dependent);
+each step's tensor work — cell forward, log-softmax, top-k over
+beam x vocab, beam/state gathers — is XLA-compiled via the op layer, and
+the final backtrace reuses ``F.gather_tree``'s compiled scan.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import ensure_tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """decode.py:39 — the initialize/step/finalize protocol."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+def _map_structure(fn, tree):
+    return jax.tree_util.tree_map(fn, tree)
+
+
+class BeamSearchDecoder(Decoder):
+    """decode.py:161 — beam search over an RNN cell.
+
+    ``embedding_fn`` maps token ids to the cell's input; ``output_fn``
+    maps cell output to vocab logits.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """decode.py:256 — [B, ...] -> [B*beam, ...] by tiling."""
+        x = ensure_tensor(x)
+        a = x._data
+        tiled = jnp.repeat(a[:, None], beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + a.shape[1:]))
+
+    def _merge(self, a):
+        return a.reshape((-1,) + a.shape[2:])
+
+    def _split(self, a):
+        return a.reshape((-1, self.beam_size) + a.shape[1:])
+
+    def initialize(self, inits):
+        """Tile initial states across beams; beam 0 starts live (log-prob
+        0), the rest dead (-inf), so step 1 expands a single beam."""
+        states = _map_structure(
+            lambda t: self._merge(jnp.repeat(
+                ensure_tensor(t)._data[:, None], self.beam_size, axis=1)),
+            inits)
+        batch = jax.tree_util.tree_leaves(states)[0].shape[0] \
+            // self.beam_size
+        tokens = jnp.full((batch * self.beam_size,), self.start_token,
+                          jnp.int32)
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1),
+                        jnp.float32)[None], (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        return tokens, states, log_probs, finished
+
+    def _embed(self, tokens):
+        t = Tensor(tokens)
+        if self.embedding_fn is not None:
+            return self.embedding_fn(t)
+        return t
+
+    def step(self, time, tokens, states, log_probs, finished):
+        inputs = self._embed(tokens)
+        cell_out, next_states = self.cell(inputs, _map_structure(
+            lambda a: Tensor(a), states))
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = ensure_tensor(cell_out)._data
+        V = logits.shape[-1]
+        B = logits.shape[0] // self.beam_size
+        step_lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        step_lp = step_lp.reshape(B, self.beam_size, V)
+        # finished beams extend only with end_token, at zero cost
+        fin_mask = jnp.full((V,), -1e9,
+                            jnp.float32).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None], fin_mask[None, None],
+                            step_lp)
+        scores = (log_probs[..., None] + step_lp).reshape(B, -1)
+        top_scores, top_idx = jax.lax.top_k(scores, self.beam_size)
+        parent = (top_idx // V).astype(jnp.int32)      # [B, beam]
+        token = (top_idx % V).astype(jnp.int32)
+        next_states = _map_structure(
+            lambda t: self._merge(jnp.take_along_axis(
+                self._split(ensure_tensor(t)._data), parent.reshape(
+                    (B, self.beam_size)
+                    + (1,) * (ensure_tensor(t)._data.ndim - 1)),
+                axis=1)), next_states)
+        prev_fin = jnp.take_along_axis(finished, parent, axis=1)
+        next_finished = prev_fin | (token == self.end_token)
+        return (token.reshape(-1), next_states, top_scores,
+                next_finished, parent)
+
+    def finalize(self, step_tokens, step_parents, sequence_lengths):
+        """Backtrace beams through the parent pointers (gather_tree)."""
+        from .functional import gather_tree
+        ids = Tensor(jnp.stack(step_tokens))        # [T, B, beam]
+        parents = Tensor(jnp.stack(step_parents))
+        return gather_tree(ids, parents)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """decode.py dynamic_decode: run decoder.initialize, then step until
+    every beam is finished or ``max_step_num``; finalize with the
+    backtrace."""
+    tokens, states, log_probs, finished = decoder.initialize(inits)
+    B, beam = finished.shape
+    step_tokens, step_parents = [], []
+    lengths = jnp.zeros((B, beam), jnp.int32)
+    limit = int(max_step_num) if max_step_num is not None else 256
+    for t in range(limit):
+        (tokens, states, log_probs, next_finished,
+         parent) = decoder.step(t, tokens, states, log_probs, finished)
+        step_tokens.append(tokens.reshape(B, beam))
+        step_parents.append(parent)
+        lengths = lengths + (~next_finished).astype(jnp.int32)
+        finished = next_finished
+        if bool(jnp.all(finished)):
+            break
+    ids = decoder.finalize(step_tokens, step_parents, lengths)
+    if not output_time_major:
+        ids = Tensor(jnp.transpose(ids._data, (1, 0, 2)))
+    # count end_token emission in the length (reference semantics)
+    lengths = Tensor(jnp.minimum(lengths + 1, len(step_tokens)))
+    if return_length:
+        return ids, lengths
+    return ids

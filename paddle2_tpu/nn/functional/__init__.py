@@ -11,7 +11,9 @@ from ...kernels.attention import scaled_dot_product_attention  # noqa: F401
 # NOTE: like the reference, `paddle.nn.functional.flash_attention` is the
 # SUBMODULE (PaddleNLP does `paddle.nn.functional.flash_attention
 # .flash_attention(...)`); only the helper names are lifted here
-from .flash_attention import (flash_attn_qkvpacked,  # noqa: F401
+from .flash_attention import (flashmask_attention,  # noqa: F401
+                              sparse_attention,
+                              flash_attn_qkvpacked,
                               flash_attn_unpadded,
                               flash_attn_varlen_qkvpacked, sdp_kernel)
 from . import flash_attention  # noqa: F401  (module binding wins)

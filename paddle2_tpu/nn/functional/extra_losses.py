@@ -7,6 +7,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...framework.tensor import Tensor
 from ...ops.dispatch import apply_op, ensure_tensor
@@ -14,7 +15,8 @@ from ...ops.dispatch import apply_op, ensure_tensor
 __all__ = ["pairwise_distance", "soft_margin_loss",
            "multi_label_soft_margin_loss", "multi_margin_loss",
            "gaussian_nll_loss", "triplet_margin_with_distance_loss",
-           "dice_loss", "npair_loss", "gather_tree", "temporal_shift"]
+           "dice_loss", "npair_loss", "gather_tree", "temporal_shift",
+           "hsigmoid_loss", "adaptive_log_softmax_with_loss", "rnnt_loss"]
 
 
 def _reduce(val, reduction):
@@ -177,3 +179,174 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
             out = jnp.transpose(out, (0, 2, 3, 1))
         return out
     return apply_op("temporal_shift", f, (ensure_tensor(x),), {})
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference loss.py hsigmoid_loss;
+    kernel hsigmoid_loss_kernel.cc + matrix_bit_code.h SimpleCode).
+
+    Complete-tree mode: class c encodes as ``c + num_classes``; walking
+    the bits of the code from LSB gives, per level j, the internal-node
+    row ``(code >> (j+1)) - 1`` and the binary target ``(code >> j) & 1``.
+    Loss per sample = sum over path of BCE-with-logits(w_row . x + b_row,
+    bit), logits clipped to [-40, 40] like the kernel.  Custom-tree mode
+    takes ``path_table``/``path_code`` (-1-padded) directly."""
+    tensors = [ensure_tensor(input), ensure_tensor(label),
+               ensure_tensor(weight)]
+    has_bias = bias is not None
+    if has_bias:
+        tensors.append(ensure_tensor(bias))
+    custom = path_table is not None
+    if custom:
+        tensors.append(ensure_tensor(path_table))
+        tensors.append(ensure_tensor(path_code))
+
+    def fn(x, lab, w, *rest):
+        b = rest[0] if has_bias else None
+        if custom:
+            ptab = rest[-2].astype(jnp.int32)   # [N, L] rows, -1 pad
+            pcode = rest[-1].astype(jnp.int32)  # [N, L] bits
+            valid = ptab >= 0
+            rows = jnp.clip(ptab, 0)
+            bits = pcode.astype(jnp.float32)
+        else:
+            code = (lab.astype(jnp.int32).reshape(-1)
+                    + jnp.int32(num_classes))   # [N]
+            L = int(np.ceil(np.log2(2 * num_classes)))
+            j = jnp.arange(L)
+            shifted = code[:, None] >> (j[None, :] + 1)
+            valid = shifted > 0                  # bit within path length
+            rows = jnp.clip(shifted - 1, 0)
+            bits = ((code[:, None] >> j[None, :]) & 1).astype(jnp.float32)
+        wr = jnp.take(w, rows, axis=0)           # [N, L, F]
+        z = jnp.einsum("nlf,nf->nl", wr, x)
+        if b is not None:
+            z = z + jnp.take(b.reshape(-1), rows)
+        z = jnp.clip(z, -40.0, 40.0)
+        # BCE with logits: softplus(z) - bit * z
+        per = jnp.logaddexp(0.0, z) - bits * z
+        per = jnp.where(valid, per, 0.0)
+        return jnp.sum(per, axis=1, keepdims=True)
+
+    return apply_op("hsigmoid_loss", fn, tuple(tensors), {})
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (reference loss.py:4461): frequent classes score
+    in the head shortlist; rare classes live in clusters reached through
+    a cluster logit and a low-rank tail projection. Returns (per-sample
+    target log-prob, nll loss = -mean)."""
+    cutoffs = [int(c) for c in cutoffs]
+    n_clusters = len(cutoffs)
+    shortlist = cutoffs[0] if cutoffs else 0
+    cutoff_ends = [0] + cutoffs
+    tensors = [ensure_tensor(input), ensure_tensor(label),
+               ensure_tensor(head_weight)]
+    has_bias = head_bias is not None
+    if has_bias:
+        tensors.append(ensure_tensor(head_bias))
+    flat_tails = []
+    for pair in tail_weights:
+        flat_tails.extend([ensure_tensor(pair[0]), ensure_tensor(pair[1])])
+    tensors.extend(flat_tails)
+
+    def fn(x, lab, hw, *rest):
+        hb = rest[0] if has_bias else None
+        tails = rest[1 if has_bias else 0:]
+        lab_i = lab.astype(jnp.int32).reshape(-1)
+        head = x @ hw                           # [N, shortlist+K]
+        if hb is not None:
+            head = head + hb
+        head_lp = jax.nn.log_softmax(head, axis=-1)
+        # shortlist targets read head directly; clamp for gather safety
+        out = jnp.take_along_axis(
+            head_lp, jnp.clip(lab_i, 0, shortlist - 1)[:, None],
+            axis=1)[:, 0]
+        for k in range(n_clusters):
+            lo = cutoffs[k]
+            hi = cutoffs[k + 1] if k + 1 < n_clusters else None
+            proj, ow = tails[2 * k], tails[2 * k + 1]
+            csize = ow.shape[1]
+            tail_lp = jax.nn.log_softmax((x @ proj) @ ow, axis=-1)
+            in_k = (lab_i >= lo) & ((lab_i < hi) if hi is not None
+                                    else jnp.full_like(lab_i, True,
+                                                       dtype=bool))
+            local = jnp.clip(lab_i - lo, 0, csize - 1)
+            cluster_lp = head_lp[:, shortlist + k]
+            cand = cluster_lp + jnp.take_along_axis(
+                tail_lp, local[:, None], axis=1)[:, 0]
+            out = jnp.where(in_k, cand, out)
+        return out, -jnp.mean(out)
+
+    return apply_op("adaptive_log_softmax", fn, tuple(tensors), {})
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T transducer loss (reference loss.py:2055, warp-transducer).
+
+    Forward-variable DP as lax.scan over T with the U axis vectorized:
+      alpha[t, u] = logaddexp(alpha[t-1, u] + blank(t-1, u),
+                              alpha[t, u-1] + emit(t, u-1))
+    run entirely in log space; -(alpha[T-1, U] + blank(T-1, U)) is the
+    NLL. FastEmit scales the emission terms' GRADIENT by (1+lambda)
+    with the loss value unchanged (warp_transducer's formulation),
+    expressed as y*(1+l) - stop_gradient(y*l)."""
+    tensors = [ensure_tensor(input), ensure_tensor(label),
+               ensure_tensor(input_lengths), ensure_tensor(label_lengths)]
+
+    def fn(logits, labels, t_lens, u_lens):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        B, T, U1, V = lp.shape
+        U = U1 - 1
+        labels_i = labels.astype(jnp.int32)
+        blank_lp = lp[..., blank]               # [B, T, U+1]
+        emit_lp = jnp.take_along_axis(
+            lp[:, :, :U, :],
+            jnp.broadcast_to(labels_i[:, None, :, None], (B, T, U, 1)),
+            axis=3)[..., 0]                      # [B, T, U]
+        if fastemit_lambda:
+            lam = float(fastemit_lambda)
+            emit_lp = (emit_lp * (1.0 + lam)
+                       - jax.lax.stop_gradient(emit_lp * lam))
+        neg = jnp.float32(-1e30)
+        u_idx = jnp.arange(U1)
+
+        def step(alpha_prev, t):
+            # horizontal move: blank at (t-1, u) keeps u
+            from_blank = jnp.where(
+                t > 0, alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :],
+                jnp.where(u_idx[None, :] == 0, 0.0, neg))
+            # init: alpha[0, 0] = 0; alpha[0, u>0] via vertical scan below
+            def vert(carry, u):
+                # vertical move: emit label u-1 at (t, u-1)
+                val = jnp.where(
+                    u > 0,
+                    jnp.logaddexp(
+                        from_blank[:, u],
+                        carry + emit_lp[:, t, jnp.maximum(u - 1, 0)]),
+                    from_blank[:, u])
+                return val, val
+            _, cols = jax.lax.scan(vert, jnp.full((B,), neg), u_idx)
+            alpha = jnp.transpose(cols)          # [B, U+1]
+            return alpha, alpha
+
+        init = jnp.full((B, U1), neg)
+        _, alphas = jax.lax.scan(step, init, jnp.arange(T))
+        alphas = jnp.transpose(alphas, (1, 0, 2))   # [B, T, U+1]
+        t_last = jnp.clip(t_lens.astype(jnp.int32) - 1, 0)
+        u_last = jnp.clip(u_lens.astype(jnp.int32), 0)
+        a_fin = alphas[jnp.arange(B), t_last, u_last]
+        b_fin = blank_lp[jnp.arange(B), t_last, u_last]
+        nll = -(a_fin + b_fin)
+        if reduction == "mean":
+            # warp-transducer averages over batch
+            return jnp.mean(nll)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return apply_op("rnnt_loss", fn, tuple(tensors), {})

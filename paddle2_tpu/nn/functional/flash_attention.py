@@ -18,6 +18,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...framework.tensor import Tensor
 from ...kernels.attention import (_sdpa_xla,
@@ -26,7 +27,7 @@ from ...ops.dispatch import apply_op, ensure_tensor
 
 __all__ = ["flash_attention", "flash_attn_unpadded", "flash_attn_qkvpacked",
            "flash_attn_varlen_qkvpacked",
-           "scaled_dot_product_attention", "sdp_kernel"]
+           "scaled_dot_product_attention", "sdp_kernel", "flashmask_attention", "sparse_attention"]
 
 
 def flash_attention(query, key, value, dropout: float = 0.0,
@@ -270,3 +271,146 @@ def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
                                max_seqlen_q, max_seqlen_k, scale,
                                dropout=dropout, causal=causal,
                                return_softmax=return_softmax, **kwargs)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout: float = 0.0, causal: bool = False,
+                        window_size=None, return_softmax_lse: bool = False,
+                        return_seed_offset: bool = False,
+                        fixed_seed_offset=None, rng_name: str = "",
+                        training: bool = True, name=None):
+    """FlashMask attention (reference flash_attention.py:1098): the mask
+    is a column-wise sparse description — per KEY position, row ranges
+    of the score matrix to mask:
+
+      causal, last dim 1:  mask rows i >= s0[j]            (+ causal)
+      causal, last dim 2:  mask s0[j] <= i < s1[j]         (+ causal)
+      bidir,  last dim 2:  mask i >= s0[j]  and  i < s1[j]
+      bidir,  last dim 4:  mask s0<=i<s1    and  s2<=i<s3
+
+    The reference's CUDA kernel skips masked tiles; here the ranges
+    materialize as a boolean mask inside one fused XLA attention — the
+    tile-skipping Pallas variant follows the same contract.
+    """
+    tensors = [ensure_tensor(query), ensure_tensor(key),
+               ensure_tensor(value)]
+    has_idx = startend_row_indices is not None
+    if has_idx:
+        tensors.append(ensure_tensor(startend_row_indices))
+
+    def fn(q, k, v, *rest):
+        B, Sq, H, D = q.shape
+        Sk = k.shape[1]
+        scale = 1.0 / np.sqrt(D)
+        # [B, H, Sq, Sk]
+        scores = jnp.einsum("bqhd,bkhd->bhqk",
+                            q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        rows = jnp.arange(Sq)[:, None]             # i
+        cols = jnp.arange(Sk)[None, :]             # j
+        masked = jnp.zeros((1, 1, Sq, Sk), bool)
+        if causal:
+            masked = masked | (rows < cols)[None, None]
+        if window_size is not None:
+            w = ((window_size, window_size)
+                 if isinstance(window_size, int) else tuple(window_size))
+            masked = masked | (rows - cols > w[0])[None, None]
+            if not causal:
+                masked = masked | (cols - rows > w[1])[None, None]
+        if has_idx:
+            idx = rest[0].astype(jnp.int32)        # [B, Hk, Sk, {1,2,4}]
+            if idx.shape[1] == 1:
+                idx = jnp.broadcast_to(idx, (B, H) + idx.shape[2:])
+            n = idx.shape[-1]
+            i = rows[None, None]                   # [1, 1, Sq, 1]
+            s = jnp.swapaxes(idx, 2, 3)            # [B, H, n, Sk]
+            if causal and n == 1:
+                band = i >= s[:, :, 0][:, :, None, :]
+            elif causal and n == 2:
+                band = ((i >= s[:, :, 0][:, :, None, :])
+                        & (i < s[:, :, 1][:, :, None, :]))
+            elif not causal and n == 2:
+                band = ((i >= s[:, :, 0][:, :, None, :])
+                        | (i < s[:, :, 1][:, :, None, :]))
+            elif not causal and n == 4:
+                band = (((i >= s[:, :, 0][:, :, None, :])
+                         & (i < s[:, :, 1][:, :, None, :]))
+                        | ((i >= s[:, :, 2][:, :, None, :])
+                           & (i < s[:, :, 3][:, :, None, :])))
+            else:
+                raise ValueError(
+                    f"startend_row_indices last dim {n} invalid for "
+                    f"causal={causal}")
+            masked = masked | band
+        scores = jnp.where(masked, -jnp.inf, scores)
+        lse = jax.scipy.special.logsumexp(scores, axis=-1)
+        probs = jnp.exp(scores - lse[..., None])
+        # fully-masked rows: zero output, not NaN
+        probs = jnp.where(jnp.isfinite(lse)[..., None], probs, 0.0)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                         v.astype(jnp.float32)).astype(q.dtype)
+        if return_softmax_lse:
+            return out, lse
+        return out
+
+    res = apply_op("flashmask_attention", fn, tuple(tensors), {})
+    if return_seed_offset:
+        extra = Tensor(jnp.zeros((2,), jnp.int32))
+        return (res + (extra,)) if isinstance(res, tuple) else (res, extra)
+    return res
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention with a CSR pattern (reference
+    sparse_attention op): q/k/v are [B, H, S, D]; per query row r, only
+    the keys listed in columns[offset[r]:offset[r+1]] participate in the
+    softmax. Dense-equivalent lowering: the CSR pattern scatters into a
+    boolean mask consumed by one fused masked softmax."""
+    tensors = [ensure_tensor(query), ensure_tensor(key),
+               ensure_tensor(value), ensure_tensor(sparse_csr_offset),
+               ensure_tensor(sparse_csr_columns)]
+    extra = []
+    if key_padding_mask is not None:
+        extra.append(ensure_tensor(key_padding_mask))
+    if attn_mask is not None:
+        extra.append(ensure_tensor(attn_mask))
+    tensors.extend(extra)
+    has_kpm = key_padding_mask is not None
+    has_am = attn_mask is not None
+
+    def fn(q, k, v, offset, columns, *rest):
+        B, H, S, D = q.shape
+        nnz = columns.shape[-1]
+        offset = offset.astype(jnp.int32)
+        columns = columns.astype(jnp.int32)
+
+        def one(off, cols):
+            # nnz element e belongs to row searchsorted(off, e, 'right')-1
+            rows = jnp.searchsorted(off, jnp.arange(nnz), side="right") - 1
+            rows = jnp.clip(rows, 0, S - 1)
+            valid = jnp.arange(nnz) < off[-1]
+            m = jnp.zeros((S, S), bool)
+            # max-scatter: padded tail elements (valid=False) collide at
+            # clipped positions and must not clear real True entries
+            return m.at[rows, jnp.clip(cols, 0, S - 1)].max(valid)
+
+        allow = jax.vmap(jax.vmap(one))(offset, columns)   # [B, H, S, S]
+        scale = 1.0 / np.sqrt(D)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        if has_kpm:
+            kpm = rest[0]
+            allow = allow & (kpm[:, None, None, :] > -1.0)
+        if has_am:
+            am = rest[-1]
+            scores = scores + am.astype(jnp.float32)
+        scores = jnp.where(allow, scores, -jnp.inf)
+        lse = jax.scipy.special.logsumexp(scores, axis=-1)
+        probs = jnp.where(jnp.isfinite(lse)[..., None],
+                          jnp.exp(scores - lse[..., None]), 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+    return apply_op("sparse_attention", fn, tuple(tensors), {})

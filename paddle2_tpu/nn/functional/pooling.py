@@ -13,7 +13,9 @@ from ...ops.dispatch import apply_op, ensure_tensor
 __all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
            "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
            "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
-           "adaptive_max_pool2d", "adaptive_max_pool3d", "lp_pool2d"]
+           "adaptive_max_pool2d", "adaptive_max_pool3d", "lp_pool1d",
+           "lp_pool2d", "max_unpool1d", "max_unpool2d", "max_unpool3d",
+           "fractional_max_pool2d", "fractional_max_pool3d"]
 
 
 def _tuplize(v, n):
@@ -75,23 +77,35 @@ def _pool(x, kernel, stride, padding, n, reducer, init, ceil_mode,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
-    out = _pool(x, kernel_size, stride, padding, 1, "max", None, ceil_mode,
-                not data_format.startswith("NC"), name="max_pool1d")
-    return (out, None) if return_mask else out
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1,
+                                   ceil_mode,
+                                   not data_format.startswith("NC"),
+                                   "max_pool1d")
+    return _pool(x, kernel_size, stride, padding, 1, "max", None, ceil_mode,
+                 not data_format.startswith("NC"), name="max_pool1d")
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
-    out = _pool(x, kernel_size, stride, padding, 2, "max", None, ceil_mode,
-                not data_format.startswith("NC"), name="max_pool2d")
-    return (out, None) if return_mask else out
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
+                                   ceil_mode,
+                                   not data_format.startswith("NC"),
+                                   "max_pool2d")
+    return _pool(x, kernel_size, stride, padding, 2, "max", None, ceil_mode,
+                 not data_format.startswith("NC"), name="max_pool2d")
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
-    out = _pool(x, kernel_size, stride, padding, 3, "max", None, ceil_mode,
-                not data_format.startswith("NC"), name="max_pool3d")
-    return (out, None) if return_mask else out
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
+                                   ceil_mode,
+                                   not data_format.startswith("NC"),
+                                   "max_pool3d")
+    return _pool(x, kernel_size, stride, padding, 3, "max", None, ceil_mode,
+                 not data_format.startswith("NC"), name="max_pool3d")
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -186,3 +200,231 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     out = _adaptive(x, output_size, 3, "max", False, "adaptive_max_pool3d")
     return (out, None) if return_mask else out
+
+
+def _max_pool_with_mask(x, kernel, stride, padding, n, ceil_mode,
+                        channel_last, name):
+    """Max pool that also returns the reference's mask: per output
+    element, the FLAT index into the input's spatial plane of the max
+    (max_pool_with_index kernels). Patch-extraction route: taps
+    materialize as a K axis, argmax picks the tap, tap -> input index."""
+    x = ensure_tensor(x)
+    kernel = _tuplize(kernel, n)
+    stride = _tuplize(stride if stride is not None else kernel, n)
+    pad = _pad_cfg(padding, n)
+    if isinstance(pad, str):
+        raise ValueError("mask mode needs explicit padding")
+    if channel_last:
+        raise NotImplementedError("return_mask expects NC-first layouts")
+
+    def fn(a):
+        nd = a.ndim
+        spatial = a.shape[nd - n:]
+        if ceil_mode:
+            padc = list(pad)
+            for i in range(n):
+                eff = spatial[i] + padc[i][0] + padc[i][1]
+                rem = (eff - kernel[i]) % stride[i]
+                if rem:
+                    padc[i] = (padc[i][0], padc[i][1] + (stride[i] - rem))
+        else:
+            padc = pad
+        neg = jnp.finfo(jnp.float32).min
+        ap = jnp.pad(a.astype(jnp.float32),
+                     [(0, 0), (0, 0)] + list(padc), constant_values=neg)
+        outs = [(ap.shape[2 + i] - kernel[i]) // stride[i] + 1
+                for i in range(n)]
+        K = int(np.prod(kernel))
+        # window gather: for each tap, a strided slice; K is tiny/static
+        taps = []
+        tap_coord = []
+        for t in range(K):
+            idx = []
+            rem = t
+            for i in reversed(range(n)):
+                idx.append(rem % kernel[i])
+                rem //= kernel[i]
+            idx = idx[::-1]
+            tap_coord.append(idx)
+            sl = [slice(None), slice(None)]
+            for i in range(n):
+                sl.append(slice(idx[i], idx[i] + (outs[i] - 1) * stride[i]
+                                + 1, stride[i]))
+            taps.append(ap[tuple(sl)])
+        stack = jnp.stack(taps, axis=2)       # [N, C, K, *outs]
+        out = jnp.max(stack, axis=2).astype(a.dtype)
+        arg = jnp.argmax(stack, axis=2)       # tap index
+        # tap -> input plane flat index (unpadded coordinates)
+        coords = jnp.asarray(tap_coord, jnp.int32)   # [K, n]
+        grids = jnp.meshgrid(*[jnp.arange(o) for o in outs],
+                             indexing="ij")
+        flat = jnp.zeros(arg.shape, jnp.int32)
+        for i in range(n):
+            pos = (grids[i][None, None] * stride[i]
+                   + jnp.take(coords[:, i], arg) - padc[i][0])
+            flat = flat * spatial[i] + pos
+        return out, flat
+
+    out, mask = apply_op(name, fn, (x,), {})
+    return out, mask
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    x = ensure_tensor(x)
+    p = float(norm_type)
+    powed = apply_op("lp_pow", lambda a: jnp.abs(a) ** p, (x,), {})
+    pooled = _pool(powed, kernel_size, stride, padding, 1, "avg", None,
+                   ceil_mode, not data_format.startswith("NC"),
+                   name="lp_pool1d")
+    kernel = _tuplize(kernel_size, 1)
+    scale = float(np.prod(kernel))
+    return apply_op("lp_root", lambda a: (a * scale) ** (1.0 / p),
+                    (pooled,), {})
+
+
+def _max_unpool(x, indices, n, kernel_size, stride, padding, output_size,
+                data_format, name):
+    """Scatter pooled values back to their argmax positions
+    (unpool kernels); non-max positions are zero."""
+    x = ensure_tensor(x)
+    idx = ensure_tensor(indices)
+    kernel = _tuplize(kernel_size, n)
+    stride = _tuplize(stride if stride is not None else kernel_size, n)
+    pads = _pad_cfg(padding, n)
+    if output_size is None:
+        out_sp = tuple(
+            (x.shape[2 + i] - 1) * stride[i] - 2 * pads[i][0] + kernel[i]
+            for i in range(n))
+    else:
+        out_sp = tuple(output_size[-n:])
+
+    def fn(a, ind):
+        N, C = a.shape[:2]
+        P = int(np.prod(a.shape[2:]))
+        plane = int(np.prod(out_sp))
+        flat = jnp.zeros((N, C, plane), a.dtype)
+        ii = ind.reshape(N, C, P)
+        vals = a.reshape(N, C, P)
+        flat = flat.at[
+            jnp.arange(N)[:, None, None],
+            jnp.arange(C)[None, :, None], ii].set(vals)
+        return flat.reshape((N, C) + out_sp)
+
+    return apply_op(name, fn, (x, idx), {})
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format, "max_unpool3d")
+
+
+def _fractional_bounds(in_size, out_size, u):
+    """Graham fractional pooling boundaries (pooling.py:2105):
+    start_i = ceil(alpha*(i+u) - 1), end_i = ceil(alpha*(i+1+u) - 1),
+    first window clamped to 0, last to in_size."""
+    alpha = in_size / out_size
+    starts = [max(0, int(np.ceil(alpha * (i + u) - 1)))
+              for i in range(out_size)]
+    ends = [min(in_size, int(np.ceil(alpha * (i + 1 + u) - 1)))
+            for i in range(out_size)]
+    ends[-1] = in_size
+    starts[0] = 0
+    return starts, ends
+
+
+def _fractional_max_pool(x, output_size, n, kernel_size, random_u,
+                         return_mask, name):
+    x = ensure_tensor(x)
+    if random_u is None:
+        from ...framework import random as fr
+        random_u = float(jax.random.uniform(fr.next_key(), ()))
+    u = float(random_u)
+    if not 0.0 < u < 1.0:
+        raise ValueError(f"random_u must be in (0, 1), got {u}")
+    nd = x.ndim
+    spatial = [x.shape[nd - n + i] for i in range(n)]
+    out_sizes = _tuplize(output_size, n)
+    out_sizes = tuple(out_sizes[i] if out_sizes[i] is not None
+                      else spatial[i] for i in range(n))
+    kern = _tuplize(kernel_size, n) if kernel_size is not None else None
+    bounds = []
+    for i in range(n):
+        s, e = _fractional_bounds(spatial[i], out_sizes[i], u)
+        if kern is not None:
+            # overlapping mode: fixed kernel extent from each start
+            e = [min(spatial[i], st + kern[i]) for st in s]
+        bounds.append((s, e))
+
+    def fn(a):
+        out = a
+        # reduce one spatial axis at a time (out sizes are static)
+        for i in range(n):
+            ax = a.ndim - n + i
+            s_list, e_list = bounds[i]
+            pieces = []
+            for s, e in zip(s_list, e_list):
+                seg = jax.lax.slice_in_dim(out, s, e, axis=ax)
+                pieces.append(jnp.max(seg, axis=ax, keepdims=True))
+            out = jnp.concatenate(pieces, axis=ax)
+        return out
+
+    res = apply_op(name, fn, (x,), {})
+    if not return_mask:
+        return res
+    # mask: recompute flat argmax per output cell (host-static bounds)
+    def mask_fn(a):
+        N, C = a.shape[:2]
+        idx_grids = []
+        cells = [list(zip(*bounds[i])) for i in range(n)]
+        plane_mul = [int(np.prod(spatial[i + 1:])) for i in range(n)]
+        out = np.zeros((N, C) + tuple(out_sizes), np.int32)
+        an = np.asarray(a)
+        for pos in np.ndindex(*out_sizes):
+            sl = tuple(slice(cells[i][pos[i]][0], cells[i][pos[i]][1])
+                       for i in range(n))
+            seg = an[(slice(None), slice(None)) + sl]
+            seg2 = seg.reshape(N, C, -1)
+            arg = seg2.argmax(-1)
+            # unravel within the window, offset by window start
+            sizes = [cells[i][pos[i]][1] - cells[i][pos[i]][0]
+                     for i in range(n)]
+            flat = np.zeros((N, C), np.int64)
+            rem = arg
+            local = []
+            for i in reversed(range(n)):
+                local.append(rem % sizes[i])
+                rem = rem // sizes[i]
+            local = local[::-1]
+            for i in range(n):
+                flat = flat * spatial[i] + (local[i]
+                                            + cells[i][pos[i]][0])
+            out[(slice(None), slice(None)) + pos] = flat
+        return jnp.asarray(out)
+
+    return res, Tensor(mask_fn(x._data))
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_max_pool(x, output_size, 2, kernel_size, random_u,
+                                return_mask, "fractional_max_pool2d")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_max_pool(x, output_size, 3, kernel_size, random_u,
+                                return_mask, "fractional_max_pool3d")

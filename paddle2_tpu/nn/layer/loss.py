@@ -10,7 +10,8 @@ __all__ = ["SoftMarginLoss", "MultiLabelSoftMarginLoss",
            "TripletMarginWithDistanceLoss", "CrossEntropyLoss", "NLLLoss", "BCELoss", "BCEWithLogitsLoss",
            "MSELoss", "L1Loss", "SmoothL1Loss", "KLDivLoss",
            "MarginRankingLoss", "HingeEmbeddingLoss", "CosineEmbeddingLoss",
-           "CTCLoss", "TripletMarginLoss", "PoissonNLLLoss", "HuberLoss"]
+           "CTCLoss", "TripletMarginLoss", "PoissonNLLLoss", "HuberLoss",
+           "HSigmoidLoss", "AdaptiveLogSoftmaxWithLoss", "RNNTLoss"]
 
 
 class CrossEntropyLoss(Layer):
@@ -226,3 +227,127 @@ class TripletMarginWithDistanceLoss(Layer):
         return F.triplet_margin_with_distance_loss(
             input, positive, negative, self.distance_function, self.margin,
             self.swap, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """layer/loss.py HSigmoidLoss: learnable hierarchical-softmax tree
+    over ``num_classes`` leaves (weight rows = internal nodes)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_classes - 1, 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """layer/loss.py:2409 AdaptiveLogSoftmaxWithLoss: head shortlist +
+    div_value-shrunk tail clusters."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if (cutoffs != sorted(cutoffs) or min(cutoffs) <= 0
+                or max(cutoffs) > n_classes - 1
+                or len(set(cutoffs)) != len(cutoffs)):
+            raise ValueError(
+                "cutoffs should be a sequence of unique, positive "
+                "integers sorted in an increasing order, each < n_classes")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.shortlist_size = self.cutoffs[0]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.shortlist_size + self.n_clusters
+        self.head_weight = self.create_parameter(
+            [in_features, self.head_size], attr=weight_attr)
+        self.head_bias = self.create_parameter(
+            [self.head_size], attr=bias_attr,
+            is_bias=True) if head_bias else None
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features // (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = self.create_parameter([in_features, hsz],
+                                         attr=weight_attr)
+            out = self.create_parameter([hsz, osz], attr=weight_attr)
+            self.add_parameter(f"tail_proj_{i}", proj)
+            self.add_parameter(f"tail_out_{i}", out)
+            self.tail_weights.append([proj, out])
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs[:-1], self.head_bias)
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-probabilities (layer/loss.py
+        AdaptiveLogSoftmaxWithLoss.log_prob)."""
+        import jax
+        import jax.numpy as jnp
+        from ...ops.dispatch import apply_op, ensure_tensor
+        tensors = [ensure_tensor(input), ensure_tensor(self.head_weight)]
+        if self.head_bias is not None:
+            tensors.append(ensure_tensor(self.head_bias))
+        for proj, out in self.tail_weights:
+            tensors.append(ensure_tensor(proj))
+            tensors.append(ensure_tensor(out))
+        short, k = self.shortlist_size, self.n_clusters
+        cuts = self.cutoffs
+
+        def fn(x, hw, *rest):
+            i = 0
+            hb = None
+            if self.head_bias is not None:
+                hb = rest[0]
+                i = 1
+            head = x @ hw
+            if hb is not None:
+                head = head + hb
+            head_lp = jax.nn.log_softmax(head, axis=-1)
+            pieces = [head_lp[:, :short]]
+            for c in range(k):
+                proj, ow = rest[i + 2 * c], rest[i + 2 * c + 1]
+                tail_lp = jax.nn.log_softmax((x @ proj) @ ow, axis=-1)
+                pieces.append(head_lp[:, short + c:short + c + 1]
+                              + tail_lp)
+            return jnp.concatenate(pieces, axis=1)
+
+        return apply_op("adaptive_log_prob", fn, tuple(tensors), {})
+
+    def predict(self, input):
+        lp = self.log_prob(input)
+        from ...ops import math as _m
+        return lp.argmax(axis=-1)
+
+
+class RNNTLoss(Layer):
+    """layer/loss.py RNNTLoss wrapper over F.rnnt_loss."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)

@@ -3,6 +3,6 @@
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
-    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LBFGS, Lion, Momentum,
-    NAdam, RAdam, RMSProp,
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, ASGD, Lamb, LBFGS, Lion,
+    Momentum, NAdam, RAdam, RMSProp, Rprop,
 )

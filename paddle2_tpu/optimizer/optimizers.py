@@ -358,3 +358,66 @@ def np_prod(shape):
     for s in shape:
         out *= s
     return out
+
+
+class ASGD(Optimizer):
+    """Stochastic Average Gradient (reference optimizer/asgd.py:41,
+    asgd_kernel.cc): a rotating buffer of the last ``batch_num``
+    gradients whose running sum drives the step:
+        i = m % n;  d += grad - y_i;  y_i = grad;
+        param -= lr * d / min(m+1, n)
+    (the lambda*x term is the base class's weight_decay)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        if batch_num < 1:
+            raise ValueError("batch_num must be >= 1")
+        self._n = int(batch_num)
+
+    def _init_state(self, p):
+        return {"d": jnp.zeros_like(p._data),
+                "y": jnp.zeros((self._n,) + tuple(p._data.shape),
+                               p._data.dtype)}
+
+    def _update_one(self, param, grad, state, lr, step):
+        m = step.astype(jnp.int32) - 1            # 0-based update count
+        i = jnp.mod(m, self._n)
+        y_i = state["y"][i]
+        d = state["d"] - y_i + grad
+        y = state["y"].at[i].set(grad)
+        denom = jnp.minimum(m + 1, self._n).astype(jnp.float32)
+        new_p = param - lr * d / denom
+        return new_p, {"d": d, "y": y}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference optimizer/rprop.py, rprop_kernel.cc):
+    per-weight step sizes grow by eta+ while the gradient keeps its sign,
+    shrink by eta- on a sign flip (where the step is skipped), clamped to
+    learning_rate_range; the update is sign(grad) * step."""
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr0 = float(learning_rate)
+        self._lr_min, self._lr_max = (float(learning_rate_range[0]),
+                                      float(learning_rate_range[1]))
+        self._eta_neg, self._eta_pos = float(etas[0]), float(etas[1])
+
+    def _init_state(self, p):
+        return {"prev": jnp.zeros_like(p._data),
+                "lr": jnp.full_like(p._data, self._lr0)}
+
+    def _update_one(self, param, grad, state, lr, step):
+        product = grad * state["prev"]
+        eta = jnp.where(product > 0, self._eta_pos,
+                        jnp.where(product < 0, self._eta_neg, 1.0))
+        grad = jnp.where(product < 0, 0.0, grad)   # skip on sign flip
+        lr_elt = jnp.clip(state["lr"] * eta, self._lr_min, self._lr_max)
+        new_p = param - jnp.sign(grad) * lr_elt
+        return new_p, {"prev": grad, "lr": lr_elt}

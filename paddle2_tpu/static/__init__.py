@@ -372,3 +372,385 @@ class amp:
     def decorate(*args, **kwargs):
         from .. import amp as _amp
         return _amp.decorate(*args, **kwargs)
+
+
+# ---------------------------------------------------------------- r5
+# remaining reference static surface (python/paddle/static/__init__.py):
+# places, variables, program serialization, EMA, metric ops, IPU guards.
+
+Variable = None  # forward decl, assigned below
+
+
+class _Variable:
+    """static.Variable: in this framework a static 'variable' IS an
+    eager Tensor recorded into the active Program, so the class exists
+    for isinstance checks and factory helpers."""
+
+    def __new__(cls, *a, **k):
+        raise TypeError("Variable is created via static.data/"
+                        "create_parameter/create_global_var, not "
+                        "directly")
+
+
+Variable = _Variable
+
+
+def cpu_places(device_count=None):
+    """static cpu_places: the PJRT host platform devices."""
+    import jax
+    devs = [d for d in jax.devices() if d.platform == "cpu"]
+    if not devs:
+        devs = jax.devices()
+    n = device_count or len(devs)
+    return devs[:n]
+
+
+def cuda_places(device_ids=None):
+    """static cuda_places -> the accelerator devices (TPU here)."""
+    import jax
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if not devs:
+        devs = jax.devices()
+    if device_ids is not None:
+        devs = [devs[i] for i in device_ids]
+    return devs
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """static.nn create_parameter: a live trainable Tensor."""
+    from ..framework.tensor import Parameter
+    import jax.numpy as jnp
+    import numpy as np
+    if is_bias and default_initializer is None:
+        data = jnp.zeros(tuple(shape), dtype)
+    else:
+        import jax
+        from ..framework import random as fr
+        fan_in = int(np.prod(shape[:-1])) or 1
+        bound = float(np.sqrt(6.0 / fan_in))
+        data = jax.random.uniform(fr.next_key(), tuple(shape),
+                                  jnp.float32, -bound, bound).astype(dtype)
+    p = Parameter(data)
+    p.name = name or f"create_parameter_{id(p)}"
+    p.stop_gradient = False
+    if default_initializer is not None:
+        # nn.initializer protocol: initializer(param) fills in place
+        default_initializer(p)
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import jax.numpy as jnp
+    from ..framework.tensor import Tensor
+    t = Tensor(jnp.full(tuple(shape), value, dtype))
+    t.persistable = persistable
+    t.name = name or f"global_var_{id(t)}"
+    return t
+
+
+class scope_guard:
+    """static.scope_guard: scopes are the live Python process here; the
+    guard keeps reference code structure valid."""
+
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        return self.scope
+
+    def __exit__(self, *exc):
+        return False
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """static normalize_program: prune to the feed->fetch slice. The
+    recorded Program replays only reachable nodes at run time already;
+    returns the program with feeds/fetches pinned."""
+    program._feeds = list(feed_vars)
+    program._fetches = list(fetch_vars)
+    return program
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    import pickle
+    return pickle.dumps({"feeds": [getattr(v, "name", None)
+                                   for v in feed_vars],
+                         "fetches": [getattr(v, "name", None)
+                                     for v in fetch_vars]})
+
+
+def deserialize_program(data):
+    import pickle
+    meta = pickle.loads(data)
+    p = Program()
+    p._meta = meta
+    return p
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None):
+    import pickle
+    import numpy as np
+    state = {}
+    for v in list(feed_vars) + list(fetch_vars):
+        if hasattr(v, "_data") and getattr(v, "persistable", False):
+            state[getattr(v, "name", str(id(v)))] = np.asarray(v._data)
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    return pickle.loads(data)
+
+
+def save_to_file(path, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_path, protocol=4, **configs):
+    """static.save: every trainable Tensor the Program keeps alive."""
+    from ..framework import io_state
+    state = {}
+    for t in getattr(program, "_live", {}).values():
+        if getattr(t, "stop_gradient", True) is False:
+            state[getattr(t, "name", str(id(t)))] = t
+    io_state.save(state, model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework import io_state
+    state = io_state.load(model_path + ".pdparams")
+    by_name = {getattr(t, "name", None): t
+               for t in getattr(program, "_live", {}).values()}
+    import jax.numpy as jnp
+    for k, v in state.items():
+        if k in by_name and by_name[k] is not None:
+            arr = v._data if hasattr(v, "_data") else jnp.asarray(v)
+            by_name[k]._replace_data(arr)
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework import io_state
+    import numpy as np
+    state = io_state.load(model_path + ".pdparams")
+    return {k: np.asarray(v._data if hasattr(v, "_data") else v)
+            for k, v in state.items()}
+
+
+def set_program_state(program, state):
+    import jax.numpy as jnp
+    by_name = {getattr(t, "name", None): t
+               for t in getattr(program, "_live", {}).values()}
+    for k, v in state.items():
+        if k in by_name and by_name[k] is not None:
+            by_name[k]._replace_data(jnp.asarray(v))
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """static.Print: debug-print a tensor as it flows (jax.debug.print
+    under jit; direct print eagerly)."""
+    import numpy as np
+    from ..ops.dispatch import ensure_tensor
+    t = ensure_tensor(input)
+    head = message or (getattr(t, "name", "var")
+                       if print_tensor_name else "")
+    arr = np.asarray(t.numpy()).ravel()[:summarize]
+    print(f"{head} shape={list(t.shape)} dtype={t.dtype}: {arr}")
+    return input
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """static.accuracy op: top-k accuracy over softmax scores."""
+    import jax.numpy as jnp
+    from ..ops.dispatch import apply_op, ensure_tensor
+
+    def fn(x, y):
+        topk = jnp.argsort(-x, axis=-1)[:, :k]
+        hit = (topk == y.reshape(-1, 1)).any(axis=1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply_op("accuracy", fn,
+                    (ensure_tensor(input), ensure_tensor(label)), {},
+                    differentiable=False)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """static.auc op: ROC-AUC of positive-class scores (threshold-bucket
+    approximation like the reference kernel)."""
+    import jax.numpy as jnp
+    from ..ops.dispatch import apply_op, ensure_tensor
+
+    def fn(x, y):
+        pos_score = x[:, 1] if x.ndim == 2 and x.shape[1] > 1 else \
+            x.reshape(-1)
+        yb = y.reshape(-1).astype(jnp.float32)
+        edges = jnp.linspace(0.0, 1.0, num_thresholds + 1)
+        idx = jnp.clip(jnp.searchsorted(edges, pos_score) - 1, 0,
+                       num_thresholds - 1)
+        pos_hist = jax.ops.segment_sum(yb, idx, num_thresholds)
+        neg_hist = jax.ops.segment_sum(1.0 - yb, idx, num_thresholds)
+        # integrate from the high-score end
+        tp = jnp.cumsum(pos_hist[::-1])
+        fp = jnp.cumsum(neg_hist[::-1])
+        tot_pos = jnp.maximum(tp[-1], 1e-9)
+        tot_neg = jnp.maximum(fp[-1], 1e-9)
+        tpr = jnp.concatenate([jnp.zeros(1), tp / tot_pos])
+        fpr = jnp.concatenate([jnp.zeros(1), fp / tot_neg])
+        return jnp.trapezoid(tpr, fpr)
+
+    import jax
+    res = apply_op("auc", fn,
+                   (ensure_tensor(input), ensure_tensor(label)), {},
+                   differentiable=False)
+    return res, [res], [res]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """static ctr_metric_bundle: (auc, batch_auc, ...) for CTR models."""
+    a, _, _ = auc(input, label)
+    return a, a
+
+
+class ExponentialMovingAverage:
+    """static.ExponentialMovingAverage: shadow EMA weights with
+    apply()/restore() swap, thres_steps-style bias correction."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._params = None
+        self._shadow = {}
+        self._saved = None
+        self._step = 0
+
+    def _ensure(self):
+        if self._params is None:
+            raise RuntimeError(
+                "call update() after registering parameters via "
+                "update(parameters=...) once")
+
+    def update(self, parameters=None):
+        import jax.numpy as jnp
+        if parameters is not None:
+            self._params = [p for p in parameters if p is not None]
+        self._ensure()
+        self._step += 1
+        d = self._decay
+        if self._thres_steps is not None:
+            # reference: decay ramps by global step only when thres_steps
+            # is supplied (ExponentialMovingAverage thres_steps docs)
+            d = min(d, (1.0 + self._step) / (10.0 + self._step))
+        for p in self._params:
+            key = id(p)
+            prev = self._shadow.get(key)
+            cur = p._data.astype(jnp.float32)
+            self._shadow[key] = (cur if prev is None
+                                 else d * prev + (1 - d) * cur)
+
+    def apply(self, executor=None, need_restore=True):
+        self._ensure()
+        self._saved = ({id(p): p._data for p in self._params}
+                       if need_restore else None)
+        for p in self._params:
+            sh = self._shadow.get(id(p))
+            if sh is not None:
+                p._replace_data(sh.astype(p._data.dtype))
+
+    def restore(self, executor=None):
+        if self._saved is None:
+            return
+        for p in self._params:
+            p._replace_data(self._saved[id(p)])
+        self._saved = None
+
+
+class BuildStrategy:
+    """Reference BuildStrategy knobs: XLA owns fusion/scheduling here;
+    the attributes are accepted and recorded (inert by design)."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_auto_fusion = False
+        self.memory_optimize = None
+        self.reduce_strategy = None
+        self.build_cinn_pass = False
+
+
+class CompiledProgram:
+    """Reference CompiledProgram(program, build_strategy): compilation
+    happens at Executor.run (jit cache); wrapper keeps the API."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, name):
+        return getattr(self._program, name)
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "IPU is a different accelerator vertical; this framework "
+            "targets TPU via XLA/PJRT (set_device('tpu')). There is no "
+            "IPU lowering to configure.")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "IPU compilation has no TPU analog; use Executor.run (XLA "
+            "jit cache) directly.")
+
+
+class ipu_shard_guard:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "ipu_shard_guard has no TPU analog; shard with "
+            "paddle.distributed shardings instead.")
+
+
+class WeightNormParamAttr:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "WeightNormParamAttr: use paddle.nn.utils weight_norm-style "
+            "parametrization on layers (deprecated in the reference).")
+
+
+__all__ += ["Variable", "cpu_places", "cuda_places", "create_parameter",
+            "create_global_var", "scope_guard", "normalize_program",
+            "serialize_program", "deserialize_program",
+            "serialize_persistables", "deserialize_persistables",
+            "save_to_file", "load_from_file", "save", "load",
+            "load_program_state", "set_program_state", "Print",
+            "accuracy", "auc", "ctr_metric_bundle",
+            "ExponentialMovingAverage", "BuildStrategy",
+            "CompiledProgram", "IpuStrategy", "IpuCompiledProgram",
+            "ipu_shard_guard", "WeightNormParamAttr"]
+
+
+def xpu_places(device_ids=None):
+    """static xpu_places: XPU is another vendor's accelerator; the
+    accelerator devices here are TPUs (same role in scripts)."""
+    return cuda_places(device_ids)
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError(
+        "set_ipu_shard has no TPU analog; use distributed shardings.")
+
+
+__all__ += ["xpu_places", "set_ipu_shard"]

@@ -13,7 +13,8 @@ from ..ops.dispatch import ensure_tensor
 __all__ = ["nms", "box_area", "box_iou", "roi_align", "RoIAlign",
            "deform_conv2d", "DeformConv2D", "psroi_pool", "PSRoIPool",
            "box_coder", "distribute_fpn_proposals", "generate_proposals",
-           "read_file", "decode_jpeg"]
+           "read_file", "decode_jpeg", "roi_pool", "RoIPool", "prior_box",
+           "yolo_box", "yolo_loss", "matrix_nms"]
 
 
 def box_area(boxes):
@@ -485,3 +486,364 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor(jnp.asarray(arr))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Classic quantized ROI max pooling (reference ops.py roi_pool,
+    roi_pool_kernel): integer bin boundaries, max inside each bin."""
+    import numpy as np
+    xd = ensure_tensor(x)._data
+    bx = ensure_tensor(boxes)._data
+    ph, pw = _pair(output_size)
+    C, H, W = xd.shape[1], xd.shape[2], xd.shape[3]
+    n_num = [int(v) for v in ensure_tensor(boxes_num).numpy()]
+    batch_idx = np.repeat(np.arange(len(n_num)), n_num)
+    outs = []
+    for r in range(bx.shape[0]):
+        img = xd[int(batch_idx[r])]
+        x1 = jnp.round(bx[r, 0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(bx[r, 1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(bx[r, 2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(bx[r, 3] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rows = []
+        ii = jnp.arange(H)[:, None]
+        jj = jnp.arange(W)[None, :]
+        for i in range(ph):
+            cols = []
+            for j in range(pw):
+                hs = y1 + (i * rh) // ph
+                he = y1 + ((i + 1) * rh + ph - 1) // ph
+                ws = x1 + (j * rw) // pw
+                we = x1 + ((j + 1) * rw + pw - 1) // pw
+                m = ((ii >= hs) & (ii < he) & (jj >= ws) & (jj < we))
+                neg = jnp.finfo(jnp.float32).min
+                vals = jnp.where(m[None], img.astype(jnp.float32),
+                                 neg).max((-2, -1))
+                empty = (he <= hs) | (we <= ws)
+                cols.append(jnp.where(empty, 0.0, vals))
+            rows.append(jnp.stack(cols, -1))
+        outs.append(jnp.stack(rows, -2).astype(xd.dtype))
+    return Tensor(jnp.stack(outs)) if outs else Tensor(
+        jnp.zeros((0, C, ph, pw), xd.dtype))
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=[1.0], variance=[0.1, 0.1, 0.2, 0.2],
+              flip=False, clip=False, steps=[0.0, 0.0], offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior (anchor) boxes (reference ops.py:438, prior_box
+    kernel). Returns (boxes [H, W, A, 4] in normalized xmin/ymin/xmax/
+    ymax, variances of the same shape)."""
+    import numpy as np
+    feat = ensure_tensor(input)._data
+    img = ensure_tensor(image)._data
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    ih, iw = float(img.shape[2]), float(img.shape[3])
+    min_sizes = ([float(m) for m in min_sizes]
+                 if isinstance(min_sizes, (list, tuple)) else
+                 [float(min_sizes)])
+    max_sizes = ([float(m) for m in max_sizes]
+                 if isinstance(max_sizes, (list, tuple)) else
+                 ([float(max_sizes)] if max_sizes is not None else []))
+    ars = [1.0]
+    for ar in (aspect_ratios if isinstance(aspect_ratios, (list, tuple))
+               else [aspect_ratios]):
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    sh = steps[0] or ih / fh
+    sw = steps[1] or iw / fw
+    whs = []
+    for ms in min_sizes:
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    A = len(whs)
+    cy = (np.arange(fh) + offset) * sh
+    cx = (np.arange(fw) + offset) * sw
+    boxes = np.zeros((fh, fw, A, 4), np.float32)
+    for a, (w, h) in enumerate(whs):
+        boxes[:, :, a, 0] = (cx[None, :] - w / 2) / iw
+        boxes[:, :, a, 1] = (cy[:, None] - h / 2) / ih
+        boxes[:, :, a, 2] = (cx[None, :] + w / 2) / iw
+        boxes[:, :, a, 3] = (cy[:, None] + h / 2) / ih
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          boxes.shape).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode a YOLOv3 head (reference ops.py:277, yolo_box kernel):
+    x [N, A*(5+C), H, W] -> (boxes [N, H*W*A, 4], scores [N, H*W*A, C]).
+    Low-confidence predictions zero out like the kernel."""
+    import numpy as np
+    xd = ensure_tensor(x)._data.astype(jnp.float32)
+    ims = ensure_tensor(img_size)._data.astype(jnp.float32)
+    anchors = list(anchors)
+    A = len(anchors) // 2
+    N, _, H, W = xd.shape
+    if iou_aware:
+        ious = jax.nn.sigmoid(xd[:, :A].reshape(N, A, 1, H, W))
+        xd = xd[:, A:]
+    pred = xd.reshape(N, A, 5 + class_num, H, W)
+    gx = (jnp.arange(W)[None, :]).astype(jnp.float32)
+    gy = (jnp.arange(H)[:, None]).astype(jnp.float32)
+    sx = jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y \
+        - (scale_x_y - 1) / 2.0
+    sy = jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y \
+        - (scale_x_y - 1) / 2.0
+    bx = (sx + gx) / W
+    by = (sy + gy) / H
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    in_w = downsample_ratio * W
+    in_h = downsample_ratio * H
+    bw = jnp.exp(pred[:, :, 2]) * aw / in_w
+    bh = jnp.exp(pred[:, :, 3]) * ah / in_h
+    conf = jax.nn.sigmoid(pred[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1 - iou_aware_factor) * \
+            ious[:, :, 0] ** iou_aware_factor
+    probs = jax.nn.sigmoid(pred[:, :, 5:]) * conf[:, :, None]
+    keep = conf >= conf_thresh
+    imh = ims[:, 0][:, None, None, None]
+    imw = ims[:, 1][:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0)
+        y1 = jnp.clip(y1, 0)
+        x2 = jnp.minimum(x2, imw - 1)
+        y2 = jnp.minimum(y2, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1) * keep[..., None]
+    scores = probs * keep[:, :, None]
+    # [N, A, H, W, ...] -> [N, H*W*A, ...] (kernel's anchor-major order
+    # inside each cell: A varies fastest)
+    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(N, -1, 4)
+    scores = scores.transpose(0, 3, 4, 1, 2).reshape(N, -1, class_num)
+    return Tensor(boxes), Tensor(scores)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (reference ops.py:2358, SOLOv2): instead of hard
+    suppression, each box's score decays by its IoU with higher-scored
+    same-class boxes. Host-eager (data-dependent output)."""
+    import numpy as np
+    bb = np.asarray(ensure_tensor(bboxes).numpy(), np.float32)
+    sc = np.asarray(ensure_tensor(scores).numpy(), np.float32)
+    N, M = bb.shape[0], bb.shape[1]
+    C = sc.shape[1]
+    outs, idxs, nums = [], [], []
+    for n in range(N):
+        dets = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            sel = np.nonzero(s >= score_threshold)[0]
+            if not len(sel):
+                continue
+            order = sel[np.argsort(-s[sel])][:nms_top_k]
+            b = bb[n, order]
+            ss = s[order]
+            iou = np.asarray(_iou_matrix(jnp.asarray(b), jnp.asarray(b)))
+            k = len(order)
+            # compensate[j] = max IoU of j with any HIGHER-scored box
+            # (strictly above the diagonal — self-IoU must not count)
+            comp_all = np.triu(iou, 1).max(axis=0, initial=0)
+            decay = np.ones(k, np.float32)
+            for i in range(1, k):
+                ious_i = iou[:i, i]
+                comp = comp_all[:i]
+                if use_gaussian:
+                    d = np.exp(-(ious_i ** 2 - comp ** 2) / gaussian_sigma)
+                else:
+                    d = (1 - ious_i) / np.maximum(1 - comp, 1e-9)
+                decay[i] = d.min() if len(d) else 1.0
+            new_s = ss * decay
+            keep = new_s >= post_threshold
+            for i in np.nonzero(keep)[0]:
+                dets.append((c, float(new_s[i]), b[i], n * M + order[i]))
+        dets.sort(key=lambda t: -t[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        out = np.asarray([[d[0], d[1], *d[2]] for d in dets],
+                         np.float32).reshape(-1, 6)
+        outs.append(out)
+        idxs.append(np.asarray([d[3] for d in dets], np.int64))
+        nums.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(outs)
+                             if outs else np.zeros((0, 6), np.float32)))
+    index = Tensor(jnp.asarray(np.concatenate(idxs)
+                               if idxs else np.zeros((0,), np.int64)))
+    rois_num = Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    res = [out]
+    res.append(index if return_index else None)
+    res.append(rois_num if return_rois_num else None)
+    return tuple(res)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference ops.py:69, yolo_loss_kernel.cc):
+    per gt, the best-IoU anchor's cell owns location (sigmoid-CE for
+    x/y, L1 for w/h, scaled by 2-w*h), objectness and class losses;
+    predictions overlapping any gt above ignore_thresh are excluded
+    from the negative-objectness term. Differentiable jnp expression
+    (the gather/scatter of responsible cells replaces the kernel's
+    host loops); returns per-sample loss [N]."""
+    from ..ops.dispatch import apply_op
+    import numpy as np
+    tensors = [ensure_tensor(x), ensure_tensor(gt_box),
+               ensure_tensor(gt_label)]
+    has_score = gt_score is not None
+    if has_score:
+        tensors.append(ensure_tensor(gt_score))
+    anchors = [float(a) for a in anchors]
+    mask = [int(m) for m in anchor_mask]
+
+    def sce(logit, label):
+        # SigmoidCrossEntropy (yolo_loss_kernel.cc:33)
+        return (jnp.maximum(logit, 0.0) - logit * label
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    def fn(xd, gtb, gtl, *rest):
+        score = (rest[0].astype(jnp.float32) if has_score
+                 else jnp.ones(gtb.shape[:2], jnp.float32))
+        N, _, H, W = xd.shape
+        A = len(mask)
+        an_all = len(anchors) // 2
+        input_size = downsample_ratio * H
+        pred = xd.reshape(N, A, 5 + class_num, H, W).astype(jnp.float32)
+        gtb = gtb.astype(jnp.float32)
+        B = gtb.shape[1]
+        valid = (gtb[..., 2] > 0) & (gtb[..., 3] > 0)        # [N, B]
+        sc = float(scale_x_y)
+        bias = -0.5 * (sc - 1.0)
+
+        # ---- decoded pred boxes for the ignore mask ----
+        gx = jnp.arange(W)[None, :].astype(jnp.float32)
+        gy = jnp.arange(H)[:, None].astype(jnp.float32)
+        px = (gx + jax.nn.sigmoid(pred[:, :, 0]) * sc + bias) / W
+        py = (gy + jax.nn.sigmoid(pred[:, :, 1]) * sc + bias) / H
+        aw = jnp.asarray(
+            [anchors[2 * m] for m in mask], jnp.float32)[None, :, None,
+                                                         None]
+        ah = jnp.asarray(
+            [anchors[2 * m + 1] for m in mask],
+            jnp.float32)[None, :, None, None]
+        pw = jnp.exp(pred[:, :, 2]) * aw / input_size
+        ph_ = jnp.exp(pred[:, :, 3]) * ah / input_size
+
+        def iou_cwh(x1, y1, w1, h1, x2, y2, w2, h2):
+            l = jnp.maximum(x1 - w1 / 2, x2 - w2 / 2)
+            r = jnp.minimum(x1 + w1 / 2, x2 + w2 / 2)
+            t = jnp.maximum(y1 - h1 / 2, y2 - h2 / 2)
+            b = jnp.minimum(y1 + h1 / 2, y2 + h2 / 2)
+            inter = jnp.clip(r - l, 0) * jnp.clip(b - t, 0)
+            return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-9)
+
+        # [N, A, H, W, B] IoU of each pred with each gt
+        ious = iou_cwh(px[..., None], py[..., None], pw[..., None],
+                       ph_[..., None],
+                       gtb[:, None, None, None, :, 0],
+                       gtb[:, None, None, None, :, 1],
+                       gtb[:, None, None, None, :, 2],
+                       gtb[:, None, None, None, :, 3])
+        ious = jnp.where(valid[:, None, None, None, :], ious, 0.0)
+        best_iou = ious.max(-1)                             # [N, A, H, W]
+        ignore = best_iou > ignore_thresh
+
+        # ---- per-gt responsible anchor/cell ----
+        an_w = jnp.asarray(anchors[0::2], jnp.float32) / input_size
+        an_h = jnp.asarray(anchors[1::2], jnp.float32) / input_size
+        gt_w = gtb[..., 2][..., None]                       # [N, B, 1]
+        gt_h = gtb[..., 3][..., None]
+        inter = (jnp.minimum(gt_w, an_w[None, None])
+                 * jnp.minimum(gt_h, an_h[None, None]))
+        an_iou = inter / jnp.maximum(
+            gt_w * gt_h + an_w[None, None] * an_h[None, None] - inter,
+            1e-9)
+        best_n = jnp.argmax(an_iou, axis=-1)                # [N, B]
+        mask_arr = jnp.asarray(mask)
+        in_mask = (best_n[..., None] == mask_arr[None, None]).any(-1)
+        mask_idx = jnp.argmax(
+            best_n[..., None] == mask_arr[None, None], axis=-1)
+        gi = jnp.clip((gtb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gtb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+        resp = valid & in_mask                              # [N, B]
+        w_s = (2.0 - gtb[..., 2] * gtb[..., 3]) * score     # box scale
+
+        # gather the responsible cell's predictions per gt: [N, B, 5+C]
+        bidx = jnp.arange(N)[:, None]
+        cell = pred[bidx, mask_idx, :, gj, gi]
+        tx = gtb[..., 0] * W - gi
+        ty = gtb[..., 1] * H - gj
+        tw = jnp.log(jnp.maximum(
+            gtb[..., 2] * input_size
+            / jnp.take(jnp.asarray(anchors[0::2]), best_n), 1e-9))
+        th = jnp.log(jnp.maximum(
+            gtb[..., 3] * input_size
+            / jnp.take(jnp.asarray(anchors[1::2]), best_n), 1e-9))
+        loc = (sce(cell[..., 0], tx) + sce(cell[..., 1], ty)
+               + jnp.abs(cell[..., 2] - tw)
+               + jnp.abs(cell[..., 3] - th)) * w_s
+        smooth = min(1.0 / class_num, 1.0 / 40) if use_label_smooth \
+            else 0.0
+        pos, neg = 1.0 - smooth, smooth
+        onehot = jax.nn.one_hot(gtl.astype(jnp.int32), class_num)
+        cls_tgt = onehot * pos + (1 - onehot) * neg
+        cls = jnp.sum(sce(cell[..., 5:], cls_tgt), -1) * score
+        per_gt = jnp.where(resp, loc + cls, 0.0)
+
+        # objectness: positive at responsible cells (score), negative
+        # elsewhere unless ignored
+        obj_pos = jnp.zeros((N, A, H, W), jnp.float32)
+        obj_pos = obj_pos.at[bidx, mask_idx, gj, gi].add(
+            jnp.where(resp, score, 0.0))
+        is_pos = jnp.zeros((N, A, H, W), bool)
+        is_pos = is_pos.at[bidx, mask_idx, gj, gi].max(resp)
+        obj_logit = pred[:, :, 4]
+        pos_loss = sce(obj_logit, 1.0) * obj_pos
+        neg_loss = jnp.where(~is_pos & ~ignore,
+                             sce(obj_logit, 0.0), 0.0)
+        return (per_gt.sum(-1)
+                + (pos_loss + neg_loss).sum((-3, -2, -1)))
+
+    return apply_op("yolo_loss", fn, tuple(tensors), {})
