@@ -8,4 +8,8 @@ Backends (file IO) are out of scope offline; features are complete.
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
 
-__all__ = ["functional", "features"]
+__all__ = ["functional", "features", "backends", "datasets", "load",
+           "save", "info"]
+from . import backends  # noqa: E402
+from . import datasets  # noqa: E402
+from .backends import info, load, save  # noqa: E402

@@ -1,0 +1,100 @@
+"""paddle.audio.backends (reference python/paddle/audio/backends/):
+wave-file IO. The reference dispatches to soundfile when installed and
+falls back to its own WAV reader; here the stdlib ``wave`` module IS the
+backend (PCM WAV read/write — zero extra deps), exposed through the
+same load/save/info entry points.
+"""
+
+from __future__ import annotations
+
+import wave as _wave
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["list_available_backends", "get_current_backend",
+           "set_backend", "load", "save", "info", "AudioInfo"]
+
+_BACKEND = "wave"
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_backend() -> str:
+    return _BACKEND
+
+
+def set_backend(backend_name: str) -> None:
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name!r}: only the stdlib 'wave' backend "
+            "is built in (soundfile is not part of this image)")
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def info(filepath: str) -> AudioInfo:
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8)
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Returns (waveform Tensor [C, N] (channels_first) float32 in
+    [-1, 1] when normalize, sample_rate)."""
+    from ..framework.tensor import Tensor
+    import jax.numpy as jnp
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n = f.getnframes()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(min(frame_offset, n))
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(count)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, ch)
+    if width == 1:
+        data = data.astype(np.int16) - 128   # unsigned 8-bit convention
+        scale = 128.0
+    else:
+        scale = float(2 ** (8 * width - 1))
+    out = data.astype(np.float32)
+    if normalize:
+        out = out / scale
+    if channels_first:
+        out = out.T
+    return Tensor(jnp.asarray(out)), sr
+
+
+def save(filepath: str, src, sample_rate: int,
+         channels_first: bool = True, encoding: str = "PCM_S",
+         bits_per_sample: int = 16) -> None:
+    from ..ops.dispatch import ensure_tensor
+    arr = np.asarray(ensure_tensor(src).numpy())
+    if channels_first:
+        arr = arr.T                        # -> [N, C]
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    if bits_per_sample != 16:
+        raise NotImplementedError(
+            "the wave backend writes 16-bit PCM; resample/convert first")
+    pcm = np.clip(arr, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())

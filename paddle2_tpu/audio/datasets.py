@@ -1,0 +1,78 @@
+"""paddle.audio.datasets (reference python/paddle/audio/datasets/):
+TESS and ESC-50. The reference downloads archives; this image has no
+egress, so the classes consume an existing local extraction via
+``data_dir`` and raise a pointered error otherwise (the documented
+offline workflow)."""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from ..io.dataloader import Dataset
+from .backends import load as _load
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _LocalAudioDataset(Dataset):
+    name = "dataset"
+
+    def __init__(self, data_dir=None, sample_rate=None, **kwargs):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise RuntimeError(
+                f"{self.name}: no network egress to download the archive; "
+                f"pass data_dir=<local extraction> (reference layout)")
+        self.data_dir = data_dir
+        self.sample_rate = sample_rate
+        self.files: List[str] = []
+        self.labels: List[int] = []
+        self._scan()
+
+    def _scan(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        wav, sr = _load(self.files[idx])
+        return wav, self.labels[idx]
+
+
+class TESS(_LocalAudioDataset):
+    """Toronto Emotional Speech Set: <data_dir>/<speaker>_<word>_
+    <emotion>.wav layout; label = emotion index."""
+
+    name = "TESS"
+    emotions = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                "sad"]
+
+    def _scan(self):
+        for root, _dirs, files in os.walk(self.data_dir):
+            for fn in sorted(files):
+                if not fn.lower().endswith(".wav"):
+                    continue
+                emo = fn.rsplit("_", 1)[-1][:-4].lower()
+                if emo in self.emotions:
+                    self.files.append(os.path.join(root, fn))
+                    self.labels.append(self.emotions.index(emo))
+
+
+class ESC50(_LocalAudioDataset):
+    """ESC-50 environmental sounds: <data_dir>/audio/<fold>-...-<target>
+    .wav; label = target class parsed from the filename."""
+
+    name = "ESC50"
+
+    def _scan(self):
+        audio_dir = os.path.join(self.data_dir, "audio")
+        base = audio_dir if os.path.isdir(audio_dir) else self.data_dir
+        for fn in sorted(os.listdir(base)):
+            if fn.endswith(".wav"):
+                try:
+                    target = int(fn[:-4].split("-")[-1])
+                except ValueError:
+                    continue
+                self.files.append(os.path.join(base, fn))
+                self.labels.append(target)

@@ -251,3 +251,68 @@ class cuda:
     def empty_cache():
         import gc
         gc.collect()
+
+
+class XPUPlace:
+    """Vendor-accelerator place: on this stack the accelerator is TPU;
+    constructing an XPUPlace raises with the migration pointer."""
+
+    def __init__(self, dev_id=0):
+        raise NotImplementedError(
+            "XPU is another vendor's accelerator; this framework targets "
+            "TPU (set_device('tpu')).")
+
+
+class IPUPlace:
+    def __init__(self, dev_id=0):
+        raise NotImplementedError(
+            "IPU has no lowering here; this framework targets TPU "
+            "(set_device('tpu')).")
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_cudnn_version():
+    """No cuDNN on the TPU stack (reference returns None when absent)."""
+    return None
+
+
+def is_compiled_with_cinn() -> bool:
+    return False   # XLA is the compiler; CINN has no analog
+
+
+def is_compiled_with_distribute() -> bool:
+    return True    # jax.distributed / collectives are always built in
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def set_stream(stream=None):
+    """device.set_stream: XLA owns stream assignment; accepted for
+    source compatibility, returns the current (only) stream object."""
+    return stream
+
+
+class stream_guard:
+    """device.stream_guard context: stream scheduling is the XLA
+    compiler's decision on TPU; the guard is a no-op scope."""
+
+    def __init__(self, stream=None):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False
+
+
+__all__ += ["XPUPlace", "IPUPlace", "get_all_device_type",
+            "get_cudnn_version", "is_compiled_with_cinn",
+            "is_compiled_with_distribute", "is_compiled_with_ipu",
+            "set_stream", "stream_guard"]

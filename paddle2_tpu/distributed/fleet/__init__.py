@@ -139,3 +139,178 @@ class meta_parallel:
     SharedLayerDesc = SharedLayerDesc
     PipelineLayer = PipelineLayer
     PipelineParallel = PipelineParallel
+
+
+class Role:
+    """fleet/base/role_maker.py:40 constants."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """role_maker.py:548: role from PADDLE_* env (every process is a
+    collective WORKER on the TPU stack; PS roles live in the decision
+    record)."""
+
+    def __init__(self, is_collective: bool = True, **kwargs):
+        import os
+        self._is_collective = is_collective
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self._size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def _role(self):
+        return Role.WORKER
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self._rank == 0
+
+    def worker_index(self):
+        return self._rank
+
+    def worker_num(self):
+        return self._size
+
+    def role_id(self):
+        return self._rank
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """role_maker.py UserDefinedRoleMaker: explicit rank/size."""
+
+    def __init__(self, is_collective=True, init_gloo=False, current_id=0,
+                 worker_num=1, role=None, **kwargs):
+        super().__init__(is_collective)
+        self._rank = int(current_id)
+        self._size = int(worker_num)
+
+
+class UtilBase:
+    """fleet/utils/UtilBase: small cross-rank host utilities over the
+    collective API."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+        from .. import collective as C
+        from ...framework.tensor import Tensor
+        import jax.numpy as jnp
+        t = Tensor(jnp.asarray(np.asarray(input)))
+        C.all_reduce(t, op=mode)
+        return np.asarray(t.numpy())
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective as C
+        C.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from .. import collective as C
+        out = []
+        C.all_gather_object(out, input)
+        return out
+
+    def get_file_shard(self, files):
+        import os
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        return [f for i, f in enumerate(files) if i % size == rank]
+
+    def print_on_rank(self, message, rank_id=0):
+        import os
+        if int(os.environ.get("PADDLE_TRAINER_ID", 0)) == int(rank_id):
+            print(message)
+
+
+class MultiSlotDataGenerator:
+    """fleet data_generator for PS pipelines: subclasses implement
+    generate_sample(line) yielding [(slot_name, [values]), ...]; run()
+    streams stdin lines to the slot format (the reference's protocol for
+    pipe_command — kept for migration, the TPU input path is
+    io.DataLoader)."""
+
+    def __init__(self):
+        self._line_fn = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass MultiSlotDataGenerator and implement "
+            "generate_sample")
+
+    def _format(self, record):
+        parts = []
+        for _slot, values in record:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            gen = self.generate_sample(line)
+            for record in (gen() if callable(gen) else gen):
+                out.append(self._format(record))
+        return out
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            for rec in self.run_from_memory([line.rstrip("\n")]):
+                sys.stdout.write(rec + "\n")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    pass
+
+
+class Fleet:
+    """fleet.py:151 Fleet class — the object form of this module's
+    functions (fleet.init/distributed_model/...)."""
+
+    def __init__(self):
+        self._role_maker = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective)
+        return init(role_maker, is_collective, strategy)
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        from .. import collective as C
+        C.barrier()
+
+    @property
+    def util(self):
+        return UtilBase()
+
+
+__all__ += ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+            "UtilBase", "Fleet", "MultiSlotDataGenerator",
+            "MultiSlotStringDataGenerator"]

@@ -494,3 +494,465 @@ def _no_kl(p, q):
 
 register_kl(LogNormal, Normal)(_no_kl)
 register_kl(Normal, LogNormal)(_no_kl)
+
+
+# ------------------------------------------------------------------ r5
+
+class Exponential(ExponentialFamily):
+    """distribution/exponential.py: rate-parameterized."""
+
+    def __init__(self, rate, name=None):
+        self.rate = ensure_tensor(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return _op("exp_mean", lambda r: 1.0 / r, self.rate)
+
+    @property
+    def variance(self):
+        return _op("exp_var", lambda r: 1.0 / jnp.square(r), self.rate)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(fr.next_key(), shape, jnp.float32,
+                               1e-7, 1.0)
+        return _t(-jnp.log(u) / self.rate._data)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return _op("exp_log_prob",
+                   lambda v, r: jnp.log(r) - r * v, value, self.rate)
+
+    def entropy(self):
+        return _op("exp_entropy", lambda r: 1.0 - jnp.log(r), self.rate)
+
+    def cdf(self, value):
+        return _op("exp_cdf", lambda v, r: 1.0 - jnp.exp(-r * v),
+                   value, self.rate)
+
+
+class Gamma(ExponentialFamily):
+    """distribution/gamma.py: concentration/rate."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = ensure_tensor(concentration)
+        self.rate = ensure_tensor(rate)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.concentration.shape), tuple(self.rate.shape))))
+
+    @property
+    def mean(self):
+        return _op("gamma_mean", lambda a, r: a / r,
+                   self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        return _op("gamma_var", lambda a, r: a / jnp.square(r),
+                   self.concentration, self.rate)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        g = jax.random.gamma(fr.next_key(),
+                             jnp.broadcast_to(
+                                 self.concentration._data, shape),
+                             shape, jnp.float32)
+        return _t(g / self.rate._data)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, a, r):
+            return (a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+                    - jax.scipy.special.gammaln(a))
+        return _op("gamma_log_prob", f, value, self.concentration,
+                   self.rate)
+
+    def entropy(self):
+        def f(a, r):
+            return (a - jnp.log(r) + jax.scipy.special.gammaln(a)
+                    + (1.0 - a) * jax.scipy.special.digamma(a))
+        return _op("gamma_entropy", f, self.concentration, self.rate)
+
+
+class Chi2(Gamma):
+    """distribution/chi2.py: Gamma(df/2, 1/2)."""
+
+    def __init__(self, df, name=None):
+        df_t = ensure_tensor(df)
+        self.df = df_t
+        # float math regardless of an integer df input
+        super().__init__(
+            _op("chi2_a", lambda d: d.astype(jnp.float32) / 2.0, df_t),
+            _op("chi2_r",
+                lambda d: jnp.full(jnp.shape(d), 0.5, jnp.float32),
+                df_t))
+
+
+class Cauchy(Distribution):
+    """distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(fr.next_key(), shape, jnp.float32,
+                               1e-6, 1 - 1e-6)
+        return _t(self.loc._data
+                  + self.scale._data * jnp.tan(jnp.pi * (u - 0.5)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            return (-jnp.log(jnp.pi) - jnp.log(s)
+                    - jnp.log1p(jnp.square((v - l) / s)))
+        return _op("cauchy_log_prob", f, value, self.loc, self.scale)
+
+    def entropy(self):
+        return _op("cauchy_entropy",
+                   lambda s: jnp.log(4 * jnp.pi * s), self.scale)
+
+    def cdf(self, value):
+        def f(v, l, s):
+            return jnp.arctan((v - l) / s) / jnp.pi + 0.5
+        return _op("cauchy_cdf", f, value, self.loc, self.scale)
+
+
+class StudentT(Distribution):
+    """distribution/student_t.py: df/loc/scale."""
+
+    def __init__(self, df, loc, scale, name=None):
+        self.df = ensure_tensor(df)
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.df.shape), tuple(self.loc.shape),
+            tuple(self.scale.shape))))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        def f(df, s):
+            return jnp.where(df > 2, jnp.square(s) * df / (df - 2),
+                             jnp.inf)
+        return _op("t_var", f, self.df, self.scale)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        t = jax.random.t(fr.next_key(),
+                         jnp.broadcast_to(self.df._data, shape), shape,
+                         jnp.float32)
+        return _t(self.loc._data + self.scale._data * t)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, df, l, s):
+            z = (v - l) / s
+            return (jax.scipy.special.gammaln((df + 1) / 2)
+                    - jax.scipy.special.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * jnp.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(jnp.square(z) / df))
+        return _op("t_log_prob", f, value, self.df, self.loc, self.scale)
+
+    def entropy(self):
+        def f(df, s):
+            hp = (df + 1) / 2
+            return (jnp.log(s) + 0.5 * jnp.log(df)
+                    + jax.scipy.special.betaln(df / 2, 0.5)
+                    + hp * (jax.scipy.special.digamma(hp)
+                            - jax.scipy.special.digamma(df / 2)))
+        return _op("t_entropy", f, self.df, self.scale)
+
+
+class Binomial(Distribution):
+    """distribution/binomial.py: total_count/probs."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = ensure_tensor(total_count)
+        self.probs = ensure_tensor(probs)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.total_count.shape), tuple(self.probs.shape))))
+
+    @property
+    def mean(self):
+        return _op("binom_mean", lambda n, p: n * p, self.total_count,
+                   self.probs)
+
+    @property
+    def variance(self):
+        return _op("binom_var", lambda n, p: n * p * (1 - p),
+                   self.total_count, self.probs)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        n = jnp.broadcast_to(self.total_count._data, shape)
+        p = jnp.broadcast_to(self.probs._data, shape)
+        # sum of Bernoulli draws via binomial sampler
+        out = jax.random.binomial(fr.next_key(), n.astype(jnp.float32),
+                                  p, shape)
+        return _t(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(v, n, p):
+            return (jax.scipy.special.gammaln(n + 1)
+                    - jax.scipy.special.gammaln(v + 1)
+                    - jax.scipy.special.gammaln(n - v + 1)
+                    + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+        return _op("binom_log_prob", f, value, self.total_count,
+                   self.probs)
+
+    def entropy(self):
+        def f(n, p):
+            # exact sum over the support (n is data-dependent but small
+            # in practice; uses the max n in the batch)
+            nmax = jnp.max(n).astype(jnp.int32)
+            k = jnp.arange(nmax + 1, dtype=jnp.float32)
+            logpmf = (jax.scipy.special.gammaln(n[..., None] + 1)
+                      - jax.scipy.special.gammaln(k + 1)
+                      - jax.scipy.special.gammaln(n[..., None] - k + 1)
+                      + k * jnp.log(p[..., None])
+                      + (n[..., None] - k) * jnp.log1p(-p[..., None]))
+            valid = k <= n[..., None]
+            pmf = jnp.where(valid, jnp.exp(logpmf), 0.0)
+            return -jnp.sum(pmf * jnp.where(valid, logpmf, 0.0), -1)
+        return _op("binom_entropy", f, self.total_count, self.probs)
+
+
+class ContinuousBernoulli(Distribution):
+    """distribution/continuous_bernoulli.py (Loaiza-Ganem & Cunningham)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = ensure_tensor(probs)
+        self._lims = lims
+        super().__init__(tuple(self.probs.shape))
+
+    def _log_C(self, p):
+        # normalizing constant, Taylor-stabilized near p=0.5
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        p_safe = jnp.where(near, 0.4, p)
+        c = jnp.log(2 * jnp.arctanh(1 - 2 * p_safe)
+                    / (1 - 2 * p_safe))
+        x = p - 0.5
+        taylor = jnp.log(2.0) + 4.0 / 3.0 * x ** 2 + 104.0 / 45.0 * x ** 4
+        return jnp.where(near, taylor, c)
+
+    @property
+    def mean(self):
+        def f(p):
+            near = (p > self._lims[0]) & (p < self._lims[1])
+            p_safe = jnp.where(near, 0.4, p)
+            m = p_safe / (2 * p_safe - 1) \
+                + 1.0 / (2 * jnp.arctanh(1 - 2 * p_safe))
+            return jnp.where(near, 0.5, m)
+        return _op("cb_mean", f, self.probs)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(fr.next_key(), shape, jnp.float32,
+                               1e-6, 1 - 1e-6)
+        p = jnp.broadcast_to(self.probs._data, shape)
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        p_safe = jnp.where(near, 0.4, p)
+        icdf = (jnp.log1p(u * (2 * p_safe - 1) / (1 - p_safe))
+                / (jnp.log(p_safe) - jnp.log1p(-p_safe)))
+        return _t(jnp.where(near, u, icdf))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, p):
+            return (v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                    + self._log_C(p))
+        return _op("cb_log_prob", f, value, self.probs)
+
+
+class MultivariateNormal(Distribution):
+    """distribution/multivariate_normal.py: loc + covariance_matrix."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = ensure_tensor(loc)
+        if scale_tril is not None:
+            self._tril = ensure_tensor(scale_tril)._data
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(
+                ensure_tensor(covariance_matrix)._data)
+        elif precision_matrix is not None:
+            prec = ensure_tensor(precision_matrix)._data
+            self._tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        else:
+            raise ValueError("need covariance_matrix / precision_matrix "
+                             "/ scale_tril")
+        super().__init__(tuple(self.loc.shape[:-1]),
+                         (int(self.loc.shape[-1]),))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _t(jnp.sum(jnp.square(self._tril), axis=-1))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape + self.event_shape
+        eps = jax.random.normal(fr.next_key(), shape, jnp.float32)
+        return _t(self.loc._data
+                  + jnp.einsum("...ij,...j->...i", self._tril, eps))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        tril = self._tril
+        def f(v, l):
+            d = v - l
+            z = jax.scipy.linalg.solve_triangular(tril, d[..., None],
+                                                  lower=True)[..., 0]
+            half_logdet = jnp.sum(jnp.log(jnp.diagonal(
+                tril, axis1=-2, axis2=-1)), -1)
+            k = v.shape[-1]
+            return (-0.5 * jnp.sum(jnp.square(z), -1) - half_logdet
+                    - 0.5 * k * jnp.log(2 * jnp.pi))
+        return _op("mvn_log_prob", f, value, self.loc)
+
+    def entropy(self):
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1)), -1)
+        k = self.event_shape[0]
+        return _t(0.5 * k * (1 + jnp.log(2 * jnp.pi)) + half_logdet)
+
+
+class Independent(Distribution):
+    """distribution/independent.py: reinterpret batch dims as event."""
+
+    def __init__(self, base, reinterpreted_batch_rank, name=None):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+        bs = tuple(base.batch_shape)
+        super().__init__(bs[:len(bs) - self._rank],
+                         bs[len(bs) - self._rank:])
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        def f(a):
+            return jnp.sum(a, axis=tuple(range(a.ndim - self._rank,
+                                               a.ndim)))
+        return _op("indep_log_prob", f, lp)
+
+    def entropy(self):
+        e = self.base.entropy()
+        def f(a):
+            return jnp.sum(a, axis=tuple(range(a.ndim - self._rank,
+                                               a.ndim)))
+        return _op("indep_entropy", f, e)
+
+
+class TransformedDistribution(Distribution):
+    """distribution/transformed_distribution.py: base pushed through a
+    chain of transforms (paddle.distribution.transform objects or any
+    object with forward / inverse / forward_log_det_jacobian)."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(tuple(base.batch_shape))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape) if hasattr(self.base, "rsample") \
+            else self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        lp = None
+        v = ensure_tensor(value)
+        # walk backwards through the chain
+        for t in reversed(self.transforms):
+            x = t.inverse(v)
+            ladj = t.forward_log_det_jacobian(x)
+            lp = ladj if lp is None else _op(
+                "td_acc", lambda a, b: a + b, lp, ladj)
+            v = x
+        base_lp = self.base.log_prob(v)
+        if lp is None:
+            return base_lp
+        return _op("td_log_prob", lambda a, b: a - b, base_lp, lp)
+
+
+class LKJCholesky(Distribution):
+    """distribution/lkj_cholesky.py: LKJ prior over correlation-matrix
+    Cholesky factors (onion-method sampling)."""
+
+    def __init__(self, dim, concentration=1.0,
+                 sample_method="onion", name=None):
+        self.dim = int(dim)
+        self.concentration = ensure_tensor(concentration)
+        super().__init__(tuple(self.concentration.shape))
+
+    def sample(self, shape=()):
+        d = self.dim
+        eta = float(jnp.reshape(self.concentration._data, (-1,))[0])
+        shape = tuple(shape)
+        # onion method (Lewandowski et al. 2009)
+        L = jnp.zeros(shape + (d, d), jnp.float32)
+        L = L.at[..., 0, 0].set(1.0)
+        beta = eta + (d - 2) / 2.0
+        for i in range(1, d):
+            b = jax.random.beta(fr.next_key(), i / 2.0, beta,
+                                shape, jnp.float32)
+            beta = beta - 0.5
+            u = jax.random.normal(fr.next_key(), shape + (i,),
+                                  jnp.float32)
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(b)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(1.0 - b))
+        return _t(L)
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)
+        def f(L, eta):
+            d = self.dim
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            # L_ii (1-based row i = orders + 2) carries exponent
+            # d - i + 2*eta - 2 (LKJ density, lkj_cholesky.py)
+            orders = jnp.arange(d - 1, dtype=jnp.float32)
+            exps = d - (orders + 2.0) + 2.0 * eta - 2.0
+            unnorm = jnp.sum(exps * jnp.log(diag), -1)
+            # normalization (lkj_cholesky.py log_normalizer)
+            i = jnp.arange(1, d, dtype=jnp.float32)
+            alpha = eta + (d - 1 - i) / 2.0
+            lognorm = jnp.sum(
+                0.5 * i * jnp.log(jnp.pi)
+                + jax.scipy.special.gammaln(alpha)
+                - jax.scipy.special.gammaln(alpha + i / 2.0))
+            return unnorm - lognorm
+        return _op("lkj_log_prob", f, v, self.concentration)
+
+
+__all__ += ["Exponential", "Gamma", "Chi2", "Cauchy", "StudentT",
+            "Binomial", "ContinuousBernoulli", "MultivariateNormal",
+            "Independent", "TransformedDistribution", "LKJCholesky"]

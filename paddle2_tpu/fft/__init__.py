@@ -76,3 +76,47 @@ def ifftshift(x, axes=None, name=None) -> Tensor:
     t = ensure_tensor(x)
     return apply_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes), (t,),
                     {})
+
+
+def _hfft_nd(a, s, axes, norm, inverse):
+    """hfft over the last axis after plain (i)ffts over the others —
+    the jnp.fft module has no hfft2/hfftn, but the reference defines
+    them as Hermitian-in-last-axis n-d transforms (fft.py hfft2/hfftn)."""
+    if axes is not None:
+        axes = tuple(axes)
+    elif s is not None:
+        axes = tuple(range(-len(s), 0))
+    else:
+        axes = tuple(range(-a.ndim, 0))   # hfftn default: ALL axes
+    pre, last = axes[:-1], axes[-1]
+    sizes = list(s) if s is not None else [None] * len(axes)
+    if inverse:
+        # r2c along the LAST axis first (ihfft needs the real input),
+        # then inverse ffts over the remaining axes
+        out = jnp.fft.ihfft(a, n=sizes[-1], axis=last, norm=norm)
+        for ax, n in zip(pre, sizes[:-1]):
+            out = jnp.fft.ifft(out, n=n, axis=ax, norm=norm)
+        return out
+    out = a
+    for ax, n in zip(pre, sizes[:-1]):
+        out = jnp.fft.fft(out, n=n, axis=ax, norm=norm)
+    return jnp.fft.hfft(out, n=sizes[-1], axis=last, norm=norm)
+
+
+def _mk_h(op_name, inverse, default_axes):
+    def f(x, s=None, axes=default_axes, norm="backward", name=None):
+        t = ensure_tensor(x)
+        return apply_op(op_name,
+                        lambda a: _hfft_nd(a, s, axes, norm, inverse),
+                        (t,), {})
+    f.__name__ = op_name
+    f.__doc__ = f"python/paddle/fft.py {op_name} parity."
+    return f
+
+
+hfft2 = _mk_h("hfft2", False, (-2, -1))
+ihfft2 = _mk_h("ihfft2", True, (-2, -1))
+hfftn = _mk_h("hfftn", False, None)     # None -> all axes at call time
+ihfftn = _mk_h("ihfftn", True, None)
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

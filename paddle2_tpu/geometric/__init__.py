@@ -109,3 +109,79 @@ def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
         return {"add": u + v, "sub": u - v, "mul": u * v,
                 "div": u / v}[message_op]
     return apply_op("send_uv", f, (xt, yt, s, d), {})
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, name=None):
+    """geometric.reindex_graph == incubate graph_reindex (stable name)."""
+    from ..incubate.graph_ops import graph_reindex
+    return graph_reindex(x, neighbors, count, value_buffer, index_buffer)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Multi-edge-type reindex: neighbors/count given PER TYPE; ids are
+    renumbered over the union (x first, then first appearance)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ..incubate.graph_ops import graph_reindex
+    from ..framework.tensor import Tensor
+    from ..ops.dispatch import ensure_tensor
+    nb = jnp.concatenate([ensure_tensor(n)._data for n in neighbors])
+    ct = jnp.concatenate([ensure_tensor(c)._data for c in count])
+    return graph_reindex(x, Tensor(nb), Tensor(ct))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """geometric.sample_neighbors == incubate graph_sample_neighbors."""
+    from ..incubate.graph_ops import graph_sample_neighbors
+    return graph_sample_neighbors(row, colptr, input_nodes, eids=eids,
+                                  sample_size=sample_size,
+                                  return_eids=return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None,
+                              return_eids=False, name=None):
+    """Weight-proportional neighbor sampling (geometric
+    weighted_sample_neighbors): per node, sample without replacement
+    with probability proportional to edge weight."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ..framework.tensor import Tensor
+    from ..ops.dispatch import ensure_tensor
+    r = np.asarray(ensure_tensor(row).numpy()).reshape(-1)
+    cp = np.asarray(ensure_tensor(colptr).numpy()).reshape(-1)
+    w = np.asarray(ensure_tensor(edge_weight).numpy()).reshape(-1)
+    nodes = np.asarray(ensure_tensor(input_nodes).numpy()).reshape(-1)
+    eid = (np.asarray(ensure_tensor(eids).numpy()).reshape(-1)
+           if eids is not None else None)
+    rng = np.random.default_rng()
+    out_nb, out_ct, out_eid = [], [], []
+    for n in nodes.tolist():
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(lo, hi)
+        else:
+            p = w[lo:hi].astype(np.float64)
+            p = p / p.sum() if p.sum() > 0 else None
+            sel = lo + rng.choice(deg, size=sample_size, replace=False,
+                                  p=p)
+        out_nb.append(r[sel])
+        out_ct.append(len(sel))
+        if eid is not None:
+            out_eid.append(eid[sel])
+    nb = np.concatenate(out_nb) if out_nb else np.zeros((0,), r.dtype)
+    res = (Tensor(jnp.asarray(nb)),
+           Tensor(jnp.asarray(np.asarray(out_ct, np.int32))))
+    if return_eids:
+        res = res + (Tensor(jnp.asarray(
+            np.concatenate(out_eid) if out_eid
+            else np.zeros((0,), r.dtype))),)
+    return res
+
+
+__all__ += ["reindex_graph", "reindex_heter_graph", "sample_neighbors",
+            "weighted_sample_neighbors"]

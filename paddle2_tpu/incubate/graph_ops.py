@@ -49,8 +49,15 @@ def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
             mapping[v] = len(out_nodes)
             out_nodes.append(v)
     reindex_src = np.array([mapping[v] for v in nb.tolist()], xs.dtype)
-    # dst: node i of x repeated count[i] times (edge list orientation)
-    dst = np.repeat(np.arange(len(xs), dtype=xs.dtype), ct)
+    # dst: node i of x repeated count[i] times; with multi-edge-type
+    # input (graph_reindex docs) count has k*len(x) entries — the x ids
+    # cycle per type
+    if len(ct) % len(xs) != 0:
+        raise ValueError(
+            f"count length {len(ct)} must be a multiple of len(x) "
+            f"{len(xs)}")
+    k = len(ct) // len(xs)
+    dst = np.repeat(np.tile(np.arange(len(xs), dtype=xs.dtype), k), ct)
     return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(dst)),
             Tensor(jnp.asarray(np.asarray(out_nodes, xs.dtype))))
 

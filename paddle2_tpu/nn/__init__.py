@@ -16,3 +16,4 @@ from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .utils_mod import clip_grad_norm_, clip_grad_value_  # noqa: F401
+from . import utils  # noqa: F401

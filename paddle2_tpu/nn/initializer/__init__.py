@@ -210,3 +210,32 @@ def set_global_initializer(weight_init, bias_init=None):
 
 def get_global_initializer():
     return _global_weight_init, _global_bias_init
+
+
+class Bilinear(Initializer):
+    """initializer/Bilinear: transposed-conv upsampling kernels
+    (each [kh, kw] slice is the bilinear interpolation stencil)."""
+
+    def __call__(self, param, block=None):
+        import numpy as np
+        import jax.numpy as jnp
+        shape = tuple(param.shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D weight")
+        kh, kw = shape[2], shape[3]
+        f_h = (kh + 1) // 2
+        f_w = (kw + 1) // 2
+        og = np.ogrid[:kh, :kw]
+        center_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        center_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        filt = ((1 - np.abs(og[0] / f_h - center_h))
+                * (1 - np.abs(og[1] / f_w - center_w)))
+        w = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                w[i, j] = filt
+        param._replace_data(jnp.asarray(w))
+        return param
+
+
+__all__.append("Bilinear")

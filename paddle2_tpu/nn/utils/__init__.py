@@ -1,0 +1,117 @@
+"""paddle.nn.utils (reference python/paddle/nn/utils/): weight
+parametrizations + parameter/vector helpers + grad clipping."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils_mod import clip_grad_norm_, clip_grad_value_  # noqa: F401
+from ...framework.tensor import Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_except(w, dim):
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """weight_norm_hook.py: reparameterize `name` as g * v/||v||,
+    recomputed before every forward via a pre-hook."""
+    w = getattr(layer, name)
+    dim = (w.ndim - 1) if dim is None else int(dim)
+    g0 = _norm_except(w._data, dim)
+    from ..layer.layers import Layer
+    v = layer.create_parameter(list(w.shape))
+    v._replace_data(w._data)
+    g = layer.create_parameter(list(g0.shape))
+    g._replace_data(g0)
+    layer.add_parameter(f"{name}_v", v)
+    layer.add_parameter(f"{name}_g", g)
+    # the original param stops being trainable; forward recomputes it
+    w.stop_gradient = True
+
+    def _recompute(layer_, inputs):
+        from ...ops.dispatch import apply_op
+        out = apply_op(
+            "weight_norm",
+            lambda vv, gg: gg * vv / jnp.maximum(
+                _norm_except(vv, dim), 1e-12), (v, g), {})
+        getattr(layer_, name)._replace_data(out._data)
+        # keep the tape connection: assign the COMPUTED tensor so grads
+        # flow to v and g
+        object.__setattr__(layer_, name, out)
+        return None
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_state = (name, v, g, handle, w, dim)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    state = getattr(layer, "_weight_norm_state", None)
+    if state is None:
+        return layer
+    name_, v, g, handle, orig, dim = state
+    handle.remove()
+    w = g._data * v._data / jnp.maximum(_norm_except(v._data, dim),
+                                        1e-12)
+    orig._replace_data(w)
+    orig.stop_gradient = False
+    object.__setattr__(layer, name_, orig)
+    del layer._weight_norm_state
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """spectral_norm_hook.py: divide the weight by its largest singular
+    value, estimated by power iteration before each forward."""
+    w = getattr(layer, name)
+    dim = 0 if dim is None else int(dim)
+    mat = jnp.moveaxis(w._data, dim, 0).reshape(w.shape[dim], -1)
+    import numpy.random as npr
+    u0 = jnp.asarray(npr.RandomState(0).randn(mat.shape[0]), jnp.float32)
+    v0 = jnp.asarray(npr.RandomState(1).randn(mat.shape[1]), jnp.float32)
+    state = {"u": u0 / jnp.linalg.norm(u0),
+             "v": v0 / jnp.linalg.norm(v0)}
+    orig = Tensor(w._data)
+
+    def _apply(layer_, inputs):
+        from ...ops.dispatch import apply_op
+        wd = orig._data
+        m = jnp.moveaxis(wd, dim, 0).reshape(wd.shape[dim], -1)
+        u, vvec = state["u"], state["v"]
+        for _ in range(n_power_iterations):
+            vvec = m.T @ u
+            vvec = vvec / jnp.maximum(jnp.linalg.norm(vvec), eps)
+            u = m @ vvec
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        state["u"], state["v"] = u, vvec
+        sigma = u @ (m @ vvec)
+        getattr(layer_, name)._replace_data(wd / sigma)
+        return None
+
+    handle = layer.register_forward_pre_hook(_apply)
+    layer._spectral_norm_state = (name, handle, orig)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """utils/transform_parameters.py: flatten params into one vector."""
+    arrs = [p._data.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(arrs))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    v = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if len(p.shape) else 1
+        p._replace_data(v[off:off + n].reshape(tuple(p.shape)))
+        off += n

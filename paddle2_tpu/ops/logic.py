@@ -13,7 +13,7 @@ from ..framework.tensor import Tensor
 __all__ = ["equal", "not_equal", "greater_than", "greater_equal", "less_than",
            "less_equal", "equal_all", "allclose", "isclose", "is_empty",
            "is_tensor", "argmax", "argmin", "topk", "kthvalue", "mode",
-           "searchsorted", "bucketize", "index_fill", "masked_scatter"]
+           "searchsorted", "bucketize", "index_fill", "index_fill_", "masked_scatter"]
 
 
 def _cmp(name, jfn):
@@ -178,3 +178,9 @@ def masked_scatter(x, mask, value, name=None) -> Tensor:
     out = a.copy()
     out[m] = v[:int(m.sum())]
     return Tensor(jnp.asarray(out))
+
+
+def index_fill_(x, index, axis, value, name=None) -> Tensor:
+    """Inplace index_fill (tensor.py index_fill_)."""
+    from .dispatch import rebind_inplace
+    return rebind_inplace(x, index_fill(x, index, axis, value))

@@ -22,7 +22,7 @@ __all__ = ["reshape", "reshape_", "transpose", "t", "flatten", "squeeze",
            "chunk", "tile", "expand", "expand_as", "broadcast_to",
            "broadcast_tensors", "flip", "rot90", "roll", "gather", "gather_nd",
            "scatter", "scatter_", "scatter_nd", "scatter_nd_add", "index_select",
-           "index_sample", "index_add", "index_put", "masked_select",
+           "index_sample", "index_add", "index_add_", "index_put_", "index_put", "masked_select",
            "masked_fill", "where", "nonzero", "take_along_axis", "put_along_axis",
            "unbind", "repeat_interleave", "unique", "unique_consecutive",
            "sort", "argsort", "slice", "strided_slice", "moveaxis", "swapaxes",
@@ -587,3 +587,12 @@ def pad_basic(x, pad, value=0.0):
     cfg = [(0, 0)] * (x.ndim - len(cfg)) + cfg
     return apply_op("pad", lambda a: jnp.pad(a, cfg, constant_values=value),
                     (x,), {})
+
+
+def index_add_(x, index, axis, value, name=None) -> Tensor:
+    """Inplace index_add (tensor.py index_add_)."""
+    return rebind_inplace(x, index_add(x, index, axis, value))
+
+
+def index_put_(x, indices, value, accumulate=False, name=None) -> Tensor:
+    return rebind_inplace(x, index_put(x, indices, value, accumulate))

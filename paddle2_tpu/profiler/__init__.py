@@ -336,3 +336,27 @@ class benchmark:
                 "ips": round(self._samples / dt, 2) if dt > 0 else 0.0,
                 "step_per_sec": round(self._steps / dt, 2) if dt > 0
                 else 0.0}
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """profiler/profiler.py export_protobuf: scheduler callback writing
+    the collected trace. The reference's .pb feeds VisualDL; the
+    portable binary container here is a length-prefixed pickle of the
+    same event records (chrome-trace JSON remains the interchange
+    format — export_chrome_tracing)."""
+    import os
+    import pickle
+
+    def handle(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        events = getattr(prof, "_events", [])
+        payload = pickle.dumps({"version": 1, "events": [
+            dict(e) if isinstance(e, dict) else e for e in events]})
+        with open(os.path.join(dir_name, name + ".pb"), "wb") as f:
+            f.write(len(payload).to_bytes(8, "little"))
+            f.write(payload)
+    return handle
+
+
+__all__.append("export_protobuf")

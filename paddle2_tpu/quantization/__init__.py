@@ -396,3 +396,47 @@ class PTQ(QAT):
 
 def quant_aware(model: nn.Layer, config: Optional[QuantConfig] = None):
     return QAT(config).quantize(model)
+
+
+class BaseObserver(nn.Layer):
+    """quantization/base_observer.py: the observer protocol — watch
+    tensors in forward, produce a scale. AbsmaxObserver/
+    ChannelWiseAbsMaxObserver are the built-in implementations."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def cal_thresholds(self):
+        pass
+
+
+class BaseQuanter(nn.Layer):
+    """quantization/base_quanter.py: the quanter protocol — fake-quant
+    in forward (FakeQuanterWithAbsMaxObserver is the built-in)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+
+def quanter(name):
+    """quantization/factory.py quanter decorator: register a Quanter
+    class under ``name`` so QuantConfig can refer to it by string."""
+    def decorator(cls):
+        _QUANTER_REGISTRY[name] = cls
+        cls.__quanter_name__ = name
+        return cls
+    return decorator
+
+
+_QUANTER_REGISTRY = {}
+
+__all__ += ["BaseObserver", "BaseQuanter", "quanter"]

@@ -148,3 +148,269 @@ def multiply(x, y):
 def relu(x):
     from ..nn import functional as F
     return F.relu(to_dense(x))
+
+
+# ------------------------------------------------------------------ r5
+# value-wise unary ops: all zero-preserving (f(0)=0), so they transform
+# VALUES in place and keep the sparsity structure — the same contract as
+# the reference's sparse unary kernels (phi/kernels/sparse/unary_*).
+
+def _same_structure(x, new_values):
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x._indices, new_values, x._shape)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x._crows, x._cols, new_values, x._shape)
+    return new_values
+
+
+def _unary(opname, jfn):
+    def op(x, name=None):
+        if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+            from ..ops.dispatch import apply_op
+            vals = apply_op(f"sparse_{opname}", jfn, (x._values,), {})
+            return _same_structure(x, vals)
+        from ..ops.dispatch import apply_op
+        return apply_op(opname, jfn, (ensure_tensor(x),), {})
+    op.__name__ = opname
+    op.__doc__ = f"sparse.{opname}: value-wise (zero-preserving)."
+    return op
+
+
+abs = _unary("abs", jnp.abs)          # noqa: A001
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+expm1 = _unary("expm1", jnp.expm1)
+log1p = _unary("log1p", jnp.log1p)
+neg = _unary("neg", jnp.negative)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+sin = _unary("sin", jnp.sin)
+sinh = _unary("sinh", jnp.sinh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+tan = _unary("tan", jnp.tan)
+tanh = _unary("tanh", jnp.tanh)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    from ..ops.dispatch import apply_op
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        vals = apply_op("sparse_pow", lambda v: jnp.power(v, factor),
+                        (x._values,), {})
+        return _same_structure(x, vals)
+    return apply_op("pow", lambda v: jnp.power(v, factor),
+                    (ensure_tensor(x),), {})
+
+
+def isnan(x, name=None):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return _same_structure(x, Tensor(jnp.isnan(x._values._data)))
+    return Tensor(jnp.isnan(ensure_tensor(x)._data))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """sparse.cast: change index/value dtypes, keep structure."""
+    if not isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        raise TypeError("sparse.cast expects a sparse tensor")
+    vals = (Tensor(x._values._data.astype(value_dtype))
+            if value_dtype else x._values)
+    if isinstance(x, SparseCooTensor):
+        idx = (Tensor(x._indices._data.astype(index_dtype))
+               if index_dtype else x._indices)
+        return SparseCooTensor(idx, vals, x._shape)
+    crows = (Tensor(x._crows._data.astype(index_dtype))
+             if index_dtype else x._crows)
+    cols = (Tensor(x._cols._data.astype(index_dtype))
+            if index_dtype else x._cols)
+    return SparseCsrTensor(crows, cols, vals, x._shape)
+
+
+def coalesce(x, name=None):
+    """sparse.coalesce: sum duplicate COO entries (host; data-dependent
+    output size, like the reference's kernel)."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("coalesce expects a COO tensor")
+    idx = np.asarray(x._indices._data)
+    vals = np.asarray(x._values._data)
+    flat = np.ravel_multi_index(idx, x._shape)
+    uniq, inv = np.unique(flat, return_inverse=True)
+    out_vals = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+    np.add.at(out_vals, inv, vals)
+    out_idx = np.stack(np.unravel_index(uniq, x._shape))
+    return SparseCooTensor(Tensor(jnp.asarray(out_idx)),
+                           Tensor(jnp.asarray(out_vals)), x._shape)
+
+
+def subtract(x, y, name=None):
+    return to_dense(x) - to_dense(y)
+
+
+def divide(x, y, name=None):
+    return to_dense(x) / to_dense(y)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """sparse.sum: over values (axis=None) or via the dense view."""
+    from ..ops.dispatch import apply_op
+    if axis is None:
+        return apply_op("sparse_sum", jnp.sum, (x._values,), {})
+    d = to_dense(x)
+    return apply_op("sparse_sum",
+                    lambda a: jnp.sum(a, axis=axis, keepdims=keepdim),
+                    (d,), {})
+
+
+def mv(x, vec, name=None):
+    """sparse.mv: CSR/COO matrix @ dense vector without densifying the
+    matrix — gather + segment-sum over the nonzeros (the TPU-friendly
+    formulation of spmv)."""
+    from ..ops.dispatch import apply_op
+    v = ensure_tensor(vec)
+    if isinstance(x, SparseCsrTensor):
+        crows = np.asarray(x._crows._data)
+        rows = np.repeat(np.arange(len(crows) - 1),
+                         np.diff(crows).astype(int))
+        rows_j = jnp.asarray(rows)
+        cols = x._cols
+
+        def fn(vals, cols_, vd):
+            prod = vals * jnp.take(vd, cols_)
+            import jax
+            return jax.ops.segment_sum(prod, rows_j,
+                                       num_segments=x._shape[0])
+        return apply_op("sparse_mv", fn, (x._values, cols, v), {})
+    if isinstance(x, SparseCooTensor):
+        rows_t, cols_t = x._indices._data[0], x._indices._data[1]
+
+        def fn(vals, vd):
+            import jax
+            prod = vals * jnp.take(vd, cols_t)
+            return jax.ops.segment_sum(prod, rows_t,
+                                       num_segments=x._shape[0])
+        return apply_op("sparse_mv", fn, (x._values, v), {})
+    raise TypeError("mv expects a sparse matrix")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """sparse.addmm: beta*input + alpha*(x @ y)."""
+    return ensure_tensor(to_dense(input)) * beta + matmul(x, y) * alpha
+
+
+def masked_matmul(x, y, mask, name=None):
+    """sparse.masked_matmul: (x @ y) evaluated ONLY at mask's sparsity
+    pattern (SDDMM). Gathers the needed row/col pairs, so the dense
+    product never materializes."""
+    from ..ops.dispatch import apply_op
+    xd = ensure_tensor(x)
+    yd = ensure_tensor(y)
+    if isinstance(mask, SparseCsrTensor):
+        crows = np.asarray(mask._crows._data)
+        rows = jnp.asarray(np.repeat(np.arange(len(crows) - 1),
+                                     np.diff(crows).astype(int)))
+        cols_t = mask._cols
+
+        def fn(a, b, cols_):
+            av = jnp.take(a, rows, axis=0)
+            bv = jnp.take(b.T, cols_, axis=0)
+            return jnp.sum(av * bv, axis=-1)
+        vals = apply_op("sparse_sddmm", fn, (xd, yd, cols_t), {})
+        return SparseCsrTensor(mask._crows, mask._cols, vals, mask._shape)
+    if isinstance(mask, SparseCooTensor):
+        rows_t = mask._indices._data[0]
+        cols_t = mask._indices._data[1]
+
+        def fn(a, b):
+            av = jnp.take(a, rows_t, axis=0)
+            bv = jnp.take(b.T, cols_t, axis=0)
+            return jnp.sum(av * bv, axis=-1)
+        vals = apply_op("sparse_sddmm", fn, (xd, yd), {})
+        return SparseCooTensor(mask._indices, vals, mask._shape)
+    raise TypeError("mask must be sparse")
+
+
+def mask_as(x, mask, name=None):
+    """sparse.mask_as: sample dense x at mask's sparsity pattern."""
+    xd = ensure_tensor(x)._data
+    if isinstance(mask, SparseCooTensor):
+        idx = tuple(mask._indices._data[i]
+                    for i in range(mask._indices.shape[0]))
+        return SparseCooTensor(mask._indices, Tensor(xd[idx]),
+                               mask._shape)
+    if isinstance(mask, SparseCsrTensor):
+        crows = np.asarray(mask._crows._data)
+        rows = np.repeat(np.arange(len(crows) - 1),
+                         np.diff(crows).astype(int))
+        vals = xd[jnp.asarray(rows), mask._cols._data]
+        return SparseCsrTensor(mask._crows, mask._cols, Tensor(vals),
+                               mask._shape)
+    raise TypeError("mask must be sparse")
+
+
+def reshape(x, shape, name=None):
+    """sparse.reshape: remap COO indices through the flat index."""
+    if isinstance(x, SparseCooTensor):
+        flat = np.ravel_multi_index(np.asarray(x._indices._data),
+                                    x._shape)
+        new_idx = np.stack(np.unravel_index(flat, tuple(shape)))
+        return SparseCooTensor(Tensor(jnp.asarray(new_idx)), x._values,
+                               tuple(shape))
+    d = np.asarray(to_dense(x)._data).reshape(shape)
+    return _dense_to_csr(d) if len(shape) == 2 else \
+        sparse_coo_from_dense(Tensor(jnp.asarray(d)))
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    """sparse.slice via the dense view (host; output nnz data-dependent)."""
+    d = np.asarray(to_dense(x)._data)
+    sl = [np.s_[:]] * d.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        sl[int(ax)] = np.s_[int(s):int(e)]
+    out = d[tuple(sl)]
+    if isinstance(x, SparseCsrTensor) and out.ndim == 2:
+        return _dense_to_csr(out)
+    return sparse_coo_from_dense(Tensor(jnp.asarray(out)))
+
+
+def sparse_coo_from_dense(d, stop_gradient=True) -> SparseCooTensor:
+    """to_sparse_coo on a dense Tensor (host nonzero scan)."""
+    arr = np.asarray(ensure_tensor(d)._data)
+    idx = np.stack(np.nonzero(arr))
+    vals = arr[tuple(idx)]
+    return SparseCooTensor(Tensor(jnp.asarray(idx)),
+                           Tensor(jnp.asarray(vals)), arr.shape)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """sparse.pca_lowrank via the dense view's SVD."""
+    d = to_dense(x)._data.astype(jnp.float32)
+    if center:
+        d = d - jnp.mean(d, axis=0, keepdims=True)
+    u, s, vt = jnp.linalg.svd(d, full_matrices=False)
+    if q is not None:
+        u, s, vt = u[:, :q], s[:q], vt[:q]
+    return Tensor(u), Tensor(s), Tensor(vt.T)
+
+
+from . import nn  # noqa: F401,E402
+
+__all__ += ["abs", "asin", "asinh", "atan", "atanh", "cast", "coalesce",
+            "deg2rad", "divide", "expm1", "isnan", "log1p", "mask_as",
+            "masked_matmul", "mv", "neg", "pca_lowrank", "pow",
+            "rad2deg", "reshape", "sin", "sinh", "slice", "sqrt",
+            "square", "subtract", "sum", "tan", "tanh", "addmm", "nn"]
+
+
+def transpose(x, perm, name=None):
+    """sparse.transpose: permute COO index rows (structure-only)."""
+    if isinstance(x, SparseCooTensor):
+        idx = x._indices._data[jnp.asarray(perm)]
+        shape = tuple(x._shape[p] for p in perm)
+        return SparseCooTensor(Tensor(idx), x._values, shape)
+    d = np.asarray(to_dense(x)._data).transpose(perm)
+    return _dense_to_csr(d) if d.ndim == 2 else \
+        sparse_coo_from_dense(Tensor(jnp.asarray(d)))
+
+
+__all__ += ["transpose"]

@@ -1,0 +1,230 @@
+"""paddle.sparse.nn (reference python/paddle/sparse/nn/): layers over
+sparse tensors. Activations/norms are value-wise (structure preserved);
+convolutions run the dense lax.conv on the densified block — XLA has no
+sparse conv kernels and the MXU wants dense tiles, so submanifold
+semantics are enforced by re-masking to the input's active sites
+(the defining property of SubmConv, sparse/gpu/conv_kernel.cu)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv2D", "Conv3D", "SubmConv2D",
+           "SubmConv3D", "MaxPool3D"]
+
+
+def _values_op(x, fn):
+    from . import SparseCooTensor, SparseCsrTensor, _same_structure
+    from ..ops.dispatch import apply_op
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return _same_structure(x, apply_op("sparse_act", fn,
+                                           (x._values,), {}))
+    from ..ops.dispatch import ensure_tensor
+    return apply_op("sparse_act", fn, (ensure_tensor(x),), {})
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _values_op(x, lambda v: jnp.maximum(v, 0))
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _values_op(x, lambda v: jnp.clip(v, 0, 6))
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        s = self._slope
+        return _values_op(x, lambda v: jnp.where(v >= 0, v, s * v))
+
+
+class Softmax(Layer):
+    """sparse softmax over the last dim of the CSR rows: normalizes each
+    row's NONZERO entries (reference sparse softmax semantics)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+
+    def forward(self, x):
+        from . import SparseCsrTensor
+        if not isinstance(x, SparseCsrTensor):
+            raise TypeError("sparse.nn.Softmax expects a CSR tensor")
+        crows = np.asarray(x._crows._data)
+        rows = jnp.asarray(np.repeat(np.arange(len(crows) - 1),
+                                     np.diff(crows).astype(int)))
+        from ..ops.dispatch import apply_op
+
+        def fn(vals):
+            n = x._shape[0]
+            row_max = jax.ops.segment_max(vals, rows, num_segments=n)
+            e = jnp.exp(vals - jnp.take(row_max, rows))
+            denom = jax.ops.segment_sum(e, rows, num_segments=n)
+            return e / jnp.take(denom, rows)
+
+        vals = apply_op("sparse_softmax", fn, (x._values,), {})
+        return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+
+
+class BatchNorm(Layer):
+    """sparse BatchNorm: normalizes the VALUES' channel dim (channels
+    last in sparse layout)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        self._eps = epsilon
+        self._momentum = momentum
+        self.weight = self.create_parameter([num_features],
+                                            attr=weight_attr,
+                                            default_initializer=None)
+        import jax.numpy as _j
+        self.weight._replace_data(_j.ones([num_features], _j.float32))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+        self._mean = _j.zeros([num_features], _j.float32)
+        self._var = _j.ones([num_features], _j.float32)
+
+    def forward(self, x):
+        from . import SparseCooTensor
+        from ..ops.dispatch import apply_op
+        if not isinstance(x, SparseCooTensor):
+            raise TypeError("sparse.nn.BatchNorm expects a COO tensor")
+        eps = self._eps
+        training = self.training
+
+        def fn(vals, w, b):
+            if training:
+                mean = jnp.mean(vals, axis=0)
+                var = jnp.var(vals, axis=0)
+            else:
+                mean, var = self._mean, self._var
+            return (vals - mean) / jnp.sqrt(var + eps) * w + b
+
+        vals = apply_op("sparse_bn", fn,
+                        (x._values, self.weight, self.bias), {})
+        if self.training:
+            v = np.asarray(x._values._data)
+            m = self._momentum
+            self._mean = m * self._mean + (1 - m) * jnp.asarray(
+                v.mean(axis=0))
+            self._var = m * self._var + (1 - m) * jnp.asarray(
+                v.var(axis=0))
+        return SparseCooTensor(x._indices, vals, x._shape)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Single-controller SPMD: batch stats are already global (the
+    values array spans the mesh), so Sync == BatchNorm."""
+
+
+class _SparseConv(Layer):
+    _nd = 3
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        nd = self._nd
+        ks = ((kernel_size,) * nd if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+        self._ks = ks
+        self._stride = ((stride,) * nd if isinstance(stride, int)
+                        else tuple(stride))
+        self._padding = ((padding,) * nd if isinstance(padding, int)
+                         else tuple(padding))
+        self.weight = self.create_parameter(
+            list(ks) + [in_channels // groups, out_channels],
+            attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        from . import SparseCooTensor, sparse_coo_from_dense
+        if not isinstance(x, SparseCooTensor):
+            raise TypeError("sparse conv expects a COO tensor")
+        from ..ops.dispatch import apply_op
+        nd = self._nd
+        dense = x.to_dense()  # [N, *spatial, C]
+
+        def fn(d, w, *rest):
+            b = rest[0] if rest else None
+            dn = jax.lax.conv_dimension_numbers(
+                d.shape, w.shape,
+                ("NDHWC", "DHWIO", "NDHWC") if nd == 3
+                else ("NHWC", "HWIO", "NHWC"))
+            out = jax.lax.conv_general_dilated(
+                d, w, self._stride,
+                [(p, p) for p in self._padding], dimension_numbers=dn)
+            if b is not None:
+                out = out + b
+            return out
+
+        args = (dense, self.weight) + (() if self.bias is None
+                                       else (self.bias,))
+        out = apply_op("sparse_conv", fn, args, {})
+        if self._subm:
+            # submanifold: only the input's active sites stay active
+            if any(s != 1 for s in self._stride):
+                raise ValueError("SubmConv requires stride 1")
+            if tuple(out.shape[:-1]) != tuple(dense.shape[:-1]):
+                raise ValueError(
+                    f"SubmConv must preserve the spatial shape "
+                    f"(got {tuple(out.shape[:-1])} from "
+                    f"{tuple(dense.shape[:-1])}); use 'same' padding "
+                    f"(padding = kernel//2)")
+            idx = x._indices          # [1+nd, nnz] batch+spatial sites
+            sp_idx = tuple(idx._data[i] for i in range(idx.shape[0]))
+            gathered = out._data[sp_idx]    # [nnz, C_out]
+            return SparseCooTensor(idx, Tensor(gathered),
+                                   tuple(out.shape))
+        return sparse_coo_from_dense(out)
+
+
+class Conv3D(_SparseConv):
+    _nd = 3
+
+
+class Conv2D(_SparseConv):
+    _nd = 2
+
+
+class SubmConv3D(_SparseConv):
+    _nd = 3
+    _subm = True
+
+
+class SubmConv2D(_SparseConv):
+    _nd = 2
+    _subm = True
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding)
+
+    def forward(self, x):
+        from . import SparseCooTensor, sparse_coo_from_dense
+        from ..nn import functional as F
+        if not isinstance(x, SparseCooTensor):
+            raise TypeError("sparse MaxPool3D expects a COO tensor")
+        d = x.to_dense()  # [N, D, H, W, C]
+        k, s, p = self._args
+        out = F.max_pool3d(Tensor(jnp.transpose(d._data, (0, 4, 1, 2, 3))),
+                           k, s, p)
+        return sparse_coo_from_dense(
+            Tensor(jnp.transpose(out._data, (0, 2, 3, 4, 1))))

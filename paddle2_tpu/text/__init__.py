@@ -163,3 +163,101 @@ class UCIHousing(Dataset):
 
     def __len__(self):
         return len(self.x)
+
+
+class _LocalTextDataset:
+    """Reference text datasets download corpora; this image has no
+    egress, so each dataset consumes a local ``data_file`` and raises a
+    pointered error otherwise."""
+
+    name = "dataset"
+
+    def __init__(self, data_file=None, mode="train", **kwargs):
+        import os
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                f"{self.name}: no network egress to download the corpus; "
+                "pass data_file=<local copy> (same layout the reference "
+                "downloads)")
+        self.data_file = data_file
+        self.mode = mode
+        self._samples = self._load()
+
+    def _load(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self._samples)
+
+    def __getitem__(self, idx):
+        return self._samples[idx]
+
+
+class Conll05st(_LocalTextDataset):
+    """CoNLL-2005 SRL: tab-separated predicate/argument rows."""
+    name = "Conll05st"
+
+    def _load(self):
+        out = []
+        with open(self.data_file) as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                if parts and parts[0]:
+                    out.append(parts)
+        return out
+
+
+class Imikolov(_LocalTextDataset):
+    """PTB n-gram corpus (imikolov): yields n-gram tuples."""
+    name = "Imikolov"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, **kwargs):
+        self.window_size = window_size
+        super().__init__(data_file, mode)
+
+    def _load(self):
+        out = []
+        with open(self.data_file) as f:
+            for line in f:
+                words = ["<s>"] + line.split() + ["<e>"]
+                n = self.window_size
+                for i in range(len(words) - n + 1):
+                    out.append(tuple(words[i:i + n]))
+        return out
+
+
+class Movielens(_LocalTextDataset):
+    """MovieLens ratings: 'user::movie::rating::ts' rows."""
+    name = "Movielens"
+
+    def _load(self):
+        out = []
+        with open(self.data_file) as f:
+            for line in f:
+                parts = line.strip().split("::")
+                if len(parts) >= 3:
+                    out.append((int(parts[0]), int(parts[1]),
+                                float(parts[2])))
+        return out
+
+
+class WMT14(_LocalTextDataset):
+    """WMT'14 en-fr: tab-separated parallel sentences."""
+    name = "WMT14"
+
+    def _load(self):
+        out = []
+        with open(self.data_file) as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                if len(parts) >= 2:
+                    out.append((parts[0].split(), parts[1].split()))
+        return out
+
+
+class WMT16(WMT14):
+    name = "WMT16"
+
+
+__all__ += ["Conll05st", "Imikolov", "Movielens", "WMT14", "WMT16"]

@@ -15,7 +15,8 @@ from .densenet import (DenseNet, densenet121, densenet161, densenet169,
                        densenet201, densenet264)
 from .inception_shuffle import (GoogLeNet, googlenet, InceptionV3,
                                 inception_v3, ShuffleNetV2,
-                                shufflenet_v2_x0_25, shufflenet_v2_x0_5,
+                                shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+                                shufflenet_v2_x0_5,
                                 shufflenet_v2_x1_0, shufflenet_v2_x1_5,
                                 shufflenet_v2_x2_0, shufflenet_v2_swish)
 
@@ -32,6 +33,7 @@ __all__ = [
     "DenseNet", "densenet121", "densenet161", "densenet169",
     "densenet201", "densenet264", "GoogLeNet", "googlenet",
     "InceptionV3", "inception_v3", "ShuffleNetV2",
-    "shufflenet_v2_x0_25", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+    "shufflenet_v2_x0_25", "shufflenet_v2_x0_33", "shufflenet_v2_x0_5",
+    "shufflenet_v2_x1_0",
     "shufflenet_v2_x1_5", "shufflenet_v2_x2_0", "shufflenet_v2_swish",
 ]

@@ -13,7 +13,8 @@ from ...ops.manipulation import concat
 from ._utils import no_pretrained
 
 __all__ = ["GoogLeNet", "googlenet", "InceptionV3", "inception_v3",
-           "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5",
            "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
            "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
 
@@ -273,7 +274,9 @@ class _ShuffleUnit(nn.Layer):
         return _channel_shuffle(out, 2)
 
 
-_SHUFFLE_CFG = {0.25: [24, 24, 48, 96, 512], 0.5: [24, 48, 96, 192, 1024],
+_SHUFFLE_CFG = {0.25: [24, 24, 48, 96, 512],
+                0.33: [24, 32, 64, 128, 512],
+                0.5: [24, 48, 96, 192, 1024],
                 1.0: [24, 116, 232, 464, 1024],
                 1.5: [24, 176, 352, 704, 1024],
                 2.0: [24, 244, 488, 976, 2048]}
@@ -323,6 +326,10 @@ def _shuffle(scale, pretrained, act="relu", **kwargs):
 
 def shufflenet_v2_x0_25(pretrained=False, **kwargs):
     return _shuffle(0.25, pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shuffle(0.33, pretrained, **kwargs)
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kwargs):

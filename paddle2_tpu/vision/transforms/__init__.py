@@ -21,7 +21,19 @@ __all__ = ["BaseTransform", "Compose", "ToTensor", "Normalize", "Resize",
            "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose",
            "RandomCrop", "CenterCrop", "RandomResizedCrop", "Pad",
            "Grayscale", "BrightnessTransform", "ContrastTransform",
-           "RandomRotation", "functional"]
+           "RandomRotation", "functional", "SaturationTransform",
+           "HueTransform", "ColorJitter", "RandomErasing", "RandomAffine",
+           "RandomPerspective",
+           # functional re-exports (reference exports them at this level)
+           "to_tensor", "normalize", "resize", "crop", "center_crop",
+           "hflip", "vflip", "pad", "rotate", "to_grayscale",
+           "adjust_brightness", "adjust_contrast", "adjust_hue",
+           "affine", "perspective", "erase"]
+
+from .functional import (adjust_brightness, adjust_contrast,  # noqa: F401,E402
+                         adjust_hue, affine, center_crop, crop, erase,
+                         hflip, normalize, pad, perspective, resize,
+                         rotate, to_grayscale, to_tensor, vflip)
 
 
 class BaseTransform:
@@ -236,3 +248,152 @@ class RandomRotation(BaseTransform):
         angle = random.uniform(*self.degrees)
         return F.rotate(img, angle, self.interpolation, self.expand,
                         self.center, self.fill)
+
+
+class SaturationTransform(BaseTransform):
+    """transforms.py SaturationTransform."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_saturation(img, factor)
+
+
+class HueTransform(BaseTransform):
+    """transforms.py HueTransform (value in [0, 0.5])."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return F.adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """transforms.py ColorJitter: random brightness/contrast/saturation/
+    hue, applied in random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = list(self.transforms)
+        random.shuffle(order)
+        for t in order:
+            img = t._apply_image(img)
+        return img
+
+
+class RandomErasing(BaseTransform):
+    """transforms.py RandomErasing (Zhong et al.): erase a random
+    rectangle with probability `prob`."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        import numpy as np
+        if random.random() >= self.prob:
+            return img
+        arr = F._to_numpy(img)
+        H, W = arr.shape[:2]
+        from ...framework.tensor import Tensor
+        if isinstance(img, Tensor) and img.ndim == 3:
+            H, W = img.shape[-2], img.shape[-1]
+        area = H * W
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]),
+                                       np.log(self.ratio[1])))
+            h = int(round(np.sqrt(target * ar)))
+            w = int(round(np.sqrt(target / ar)))
+            if h < H and w < W:
+                i = random.randint(0, H - h)
+                j = random.randint(0, W - w)
+                v = (random.random() if self.value == "random"
+                     else self.value)
+                return F.erase(img, i, j, h, w, v, inplace=self.inplace)
+        return img
+
+
+class RandomAffine(BaseTransform):
+    """transforms.py RandomAffine: random rotation/translate/scale/shear."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = ((-degrees, degrees)
+                        if isinstance(degrees, (int, float)) else degrees)
+        self.translate, self.scale_rng = translate, scale
+        self.shear = shear
+        self.interpolation, self.fill, self.center = (interpolation, fill,
+                                                      center)
+
+    def _apply_image(self, img):
+        import numpy as np
+        angle = random.uniform(*self.degrees)
+        H, W = F._to_numpy(img).shape[:2]
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * W
+            ty = random.uniform(-self.translate[1], self.translate[1]) * H
+        else:
+            tx = ty = 0.0
+        sc = (random.uniform(*self.scale_rng)
+              if self.scale_rng is not None else 1.0)
+        if self.shear is None:
+            sh = (0.0, 0.0)
+        elif isinstance(self.shear, (int, float)):
+            sh = (random.uniform(-self.shear, self.shear), 0.0)
+        else:
+            sh = (random.uniform(self.shear[0], self.shear[1]), 0.0)
+        return F.affine(img, angle, (tx, ty), sc, sh,
+                        interpolation=self.interpolation, fill=self.fill,
+                        center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """transforms.py RandomPerspective: random 4-point projective warp
+    with probability `prob`."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation, self.fill = interpolation, fill
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        H, W = F._to_numpy(img).shape[:2]
+        d = self.distortion_scale
+        hw = int(W * d / 2)
+        hh = int(H * d / 2)
+
+        def jig(x, y):
+            return (x + random.randint(-hw, hw) if hw else x,
+                    y + random.randint(-hh, hh) if hh else y)
+
+        start = [(0, 0), (W - 1, 0), (W - 1, H - 1), (0, H - 1)]
+        end = [jig(*p) for p in start]
+        return F.perspective(img, start, end,
+                             interpolation=self.interpolation,
+                             fill=self.fill)

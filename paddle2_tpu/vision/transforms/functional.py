@@ -168,3 +168,171 @@ def adjust_contrast(img, contrast_factor):
     if _is_pil(img):
         return Image.fromarray(out.astype("uint8"))
     return out.astype(_to_numpy(img).dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    """functional.py adjust_saturation: blend with the grayscale image."""
+    arr = _to_numpy(img).astype("float32")
+    gray = (arr[..., 0] * 0.299 + arr[..., 1] * 0.587
+            + arr[..., 2] * 0.114)[..., None]
+    out = np.clip(gray + (arr - gray) * saturation_factor, 0, 255)
+    if _is_pil(img):
+        return Image.fromarray(out.astype("uint8"))
+    return out.astype(_to_numpy(img).dtype)
+
+
+def adjust_hue(img, hue_factor):
+    """functional.py adjust_hue: shift hue by hue_factor (in [-0.5, 0.5]
+    turns) through an RGB->HSV->RGB round trip."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _to_numpy(img).astype("float32") / 255.0
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    maxc = arr[..., :3].max(-1)
+    minc = arr[..., :3].min(-1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(d, 1e-12)
+    rc = (maxc - r) / dz
+    gc = (maxc - g) / dz
+    bc = (maxc - b) / dz
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = np.where(d == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype("int32") % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.clip(np.stack([r2, g2, b2], -1) * 255.0, 0, 255)
+    if _is_pil(img):
+        return Image.fromarray(out.astype("uint8"))
+    return out.astype(_to_numpy(img).dtype)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """functional.py erase: fill img[i:i+h, j:j+w] with v."""
+    from ...framework.tensor import Tensor
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+        arr = img._data
+        val = jnp.broadcast_to(jnp.asarray(v, arr.dtype),
+                               arr[..., i:i + h, j:j + w].shape)
+        out = arr.at[..., i:i + h, j:j + w].set(val)
+        if inplace:
+            img._replace_data(out)
+            return img
+        return Tensor(out)
+    arr = _to_numpy(img).copy()
+    arr[i:i + h, j:j + w] = v
+    if _is_pil(img):
+        return Image.fromarray(arr.astype("uint8"))
+    return arr
+
+
+def _warp_bilinear(arr, inv_matrix, fill=0):
+    """Inverse-map warp with bilinear sampling. arr HWC; inv maps output
+    (x, y, 1) -> input (x, y)."""
+    H, W = arr.shape[:2]
+    ys, xs = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1).astype("float64")
+    src = inv_matrix @ coords
+    if inv_matrix.shape[0] == 3:
+        src = src[:2] / np.maximum(np.abs(src[2:3]), 1e-12) * np.sign(
+            src[2:3])
+    sx = src[0].reshape(H, W)
+    sy = src[1].reshape(H, W)
+    x0 = np.floor(sx).astype(int)
+    y0 = np.floor(sy).astype(int)
+    wx = sx - x0
+    wy = sy - y0
+    out = np.zeros_like(arr, dtype="float32")
+    acc = np.zeros(arr.shape[:2], dtype="float32")
+    for dy in (0, 1):
+        for dx in (0, 1):
+            xi = x0 + dx
+            yi = y0 + dy
+            wgt = (wx if dx else 1 - wx) * (wy if dy else 1 - wy)
+            valid = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+            xi_c = np.clip(xi, 0, W - 1)
+            yi_c = np.clip(yi, 0, H - 1)
+            pix = arr[yi_c, xi_c].astype("float32")
+            out += pix * (wgt * valid)[..., None]
+            acc += wgt * valid
+    out = out + np.asarray(fill, "float32") * (1 - acc)[..., None]
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """functional.py affine: rotation+translation+scale+shear about
+    center, implemented as an inverse-matrix bilinear warp."""
+    arr = _to_numpy(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[..., None]
+    H, W = arr.shape[:2]
+    if center is None:
+        center = ((W - 1) * 0.5, (H - 1) * 0.5)
+    rot = np.deg2rad(angle)
+    sx, sy = [np.deg2rad(s) for s in (shear if isinstance(
+        shear, (list, tuple)) else (shear, 0.0))]
+    cx, cy = center
+    tx, ty = translate
+    # forward matrix M = T(center) R S Shear T(-center) + translate
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    M = np.array([[a, b, 0.0], [c, d, 0.0], [0, 0, 1.0]]) * 1.0
+    M[:2, :2] *= scale
+    M[0, 2] = cx + tx - M[0, 0] * cx - M[0, 1] * cy
+    M[1, 2] = cy + ty - M[1, 0] * cx - M[1, 1] * cy
+    inv = np.linalg.inv(M)
+    out = _warp_bilinear(arr, inv, fill)
+    out = np.clip(out, 0, 255) if arr.dtype == np.uint8 else out
+    if squeeze:
+        out = out[..., 0]
+    if _is_pil(img):
+        return Image.fromarray(out.astype("uint8"))
+    return out.astype(arr.dtype)
+
+
+def _homography(startpoints, endpoints):
+    """Solve the 3x3 projective transform mapping endpoints->startpoints
+    (the inverse map the warp needs)."""
+    A = []
+    bvec = []
+    for (ex, ey), (sx, sy) in zip(endpoints, startpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        bvec.append(sx)
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        bvec.append(sy)
+    h = np.linalg.solve(np.asarray(A, "float64"),
+                        np.asarray(bvec, "float64"))
+    return np.concatenate([h, [1.0]]).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """functional.py perspective: 4-point projective warp."""
+    arr = _to_numpy(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[..., None]
+    inv = _homography(startpoints, endpoints)
+    out = _warp_bilinear(arr, inv, fill)
+    out = np.clip(out, 0, 255) if arr.dtype == np.uint8 else out
+    if squeeze:
+        out = out[..., 0]
+    if _is_pil(img):
+        return Image.fromarray(out.astype("uint8"))
+    return out.astype(arr.dtype)
