@@ -71,6 +71,7 @@ from . import hub  # noqa: F401
 from . import regularizer  # noqa: F401
 from .hapi import callbacks  # noqa: F401
 from . import version  # noqa: F401
+from . import linalg  # noqa: F401
 from .framework.dtype_info import iinfo, finfo  # noqa: F401
 from . import audio  # noqa: F401
 from . import geometric  # noqa: F401

@@ -262,6 +262,15 @@ class Tensor:
         t = Tensor(arr, stop_gradient=self.stop_gradient)
         return t
 
+    def cuda(self, device_id=None, blocking=True):
+        """Move to the accelerator (reference Tensor.cuda; the
+        accelerator here is the TPU/default backend device)."""
+        devs = [d for d in jax.devices() if d.platform != "cpu"] \
+            or jax.devices()
+        dev = devs[device_id or 0]
+        return Tensor(jax.device_put(self._data, dev),
+                      stop_gradient=self.stop_gradient)
+
     def to(self, *args, **kwargs):
         from ..ops.dispatch import apply_op
         device = kwargs.pop("device", None)

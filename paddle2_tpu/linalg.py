@@ -1,0 +1,5 @@
+"""paddle.linalg namespace (reference python/paddle/linalg.py — the
+linear-algebra functions re-exported as a module)."""
+
+from .ops.linalg import *  # noqa: F401,F403
+from .ops.linalg import __all__  # noqa: F401

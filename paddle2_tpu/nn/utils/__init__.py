@@ -64,6 +64,10 @@ def remove_weight_norm(layer, name="weight"):
     orig._replace_data(w)
     orig.stop_gradient = False
     object.__setattr__(layer, name_, orig)
+    # drop the now-dead reparameterization params so parameters()/
+    # state_dict round-trip like an unwrapped layer
+    layer._parameters.pop(f"{name_}_v", None)
+    layer._parameters.pop(f"{name_}_g", None)
     del layer._weight_norm_state
     return layer
 
@@ -71,7 +75,10 @@ def remove_weight_norm(layer, name="weight"):
 def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
                   dim=None):
     """spectral_norm_hook.py: divide the weight by its largest singular
-    value, estimated by power iteration before each forward."""
+    value, estimated by power iteration before each forward. The
+    TRAINABLE parameter is ``<name>_orig`` (reference weight_orig); the
+    consumed weight is recomputed from it each forward so the optimizer
+    keeps training through the normalization."""
     w = getattr(layer, name)
     dim = 0 if dim is None else int(dim)
     mat = jnp.moveaxis(w._data, dim, 0).reshape(w.shape[dim], -1)
@@ -80,10 +87,15 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
     v0 = jnp.asarray(npr.RandomState(1).randn(mat.shape[1]), jnp.float32)
     state = {"u": u0 / jnp.linalg.norm(u0),
              "v": v0 / jnp.linalg.norm(v0)}
-    orig = Tensor(w._data)
+    orig = layer.create_parameter(list(w.shape))
+    orig._replace_data(w._data)
+    layer.add_parameter(f"{name}_orig", orig)
+    w.stop_gradient = True
 
     def _apply(layer_, inputs):
         from ...ops.dispatch import apply_op
+        # sigma from the LIVE weight_orig (updated by the optimizer);
+        # the power-iteration vectors carry across steps
         wd = orig._data
         m = jnp.moveaxis(wd, dim, 0).reshape(wd.shape[dim], -1)
         u, vvec = state["u"], state["v"]
@@ -92,9 +104,18 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
             vvec = vvec / jnp.maximum(jnp.linalg.norm(vvec), eps)
             u = m @ vvec
             u = u / jnp.maximum(jnp.linalg.norm(u), eps)
-        state["u"], state["v"] = u, vvec
-        sigma = u @ (m @ vvec)
-        getattr(layer_, name)._replace_data(wd / sigma)
+        state["u"], state["v"] = (jax.lax.stop_gradient(u),
+                                  jax.lax.stop_gradient(vvec))
+        u_c, v_c = state["u"], state["v"]
+
+        def norm_fn(wo):
+            mm = jnp.moveaxis(wo, dim, 0).reshape(wo.shape[dim], -1)
+            sigma = u_c @ (mm @ v_c)
+            return wo / sigma
+
+        out = apply_op("spectral_norm", norm_fn, (orig,), {})
+        getattr(layer_, name)._replace_data(out._data)
+        object.__setattr__(layer_, name, out)
         return None
 
     handle = layer.register_forward_pre_hook(_apply)

@@ -145,6 +145,9 @@ class _SparseConv(Layer):
                         else tuple(stride))
         self._padding = ((padding,) * nd if isinstance(padding, int)
                          else tuple(padding))
+        self._dilation = ((dilation,) * nd if isinstance(dilation, int)
+                          else tuple(dilation))
+        self._groups = int(groups)
         self.weight = self.create_parameter(
             list(ks) + [in_channels // groups, out_channels],
             attr=weight_attr)
@@ -167,7 +170,9 @@ class _SparseConv(Layer):
                 else ("NHWC", "HWIO", "NHWC"))
             out = jax.lax.conv_general_dilated(
                 d, w, self._stride,
-                [(p, p) for p in self._padding], dimension_numbers=dn)
+                [(p, p) for p in self._padding],
+                rhs_dilation=self._dilation,
+                feature_group_count=self._groups, dimension_numbers=dn)
             if b is not None:
                 out = out + b
             return out
@@ -190,7 +195,15 @@ class _SparseConv(Layer):
             gathered = out._data[sp_idx]    # [nnz, C_out]
             return SparseCooTensor(idx, Tensor(gathered),
                                    tuple(out.shape))
-        return sparse_coo_from_dense(out)
+        # dense conv: emit the SAME site-indexed COO form SubmConv and
+        # BatchNorm consume (indices over batch+spatial, values [nnz, C])
+        arr = np.asarray(out._data)
+        active = np.nonzero(np.abs(arr).sum(axis=-1) > 0)
+        site_idx = np.stack(active)
+        vals = arr[active]
+        return SparseCooTensor(Tensor(jnp.asarray(site_idx)),
+                               Tensor(jnp.asarray(vals)),
+                               tuple(out.shape))
 
 
 class Conv3D(_SparseConv):

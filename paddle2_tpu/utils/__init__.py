@@ -19,3 +19,26 @@ def run_check():
     assert float(y[0, 0]) == 128.0
     print(f"PaddlePaddle (TPU-native) works on {len(devs)} "
           f"{devs[0].platform} device(s).")
+
+
+def require_version(min_version: str, max_version=None):
+    """utils require_version (reference utils/__init__.py): assert the
+    installed framework version is inside [min_version, max_version]."""
+    import re as _re
+    from .. import version as _v
+
+    def parse(s):
+        out = []
+        for part in str(s).split(".")[:3]:
+            m = _re.match(r"\d+", part)
+            out.append(int(m.group()) if m else 0)
+        return tuple(out)
+
+    cur = parse(_v.full_version)
+
+    if cur < parse(min_version):
+        raise Exception(
+            f"version {_v.full_version} < required minimum {min_version}")
+    if max_version is not None and cur > parse(max_version):
+        raise Exception(
+            f"version {_v.full_version} > allowed maximum {max_version}")

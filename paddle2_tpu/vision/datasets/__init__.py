@@ -19,7 +19,7 @@ import numpy as np
 from ...io.dataloader import Dataset
 
 __all__ = ["DatasetFolder", "ImageFolder", "MNIST", "FashionMNIST",
-           "Cifar10", "Cifar100"]
+           "Cifar10", "Cifar100", "Flowers", "VOC2012"]
 
 IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
                   ".tiff", ".webp", ".npy")
@@ -206,3 +206,73 @@ class Cifar100(Cifar10):
     _train_members = ["train"]
     _test_members = ["test"]
     _label_key = b"fine_labels"
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (datasets/flowers.py parity, local files only:
+    data_file = extracted jpg directory, label_file = imagelabels .mat
+    or a plain text file of one label per line)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend="cv2"):
+        import numpy as np
+        self.transform = transform
+        root = _require(data_file, "Flowers")
+        files = sorted(f for f in os.listdir(root)
+                       if f.lower().endswith((".jpg", ".png")))
+        self.files = [os.path.join(root, f) for f in files]
+        if label_file and os.path.exists(label_file):
+            if label_file.endswith(".mat"):
+                raise ValueError(
+                    "scipy .mat labels are not parseable offline; convert "
+                    "imagelabels.mat to a text file of one label per line")
+            with open(label_file) as f:
+                self.labels = [int(x) for x in f.read().split()]
+            if len(self.labels) != len(self.files):
+                raise ValueError(
+                    f"Flowers: {len(self.labels)} labels for "
+                    f"{len(self.files)} images — the label file must "
+                    "have one entry per jpg")
+        else:
+            self.labels = [0] * len(self.files)
+
+    def __getitem__(self, idx):
+        img = default_loader(self.files[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.files)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC 2012 segmentation pairs (datasets/voc2012.py parity,
+    local extraction only: data_file = VOCdevkit/VOC2012 root)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        self.transform = transform
+        root = _require(data_file, "VOC2012")
+        split = {"train": "train", "valid": "val", "test": "val"}.get(
+            mode, "train")
+        listing = os.path.join(root, "ImageSets", "Segmentation",
+                               split + ".txt")
+        _require(listing, "VOC2012 split list")
+        with open(listing) as f:
+            names = [line.strip() for line in f if line.strip()]
+        self.images = [os.path.join(root, "JPEGImages", n + ".jpg")
+                       for n in names]
+        self.masks = [os.path.join(root, "SegmentationClass", n + ".png")
+                      for n in names]
+
+    def __getitem__(self, idx):
+        img = default_loader(self.images[idx])
+        mask = default_loader(self.masks[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self.images)

@@ -208,28 +208,37 @@ class Grayscale(BaseTransform):
         return F.to_grayscale(img, self.num_output_channels)
 
 
+def _jitter_range(value, center=1.0):
+    """Scalar v -> [max(0, c-v), c+v]; (lo, hi) passes through
+    (reference ColorJitter _check_input)."""
+    if isinstance(value, (tuple, list)):
+        return float(value[0]), float(value[1])
+    v = float(value)
+    return max(0.0, center - v), center + v
+
+
 class BrightnessTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
-        self.value = float(value)
+        self.value = _jitter_range(value)
 
     def _apply_image(self, img):
-        if self.value == 0:
+        lo, hi = self.value
+        if lo == hi == 1.0:
             return img
-        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
-        return F.adjust_brightness(img, factor)
+        return F.adjust_brightness(img, random.uniform(lo, hi))
 
 
 class ContrastTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
-        self.value = float(value)
+        self.value = _jitter_range(value)
 
     def _apply_image(self, img):
-        if self.value == 0:
+        lo, hi = self.value
+        if lo == hi == 1.0:
             return img
-        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
-        return F.adjust_contrast(img, factor)
+        return F.adjust_contrast(img, random.uniform(lo, hi))
 
 
 class RandomRotation(BaseTransform):
@@ -255,28 +264,35 @@ class SaturationTransform(BaseTransform):
 
     def __init__(self, value, keys=None):
         super().__init__(keys)
-        self.value = float(value)
+        self.value = _jitter_range(value)
 
     def _apply_image(self, img):
-        if self.value == 0:
+        lo, hi = self.value
+        if lo == hi == 1.0:
             return img
-        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
-        return F.adjust_saturation(img, factor)
+        return F.adjust_saturation(img, random.uniform(lo, hi))
 
 
 class HueTransform(BaseTransform):
-    """transforms.py HueTransform (value in [0, 0.5])."""
+    """transforms.py HueTransform (scalar in [0, 0.5] or (lo, hi))."""
 
     def __init__(self, value, keys=None):
         super().__init__(keys)
-        if not 0 <= value <= 0.5:
-            raise ValueError("hue value must be in [0, 0.5]")
-        self.value = float(value)
+        if isinstance(value, (tuple, list)):
+            lo, hi = float(value[0]), float(value[1])
+        else:
+            if not 0 <= value <= 0.5:
+                raise ValueError("hue value must be in [0, 0.5]")
+            lo, hi = -float(value), float(value)
+        if not -0.5 <= lo <= hi <= 0.5:
+            raise ValueError("hue range must lie in [-0.5, 0.5]")
+        self.value = (lo, hi)
 
     def _apply_image(self, img):
-        if self.value == 0:
+        lo, hi = self.value
+        if lo == hi == 0.0:
             return img
-        return F.adjust_hue(img, random.uniform(-self.value, self.value))
+        return F.adjust_hue(img, random.uniform(lo, hi))
 
 
 class ColorJitter(BaseTransform):
@@ -362,6 +378,10 @@ class RandomAffine(BaseTransform):
             sh = (0.0, 0.0)
         elif isinstance(self.shear, (int, float)):
             sh = (random.uniform(-self.shear, self.shear), 0.0)
+        elif len(self.shear) == 4:
+            # (x_min, x_max, y_min, y_max) — reference 4-tuple form
+            sh = (random.uniform(self.shear[0], self.shear[1]),
+                  random.uniform(self.shear[2], self.shear[3]))
         else:
             sh = (random.uniform(self.shear[0], self.shear[1]), 0.0)
         return F.affine(img, angle, (tx, ty), sc, sh,

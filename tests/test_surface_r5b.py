@@ -377,3 +377,71 @@ def test_review_regressions_r5b():
         paddle.to_tensor(np.ones((1, 1), np.float32)), (1, 4, 4, 1))
     with pytest.raises(ValueError, match="preserve"):
         snn.SubmConv2D(1, 1, 3)(x)   # padding=0 shrinks the map
+
+
+def test_review_regressions_r5c():
+    import paddle2_tpu.nn as nn
+    import paddle2_tpu.optimizer as opt
+    from paddle2_tpu.nn.utils import (remove_weight_norm, spectral_norm,
+                                      weight_norm)
+    paddle.seed(0)
+    # spectral_norm keeps TRAINING (weight_orig is the live parameter)
+    lin = nn.Linear(6, 1)
+    spectral_norm(lin)
+    o = opt.Adam(learning_rate=0.05, parameters=lin.parameters())
+    X = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(32, 6).astype(np.float32))
+    Y = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(32, 1).astype(np.float32))
+    first = last = None
+    for _ in range(40):
+        loss = ((lin(X) - Y) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        last = float(loss.numpy())
+        first = first if first is not None else last
+    assert last < 0.8 * first, (first, last)
+    # remove_weight_norm de-registers the reparam params
+    lin2 = nn.Linear(4, 4)
+    weight_norm(lin2)
+    remove_weight_norm(lin2)
+    names = dict(lin2.named_parameters())
+    assert "weight_v" not in names and "weight_g" not in names
+    # sparse dense-conv output chains into SubmConv (site-indexed COO)
+    import paddle2_tpu.sparse as sp
+    import paddle2_tpu.sparse.nn as snn
+    idx = np.array([[0, 0], [1, 2], [1, 3]])
+    x = sp.sparse_coo_tensor(paddle.to_tensor(idx),
+                             paddle.to_tensor(np.random.RandomState(2)
+                                              .randn(2, 3)
+                                              .astype(np.float32)),
+                             (1, 4, 4, 3))
+    y = snn.Conv2D(3, 5, 3, padding=1)(x)
+    z = snn.SubmConv2D(5, 2, 3, padding=1)(y)   # must not corrupt
+    assert np.asarray(z.values().numpy()).shape[-1] == 2
+    # groups/dilation are honored (shape-level check)
+    g = snn.Conv2D(4, 4, 3, padding=2, dilation=2, groups=2)
+    xg = sp.sparse_coo_tensor(paddle.to_tensor(np.array([[0], [1], [1]])),
+                              paddle.to_tensor(np.ones((1, 4), np.float32)),
+                              (1, 4, 4, 4))
+    assert g(xg).shape[-1] == 4
+    # ColorJitter accepts (lo, hi) tuples; 4-element shear is honored
+    import paddle2_tpu.vision.transforms as T
+    img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype(np.uint8)
+    cj = T.ColorJitter(brightness=(0.5, 1.5), hue=(-0.1, 0.1))
+    assert cj._apply_image(img).shape == img.shape
+    ra = T.RandomAffine(0, shear=(0, 0, 30, 30))
+    out = ra._apply_image(img.astype(np.float32))
+    assert (out != img).any()       # y-shear actually applied
+    # Flowers validates label/image count at init
+    import tempfile, os
+    from PIL import Image
+    d = tempfile.mkdtemp()
+    for i in range(2):
+        Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(
+            os.path.join(d, f"im{i}.jpg"))
+    lab = os.path.join(d, "labels.txt")
+    open(lab, "w").write("1\n")
+    with pytest.raises(ValueError, match="one entry per jpg"):
+        paddle.vision.datasets.Flowers(data_file=d, label_file=lab)
