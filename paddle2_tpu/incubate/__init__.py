@@ -10,6 +10,7 @@ __all__ = ["MoELayer", "SwitchGate", "TopKGate", "moe", "distributed",
            "nn", "LookAhead", "ModelAverage"]
 from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import autograd  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from .graph_ops import (graph_khop_sampler, graph_reindex,  # noqa: F401
                         graph_sample_neighbors, graph_send_recv)

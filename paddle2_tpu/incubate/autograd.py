@@ -1,0 +1,37 @@
+"""paddle.incubate.autograd (reference incubate/autograd/): primitive-
+based functional autodiff. On this stack the "primitive system" IS jax's
+jaxpr tracing — forward-mode (jvp), reverse-mode (vjp), and the
+Jacobian/Hessian objects ride the same machinery as paddle.autograd;
+enable/disable_prim are accepted no-ops (XLA always composes from
+primitives)."""
+
+from ..autograd.functional import hessian as Hessian  # noqa: F401
+from ..autograd.functional import jacobian as Jacobian  # noqa: F401
+from ..autograd.functional import jvp, vjp  # noqa: F401
+from ..autograd import grad  # noqa: F401
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "forward_grad", "grad"]
+
+_PRIM = {"enabled": True}
+
+
+def enable_prim():
+    """No-op: every op already lowers through jaxpr primitives."""
+    _PRIM["enabled"] = True
+
+
+def disable_prim():
+    _PRIM["enabled"] = False
+
+
+def prim_enabled():
+    return _PRIM["enabled"]
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """incubate/autograd forward_grad is a PIR program-transform API;
+    the dygraph equivalent is jvp(func, xs, v)."""
+    raise NotImplementedError(
+        "forward_grad over already-built static programs is a PIR-pass "
+        "API; in dygraph use paddle.incubate.autograd.jvp(func, xs, v)")

@@ -13,3 +13,160 @@ from . import functional  # noqa
 
 __all__ = ["FusedMultiHeadAttention", "FusedLinear",
            "FusedTransformerEncoderLayer", "MoELayer", "functional"]
+
+
+from ...nn.layer.layers import Layer as _Layer
+
+
+class FusedDropoutAdd(_Layer):
+    """incubate/nn/layer/fused_dropout_add.py: dropout(x) + y."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return functional.fused_dropout_add(x, y, p=self.p,
+                                            training=self.training,
+                                            mode=self.mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(_Layer):
+    """incubate/nn/layer/fused_transformer.py
+    FusedBiasDropoutResidualLayerNorm."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter([embed_dim],
+                                                 is_bias=True)
+        self.ln_scale = self.create_parameter([embed_dim])
+        import jax.numpy as _j
+        self.ln_scale._replace_data(_j.ones([embed_dim], _j.float32))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, x, residual):
+        return functional.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedFeedForward(_Layer):
+    """incubate/nn/layer/fused_transformer.py:534 FusedFeedForward."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        import jax.numpy as _j
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (act_dropout_rate
+                                 if act_dropout_rate is not None
+                                 else dropout_rate)
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr)
+        self.linear1_bias = self.create_parameter([dim_feedforward],
+                                                  is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr)
+        self.linear2_bias = self.create_parameter([d_model], is_bias=True)
+        self.ln1_scale = self.create_parameter([d_model])
+        self.ln1_scale._replace_data(_j.ones([d_model], _j.float32))
+        self.ln1_bias = self.create_parameter([d_model], is_bias=True)
+        self.ln2_scale = self.create_parameter([d_model])
+        self.ln2_scale._replace_data(_j.ones([d_model], _j.float32))
+        self.ln2_bias = self.create_parameter([d_model], is_bias=True)
+
+    def forward(self, src, cache=None):
+        return functional.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            self.linear1_bias, self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate,
+            activation=self.activation, pre_layer_norm=
+            self.normalize_before, ln1_epsilon=self.epsilon,
+            ln2_epsilon=self.epsilon, training=self.training)
+
+
+class FusedMultiTransformer(_Layer):
+    """incubate/nn/layer/fused_transformer.py:750 FusedMultiTransformer:
+    a pre-LN decoder stack stored as per-layer weight LISTS, executed
+    through functional.fused_multi_transformer."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, num_layers=1, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None, **kwargs):
+        super().__init__()
+        import jax.numpy as _j
+        if not normalize_before:
+            raise ValueError(
+                "FusedMultiTransformer is pre-LN only (reference "
+                "fused_transformer.py assert)")
+        head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        self.epsilon = epsilon
+        (self.ln_scales, self.ln_biases, self.qkv_weights,
+         self.qkv_biases, self.linear_weights, self.linear_biases,
+         self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+         self.ffn1_biases, self.ffn2_weights, self.ffn2_biases) = \
+            ([] for _ in range(12))
+        for i in range(num_layers):
+            def mk(shape, bias=False, ones=False, tag=""):
+                p = self.create_parameter(shape, is_bias=bias)
+                if ones:
+                    p._replace_data(_j.ones(shape, _j.float32))
+                self.add_parameter(f"l{i}_{tag}", p)
+                return p
+            self.ln_scales.append(mk([embed_dim], ones=True,
+                                     tag="ln_scale"))
+            self.ln_biases.append(mk([embed_dim], bias=True,
+                                     tag="ln_bias"))
+            self.qkv_weights.append(mk([3, num_heads, head_dim,
+                                        embed_dim], tag="qkv_w"))
+            self.qkv_biases.append(mk([3, num_heads, head_dim],
+                                      bias=True, tag="qkv_b"))
+            self.linear_weights.append(mk([embed_dim, embed_dim],
+                                          tag="out_w"))
+            self.linear_biases.append(mk([embed_dim], bias=True,
+                                         tag="out_b"))
+            self.ffn_ln_scales.append(mk([embed_dim], ones=True,
+                                         tag="ffn_ln_scale"))
+            self.ffn_ln_biases.append(mk([embed_dim], bias=True,
+                                         tag="ffn_ln_bias"))
+            self.ffn1_weights.append(mk([embed_dim, dim_feedforward],
+                                        tag="ffn1_w"))
+            self.ffn1_biases.append(mk([dim_feedforward], bias=True,
+                                       tag="ffn1_b"))
+            self.ffn2_weights.append(mk([dim_feedforward, embed_dim],
+                                        tag="ffn2_w"))
+            self.ffn2_biases.append(mk([embed_dim], bias=True,
+                                       tag="ffn2_b"))
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        return functional.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            activation=self.activation, epsilon=self.epsilon,
+            training=self.training)
+
+
+__all__ += ["FusedDropoutAdd", "FusedBiasDropoutResidualLayerNorm",
+            "FusedFeedForward", "FusedMultiTransformer"]

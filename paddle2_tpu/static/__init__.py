@@ -356,13 +356,444 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input
 
 
 class nn:
-    """static.nn namespace: the fc/conv helpers map to dygraph layers."""
+    """static.nn namespace (reference python/paddle/static/nn/): the
+    block builders create parameters and dispatch the SAME ops eager
+    dispatch runs — under ``program_guard`` the recorder captures them,
+    so build-then-run works like the reference's layer helpers.
+    Sequence (LoD) ops raise: LoD tensors are replaced by ragged/packed
+    batches in this framework (see flash_attn_unpadded / varlen)."""
+
+    _name_counter = {}
+
+    @staticmethod
+    def _uname(base):
+        """Unique parameter names per builder call (the reference's
+        unique_name.generate) so name-based matching never collides."""
+        k = nn._name_counter.get(base, 0)
+        nn._name_counter[base] = k + 1
+        return f"{base}_{k}" if k else base
 
     @staticmethod
     def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from ..ops.dispatch import ensure_tensor
+        t = ensure_tensor(x)
+        import numpy as _np
+        in_f = int(_np.prod(t.shape[num_flatten_dims:]))
+        w = create_parameter([in_f, size], "float32",
+                             name=nn._uname(f"{name or 'fc'}_w"))
+        b = create_parameter([size], "float32", is_bias=True,
+                             name=nn._uname(f"{name or 'fc'}_b"))
+        from ..nn import functional as F
+        flat = t.reshape(list(t.shape[:num_flatten_dims]) + [in_f])
+        out = F.linear(flat, w, b)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def embedding(input, size, is_sparse=False, padding_idx=None,
+                  param_attr=None, dtype="float32"):
+        w = create_parameter(list(size), dtype, name=nn._uname("embedding_w"))
+        from ..nn import functional as F
+        return F.embedding(input, w, padding_idx=padding_idx)
+
+    @staticmethod
+    def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                         dtype="float32", **kwargs):
+        """PS-era sparse table embedding: the TPU path is the sharded
+        dense table (distributed.ps.SparseTable decision record)."""
+        return nn.embedding(input, size, padding_idx=padding_idx,
+                            dtype=dtype)
+
+    @staticmethod
+    def _conv(x, num_filters, filter_size, nd, stride=1, padding=0,
+              dilation=1, groups=1, act=None, transpose=False,
+              name="conv", output_size=None):
+        from ..ops.dispatch import ensure_tensor
+        t = ensure_tensor(x)
+        cin = int(t.shape[1])
+        if filter_size is None:
+            if not (transpose and output_size is not None):
+                raise ValueError(
+                    f"{name}: filter_size is required (output_size can "
+                    "derive it only for transpose convs)")
+            # k = out - (in - 1) * stride + 2 * pad (reference derivation)
+            outs = ([output_size] * nd if isinstance(output_size, int)
+                    else list(output_size))[-nd:]
+            st_ = ([stride] * nd if isinstance(stride, int)
+                   else list(stride))
+            pd = ([padding] * nd if isinstance(padding, int)
+                  else list(padding))
+            filter_size = [int(outs[i] - (int(t.shape[2 + i]) - 1)
+                               * st_[i] + 2 * pd[i]) for i in range(nd)]
+        ks = ([filter_size] * nd if isinstance(filter_size, int)
+              else list(filter_size))
+        from ..nn import functional as F
+        if transpose:
+            w = create_parameter([cin, num_filters // groups] + ks,
+                                 "float32", name=nn._uname(f"{name}_w"))
+            fn = F.conv2d_transpose if nd == 2 else F.conv3d_transpose
+        else:
+            w = create_parameter([num_filters, cin // groups] + ks,
+                                 "float32", name=nn._uname(f"{name}_w"))
+            fn = F.conv2d if nd == 2 else F.conv3d
+        b = create_parameter([num_filters], "float32", is_bias=True,
+                             name=nn._uname(f"{name}_b"))
+        out = fn(t, w, b, stride=stride, padding=padding,
+                 dilation=dilation, groups=groups)
+        if act:
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
+    def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+               dilation=1, groups=1, param_attr=None, bias_attr=None,
+               act=None, name=None, **kw):
+        return nn._conv(input, num_filters, filter_size, 2, stride,
+                        padding, dilation, groups, act, name=name or
+                        "conv2d")
+
+    @staticmethod
+    def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+               dilation=1, groups=1, param_attr=None, bias_attr=None,
+               act=None, name=None, **kw):
+        return nn._conv(input, num_filters, filter_size, 3, stride,
+                        padding, dilation, groups, act, name=name or
+                        "conv3d")
+
+    @staticmethod
+    def conv2d_transpose(input, num_filters, filter_size=None,
+                         output_size=None, stride=1, padding=0,
+                         dilation=1, groups=1, param_attr=None,
+                         bias_attr=None, act=None, name=None, **kw):
+        return nn._conv(input, num_filters, filter_size, 2, stride,
+                        padding, dilation, groups, act, transpose=True,
+                        name=name or "conv2d_transpose",
+                        output_size=output_size)
+
+    @staticmethod
+    def conv3d_transpose(input, num_filters, filter_size=None,
+                         output_size=None, stride=1, padding=0,
+                         dilation=1, groups=1, param_attr=None,
+                         bias_attr=None, act=None, name=None, **kw):
+        return nn._conv(input, num_filters, filter_size, 3, stride,
+                        padding, dilation, groups, act, transpose=True,
+                        name=name or "conv3d_transpose",
+                        output_size=output_size)
+
+    @staticmethod
+    def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+                   param_attr=None, bias_attr=None, data_layout="NCHW",
+                   **kw):
+        from ..ops.dispatch import ensure_tensor
+        t = ensure_tensor(input)
+        c = int(t.shape[1])
+        import jax.numpy as _j
+        scale = create_parameter([c], "float32", name=nn._uname("bn_scale"))
+        scale._replace_data(_j.ones([c], _j.float32))
+        bias = create_parameter([c], "float32", is_bias=True,
+                                name=nn._uname("bn_bias"))
+        mean = create_global_var([c], 0.0, "float32", name=nn._uname("bn_mean"))
+        var = create_global_var([c], 1.0, "float32", name=nn._uname("bn_var"))
+        from ..nn import functional as F
+        out = F.batch_norm(t, mean, var, weight=scale, bias=bias,
+                           training=True, momentum=momentum,
+                           epsilon=epsilon)
+        if act:
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
+    def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+                   epsilon=1e-5, param_attr=None, bias_attr=None,
+                   act=None):
+        from ..ops.dispatch import ensure_tensor
+        import numpy as _np
+        t = ensure_tensor(input)
+        shape = [int(s) for s in t.shape[begin_norm_axis:]]
+        import jax.numpy as _j
+        w = create_parameter(shape, "float32", name=nn._uname("ln_scale"))
+        w._replace_data(_j.ones(shape, _j.float32))
+        b = create_parameter(shape, "float32", is_bias=True,
+                             name=nn._uname("ln_bias"))
+        from ..nn import functional as F
+        out = F.layer_norm(t, t.shape[begin_norm_axis:],
+                           weight=w if scale else None,
+                           bias=b if shift else None, epsilon=epsilon)
+        if act:
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
+    def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+                   bias_attr=None, act=None, data_layout="NCHW"):
+        from ..ops.dispatch import ensure_tensor
+        t = ensure_tensor(input)
+        c = int(t.shape[1])
+        import jax.numpy as _j
+        w = create_parameter([c], "float32", name=nn._uname("gn_scale"))
+        w._replace_data(_j.ones([c], _j.float32))
+        b = create_parameter([c], "float32", is_bias=True,
+                             name=nn._uname("gn_bias"))
+        from ..nn import functional as F
+        out = F.group_norm(t, groups, epsilon=epsilon, weight=w, bias=b)
+        if act:
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
+    def instance_norm(input, epsilon=1e-5, param_attr=None,
+                      bias_attr=None):
+        from ..ops.dispatch import ensure_tensor
+        t = ensure_tensor(input)
+        c = int(t.shape[1])
+        import jax.numpy as _j
+        w = create_parameter([c], "float32", name=nn._uname("in_scale"))
+        w._replace_data(_j.ones([c], _j.float32))
+        b = create_parameter([c], "float32", is_bias=True,
+                             name=nn._uname("in_bias"))
+        from ..nn import functional as F
+        return F.instance_norm(t, weight=w, bias=b, eps=epsilon)
+
+    @staticmethod
+    def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+                  **kw):
+        """data_norm: normalization by accumulated batch statistics —
+        the stateless equivalent normalizes by the CURRENT batch."""
+        from ..ops.dispatch import ensure_tensor, apply_op
+        import jax.numpy as _j
+        t = ensure_tensor(input)
+
+        def fn(a):
+            mu = _j.mean(a, axis=0, keepdims=True)
+            var = _j.var(a, axis=0, keepdims=True)
+            return (a - mu) / _j.sqrt(var + epsilon)
+        out = apply_op("data_norm", fn, (t,), {})
+        if act:
+            from ..nn import functional as F
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
+    def prelu(x, mode="all", param_attr=None, data_format="NCHW",
+              name=None):
+        from ..ops.dispatch import ensure_tensor
+        t = ensure_tensor(x)
+        n = (1 if mode == "all" else int(t.shape[1]))
+        import jax.numpy as _j
+        alpha = create_parameter([n], "float32", name=nn._uname("prelu_alpha"))
+        alpha._replace_data(_j.full([n], 0.25, _j.float32))
+        from ..nn import functional as F
+        return F.prelu(t, alpha)
+
+    @staticmethod
+    def deform_conv2d(x, offset, mask, num_filters, filter_size,
+                      stride=1, padding=0, dilation=1, groups=1,
+                      deformable_groups=1, im2col_step=1,
+                      param_attr=None, bias_attr=None, name=None):
+        from ..ops.dispatch import ensure_tensor
+        from ..vision.ops import deform_conv2d as _dc
+        t = ensure_tensor(x)
+        cin = int(t.shape[1])
+        ks = ([filter_size] * 2 if isinstance(filter_size, int)
+              else list(filter_size))
+        w = create_parameter([num_filters, cin // groups] + ks,
+                             "float32", name=nn._uname("deform_w"))
+        b = create_parameter([num_filters], "float32", is_bias=True,
+                             name=nn._uname("deform_b"))
+        return _dc(t, offset, w, b, stride=stride, padding=padding,
+                   dilation=dilation, deformable_groups=deformable_groups,
+                   groups=groups, mask=mask)
+
+    @staticmethod
+    def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                                bias_attr=None, name=None):
+        from ..ops.dispatch import apply_op, ensure_tensor
+        import jax.numpy as _j
+        xt, yt = ensure_tensor(x), ensure_tensor(y)
+        dx, dy = int(xt.shape[-1]), int(yt.shape[-1])
+        w = create_parameter([size, dx, dy], "float32", name=nn._uname("btp_w"))
+        b = create_parameter([size], "float32", is_bias=True,
+                             name=nn._uname("btp_b"))
+
+        def fn(a, c, wv, bv):
+            return _j.einsum("bi,kij,bj->bk", a, wv, c) + bv
+        out = apply_op("bilinear_tensor_product", fn, (xt, yt, w, b), {})
+        if act:
+            from ..nn import functional as F
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
+    def nce(input, label, num_total_classes, sample_weight=None,
+            param_attr=None, bias_attr=None, num_neg_samples=None,
+            name=None, sampler="uniform", custom_dist=None, seed=0,
+            is_sparse=False):
+        """Noise-contrastive estimation loss over a learned class
+        matrix (static/nn/common.py nce): log-sigmoid positive + k
+        uniform negatives."""
+        from ..ops.dispatch import apply_op, ensure_tensor
+        import jax
+        import jax.numpy as _j
+        from ..framework import random as fr
+        xt = ensure_tensor(input)
+        lt = ensure_tensor(label)
+        d = int(xt.shape[-1])
+        k = num_neg_samples or 10
+        w = create_parameter([num_total_classes, d], "float32",
+                             name=nn._uname("nce_w"))
+        b = create_parameter([num_total_classes], "float32",
+                             is_bias=True, name=nn._uname("nce_b"))
+        key = fr.next_key()
+
+        def fn(a, y, wv, bv):
+            y = y.reshape(-1).astype(_j.int32)
+            pos = _j.einsum("bd,bd->b", a, wv[y]) + bv[y]
+            neg_ids = jax.random.randint(key, (a.shape[0], k), 0,
+                                         num_total_classes)
+            neg = _j.einsum("bd,bkd->bk", a, wv[neg_ids]) + bv[neg_ids]
+            loss = (-jax.nn.log_sigmoid(pos)
+                    - _j.sum(jax.nn.log_sigmoid(-neg), axis=1))
+            return loss[:, None]
+        return apply_op("nce", fn, (xt, lt, w, b), {})
+
+    @staticmethod
+    def row_conv(input, future_context_size, param_attr=None, act=None):
+        """row_conv (lookahead conv, static/nn/common.py): each step t
+        mixes steps t..t+k with a per-feature learned window."""
+        from ..ops.dispatch import apply_op, ensure_tensor
+        import jax.numpy as _j
+        t = ensure_tensor(input)              # [B, T, D]
+        d = int(t.shape[-1])
+        k = future_context_size + 1
+        w = create_parameter([k, d], "float32", name=nn._uname("row_conv_w"))
+
+        def fn(a, wv):
+            outs = 0.0
+            for i in range(k):
+                shifted = _j.concatenate(
+                    [a[:, i:], _j.zeros_like(a[:, :i])], axis=1)
+                outs = outs + shifted * wv[i]
+            return outs
+        out = apply_op("row_conv", fn, (t, w), {})
+        if act:
+            from ..nn import functional as F
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
+    def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12,
+                      name=None):
+        from ..ops.dispatch import apply_op, ensure_tensor
+        import jax.numpy as _j
+        import numpy as _np
+        w = ensure_tensor(weight)
+
+        def fn(wv):
+            m = _j.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+            u = _j.asarray(_np.random.RandomState(0)
+                           .randn(m.shape[0]).astype(_np.float32))
+            u = u / _j.linalg.norm(u)
+            v = m.T @ u
+            v = v / _j.maximum(_j.linalg.norm(v), eps)
+            for _ in range(power_iters):
+                u = m @ v
+                u = u / _j.maximum(_j.linalg.norm(u), eps)
+                v = m.T @ u
+                v = v / _j.maximum(_j.linalg.norm(v), eps)
+            sigma = u @ (m @ v)
+            return wv / sigma
+        return apply_op("static_spectral_norm", fn, (w,), {})
+
+    # -- control flow (host-evaluated: the recorded program replays the
+    #    branch taken at BUILD time; data-dependent control flow at run
+    #    time is jit.to_static's graph-break territory) --
+    @staticmethod
+    def cond(pred, true_fn=None, false_fn=None, name=None,
+             return_names=None):
+        from ..ops.dispatch import ensure_tensor
+        import numpy as _np
+        p = bool(_np.asarray(ensure_tensor(pred).numpy()).reshape(-1)[0])
+        if p:
+            return true_fn() if true_fn is not None else None
+        return false_fn() if false_fn is not None else None
+
+    @staticmethod
+    def case(pred_fn_pairs, default=None, name=None):
+        from ..ops.dispatch import ensure_tensor
+        import numpy as _np
+        for pred, fn in pred_fn_pairs:
+            if bool(_np.asarray(ensure_tensor(pred).numpy())
+                    .reshape(-1)[0]):
+                return fn()
+        return default() if default is not None else None
+
+    @staticmethod
+    def switch_case(branch_index, branch_fns, default=None, name=None):
+        from ..ops.dispatch import ensure_tensor
+        import numpy as _np
+        idx = int(_np.asarray(ensure_tensor(branch_index).numpy())
+                  .reshape(-1)[0])
+        fns = dict(branch_fns) if not isinstance(branch_fns, dict)             else branch_fns
+        if idx in fns:
+            return fns[idx]()
+        return default() if default is not None else None
+
+    @staticmethod
+    def while_loop(cond, body, loop_vars, is_test=False, name=None):
+        from ..ops.dispatch import ensure_tensor
+        import numpy as _np
+        vars_ = list(loop_vars)
+        while bool(_np.asarray(ensure_tensor(cond(*vars_)).numpy())
+                   .reshape(-1)[0]):
+            out = body(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_
+
+    @staticmethod
+    def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+        """static_pylayer -> the dygraph PyLayer covers custom-grad
+        blocks; inputs run through forward_fn directly."""
+        return forward_fn(*inputs)
+
+    @staticmethod
+    def py_func(func, x, out, backward_func=None,
+                skip_vars_in_backward_input=None):
+        return py_func(func, x, out, backward_func,
+                       skip_vars_in_backward_input)
+
+    # -- LoD sequence ops: no LoD tensors on this stack --
+    @staticmethod
+    def _no_lod(op):
         raise NotImplementedError(
-            "static.nn.fc: build models with paddle.nn.Linear — the static "
-            "block builder has no TPU counterpart")
+            f"static.nn.{op}: LoD (level-of-detail) sequence tensors are "
+            "replaced by padded/packed ragged batches here — use "
+            "nn.functional flash_attn_unpadded / pack by cu_seqlens "
+            "(decision: ragged varlen path, README)")
+
+    @staticmethod
+    def sequence_conv(*a, **k):
+        nn._no_lod("sequence_conv")
+
+    @staticmethod
+    def sequence_pool(*a, **k):
+        nn._no_lod("sequence_pool")
+
+    @staticmethod
+    def sequence_softmax(*a, **k):
+        nn._no_lod("sequence_softmax")
+
+    @staticmethod
+    def sequence_expand(*a, **k):
+        nn._no_lod("sequence_expand")
+
+    @staticmethod
+    def sequence_first_step(*a, **k):
+        nn._no_lod("sequence_first_step")
+
+    @staticmethod
+    def sequence_last_step(*a, **k):
+        nn._no_lod("sequence_last_step")
 
 
 class amp:

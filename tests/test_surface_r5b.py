@@ -445,3 +445,158 @@ def test_review_regressions_r5c():
     open(lab, "w").write("1\n")
     with pytest.raises(ValueError, match="one entry per jpg"):
         paddle.vision.datasets.Flowers(data_file=d, label_file=lab)
+
+
+def test_incubate_fused_functional_math():
+    import paddle2_tpu.incubate.nn.functional as FF
+    rng = np.random.RandomState(0)
+    # swiglu single-input splits; fused LN matches manual
+    y = FF.swiglu(paddle.to_tensor(rng.randn(2, 8).astype(np.float32)))
+    assert tuple(y.shape) == (2, 4)
+    x = paddle.to_tensor(rng.randn(2, 4, 8).astype(np.float32))
+    w = paddle.to_tensor(np.ones(8, np.float32))
+    b = paddle.to_tensor(np.zeros(8, np.float32))
+    out = FF.fused_layer_norm(x, w, b, begin_norm_axis=2)
+    a = np.asarray(x.numpy())
+    mu = a.mean(-1, keepdims=True)
+    var = a.var(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy(), (a - mu) / np.sqrt(var + 1e-5),
+                               rtol=1e-4, atol=1e-4)
+    # residual form returns (out, residual_out)
+    r = paddle.to_tensor(rng.randn(2, 4, 8).astype(np.float32))
+    o2, res = FF.fused_layer_norm(x, w, b, begin_norm_axis=2, residual=r)
+    np.testing.assert_allclose(res.numpy(), a + np.asarray(r.numpy()),
+                               rtol=1e-5)
+    # fused MHA runs; MultiTransformer stack finite
+    qkvw = paddle.to_tensor(rng.randn(3, 2, 4, 8).astype(np.float32) * .1)
+    lw = paddle.to_tensor(rng.randn(8, 8).astype(np.float32) * 0.1)
+    o = FF.fused_multi_head_attention(x, qkvw, lw, pre_layer_norm=True,
+                                      pre_ln_scale=w, pre_ln_bias=b,
+                                      dropout_rate=0.0,
+                                      attn_dropout_rate=0.0,
+                                      training=False)
+    assert tuple(o.shape) == (2, 4, 8)
+    import paddle2_tpu.incubate.nn as inn
+    mt = inn.FusedMultiTransformer(8, 2, 16, num_layers=2)
+    mt.eval()
+    assert np.isfinite(mt(x).numpy()).all()
+    with pytest.raises(NotImplementedError, match="MoELayer"):
+        FF.fused_moe(x, None, None, None)
+
+
+def test_static_nn_builders():
+    import paddle2_tpu.static as st
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 6).astype(np.float32))
+    y = st.nn.fc(x, 4, activation="relu")
+    assert tuple(y.shape) == (2, 4) and (y.numpy() >= 0).all()
+    img = paddle.to_tensor(rng.randn(1, 3, 8, 8).astype(np.float32))
+    c = st.nn.conv2d(img, 6, 3, padding=1)
+    assert tuple(c.shape) == (1, 6, 8, 8)
+    assert tuple(st.nn.group_norm(c, 2).shape) == (1, 6, 8, 8)
+    e = st.nn.embedding(paddle.to_tensor(np.array([[1, 2]])), (10, 4))
+    assert tuple(e.shape) == (1, 2, 4)
+    assert tuple(st.nn.bilinear_tensor_product(x, x, 3).shape) == (2, 3)
+    # control flow evaluates the taken branch
+    r = st.nn.cond(paddle.to_tensor(np.array([False])),
+                   lambda: paddle.to_tensor(np.array([1.0])),
+                   lambda: paddle.to_tensor(np.array([2.0])))
+    assert float(r.numpy()[0]) == 2.0
+    v = st.nn.while_loop(lambda t: t < 3, lambda t: t + 1,
+                         [paddle.to_tensor(np.array([0.0]))])
+    assert float(v[0].numpy()[0]) == 3.0
+    with pytest.raises(NotImplementedError, match="LoD"):
+        st.nn.sequence_pool(None)
+    # fc under program_guard records and replays
+    prog = st.Program()
+    with st.program_guard(prog):
+        ph = st.data("x", [2, 6], "float32")
+        out = st.nn.fc(ph, 3)
+    exe = st.Executor()
+    res = exe.run(prog, feed={"x": rng.randn(2, 6).astype(np.float32)},
+                  fetch_list=[out])
+    assert res[0].shape == (2, 3)
+
+
+def test_incubate_autograd_namespace():
+    import paddle2_tpu.incubate as inc
+    assert inc.autograd.prim_enabled()
+    inc.autograd.disable_prim()
+    assert not inc.autograd.prim_enabled()
+    inc.autograd.enable_prim()
+    out, jv = inc.autograd.jvp(
+        lambda t: t * t,
+        paddle.to_tensor(np.array([3.0], np.float32)),
+        paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(jv.numpy(), [6.0], rtol=1e-5)
+
+
+def test_review_regressions_r5d():
+    import paddle2_tpu.static as st
+    import paddle2_tpu.incubate.nn.functional as FF
+    rng = np.random.RandomState(0)
+    # layer_norm handles multi-dim normalized shape
+    x3 = paddle.to_tensor(rng.randn(2, 3, 4).astype(np.float32))
+    ln = st.nn.layer_norm(x3)     # begin_norm_axis=1 over (3, 4)
+    a = np.asarray(x3.numpy())
+    mu = a.reshape(2, -1).mean(1).reshape(2, 1, 1)
+    sd = a.reshape(2, -1).std(1).reshape(2, 1, 1)
+    np.testing.assert_allclose(ln.numpy(), (a - mu) / sd, rtol=1e-3,
+                               atol=1e-3)
+    # conv2d_transpose derives filter_size from output_size
+    img = paddle.to_tensor(rng.randn(1, 3, 8, 8).astype(np.float32))
+    up = st.nn.conv2d_transpose(img, 4, output_size=[16, 16], stride=2)
+    assert tuple(up.shape)[2:] == (16, 16)
+    # unique builder param names
+    st.nn._name_counter.clear()
+    x = paddle.to_tensor(rng.randn(2, 6).astype(np.float32))
+    prog = st.Program()
+    with st.program_guard(prog):
+        ph = st.data("x", [2, 6], "float32")
+        a1 = st.nn.fc(ph, 4)
+        a2 = st.nn.fc(a1, 4)
+    names = [getattr(t, "name", "") for t in prog._live.values()
+             if getattr(t, "stop_gradient", True) is False
+             and getattr(t, "name", "")]   # params only (not activations)
+    assert len(names) == len(set(names)), names
+    # fused_bias_dropout_residual_layer_norm works with defaults
+    h = paddle.to_tensor(rng.randn(2, 4, 8).astype(np.float32))
+    r = paddle.to_tensor(rng.randn(2, 4, 8).astype(np.float32))
+    out = FF.fused_bias_dropout_residual_layer_norm(h, r, training=False)
+    assert np.isfinite(np.asarray(out[0].numpy()
+                                  if isinstance(out, tuple)
+                                  else out.numpy())).all()
+    # varlen attention applies the additive mask
+    q = paddle.to_tensor(rng.randn(1, 1, 4, 8).astype(np.float32))
+    m0 = FF.variable_length_memory_efficient_attention(
+        q, q, q, paddle.to_tensor(np.array([4])),
+        paddle.to_tensor(np.array([4])))
+    big = np.zeros((1, 1, 4, 4), np.float32)
+    big[..., 0] = 100.0            # force all attention onto key 0
+    m1 = FF.variable_length_memory_efficient_attention(
+        q, q, q, paddle.to_tensor(np.array([4])),
+        paddle.to_tensor(np.array([4])), mask=paddle.to_tensor(big))
+    assert not np.allclose(m0.numpy(), m1.numpy())
+    np.testing.assert_allclose(m1.numpy()[0, 0, 1],
+                               np.asarray(q.numpy())[0, 0, 0], atol=1e-3)
+    # cache_kv raises loudly
+    with pytest.raises(NotImplementedError, match="cache"):
+        FF.fused_multi_head_attention(
+            paddle.to_tensor(rng.randn(1, 2, 8).astype(np.float32)),
+            paddle.to_tensor(rng.randn(3, 2, 4, 8).astype(np.float32)),
+            paddle.to_tensor(rng.randn(8, 8).astype(np.float32)),
+            cache_kv=paddle.zeros([2]))
+    # trans_qkvw=False layout accepted
+    w_alt = paddle.to_tensor(rng.randn(8, 3, 2, 4).astype(np.float32)
+                             * 0.1)
+    lw = paddle.to_tensor(rng.randn(8, 8).astype(np.float32) * 0.1)
+    ones = paddle.to_tensor(np.ones(8, np.float32))
+    zeros = paddle.to_tensor(np.zeros(8, np.float32))
+    h8 = paddle.to_tensor(rng.randn(1, 3, 8).astype(np.float32))
+    out_alt = FF.fused_multi_transformer(
+        h8, [ones], [zeros], [w_alt], None, [lw], None, [ones], [zeros],
+        [paddle.to_tensor(rng.randn(8, 16).astype(np.float32) * 0.1)],
+        None,
+        [paddle.to_tensor(rng.randn(16, 8).astype(np.float32) * 0.1)],
+        None, trans_qkvw=False, training=False)
+    assert np.isfinite(out_alt.numpy()).all()
