@@ -31,6 +31,9 @@ from .sep import ring_attention, ulysses_attention  # noqa: F401
 from .utils import get_logger  # noqa: F401
 from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import fault_tolerance  # noqa: F401
+from .fault_tolerance import (CheckpointManager, PreemptionGuard,  # noqa: F401
+                              ReliableStep, retry_with_backoff)
 from . import auto_tuner  # noqa: F401
 from . import ps  # noqa: F401
 from . import communication  # noqa: F401
@@ -66,4 +69,7 @@ __all__ = [
     "unshard_dtensor", "dtensor_from_fn", "dtensor_from_local",
     "shard_dataloader", "ShardDataloader", "Strategy", "to_static",
     "DistModel", "AutoTuner",
+    # fault tolerance (detect->recover loop)
+    "fault_tolerance", "CheckpointManager", "PreemptionGuard",
+    "ReliableStep", "retry_with_backoff",
 ]

@@ -17,16 +17,29 @@ across mesh shapes falls out of that for free.
 
 from __future__ import annotations
 
+import atexit
 import os
 import pickle
+import sys
 import threading
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..env import get_rank, get_world_size
+from ...framework.io_state import (CheckpointCorruptionError,
+                                   Crc32Writer as _Crc32Writer,
+                                   verified_unpickle as _verified_unpickle)
 
 _METADATA = "0.metadata"
+
+
+def _chaos():
+    """Chaos-injection hooks (lazy: fault_tolerance imports this
+    package, so a top-level import would be circular)."""
+    from ..fault_tolerance import chaos
+    return chaos
 
 # pending async saves: a new save (sync or async) or a load first drains
 # EVERY previous in-flight save — global, not per-path, so that in a
@@ -63,16 +76,22 @@ class AsyncSaveHandle:
             raise self._error
 
 
-def _drain_pending(path: str) -> None:
+def _drain_pending(path: str, report: bool = False) -> None:
     """Serialize on EVERY in-flight async save (any path — see registry
     comment). A previous save's FAILURE belongs to its own handle
     (surfaced by its wait()) — it must not poison the next save/load,
-    which proceeds against whatever checkpoint is committed."""
+    which proceeds against whatever checkpoint is committed.
+    ``report=True`` (the atexit path, where no wait() will ever run)
+    prints any unobserved writer error to stderr instead."""
     with _ASYNC_LOCK:
-        prev = list(_ASYNC_PENDING.values())
+        prev = list(_ASYNC_PENDING.items())
         _ASYNC_PENDING.clear()
-    for h in prev:
+    for pth, h in prev:
         h._thread.join()
+        if report and h._error is not None:
+            print(f"[distributed.checkpoint] async save to {pth!r} "
+                  f"failed during interpreter exit: {h._error!r}",
+                  file=sys.stderr)
 
 
 def _parse_shard_name(fname: str):
@@ -142,7 +161,8 @@ def _snapshot(state_dict, rank: int, data_file: str):
     that lets step N+1 overlap the write of step N's checkpoint."""
     flat = flatten_state_dict(state_dict)
     meta: Dict[str, Any] = {"tensors": {}, "scalars": {},
-                            "files": [os.path.basename(data_file)]}
+                            "files": [os.path.basename(data_file)],
+                            "file_checksums": {}}
     data: Dict[Tuple[str, Tuple], np.ndarray] = {}
     for key, leaf in flat.items():
         arr = _leaf_array(leaf)
@@ -181,7 +201,9 @@ def _write_side_meta(path: str, uid: int, rank: int, meta) -> None:
     side = os.path.join(path, f"shards_{uid}_{rank}.pkl")
     with open(side + ".tmp", "wb") as f:
         pickle.dump({"tensors": meta["tensors"],
-                     "scalars": meta["scalars"]}, f, protocol=4)
+                     "scalars": meta["scalars"],
+                     "file_checksums": meta.get("file_checksums", {})},
+                    f, protocol=4)
     os.replace(side + ".tmp", side)
 
 
@@ -190,7 +212,7 @@ def _bounds_overlap(a, b) -> bool:
                for (lo1, hi1), (lo2, hi2) in zip(a, b))
 
 
-def _merge_side_meta(tensors, scalars, side) -> None:
+def _merge_side_meta(tensors, scalars, side, checksums=None) -> None:
     """Merge one sidecar's tensors/scalars into the global metadata.
     Scalars: first writer wins — callers merge NEWEST sidecar first.
     Tensors: skip entries whose global_shape disagrees with the committed
@@ -200,6 +222,9 @@ def _merge_side_meta(tensors, scalars, side) -> None:
     multi-rank shards are disjoint or identical)."""
     for key, val in side.get("scalars", {}).items():
         scalars.setdefault(key, val)
+    if checksums is not None:
+        for fname, ck in side.get("file_checksums", {}).items():
+            checksums.setdefault(fname, ck)
     for key, info in side.get("tensors", {}).items():
         if key not in tensors:
             tensors[key] = dict(info, shards=list(info["shards"]))
@@ -233,9 +258,19 @@ def _write_phase(path: str, meta, data, data_file: str, rank: int,
     narrowing and the post-commit sweep is skipped, so load falls back to
     merging every ``data_*.pkl`` — other ranks' shards are never deleted
     out from under them."""
+    # stream the pickle to disk through a CRC-tracking writer (no full
+    # in-memory copy of a potentially multi-GB shard); the recorded
+    # CRC32/size describe exactly what verification will re-read. The
+    # chaos hook mutates the WRITTEN file, after the checksum is taken —
+    # that is the point: an injected corruption must be caught by
+    # verify/load.
     tmp = data_file + ".tmp"
     with open(tmp, "wb") as f:
-        pickle.dump(data, f, protocol=4)
+        w = _Crc32Writer(f)
+        pickle.dump(data, w, protocol=4)
+    meta.setdefault("file_checksums", {})[os.path.basename(data_file)] = {
+        "crc32": w.crc & 0xFFFFFFFF, "size": w.size}
+    _chaos().mutate_shard_file(tmp)
     os.replace(tmp, data_file)
     if legacy_merge:
         # barrier-free sidecar: load merges these so tensor/scalar keys
@@ -257,6 +292,7 @@ def _write_phase(path: str, meta, data, data_file: str, rank: int,
             meta = dict(meta)
             meta.pop("files", None)      # load merges every data_*.pkl
             meta["uid"] = uid            # lets load order it vs sidecars
+            _chaos().maybe_fail_commit(path)
             mtmp = os.path.join(path, _METADATA + ".tmp")
             with open(mtmp, "wb") as f:
                 pickle.dump(meta, f, protocol=4)
@@ -279,16 +315,20 @@ def _write_phase(path: str, meta, data, data_file: str, rank: int,
             merged = {k: dict(v, shards=list(v["shards"]))
                       for k, v in meta["tensors"].items()}
             merged_scalars = dict(meta["scalars"])
+            merged_cksums = dict(meta.get("file_checksums", {}))
             for fname in sorted(os.listdir(path)):
                 if not (fname.startswith(f"shards_{uid}_")
                         and fname.endswith(".pkl")):
                     continue
                 with open(os.path.join(path, fname), "rb") as f:
                     side_meta = pickle.load(f)
-                _merge_side_meta(merged, merged_scalars, side_meta)
+                _merge_side_meta(merged, merged_scalars, side_meta,
+                                 merged_cksums)
             meta["tensors"] = merged
             meta["scalars"] = merged_scalars
+            meta["file_checksums"] = merged_cksums
     if rank == coordinator_rank:
+        _chaos().maybe_fail_commit(path)
         mtmp = os.path.join(path, _METADATA + ".tmp")
         with open(mtmp, "wb") as f:
             pickle.dump(meta, f, protocol=4)
@@ -302,6 +342,103 @@ def _write_phase(path: str, meta, data, data_file: str, rank: int,
     if multi:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("ckpt_committed")
+
+
+def _read_shard_file(path: str, fname: str, checksum: Optional[dict],
+                     verify_only: bool = False):
+    """Integrity-check (and unless ``verify_only``, load) one shard
+    file. ``checksum`` is the recorded {crc32, size} (None for
+    pre-integrity checkpoints — those are still guarded against
+    truncation by the unpickle readability check). The CRC pass streams
+    in chunks and ``verify_only`` with a matching checksum skips the
+    unpickle entirely, so verification never materializes tensors."""
+    full = os.path.join(path, fname)
+    if checksum is not None and verify_only:
+        # chunked CRC pass only — never touches the pickle layer
+        crc = 0
+        size = 0
+        with open(full, "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                crc = zlib.crc32(chunk, crc)
+                size += len(chunk)
+        _check_shard_sums(fname, crc, size, checksum)
+        return None
+    try:
+        with open(full, "rb") as f:
+            if checksum is None:
+                return pickle.load(f)     # pre-integrity file
+            # single pass: CRC the bytes AS pickle consumes them; the
+            # verdict lands at EOF before the result is trusted
+            return _verified_unpickle(f, checksum["crc32"],
+                                      checksum["size"],
+                                      f"checkpoint shard {fname!r}")
+    except (FileNotFoundError, CheckpointCorruptionError):
+        raise
+    except Exception as e:
+        raise CheckpointCorruptionError(
+            f"checkpoint shard {fname!r} unreadable: {e}") from e
+
+
+def _check_shard_sums(fname: str, crc: int, size: int, checksum: dict):
+    if size != checksum["size"]:
+        raise CheckpointCorruptionError(
+            f"checkpoint shard {fname!r} truncated: {size} bytes "
+            f"on disk, metadata recorded {checksum['size']}")
+    if crc & 0xFFFFFFFF != checksum["crc32"]:
+        raise CheckpointCorruptionError(
+            f"checkpoint shard {fname!r} corrupt: crc32 "
+            f"{crc & 0xFFFFFFFF:#010x} != recorded "
+            f"{checksum['crc32']:#010x}")
+
+
+def verify_checkpoint(path: str) -> None:
+    """Integrity-check a committed checkpoint WITHOUT materializing any
+    tensors: the metadata must load, and every shard file it names must
+    exist with the recorded byte size and CRC32 (pre-integrity files
+    fall back to an unpickle readability check). Raises
+    :class:`CheckpointCorruptionError` (or ValueError for a missing
+    metadata) — the :class:`~..fault_tolerance.CheckpointManager` uses
+    this as the gate before committing its ``latest`` pointer and when
+    deciding how far to roll back."""
+    mpath = os.path.join(path, _METADATA)
+    if not os.path.exists(mpath):
+        raise ValueError(f"checkpoint metadata not found: {mpath}")
+    try:
+        with open(mpath, "rb") as f:
+            meta = pickle.load(f)
+    except Exception as e:
+        raise CheckpointCorruptionError(
+            f"checkpoint metadata {mpath!r} unreadable: {e}") from e
+    checksums = dict(meta.get("file_checksums", {}))
+    files = meta.get("files")
+    if files is None:     # legacy merge-all layout: sidecars carry sums
+        files = [f for f in os.listdir(path)
+                 if f.startswith("data_") and f.endswith(".pkl")]
+        for fname in os.listdir(path):
+            if fname.startswith("shards_") and fname.endswith(".pkl"):
+                try:
+                    with open(os.path.join(path, fname), "rb") as f:
+                        side = pickle.load(f)
+                    for k, v in side.get("file_checksums", {}).items():
+                        checksums.setdefault(k, v)
+                except (OSError, pickle.PickleError):
+                    continue
+    for fname in files:
+        _read_shard_file(path, fname, checksums.get(fname),
+                         verify_only=True)
+
+
+def _drain_at_exit() -> None:
+    """atexit hook: a clean interpreter exit must not lose an in-flight
+    async save — join every pending writer so its commit lands, and
+    surface (print) any writer error that no wait() ever observed."""
+    _drain_pending("", report=True)
+
+
+atexit.register(_drain_at_exit)
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
@@ -437,7 +574,8 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
             meta_uid = float("inf")
         sources = [((meta_uid, -1, ""),
                     {"tensors": meta["tensors"],
-                     "scalars": meta["scalars"]})]
+                     "scalars": meta["scalars"],
+                     "file_checksums": meta.get("file_checksums", {})})]
         for fname in (f for f in os.listdir(path)
                       if f.startswith("shards_") and f.endswith(".pkl")):
             try:
@@ -447,14 +585,17 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
                 continue
         tensors: Dict[str, Any] = {}
         scalars: Dict[str, Any] = {}
+        cksums: Dict[str, Any] = {}
         for _, side in sorted(sources, key=lambda t: t[0], reverse=True):
-            _merge_side_meta(tensors, scalars, side)
+            _merge_side_meta(tensors, scalars, side, cksums)
         meta["tensors"], meta["scalars"] = tensors, scalars
+        meta["file_checksums"] = cksums
     data: Dict[Tuple[str, Tuple], np.ndarray] = {}
+    checksums = meta.get("file_checksums", {})
     for fname in files:
         try:
-            with open(os.path.join(path, fname), "rb") as f:
-                data.update(pickle.load(f))
+            data.update(_read_shard_file(path, fname,
+                                         checksums.get(fname)))
         except FileNotFoundError:
             if not legacy:
                 raise      # a concurrent legacy-mode save swept it
@@ -515,4 +656,5 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
 
 
 __all__ = ["save_state_dict", "load_state_dict", "flatten_state_dict",
-           "AsyncSaveHandle"]
+           "AsyncSaveHandle", "verify_checkpoint",
+           "CheckpointCorruptionError"]

@@ -23,7 +23,12 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5: lives under experimental
+    from jax.experimental.shard_map import shard_map
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = shard_map  # old-jax shim for jax.shard_map callers
 from jax.sharding import PartitionSpec
 
 from ..framework.tensor import Tensor

@@ -1,0 +1,40 @@
+"""paddle.distributed.fault_tolerance — the detect->recover loop.
+
+The reference stack detects failures (comm_task_manager watchdogs,
+elastic heartbeats, launcher gang supervision); this subsystem closes
+the loop with RECOVERY across four layers:
+
+1. **Checkpoint integrity & rollback** — per-shard CRC32/size in the
+   checkpoint metadata (``distributed.checkpoint``), verified on load;
+   :class:`CheckpointManager` keeps the last K checkpoints behind a
+   ``latest`` pointer committed only after verification and rolls back
+   to the newest verified one when a shard is corrupt or truncated.
+2. **Preemption-safe training** — :class:`PreemptionGuard` turns
+   SIGTERM into a step-boundary checkpoint-then-exit (wired into
+   ``hapi.Model.fit``; the launcher forwards the signal and extends its
+   kill grace while a save is in flight).
+3. **In-job retry** — :class:`ReliableStep` snapshots model/optimizer
+   state to host memory and replays a transiently-failed step
+   (NaN/Inf loss, watchdog timeout, injected fault) with exponential
+   backoff; :func:`retry_with_backoff` is the shared policy also used
+   by the elastic store IO and launch-master polling.
+4. **Chaos harness** — :mod:`.chaos`, a deterministic flag-controlled
+   fault injector (``FLAGS_chaos``) the test suite and
+   ``bench.py --inject-fault`` drive end-to-end.
+"""
+
+from . import chaos  # noqa: F401
+from .manager import CheckpointManager, CheckpointVerificationError
+from .preemption import MARKER_ENV, PreemptionGuard, preempted
+from .reliable import (ReliableStep, RetryBudgetExceededError,
+                       TransientStepError)
+from .retry import backoff_delays, retry_with_backoff
+from ...framework.io_state import CheckpointCorruptionError  # noqa: F401
+
+__all__ = [
+    "CheckpointManager", "CheckpointVerificationError",
+    "CheckpointCorruptionError", "PreemptionGuard", "preempted",
+    "MARKER_ENV", "ReliableStep", "TransientStepError",
+    "RetryBudgetExceededError", "retry_with_backoff", "backoff_delays",
+    "chaos",
+]

@@ -1,0 +1,177 @@
+"""Deterministic chaos-injection harness.
+
+Flag-controlled fault injector that the fault-tolerance test suite (and
+``bench.py --inject-fault``) drives end-to-end: inject -> detect ->
+recover -> training converges anyway. Faults fire on an exact Nth
+occurrence per kind, so a failing chaos run replays bit-identically.
+
+Spec grammar (flag ``FLAGS_chaos`` or :func:`arm`)::
+
+    kind[:nth[:param]][,kind...]
+
+    corrupt_shard:2        flip bytes of the 2nd shard file written
+    truncate_shard:1       write only half of the 1st shard file
+    fail_commit:1          raise IOError at the 1st metadata commit
+    poison_loss:3          NaN the 3rd step's loss
+    delay_collective:1:0.8 sleep 0.8 s inside the 1st watched collective
+
+Clean-path cost is a single module-attribute load per hook site: every
+hook starts with ``if _ACTIVE is None: return`` — no device syncs, no
+flag lookups, no allocation when chaos is disarmed (the acceptance bar:
+recovery machinery adds no overhead when no fault fires).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...flags import define_flag, flag_value
+
+# kinds the injector understands; hooks for each live in
+# distributed/checkpoint (shard bytes, commit), ReliableStep (loss), and
+# the collective watchdog waiter (delay)
+KINDS = ("corrupt_shard", "truncate_shard", "fail_commit", "poison_loss",
+         "delay_collective")
+
+
+class ChaosInjector:
+    """Per-kind occurrence counters + the fired-event log."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.targets: Dict[str, Tuple[int, Optional[float]]] = {}
+        self.counts: Dict[str, int] = {}
+        self.fired: List[Tuple[str, str]] = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            pieces = part.split(":")
+            kind = pieces[0]
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown chaos kind {kind!r}; valid: {KINDS}")
+            nth = int(pieces[1]) if len(pieces) > 1 else 1
+            param = float(pieces[2]) if len(pieces) > 2 else None
+            self.targets[kind] = (nth, param)
+            self.counts[kind] = 0
+
+    def should_fire(self, kind: str) -> bool:
+        tgt = self.targets.get(kind)
+        if tgt is None:
+            return False
+        self.counts[kind] += 1
+        return self.counts[kind] == tgt[0]
+
+    def param(self, kind: str, default: float) -> float:
+        tgt = self.targets.get(kind)
+        return default if tgt is None or tgt[1] is None else tgt[1]
+
+    def record(self, kind: str, detail: str) -> None:
+        self.fired.append((kind, detail))
+
+
+_ACTIVE: Optional[ChaosInjector] = None
+
+
+def arm(spec: str) -> ChaosInjector:
+    """Arm the injector with a spec string; returns it for inspection."""
+    global _ACTIVE
+    _ACTIVE = ChaosInjector(spec) if spec else None
+    return _ACTIVE
+
+
+def disarm() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> Optional[ChaosInjector]:
+    return _ACTIVE
+
+
+def fired_log() -> List[Tuple[str, str]]:
+    return list(_ACTIVE.fired) if _ACTIVE is not None else []
+
+
+define_flag("chaos", "",
+            "Chaos-injection spec 'kind[:nth[:param]],...' (kinds: "
+            + ", ".join(KINDS) + "); empty disarms.",
+            on_change=arm)
+if flag_value("chaos"):          # env FLAGS_chaos was set before import
+    arm(str(flag_value("chaos")))
+
+
+# ---------------------------------------------------------------- hooks
+def mutate_shard_file(path: str) -> None:
+    """Checkpoint write hook: may corrupt (bit-flip a window) or
+    truncate the just-written shard file ON DISK, before it is renamed
+    into place. The recorded CRC32/size in the metadata were computed on
+    the clean stream, so verification must catch this on load."""
+    if _ACTIVE is None:
+        return
+    if _ACTIVE.should_fire("truncate_shard"):
+        _ACTIVE.record("truncate_shard", path)
+        size = os.path.getsize(path)
+        os.truncate(path, max(1, size // 2))
+        return
+    if _ACTIVE.should_fire("corrupt_shard"):
+        _ACTIVE.record("corrupt_shard", path)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            window = f.read(64)
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in window))
+
+
+def maybe_fail_commit(path: str) -> None:
+    """Checkpoint commit hook: raise IOError right before the metadata
+    os.replace, simulating the filesystem dying at the commit point."""
+    if _ACTIVE is None:
+        return
+    if _ACTIVE.should_fire("fail_commit"):
+        _ACTIVE.record("fail_commit", path)
+        raise IOError(f"chaos: injected commit failure for {path}")
+
+
+def _poison(value: Any) -> Any:
+    from ...framework.tensor import Tensor
+    if isinstance(value, (tuple, list)):     # (loss, metrics)-style returns
+        if not value:
+            return value
+        return type(value)([_poison(value[0])] + list(value[1:]))
+    if isinstance(value, Tensor):
+        import jax.numpy as jnp
+        if jnp.issubdtype(value._data.dtype, jnp.floating):
+            return Tensor(jnp.full(value._data.shape, jnp.nan,
+                                   value._data.dtype))
+        return value
+    return float("nan")
+
+
+def maybe_poison_loss(value: Any) -> Any:
+    """Step hook (ReliableStep): replace the step's loss with NaN."""
+    if _ACTIVE is None:
+        return value
+    if not _ACTIVE.should_fire("poison_loss"):
+        return value
+    _ACTIVE.record("poison_loss", type(value).__name__)
+    return _poison(value)
+
+
+def maybe_delay_collective(tag: str) -> None:
+    """Watchdog waiter hook: hold the op in flight past its deadline."""
+    if _ACTIVE is None:
+        return
+    if _ACTIVE.should_fire("delay_collective"):
+        delay = _ACTIVE.param("delay_collective", 0.5)
+        _ACTIVE.record("delay_collective", f"{tag}:{delay}")
+        time.sleep(delay)
+
+
+__all__ = ["ChaosInjector", "arm", "disarm", "active", "fired_log",
+           "mutate_shard_file", "maybe_fail_commit", "maybe_poison_loss",
+           "maybe_delay_collective", "KINDS"]

@@ -1,0 +1,169 @@
+"""Verified checkpoint retention with rollback.
+
+:class:`CheckpointManager` closes the recovery half of the
+detect->recover loop for durable state: every save is CRC-verified
+before the ``latest`` pointer commits, the last K checkpoints are kept,
+and :meth:`restore` transparently walks newest->oldest until one passes
+verification — a corrupted or truncated shard costs at most K-1 saves of
+progress, never the run.
+
+Layout under ``root``::
+
+    root/
+      step_00000010/   <- one distributed.checkpoint directory per save
+      step_00000020/
+      latest           <- text file naming the newest VERIFIED save
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import sys
+from typing import Any, Dict, List, Optional
+
+from ..checkpoint import (load_state_dict, save_state_dict,
+                          verify_checkpoint)
+from ...framework.io_state import CheckpointCorruptionError
+
+_STEP_DIR = re.compile(r"^step_(\d{8,})$")
+_LATEST = "latest"
+
+
+class CheckpointVerificationError(RuntimeError):
+    """A just-written checkpoint failed post-save verification; the
+    ``latest`` pointer still names the previous good checkpoint."""
+
+
+class CheckpointManager:
+    """Keep the last ``keep_last`` verified checkpoints of a run.
+
+    ::
+
+        mgr = CheckpointManager("gs-fuse/ckpts", keep_last=3)
+        start = mgr.restore(state) or 0        # rollback-aware resume
+        for step in range(start, total):
+            train(step)
+            if step % 100 == 0:
+                mgr.save(state, step)
+    """
+
+    def __init__(self, root: str, keep_last: int = 3):
+        if keep_last < 1:
+            raise ValueError("keep_last must be >= 1")
+        self.root = root
+        self.keep_last = keep_last
+        os.makedirs(root, exist_ok=True)
+
+    # -- directory bookkeeping ------------------------------------------
+    def _dir(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{step:08d}")
+
+    def steps(self) -> List[int]:
+        """Steps with a checkpoint directory present, ascending."""
+        out = []
+        try:
+            for name in os.listdir(self.root):
+                m = _STEP_DIR.match(name)
+                if m and os.path.isdir(os.path.join(self.root, name)):
+                    out.append(int(m.group(1)))
+        except FileNotFoundError:
+            pass
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        """Step named by the committed ``latest`` pointer (no verify)."""
+        try:
+            with open(os.path.join(self.root, _LATEST)) as f:
+                m = _STEP_DIR.match(f.read().strip())
+                return int(m.group(1)) if m else None
+        except (OSError, ValueError):
+            return None
+
+    def _commit_latest(self, step: int) -> None:
+        tmp = os.path.join(self.root, _LATEST + ".tmp")
+        with open(tmp, "w") as f:
+            f.write(f"step_{step:08d}")
+        os.replace(tmp, os.path.join(self.root, _LATEST))
+
+    def _prune(self) -> None:
+        """Drop oldest checkpoints beyond ``keep_last`` (never the one
+        the ``latest`` pointer names)."""
+        keep_from = self.steps()[-self.keep_last:]
+        pointed = self.latest_step()
+        for s in self.steps():
+            if s not in keep_from and s != pointed:
+                shutil.rmtree(self._dir(s), ignore_errors=True)
+
+    # -- save / restore --------------------------------------------------
+    def save(self, state_dict: Dict[str, Any], step: int) -> str:
+        """Write, verify, THEN commit ``latest`` and prune. If the write
+        or verification fails, ``latest`` keeps naming the previous good
+        checkpoint and the failed directory is renamed to
+        ``step_XXXXXXXX.failed`` for post-mortem — quarantined so it
+        neither counts against ``keep_last`` retention nor shows up as a
+        restore candidate. In a multi-process job every rank must call
+        this (save_state_dict is collective); the pointer commit and
+        prune run on rank 0 only."""
+        path = self._dir(step)
+        try:
+            save_state_dict(state_dict, path)
+            verify_checkpoint(path)
+        except (CheckpointCorruptionError, OSError, ValueError) as e:
+            try:
+                failed = path + ".failed"
+                shutil.rmtree(failed, ignore_errors=True)
+                os.rename(path, failed)
+            except OSError:
+                pass
+            raise CheckpointVerificationError(
+                f"checkpoint at step {step} failed verification and was "
+                f"NOT committed (latest still -> step {self.latest_step()}"
+                f"): {e}") from e
+        from ..env import get_rank
+        if get_rank() == 0:
+            self._commit_latest(step)
+            self._prune()
+        return path
+
+    def restore(self, state_dict: Dict[str, Any]) -> Optional[int]:
+        """Load the newest checkpoint that passes verification into
+        ``state_dict`` (in place); returns its step, or None when no
+        loadable checkpoint exists. Candidates are tried newest-first,
+        starting with the ``latest`` pointer; a corrupt/truncated/
+        partially-deleted candidate is skipped with a warning — the
+        rollback path needs no human in the loop.
+
+        Multi-rank caveat: each process walks the candidates itself, so
+        a TRANSIENT shared-FS read error on one rank could make it pick
+        an older step than its peers. Rollback decisions are driven by
+        on-disk content (identical across ranks); if your filesystem
+        serves torn reads, verify on rank 0 and broadcast the chosen
+        step before calling restore."""
+        candidates = sorted(set(self.steps()), reverse=True)
+        pointed = self.latest_step()
+        if pointed is not None and pointed in candidates:
+            candidates.remove(pointed)
+            candidates.insert(0, pointed)
+        for step in candidates:
+            path = self._dir(step)
+            try:
+                # no pre-verify pass: load_state_dict CRC-checks every
+                # shard as it reads (verified_unpickle), so a separate
+                # verify_checkpoint here would just double the restore
+                # I/O on exactly the slow filesystems rollback targets
+                load_state_dict(state_dict, path)
+                if step != pointed:   # roll the pointer back too, so the
+                    from ..env import get_rank
+                    if get_rank() == 0:        # next resume skips the scan
+                        self._commit_latest(step)
+                return step
+            except (CheckpointCorruptionError, OSError, ValueError) as e:
+                print(f"[fault_tolerance] checkpoint step {step} failed "
+                      f"verification ({e}); rolling back",
+                      file=sys.stderr)
+        return None
+
+
+__all__ = ["CheckpointManager", "CheckpointVerificationError"]

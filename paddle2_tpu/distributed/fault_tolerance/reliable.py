@@ -1,0 +1,206 @@
+"""In-job retry: host-memory snapshots + bounded replay of failed steps.
+
+A transient step failure (NaN/Inf loss from a bad batch or numerics
+blip, a collective flagged by the watchdog, an injected chaos fault)
+should not kill a multi-hour run when the fix is "rewind a few steps and
+go again". :class:`ReliableStep` wraps the training step with:
+
+* a device->host snapshot of model/optimizer state every ``snapshot_every``
+  steps (numpy copies — safe against later donation/mutation);
+* failure detection that is FREE on the clean path: the loss returned by
+  step N is checked when step N+1 is submitted (by then it has
+  materialized as a by-product of normal dispatch), so no extra
+  ``block_until_ready``/host readback is added per step;
+* on failure: restore the snapshot, replay the failed step with
+  exponential backoff, bounded by a per-step and a per-run retry budget.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .retry import backoff_delays
+from . import chaos
+
+
+class TransientStepError(RuntimeError):
+    """A step failure worth retrying from the last snapshot: non-finite
+    loss, watchdog-flagged collective timeout, or an injected fault.
+    ``step_fn`` may also raise this directly to request a retry."""
+
+
+class RetryBudgetExceededError(RuntimeError):
+    """The bounded retry budget ran out — the failure is not transient."""
+
+
+def _tree_to_host(obj: Any) -> Any:
+    """Nested state-dict -> host-memory copy (numpy leaves)."""
+    from ...framework.tensor import Tensor
+    if isinstance(obj, Tensor):
+        return np.array(np.asarray(obj._data), copy=True)
+    if isinstance(obj, dict):
+        return {k: _tree_to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_host(v) for v in obj)
+    try:
+        import jax
+        if isinstance(obj, jax.Array):
+            return np.array(np.asarray(obj), copy=True)
+    except ImportError:
+        pass
+    return obj
+
+
+def _loss_is_finite(loss: Any) -> bool:
+    from ...framework.tensor import Tensor
+    if isinstance(loss, (tuple, list)):      # (loss, metrics)-style returns
+        return _loss_is_finite(loss[0]) if loss else True
+    if isinstance(loss, Tensor):
+        loss = np.asarray(loss._data)
+    elif hasattr(loss, "dtype"):
+        loss = np.asarray(loss)
+    if isinstance(loss, (int, float, np.generic, np.ndarray)):
+        arr = np.asarray(loss)
+        if arr.dtype.kind in "fc":
+            return bool(np.isfinite(arr).all())
+    return True
+
+
+class ReliableStep:
+    """Wrap a training step with snapshot/restore-based retry.
+
+    ::
+
+        reliable = ReliableStep(model, optimizer, snapshot_every=10)
+        for batch in loader:
+            loss = reliable.run(train_step, batch)
+        reliable.finalize()      # checks the last step's loss
+
+    ``run`` snapshots state_dicts to host memory every ``snapshot_every``
+    steps and submits ``step_fn(*args)``. Detection is deferred one step
+    (clean path stays sync-free); a detected failure restores the newest
+    snapshot and replays the offending call. Steps between the snapshot
+    and the failure are re-run implicitly only when ``snapshot_every == 1``
+    (the failed call is the only one since the snapshot); with coarser
+    snapshots the intervening steps' progress is discarded — the
+    documented trade of snapshot cost vs. replay loss.
+    """
+
+    def __init__(self, model: Any = None, optimizer: Any = None,
+                 snapshot_every: int = 1, max_retries: int = 3,
+                 retry_budget: int = 16, base_delay: float = 0.05,
+                 max_delay: float = 2.0, check_finite: bool = True,
+                 sleep: Callable[[float], None] = time.sleep):
+        if snapshot_every < 1:
+            raise ValueError("snapshot_every must be >= 1")
+        self._holders: List[Any] = [
+            h for h in (model, optimizer)
+            if h is not None and hasattr(h, "state_dict")]
+        self.snapshot_every = snapshot_every
+        self.max_retries = max_retries
+        self.retry_budget = retry_budget
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.check_finite = check_finite
+        self._sleep = sleep
+        self._step = 0
+        self._snapshot: Optional[List[Any]] = None
+        self._snapshot_step = -1
+        self._pending: Optional[Tuple[Callable, tuple, dict, Any]] = None
+        self.stats: Dict[str, int] = {"steps": 0, "retries": 0,
+                                      "restores": 0, "snapshots": 0}
+
+    # -- snapshot/restore ------------------------------------------------
+    def snapshot(self) -> None:
+        """Copy every holder's state_dict to host memory NOW."""
+        self._snapshot = [_tree_to_host(h.state_dict())
+                          for h in self._holders]
+        self._snapshot_step = self._step
+        self.stats["snapshots"] += 1
+
+    def restore(self) -> None:
+        """Write the newest snapshot back into the live objects."""
+        if self._snapshot is None:
+            raise RuntimeError("ReliableStep.restore: no snapshot taken")
+        for holder, state in zip(self._holders, self._snapshot):
+            holder.set_state_dict(state)
+        self.stats["restores"] += 1
+
+    # -- failure plumbing ------------------------------------------------
+    def _watchdog_timed_out(self) -> bool:
+        from ..watchdog import CommWatchdog
+        wd = CommWatchdog.get()
+        return bool(wd.enabled()) and bool(wd.consume_timeouts())
+
+    def _check(self, loss: Any) -> None:
+        """Raise TransientStepError if the (materialized) loss or the
+        watchdog says the step went bad."""
+        if self.check_finite and not _loss_is_finite(loss):
+            raise TransientStepError("non-finite loss")
+        if self._watchdog_timed_out():
+            raise TransientStepError("collective watchdog timeout")
+
+    def _replay(self, step_fn, args, kwargs) -> Any:
+        """Restore + bounded retry of one failed step call."""
+        delays = backoff_delays(self.base_delay, self.max_delay,
+                                self.max_retries)
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_retries):
+            if self.stats["retries"] >= self.retry_budget:
+                raise RetryBudgetExceededError(
+                    f"retry budget ({self.retry_budget}) exhausted at "
+                    f"step {self._step}: {last}")
+            self.stats["retries"] += 1
+            self.restore()
+            self._sleep(next(delays))
+            try:
+                out = chaos.maybe_poison_loss(step_fn(*args, **kwargs))
+                self._check(out)         # eager check while recovering
+                return out
+            except TransientStepError as e:
+                last = e
+        raise RetryBudgetExceededError(
+            f"step {self._step} still failing after {self.max_retries} "
+            f"retries: {last}")
+
+    def _settle_pending(self) -> None:
+        """Deferred detection: validate the PREVIOUS step's loss (it has
+        materialized by now) and, on failure, restore + replay it."""
+        if self._pending is None:
+            return
+        step_fn, args, kwargs, loss = self._pending
+        self._pending = None
+        try:
+            self._check(loss)
+        except TransientStepError:
+            self._replay(step_fn, args, kwargs)
+
+    # -- the step --------------------------------------------------------
+    def run(self, step_fn: Callable, *args, **kwargs) -> Any:
+        """Submit one training step through the reliability wrapper and
+        return ``step_fn``'s result (usually the loss)."""
+        self._settle_pending()
+        if self._step % self.snapshot_every == 0:
+            self.snapshot()
+        try:
+            out = chaos.maybe_poison_loss(step_fn(*args, **kwargs))
+        except TransientStepError:
+            # step_fn self-reported a transient failure: recover eagerly
+            out = self._replay(step_fn, args, kwargs)
+        self._pending = (step_fn, args, kwargs, out)
+        self._step += 1
+        self.stats["steps"] += 1
+        return out
+
+    def finalize(self) -> None:
+        """Check (and if needed replay) the last submitted step. Call
+        once after the loop — or rely on the next checkpoint save, which
+        should follow a finalize()."""
+        self._settle_pending()
+
+
+__all__ = ["ReliableStep", "TransientStepError",
+           "RetryBudgetExceededError"]

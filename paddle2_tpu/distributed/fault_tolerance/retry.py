@@ -1,0 +1,66 @@
+"""Shared bounded-retry utility with exponential backoff.
+
+One retry policy for every transient-failure site in the stack: the
+elastic store's heartbeat IO (NFS/GCS-fuse hiccups), the launch-master
+HTTP polling (master briefly unreachable during a restart), and the
+in-job :class:`~paddle2_tpu.distributed.fault_tolerance.ReliableStep`
+recovery loop. Mirrors the reference's ad-hoc ``while retries:`` loops
+(fleet/elastic/manager.py, launch/controllers/master.py) but with one
+tested implementation instead of N divergent ones.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Tuple, Type
+
+
+def backoff_delays(base_delay: float, max_delay: float, attempts: int):
+    """The deterministic delay schedule ``retry_with_backoff`` sleeps
+    through: base, 2*base, 4*base, ... capped at ``max_delay``. Exposed
+    so tests and callers can reason about the worst-case wall time."""
+    d = base_delay
+    for _ in range(attempts):
+        yield min(d, max_delay)
+        d *= 2.0
+
+
+def retry_with_backoff(fn: Callable[[], Any], *,
+                       max_attempts: int = 3,
+                       base_delay: float = 0.1,
+                       max_delay: float = 5.0,
+                       retry_on: Tuple[Type[BaseException], ...]
+                       = (Exception,),
+                       on_retry: Optional[Callable[[int, BaseException],
+                                                   None]] = None,
+                       sleep: Optional[Callable[[float], None]]
+                       = None) -> Any:
+    """Call ``fn()`` up to ``max_attempts`` times, sleeping an
+    exponentially growing delay between attempts.
+
+    ``retry_on`` bounds WHICH failures are considered transient —
+    anything else propagates immediately (a programming error must not
+    burn the retry budget). ``on_retry(attempt, exc)`` is invoked before
+    each sleep, for logging / metrics / test introspection. The final
+    failure re-raises the last exception unchanged.
+    """
+    if max_attempts < 1:
+        raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+    if sleep is None:
+        sleep = time.sleep        # bound late: tests may patch time.sleep
+    delays = backoff_delays(base_delay, max_delay, max_attempts - 1)
+    last: Optional[BaseException] = None
+    for attempt in range(max_attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            last = e
+            if attempt + 1 >= max_attempts:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(next(delays))
+    raise last  # unreachable; keeps type-checkers honest
+
+
+__all__ = ["retry_with_backoff", "backoff_delays"]

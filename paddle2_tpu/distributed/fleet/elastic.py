@@ -58,9 +58,23 @@ class ElasticManager:
         now = time.time()
         if now - self._last_beat < self.interval:
             return
-        with open(self._path(self.rank), "w") as f:
-            json.dump({"rank": self.rank, "ts": now,
-                       "world": self.world}, f)
+        path = self._path(self.rank)
+
+        def _write():
+            # atomic: temp file + os.replace, so a concurrent
+            # alive_ranks() reader never sees a partially written JSON
+            # (a torn read used to count the rank as dead for a poll)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({"rank": self.rank, "ts": now,
+                           "world": self.world}, f)
+            os.replace(tmp, path)
+
+        from ..fault_tolerance.retry import retry_with_backoff
+        # shared-FS stores (NFS/GCS-fuse) throw transient OSErrors under
+        # load; a missed beat is a false death sentence, so retry
+        retry_with_backoff(_write, max_attempts=3, base_delay=0.05,
+                           max_delay=0.5, retry_on=(OSError,))
         self._last_beat = now
 
     def _alive_entries(self) -> List[dict]:

@@ -21,13 +21,15 @@ TCPStore rendezvous analog). The launcher:
 from __future__ import annotations
 
 import argparse
+import glob
 import os
 import signal
 import subprocess
 import sys
+import tempfile
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 
 def _parse(argv: Optional[List[str]] = None):
@@ -64,9 +66,23 @@ def _parse(argv: Optional[List[str]] = None):
     p.add_argument("--rdzv_dead", type=float, default=30.0,
                    help="pod heartbeat timeout before the master sweeps "
                         "it (s)")
+    p.add_argument("--preempt_grace", type=float, default=30.0,
+                   help="seconds workers get to checkpoint-then-exit "
+                        "after the launcher receives SIGTERM (TPU "
+                        "preemption notice); extended while a worker's "
+                        "save-in-flight marker exists")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
+
+
+def _marker_prefix() -> str:
+    """Shared path prefix for preemption save-in-flight markers: each
+    worker's PreemptionGuard touches ``<prefix>.<rank>`` while its final
+    checkpoint is being written; the launcher extends its SIGTERM grace
+    period while any such marker exists."""
+    return os.path.join(tempfile.gettempdir(),
+                        f"p2t_preempt_{os.getpid()}")
 
 
 def _worker_env(args, local_rank: int) -> dict:
@@ -79,6 +95,7 @@ def _worker_env(args, local_rank: int) -> dict:
         "PADDLE_LOCAL_RANK": str(local_rank),
         "PADDLE_NNODES": str(args.nnodes),
         "PADDLE_JOB_ID": args.job_id,
+        "PADDLE_PREEMPT_MARKER": f"{_marker_prefix()}.{rank}",
     })
     if args.master:
         env.update({
@@ -146,13 +163,87 @@ def _surface_failure_logs(procs, n_tail: int = 30) -> None:
             pass
 
 
-def _watch(procs: List[subprocess.Popen]):
+class _PreemptForwarder:
+    """Launcher-side half of preemption safety: on SIGTERM, forward the
+    signal to every live worker (whose PreemptionGuard turns it into
+    checkpoint-then-exit at the next step boundary) and grant a grace
+    period before SIGKILL. The deadline EXTENDS while any worker's
+    save-in-flight marker (``<_marker_prefix()>.<rank>``) exists — a
+    final checkpoint write is never truncated by the kill — bounded by a
+    10x hard cap so a leaked marker can't wedge the launcher."""
+
+    def __init__(self, grace: float):
+        self.grace = max(0.1, grace)
+        self.procs: List[subprocess.Popen] = []
+        self.fired = threading.Event()
+        self._prev = None
+
+    def install(self) -> "_PreemptForwarder":
+        try:
+            self._prev = signal.signal(signal.SIGTERM, self._handle)
+        except ValueError:        # non-main thread (embedded): poll-only
+            self._prev = None
+        return self
+
+    def uninstall(self) -> None:
+        if self._prev is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev)
+            except ValueError:
+                pass
+            self._prev = None
+
+    def _handle(self, signum, frame):
+        self.fired.set()
+        for p in self.procs:
+            if p.poll() is None:
+                p._torn_down = True   # our forward, not its own failure
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+
+    @staticmethod
+    def save_in_flight() -> bool:
+        return bool(glob.glob(_marker_prefix() + ".*"))
+
+    def drain(self) -> None:
+        """Wait for the gang's checkpoint-then-exit, then reap. Forwards
+        SIGTERM (again) first: the signal may have fired between gangs —
+        e.g. while the elastic agent was re-joining — in which case the
+        CURRENT procs never saw the original forward."""
+        self._handle(signal.SIGTERM, None)
+        start = time.time()
+        deadline = start + self.grace
+        hard = start + self.grace * 10
+        while any(p.poll() is None for p in self.procs):
+            now = time.time()
+            if self.save_in_flight():
+                deadline = min(max(deadline, now + self.grace), hard)
+            if now > deadline:
+                break
+            time.sleep(0.1)
+        for p in self.procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def _watch(procs: List[subprocess.Popen],
+           forwarder: Optional[_PreemptForwarder] = None
+           ) -> Tuple[int, int, bool]:
     """Babysit the local gang: first non-zero exit kills everyone
     (failure-detection parity — a dead rank must not hang the ring).
-    Returns (rc, n_self_failed): how many workers died on their OWN
-    (not from our teardown) — the scale-in delta for --elastic_rescale."""
+    Returns (rc, n_self_failed, preempted): how many workers died on
+    their OWN (not from our teardown) — the scale-in delta for
+    --elastic_rescale — and whether a forwarded SIGTERM (preemption)
+    ended the gang instead."""
     from ..fleet.elastic import ELASTIC_EXIT_CODE
+    if forwarder is not None:
+        forwarder.procs = procs
     while True:
+        if forwarder is not None and forwarder.fired.is_set():
+            forwarder.drain()
+            return 0, 0, True
         alive = False
         failed = 0
         rc_out = 0
@@ -176,9 +267,9 @@ def _watch(procs: List[subprocess.Popen]):
             for q in procs:
                 if q.poll() is None:
                     q.kill()
-            return rc_out, failed
+            return rc_out, failed, False
         if not alive:
-            return 0, 0
+            return 0, 0, False
         time.sleep(0.5)
 
 
@@ -200,6 +291,7 @@ def _spawn_layout(args, layout: dict, me: dict,
             "PADDLE_NODE_RANK": str(me["node_rank"]),
             "PADDLE_JOB_VERSION": str(layout["version"]),
             "PADDLE_ELASTIC_RESTART_COUNT": str(attempt),
+            "PADDLE_PREEMPT_MARKER": f"{_marker_prefix()}.{rank}",
         })
         if args.master:
             env.update({
@@ -235,13 +327,19 @@ def _teardown(procs):
 
 
 def _watch_with_master(procs, client, node_id: str, version: int,
-                       beat: float):
+                       beat: float,
+                       forwarder: Optional[_PreemptForwarder] = None):
     """Babysit the local gang AND the job version: a version bump means
     the membership changed — tear down and respawn at the new layout."""
     from .master import UnknownPodError
     from ..fleet.elastic import ELASTIC_EXIT_CODE
+    if forwarder is not None:
+        forwarder.procs = procs
     last_beat = 0.0
     while True:
+        if forwarder is not None and forwarder.fired.is_set():
+            forwarder.drain()
+            return "preempted", 0, 0
         alive = False
         failed = 0
         rc_out = 0
@@ -291,6 +389,7 @@ def _elastic_agent(args) -> int:
     node_id = f"node-{args.node_rank}"
     host = socket.gethostname()
     attempt = 0
+    forwarder = _PreemptForwarder(args.preempt_grace).install()
     beat_thread_stop = threading.Event()
 
     def _beat_during_settle():
@@ -324,8 +423,12 @@ def _elastic_agent(args) -> int:
                   f"{me['node_rank']}", file=sys.stderr)
             procs = _spawn_layout(args, layout, me, attempt)
             state, rc, _n = _watch_with_master(procs, client, node_id,
-                                               version, args.rdzv_beat)
-            if state == "done":
+                                               version, args.rdzv_beat,
+                                               forwarder)
+            if state in ("done", "preempted"):
+                if state == "preempted":
+                    print("[launch] preemption: gang checkpointed and "
+                          "exited", file=sys.stderr)
                 try:
                     client.leave(node_id)
                 except Exception:
@@ -360,6 +463,7 @@ def _elastic_agent(args) -> int:
                   f"{attempt}/{args.max_restarts}", file=sys.stderr)
     finally:
         beat_thread_stop.set()
+        forwarder.uninstall()
         if master is not None:
             master.shutdown()
 
@@ -369,9 +473,21 @@ def launch(argv: Optional[List[str]] = None) -> int:
     if args.rdzv_master:
         return _elastic_agent(args)
     attempt = 0
+    forwarder = _PreemptForwarder(args.preempt_grace).install()
+    try:
+        return _launch_loop(args, forwarder, attempt)
+    finally:
+        forwarder.uninstall()
+
+
+def _launch_loop(args, forwarder: _PreemptForwarder, attempt: int) -> int:
     while True:
         procs = _spawn(args)
-        rc, n_failed = _watch(procs)
+        rc, n_failed, preempted = _watch(procs, forwarder)
+        if preempted:
+            print("[launch] preemption: gang checkpointed and exited",
+                  file=sys.stderr)
+            return 0
         if rc == 0:
             return 0
         _surface_failure_logs(procs)

@@ -182,7 +182,7 @@ class MasterClient:
     """Agent-side client for :class:`RendezvousMaster`."""
 
     def __init__(self, endpoint: str, timeout: float = 5.0,
-                 retries: int = 20, retry_wait: float = 0.5):
+                 retries: int = 12, retry_wait: float = 0.5):
         if not endpoint.startswith("http"):
             endpoint = "http://" + endpoint
         self.endpoint = endpoint.rstrip("/")
@@ -192,8 +192,9 @@ class MasterClient:
 
     def _req(self, path: str, body: Optional[dict] = None,
              retries: Optional[int] = None) -> dict:
-        last: Optional[Exception] = None
-        for _ in range(retries if retries is not None else self.retries):
+        from ..fault_tolerance.retry import retry_with_backoff
+
+        def _once() -> dict:
             try:
                 data = None if body is None else json.dumps(body).encode()
                 r = urllib.request.Request(
@@ -203,14 +204,34 @@ class MasterClient:
                     return json.loads(f.read())
             except urllib.error.HTTPError as e:
                 if e.code == 404 and path == "/beat":
-                    raise UnknownPodError()   # must re-join
-                last = e
-            except Exception as e:   # conn refused while master boots
-                last = e
-            time.sleep(self.retry_wait)
-        raise ConnectionError(
-            f"rendezvous master unreachable at {self.endpoint}{path}: "
-            f"{last}")
+                    raise UnknownPodError()   # must re-join: not transient
+                raise
+
+        import http.client
+        try:
+            # shared retry policy (fault_tolerance.retry): exponential
+            # backoff from retry_wait capped at 2x, with the default
+            # attempt count sized so a PERMANENTLY dead master still
+            # surfaces in ~11s of backoff (parity with the old 20x0.5s
+            # fixed loop) while a booting one isn't hammered.
+            # HTTPException covers a master restart tearing a response
+            # mid-read (IncompleteRead/BadStatusLine); ValueError covers
+            # the torn-JSON tail of the same event.
+            return retry_with_backoff(
+                _once,
+                max_attempts=retries if retries is not None
+                else self.retries,
+                base_delay=self.retry_wait,
+                max_delay=self.retry_wait * 2,
+                retry_on=(urllib.error.URLError, urllib.error.HTTPError,
+                          http.client.HTTPException, ConnectionError,
+                          OSError, TimeoutError, ValueError))
+        except UnknownPodError:
+            raise
+        except Exception as last:   # conn refused while master boots
+            raise ConnectionError(
+                f"rendezvous master unreachable at {self.endpoint}{path}: "
+                f"{last}")
 
     def join(self, node_id: str, host: str, nproc: int) -> dict:
         return self._req("/join", {"node_id": node_id, "host": host,

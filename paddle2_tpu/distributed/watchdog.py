@@ -51,6 +51,10 @@ class CommWatchdog:
         self._mu = threading.Lock()
         self._monitor: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # tags of ops the monitor flagged as overrun, drained by
+        # consume_timeouts() — how ReliableStep learns a step's
+        # collective hung (detect -> recover wiring)
+        self._timeouts: list = []
 
     @classmethod
     def get(cls) -> "CommWatchdog":
@@ -85,6 +89,8 @@ class CommWatchdog:
     # -- internals -------------------------------------------------------
     def _wait(self, op_id: int, arrays) -> None:
         try:
+            from .fault_tolerance import chaos
+            chaos.maybe_delay_collective(self._tag(op_id))
             import jax
             jax.block_until_ready(arrays)
         except Exception as e:  # execution error counts as completion
@@ -125,6 +131,9 @@ class CommWatchdog:
                         e["fired"] = True
                         overdue.append((op_id, dict(e)))
                 pending = [e["tag"] for e in self._inflight.values()]
+            if overdue:
+                with self._mu:
+                    self._timeouts.extend(e["tag"] for _, e in overdue)
             for op_id, e in overdue:
                 logger.error(
                     "collective TIMEOUT after %.1fs: %s (in-flight: %s) — "
@@ -136,6 +145,15 @@ class CommWatchdog:
                     logger.error("aborting process for gang restart "
                                  "(AbortComm semantics)")
                     os._exit(134)
+
+    def consume_timeouts(self) -> list:
+        """Drain and return the tags flagged as overrun since the last
+        call. Polled by ReliableStep after each step so a hung-then-
+        recovered collective triggers an in-job retry instead of
+        silently training on a desynced gang."""
+        with self._mu:
+            out, self._timeouts = self._timeouts, []
+            return out
 
     # test hook ----------------------------------------------------------
     def inflight_count(self) -> int:

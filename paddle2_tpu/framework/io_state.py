@@ -17,11 +17,208 @@ from __future__ import annotations
 import io as _io
 import os
 import pickle
+import struct
+import zlib
 from typing import Any
 
 import numpy as np
 
 _PROTOCOL_DEFAULT = 4
+
+# integrity format: an 8-byte magic + (crc32, size) header, then the
+# payload pickle STREAMED through a CRC-tracking writer (no in-memory
+# copy of the serialized state); the header is backfilled once the
+# stream ends. load() re-computes the CRC while pickle consumes the
+# stream — one pass, verified at EOF. Old files (bare payload pickle)
+# still load; non-seekable streams fall back to the envelope-dict form.
+_MAGIC = b"P2TCKPT\x01"
+_HEADER = struct.Struct("<IQ")
+_INTEGRITY_MARKER = "__p2t_integrity__"
+_INTEGRITY_VERSION = 1
+
+
+class CheckpointCorruptionError(ValueError):
+    """A checkpoint file or shard failed integrity verification (CRC32 or
+    byte-size mismatch, truncation, or an unreadable pickle). Raised by
+    :func:`load`, ``distributed.checkpoint.load_state_dict``, and
+    ``distributed.checkpoint.verify_checkpoint`` so callers (e.g. the
+    fault-tolerance ``CheckpointManager``) can roll back to an older
+    verified checkpoint instead of crashing on garbage weights."""
+
+
+class Crc32Writer:
+    """File-object wrapper feeding a running CRC32 + byte counter while
+    a pickle streams through it — integrity metadata without holding the
+    serialized bytes in memory. Shared with distributed.checkpoint."""
+
+    __slots__ = ("_f", "crc", "size")
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+        self.size = 0
+
+    def write(self, b):
+        self._f.write(b)
+        self.crc = zlib.crc32(b, self.crc)
+        self.size += len(b)
+
+
+class Crc32Reader:
+    """Read-side mirror of :class:`Crc32Writer`: CRCs bytes as
+    ``pickle.load`` consumes them, so integrity verification costs no
+    second pass over the file."""
+
+    __slots__ = ("_f", "crc", "size")
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+        self.size = 0
+
+    def read(self, n=-1):
+        b = self._f.read(n)
+        self.crc = zlib.crc32(b, self.crc)
+        self.size += len(b)
+        return b
+
+    def readline(self):
+        b = self._f.readline()
+        self.crc = zlib.crc32(b, self.crc)
+        self.size += len(b)
+        return b
+
+
+def _integrity_wrap(blob: bytes) -> dict:
+    return {_INTEGRITY_MARKER: _INTEGRITY_VERSION,
+            "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+            "size": len(blob),
+            "payload": blob}
+
+
+def _integrity_unwrap(obj: Any, origin: str) -> Any:
+    """Return the verified inner payload bytes→object, or ``obj`` itself
+    for pre-envelope files."""
+    if not (isinstance(obj, dict) and _INTEGRITY_MARKER in obj):
+        return obj
+    version = obj[_INTEGRITY_MARKER]
+    if version != _INTEGRITY_VERSION or \
+            not isinstance(obj.get("payload"), bytes):
+        raise CheckpointCorruptionError(
+            f"paddle.load: {origin} has integrity-envelope version "
+            f"{version!r}; this build supports {_INTEGRITY_VERSION} — "
+            "load it with the build that wrote it")
+    blob = obj["payload"]
+    if len(blob) != obj.get("size"):
+        raise CheckpointCorruptionError(
+            f"paddle.load: {origin} truncated: payload {len(blob)} bytes, "
+            f"expected {obj.get('size')}")
+    crc = zlib.crc32(blob) & 0xFFFFFFFF
+    if crc != obj.get("crc32"):
+        raise CheckpointCorruptionError(
+            f"paddle.load: {origin} corrupt: crc32 {crc:#010x} != recorded "
+            f"{obj.get('crc32'):#010x}")
+    return pickle.loads(blob)
+
+
+def _dump_with_integrity(payload: Any, f, protocol: int) -> None:
+    """Stream the payload pickle behind a magic + (crc32, size) header;
+    non-seekable sinks get the envelope-dict fallback (payload buffered
+    once — unavoidable without a second pass over the sink)."""
+    try:
+        seekable = f.seekable()
+    except (AttributeError, OSError):
+        seekable = False
+    if not seekable:
+        pickle.dump(_integrity_wrap(pickle.dumps(payload, protocol)), f,
+                    protocol=protocol)
+        return
+    start = f.tell()
+    f.write(_MAGIC)
+    f.write(_HEADER.pack(0, 0))          # backfilled after the stream
+    w = Crc32Writer(f)
+    pickle.dump(payload, w, protocol=protocol)
+    end = f.tell()
+    f.seek(start + len(_MAGIC))
+    f.write(_HEADER.pack(w.crc & 0xFFFFFFFF, w.size))
+    f.seek(end)
+
+
+def verified_unpickle(f, crc32: int, size: int, label: str) -> Any:
+    """``pickle.load`` through a :class:`Crc32Reader` with the
+    size/CRC32 verdict delivered at EOF — one pass over the stream, and
+    the integrity error (not the confused unpickle error) is what
+    surfaces when the bytes are bad. Shared by :func:`load` and
+    ``distributed.checkpoint``'s shard reader."""
+    r = Crc32Reader(f)
+    err = None
+    out = None
+    try:
+        out = pickle.load(r)
+    except Exception as e:
+        err = e
+        r.read()                         # drain: complete the CRC verdict
+    if r.size != size:
+        raise CheckpointCorruptionError(
+            f"{label} truncated: {r.size} bytes read, recorded {size}")
+    if r.crc & 0xFFFFFFFF != crc32:
+        raise CheckpointCorruptionError(
+            f"{label} corrupt: crc32 {r.crc & 0xFFFFFFFF:#010x} != "
+            f"recorded {crc32:#010x}")
+    if err is not None:
+        raise CheckpointCorruptionError(
+            f"{label} unreadable: {err}") from err
+    return out
+
+
+class _PrependReader:
+    """Serve already-consumed sniff bytes ahead of the underlying
+    stream — lets load() probe for the magic header on NON-SEEKABLE
+    streams (pipes, sockets) without losing those bytes."""
+
+    __slots__ = ("_head", "_f")
+
+    def __init__(self, head: bytes, f):
+        self._head = head
+        self._f = f
+
+    def read(self, n=-1):
+        if not self._head:
+            return self._f.read(n)
+        if n is None or n < 0:
+            b, self._head = self._head, b""
+            return b + self._f.read(n)
+        b, self._head = self._head[:n], self._head[n:]
+        if len(b) < n:
+            b += self._f.read(n - len(b))
+        return b
+
+    def readline(self):
+        if not self._head:
+            return self._f.readline()
+        i = self._head.find(b"\n")
+        if i >= 0:
+            b, self._head = self._head[:i + 1], self._head[i + 1:]
+            return b
+        b, self._head = self._head, b""
+        return b + self._f.readline()
+
+
+def _load_with_integrity(f, origin: str) -> Any:
+    """Counterpart of :func:`_dump_with_integrity`; also accepts the
+    envelope-dict form and pre-integrity bare pickles, on seekable AND
+    non-seekable streams."""
+    head = f.read(len(_MAGIC))
+    if head == _MAGIC:
+        raw = f.read(_HEADER.size)
+        if len(raw) != _HEADER.size:
+            raise CheckpointCorruptionError(
+                f"paddle.load: {origin} truncated inside the integrity "
+                "header")
+        crc, size = _HEADER.unpack(raw)
+        return verified_unpickle(f, crc, size, f"paddle.load: {origin}")
+    # legacy bare pickle / envelope fallback: re-serve the sniffed bytes
+    return _integrity_unwrap(pickle.load(_PrependReader(head, f)), origin)
 
 
 class _TensorPayload:
@@ -102,7 +299,7 @@ def save(obj: Any, path, protocol: int = _PROTOCOL_DEFAULT, **configs) -> None:
         raise ValueError(f"pickle protocol must be in [2, 5], got {protocol}")
     payload = _to_saveable(obj)
     if hasattr(path, "write"):
-        pickle.dump(payload, path, protocol=protocol)
+        _dump_with_integrity(payload, path, protocol)
         return
     path = os.fspath(path)
     if path.endswith(os.sep) or (os.path.isdir(path)):
@@ -112,7 +309,7 @@ def save(obj: Any, path, protocol: int = _PROTOCOL_DEFAULT, **configs) -> None:
         os.makedirs(d, exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        pickle.dump(payload, f, protocol=protocol)
+        _dump_with_integrity(payload, f, protocol)
     os.replace(tmp, path)  # atomic: a crashed save never corrupts the file
 
 
@@ -122,13 +319,18 @@ def load(path, return_numpy: bool = False, **configs) -> Any:
     ``return_numpy=True`` yields raw ndarrays instead of Tensors.
     """
     if hasattr(path, "read"):
-        payload = pickle.load(path)
+        payload = _load_with_integrity(path, "<stream>")
     else:
         path = os.fspath(path)
         if not os.path.exists(path):
             raise ValueError(f"paddle.load: no such file {path!r}")
-        with open(path, "rb") as f:
-            payload = pickle.load(f)
+        try:
+            with open(path, "rb") as f:
+                payload = _load_with_integrity(f, path)
+        except (pickle.UnpicklingError, EOFError) as e:
+            raise CheckpointCorruptionError(
+                f"paddle.load: {path!r} unreadable (truncated or "
+                f"corrupt): {e}") from e
     return _from_saved(payload, return_numpy)
 
 

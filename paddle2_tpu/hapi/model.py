@@ -175,35 +175,56 @@ class Model:
         self.stop_training = False
         cbk.on_train_begin()
         it = 0
-        for epoch in range(epochs):
-            cbk.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}  # an empty loader must still yield epoch logs
-            for step, batch in enumerate(loader):
-                cbk.on_train_batch_begin(step)
-                ins, lbs = self._split_batch(batch)
-                # end-of-epoch flush so a trailing partial accumulation
-                # cannot leak into the next epoch (reference model.py:2808)
-                update = ((step + 1) % accumulate_grad_batches == 0
-                          or step + 1 == len(loader))
-                res = self.train_batch(ins, lbs, update=update)
-                logs = self._pack_logs(res)
-                cbk.on_train_batch_end(step, logs)
-                it += 1
+        # preemption safety: SIGTERM (TPU preemption notice) is latched
+        # by the guard and honored at the NEXT STEP BOUNDARY — save a
+        # final checkpoint (when save_dir is set) and exit the loop
+        # cleanly instead of dying mid-step with progress lost
+        from ..distributed.fault_tolerance import PreemptionGuard
+        with PreemptionGuard() as guard:
+            for epoch in range(epochs):
+                cbk.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}  # an empty loader must still yield epoch logs
+                for step, batch in enumerate(loader):
+                    cbk.on_train_batch_begin(step)
+                    ins, lbs = self._split_batch(batch)
+                    # end-of-epoch flush so a trailing partial
+                    # accumulation cannot leak into the next epoch
+                    # (reference model.py:2808)
+                    update = ((step + 1) % accumulate_grad_batches == 0
+                              or step + 1 == len(loader))
+                    res = self.train_batch(ins, lbs, update=update)
+                    logs = self._pack_logs(res)
+                    cbk.on_train_batch_end(step, logs)
+                    it += 1
+                    if guard.preempted:
+                        self.stop_training = True
+                        if save_dir:
+                            with guard.saving():
+                                self.save(os.path.join(save_dir,
+                                                       "preempted"))
+                    if (num_iters is not None and it >= num_iters) or \
+                            self.stop_training:
+                        break
+                epoch_logs = dict(logs)
+                if not guard.preempted and eval_loader is not None \
+                        and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_loader, verbose=0,
+                                              num_workers=num_workers)
+                    epoch_logs.update({f"eval_{k}": v
+                                       for k, v in eval_logs.items()})
+                cbk.on_epoch_end(epoch, epoch_logs)
                 if (num_iters is not None and it >= num_iters) or \
                         self.stop_training:
                     break
-            epoch_logs = dict(logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, verbose=0,
-                                          num_workers=num_workers)
-                epoch_logs.update({f"eval_{k}": v
-                                   for k, v in eval_logs.items()})
-            cbk.on_epoch_end(epoch, epoch_logs)
-            if (num_iters is not None and it >= num_iters) or \
-                    self.stop_training:
-                break
+        if guard.preempted:
+            # this fit CONSUMED the preemption (checkpointed + stopped);
+            # clear the process-wide latch so a later fit() in the same
+            # surviving process trains normally instead of stopping at
+            # its first step boundary
+            from ..distributed.fault_tolerance import preemption
+            preemption.reset()
         cbk.on_train_end()
         return self
 

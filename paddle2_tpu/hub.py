@@ -10,33 +10,43 @@ __all__ = ["list", "help", "load"]
 
 _ENTRY = "hubconf.py"
 
+# loaded hubconf modules keyed by absolute repo dir — repeated
+# list()/help()/load() calls against the same repo must not re-execute
+# hubconf.py (it may build registries / touch the filesystem);
+# force_reload=True bypasses and refreshes the cached entry
+_HUBCONF_CACHE: dict = {}
 
-def _load_hubconf(repo_dir: str):
+
+def _load_hubconf(repo_dir: str, force_reload: bool = False):
     path = os.path.join(repo_dir, _ENTRY)
     if not os.path.exists(path):
         raise ValueError(f"no {_ENTRY} in {repo_dir!r}; paddle.hub in this "
                          "offline build supports source='local' only")
+    key = os.path.abspath(repo_dir)
+    if not force_reload and key in _HUBCONF_CACHE:
+        return _HUBCONF_CACHE[key]
     spec = importlib.util.spec_from_file_location("hubconf", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    _HUBCONF_CACHE[key] = mod
     return mod
 
 
 def list(repo_dir: str, source: str = "local", force_reload: bool = False):
     if source != "local":
         raise ValueError("offline build: only source='local'")
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     return [n for n in dir(mod)
             if callable(getattr(mod, n)) and not n.startswith("_")]
 
 
 def help(repo_dir: str, model: str, source: str = "local",
          force_reload: bool = False):
-    return getattr(_load_hubconf(repo_dir), model).__doc__
+    return getattr(_load_hubconf(repo_dir, force_reload), model).__doc__
 
 
 def load(repo_dir: str, model: str, source: str = "local",
          force_reload: bool = False, **kwargs):
     if source != "local":
         raise ValueError("offline build: only source='local'")
-    return getattr(_load_hubconf(repo_dir), model)(**kwargs)
+    return getattr(_load_hubconf(repo_dir, force_reload), model)(**kwargs)

@@ -1,0 +1,691 @@
+"""Fault-tolerance subsystem: chaos-driven detect->recover loops.
+
+Covers the four layers of paddle2_tpu.distributed.fault_tolerance:
+checkpoint integrity/rollback (CRC32 + CheckpointManager), preemption
+safety (PreemptionGuard + hapi fit wiring), in-job retry (ReliableStep +
+retry_with_backoff adoption), and the deterministic chaos injector that
+drives the end-to-end scenarios. Everything here is fast (< 60 s total,
+no ``slow`` marks) so it runs inside the tier-1 budget.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.nn as nn
+import paddle2_tpu.nn.functional as F
+import paddle2_tpu.optimizer as opt
+from paddle2_tpu.distributed import checkpoint as dck
+from paddle2_tpu.distributed.fault_tolerance import (
+    CheckpointCorruptionError, CheckpointManager,
+    CheckpointVerificationError, PreemptionGuard, ReliableStep,
+    RetryBudgetExceededError, TransientStepError, chaos, preemption,
+    retry_with_backoff)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_and_preemption():
+    chaos.disarm()
+    preemption.reset()
+    yield
+    chaos.disarm()
+    preemption.reset()
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 3))
+
+
+def _batch(seed):
+    rs = np.random.RandomState(seed)
+    return (paddle.to_tensor(rs.randn(8, 6).astype(np.float32)),
+            paddle.to_tensor(rs.randn(8, 3).astype(np.float32)))
+
+
+def _make_step(model, optimizer):
+    def step(x, y):
+        loss = F.mse_loss(model(x), y)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        return loss
+    return step
+
+
+def _corrupt_file(path, offset_frac=0.5, n=32):
+    with open(path, "rb") as f:
+        blob = bytearray(f.read())
+    mid = int(len(blob) * offset_frac)
+    for i in range(mid, min(mid + n, len(blob))):
+        blob[i] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+
+
+def _data_files(path):
+    return sorted(f for f in os.listdir(path)
+                  if f.startswith("data_") and f.endswith(".pkl"))
+
+
+# ---------------------------------------------------------------- retry
+class TestRetryWithBackoff:
+    def test_succeeds_after_transient_failures(self):
+        calls, delays = [], []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        out = retry_with_backoff(flaky, max_attempts=5, base_delay=0.1,
+                                 max_delay=10.0, retry_on=(OSError,),
+                                 sleep=delays.append)
+        assert out == "ok" and len(calls) == 3
+        assert delays == [0.1, 0.2]          # exponential schedule
+
+    def test_exhausts_budget_and_reraises_last(self):
+        delays = []
+        with pytest.raises(OSError, match="always"):
+            retry_with_backoff(lambda: (_ for _ in ()).throw(
+                OSError("always")), max_attempts=3, base_delay=0.01,
+                retry_on=(OSError,), sleep=delays.append)
+        assert len(delays) == 2              # attempts-1 sleeps
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("programming error")
+
+        with pytest.raises(ValueError):
+            retry_with_backoff(bad, max_attempts=5, retry_on=(OSError,),
+                               sleep=lambda _: None)
+        assert len(calls) == 1
+
+    def test_delay_cap(self):
+        from paddle2_tpu.distributed.fault_tolerance.retry import \
+            backoff_delays
+        assert list(backoff_delays(0.5, 1.0, 4)) == [0.5, 1.0, 1.0, 1.0]
+
+
+# ------------------------------------------------- integrity: paddle.save
+class TestSingleFileIntegrity:
+    def test_roundtrip_unchanged(self, tmp_path):
+        p = str(tmp_path / "m.pdparams")
+        m = _model()
+        paddle.save(m.state_dict(), p)
+        loaded = paddle.load(p)
+        m2 = _model(seed=5)
+        m2.set_state_dict(loaded)
+        for a, b in zip(m.parameters(), m2.parameters()):
+            np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+    def test_bitflip_detected(self, tmp_path):
+        p = str(tmp_path / "m.pdparams")
+        paddle.save(_model().state_dict(), p)
+        _corrupt_file(p)
+        with pytest.raises(CheckpointCorruptionError):
+            paddle.load(p)
+
+    def test_truncation_detected(self, tmp_path):
+        p = str(tmp_path / "m.pdparams")
+        paddle.save(_model().state_dict(), p)
+        size = os.path.getsize(p)
+        with open(p, "rb") as f:
+            head = f.read(size // 2)
+        with open(p, "wb") as f:
+            f.write(head)
+        with pytest.raises(CheckpointCorruptionError):
+            paddle.load(p)
+
+    def test_non_seekable_stream_roundtrip(self):
+        """Pipes/sockets: save falls back to the envelope form and load
+        must read it back without seeking (regression)."""
+        r, w = os.pipe()
+        with os.fdopen(w, "wb") as fw:
+            paddle.save({"a": 1, "w": paddle.to_tensor([2.0])}, fw)
+        with os.fdopen(r, "rb") as fr:
+            back = paddle.load(fr)
+        assert back["a"] == 1
+        np.testing.assert_array_equal(back["w"].numpy(), [2.0])
+
+    def test_legacy_bare_pickle_still_loads(self, tmp_path):
+        import pickle
+        p = str(tmp_path / "old.pdparams")
+        with open(p, "wb") as f:
+            pickle.dump({"epoch": 7}, f, protocol=4)   # pre-integrity file
+        assert paddle.load(p) == {"epoch": 7}
+
+    def test_future_envelope_version_rejected(self, tmp_path):
+        import pickle
+        from paddle2_tpu.framework import io_state
+        p = str(tmp_path / "future.pdparams")
+        with open(p, "wb") as f:
+            pickle.dump({io_state._INTEGRITY_MARKER: 99,
+                         "crc32": 0, "size": 3, "payload": b"abc"}, f)
+        with pytest.raises(CheckpointCorruptionError, match="version"):
+            paddle.load(p)
+
+
+# --------------------------------------------- integrity: sharded ckpt
+class TestShardIntegrity:
+    def _state(self, val=1.0):
+        return {"w": paddle.to_tensor(np.full((16, 4), val, np.float32)),
+                "step": int(val)}
+
+    def test_metadata_records_crc_and_size(self, tmp_path):
+        import pickle
+        path = str(tmp_path / "ck")
+        dck.save_state_dict(self._state(), path)
+        with open(os.path.join(path, "0.metadata"), "rb") as f:
+            meta = pickle.load(f)
+        (fname, ck), = meta["file_checksums"].items()
+        assert ck["size"] == os.path.getsize(os.path.join(path, fname))
+        assert isinstance(ck["crc32"], int)
+
+    def test_corrupt_shard_detected_on_load(self, tmp_path):
+        path = str(tmp_path / "ck")
+        dck.save_state_dict(self._state(), path)
+        _corrupt_file(os.path.join(path, _data_files(path)[0]))
+        with pytest.raises(CheckpointCorruptionError, match="corrupt"):
+            dck.load_state_dict(self._state(0.0), path)
+        with pytest.raises(CheckpointCorruptionError):
+            dck.verify_checkpoint(path)
+
+    def test_truncated_shard_detected(self, tmp_path):
+        path = str(tmp_path / "ck")
+        dck.save_state_dict(self._state(), path)
+        fpath = os.path.join(path, _data_files(path)[0])
+        with open(fpath, "rb") as f:
+            head = f.read(os.path.getsize(fpath) // 2)
+        with open(fpath, "wb") as f:
+            f.write(head)
+        with pytest.raises(CheckpointCorruptionError, match="truncated"):
+            dck.verify_checkpoint(path)
+
+    def test_verify_passes_on_good_checkpoint(self, tmp_path):
+        path = str(tmp_path / "ck")
+        dck.save_state_dict(self._state(), path)
+        dck.verify_checkpoint(path)          # no raise
+
+    def test_async_save_atexit_drain_commits(self, tmp_path, monkeypatch):
+        import threading
+        import paddle2_tpu.distributed.checkpoint as ck
+        path = str(tmp_path / "ack")
+        gate = threading.Event()
+        orig = ck._write_phase
+
+        def slow_write(*a, **kw):
+            gate.wait(timeout=30)
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(ck, "_write_phase", slow_write)
+        h = dck.save_state_dict(self._state(3.0), path, async_save=True)
+        assert not h.is_completed()
+        gate.set()
+        ck._drain_at_exit()                  # what atexit runs
+        assert h.is_completed()
+        dck.verify_checkpoint(path)
+
+    def test_atexit_drain_surfaces_writer_error(self, tmp_path,
+                                                monkeypatch, capsys):
+        import paddle2_tpu.distributed.checkpoint as ck
+        monkeypatch.setattr(ck, "_write_phase",
+                            lambda *a, **kw: (_ for _ in ()).throw(
+                                RuntimeError("disk died")))
+        dck.save_state_dict(self._state(), str(tmp_path / "bad"),
+                            async_save=True)
+        ck._drain_at_exit()
+        assert "disk died" in capsys.readouterr().err
+
+
+# ----------------------------------------------------------------- chaos
+class TestChaosInjector:
+    def test_deterministic_nth_firing(self):
+        inj = chaos.arm("corrupt_shard:2,poison_loss:1")
+        assert not inj.should_fire("corrupt_shard")   # 1st occurrence
+        assert inj.should_fire("corrupt_shard")       # 2nd fires
+        assert not inj.should_fire("corrupt_shard")   # once only
+        assert inj.should_fire("poison_loss")
+        assert not inj.should_fire("fail_commit")     # not armed
+
+    def test_flag_arms_and_disarms(self):
+        paddle.set_flags({"FLAGS_chaos": "fail_commit:1"})
+        assert chaos.active() is not None
+        assert chaos.active().targets["fail_commit"] == (1, None)
+        paddle.set_flags({"FLAGS_chaos": ""})
+        assert chaos.active() is None
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown chaos kind"):
+            chaos.arm("meteor_strike:1")
+
+    def test_corrupt_on_write_caught_by_verify(self, tmp_path):
+        path = str(tmp_path / "ck")
+        chaos.arm("corrupt_shard:1")
+        dck.save_state_dict({"w": paddle.to_tensor([1.0, 2.0])}, path)
+        assert chaos.fired_log()
+        with pytest.raises(CheckpointCorruptionError):
+            dck.verify_checkpoint(path)
+
+    def test_clean_path_inactive(self, tmp_path):
+        assert chaos.active() is None
+        assert chaos.maybe_poison_loss(1.25) == 1.25
+        f = tmp_path / "shard.pkl"
+        f.write_bytes(b"abc")
+        chaos.mutate_shard_file(str(f))      # disarmed: must be a no-op
+        assert f.read_bytes() == b"abc"
+
+
+# ------------------------------------------------------ CheckpointManager
+class TestCheckpointManager:
+    def _state(self, val=1.0):
+        return {"w": paddle.to_tensor(np.full((8, 8), val, np.float32)),
+                "step": int(val)}
+
+    def test_save_restore_and_latest_pointer(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_last=3)
+        mgr.save(self._state(1.0), 10)
+        mgr.save(self._state(2.0), 20)
+        assert mgr.latest_step() == 20
+        tgt = self._state(0.0)
+        assert mgr.restore(tgt) == 20
+        np.testing.assert_array_equal(tgt["w"].numpy(),
+                                      np.full((8, 8), 2.0, np.float32))
+        assert tgt["step"] == 2
+
+    def test_retention_prunes_oldest(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_last=2)
+        for i, step in enumerate((10, 20, 30), start=1):
+            mgr.save(self._state(float(i)), step)
+        assert mgr.steps() == [20, 30]
+
+    def test_rollback_on_disk_corruption(self, tmp_path):
+        """Acceptance: a corrupted shard in save N is detected on load
+        and training resumes from verified checkpoint N-1 — no manual
+        intervention."""
+        mgr = CheckpointManager(str(tmp_path), keep_last=3)
+        mgr.save(self._state(1.0), 10)
+        mgr.save(self._state(2.0), 20)
+        newest = os.path.join(str(tmp_path), "step_00000020")
+        _corrupt_file(os.path.join(newest, _data_files(newest)[0]))
+        tgt = self._state(0.0)
+        assert mgr.restore(tgt) == 10        # rolled back
+        np.testing.assert_array_equal(tgt["w"].numpy(),
+                                      np.full((8, 8), 1.0, np.float32))
+        assert mgr.latest_step() == 10       # pointer rolled back too
+
+    def test_chaos_corrupted_save_never_commits(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_last=3)
+        mgr.save(self._state(1.0), 10)
+        chaos.arm("truncate_shard:1")
+        with pytest.raises(CheckpointVerificationError):
+            mgr.save(self._state(2.0), 20)
+        chaos.disarm()
+        assert mgr.latest_step() == 10       # latest never moved
+        # failed save is quarantined: kept for post-mortem but invisible
+        # to retention accounting and restore candidates
+        assert mgr.steps() == [10]
+        assert os.path.isdir(str(tmp_path / "step_00000020.failed"))
+        tgt = self._state(0.0)
+        assert mgr.restore(tgt) == 10
+
+    def test_failed_save_does_not_consume_retention_slot(self, tmp_path):
+        """keep_last counts only real candidates: a failed save must not
+        push a VERIFIED checkpoint out of the retention window."""
+        mgr = CheckpointManager(str(tmp_path), keep_last=2)
+        mgr.save(self._state(1.0), 10)
+        chaos.arm("corrupt_shard:1")
+        with pytest.raises(CheckpointVerificationError):
+            mgr.save(self._state(2.0), 20)
+        chaos.disarm()
+        mgr.save(self._state(3.0), 30)
+        assert mgr.steps() == [10, 30]       # 10 kept: window is [10, 30]
+        _corrupt_file(os.path.join(str(tmp_path), "step_00000030",
+                                   _data_files(str(tmp_path
+                                                   / "step_00000030"))[0]))
+        assert mgr.restore(self._state(0.0)) == 10   # rollback still works
+
+    def test_chaos_commit_failure_keeps_previous(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_last=3)
+        mgr.save(self._state(1.0), 10)
+        chaos.arm("fail_commit:1")
+        with pytest.raises(CheckpointVerificationError):
+            mgr.save(self._state(2.0), 20)
+        chaos.disarm()
+        assert mgr.restore(self._state(0.0)) == 10
+
+    def test_restore_empty_returns_none(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        assert mgr.restore(self._state(0.0)) is None
+
+
+# ------------------------------------------------------------ preemption
+class TestPreemption:
+    def test_sigterm_latches_and_handler_restored(self):
+        prev = signal.getsignal(signal.SIGTERM)
+        with PreemptionGuard() as guard:
+            assert not guard.preempted
+            os.kill(os.getpid(), signal.SIGTERM)
+            for _ in range(100):
+                if guard.preempted:
+                    break
+                time.sleep(0.01)
+            assert guard.preempted
+        assert signal.getsignal(signal.SIGTERM) == prev
+
+    def test_request_is_programmatic_preemption(self):
+        with PreemptionGuard() as guard:
+            guard.request()
+            assert guard.preempted and preemption.preempted()
+
+    def test_saving_marker_lifecycle(self, tmp_path, monkeypatch):
+        marker = str(tmp_path / "save.marker")
+        monkeypatch.setenv(preemption.MARKER_ENV, marker)
+        with PreemptionGuard() as guard:
+            with guard.saving():
+                assert os.path.exists(marker)
+            assert not os.path.exists(marker)
+
+    def test_fit_checkpoints_then_exits_at_step_boundary(self, tmp_path):
+        """hapi wiring: SIGTERM mid-epoch -> one more step boundary ->
+        save to save_dir -> loop exits; no further batches run."""
+        from paddle2_tpu.hapi.callbacks import Callback
+        from paddle2_tpu.io.dataloader import Dataset
+
+        class Data(Dataset):
+            def __len__(self):
+                return 64
+
+            def __getitem__(self, i):
+                rs = np.random.RandomState(i)
+                return (rs.randn(6).astype(np.float32),
+                        rs.randn(3).astype(np.float32))
+
+        seen = []
+
+        class Preempt(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                seen.append(step)
+                if len(seen) == 2:
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+        m = paddle.Model(_model())
+        m.prepare(opt.SGD(learning_rate=0.01,
+                          parameters=m.parameters()),
+                  F.mse_loss)
+        save_dir = str(tmp_path / "run")
+        m.fit(Data(), batch_size=8, epochs=4, verbose=0,
+              save_dir=save_dir, callbacks=[Preempt()])
+        assert len(seen) <= 4                # stopped mid-epoch-1
+        assert os.path.exists(os.path.join(save_dir,
+                                           "preempted.pdparams"))
+        # the preemption checkpoint is loadable and integrity-clean
+        m2 = paddle.Model(_model(seed=3))
+        m2.load(os.path.join(save_dir, "preempted"))
+
+
+# ---------------------------------------------------------- ReliableStep
+class TestReliableStep:
+    def _train(self, poison_spec=None, steps=6):
+        model = _model(seed=0)
+        o = opt.SGD(learning_rate=0.05, parameters=model.parameters())
+        step_fn = _make_step(model, o)
+        rs = ReliableStep(model, o, snapshot_every=1,
+                          sleep=lambda _: None)
+        if poison_spec:
+            chaos.arm(poison_spec)
+        losses = []
+        for i in range(steps):
+            x, y = _batch(i)
+            losses.append(rs.run(step_fn, x, y))
+        rs.finalize()
+        chaos.disarm()
+        return model, rs, losses
+
+    def test_clean_run_matches_unwrapped(self):
+        model_a, rs, _ = self._train()
+        assert rs.stats["retries"] == 0
+        model_b = _model(seed=0)
+        o = opt.SGD(learning_rate=0.05, parameters=model_b.parameters())
+        step_fn = _make_step(model_b, o)
+        for i in range(6):
+            x, y = _batch(i)
+            step_fn(x, y)
+        for a, b in zip(model_a.parameters(), model_b.parameters()):
+            np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+    def test_poisoned_step_retried_bit_exact(self):
+        """Acceptance: a poisoned step is retried from the in-memory
+        snapshot and the run ends bit-identical to a clean one."""
+        clean_model, _, _ = self._train()
+        faulty_model, rs, _ = self._train(poison_spec="poison_loss:3")
+        assert rs.stats["retries"] >= 1 and rs.stats["restores"] >= 1
+        assert [k for k, _ in chaos.fired_log()] == []  # disarmed again
+        for a, b in zip(clean_model.parameters(),
+                        faulty_model.parameters()):
+            np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+    def test_persistent_failure_exhausts_budget(self):
+        model = _model()
+        o = opt.SGD(learning_rate=0.05, parameters=model.parameters())
+        rs = ReliableStep(model, o, snapshot_every=1, max_retries=2,
+                          retry_budget=4, sleep=lambda _: None)
+
+        def always_nan(x, y):
+            return paddle.to_tensor(float("nan"))
+
+        x, y = _batch(0)
+        with pytest.raises(RetryBudgetExceededError):
+            for _ in range(8):
+                rs.run(always_nan, x, y)
+                rs.finalize()
+
+    def test_step_fn_can_request_retry(self):
+        model = _model()
+        o = opt.SGD(learning_rate=0.05, parameters=model.parameters())
+        rs = ReliableStep(model, o, sleep=lambda _: None)
+        calls = []
+
+        def step(x, y):
+            calls.append(1)
+            if len(calls) == 1:
+                raise TransientStepError("injected")
+            return paddle.to_tensor(0.5)
+
+        x, y = _batch(0)
+        out = rs.run(step, x, y)
+        assert float(np.asarray(out._data)) == 0.5
+        assert len(calls) == 2 and rs.stats["retries"] == 1
+
+    def test_watchdog_timeout_counts_as_transient(self):
+        from paddle2_tpu.distributed.watchdog import CommWatchdog
+        paddle.set_flags({"FLAGS_collective_timeout_s": 5.0})
+        try:
+            wd = CommWatchdog.get()
+            model = _model()
+            o = opt.SGD(learning_rate=0.05,
+                        parameters=model.parameters())
+            step_fn = _make_step(model, o)
+            rs = ReliableStep(model, o, sleep=lambda _: None)
+            x, y = _batch(0)
+            rs.run(step_fn, x, y)
+            with wd._mu:                    # simulate a flagged overrun
+                wd._timeouts.append("allreduce_dp")
+            rs.run(step_fn, x, y)           # settle detects + replays
+            assert rs.stats["retries"] >= 1
+            assert wd.consume_timeouts() == []
+        finally:
+            paddle.set_flags({"FLAGS_collective_timeout_s": 0.0})
+
+
+# -------------------------------------------------- end-to-end chaos loop
+def test_chaos_end_to_end_inject_detect_recover_converge(tmp_path):
+    """The full loop: poison a step (retried from host snapshot), corrupt
+    the newest checkpoint on disk (detected, rolled back to N-1), resume,
+    and training still converges — no human in the loop."""
+    root = str(tmp_path / "ckpts")
+    model = _model(seed=0)
+    o = opt.SGD(learning_rate=0.05, parameters=model.parameters())
+    step_fn = _make_step(model, o)
+    mgr = CheckpointManager(root, keep_last=3)
+    rs = ReliableStep(model, o, snapshot_every=1, sleep=lambda _: None)
+    ex, ey = _batch(100)                     # fixed held-out batch
+
+    def eval_loss(net):
+        return float(np.asarray(F.mse_loss(net(ex), ey)._data))
+
+    first = eval_loss(model)                 # untrained reference
+    chaos.arm("poison_loss:4")
+    for i in range(8):
+        x, y = _batch(i)
+        rs.run(step_fn, x, y)
+        if (i + 1) % 2 == 0:
+            rs.finalize()
+            mgr.save({"model": model.state_dict(),
+                      "opt_step": i + 1}, i + 1)
+    rs.finalize()
+    chaos.disarm()
+    assert rs.stats["retries"] >= 1          # the poison was recovered
+
+    # corruption lands on the NEWEST committed checkpoint post-commit
+    newest = os.path.join(root, "step_00000008")
+    _corrupt_file(os.path.join(newest, _data_files(newest)[0]))
+
+    # simulated restart: fresh process state resumes WITHOUT intervention
+    model2 = _model(seed=9)
+    state = {"model": model2.state_dict(), "opt_step": 0}
+    resumed = CheckpointManager(root, keep_last=3).restore(state)
+    assert resumed == 6                      # rolled back to N-1
+    assert state["opt_step"] == 6
+    o2 = opt.SGD(learning_rate=0.05, parameters=model2.parameters())
+    step_fn2 = _make_step(model2, o2)
+    for i in range(6, 10):
+        x, y = _batch(i)
+        step_fn2(x, y)
+    last = eval_loss(model2)
+    assert np.isfinite(last) and last < first   # converged anyway
+
+
+# ------------------------------------------------- launcher grace period
+class TestLauncherPreemptForwarder:
+    def _worker(self, code):
+        import subprocess
+        import sys
+        p = subprocess.Popen([sys.executable, "-c", code],
+                             stdout=subprocess.PIPE)
+        assert b"ready" in p.stdout.readline()
+        return p
+
+    def test_grace_extends_while_save_in_flight(self, tmp_path,
+                                                monkeypatch):
+        """A worker whose preemption save outlives the base grace is NOT
+        SIGKILLed: the save-in-flight marker extends the deadline."""
+        import importlib
+        lmain = importlib.import_module(
+            'paddle2_tpu.distributed.launch.main')
+        prefix = str(tmp_path / "mk")
+        monkeypatch.setattr(lmain, "_marker_prefix", lambda: prefix)
+        marker = prefix + ".0"
+        p = self._worker(
+            "import signal, sys, time, os\n"
+            f"m = {marker!r}\n"
+            "def h(s, f):\n"
+            "    open(m, 'w').write('x')\n"
+            "    time.sleep(1.2)\n"           # save outlives grace=0.4
+            "    os.remove(m)\n"
+            "    sys.exit(0)\n"
+            "signal.signal(signal.SIGTERM, h)\n"
+            "print('ready', flush=True)\n"
+            "time.sleep(60)\n")
+        fwd = lmain._PreemptForwarder(grace=0.4)
+        fwd.procs = [p]
+        fwd._handle(signal.SIGTERM, None)     # forward + latch
+        fwd.drain()
+        assert p.wait() == 0                  # exited itself, not killed
+
+    def test_grace_is_bounded_without_marker(self, tmp_path, monkeypatch):
+        """A worker that ignores SIGTERM and holds no marker is killed
+        once the grace period lapses — the launcher never wedges."""
+        import importlib
+        lmain = importlib.import_module(
+            'paddle2_tpu.distributed.launch.main')
+        monkeypatch.setattr(lmain, "_marker_prefix",
+                            lambda: str(tmp_path / "mk"))
+        p = self._worker(
+            "import signal, time\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "print('ready', flush=True)\n"
+            "time.sleep(60)\n")
+        fwd = lmain._PreemptForwarder(grace=0.3)
+        fwd.procs = [p]
+        t0 = time.time()
+        fwd._handle(signal.SIGTERM, None)
+        fwd.drain()
+        assert p.wait() != 0                  # SIGKILLed
+        assert time.time() - t0 < 10
+
+
+# ------------------------------------------------------- elastic + master
+def test_elastic_heartbeat_atomic_and_retried(tmp_path, monkeypatch):
+    from paddle2_tpu.distributed.fleet.elastic import ElasticManager
+    mgr = ElasticManager(store_dir=str(tmp_path), heartbeat_interval=0.0)
+    real_replace = os.replace
+    fails = {"n": 0}
+
+    def flaky_replace(src, dst):
+        if fails["n"] == 0:
+            fails["n"] += 1
+            raise OSError("transient NFS hiccup")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", flaky_replace)
+    monkeypatch.setattr(time, "sleep", lambda _: None)
+    mgr.heartbeat()
+    monkeypatch.undo()
+    assert fails["n"] == 1                   # retried through the hiccup
+    assert mgr.alive_ranks() == [mgr.rank]
+    assert not [f for f in os.listdir(str(tmp_path))
+                if f.endswith(".tmp")]       # no partial files visible
+
+
+def test_master_client_polling_uses_backoff(monkeypatch):
+    from paddle2_tpu.distributed.launch.master import MasterClient
+    import paddle2_tpu.distributed.fault_tolerance.retry as rmod
+    delays = []
+    monkeypatch.setattr(rmod.time, "sleep", delays.append)
+    c = MasterClient("127.0.0.1:1", timeout=0.2, retries=3,
+                     retry_wait=0.05)
+    with pytest.raises(ConnectionError):
+        c.layout()
+    assert delays == [0.05, 0.1]             # exponential, retries-1 sleeps
+
+
+# ------------------------------------------------------------------- hub
+def test_hub_force_reload_honored(tmp_path):
+    import paddle2_tpu.hub as hub
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    counter = repo / "count.txt"
+    (repo / "hubconf.py").write_text(
+        "import pathlib\n"
+        "p = pathlib.Path(__file__).parent / 'count.txt'\n"
+        "p.write_text(str(int(p.read_text() or 0) + 1) "
+        "if p.exists() else '1')\n"
+        "def make(scale=2.0):\n"
+        "    'doc for make'\n"
+        "    return scale * 3\n")
+    assert hub.load(str(repo), "make", scale=2.0) == 6.0
+    assert counter.read_text() == "1"
+    assert "make" in hub.list(str(repo))     # cached: not re-executed
+    assert hub.help(str(repo), "make") == "doc for make"
+    assert counter.read_text() == "1"
+    assert hub.load(str(repo), "make", force_reload=True, scale=1.0) == 3.0
+    assert counter.read_text() == "2"        # refresh re-executed

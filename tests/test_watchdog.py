@@ -1,0 +1,121 @@
+"""Collective watchdog coverage (distributed/watchdog.py).
+
+Models the reference comm_task_manager behaviors: the monitor thread
+flags a deadline overrun, the diagnostic names every in-flight op tag
+(the rank-desync clue), and the waiter threads shut down cleanly once
+the watched op completes. The overrun itself is produced by the chaos
+harness's delay_collective fault, so this doubles as the end-to-end test
+of that injection path.
+"""
+
+import logging
+import time
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+from paddle2_tpu.distributed.fault_tolerance import chaos
+from paddle2_tpu.distributed.watchdog import CommWatchdog, logger
+
+
+class _Records(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.ERROR)
+        self.messages = []
+
+    def emit(self, record):
+        self.messages.append(record.getMessage())
+
+
+@pytest.fixture
+def errlog():
+    """The watchdog logger has propagate=False (own stderr handler), so
+    capture by attaching a handler directly instead of caplog."""
+    h = _Records()
+    logger.addHandler(h)
+    yield h
+    logger.removeHandler(h)
+
+
+@pytest.fixture(autouse=True)
+def _watchdog_env():
+    chaos.disarm()
+    yield
+    paddle.set_flags({"FLAGS_collective_timeout_s": 0.0})
+    chaos.disarm()
+    wd = CommWatchdog.get()
+    deadline = time.time() + 5
+    while wd.inflight_count() and time.time() < deadline:
+        time.sleep(0.02)
+    wd.consume_timeouts()
+
+
+def _wait_until(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def test_disabled_by_default_registers_nothing():
+    wd = CommWatchdog.get()
+    assert not wd.enabled()
+    wd.watch("noop", np.zeros(2))            # no flag: must be a no-op
+    assert wd.inflight_count() == 0
+
+
+def test_monitor_flags_overrun_and_logs_all_inflight_tags(errlog):
+    """A collective held past its deadline is flagged by the monitor,
+    the diagnostic lists EVERY in-flight tag, and the timeout is queued
+    for consume_timeouts() (the ReliableStep detection hook)."""
+    import jax.numpy as jnp
+    paddle.set_flags({"FLAGS_collective_timeout_s": 0.2})
+    chaos.arm("delay_collective:1:0.8")      # hold the 1st op in flight
+    wd = CommWatchdog.get()
+    arr = jnp.ones((4,))
+    wd.watch("allreduce_dp", arr)
+    wd.watch("allgather_mp", arr)            # completes immediately
+    assert _wait_until(lambda: any("TIMEOUT" in m
+                                   for m in errlog.messages))
+    overrun = [m for m in errlog.messages if "TIMEOUT" in m]
+    assert any("allreduce_dp" in m for m in overrun)
+    # the in-flight dump names the delayed op (desync diagnostic)
+    assert any("in-flight" in m and "allreduce_dp" in m for m in overrun)
+    assert _wait_until(lambda: "allreduce_dp" in wd.consume_timeouts())
+    assert _wait_until(lambda: wd.inflight_count() == 0)
+
+
+def test_waiters_shut_down_cleanly_when_op_completes(errlog):
+    """Ops that complete within the deadline: waiter threads drain, the
+    monitor parks itself, and no timeout is recorded."""
+    import jax.numpy as jnp
+    paddle.set_flags({"FLAGS_collective_timeout_s": 5.0})
+    wd = CommWatchdog.get()
+    wd.consume_timeouts()                    # drain leftovers
+    for i in range(4):
+        wd.watch(f"op_{i}", jnp.full((8,), float(i)))
+    assert _wait_until(lambda: wd.inflight_count() == 0)
+    # monitor parks once the table empties (respawned by the next watch)
+    assert _wait_until(lambda: wd._monitor is None
+                       or not wd._monitor.is_alive())
+    assert wd.consume_timeouts() == []
+    assert not any("TIMEOUT" in m for m in errlog.messages)
+
+
+def test_delayed_op_still_completes_after_flagging():
+    """delay_collective holds the op past the deadline but the op DOES
+    finish: the entry must clear (no leak) even though it was flagged."""
+    import jax.numpy as jnp
+    paddle.set_flags({"FLAGS_collective_timeout_s": 0.15})
+    chaos.arm("delay_collective:1:0.5")
+    wd = CommWatchdog.get()
+    wd.consume_timeouts()
+    wd.watch("slow_psum", jnp.ones((2,)))
+    flagged = []
+    assert _wait_until(
+        lambda: bool(flagged.extend(wd.consume_timeouts()) or flagged))
+    assert "slow_psum" in flagged
+    assert _wait_until(lambda: wd.inflight_count() == 0)
