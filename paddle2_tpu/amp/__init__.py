@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from ..framework import core
 from ..framework.tensor import Tensor
 from . import amp_lists
-from .grad_scaler import GradScaler  # noqa: F401
+from .grad_scaler import GradScaler, ScaleSaturationError  # noqa: F401
 
 
 class _AmpState:

@@ -3,21 +3,46 @@
 Dynamic loss scaling for fp16; with bf16 (the TPU default) scaling is usually
 unnecessary — enable=False makes every method a passthrough, matching the
 reference's behavior knobs.
+
+Reliability posture (fault_tolerance.numerics wiring):
+
+* unscale_ computes ONE fused device-side non-finite sentinel over all
+  gradients (no per-parameter host syncs; the old path issued one
+  ``bool(jnp.any(...))`` readback per parameter) and reads it back
+  exactly once — the host sync the skip decision needs anyway.
+* the sentinel is ALL-REDUCED across the data-parallel ranks before any
+  scale update (``numerics.all_reduce_found_inf``), so every rank skips
+  the same steps and backs the scale off identically — multi-controller
+  jobs cannot silently diverge on skip-vs-step.
+* the scale is clamped to ``[min_loss_scaling, max_loss_scaling]`` and
+  ``max_consecutive_skips`` bad steps in a row raise
+  :class:`ScaleSaturationError` instead of silently scaling toward zero
+  while training goes nowhere.
 """
 
 from __future__ import annotations
 
 from typing import Dict
 
-import jax.numpy as jnp
-
 from ..framework.tensor import Tensor
+
+
+class ScaleSaturationError(RuntimeError):
+    """Dynamic loss scaling skipped too many consecutive steps: the
+    gradients are persistently non-finite, which no scale can fix —
+    a numerics bug, not an overflow. Bisect with FLAGS_debug_anomaly."""
 
 
 class GradScaler:
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
-                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True,
+                 min_loss_scaling=1.0, max_loss_scaling=2.0 ** 32,
+                 max_consecutive_skips=100):
+        if min_loss_scaling > max_loss_scaling:
+            raise ValueError(
+                f"min_loss_scaling ({min_loss_scaling}) must be <= "
+                f"max_loss_scaling ({max_loss_scaling})")
         self._enable = enable
         self._scale = float(init_loss_scaling)
         self._incr_ratio = incr_ratio
@@ -25,8 +50,12 @@ class GradScaler:
         self._incr_every = incr_every_n_steps
         self._decr_every = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
+        self._min_scale = float(min_loss_scaling)
+        self._max_scale = float(max_loss_scaling)
+        self._max_consecutive_skips = int(max_consecutive_skips)
         self._good_steps = 0
         self._bad_steps = 0
+        self._consecutive_skips = 0
         # per-optimizer unscale/inf flags (reference OptimizerState map):
         # a GAN-style step with two optimizers must not let one optimizer's
         # scale()/unscale_ cycle erase the other's inf detection
@@ -66,14 +95,17 @@ class GradScaler:
         st = self._state_for(optimizer)
         if not self._enable or st["unscaled"]:
             return
+        from ..distributed.fault_tolerance import chaos, numerics
+        chaos.maybe_poison_grads(optimizer)
         inv = 1.0 / self._scale
-        found_inf = False
-        for p in optimizer._parameter_list():
-            if p.grad is not None:
-                g = p.grad._data.astype(jnp.float32) * inv
-                if bool(jnp.any(~jnp.isfinite(g))):
-                    found_inf = True
-                p.grad._replace_data(g.astype(p.grad._data.dtype))
+        # one fused sentinel over ALL grads + one host readback — not a
+        # per-parameter any()/bool() chain
+        flag, unscaled = numerics.grads_nonfinite_flag(optimizer, inv)
+        for p, g in unscaled:
+            p.grad._replace_data(g.astype(p.grad._data.dtype))
+        # rank-consistent BEFORE any skip decision or scale update
+        found_inf = numerics.flag_to_host(
+            numerics.all_reduce_found_inf(flag))
         st["found_inf"] = found_inf
         st["unscaled"] = True
         self._cycle_found_inf = self._cycle_found_inf or found_inf
@@ -105,16 +137,28 @@ class GradScaler:
             self._cycle_found_inf = False
             return
         if self._cycle_found_inf:
+            self._consecutive_skips += 1
+            if self._consecutive_skips >= self._max_consecutive_skips:
+                raise ScaleSaturationError(
+                    f"{self._consecutive_skips} consecutive steps "
+                    f"produced non-finite gradients (scale now "
+                    f"{self._scale:g}, floor {self._min_scale:g}) — no "
+                    f"loss scale can fix persistently bad numerics; "
+                    f"bisect with FLAGS_debug_anomaly=1 or "
+                    f"fault_tolerance.numerics.debug_anomaly()")
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._scale = max(self._scale * self._decr_ratio,
+                                  self._min_scale)
                 self._bad_steps = 0
         else:
+            self._consecutive_skips = 0
             self._good_steps += 1
             self._bad_steps = 0
             if self._good_steps >= self._incr_every:
-                self._scale *= self._incr_ratio
+                self._scale = min(self._scale * self._incr_ratio,
+                                  self._max_scale)
                 self._good_steps = 0
         self._opt_state.clear()
         self._cycle_found_inf = False
@@ -124,9 +168,14 @@ class GradScaler:
                 "decr_ratio": self._decr_ratio,
                 "incr_every_n_steps": self._incr_every,
                 "decr_every_n_nan_or_inf": self._decr_every,
-                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps,
+                "min_scale": self._min_scale, "max_scale": self._max_scale,
+                "consecutive_skips": self._consecutive_skips}
 
     def load_state_dict(self, state: Dict) -> None:
         self._scale = state.get("scale", self._scale)
         self._good_steps = state.get("good_steps", 0)
         self._bad_steps = state.get("bad_steps", 0)
+        self._min_scale = state.get("min_scale", self._min_scale)
+        self._max_scale = state.get("max_scale", self._max_scale)
+        self._consecutive_skips = state.get("consecutive_skips", 0)

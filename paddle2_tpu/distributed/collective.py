@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec
 
 from ..framework.tensor import Tensor
 from . import mesh as mesh_mod
+from .watchdog import CollectiveTimeout  # re-export: raised by timeouts
 
 P = PartitionSpec
 
@@ -42,7 +43,8 @@ __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
            "isend", "irecv", "barrier", "ppermute", "wait",
            "batch_isend_irecv", "P2POp", "is_initialized",
            "destroy_process_group", "gather", "alltoall_single",
-           "broadcast_object_list", "scatter_object_list"]
+           "broadcast_object_list", "scatter_object_list",
+           "CollectiveTimeout"]
 
 
 class ReduceOp:
@@ -410,7 +412,13 @@ def _to_mesh(arr: jax.Array) -> jax.Array:
     return jax.device_put(arr, NamedSharding(mesh, spec))
 
 
-def _run(kind: str, t: Tensor, group: Optional[Group], extra=()) -> Tensor:
+def _group_desc(group: Optional[Group]) -> str:
+    g = group if group is not None else _world_group()
+    return f"axes={g.axes} nranks={g.nranks}"
+
+
+def _run(kind: str, t: Tensor, group: Optional[Group], extra=(),
+         timeout: Optional[float] = None) -> Tensor:
     _check_rank_major(t, group)
     arr = t._data
     # per-rank scalars ([W] global): lift to [W, 1] so axis-0 kernels work,
@@ -425,6 +433,12 @@ def _run(kind: str, t: Tensor, group: Optional[Group], extra=()) -> Tensor:
         out = out[..., 0]
     from .watchdog import watch as _watch
     _watch(kind, out)
+    if timeout is not None:
+        # deadline-aware: bound the wait on the dispatched result — a
+        # hang raises CollectiveTimeout naming group/op/stragglers
+        from .watchdog import wait_with_deadline
+        wait_with_deadline(kind, out, float(timeout),
+                           group_desc=_group_desc(group))
     t._replace_data(out)
     return t
 
@@ -433,13 +447,40 @@ def _run(kind: str, t: Tensor, group: Optional[Group], extra=()) -> Tensor:
 # public API (communication/all_reduce.py etc. parity)
 # --------------------------------------------------------------------------
 
+def _deadline_process_level(kind: str, t: Tensor, extra=(),
+                            timeout: Optional[float] = None) -> Tensor:
+    """Multi-controller collectives block inside the coordination
+    service, so the deadline wraps the WHOLE call on a helper thread.
+    The thread dispatches into a SHADOW tensor and the caller commits
+    only on an in-deadline return — an abandoned thread that wakes late
+    can never mutate the live tensor under a retried step. Note the
+    gang itself stays desynced after a timeout (this rank dispatched a
+    collective its peers may still complete); pair deadlines with
+    FLAGS_collective_abort_on_timeout for launcher-driven gang restart,
+    exactly the reference AbortComm posture."""
+    if timeout is None:
+        return _run_process_level(kind, t, extra=extra)
+    from .watchdog import run_with_deadline
+    shadow = Tensor(t._data)
+
+    def _dispatch():
+        return _run_process_level(kind, shadow, extra=extra)
+
+    out = run_with_deadline(kind, _dispatch, float(timeout),
+                            group_desc=f"processes={jax.process_count()}")
+    t._replace_data(out._data)
+    return t
+
+
 def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM,
-               group: Optional[Group] = None, sync_op: bool = True):
+               group: Optional[Group] = None, sync_op: bool = True,
+               timeout: Optional[float] = None):
     if _multiprocess():
         _mp_group_guard(group)
-        _run_process_level(f"all_reduce_{op}", tensor)
+        _deadline_process_level(f"all_reduce_{op}", tensor,
+                                timeout=timeout)
         return _Task(tensor)
-    _run(f"all_reduce_{op}", tensor, group)
+    _run(f"all_reduce_{op}", tensor, group, timeout=timeout)
     return _Task(tensor)
 
 
@@ -488,7 +529,7 @@ def all_gather_object(object_list: list, obj, group: Optional[Group] = None):
 
 def reduce_scatter(tensor: Tensor, tensor_or_tensor_list=None,
                    op: str = ReduceOp.SUM, group: Optional[Group] = None,
-                   sync_op: bool = True):
+                   sync_op: bool = True, timeout: Optional[float] = None):
     t = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
     if isinstance(t, list):
         from ..ops.manipulation import concat
@@ -500,37 +541,40 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list=None,
         raise NotImplementedError("reduce_scatter supports SUM on TPU")
     if _multiprocess():
         _mp_group_guard(group)
-        out = _run_process_level("reduce_scatter", t)
+        out = _deadline_process_level("reduce_scatter", t, timeout=timeout)
         if t is not tensor:
             tensor._replace_data(out._data)
         return _Task(tensor)
-    out = _run("reduce_scatter", t, group)
+    out = _run("reduce_scatter", t, group, timeout=timeout)
     if t is not tensor:
         tensor._replace_data(out._data)
     return _Task(tensor)
 
 
 def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
-              sync_op: bool = True):
+              sync_op: bool = True, timeout: Optional[float] = None):
     g = group if group is not None else _world_group()
     rel = g.get_group_rank(src) if src in g.ranks else src
     if _multiprocess():
         _mp_group_guard(group)
-        _run_process_level("broadcast", tensor, extra=(int(src),))
+        _deadline_process_level("broadcast", tensor, extra=(int(src),),
+                                timeout=timeout)
         return _Task(tensor)
-    _run("broadcast", tensor, group, extra=(int(rel),))
+    _run("broadcast", tensor, group, extra=(int(rel),), timeout=timeout)
     return _Task(tensor)
 
 
 def reduce(tensor: Tensor, dst: int = 0, op: str = ReduceOp.SUM,
-           group: Optional[Group] = None, sync_op: bool = True):
+           group: Optional[Group] = None, sync_op: bool = True,
+           timeout: Optional[float] = None):
     g = group if group is not None else _world_group()
     rel = g.get_group_rank(dst) if dst in g.ranks else dst
     if _multiprocess():
         _mp_group_guard(group)
-        _run_process_level("reduce", tensor, extra=(int(dst), op))
+        _deadline_process_level("reduce", tensor, extra=(int(dst), op),
+                                timeout=timeout)
         return _Task(tensor)
-    _run("reduce", tensor, group, extra=(int(rel), op))
+    _run("reduce", tensor, group, extra=(int(rel), op), timeout=timeout)
     return _Task(tensor)
 
 
@@ -690,7 +734,66 @@ class P2POp:
         self.op, self.tensor, self.peer, self.group = op, tensor, peer, group
 
 
+def _validate_p2p_batch(p2p_op_list: List[P2POp]) -> None:
+    """Pre-dispatch validation: the batch must pair up — recvs match
+    sends FIFO, shapes/dtypes agree, and nothing is left dangling.
+    Catching this here turns a shape mismatch deep inside an XLA
+    ppermute (or a deadlocked half-pair) into a descriptive error
+    naming the offending list entries."""
+    # per-group FIFO of pending sends: sends already queued on the group
+    # (earlier bare send() calls) count too, labelled as such
+    pending: Dict[int, List[Tuple[str, Tensor]]] = {}
+
+    def _fifo(gr):
+        g = gr if gr is not None else _world_group()
+        if id(g) not in pending:
+            pending[id(g)] = [("a send queued before this batch", t)
+                              for t, _ in g._p2p_queue]
+        return pending[id(g)]
+
+    for i, op in enumerate(p2p_op_list):
+        if not isinstance(op, P2POp):
+            raise TypeError(
+                f"batch_isend_irecv entry {i} is {type(op).__name__}, "
+                f"expected P2POp")
+        if op.op is send:
+            _fifo(op.group).append((f"the send at entry {i}", op.tensor))
+        elif op.op is recv:
+            fifo = _fifo(op.group)
+            if not fifo:
+                raise ValueError(
+                    f"batch_isend_irecv: recv at entry {i} has no "
+                    f"matching earlier send in its group — sends pair "
+                    f"FIFO with recvs; reorder the op list so every "
+                    f"recv follows its send")
+            label, sent = fifo.pop(0)
+            if tuple(sent.shape) != tuple(op.tensor.shape):
+                raise ValueError(
+                    f"batch_isend_irecv: {label} (shape "
+                    f"{tuple(sent.shape)}) pairs with recv at entry "
+                    f"{i} (shape {tuple(op.tensor.shape)}) — buffer "
+                    f"shapes must match")
+            if str(sent._data.dtype) != str(op.tensor._data.dtype):
+                raise ValueError(
+                    f"batch_isend_irecv: {label} (dtype "
+                    f"{sent._data.dtype}) pairs with recv at entry "
+                    f"{i} (dtype {op.tensor._data.dtype}) — buffer "
+                    f"dtypes must match")
+        else:
+            raise ValueError(
+                f"batch_isend_irecv entry {i}: op must be isend/irecv, "
+                f"got {getattr(op.op, '__name__', op.op)!r}")
+    dangling = [lbl for fifo in pending.values()
+                for lbl, _ in fifo if lbl.startswith("the send at")]
+    if dangling:
+        raise ValueError(
+            f"batch_isend_irecv: {', '.join(dangling)} ha"
+            f"{'s' if len(dangling) == 1 else 've'} no matching recv in "
+            f"the batch — each send needs a recv or the pair deadlocks")
+
+
 def batch_isend_irecv(p2p_op_list: List[P2POp]) -> List[_Task]:
+    _validate_p2p_batch(p2p_op_list)
     tasks = []
     for op in p2p_op_list:
         tasks.append(op.op(op.tensor, op.peer, group=op.group))
@@ -701,15 +804,29 @@ def wait(tensor: Tensor, group: Optional[Group] = None, use_calc_stream=True):
     jax.block_until_ready(tensor._data)
 
 
-def barrier(group: Optional[Group] = None):
+def barrier(group: Optional[Group] = None,
+            timeout: Optional[float] = None):
+    """Block until every rank arrives. With ``timeout`` (seconds) the
+    wait is DEADLINE-AWARE: a desynced gang raises CollectiveTimeout
+    (naming group, op tag, and suspected straggler ranks) instead of
+    blocking forever — the unattended-training contract."""
     if _multiprocess():
         from jax.experimental import multihost_utils as mhu
-        mhu.sync_global_devices("paddle2_tpu.distributed.barrier")
+
+        def _sync():
+            mhu.sync_global_devices("paddle2_tpu.distributed.barrier")
+
+        if timeout is None:
+            _sync()
+            return _Task()
+        from .watchdog import run_with_deadline
+        run_with_deadline("barrier", _sync, float(timeout),
+                          group_desc=f"processes={jax.process_count()}")
         return _Task()
     mesh = mesh_mod.get_mesh()
     w = mesh_mod.world_size()
     token = Tensor(jnp.zeros((w,), jnp.float32))
-    _run("all_reduce_sum", token, group)
+    _run("all_reduce_sum", token, group, timeout=timeout)
     token.numpy()
     return _Task()
 

@@ -21,20 +21,39 @@ the loop with RECOVERY across four layers:
 4. **Chaos harness** — :mod:`.chaos`, a deterministic flag-controlled
    fault injector (``FLAGS_chaos``) the test suite and
    ``bench.py --inject-fault`` drive end-to-end.
+5. **Self-healing input pipeline** — the shm DataLoader respawns
+   crashed workers (bounded budget, in-flight batches resubmitted) and
+   escalates with :class:`WorkerCrashError` (a
+   :class:`TransientStepError`); ``DataLoader.state_dict`` +
+   :meth:`CheckpointManager.register_stateful` resume the data stream
+   at the exact next batch after a preempt/rollback.
+6. **Rank-consistent numerical guardrails** — :mod:`.numerics`: a
+   fused device-side non-finite sentinel (one host readback per step),
+   data-parallel all-reduced ``found_inf`` in ``amp.GradScaler``, and
+   the opt-in ``debug_anomaly`` bisection.
+7. **Deadline-aware collectives** — ``barrier``/``all_reduce``-family
+   ``timeout=`` raises :class:`CollectiveTimeout` naming the group, op
+   tag, and suspected stragglers (:class:`StragglerDetector` step-time
+   gossip); ReliableStep retries it like any transient fault.
 """
 
 from . import chaos  # noqa: F401
+from . import numerics  # noqa: F401
 from .manager import CheckpointManager, CheckpointVerificationError
+from .numerics import (AnomalyDetected, NonFiniteError, debug_anomaly)
 from .preemption import MARKER_ENV, PreemptionGuard, preempted
 from .reliable import (ReliableStep, RetryBudgetExceededError,
-                       TransientStepError)
+                       TransientStepError, WorkerCrashError)
 from .retry import backoff_delays, retry_with_backoff
+from ..watchdog import CollectiveTimeout, StragglerDetector  # noqa: F401
 from ...framework.io_state import CheckpointCorruptionError  # noqa: F401
 
 __all__ = [
     "CheckpointManager", "CheckpointVerificationError",
     "CheckpointCorruptionError", "PreemptionGuard", "preempted",
     "MARKER_ENV", "ReliableStep", "TransientStepError",
-    "RetryBudgetExceededError", "retry_with_backoff", "backoff_delays",
-    "chaos",
+    "WorkerCrashError", "RetryBudgetExceededError", "retry_with_backoff",
+    "backoff_delays", "chaos", "numerics", "NonFiniteError",
+    "AnomalyDetected", "debug_anomaly", "CollectiveTimeout",
+    "StragglerDetector",
 ]

@@ -14,6 +14,9 @@ Spec grammar (flag ``FLAGS_chaos`` or :func:`arm`)::
     fail_commit:1          raise IOError at the 1st metadata commit
     poison_loss:3          NaN the 3rd step's loss
     delay_collective:1:0.8 sleep 0.8 s inside the 1st watched collective
+    worker_crash:3:1       SIGKILL DataLoader worker 1 at the 3rd fetch
+    poison_grads:2         NaN the gradients at the 2nd unscale/check
+    stall_collective:1:30  hold the 1st deadline-watched collective 30 s
 
 Clean-path cost is a single module-attribute load per hook site: every
 hook starts with ``if _ACTIVE is None: return`` — no device syncs, no
@@ -30,10 +33,12 @@ from typing import Any, Dict, List, Optional, Tuple
 from ...flags import define_flag, flag_value
 
 # kinds the injector understands; hooks for each live in
-# distributed/checkpoint (shard bytes, commit), ReliableStep (loss), and
-# the collective watchdog waiter (delay)
+# distributed/checkpoint (shard bytes, commit), ReliableStep (loss),
+# the collective watchdog waiter (delay/stall), the shm DataLoader
+# consumer (worker_crash), and GradScaler's unscale path (poison_grads)
 KINDS = ("corrupt_shard", "truncate_shard", "fail_commit", "poison_loss",
-         "delay_collective")
+         "delay_collective", "worker_crash", "poison_grads",
+         "stall_collective")
 
 
 class ChaosInjector:
@@ -172,6 +177,55 @@ def maybe_delay_collective(tag: str) -> None:
         time.sleep(delay)
 
 
+def maybe_stall_collective(tag: str) -> None:
+    """Deadline-wait hook: stall the op long past any sane deadline so
+    a timeout-armed collective MUST raise CollectiveTimeout. The stall
+    runs on the waiter/deadline helper thread, never the main thread."""
+    if _ACTIVE is None:
+        return
+    if _ACTIVE.should_fire("stall_collective"):
+        delay = _ACTIVE.param("stall_collective", 30.0)
+        _ACTIVE.record("stall_collective", f"{tag}:{delay}")
+        time.sleep(delay)
+
+
+def maybe_crash_worker(pids) -> None:
+    """Shm DataLoader consumer hook: SIGKILL a live worker process mid-
+    epoch (param selects the worker index, default 0) — the OOM-killer
+    simulation. Fires on the Nth batch FETCH, parent side, so the
+    occurrence counter is single-process-deterministic."""
+    if _ACTIVE is None:
+        return
+    if _ACTIVE.should_fire("worker_crash"):
+        import signal as _signal
+        w = int(_ACTIVE.param("worker_crash", 0.0))
+        w = w if 0 <= w < len(pids) else 0
+        _ACTIVE.record("worker_crash", f"worker{w}:pid{pids[w]}")
+        try:
+            os.kill(pids[w], _signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def maybe_poison_grads(optimizer) -> None:
+    """GradScaler unscale hook: overwrite every gradient with NaN, the
+    deterministic stand-in for an fp16 overflow — drives the skip-step
+    + rank-consistent back-off loop."""
+    if _ACTIVE is None:
+        return
+    if _ACTIVE.should_fire("poison_grads"):
+        import jax.numpy as jnp
+        n = 0
+        for p in optimizer._parameter_list():
+            if p.grad is not None:
+                p.grad._replace_data(
+                    jnp.full(p.grad._data.shape, jnp.nan,
+                             p.grad._data.dtype))
+                n += 1
+        _ACTIVE.record("poison_grads", f"{n} grads")
+
+
 __all__ = ["ChaosInjector", "arm", "disarm", "active", "fired_log",
            "mutate_shard_file", "maybe_fail_commit", "maybe_poison_loss",
-           "maybe_delay_collective", "KINDS"]
+           "maybe_delay_collective", "maybe_stall_collective",
+           "maybe_crash_worker", "maybe_poison_grads", "KINDS"]

@@ -29,6 +29,7 @@ from ...framework.io_state import CheckpointCorruptionError
 
 _STEP_DIR = re.compile(r"^step_(\d{8,})$")
 _LATEST = "latest"
+_STATEFUL_FILE = "stateful.pdstate"
 
 
 class CheckpointVerificationError(RuntimeError):
@@ -54,7 +55,24 @@ class CheckpointManager:
             raise ValueError("keep_last must be >= 1")
         self.root = root
         self.keep_last = keep_last
+        # named state_dict/load_state_dict holders (DataLoader, GradScaler,
+        # LR schedulers, ...) whose state rides along with every save in a
+        # CRC-enveloped side file and is pushed back on restore — the
+        # input pipeline resumes at the exact next batch with the model
+        self._stateful: Dict[str, Any] = {}
         os.makedirs(root, exist_ok=True)
+
+    def register_stateful(self, name: str, obj: Any) -> Any:
+        """Attach a ``state_dict()``/``load_state_dict()`` holder to every
+        future :meth:`save`/:meth:`restore` under ``name``. Returns
+        ``obj`` so registration can wrap construction."""
+        if not (callable(getattr(obj, "state_dict", None)) and
+                callable(getattr(obj, "load_state_dict", None))):
+            raise TypeError(
+                f"register_stateful({name!r}): object must expose "
+                f"state_dict() and load_state_dict()")
+        self._stateful[name] = obj
+        return obj
 
     # -- directory bookkeeping ------------------------------------------
     def _dir(self, step: int) -> str:
@@ -109,6 +127,11 @@ class CheckpointManager:
         path = self._dir(step)
         try:
             save_state_dict(state_dict, path)
+            if self._stateful:
+                from ...framework import io_state
+                io_state.save({n: o.state_dict()
+                               for n, o in self._stateful.items()},
+                              os.path.join(path, _STATEFUL_FILE))
             verify_checkpoint(path)
         except (CheckpointCorruptionError, OSError, ValueError) as e:
             try:
@@ -154,6 +177,7 @@ class CheckpointManager:
                 # verify_checkpoint here would just double the restore
                 # I/O on exactly the slow filesystems rollback targets
                 load_state_dict(state_dict, path)
+                self._restore_stateful(path)
                 if step != pointed:   # roll the pointer back too, so the
                     from ..env import get_rank
                     if get_rank() == 0:        # next resume skips the scan
@@ -164,6 +188,23 @@ class CheckpointManager:
                       f"verification ({e}); rolling back",
                       file=sys.stderr)
         return None
+
+    def _restore_stateful(self, path: str) -> None:
+        """Push the side-file state back into registered holders. A
+        missing file (checkpoint predates the registrations) restores
+        whatever names it has and leaves the rest untouched; a corrupt
+        file raises CheckpointCorruptionError so the candidate walk
+        rolls back to an older checkpoint."""
+        if not self._stateful:
+            return
+        fpath = os.path.join(path, _STATEFUL_FILE)
+        if not os.path.exists(fpath):
+            return
+        from ...framework import io_state
+        side = io_state.load(fpath)
+        for name, obj in self._stateful.items():
+            if name in side:
+                obj.load_state_dict(side[name])
 
 
 __all__ = ["CheckpointManager", "CheckpointVerificationError"]

@@ -1,0 +1,250 @@
+"""Rank-consistent numerical guardrails.
+
+The reference stack treats non-finite values as a *reliability* signal:
+AMP dynamic loss scaling skips-and-backs-off on inf/nan gradients with
+the found_inf flag reduced across the data-parallel group (so every
+rank skips — or none does), and ``FLAGS_check_nan_inf`` turns on
+per-op anomaly hunting. This module is that surface for the TPU stack:
+
+* :func:`nonfinite_flag` — a jit-fusable device-side sentinel: ONE
+  scalar per tree of arrays, no host sync on the clean path. Callers
+  (GradScaler, ReliableStep) read it back exactly once per step, where
+  a host decision is unavoidable anyway.
+* :func:`all_reduce_found_inf` — makes the sentinel RANK-CONSISTENT:
+  in multi-controller jobs the per-process flags are max-reduced over
+  the coordination service before any scale update, so data-parallel
+  ranks never diverge on skip-vs-step. Single-controller SPMD grads
+  are already globally consistent (the DP psum runs inside the step
+  program), so the reduce is the identity there.
+* :func:`debug_anomaly` — opt-in bisection mode: forward hooks on
+  every sublayer host-check each output and raise
+  :class:`AnomalyDetected` naming the FIRST module that produced a
+  non-finite value (the per-layer host syncs are the documented cost
+  of debug mode; never enabled on the clean path).
+
+Host-sync accounting: every deliberate device->host readback in this
+module bumps :func:`host_sync_count`, which ``bench.py --guardrails``
+uses to prove the sentinel adds no per-step syncs beyond the one the
+skip decision already requires.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ...flags import define_flag, flag_value
+
+define_flag("debug_anomaly", False,
+            "Bisect the module producing the first NaN/Inf via per-layer "
+            "forward hooks (adds a host sync per sublayer; debug only).")
+define_flag("check_loss_finite", False,
+            "Raise NonFiniteError when a materialized step loss is "
+            "NaN/Inf. Free on the clean path (the loss is already on "
+            "host for logging) — the cheap alternative to "
+            "FLAGS_check_nan_inf's per-op device checks.")
+
+_host_syncs = 0
+
+
+def host_sync_count() -> int:
+    """Number of deliberate device->host readbacks this module issued."""
+    return _host_syncs
+
+
+def _count_sync() -> None:
+    global _host_syncs
+    _host_syncs += 1
+
+
+class NonFiniteError(RuntimeError):
+    """A loss/grad sentinel reported NaN/Inf where none was tolerated."""
+
+
+class AnomalyDetected(NonFiniteError):
+    """debug_anomaly located the module that produced the first NaN/Inf."""
+
+    def __init__(self, module_name: str, detail: str = ""):
+        self.module_name = module_name
+        super().__init__(
+            f"first non-finite output produced by sublayer "
+            f"{module_name!r}{': ' + detail if detail else ''} — inspect "
+            f"its inputs/parameters (FLAGS_debug_anomaly bisection)")
+
+
+# ------------------------------------------------------------ device side
+
+def _float_leaves(tree: Any) -> List[Any]:
+    """Float jax arrays in a nested structure of Tensors/arrays/containers.
+    Integer leaves cannot go non-finite and are skipped for free."""
+    import jax.numpy as jnp
+    from ...framework.tensor import Tensor
+    out: List[Any] = []
+
+    def walk(obj):
+        if obj is None:
+            return
+        if isinstance(obj, Tensor):
+            obj = obj._data
+        if isinstance(obj, dict):
+            for v in obj.values():
+                walk(v)
+            return
+        if isinstance(obj, (list, tuple)):
+            for v in obj:
+                walk(v)
+            return
+        if hasattr(obj, "dtype") and jnp.issubdtype(obj.dtype, jnp.floating):
+            out.append(obj)
+
+    walk(tree)
+    return out
+
+
+def nonfinite_flag(tree: Any):
+    """Fused device-side sentinel: a single bool scalar that is True iff
+    ANY float leaf in ``tree`` holds a NaN/Inf. Pure jnp — jit-fusable,
+    async-dispatched, NO host sync. Returns None when the tree has no
+    float leaves (nothing can be non-finite)."""
+    import jax.numpy as jnp
+    leaves = _float_leaves(tree)
+    if not leaves:
+        return None
+    # sum-of-nonfinite-counts fuses into one scalar reduction per leaf
+    # plus one add chain — cheaper to fuse than W bool any()s + stack
+    total = None
+    for leaf in leaves:
+        n = jnp.sum(~jnp.isfinite(leaf))
+        total = n if total is None else total + n
+    return total > 0
+
+
+def grads_nonfinite_flag(optimizer, inv_scale: Optional[float] = None):
+    """Sentinel over an optimizer's gradients; optionally folds the
+    unscale multiply in (GradScaler's fused unscale-and-check). Returns
+    (flag_or_None, unscaled_grads_list) where the list pairs each
+    parameter with its unscaled fp32 gradient."""
+    import jax.numpy as jnp
+    flag = None
+    unscaled = []
+    for p in optimizer._parameter_list():
+        if p.grad is None:
+            continue
+        g = p.grad._data.astype(jnp.float32)
+        if inv_scale is not None:
+            g = g * inv_scale
+        unscaled.append((p, g))
+        n = jnp.sum(~jnp.isfinite(g))
+        flag = n if flag is None else flag + n
+    return (None if flag is None else flag > 0), unscaled
+
+
+def all_reduce_found_inf(flag, group=None):
+    """Max-reduce a found_inf sentinel across the data-parallel ranks.
+
+    * single-controller SPMD (one process): DP replicas live inside one
+      program whose gradient psum already made the flag identical on
+      every logical rank — identity, still on device, no sync.
+    * multi-controller (``jax.process_count() > 1``): each process holds
+      a LOCAL flag; reduce with the coordination service so every
+      process takes the same skip decision. This is the one host sync
+      the skip decision needs anyway.
+    """
+    if flag is None:
+        return None
+    import jax
+    if jax.process_count() <= 1:
+        return flag
+    from jax.experimental import multihost_utils as mhu
+    _count_sync()
+    g = mhu.process_allgather(np.asarray(bool(flag)))
+    return bool(np.any(g))
+
+
+def flag_to_host(flag) -> bool:
+    """THE one host readback of a sentinel. Counted for the bench (a
+    flag that already lives on the host — e.g. the output of a
+    multi-controller reduce — costs nothing more)."""
+    if flag is None:
+        return False
+    if isinstance(flag, (bool, np.bool_)):
+        return bool(flag)
+    _count_sync()
+    return bool(flag)
+
+
+# -------------------------------------------------------------- host side
+
+def found_nonfinite_host(value: Any) -> bool:
+    """Host-side non-finite check of an ALREADY-MATERIALIZED value
+    (a loss read back for logging, a (loss, metrics) tuple). Used by
+    ReliableStep's deferred detection and hapi's fit loop — it never
+    forces materialization, so the clean path gains no sync."""
+    from ...framework.tensor import Tensor
+    if isinstance(value, (tuple, list)):   # (loss, metrics)-style returns
+        return found_nonfinite_host(value[0]) if value else False
+    if isinstance(value, Tensor):
+        value = np.asarray(value._data)
+    elif hasattr(value, "dtype"):
+        value = np.asarray(value)
+    if isinstance(value, (int, float, np.generic, np.ndarray)):
+        arr = np.asarray(value)
+        if arr.dtype.kind in "fc":
+            return not bool(np.isfinite(arr).all())
+    return False
+
+
+def assert_finite(value: Any, context: str = "loss") -> None:
+    """Raise :class:`NonFiniteError` if a materialized value is NaN/Inf,
+    with a pointer at debug_anomaly for localization."""
+    if found_nonfinite_host(value):
+        raise NonFiniteError(
+            f"non-finite {context} detected; re-run with "
+            f"FLAGS_debug_anomaly=1 (or the debug_anomaly() context "
+            f"manager) to bisect the module producing it")
+
+
+def debug_anomaly_enabled() -> bool:
+    return bool(flag_value("debug_anomaly"))
+
+
+@contextlib.contextmanager
+def debug_anomaly(layer):
+    """Opt-in bisection: hook every sublayer's forward and raise
+    :class:`AnomalyDetected` naming the FIRST one whose output goes
+    non-finite. Host-syncs once per sublayer call — debug mode only.
+
+    ::
+
+        with debug_anomaly(model):
+            loss = model(x)        # raises AnomalyDetected at the source
+    """
+    removers = []
+    tripped = {"name": None}
+
+    def make_hook(name):
+        def hook(l, inputs, outputs):
+            if tripped["name"] is not None:
+                return
+            _count_sync()
+            if any(found_nonfinite_host(leaf)
+                   for leaf in _float_leaves(outputs)):
+                tripped["name"] = name
+                raise AnomalyDetected(name or type(l).__name__)
+        return hook
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        removers.append(sub.register_forward_post_hook(make_hook(name)))
+    try:
+        yield tripped
+    finally:
+        for r in removers:
+            r.remove()
+
+
+__all__ = ["nonfinite_flag", "grads_nonfinite_flag", "all_reduce_found_inf",
+           "flag_to_host", "found_nonfinite_host", "assert_finite",
+           "debug_anomaly", "debug_anomaly_enabled", "host_sync_count",
+           "NonFiniteError", "AnomalyDetected"]

@@ -22,14 +22,23 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..watchdog import CollectiveTimeout, StragglerDetector
 from .retry import backoff_delays
 from . import chaos
+from . import numerics
 
 
 class TransientStepError(RuntimeError):
     """A step failure worth retrying from the last snapshot: non-finite
     loss, watchdog-flagged collective timeout, or an injected fault.
     ``step_fn`` may also raise this directly to request a retry."""
+
+
+class WorkerCrashError(TransientStepError):
+    """The input pipeline's self-healing gave up: a DataLoader worker
+    kept dying past its restart budget. Raised by the shm iterator so a
+    ReliableStep-wrapped loop treats the exhausted pipeline as one more
+    retryable fault (fresh iterators respawn a fresh worker pool)."""
 
 
 class RetryBudgetExceededError(RuntimeError):
@@ -55,18 +64,9 @@ def _tree_to_host(obj: Any) -> Any:
 
 
 def _loss_is_finite(loss: Any) -> bool:
-    from ...framework.tensor import Tensor
-    if isinstance(loss, (tuple, list)):      # (loss, metrics)-style returns
-        return _loss_is_finite(loss[0]) if loss else True
-    if isinstance(loss, Tensor):
-        loss = np.asarray(loss._data)
-    elif hasattr(loss, "dtype"):
-        loss = np.asarray(loss)
-    if isinstance(loss, (int, float, np.generic, np.ndarray)):
-        arr = np.asarray(loss)
-        if arr.dtype.kind in "fc":
-            return bool(np.isfinite(arr).all())
-    return True
+    # the shared numerics sentinel (fault_tolerance/numerics.py) is the
+    # single source of truth for what counts as a bad materialized loss
+    return not numerics.found_nonfinite_host(loss)
 
 
 class ReliableStep:
@@ -131,6 +131,10 @@ class ReliableStep:
 
     # -- failure plumbing ------------------------------------------------
     def _watchdog_timed_out(self) -> bool:
+        # gated on the flag: the queue poll serves the flag-driven
+        # monitor; per-op deadline timeouts (timeout= collectives) reach
+        # run() through the synchronous CollectiveTimeout raise instead,
+        # and _replay drains their redundant queue twin
         from ..watchdog import CommWatchdog
         wd = CommWatchdog.get()
         return bool(wd.enabled()) and bool(wd.consume_timeouts())
@@ -155,12 +159,19 @@ class ReliableStep:
                     f"step {self._step}: {last}")
             self.stats["retries"] += 1
             self.restore()
+            # a deadline-aware collective signals a timeout twice: the
+            # CollectiveTimeout raise (which got us here) AND a queue
+            # entry for the deferred poll. Drop entries from the attempt
+            # we are replacing so the fresh attempt's _check doesn't
+            # consume a stale one and burn a second retry
+            from ..watchdog import CommWatchdog
+            CommWatchdog.get().consume_timeouts()
             self._sleep(next(delays))
             try:
                 out = chaos.maybe_poison_loss(step_fn(*args, **kwargs))
                 self._check(out)         # eager check while recovering
                 return out
-            except TransientStepError as e:
+            except (TransientStepError, CollectiveTimeout) as e:
                 last = e
         raise RetryBudgetExceededError(
             f"step {self._step} still failing after {self.max_retries} "
@@ -185,11 +196,22 @@ class ReliableStep:
         self._settle_pending()
         if self._step % self.snapshot_every == 0:
             self.snapshot()
+        t0 = time.monotonic()
         try:
             out = chaos.maybe_poison_loss(step_fn(*args, **kwargs))
-        except TransientStepError:
-            # step_fn self-reported a transient failure: recover eagerly
+        except (TransientStepError, CollectiveTimeout):
+            # step_fn self-reported a transient failure (or one of its
+            # deadline-aware collectives timed out): recover eagerly
             out = self._replay(step_fn, args, kwargs)
+        # step-time gossip: feeds the straggler suspect list that
+        # CollectiveTimeout diagnostics name (dispatch wall-time only —
+        # cheap, and slow ranks are slow at dispatch too)
+        try:
+            from ..env import get_rank
+            StragglerDetector.get().observe(get_rank(),
+                                            time.monotonic() - t0)
+        except Exception:
+            pass
         self._pending = (step_fn, args, kwargs, out)
         self._step += 1
         self.stats["steps"] += 1
@@ -202,5 +224,5 @@ class ReliableStep:
         self._settle_pending()
 
 
-__all__ = ["ReliableStep", "TransientStepError",
+__all__ = ["ReliableStep", "TransientStepError", "WorkerCrashError",
            "RetryBudgetExceededError"]

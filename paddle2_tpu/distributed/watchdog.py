@@ -35,8 +35,111 @@ define_flag("collective_abort_on_timeout", False,
             "Kill the process when a collective times out so the "
             "launcher can restart the gang (CommTaskManager abort "
             "semantics).")
+define_flag("straggler_k", 2.0,
+            "A rank whose last step time exceeds k x the median of all "
+            "ranks' step times is flagged as a suspected straggler in "
+            "CollectiveTimeout diagnostics.")
 
 logger = get_logger(name=__name__)
+
+# env var: directory where ranks gossip their step times (one small file
+# per rank, atomic tmp+replace like the elastic heartbeats). Unset =
+# process-local gossip only (single-controller: that IS every rank).
+GOSSIP_DIR_ENV = "PADDLE_STEP_GOSSIP_DIR"
+
+
+class CollectiveTimeout(RuntimeError):
+    """A deadline-aware collective outlived its timeout. Carries enough
+    context to page the right person: the op tag, the group description,
+    the deadline, and the suspected straggler ranks from step-time
+    gossip (empty when no gossip has been observed)."""
+
+    def __init__(self, tag: str, group_desc: str, timeout: float,
+                 stragglers=()):
+        self.tag = tag
+        self.group_desc = group_desc
+        self.timeout = timeout
+        self.stragglers = list(stragglers)
+        who = (f"; suspected straggler rank(s): {self.stragglers} "
+               f"(step time > k*median gossip)" if self.stragglers
+               else "; no straggler gossip observed")
+        super().__init__(
+            f"collective '{tag}' on group {group_desc} exceeded its "
+            f"{timeout:.1f}s deadline{who} — likely a desynced gang: "
+            f"some rank never dispatched the matching collective")
+
+
+class StragglerDetector:
+    """Per-rank step-time gossip: each rank records how long its steps
+    take; :meth:`suspects` flags ranks whose latest step time exceeds
+    ``k * median`` of all observed ranks. Cross-process gossip rides
+    one small file per rank under ``PADDLE_STEP_GOSSIP_DIR`` (atomic
+    tmp+replace, read lazily); without it the registry is process-local
+    — which in single-controller SPMD covers every logical rank."""
+
+    _instance: Optional["StragglerDetector"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._times: Dict[int, float] = {}
+
+    @classmethod
+    def get(cls) -> "StragglerDetector":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = StragglerDetector()
+            return cls._instance
+
+    def observe(self, rank: int, step_seconds: float) -> None:
+        with self._mu:
+            self._times[int(rank)] = float(step_seconds)
+        d = os.environ.get(GOSSIP_DIR_ENV)
+        if d:
+            try:
+                os.makedirs(d, exist_ok=True)
+                tmp = os.path.join(d, f".rank.{int(rank)}.tmp")
+                with open(tmp, "w") as f:
+                    f.write(f"{float(step_seconds):.6f}")
+                os.replace(tmp, os.path.join(d, f"rank.{int(rank)}"))
+            except OSError:
+                pass                      # gossip is best-effort
+
+    def _gossip(self) -> Dict[int, float]:
+        with self._mu:
+            times = dict(self._times)
+        d = os.environ.get(GOSSIP_DIR_ENV)
+        if d and os.path.isdir(d):
+            for name in os.listdir(d):
+                if not name.startswith("rank."):
+                    continue
+                try:
+                    r = int(name.split(".", 1)[1])
+                    with open(os.path.join(d, name)) as f:
+                        times[r] = float(f.read().strip())
+                except (OSError, ValueError):
+                    continue
+        return times
+
+    def suspects(self, k: Optional[float] = None) -> list:
+        """Ranks whose last step time exceeds k x the median, slowest
+        first. Needs >= 2 ranks observed (a median of one is itself)."""
+        times = self._gossip()
+        if len(times) < 2:
+            return []
+        k = float(flag_value("straggler_k")) if k is None else float(k)
+        vals = sorted(times.values())
+        mid = len(vals) // 2
+        median = (vals[mid] if len(vals) % 2
+                  else 0.5 * (vals[mid - 1] + vals[mid]))
+        if median <= 0:
+            return []
+        out = [(t, r) for r, t in times.items() if t > k * median]
+        return [r for _, r in sorted(out, reverse=True)]
+
+    def reset(self) -> None:
+        with self._mu:
+            self._times.clear()
 
 
 class CommWatchdog:
@@ -67,12 +170,16 @@ class CommWatchdog:
     def enabled(self) -> bool:
         return float(flag_value("collective_timeout_s")) > 0.0
 
-    def watch(self, tag: str, arrays) -> None:
+    def watch(self, tag: str, arrays, timeout: Optional[float] = None
+              ) -> None:
         """Register a dispatched collective; a waiter thread blocks on
-        the buffers and clears the entry when they materialize."""
-        if not self.enabled():
-            return
-        timeout = float(flag_value("collective_timeout_s"))
+        the buffers and clears the entry when they materialize. A
+        per-op ``timeout`` (deadline-aware collectives) overrides the
+        global flag and registers the op even when the flag is off."""
+        if timeout is None:
+            if not self.enabled():
+                return
+            timeout = float(flag_value("collective_timeout_s"))
         with self._mu:
             op_id = self._next_id
             self._next_id += 1
@@ -91,6 +198,7 @@ class CommWatchdog:
         try:
             from .fault_tolerance import chaos
             chaos.maybe_delay_collective(self._tag(op_id))
+            chaos.maybe_stall_collective(self._tag(op_id))
             import jax
             jax.block_until_ready(arrays)
         except Exception as e:  # execution error counts as completion
@@ -161,8 +269,65 @@ class CommWatchdog:
             return len(self._inflight)
 
 
-def watch(tag: str, arrays) -> None:
+def watch(tag: str, arrays, timeout: Optional[float] = None) -> None:
     """Module-level convenience used by collective dispatch."""
     wd = CommWatchdog.get()
-    if wd.enabled():
-        wd.watch(tag, arrays)
+    if wd.enabled() or timeout is not None:
+        wd.watch(tag, arrays, timeout=timeout)
+
+
+def run_with_deadline(tag: str, fn, timeout: float,
+                      group_desc: str = "world"):
+    """Run ``fn()`` on a helper thread, bounded by ``timeout`` seconds:
+    past the deadline, queue ``tag`` for ReliableStep's poll, log,
+    honor FLAGS_collective_abort_on_timeout, and raise
+    :class:`CollectiveTimeout` naming the group, the op tag, and the
+    suspected straggler ranks — a hang surfaces a rank instead of
+    stalling the pod. The single deadline-thread implementation behind
+    ``wait_with_deadline``, the multi-controller collective paths, and
+    ``barrier``. The helper thread is abandoned on timeout (daemon) —
+    callers must make late completion side-effect-free (e.g. dispatch
+    into a shadow buffer and commit only on an in-deadline return)."""
+    done = threading.Event()
+    box: Dict[str, Any] = {}
+
+    def _block():
+        try:
+            from .fault_tolerance import chaos
+            chaos.maybe_stall_collective(tag)
+            box["out"] = fn()
+        except BaseException as e:       # surfaced on the caller thread
+            box["err"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_block, daemon=True,
+                         name=f"comm-deadline-{tag}")
+    t.start()
+    if not done.wait(timeout):
+        suspects = StragglerDetector.get().suspects()
+        wd = CommWatchdog.get()
+        with wd._mu:                     # ReliableStep's poll sees it too
+            wd._timeouts.append(tag)
+        exc = CollectiveTimeout(tag, group_desc, timeout, suspects)
+        logger.error("%s", exc)
+        if bool(flag_value("collective_abort_on_timeout")):
+            logger.error("aborting process for gang restart "
+                         "(AbortComm semantics)")
+            os._exit(134)
+        raise exc
+    if "err" in box:
+        raise box["err"]
+    return box.get("out")
+
+
+def wait_with_deadline(tag: str, arrays, timeout: float,
+                       group_desc: str = "world") -> None:
+    """Block on an ALREADY-DISPATCHED collective's result buffers for at
+    most ``timeout`` seconds (late completion only reads the buffers —
+    no side effects to suppress)."""
+    def _block():
+        import jax
+        jax.block_until_ready(arrays)
+
+    run_with_deadline(tag, _block, timeout, group_desc=group_desc)

@@ -69,7 +69,14 @@ class Model:
         self.network.train()
         ins = _tensorize(inputs)
         lbs = _tensorize(labels)
-        outs = self.network(*ins)
+        from ..distributed.fault_tolerance import numerics
+        if numerics.debug_anomaly_enabled():
+            # opt-in bisection: raises AnomalyDetected naming the first
+            # sublayer whose output goes non-finite
+            with numerics.debug_anomaly(self.network):
+                outs = self.network(*ins)
+        else:
+            outs = self.network(*ins)
         losses = self._compute_loss(outs, lbs)
         total = losses[0]
         for l in losses[1:]:
@@ -179,7 +186,15 @@ class Model:
         # by the guard and honored at the NEXT STEP BOUNDARY — save a
         # final checkpoint (when save_dir is set) and exit the loop
         # cleanly instead of dying mid-step with progress lost
-        from ..distributed.fault_tolerance import PreemptionGuard
+        from ..distributed.fault_tolerance import PreemptionGuard, numerics
+        from ..flags import flag_value
+        # FLAGS_check_loss_finite (or the heavier FLAGS_check_nan_inf):
+        # consume the numerics sentinel on the loss each step — the value
+        # is already on the host for logging, so the guard adds no sync;
+        # it turns silent NaN training into a raise that ReliableStep /
+        # debug_anomaly can act on
+        nan_guard = bool(flag_value("check_loss_finite")) or \
+            bool(flag_value("check_nan_inf"))
         with PreemptionGuard() as guard:
             for epoch in range(epochs):
                 cbk.on_epoch_begin(epoch)
@@ -196,6 +211,10 @@ class Model:
                               or step + 1 == len(loader))
                     res = self.train_batch(ins, lbs, update=update)
                     logs = self._pack_logs(res)
+                    if nan_guard:
+                        numerics.assert_finite(
+                            logs.get("loss", 0.0),
+                            context=f"loss (epoch {epoch} step {step})")
                     cbk.on_train_batch_end(step, logs)
                     it += 1
                     if guard.preempted:

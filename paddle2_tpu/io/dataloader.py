@@ -310,6 +310,27 @@ def get_worker_info():
     return getattr(_worker_tls, "info", None)
 
 
+class _SyncIter:
+    """num_workers=0 path, tracked: exposes the emitted-batch cursor
+    (``next_emit``) that DataLoader.state_dict reads for exact resume."""
+
+    def __init__(self, loader, batches):
+        self.loader = loader
+        self.batches = batches
+        self.next_emit = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.next_emit >= len(self.batches):
+            self.loader._note_epoch_end(self)
+            raise StopIteration
+        batch = self.loader._fetch(self.batches[self.next_emit])
+        self.next_emit += 1
+        return batch
+
+
 class _PrefetchIter:
     """Thread-pool prefetcher: ordered batch delivery, bounded queue."""
 
@@ -367,26 +388,40 @@ class _PrefetchIter:
                     return batch
                 if self.done and not self.results and all(
                         not t.is_alive() for t in self.threads):
+                    self.loader._note_epoch_end(self)
                     raise StopIteration
             self.sem.acquire(timeout=1.0)
 
 
 class DataLoader:
-    """python/paddle/io/reader.py:262 parity."""
+    """python/paddle/io/reader.py:262 parity, plus EXACT-RESUME state:
+    ``state_dict()`` captures the in-flight epoch (the materialized batch
+    index sequence — shuffle already applied — the emitted-batch cursor,
+    the sampler epoch, and the numpy RNG state) and
+    ``load_state_dict()`` arms the next ``__iter__`` to continue at the
+    exact next batch with no replay and no skip. Register the loader
+    with ``fault_tolerance.CheckpointManager.register_stateful`` so a
+    preempt/rollback resumes the data stream with the model."""
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, worker_restarts=2):
         self.dataset = dataset
         self.num_workers = max(0, num_workers)
         self.collate_fn = collate_fn or default_collate_fn
         self.worker_init_fn = worker_init_fn
         self.use_shared_memory = use_shared_memory
         self.timeout = timeout
+        # restart budget per shm worker before the iterator escalates
+        # a crashed worker to the step-level retry loop
+        self.worker_restarts = max(0, int(worker_restarts))
         self._iterable_mode = isinstance(dataset, IterableDataset)
+        self._epoch = 0
+        self._active = None      # (epoch batch list, start, live iterator)
+        self._resume = None      # armed by load_state_dict
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
             self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
@@ -412,22 +447,38 @@ class DataLoader:
     def __iter__(self):
         if self._iterable_mode:
             return self._iter_iterable()
+        batches, start = self._epoch_plan()
+        remaining = batches[start:]
+        it = None
         if self.num_workers == 0:
-            return self._iter_sync()
-        if self.use_shared_memory and self.collate_fn is default_collate_fn:
+            it = _SyncIter(self, remaining)
+        elif self.use_shared_memory and \
+                self.collate_fn is default_collate_fn:
             # multiprocess + C++ shm ring: Python decode escapes the GIL
             # (reference dataloader_iter.py:368 design); falls back to the
             # thread prefetcher when the native lib can't build
             try:
                 from .shm_loader import ShmProcessIter
-                return ShmProcessIter(self, list(self.batch_sampler))
+                it = ShmProcessIter(self, remaining)
             except (RuntimeError, OSError):
-                pass
-        return _PrefetchIter(self, iter(self.batch_sampler))
+                it = None
+        if it is None:
+            it = _PrefetchIter(self, iter(remaining))
+        self._active = (batches, start, it)
+        return it
 
-    def _iter_sync(self):
-        for indices in self.batch_sampler:
-            yield self._fetch(indices)
+    def _epoch_plan(self):
+        """Batch index sequence for the epoch about to start, plus the
+        cursor to resume from (0 unless load_state_dict armed one)."""
+        if self._resume is not None:
+            st, self._resume = self._resume, None
+            return [list(b) for b in st["batches"]], int(st["cursor"])
+        return [list(b) for b in self.batch_sampler], 0
+
+    def _note_epoch_end(self, it):
+        if self._active is not None and self._active[2] is it:
+            self._active = None
+            self._epoch += 1
 
     def _iter_iterable(self):
         batch = []
@@ -438,3 +489,51 @@ class DataLoader:
                 batch = []
         if batch and not getattr(self, "drop_last", False):
             yield self.collate_fn(batch)
+
+    # -- resumable-pipeline state ---------------------------------------
+    def state_dict(self):
+        """Snapshot the data stream position. Mid-epoch, the in-flight
+        epoch's exact batch sequence (shuffle RNG already applied) and
+        the emitted-batch cursor are captured, so a restore yields the
+        REMAINING batches only — no duplicates, no gaps; prefetched but
+        not-yet-emitted batches are re-decoded, never re-trained. The
+        numpy RNG state rides along so every SUBSEQUENT epoch's shuffle
+        also replays identically."""
+        if self._iterable_mode:
+            raise TypeError(
+                "IterableDataset pipelines stream without an index "
+                "order, so DataLoader.state_dict() cannot capture an "
+                "exact cursor; give the dataset itself "
+                "state_dict/load_state_dict and register it directly")
+        state = {"version": 1, "epoch": self._epoch, "cursor": 0,
+                 "batches": None,
+                 "sampler_epoch": getattr(self.batch_sampler, "epoch",
+                                          None),
+                 "np_rng_state": np.random.get_state()}
+        if self._active is not None:
+            batches, start, it = self._active
+            state["cursor"] = start + int(it.next_emit)
+            state["batches"] = [list(b) for b in batches]
+        elif self._resume is not None:   # saved again before iterating
+            state["cursor"] = int(self._resume["cursor"])
+            state["batches"] = [list(b) for b in self._resume["batches"]]
+        return state
+
+    def load_state_dict(self, state):
+        if not isinstance(state, dict) or "epoch" not in state:
+            raise ValueError("not a DataLoader state_dict")
+        if int(state.get("version", 1)) != 1:
+            raise ValueError(
+                f"DataLoader state version {state.get('version')} is "
+                f"newer than this runtime understands")
+        self._epoch = int(state["epoch"])
+        if state.get("np_rng_state") is not None:
+            np.random.set_state(state["np_rng_state"])
+        if state.get("sampler_epoch") is not None and \
+                hasattr(self.batch_sampler, "set_epoch"):
+            self.batch_sampler.set_epoch(int(state["sampler_epoch"]))
+        batches, cursor = state.get("batches"), int(state.get("cursor", 0))
+        if batches is not None and cursor < len(batches):
+            self._resume = {"batches": batches, "cursor": cursor}
+        else:
+            self._resume = None

@@ -8,6 +8,19 @@ Workers are jax-free: they decode+collate to NUMPY trees, pickle into
 their ring, and the main process materializes Tensors. Batch order is
 deterministic: worker w owns batches w, w+W, ... and the consumer drains
 rings round-robin.
+
+Self-healing: a worker that DIES (OOM-kill, segfault, chaos
+``worker_crash``) is respawned up to ``loader.worker_restarts`` times
+per worker. The dead worker's completed-but-undelivered batches are
+drained out of its ring first (the ring commits records atomically — a
+kill mid-push leaves only whole records), its rings are recreated, and
+the replacement worker resubmits every in-flight batch — the epoch
+still yields every batch exactly once, in order. Only when the restart
+budget is exhausted does the iterator escalate with
+:class:`WorkerCrashError`, a ``TransientStepError`` subclass so
+ReliableStep treats it as a retryable fault. A worker that raises a
+Python EXCEPTION (a dataset bug — deterministic, a respawn would just
+re-raise) still propagates immediately.
 """
 
 from __future__ import annotations
@@ -16,13 +29,17 @@ import ctypes
 import os
 import pickle
 import signal
-import threading
+import time
 import traceback
-from typing import Any, List
+from typing import Any, Dict, List
 
 import numpy as np
 
 _DEF_RING_BYTES = 64 << 20  # per worker
+
+# how long close() waits for SIGTERMed workers before SIGKILL: a hung
+# worker (stuck decode, wedged FS) must never block interpreter exit
+_JOIN_TIMEOUT_S = 2.0
 
 
 def _np_collate(batch):
@@ -71,49 +88,65 @@ class ShmProcessIter:
         # timeout=0 means wait forever (reference DataLoader semantics)
         t = float(getattr(loader, "timeout", 0) or 0)
         self._timeout_ms = int(t * 1000) if t > 0 else -1
-        ring_bytes = ring_bytes or int(os.environ.get(
+        self._ring_bytes = ring_bytes or int(os.environ.get(
             "PADDLE2_TPU_SHM_RING_BYTES", _DEF_RING_BYTES))
         uid = f"/p2t_{os.getpid()}_{id(self) & 0xFFFFFF}"
         self._names = [f"{uid}_{w}".encode() for w in range(self.W)]
         # error side-channel per worker: survives a full data ring
         self._err_names = [f"{uid}_{w}e".encode() for w in range(self.W)]
-        self._rings = []
-        self._err_rings = []
+        self._rings: List[Any] = [None] * self.W
+        self._err_rings: List[Any] = [None] * self.W
         self._created = []  # exact (ring, name) pairs for cleanup
-        self._procs = []
+        self._procs: List[int] = [0] * self.W
         self._closed = False
+        # self-healing state: drained-but-unemitted payloads from a dead
+        # worker's ring, and the per-worker restart ledger
+        self._stash: Dict[int, Any] = {}
+        self._skip: List[frozenset] = [frozenset()] * self.W
+        self._restarts = [0] * self.W
+        self._restart_budget = int(getattr(loader, "worker_restarts", 2))
         try:
-            for n, en in zip(self._names, self._err_names):
-                r = self._lib.rb_create(n, ring_bytes)
-                if not r:
-                    raise RuntimeError(f"shm ring create failed ({n!r})")
-                self._rings.append(r)
-                self._created.append((r, n))
-                er = self._lib.rb_create(en, 1 << 20)
-                if not er:
-                    raise RuntimeError(f"shm ring create failed ({en!r})")
-                self._err_rings.append(er)
-                self._created.append((er, en))
-            import warnings
             for w in range(self.W):
-                with warnings.catch_warnings():
-                    # jax warns on fork because ITS threads could hold
-                    # locks; our children never enter jax (numpy-only
-                    # decode), the same posture as the reference's forked
-                    # workers
-                    warnings.simplefilter("ignore", RuntimeWarning)
-                    pid = os.fork()
-                if pid == 0:  # child: jax-free decode loop
-                    code = 1
-                    try:
-                        self._worker_main(w)
-                        code = 0
-                    finally:
-                        os._exit(code)
-                self._procs.append(pid)
+                self._make_rings(w)
+                self._procs[w] = self._fork_worker(w)
         except BaseException:
             self.close()
             raise
+
+    def _make_rings(self, w: int) -> None:
+        """(Re)create worker w's data + error rings."""
+        for slot, names, nbytes in ((self._rings, self._names,
+                                     self._ring_bytes),
+                                    (self._err_rings, self._err_names,
+                                     1 << 20)):
+            old = slot[w]
+            if old is not None:
+                self._created.remove((old, names[w]))
+                self._lib.rb_detach(old)
+                self._lib.rb_unlink(names[w])
+            r = self._lib.rb_create(names[w], nbytes)
+            if not r:
+                raise RuntimeError(f"shm ring create failed "
+                                   f"({names[w]!r})")
+            slot[w] = r
+            self._created.append((r, names[w]))
+
+    def _fork_worker(self, w: int) -> int:
+        import warnings
+        with warnings.catch_warnings():
+            # jax warns on fork because ITS threads could hold locks; our
+            # children never enter jax (numpy-only decode), the same
+            # posture as the reference's forked workers
+            warnings.simplefilter("ignore", RuntimeWarning)
+            pid = os.fork()
+        if pid == 0:  # child: jax-free decode loop
+            code = 1
+            try:
+                self._worker_main(w)
+                code = 0
+            finally:
+                os._exit(code)
+        return pid
 
     # -- worker side -----------------------------------------------------
     def _worker_main(self, w: int):
@@ -128,7 +161,14 @@ class ShmProcessIter:
             _worker_tls.info = _WorkerInfo(w, self.W, ds)
             if self.loader.worker_init_fn is not None:
                 self.loader.worker_init_fn(w)
+            # a respawned worker resubmits only the in-flight batches:
+            # tags already emitted (< resume floor) or drained into the
+            # parent's stash (skip set) are not decoded again
+            start = self.next_emit
+            skip = self._skip[w]
             for i in range(w, len(self.batches), self.W):
+                if i < start or i in skip:
+                    continue
                 samples = [ds[j] for j in self.batches[i]]
                 payload = pickle.dumps((i, _np_collate(samples)),
                                        protocol=4)
@@ -157,17 +197,23 @@ class ShmProcessIter:
     def __iter__(self):
         return self
 
-    def _raise_worker_error(self, w: int, fallback: str):
+    def _pop_error(self, w: int):
+        """(exc, tb) reported by worker w, or None."""
         n = self._lib.rb_next_len(self._err_rings[w], 0)
-        if n >= 0:
-            buf = ctypes.create_string_buffer(int(n))
-            self._lib.rb_pop(self._err_rings[w], buf, int(n))
-            exc, tb = pickle.loads(buf.raw)
-            self.close()
+        if n < 0:
+            return None
+        buf = ctypes.create_string_buffer(int(n))
+        self._lib.rb_pop(self._err_rings[w], buf, int(n))
+        return pickle.loads(buf.raw)
+
+    def _raise_worker_error(self, w: int, fallback: str):
+        reported = self._pop_error(w)
+        self.close()
+        if reported is not None:
+            exc, tb = reported
             if exc is not None:
                 raise exc
             raise RuntimeError(f"DataLoader worker failed:\n{tb}")
-        self.close()
         raise RuntimeError(fallback)
 
     def _worker_dead(self, w: int) -> bool:
@@ -178,10 +224,47 @@ class ShmProcessIter:
         except ChildProcessError:
             return True
 
+    # -- self-healing ----------------------------------------------------
+    def _drain_ring(self, w: int) -> None:
+        """Salvage completed batches out of a dead worker's ring. The
+        ring publishes a record only after its full payload is copied
+        (release-store on tail), so everything readable is whole."""
+        while True:
+            n = self._lib.rb_next_len(self._rings[w], 0)
+            if n < 0:
+                return
+            buf = ctypes.create_string_buffer(int(n))
+            self._lib.rb_pop(self._rings[w], buf, int(n))
+            tag, payload = pickle.loads(buf.raw)
+            self._stash[tag] = payload
+
+    def _escalate(self, w: int, detail: str):
+        from ..distributed.fault_tolerance.reliable import WorkerCrashError
+        self.close()
+        raise WorkerCrashError(detail)
+
+    def _respawn(self, w: int) -> None:
+        """Replace dead worker w: drain its ring, rebuild the rings
+        (a killed producer never set `closed`; fresh rings keep the
+        -3 'producer done' signal trustworthy), and fork a replacement
+        that resubmits the in-flight batches."""
+        self._restarts[w] += 1
+        self._drain_ring(w)
+        self._make_rings(w)
+        self._skip[w] = frozenset(self._stash)
+        self._procs[w] = self._fork_worker(w)
+
     def __next__(self):
         if self.next_emit >= len(self.batches):
             self.close()
+            self._note_epoch_end()
             raise StopIteration
+        from ..distributed.fault_tolerance import chaos
+        chaos.maybe_crash_worker(self._procs)
+        if self.next_emit in self._stash:  # salvaged from a dead ring
+            payload = self._stash.pop(self.next_emit)
+            self.next_emit += 1
+            return _to_tensor_tree(payload)
         w = self.next_emit % self.W
         waited = 0
         while True:  # 1s slices: detect killed/odd-death workers
@@ -191,9 +274,28 @@ class ShmProcessIter:
             waited += 1000
             if self._worker_dead(w) and \
                     self._lib.rb_next_len(self._rings[w], 0) < 0:
-                self._raise_worker_error(
-                    w, f"worker {w} (pid {self._procs[w]}) died without "
-                       f"reporting an error (OOM-killed?)")
+                reported = self._pop_error(w)
+                if reported is not None:
+                    # a Python exception is a DATASET bug: deterministic,
+                    # a respawn would re-raise it — propagate
+                    exc, tb = reported
+                    self.close()
+                    if exc is not None:
+                        raise exc
+                    raise RuntimeError(f"DataLoader worker failed:\n{tb}")
+                if self._restarts[w] < self._restart_budget:
+                    self._respawn(w)
+                    if self.next_emit in self._stash:
+                        payload = self._stash.pop(self.next_emit)
+                        self.next_emit += 1
+                        return _to_tensor_tree(payload)
+                    waited = 0  # fresh worker gets a fresh timeout clock
+                    continue
+                self._escalate(
+                    w, f"DataLoader worker {w} died without reporting an "
+                       f"error (OOM-killed?) and exhausted its restart "
+                       f"budget ({self._restart_budget}); escalating to "
+                       f"the step-level retry loop")
             if 0 <= self._timeout_ms <= waited:
                 self._raise_worker_error(
                     w, f"shm DataLoader timed out after "
@@ -209,16 +311,43 @@ class ShmProcessIter:
         self.next_emit += 1
         return _to_tensor_tree(payload)
 
+    def _note_epoch_end(self):
+        note = getattr(self.loader, "_note_epoch_end", None)
+        if note is not None:
+            note(self)
+
     def close(self):
+        """Idempotent teardown. Workers get SIGTERM, a bounded join
+        (``_JOIN_TIMEOUT_S``), then SIGKILL — a hung or SIGSTOPped
+        worker can never block interpreter exit (the old unconditional
+        ``waitpid`` could deadlock ``__del__``)."""
         if self._closed:
             return
         self._closed = True
-        for pid in self._procs:
+        procs = [p for p in self._procs if p]
+        for pid in procs:
             try:
                 os.kill(pid, signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
                 pass
-        for pid in self._procs:
+        alive = set(procs)
+        deadline = time.monotonic() + _JOIN_TIMEOUT_S
+        while alive and time.monotonic() < deadline:
+            for pid in list(alive):
+                try:
+                    done, _ = os.waitpid(pid, os.WNOHANG)
+                    if done == pid:
+                        alive.discard(pid)
+                except (ChildProcessError, OSError):
+                    alive.discard(pid)
+            if alive:
+                time.sleep(0.02)
+        for pid in alive:  # join timed out: escalate
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        for pid in alive:
             try:
                 os.waitpid(pid, 0)
             except (ChildProcessError, OSError):
@@ -229,6 +358,7 @@ class ShmProcessIter:
         self._created = []
         self._rings = []
         self._err_rings = []
+        self._procs = []
 
     def __del__(self):
         try:
