@@ -904,11 +904,155 @@ def bench_guardrails():
     return 0 if ok else 1
 
 
+def bench_flight_recorder():
+    """``--flight-recorder`` smoke: run the train loop with recording ON
+    vs OFF (interleaved A/B trials, medians — shared-host noise
+    rationale as --inject-fault) and GATE overhead at < 3% of step
+    time. Also gates on the dump pipeline end-to-end: the dump must be
+    parseable jsonl whose events cover the loop's steps and whose
+    stacks section is non-empty (evidence quality, not just speed)."""
+    import tempfile
+
+    import paddle2_tpu as paddle
+    import paddle2_tpu.nn as nn
+    import paddle2_tpu.nn.functional as F
+    import paddle2_tpu.optimizer as opt
+    from paddle2_tpu.distributed.fault_tolerance import (ReliableStep,
+                                                         chaos,
+                                                         flight_recorder)
+
+    def build():
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                              nn.Linear(128, 64))
+        o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+
+        def inner(x, y):
+            loss = F.mse_loss(model(x), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            return loss
+
+        reliable = ReliableStep(model, o, snapshot_every=50)
+
+        def step(x, y):
+            return reliable.run(inner, x, y)
+
+        return step, reliable
+
+    rs_data = np.random.RandomState(0)
+    batches = [(paddle.to_tensor(rs_data.randn(32, 64).astype(np.float32)),
+                paddle.to_tensor(rs_data.randn(32, 64).astype(np.float32)))
+               for _ in range(8)]
+    steps, warm, trials = 40, 10, 7
+
+    def timed_loop(run_one):
+        """Per-STEP wall times: host noise (scheduler burps, shared-box
+        contention) only ever ADDS time to a step, so the min over many
+        individually-timed steps is the loop's true floor — the only
+        statistic that can resolve a sub-1% recording cost at this step
+        size."""
+        out = []
+        for i in range(steps):
+            t0 = time.perf_counter()
+            run_one(*batches[i % len(batches)])
+            out.append(time.perf_counter() - t0)
+        return out
+
+    chaos.disarm()
+    flight_recorder.disable()
+    off_step, off_rel = build()
+    with tempfile.TemporaryDirectory() as flight_dir:
+        # ONE recorder for every ON leg (the ring accumulates across
+        # trials); the process-global hook is suspended for OFF legs.
+        # Leg order ALTERNATES per trial so slow host drift cancels out
+        # of the paired per-trial overheads instead of reading as cost.
+        on_step, on_rel = build()
+        fr = flight_recorder.enable(flight_dir, rank=0,
+                                    install_hooks=False)
+        flight_recorder.suspend()
+        for i in range(warm):
+            off_step(*batches[i % len(batches)])
+            flight_recorder.resume(fr)
+            on_step(*batches[i % len(batches)])
+            flight_recorder.suspend()
+        n0 = fr.events_recorded()
+        off_times, on_times = [], []
+        for trial in range(trials):
+            if trial % 2 == 0:
+                off_times += timed_loop(off_step)
+                flight_recorder.resume(fr)
+                on_times += timed_loop(on_step)
+                flight_recorder.suspend()
+            else:
+                flight_recorder.resume(fr)
+                on_times += timed_loop(on_step)
+                flight_recorder.suspend()
+                off_times += timed_loop(off_step)
+        off_rel.finalize()
+        flight_recorder.resume(fr)
+        on_rel.finalize()
+        events_per_step = ((fr.events_recorded() - n0)
+                           / max(1, trials * steps))
+        # dump BEFORE the microbench floods the ring with bench ticks
+        dump = flight_recorder.dump("bench_smoke")
+        # per-event cost, microbenched on the same recorder: the gate
+        # multiplies it by the instrumented loop's real events/step —
+        # deterministic where a wall-clock A/B on a contended host is
+        # a ±8% coin flip around a ~0.01% true effect
+        t0 = time.perf_counter()
+        for i in range(50000):
+            fr.record("bench_tick", i=i)
+        per_event_s = (time.perf_counter() - t0) / 50000
+        flight_recorder.disable()
+        lines = [json.loads(ln) for ln in open(dump)]
+        kinds = {ln.get("kind") for ln in lines if ln["type"] == "event"}
+        dump_ok = (lines[0]["type"] == "header"
+                   and "step_begin" in kinds and "step_ok" in kinds
+                   and any(ln["type"] == "stacks" and ln["threads"]
+                           for ln in lines))
+
+    # floor-vs-floor wall clock (REPORTED, not gated: on a shared host
+    # even per-step floors wobble ±8%, swamping the ~0.01% true cost)
+    off = float(min(off_times))
+    on = float(min(on_times))
+    ab_delta_pct = (on - off) / off * 100.0
+    # THE GATE: real events/step x real per-event cost vs the step
+    # floor — recording must cost < 3% of step time
+    overhead_pct = events_per_step * per_event_s / off * 100.0
+    ok = overhead_pct < 3.0 and dump_ok and events_per_step >= 1.0 \
+        and off_rel.stats["retries"] == 0 and on_rel.stats["retries"] == 0
+
+    print(json.dumps({
+        "metric": "flight_recorder_smoke",
+        "value": round(overhead_pct, 4),
+        "unit": "% step-time overhead of recording (gated)",
+        "gate_pct": 3.0,
+        "events_per_step": round(events_per_step, 2),
+        "per_event_us": round(per_event_s * 1e6, 3),
+        "off_step_ms": round(off * 1e3, 3),
+        "on_step_ms": round(on * 1e3, 3),
+        "ab_delta_pct": round(ab_delta_pct, 2),
+        "dump_parseable": bool(dump_ok),
+        "stack": "ReliableStep-wrapped loop; ring capacity default; "
+                 "interleaved A/B per-step floors (reported) + "
+                 "events/step x per-event cost (gated)",
+        "note": "ab_delta_pct is REPORT-ONLY (shared-host noise "
+                "rationale as --inject-fault); the gate is the "
+                "measured recording cost per step",
+        "ok": bool(ok),
+    }))
+    return 0 if ok else 1
+
+
 def main():
     if "--inject-fault" in sys.argv:
         sys.exit(bench_fault_tolerance())
     if "--guardrails" in sys.argv:
         sys.exit(bench_guardrails())
+    if "--flight-recorder" in sys.argv:
+        sys.exit(bench_flight_recorder())
     mode = os.environ.get("BENCH_MODEL", "gpt")
     if mode in ("scaling", "gpt_hybrid", "zero3"):
         # must run BEFORE anything imports jax: the device-count env var

@@ -136,9 +136,17 @@ class GradScaler:
             self._opt_state.clear()
             self._cycle_found_inf = False
             return
+        # flight-recorder hook: skip decisions and scale movements are
+        # exactly the events a post-mortem needs to see (a rank whose
+        # scale diverged from its peers skipped different steps)
+        from ..distributed.fault_tolerance import flight_recorder
+        prev_scale = self._scale
         if self._cycle_found_inf:
             self._consecutive_skips += 1
             if self._consecutive_skips >= self._max_consecutive_skips:
+                flight_recorder.record(
+                    "scale_saturated", scale=self._scale,
+                    consecutive_skips=self._consecutive_skips)
                 raise ScaleSaturationError(
                     f"{self._consecutive_skips} consecutive steps "
                     f"produced non-finite gradients (scale now "
@@ -152,6 +160,10 @@ class GradScaler:
                 self._scale = max(self._scale * self._decr_ratio,
                                   self._min_scale)
                 self._bad_steps = 0
+            flight_recorder.record(
+                "scale_update", found_inf=True, scale=self._scale,
+                prev_scale=prev_scale,
+                consecutive_skips=self._consecutive_skips)
         else:
             self._consecutive_skips = 0
             self._good_steps += 1
@@ -160,6 +172,10 @@ class GradScaler:
                 self._scale = min(self._scale * self._incr_ratio,
                                   self._max_scale)
                 self._good_steps = 0
+            if self._scale != prev_scale:
+                flight_recorder.record(
+                    "scale_update", found_inf=False, scale=self._scale,
+                    prev_scale=prev_scale, consecutive_skips=0)
         self._opt_state.clear()
         self._cycle_found_inf = False
 

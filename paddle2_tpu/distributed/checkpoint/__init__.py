@@ -41,6 +41,12 @@ def _chaos():
     from ..fault_tolerance import chaos
     return chaos
 
+
+def _flight():
+    """Flight-recorder hooks (lazy, same circularity as _chaos)."""
+    from ..fault_tolerance import flight_recorder
+    return flight_recorder
+
 # pending async saves: a new save (sync or async) or a load first drains
 # EVERY previous in-flight save — global, not per-path, so that in a
 # multi-process job the background barriers of successive saves pair up
@@ -333,6 +339,7 @@ def _write_phase(path: str, meta, data, data_file: str, rank: int,
         with open(mtmp, "wb") as f:
             pickle.dump(meta, f, protocol=4)
         os.replace(mtmp, os.path.join(path, _METADATA))   # commit point
+        _flight().record("checkpoint_meta_commit", path=path)
         keep = set(meta["files"])
         for fname in os.listdir(path):
             if fname.endswith(".pkl") and fname not in keep \

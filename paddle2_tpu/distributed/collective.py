@@ -34,6 +34,10 @@ from jax.sharding import PartitionSpec
 from ..framework.tensor import Tensor
 from . import mesh as mesh_mod
 from .watchdog import CollectiveTimeout  # re-export: raised by timeouts
+# flight recorder: every dispatched collective records enter/exit with a
+# per-rank sequence number — the key the post-mortem doctor joins ranks
+# on. One attribute load per collective when recording is off.
+from .fault_tolerance import flight_recorder as _flight
 
 P = PartitionSpec
 
@@ -361,6 +365,11 @@ def _run_process_level(kind: str, t: Tensor, extra=()) -> Tensor:
     collectives (module docstring)."""
     from jax.experimental import multihost_utils as mhu
     local = np.asarray(t._data)
+    cseq = -1
+    if _flight._ACTIVE is not None:
+        cseq = _flight.collective_enter(
+            kind, f"processes={jax.process_count()}",
+            shape=tuple(map(int, local.shape)), dtype=str(local.dtype))
     g = mhu.process_allgather(local)            # [P, *S] everywhere
     pid = jax.process_index()
     nproc = jax.process_count()
@@ -400,6 +409,7 @@ def _run_process_level(kind: str, t: Tensor, extra=()) -> Tensor:
             f"collective '{kind}' has no multi-process path (send/recv "
             "p2p pairs inside one controller only; use ppermute-based "
             "patterns or the GSPMD path for cross-process p2p)")
+    _flight.collective_exit(cseq, kind)
     t._replace_data(jnp.asarray(out))
     return t
 
@@ -421,6 +431,11 @@ def _run(kind: str, t: Tensor, group: Optional[Group], extra=(),
          timeout: Optional[float] = None) -> Tensor:
     _check_rank_major(t, group)
     arr = t._data
+    cseq = -1
+    if _flight._ACTIVE is not None:
+        cseq = _flight.collective_enter(
+            kind, _group_desc(group), shape=tuple(map(int, arr.shape)),
+            dtype=str(arr.dtype))
     # per-rank scalars ([W] global): lift to [W, 1] so axis-0 kernels work,
     # then drop the lifted dim (all_gather keeps it: its output IS the dim)
     lifted = arr.ndim == 1
@@ -435,10 +450,13 @@ def _run(kind: str, t: Tensor, group: Optional[Group], extra=(),
     _watch(kind, out)
     if timeout is not None:
         # deadline-aware: bound the wait on the dispatched result — a
-        # hang raises CollectiveTimeout naming group/op/stragglers
+        # hang raises CollectiveTimeout naming group/op/stragglers. A
+        # timeout propagates with the enter event left un-exited: the
+        # dump shows this op in flight at death.
         from .watchdog import wait_with_deadline
         wait_with_deadline(kind, out, float(timeout),
                            group_desc=_group_desc(group))
+    _flight.collective_exit(cseq, kind)
     t._replace_data(out)
     return t
 
@@ -715,12 +733,19 @@ def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
     sent, dst = g._p2p_queue.pop(0)
     _check_rank_major(sent, group)
     _check_rank_major(tensor, group)
+    cseq = -1
+    if _flight._ACTIVE is not None:
+        cseq = _flight.collective_enter(
+            "p2p", _group_desc(group),
+            shape=tuple(map(int, sent._data.shape)),
+            dtype=str(sent._data.dtype))
     fn = _kernel("p2p", g.axes,
                  jax.ShapeDtypeStruct(sent._data.shape, sent._data.dtype),
                  extra=(int(src), int(dst)))
     out = fn(_to_mesh(sent._data), _to_mesh(tensor._data))
     from .watchdog import watch as _watch
     _watch("p2p", out)
+    _flight.collective_exit(cseq, "p2p")
     tensor._replace_data(out)
     return _Task(tensor)
 

@@ -35,11 +35,22 @@ the loop with RECOVERY across four layers:
    ``timeout=`` raises :class:`CollectiveTimeout` naming the group, op
    tag, and suspected stragglers (:class:`StragglerDetector` step-time
    gossip); ReliableStep retries it like any transient fault.
+8. **Black-box flight recorder** — :mod:`.flight_recorder`: per-rank
+   fixed-size event rings (collective enter/exit with seq numbers,
+   step/retry, dataloader batches, checkpoint phases, scale updates,
+   chaos) dumped with thread stacks to ``PADDLE_FLIGHT_DIR`` on any
+   terminal fault; ``python -m paddle2_tpu.tools.flight_doctor``
+   merges the per-rank dumps into a cross-rank desync diagnosis.
+   Checkpoint commits are fenced by the launcher restart generation
+   (:class:`StaleGenerationError`) so a zombie pre-restart rank cannot
+   clobber the post-restart lineage.
 """
 
 from . import chaos  # noqa: F401
+from . import flight_recorder  # noqa: F401
 from . import numerics  # noqa: F401
-from .manager import CheckpointManager, CheckpointVerificationError
+from .manager import (CheckpointManager, CheckpointVerificationError,
+                      StaleGenerationError)
 from .numerics import (AnomalyDetected, NonFiniteError, debug_anomaly)
 from .preemption import MARKER_ENV, PreemptionGuard, preempted
 from .reliable import (ReliableStep, RetryBudgetExceededError,
@@ -50,10 +61,10 @@ from ...framework.io_state import CheckpointCorruptionError  # noqa: F401
 
 __all__ = [
     "CheckpointManager", "CheckpointVerificationError",
-    "CheckpointCorruptionError", "PreemptionGuard", "preempted",
-    "MARKER_ENV", "ReliableStep", "TransientStepError",
-    "WorkerCrashError", "RetryBudgetExceededError", "retry_with_backoff",
-    "backoff_delays", "chaos", "numerics", "NonFiniteError",
-    "AnomalyDetected", "debug_anomaly", "CollectiveTimeout",
-    "StragglerDetector",
+    "StaleGenerationError", "CheckpointCorruptionError",
+    "PreemptionGuard", "preempted", "MARKER_ENV", "ReliableStep",
+    "TransientStepError", "WorkerCrashError", "RetryBudgetExceededError",
+    "retry_with_backoff", "backoff_delays", "chaos", "flight_recorder",
+    "numerics", "NonFiniteError", "AnomalyDetected", "debug_anomaly",
+    "CollectiveTimeout", "StragglerDetector",
 ]

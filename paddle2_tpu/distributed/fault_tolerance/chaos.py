@@ -76,6 +76,10 @@ class ChaosInjector:
 
     def record(self, kind: str, detail: str) -> None:
         self.fired.append((kind, detail))
+        # chaos events land in the flight ring too: a post-mortem must
+        # distinguish an injected fault from an organic one
+        from . import flight_recorder
+        flight_recorder.record("chaos", fault=kind, detail=detail)
 
 
 _ACTIVE: Optional[ChaosInjector] = None

@@ -26,15 +26,39 @@ from typing import Any, Dict, List, Optional
 from ..checkpoint import (load_state_dict, save_state_dict,
                           verify_checkpoint)
 from ...framework.io_state import CheckpointCorruptionError
+from . import flight_recorder
+from .flight_recorder import GENERATION_ENV
 
 _STEP_DIR = re.compile(r"^step_(\d{8,})$")
 _LATEST = "latest"
+_GENERATION = "generation"
 _STATEFUL_FILE = "stateful.pdstate"
 
 
 class CheckpointVerificationError(RuntimeError):
     """A just-written checkpoint failed post-save verification; the
     ``latest`` pointer still names the previous good checkpoint."""
+
+
+class StaleGenerationError(RuntimeError):
+    """A rank from a PRE-restart launcher generation tried to commit the
+    ``latest`` pointer after a newer generation already committed. The
+    zombie's write is refused so it cannot clobber the post-restart
+    lineage (its shard files may land on disk, but the pointer — the
+    only thing restore trusts — never moves backward in generation)."""
+
+
+# unique id of ONE launcher incarnation: generations are comparable only
+# within it (a fresh `launch` of the same job legitimately starts back
+# at generation 0 and must not be fenced by last week's file)
+SESSION_ENV = "PADDLE_LAUNCH_SESSION"
+
+
+def _env_generation() -> int:
+    try:
+        return int(os.environ.get(GENERATION_ENV, "0") or 0)
+    except ValueError:
+        return 0
 
 
 class CheckpointManager:
@@ -99,7 +123,50 @@ class CheckpointManager:
         except (OSError, ValueError):
             return None
 
+    # -- restart-generation fencing -------------------------------------
+    def committed_generation(self):
+        """(session, generation) recorded at the last pointer commit,
+        or ("", 0) when the run predates fencing."""
+        try:
+            with open(os.path.join(self.root, _GENERATION)) as f:
+                sess, _, gen = f.read().strip().rpartition(":")
+                return sess, int(gen or 0)
+        except (OSError, ValueError):
+            return "", 0
+
+    def _fence_generation(self, step: int) -> None:
+        """Refuse a ``latest`` commit from a stale launcher restart
+        generation. The launcher stamps every worker with a per-
+        incarnation ``PADDLE_LAUNCH_SESSION`` and a monotonically
+        increasing ``PADDLE_RESTART_GENERATION``; after a gang restart,
+        a zombie pre-restart rank that wakes up mid-save carries the old
+        generation and must NOT move the pointer the new gang is
+        training on top of. Generations from a DIFFERENT session (a
+        fresh launch of the same job, or an unmanaged run) reset the
+        fence instead of tripping it."""
+        sess = os.environ.get(SESSION_ENV, "")
+        if not sess:
+            return                      # unmanaged run: nothing to fence
+        mine = _env_generation()
+        c_sess, c_gen = self.committed_generation()
+        if c_sess == sess and mine < c_gen:
+            flight_recorder.record("checkpoint_fenced", step=step,
+                                   generation=mine,
+                                   committed_generation=c_gen)
+            raise StaleGenerationError(
+                f"refusing latest-pointer commit for step {step}: this "
+                f"rank is restart generation {mine} but generation "
+                f"{c_gen} of the same launch already committed — a "
+                f"zombie pre-restart rank must not clobber the "
+                f"post-restart lineage{flight_recorder.dump_hint()}")
+        if c_sess != sess or mine > c_gen:
+            gtmp = os.path.join(self.root, _GENERATION + ".tmp")
+            with open(gtmp, "w") as f:
+                f.write(f"{sess}:{mine}")
+            os.replace(gtmp, os.path.join(self.root, _GENERATION))
+
     def _commit_latest(self, step: int) -> None:
+        self._fence_generation(step)
         tmp = os.path.join(self.root, _LATEST + ".tmp")
         with open(tmp, "w") as f:
             f.write(f"step_{step:08d}")
@@ -125,6 +192,7 @@ class CheckpointManager:
         this (save_state_dict is collective); the pointer commit and
         prune run on rank 0 only."""
         path = self._dir(step)
+        flight_recorder.record("checkpoint_save_begin", step=step)
         try:
             save_state_dict(state_dict, path)
             if self._stateful:
@@ -133,7 +201,10 @@ class CheckpointManager:
                                for n, o in self._stateful.items()},
                               os.path.join(path, _STATEFUL_FILE))
             verify_checkpoint(path)
+            flight_recorder.record("checkpoint_verified", step=step)
         except (CheckpointCorruptionError, OSError, ValueError) as e:
+            flight_recorder.record("checkpoint_save_failed", step=step,
+                                   error=str(e)[:300])
             try:
                 failed = path + ".failed"
                 shutil.rmtree(failed, ignore_errors=True)
@@ -148,6 +219,7 @@ class CheckpointManager:
         if get_rank() == 0:
             self._commit_latest(step)
             self._prune()
+            flight_recorder.record("checkpoint_committed", step=step)
         return path
 
     def restore(self, state_dict: Dict[str, Any]) -> Optional[int]:
@@ -182,8 +254,12 @@ class CheckpointManager:
                     from ..env import get_rank
                     if get_rank() == 0:        # next resume skips the scan
                         self._commit_latest(step)
+                flight_recorder.record("checkpoint_restored", step=step,
+                                       rolled_back=step != pointed)
                 return step
             except (CheckpointCorruptionError, OSError, ValueError) as e:
+                flight_recorder.record("checkpoint_restore_failed",
+                                       step=step, error=str(e)[:300])
                 print(f"[fault_tolerance] checkpoint step {step} failed "
                       f"verification ({e}); rolling back",
                       file=sys.stderr)
@@ -207,4 +283,5 @@ class CheckpointManager:
                 obj.load_state_dict(side[name])
 
 
-__all__ = ["CheckpointManager", "CheckpointVerificationError"]
+__all__ = ["CheckpointManager", "CheckpointVerificationError",
+           "StaleGenerationError", "SESSION_ENV"]

@@ -79,6 +79,16 @@ class PreemptionGuard:
     # -- signal plumbing -------------------------------------------------
     def _handle(self, signum, frame):
         _PREEMPTED.set()
+        # dump the flight ring NOW: if the grace period ends in SIGKILL
+        # (a worker hung past grace), this dump is the surviving
+        # evidence the launcher collects. CPython runs handlers between
+        # bytecodes, so file IO here is safe; best-effort regardless.
+        try:
+            from . import flight_recorder
+            flight_recorder.record("sigterm", signum=int(signum))
+            flight_recorder.dump(f"sigterm:{int(signum)}")
+        except Exception:
+            pass
         prev = self._prev.get(signum)
         if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
             prev(signum, frame)

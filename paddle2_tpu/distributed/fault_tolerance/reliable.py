@@ -25,6 +25,7 @@ import numpy as np
 from ..watchdog import CollectiveTimeout, StragglerDetector
 from .retry import backoff_delays
 from . import chaos
+from . import flight_recorder
 from . import numerics
 
 
@@ -158,6 +159,9 @@ class ReliableStep:
                     f"retry budget ({self.retry_budget}) exhausted at "
                     f"step {self._step}: {last}")
             self.stats["retries"] += 1
+            flight_recorder.record(
+                "step_retry", step=self._step, attempt=attempt + 1,
+                error=str(last)[:300] if last is not None else None)
             self.restore()
             # a deadline-aware collective signals a timeout twice: the
             # CollectiveTimeout raise (which got us here) AND a queue
@@ -182,12 +186,15 @@ class ReliableStep:
         materialized by now) and, on failure, restore + replay it."""
         if self._pending is None:
             return
-        step_fn, args, kwargs, loss = self._pending
+        step_fn, args, kwargs, loss, step_no = self._pending
         self._pending = None
         try:
             self._check(loss)
         except TransientStepError:
             self._replay(step_fn, args, kwargs)
+        # the settled step is now KNOWN GOOD (validated loss, or a
+        # successful replay) — the doctor's last-known-good marker
+        flight_recorder.record("step_ok", step=step_no)
 
     # -- the step --------------------------------------------------------
     def run(self, step_fn: Callable, *args, **kwargs) -> Any:
@@ -196,6 +203,7 @@ class ReliableStep:
         self._settle_pending()
         if self._step % self.snapshot_every == 0:
             self.snapshot()
+        flight_recorder.record("step_begin", step=self._step)
         t0 = time.monotonic()
         try:
             out = chaos.maybe_poison_loss(step_fn(*args, **kwargs))
@@ -212,7 +220,7 @@ class ReliableStep:
                                             time.monotonic() - t0)
         except Exception:
             pass
-        self._pending = (step_fn, args, kwargs, out)
+        self._pending = (step_fn, args, kwargs, out, self._step)
         self._step += 1
         self.stats["steps"] += 1
         return out

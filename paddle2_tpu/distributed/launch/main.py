@@ -85,7 +85,22 @@ def _marker_prefix() -> str:
                         f"p2t_preempt_{os.getpid()}")
 
 
-def _worker_env(args, local_rank: int) -> dict:
+def _launch_session() -> str:
+    """Unique id of THIS launcher incarnation. Workers get it as
+    PADDLE_LAUNCH_SESSION: checkpoint generation fencing compares
+    restart generations only within one session, so a fresh launch of
+    the same job is never fenced by a stale generation file."""
+    import socket
+    return f"{socket.gethostname()}-{os.getpid()}-{int(time.time())}"
+
+
+_SESSION = None
+
+
+def _worker_env(args, local_rank: int, attempt: int = 0) -> dict:
+    global _SESSION
+    if _SESSION is None:
+        _SESSION = _launch_session()
     env = dict(os.environ)
     world = args.nnodes * args.nproc_per_node
     rank = args.node_rank * args.nproc_per_node + local_rank
@@ -96,6 +111,11 @@ def _worker_env(args, local_rank: int) -> dict:
         "PADDLE_NNODES": str(args.nnodes),
         "PADDLE_JOB_ID": args.job_id,
         "PADDLE_PREEMPT_MARKER": f"{_marker_prefix()}.{rank}",
+        # gang restart generation: flight-recorder dump headers carry it
+        # and CheckpointManager fences latest-pointer commits on it, so
+        # a zombie pre-restart rank cannot clobber the new lineage
+        "PADDLE_RESTART_GENERATION": str(attempt),
+        "PADDLE_LAUNCH_SESSION": _SESSION,
     })
     if args.master:
         env.update({
@@ -111,7 +131,7 @@ def _worker_env(args, local_rank: int) -> dict:
     return env
 
 
-def _spawn(args) -> List[subprocess.Popen]:
+def _spawn(args, attempt: int = 0) -> List[subprocess.Popen]:
     procs = []
     for lr in range(args.nproc_per_node):
         cmd = [sys.executable, args.training_script] \
@@ -124,7 +144,7 @@ def _spawn(args) -> List[subprocess.Popen]:
             log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
             f = open(log_path, "ab")
             stdout = stderr = f
-        p = subprocess.Popen(cmd, env=_worker_env(args, lr),
+        p = subprocess.Popen(cmd, env=_worker_env(args, lr, attempt),
                              stdout=stdout, stderr=stderr)
         p.log_path = log_path
         procs.append(p)
@@ -161,6 +181,48 @@ def _surface_failure_logs(procs, n_tail: int = 30) -> None:
                 print(f"[launch] | {ln}", file=sys.stderr)
         except OSError:
             pass
+
+
+def _surface_flight_dumps() -> None:
+    """Collect surviving flight-recorder dumps when the gang dies: each
+    worker dumps its event ring to PADDLE_FLIGHT_DIR on its own terminal
+    fault (exception, timeout, SIGTERM); the launcher's job is to point
+    the operator at whatever evidence survived — including dumps from
+    ranks that were reaped without writing one themselves (their
+    absence is itself a clue the doctor reports)."""
+    flight_dir = os.environ.get("PADDLE_FLIGHT_DIR")
+    if not flight_dir:
+        return
+    try:
+        from ..fault_tolerance.flight_recorder import list_dumps
+        dumps = [os.path.basename(p) for p in list_dumps(flight_dir)]
+    except Exception:
+        dumps = []
+    if dumps:
+        print(f"[launch] flight-recorder dumps collected in "
+              f"{flight_dir}: {', '.join(dumps)}", file=sys.stderr)
+        print(f"[launch] diagnose with: python -m "
+              f"paddle2_tpu.tools.flight_doctor {flight_dir}",
+              file=sys.stderr)
+    else:
+        print(f"[launch] no flight-recorder dumps found in "
+              f"{flight_dir} (workers died before dumping?)",
+              file=sys.stderr)
+
+
+def _prune_gossip(live_world: int) -> None:
+    """Elastic scale-in: drop step-time gossip of ranks that left the
+    gang so straggler attribution stops accusing dead ranks."""
+    if not os.environ.get("PADDLE_STEP_GOSSIP_DIR"):
+        return
+    try:
+        from ..watchdog import prune_gossip
+        pruned = prune_gossip(live_world)
+        if pruned:
+            print(f"[launch] pruned step gossip of departed ranks "
+                  f"{pruned}", file=sys.stderr)
+    except Exception:
+        pass
 
 
 class _PreemptForwarder:
@@ -282,7 +344,7 @@ def _spawn_layout(args, layout: dict, me: dict,
         # one shared env builder (_worker_env: devices, master, job id),
         # then override the rank/world vars with the MASTER-ASSIGNED
         # layout instead of the static nnodes*nproc derivation
-        env = _worker_env(args, lr)
+        env = _worker_env(args, lr, attempt)
         rank = me["rank_offset"] + lr
         env.update({
             "PADDLE_TRAINER_ID": str(rank),
@@ -421,6 +483,7 @@ def _elastic_agent(args) -> int:
             print(f"[launch] job v{version}: world={layout['world']} "
                   f"nnodes={layout['nnodes']} node_rank="
                   f"{me['node_rank']}", file=sys.stderr)
+            _prune_gossip(int(layout["world"]))
             procs = _spawn_layout(args, layout, me, attempt)
             state, rc, _n = _watch_with_master(procs, client, node_id,
                                                version, args.rdzv_beat,
@@ -440,6 +503,7 @@ def _elastic_agent(args) -> int:
                 continue
             # local failure
             _surface_failure_logs(procs)
+            _surface_flight_dumps()
             from ..fleet.elastic import ELASTIC_EXIT_CODE
             if rc != ELASTIC_EXIT_CODE:
                 attempt += 1
@@ -482,7 +546,7 @@ def launch(argv: Optional[List[str]] = None) -> int:
 
 def _launch_loop(args, forwarder: _PreemptForwarder, attempt: int) -> int:
     while True:
-        procs = _spawn(args)
+        procs = _spawn(args, attempt)
         rc, n_failed, preempted = _watch(procs, forwarder)
         if preempted:
             print("[launch] preemption: gang checkpointed and exited",
@@ -491,6 +555,7 @@ def _launch_loop(args, forwarder: _PreemptForwarder, attempt: int) -> int:
         if rc == 0:
             return 0
         _surface_failure_logs(procs)
+        _surface_flight_dumps()
         # reference ELASTIC_EXIT_CODE (manager.py:33): a worker exiting
         # 101 announces a deliberate scale event — restart does not
         # consume the failure budget
@@ -515,6 +580,7 @@ def _launch_loop(args, forwarder: _PreemptForwarder, attempt: int) -> int:
                       f"{args.nproc_per_node} -> {new_world}",
                       file=sys.stderr)
                 args.nproc_per_node = new_world
+                _prune_gossip(new_world)
         os.environ["PADDLE_ELASTIC_RESTART_COUNT"] = str(attempt)
         print(f"[launch] worker failed (rc={rc}); elastic restart "
               f"{attempt}/{args.max_restarts} at world "

@@ -51,11 +51,13 @@ GOSSIP_DIR_ENV = "PADDLE_STEP_GOSSIP_DIR"
 class CollectiveTimeout(RuntimeError):
     """A deadline-aware collective outlived its timeout. Carries enough
     context to page the right person: the op tag, the group description,
-    the deadline, and the suspected straggler ranks from step-time
-    gossip (empty when no gossip has been observed)."""
+    the deadline, the suspected straggler ranks from step-time gossip
+    (empty when no gossip has been observed), and — when the flight
+    recorder is on — the path of the dump written at the timeout, so
+    the operator's first stack trace points at the evidence."""
 
     def __init__(self, tag: str, group_desc: str, timeout: float,
-                 stragglers=()):
+                 stragglers=(), dump_hint: str = ""):
         self.tag = tag
         self.group_desc = group_desc
         self.timeout = timeout
@@ -66,7 +68,8 @@ class CollectiveTimeout(RuntimeError):
         super().__init__(
             f"collective '{tag}' on group {group_desc} exceeded its "
             f"{timeout:.1f}s deadline{who} — likely a desynced gang: "
-            f"some rank never dispatched the matching collective")
+            f"some rank never dispatched the matching collective"
+            f"{dump_hint}")
 
 
 class StragglerDetector:
@@ -140,6 +143,40 @@ class StragglerDetector:
     def reset(self) -> None:
         with self._mu:
             self._times.clear()
+
+
+def prune_gossip(live_world: int,
+                 directory: Optional[str] = None) -> list:
+    """Drop step-time gossip from ranks that LEFT the gang (elastic
+    scale-in): delete ``rank.N`` files with ``N >= live_world`` from the
+    gossip dir and evict the same ranks from the in-process registry, so
+    straggler attribution stops accusing dead ranks. Returns the pruned
+    rank ids. The launcher calls this before respawning at a smaller
+    world; harmless when no gossip dir is configured."""
+    pruned = []
+    d = directory or os.environ.get(GOSSIP_DIR_ENV)
+    if d and os.path.isdir(d):
+        for name in os.listdir(d):
+            if not name.startswith("rank."):
+                continue
+            try:
+                r = int(name.split(".", 1)[1])
+            except ValueError:
+                continue
+            if r >= int(live_world):
+                try:
+                    os.remove(os.path.join(d, name))
+                    pruned.append(r)
+                except OSError:
+                    pass
+    det = StragglerDetector._instance
+    if det is not None:
+        with det._mu:
+            for r in [r for r in det._times if r >= int(live_world)]:
+                det._times.pop(r, None)
+                if r not in pruned:
+                    pruned.append(r)
+    return sorted(pruned)
 
 
 class CommWatchdog:
@@ -249,7 +286,14 @@ class CommWatchdog:
                     "the matching collective (comm_task_manager.h "
                     "IsTimeout semantics)",
                     now - e["start"], e["tag"], pending)
+                from .fault_tolerance import flight_recorder
+                flight_recorder.record("watchdog_overrun", tag=e["tag"],
+                                       waited_s=now - e["start"],
+                                       inflight=list(pending))
                 if bool(flag_value("collective_abort_on_timeout")):
+                    # dump BEFORE the abort: the whole point of the
+                    # flight recorder is that this exit leaves evidence
+                    flight_recorder.dump(f"watchdog_abort:{e['tag']}")
                     logger.error("aborting process for gang restart "
                                  "(AbortComm semantics)")
                     os._exit(134)
@@ -309,7 +353,13 @@ def run_with_deadline(tag: str, fn, timeout: float,
         wd = CommWatchdog.get()
         with wd._mu:                     # ReliableStep's poll sees it too
             wd._timeouts.append(tag)
-        exc = CollectiveTimeout(tag, group_desc, timeout, suspects)
+        from .fault_tolerance import flight_recorder
+        flight_recorder.record("collective_timeout", tag=tag,
+                               group=group_desc, timeout_s=timeout,
+                               stragglers=list(suspects))
+        flight_recorder.dump(f"collective_timeout:{tag}")
+        exc = CollectiveTimeout(tag, group_desc, timeout, suspects,
+                                dump_hint=flight_recorder.dump_hint())
         logger.error("%s", exc)
         if bool(flag_value("collective_abort_on_timeout")):
             logger.error("aborting process for gang restart "

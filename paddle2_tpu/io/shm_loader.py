@@ -239,9 +239,14 @@ class ShmProcessIter:
             self._stash[tag] = payload
 
     def _escalate(self, w: int, detail: str):
+        from ..distributed.fault_tolerance import flight_recorder
         from ..distributed.fault_tolerance.reliable import WorkerCrashError
+        flight_recorder.record("worker_crash_escalate", worker=w,
+                               restarts=self._restarts[w],
+                               next_batch=self.next_emit)
+        flight_recorder.dump(f"worker_crash:worker{w}")
         self.close()
-        raise WorkerCrashError(detail)
+        raise WorkerCrashError(detail + flight_recorder.dump_hint())
 
     def _respawn(self, w: int) -> None:
         """Replace dead worker w: drain its ring, rebuild the rings
@@ -249,6 +254,11 @@ class ShmProcessIter:
         -3 'producer done' signal trustworthy), and fork a replacement
         that resubmits the in-flight batches."""
         self._restarts[w] += 1
+        from ..distributed.fault_tolerance import flight_recorder
+        flight_recorder.record("worker_respawn", worker=w,
+                               restarts=self._restarts[w],
+                               salvaged=len(self._stash),
+                               next_batch=self.next_emit)
         self._drain_ring(w)
         self._make_rings(w)
         self._skip[w] = frozenset(self._stash)
@@ -259,10 +269,12 @@ class ShmProcessIter:
             self.close()
             self._note_epoch_end()
             raise StopIteration
-        from ..distributed.fault_tolerance import chaos
+        from ..distributed.fault_tolerance import chaos, flight_recorder
         chaos.maybe_crash_worker(self._procs)
         if self.next_emit in self._stash:  # salvaged from a dead ring
             payload = self._stash.pop(self.next_emit)
+            flight_recorder.record("dataloader_batch",
+                                   batch=self.next_emit, salvaged=True)
             self.next_emit += 1
             return _to_tensor_tree(payload)
         w = self.next_emit % self.W
@@ -287,6 +299,9 @@ class ShmProcessIter:
                     self._respawn(w)
                     if self.next_emit in self._stash:
                         payload = self._stash.pop(self.next_emit)
+                        flight_recorder.record("dataloader_batch",
+                                               batch=self.next_emit,
+                                               salvaged=True)
                         self.next_emit += 1
                         return _to_tensor_tree(payload)
                     waited = 0  # fresh worker gets a fresh timeout clock
@@ -308,6 +323,7 @@ class ShmProcessIter:
         self._lib.rb_pop(self._rings[w], buf, int(n))
         tag, payload = pickle.loads(buf.raw)
         assert tag == self.next_emit, (tag, self.next_emit)
+        flight_recorder.record("dataloader_batch", batch=tag, worker=w)
         self.next_emit += 1
         return _to_tensor_tree(payload)
 

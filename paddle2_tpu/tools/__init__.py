@@ -1,0 +1,5 @@
+"""Offline operator tooling (``python -m paddle2_tpu.tools.<tool>``).
+
+Kept import-light: these run on a dead job's artifacts (flight-recorder
+dumps, gossip dirs), often on a machine with no accelerator.
+"""

@@ -1,0 +1,191 @@
+"""Launcher gang-babysit restart-loop coverage (launch/main.py's
+_launch_loop/_watch — previously only the rendezvous master and elastic
+manager were tested): a worker that CRASHES consumes the restart budget
+and is relaunched with a bumped PADDLE_RESTART_GENERATION; a worker
+exiting ELASTIC_EXIT_CODE restarts WITHOUT consuming the budget; a
+worker that hangs past the SIGTERM grace is killed (never wedges the
+launcher); and on gang death the launcher collects surviving
+flight-recorder dumps.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle2_tpu.distributed.fleet.elastic import ELASTIC_EXIT_CODE
+from paddle2_tpu.distributed.launch.main import launch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _env_guard(monkeypatch):
+    """The launch loop mutates PADDLE_ELASTIC_RESTART_COUNT in
+    os.environ; pin it (and worker-visible vars) so monkeypatch
+    restores the test process env afterwards."""
+    monkeypatch.setenv("PADDLE_ELASTIC_RESTART_COUNT", "0")
+    monkeypatch.delenv("PADDLE_FLIGHT_DIR", raising=False)
+    yield
+
+
+def _script(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(body)
+    return str(p)
+
+
+class TestRestartLoop:
+    def test_crash_consumes_budget_then_succeeds(self, tmp_path):
+        """Worker crashes twice, succeeds on the 3rd run: restarts
+        consume the budget and each relaunch bumps the restart
+        generation the workers see."""
+        log = tmp_path / "runs.jsonl"
+        script = _script(tmp_path, "w.py", f"""
+import json, os, sys
+log = {str(log)!r}
+runs = sum(1 for _ in open(log)) if os.path.exists(log) else 0
+with open(log, "a") as f:
+    f.write(json.dumps({{
+        "run": runs,
+        "generation": os.environ.get("PADDLE_RESTART_GENERATION"),
+        "session": os.environ.get("PADDLE_LAUNCH_SESSION", ""),
+    }}) + "\\n")
+sys.exit(1 if runs < 2 else 0)
+""")
+        rc = launch(["--max_restarts", "3", script])
+        assert rc == 0
+        runs = [json.loads(l) for l in open(log)]
+        assert len(runs) == 3
+        # restart generation bumps per relaunch (the checkpoint fence
+        # stamp) and the launch session is stable across them
+        assert [r["generation"] for r in runs] == ["0", "1", "2"]
+        sessions = {r["session"] for r in runs}
+        assert len(sessions) == 1 and sessions != {""}
+
+    def test_budget_exhausted_returns_worker_rc(self, tmp_path):
+        log = tmp_path / "runs"
+        script = _script(tmp_path, "w.py", f"""
+import sys
+with open({str(log)!r}, "a") as f:
+    f.write("x")
+sys.exit(7)
+""")
+        rc = launch(["--max_restarts", "1", script])
+        assert rc == 7
+        assert len(open(log).read()) == 2     # initial run + 1 restart
+
+    def test_elastic_exit_code_restarts_without_budget(self, tmp_path):
+        """ELASTIC_EXIT_CODE announces a deliberate scale event: the
+        gang restarts even with max_restarts=0 and the failure budget
+        is untouched."""
+        log = tmp_path / "runs"
+        script = _script(tmp_path, "w.py", f"""
+import os, sys
+log = {str(log)!r}
+runs = len(open(log).read()) if os.path.exists(log) else 0
+with open(log, "a") as f:
+    f.write("x")
+sys.exit({ELASTIC_EXIT_CODE} if runs == 0 else 0)
+""")
+        rc = launch(["--max_restarts", "0", script])
+        assert rc == 0
+        assert len(open(log).read()) == 2
+
+    def test_one_crash_tears_down_whole_gang(self, tmp_path):
+        """First non-zero exit kills the siblings (a dead rank must not
+        hang the ring): the survivor's SIGTERM handler proves it was
+        torn down rather than left running."""
+        log = tmp_path / "who"
+        crasher = _script(tmp_path, "crash.py", """
+import sys
+sys.exit(3)
+""")
+        # nproc_per_node=2 runs the same script twice; rank 1 sleeps and
+        # records the SIGTERM the launcher's teardown sends it
+        script = _script(tmp_path, "w.py", f"""
+import os, signal, sys, time
+rank = os.environ["PADDLE_TRAINER_ID"]
+if rank == "0":
+    sys.exit(3)
+def bye(sig, frame):
+    with open({str(log)!r}, "w") as f:
+        f.write("sigterm rank " + rank)
+    sys.exit(0)
+signal.signal(signal.SIGTERM, bye)
+time.sleep(30)
+""")
+        t0 = time.time()
+        rc = launch(["--nproc_per_node", "2", "--max_restarts", "0",
+                     script])
+        assert rc == 3
+        assert time.time() - t0 < 20          # no 30s sleep-out
+        assert open(log).read() == "sigterm rank 1"
+
+    def test_gang_death_surfaces_flight_dumps(self, tmp_path, capsys,
+                                              monkeypatch):
+        """Satellite: the launcher collects surviving flight-recorder
+        dumps when the gang dies and points at flight_doctor."""
+        flight = tmp_path / "flight"
+        monkeypatch.setenv("PADDLE_FLIGHT_DIR", str(flight))
+        script = _script(tmp_path, "w.py", f"""
+import json, os, sys
+d = os.environ["PADDLE_FLIGHT_DIR"]
+os.makedirs(d, exist_ok=True)
+rank = os.environ["PADDLE_TRAINER_ID"]
+with open(os.path.join(d, "rank_%s.jsonl" % rank), "w") as f:
+    f.write(json.dumps({{"type": "header", "rank": int(rank),
+                         "reason": "unhandled_exception:Boom"}}) + "\\n")
+sys.exit(1)
+""")
+        rc = launch(["--max_restarts", "0", script])
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "flight-recorder dumps collected" in err
+        assert "rank_0.jsonl" in err
+        assert "flight_doctor" in err
+
+
+class TestHangPastGrace:
+    def test_sigterm_hang_past_grace_is_killed(self, tmp_path):
+        """Preemption path: a worker that IGNORES SIGTERM and hangs must
+        be SIGKILLed once the grace (plus the 10x hard cap) expires —
+        the launcher exits cleanly instead of wedging. Run as a real
+        subprocess so the SIGTERM hits the launcher like a preemption
+        notice would."""
+        marker = tmp_path / "started"
+        script = _script(tmp_path, "hang.py", f"""
+import signal, time
+signal.signal(signal.SIGTERM, signal.SIG_IGN)
+open({str(marker)!r}, "w").write("up")
+time.sleep(120)
+""")
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("JAX_", "PADDLE_"))}
+        env["PYTHONPATH"] = REPO
+        launcher = subprocess.Popen(
+            [sys.executable, "-m", "paddle2_tpu.distributed.launch",
+             "--preempt_grace", "0.5", script],
+            env=env, start_new_session=True,
+            stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.time() + 60
+            while not marker.exists():
+                assert time.time() < deadline, "worker never started"
+                assert launcher.poll() is None, launcher.stderr.read()
+                time.sleep(0.1)
+            os.kill(launcher.pid, signal.SIGTERM)
+            t0 = time.time()
+            rc = launcher.wait(timeout=30)
+            # grace 0.5s, hard cap 5s: the kill lands well under 30s
+            assert rc == 0
+            assert time.time() - t0 < 20
+            assert "preemption" in launcher.stderr.read()
+        finally:
+            if launcher.poll() is None:
+                os.killpg(os.getpgid(launcher.pid), signal.SIGKILL)
+                launcher.wait(timeout=10)
