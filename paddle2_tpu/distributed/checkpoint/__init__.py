@@ -82,13 +82,42 @@ class AsyncSaveHandle:
             raise self._error
 
 
+# an orphaned ``*.tmp`` shard file (a rank SIGKILLed mid-_write_phase
+# never reached its os.replace) is reaped once it is older than this —
+# the age guard keeps a LIVE concurrent writer's in-flight tmp safe
+# (another rank of a launcher-mode gang may legitimately be mid-write)
+_ORPHAN_TMP_MIN_AGE_S = 60.0
+
+
+def _is_our_tmp(fname: str) -> bool:
+    stem = fname[:-len(".tmp")]
+    return _parse_shard_name(stem) is not None or stem == _METADATA
+
+
+def _reap_orphan_tmps(path: str) -> List[str]:
+    """Remove shard/metadata ``.tmp`` leftovers of a writer that died
+    mid-``_write_phase``. Only names our own writer produces (shard
+    files and the metadata) are touched, and only past the age guard —
+    a recovering gang must never load, count, or trip over a partial
+    shard, but must also never truncate a living peer's write."""
+    from ...framework.io_state import reap_stale_tmps
+    reaped = reap_stale_tmps(path, _is_our_tmp,
+                             min_age_s=_ORPHAN_TMP_MIN_AGE_S)
+    if reaped:
+        _flight().record("checkpoint_tmp_reaped", path=path,
+                         files=reaped)
+    return reaped
+
+
 def _drain_pending(path: str, report: bool = False) -> None:
     """Serialize on EVERY in-flight async save (any path — see registry
     comment). A previous save's FAILURE belongs to its own handle
     (surfaced by its wait()) — it must not poison the next save/load,
     which proceeds against whatever checkpoint is committed.
     ``report=True`` (the atexit path, where no wait() will ever run)
-    prints any unobserved writer error to stderr instead."""
+    prints any unobserved writer error to stderr instead. With a
+    ``path``, stale ``.tmp`` shard files from a rank killed mid-write
+    are reaped after the joins (see :func:`_reap_orphan_tmps`)."""
     with _ASYNC_LOCK:
         prev = list(_ASYNC_PENDING.items())
         _ASYNC_PENDING.clear()
@@ -98,6 +127,8 @@ def _drain_pending(path: str, report: bool = False) -> None:
             print(f"[distributed.checkpoint] async save to {pth!r} "
                   f"failed during interpreter exit: {h._error!r}",
                   file=sys.stderr)
+    if path:
+        _reap_orphan_tmps(path)
 
 
 def _parse_shard_name(fname: str):
@@ -166,8 +197,9 @@ def _snapshot(state_dict, rank: int, data_file: str):
     live buffers cannot corrupt the write — this is the double buffer
     that lets step N+1 overlap the write of step N's checkpoint."""
     flat = flatten_state_dict(state_dict)
+    fname = os.path.basename(data_file)
     meta: Dict[str, Any] = {"tensors": {}, "scalars": {},
-                            "files": [os.path.basename(data_file)],
+                            "files": [fname],
                             "file_checksums": {}}
     data: Dict[Tuple[str, Tuple], np.ndarray] = {}
     for key, leaf in flat.items():
@@ -178,6 +210,8 @@ def _snapshot(state_dict, rank: int, data_file: str):
         if isinstance(arr, (np.ndarray, np.generic)):
             import jax.numpy as jnp
             arr = jnp.asarray(arr)
+        # each shard records the FILE it landed in: reshard-on-load reads
+        # only files whose bounds overlap the loader's local slice
         shards: List[Dict[str, Any]] = []
         seen = set()
         addressable = getattr(arr, "addressable_shards", None)
@@ -188,11 +222,12 @@ def _snapshot(state_dict, rank: int, data_file: str):
                     continue  # replicated copy — save once
                 seen.add(ik)
                 data[(key, ik)] = np.asarray(sh.data)
-                shards.append({"bounds": ik, "rank": rank})
+                shards.append({"bounds": ik, "rank": rank,
+                               "file": fname})
         else:  # tracers can't land here; plain single-device array
             ik = tuple((0, d) for d in arr.shape)
             data[(key, ik)] = np.asarray(arr)
-            shards.append({"bounds": ik, "rank": rank})
+            shards.append({"bounds": ik, "rank": rank, "file": fname})
         meta["tensors"][key] = {
             "global_shape": tuple(int(d) for d in arr.shape),
             "dtype": str(arr.dtype),
@@ -216,6 +251,106 @@ def _write_side_meta(path: str, uid: int, rank: int, meta) -> None:
 def _bounds_overlap(a, b) -> bool:
     return all(lo1 < hi2 and lo2 < hi1
                for (lo1, hi1), (lo2, hi2) in zip(a, b))
+
+
+def _norm_bounds(b) -> Tuple[Tuple[int, int], ...]:
+    return tuple((int(lo), int(hi)) for lo, hi in b)
+
+
+def _local_bounds(target_arr, shape) -> List[Tuple]:
+    """Bounds of the slices THIS process will materialize for a target
+    leaf: the union of its sharding's addressable-device indices (the
+    per-host slice in multi-host — each host narrows to what it owns),
+    or the full tensor for an unsharded/host-local target. Narrowing
+    applies under EXACTLY the condition load's sliced-assembly branch
+    does (a mesh-carrying sharding): a target that will be assembled
+    over full bounds must read full bounds."""
+    full = tuple((0, int(d)) for d in shape)
+    sharding = getattr(target_arr, "sharding", None)
+    imap = getattr(sharding, "addressable_devices_indices_map", None)
+    if imap is None or not hasattr(sharding, "mesh"):
+        return [full]
+    try:
+        idx_map = imap(tuple(shape))
+    except Exception:
+        return [full]
+    out: List[Tuple] = []
+    for index in idx_map.values():
+        b = full if index is None else _index_key(index, shape)
+        if b not in out:
+            out.append(b)
+    return out or [full]
+
+
+def _needed_files(meta, flat_targets) -> Optional[set]:
+    """Shard files whose recorded bounds overlap a slice this process
+    will materialize — the reshard-on-load narrowing: a checkpoint
+    written by N ranks is loaded by M ranks each reading only its
+    overlap. Returns None (read everything) when any relevant shard
+    predates per-shard file recording."""
+    needed: set = set()
+    for key, target in flat_targets.items():
+        info = meta["tensors"].get(key)
+        if info is None:
+            continue             # scalar, or reported missing later
+        local = _local_bounds(_leaf_array(target),
+                              tuple(info["global_shape"]))
+        for s in info["shards"]:
+            nb = _norm_bounds(s["bounds"])
+            if any(_bounds_overlap(nb, lb) for lb in local):
+                fname = s.get("file")
+                if fname is None:       # pre-upgrade checkpoint
+                    return None
+                needed.add(fname)
+    return needed
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Recorded dtype string -> numpy dtype; jax's extended dtypes
+    (bfloat16, float8_*) resolve once ml_dtypes registers them."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # noqa: F401 — registering import
+        return np.dtype(name)
+
+
+def _assemble_bounds(key: str, info, data, bounds) -> np.ndarray:
+    """Materialize the slice ``bounds`` of tensor ``key`` from whatever
+    source shards overlap it — the reshard core: source and target
+    shardings need not agree, a source shard contributes exactly its
+    intersection with the requested slice."""
+    shape = tuple(hi - lo for lo, hi in bounds)
+    if 0 in shape or 0 in tuple(info["global_shape"]):
+        # zero-size tensor: there are no bytes to read (and a (0, N)
+        # bound never strictly overlaps anything, so its file may have
+        # been narrowed away entirely) — the recorded dtype is all that
+        # matters
+        return np.zeros(shape, dtype=_np_dtype(info["dtype"]))
+    first = next((data[(key, _norm_bounds(s["bounds"]))]
+                  for s in info["shards"]
+                  if (key, _norm_bounds(s["bounds"])) in data), None)
+    if first is None:
+        raise ValueError(f"no shard data found for {key!r}")
+    buf = np.zeros(shape, dtype=first.dtype)
+    covered = np.zeros(shape, dtype=bool) if shape else None
+    for s in info["shards"]:
+        ik = _norm_bounds(s["bounds"])
+        if not _bounds_overlap(ik, bounds):
+            continue
+        piece = data.get((key, ik))
+        if piece is None:
+            raise ValueError(f"missing shard {ik} of {key!r}")
+        dst = tuple(slice(max(tlo, slo) - tlo, min(thi, shi) - tlo)
+                    for (tlo, thi), (slo, shi) in zip(bounds, ik))
+        src = tuple(slice(max(tlo, slo) - slo, min(thi, shi) - slo)
+                    for (tlo, thi), (slo, shi) in zip(bounds, ik))
+        buf[dst] = piece[src]
+        if covered is not None:
+            covered[dst] = True
+    if covered is not None and not covered.all():
+        raise ValueError(f"checkpoint shards do not cover {key!r}")
+    return buf
 
 
 def _merge_side_meta(tensors, scalars, side, checksums=None) -> None:
@@ -597,17 +732,6 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
             _merge_side_meta(tensors, scalars, side, cksums)
         meta["tensors"], meta["scalars"] = tensors, scalars
         meta["file_checksums"] = cksums
-    data: Dict[Tuple[str, Tuple], np.ndarray] = {}
-    checksums = meta.get("file_checksums", {})
-    for fname in files:
-        try:
-            data.update(_read_shard_file(path, fname,
-                                         checksums.get(fname)))
-        except FileNotFoundError:
-            if not legacy:
-                raise      # a concurrent legacy-mode save swept it
-
-
     flat = flatten_state_dict(state_dict)
     missing = [k for k in flat
                if k not in meta["tensors"] and k not in meta["scalars"]]
@@ -615,6 +739,23 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
         raise ValueError(
             f"checkpoint at {path!r} lacks keys {missing[:8]}"
             f"{'...' if len(missing) > 8 else ''}")
+
+    # reshard-on-load narrowing: read ONLY the shard files whose
+    # recorded bounds overlap this loader's local slices (a checkpoint
+    # written by N ranks loads on M ranks, each paying its overlap in
+    # I/O). Per-file CRC verification applies to every file read.
+    needed = _needed_files(meta, flat)
+    data: Dict[Tuple[str, Tuple], np.ndarray] = {}
+    checksums = meta.get("file_checksums", {})
+    for fname in files:
+        if needed is not None and fname not in needed:
+            continue
+        try:
+            data.update(_read_shard_file(path, fname,
+                                         checksums.get(fname)))
+        except FileNotFoundError:
+            if not legacy:
+                raise      # a concurrent legacy-mode save swept it
 
     # scalars: write back through the nested dict
     def _set_nested(d, key, value):
@@ -629,35 +770,36 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
             continue
         info = meta["tensors"][key]
         shape = tuple(info["global_shape"])
-        first = next((data[(key, tuple(s["bounds"]))] for s in info["shards"]
-                      if (key, tuple(s["bounds"])) in data), None)
-        if first is None:
-            raise ValueError(f"no shard data found for {key!r}")
-        buf = np.zeros(shape, dtype=first.dtype)
-        covered = np.zeros(shape, dtype=bool) if shape else None
-        for s in info["shards"]:
-            ik = tuple(tuple(b) for b in s["bounds"])
-            piece = data.get((key, ik))
-            if piece is None:
-                raise ValueError(f"missing shard {ik} of {key!r}")
-            sl = tuple(slice(a, b) for a, b in ik)
-            buf[sl] = piece
-            if covered is not None:
-                covered[sl] = True
-        if covered is not None and not covered.all():
-            raise ValueError(f"checkpoint shards do not cover {key!r}")
-
-        arr = jnp.asarray(buf)
         tgt = _leaf_array(target)
+        if isinstance(target, Tensor) and tuple(tgt.shape) != shape:
+            raise ValueError(
+                f"shape mismatch for {key!r}: checkpoint {shape} vs "
+                f"current {tuple(tgt.shape)}")
         sharding = getattr(tgt, "sharding", None)
-        if sharding is not None and hasattr(sharding, "mesh"):
-            arr = jax.device_put(arr, sharding)  # reshard onto current mesh
+        if sharding is not None and hasattr(sharding, "mesh") and \
+                hasattr(sharding, "addressable_devices_indices_map"):
+            # sharded target: materialize ONLY the addressable slices,
+            # each assembled from its overlapping source shards —
+            # resharding across world/mesh changes without ever building
+            # the full global array on the host
+            tgt_dtype = tgt.dtype
+
+            def _cb(index, _key=key, _info=info, _shape=shape,
+                    _dt=tgt_dtype):
+                piece = _assemble_bounds(_key, _info, data,
+                                         _index_key(index, _shape))
+                return piece if piece.dtype == _dt \
+                    else piece.astype(_dt)
+
+            arr = jax.make_array_from_callback(shape, sharding, _cb)
+        else:
+            buf = _assemble_bounds(key, info, data,
+                                   tuple((0, d) for d in shape))
+            arr = jnp.asarray(buf)
+            if isinstance(target, Tensor):
+                arr = arr.astype(tgt.dtype)
         if isinstance(target, Tensor):
-            if tuple(tgt.shape) != shape:
-                raise ValueError(
-                    f"shape mismatch for {key!r}: checkpoint {shape} vs "
-                    f"current {tuple(tgt.shape)}")
-            target._replace_data(arr.astype(tgt.dtype))
+            target._replace_data(arr)
         else:
             _set_nested(state_dict, key, arr)
 

@@ -44,6 +44,14 @@ the loop with RECOVERY across four layers:
    Checkpoint commits are fenced by the launcher restart generation
    (:class:`StaleGenerationError`) so a zombie pre-restart rank cannot
    clobber the post-restart lineage.
+9. **Elastic recovery** — :mod:`.replica`: buddy-replicated in-memory
+   snapshots (ring topology over the gang, shm transport) so an
+   in-job rollback or single-rank respawn restores from a peer's RAM;
+   :func:`~.replica.elastic_restore` is the RAM-then-disk recovery
+   ladder, and ``distributed.checkpoint.load_state_dict`` reshards a
+   checkpoint written by N ranks onto M ranks (each loader reads only
+   the shard files overlapping its local slice). ``bench.py
+   --elastic`` measures and gates MTTR.
 """
 
 from . import chaos  # noqa: F401
@@ -55,6 +63,8 @@ from .numerics import (AnomalyDetected, NonFiniteError, debug_anomaly)
 from .preemption import MARKER_ENV, PreemptionGuard, preempted
 from .reliable import (ReliableStep, RetryBudgetExceededError,
                        TransientStepError, WorkerCrashError)
+from .replica import (BuddyReplicator, ReplicaUnavailableError,
+                      elastic_restore)
 from .retry import backoff_delays, retry_with_backoff
 from ..watchdog import CollectiveTimeout, StragglerDetector  # noqa: F401
 from ...framework.io_state import CheckpointCorruptionError  # noqa: F401
@@ -66,5 +76,6 @@ __all__ = [
     "TransientStepError", "WorkerCrashError", "RetryBudgetExceededError",
     "retry_with_backoff", "backoff_delays", "chaos", "flight_recorder",
     "numerics", "NonFiniteError", "AnomalyDetected", "debug_anomaly",
-    "CollectiveTimeout", "StragglerDetector",
+    "CollectiveTimeout", "StragglerDetector", "BuddyReplicator",
+    "ReplicaUnavailableError", "elastic_restore",
 ]

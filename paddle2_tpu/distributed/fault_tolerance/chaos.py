@@ -17,6 +17,8 @@ Spec grammar (flag ``FLAGS_chaos`` or :func:`arm`)::
     worker_crash:3:1       SIGKILL DataLoader worker 1 at the 3rd fetch
     poison_grads:2         NaN the gradients at the 2nd unscale/check
     stall_collective:1:30  hold the 1st deadline-watched collective 30 s
+    kill_rank:4:1          SIGKILL rank 1's process at its 4th step
+                           (node-loss simulation: no dump, no cleanup)
 
 Clean-path cost is a single module-attribute load per hook site: every
 hook starts with ``if _ACTIVE is None: return`` — no device syncs, no
@@ -38,7 +40,7 @@ from ...flags import define_flag, flag_value
 # consumer (worker_crash), and GradScaler's unscale path (poison_grads)
 KINDS = ("corrupt_shard", "truncate_shard", "fail_commit", "poison_loss",
          "delay_collective", "worker_crash", "poison_grads",
-         "stall_collective")
+         "stall_collective", "kill_rank")
 
 
 class ChaosInjector:
@@ -211,6 +213,30 @@ def maybe_crash_worker(pids) -> None:
             pass
 
 
+def maybe_kill_rank(step: Any = None) -> None:
+    """Step hook (ReliableStep): SIGKILL THIS process when it is the
+    param-selected victim rank (default 0) and the occurrence counter
+    hits — the hard node-loss simulation behind the elastic-recovery
+    gang test and ``bench.py --elastic``. The counter ticks only on the
+    victim, so ``nth`` means "the victim's nth step" regardless of what
+    the survivors are doing. SIGKILL on purpose: no excepthook, no
+    flight dump, no atexit — recovery must work from the OUTSIDE
+    evidence (buddy replica, launcher supervision) alone."""
+    if _ACTIVE is None:
+        return
+    tgt = _ACTIVE.targets.get("kill_rank")
+    if tgt is None:
+        return
+    from ..env import get_rank
+    victim = 0 if tgt[1] is None else int(tgt[1])
+    if get_rank() != victim:
+        return
+    if _ACTIVE.should_fire("kill_rank"):
+        import signal as _signal
+        _ACTIVE.record("kill_rank", f"rank{victim}:step{step}")
+        os.kill(os.getpid(), _signal.SIGKILL)
+
+
 def maybe_poison_grads(optimizer) -> None:
     """GradScaler unscale hook: overwrite every gradient with NaN, the
     deterministic stand-in for an fp16 overflow — drives the skip-step
@@ -232,4 +258,5 @@ def maybe_poison_grads(optimizer) -> None:
 __all__ = ["ChaosInjector", "arm", "disarm", "active", "fired_log",
            "mutate_shard_file", "maybe_fail_commit", "maybe_poison_loss",
            "maybe_delay_collective", "maybe_stall_collective",
-           "maybe_crash_worker", "maybe_poison_grads", "KINDS"]
+           "maybe_crash_worker", "maybe_poison_grads", "maybe_kill_rank",
+           "KINDS"]

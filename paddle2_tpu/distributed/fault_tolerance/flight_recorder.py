@@ -370,6 +370,76 @@ def dump_hint() -> str:
             f"`python -m paddle2_tpu.tools.flight_doctor {fr.dir}`)")
 
 
+# dumps younger than this survive a scale-in prune: the departed
+# rank's dump written SECONDS ago is the evidence of the very failure
+# the launcher is reacting to — the operator must get to read it
+_PRUNE_MIN_AGE_S = 300.0
+
+
+def prune_ranks(live_world: int, directory: Optional[str] = None,
+                min_age_s: float = _PRUNE_MIN_AGE_S) -> List[int]:
+    """Elastic scale-in hygiene: delete per-rank dump/stack files of
+    ranks that left the gang (``rank >= live_world``) so a LATER
+    post-mortem diagnoses the live lineage instead of mixing in a
+    long-departed rank's evidence. Files newer than ``min_age_s`` are
+    kept — the dump of the failure that caused THIS scale-in is the
+    one thing the operator was just told to read (they age out at the
+    next scale event; the doctor's stale-generation fence excludes
+    them from the cross-rank join meanwhile). The launcher calls this
+    (alongside ``watchdog.prune_gossip``) before respawning at a
+    smaller world. Returns the pruned rank ids."""
+    d = directory or os.environ.get(FLIGHT_DIR_ENV)
+    pruned: List[int] = []
+    if not d or not os.path.isdir(d):
+        return pruned
+    now = time.time()
+    for name in os.listdir(d):
+        for suffix in (".jsonl", ".stacks"):
+            if name.startswith("rank_") and name.endswith(suffix):
+                stem = name[len("rank_"):-len(suffix)]
+                if stem.isdigit() and int(stem) >= int(live_world):
+                    full = os.path.join(d, name)
+                    try:
+                        if now - os.path.getmtime(full) < min_age_s:
+                            continue
+                        os.remove(full)
+                        if int(stem) not in pruned:
+                            pruned.append(int(stem))
+                    except OSError:
+                        pass
+    return sorted(pruned)
+
+
+# launcher-side structured event stream: the launcher has no event ring
+# of its own (it never calls enable()), but scale events are exactly
+# what a post-mortem of an elastic job needs a timeline of
+ELASTIC_LOG = "elastic_events.jsonl"
+
+
+def append_elastic_event(kind: str, directory: Optional[str] = None,
+                         **fields) -> None:
+    """Append one ``elastic.*`` event to ``elastic_events.jsonl`` under
+    the flight dir (auto-prefixed; silently a no-op without a directory
+    — evidence is best-effort, never a failure source). Workers record
+    ``elastic.*`` through their rings instead; this is the LAUNCHER's
+    half of the stream: rendezvous outcomes, scale events, respawns,
+    MTTR accounting."""
+    d = directory or os.environ.get(FLIGHT_DIR_ENV)
+    if not d:
+        return
+    if not kind.startswith("elastic."):
+        kind = f"elastic.{kind}"
+    rec = {"type": "event", "kind": kind, "t": time.time(),
+           "generation": _generation()}
+    rec.update(_jsonable(fields))
+    try:
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, ELASTIC_LOG), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
 def list_dumps(directory: Optional[str] = None) -> List[str]:
     """Per-rank dump files present under ``directory`` (defaults to
     ``PADDLE_FLIGHT_DIR``), rank order. Used by the launcher to collect
@@ -402,5 +472,6 @@ if os.environ.get(FLIGHT_DIR_ENV) and os.environ.get("PADDLE_TRAINER_ID"):
 
 __all__ = ["FlightRecorder", "enable", "disable", "active", "record",
            "collective_enter", "collective_exit", "dump", "dump_path",
-           "dump_hint", "list_dumps", "FLIGHT_DIR_ENV",
+           "dump_hint", "list_dumps", "prune_ranks",
+           "append_elastic_event", "ELASTIC_LOG", "FLIGHT_DIR_ENV",
            "FLIGHT_EVENTS_ENV", "GENERATION_ENV"]

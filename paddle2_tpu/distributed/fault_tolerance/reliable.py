@@ -27,6 +27,7 @@ from .retry import backoff_delays
 from . import chaos
 from . import flight_recorder
 from . import numerics
+from .replica import ReplicaUnavailableError, tree_to_host
 
 
 class TransientStepError(RuntimeError):
@@ -46,22 +47,9 @@ class RetryBudgetExceededError(RuntimeError):
     """The bounded retry budget ran out — the failure is not transient."""
 
 
-def _tree_to_host(obj: Any) -> Any:
-    """Nested state-dict -> host-memory copy (numpy leaves)."""
-    from ...framework.tensor import Tensor
-    if isinstance(obj, Tensor):
-        return np.array(np.asarray(obj._data), copy=True)
-    if isinstance(obj, dict):
-        return {k: _tree_to_host(v) for k, v in obj.items()}
-    if isinstance(obj, (list, tuple)):
-        return type(obj)(_tree_to_host(v) for v in obj)
-    try:
-        import jax
-        if isinstance(obj, jax.Array):
-            return np.array(np.asarray(obj), copy=True)
-    except ImportError:
-        pass
-    return obj
+# the device->host snapshot now lives in replica.py (shared with the
+# buddy replicator); kept under the old private name for callers
+_tree_to_host = tree_to_host
 
 
 def _loss_is_finite(loss: Any) -> bool:
@@ -94,9 +82,15 @@ class ReliableStep:
                  snapshot_every: int = 1, max_retries: int = 3,
                  retry_budget: int = 16, base_delay: float = 0.05,
                  max_delay: float = 2.0, check_finite: bool = True,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 replicator: Any = None):
         if snapshot_every < 1:
             raise ValueError("snapshot_every must be >= 1")
+        # optional BuddyReplicator: every host snapshot is also mirrored
+        # to the buddy rank's RAM, so a RESPAWNED process (which has no
+        # local snapshot) resumes via resume_from_replica() instead of
+        # a disk checkpoint
+        self._replicator = replicator
         self._holders: List[Any] = [
             h for h in (model, optimizer)
             if h is not None and hasattr(h, "state_dict")]
@@ -116,11 +110,91 @@ class ReliableStep:
 
     # -- snapshot/restore ------------------------------------------------
     def snapshot(self) -> None:
-        """Copy every holder's state_dict to host memory NOW."""
+        """Copy every holder's state_dict to host memory NOW (and mirror
+        it to the buddy rank when a replicator is attached — replication
+        is best-effort: a full shm store must not fail the step)."""
         self._snapshot = [_tree_to_host(h.state_dict())
                           for h in self._holders]
         self._snapshot_step = self._step
         self.stats["snapshots"] += 1
+        if self._replicator is not None:
+            try:
+                self._replicator.put(list(self._snapshot),
+                                     step=self._step)
+            except Exception as e:
+                # best-effort by contract: a full shm store OR an
+                # unserializable leaf in some holder's state must not
+                # fail the step — the local snapshot (which tolerates
+                # arbitrary leaves) still covers in-job rollback
+                flight_recorder.record("elastic.replica_put_failed",
+                                       step=self._step,
+                                       error=str(e)[:200])
+
+    def resume_from_replica(self) -> Optional[int]:
+        """Respawn path: adopt the newest buddy-replicated snapshot as
+        this process's state — holders get ``set_state_dict``, the local
+        snapshot and step counter jump to the replica's. Returns the
+        replica's step, or None when no intact replica exists (resume
+        from the disk checkpoint chain instead).
+
+        Multi-rank caveat: each rank adopts ITS OWN replica's step, and
+        a teardown can land between two ranks' puts — with
+        ``world > 1`` after recovery, ranks must agree on the step
+        before training (broadcast the minimum of the returned steps
+        and roll anyone ahead back via the disk chain, or snapshot
+        every step so puts can't skew by more than the in-flight one).
+        The elastic drive-through exercised here recovers at world 1,
+        where the question doesn't arise."""
+        if self._replicator is None:
+            return None
+        try:
+            rec = self._replicator.fetch()
+        except ReplicaUnavailableError:
+            return None
+        tree = rec.get("tree")
+        if not isinstance(tree, list) or len(tree) != len(self._holders):
+            return None
+        # validate EVERY leaf shape against the holders' CURRENT state
+        # before applying any: a replica shaped for a different world
+        # (resharded optimizer state after a scale event) must reject
+        # cleanly and fall through to the reshard-capable disk rung,
+        # never leave the model updated and the optimizer not
+        from ..checkpoint import flatten_state_dict
+        for holder, state in zip(self._holders, tree):
+            if not isinstance(state, dict):
+                return None
+            cur = flatten_state_dict(holder.state_dict())
+            flat = flatten_state_dict(state)
+            if any(k not in flat for k in cur):
+                # the replica must COVER the holder: a missing key
+                # applied via set_state_dict would silently leave that
+                # leaf at init value while reporting a successful resume
+                flight_recorder.record(
+                    "elastic.replica_incomplete",
+                    missing=[k for k in cur if k not in flat][:8])
+                return None
+            for key, val in flat.items():
+                have = cur.get(key)
+                v_shape = getattr(val, "shape", None)
+                h_shape = getattr(have, "shape", None)
+                if v_shape is not None and h_shape is not None \
+                        and tuple(v_shape) != tuple(h_shape):
+                    flight_recorder.record(
+                        "elastic.replica_shape_mismatch", key=key,
+                        replica=list(v_shape), target=list(h_shape))
+                    return None
+        try:
+            for holder, state in zip(self._holders, tree):
+                holder.set_state_dict(state)
+        except Exception:
+            # a partial application is healed by the caller's disk
+            # restore (the ladder overwrites every holder)
+            return None
+        self._snapshot = list(tree)
+        self._step = self._snapshot_step = int(rec["step"])
+        flight_recorder.record("elastic.reliable_resume",
+                               step=self._step)
+        return self._step
 
     def restore(self) -> None:
         """Write the newest snapshot back into the live objects."""
@@ -204,6 +278,7 @@ class ReliableStep:
         if self._step % self.snapshot_every == 0:
             self.snapshot()
         flight_recorder.record("step_begin", step=self._step)
+        chaos.maybe_kill_rank(self._step)
         t0 = time.monotonic()
         try:
             out = chaos.maybe_poison_loss(step_fn(*args, **kwargs))

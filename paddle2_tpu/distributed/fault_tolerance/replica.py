@@ -1,0 +1,385 @@
+"""In-memory buddy-replicated snapshots — RAM-first elastic recovery.
+
+Gemini (Wang et al., SOSP'23) observes that most training failures kill
+one rank, not the cluster, and that recovering from a PEER's RAM is an
+order of magnitude cheaper than a storage round-trip. This module is
+that fast lane: each rank keeps its last-good step's state as a
+serialized snapshot in its own memory AND mirrors it to a **buddy rank**
+— ring topology, rank ``r``'s buddy is ``(r + 1) % world`` — so an
+in-job rollback or a single-rank respawn restores from the buddy's copy
+instead of disk, falling back to the
+:class:`~.manager.CheckpointManager` disk chain only when the buddy is
+gone too (:func:`elastic_restore` is that ladder).
+
+Transport: inside one controller the "peer RAM" is this process
+(``self._last``). Across a launcher-mode gang the mirror rides the shm
+transport — a POSIX shared-memory file store (``/dev/shm`` when
+present, so the copy lives in host RAM, never on the checkpoint
+filesystem); each ``put`` lands two CRC-enveloped files, the owner slot
+``rank_{r}.replica`` and the buddy-held mirror
+``rank_{b}.holds_{r}.replica``, written atomically (tmp + replace). A
+multi-host gang would move the mirror over ``collective`` p2p instead;
+the store abstraction is the seam where that transport plugs in.
+
+Every put/restore/miss lands in the flight recorder as an ``elastic.*``
+event, so a post-mortem can tell a RAM restore from a disk rollback.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..env import get_rank, get_world_size
+from ...framework import io_state
+from ...framework.io_state import CheckpointCorruptionError
+from . import flight_recorder
+
+# operator/launcher override for the shm store location; unset picks
+# /dev/shm (true in-memory) when writable, else the temp dir
+REPLICA_DIR_ENV = "PADDLE_REPLICA_DIR"
+
+
+class ReplicaUnavailableError(RuntimeError):
+    """No live, intact replica to restore from (never written, pruned,
+    corrupt, or shaped for a different target) — the caller drops to the
+    next rung of the recovery ladder (the disk checkpoint chain)."""
+
+
+def tree_to_host(obj: Any) -> Any:
+    """Nested state-dict -> host-memory copy (numpy leaves). The
+    device->host snapshot underlying both ReliableStep rollbacks and
+    buddy replicas: copies NOW, so later donation/mutation of the live
+    buffers cannot corrupt the snapshot."""
+    from ...framework.tensor import Tensor
+    if isinstance(obj, Tensor):
+        return np.array(np.asarray(obj._data), copy=True)
+    if isinstance(obj, dict):
+        return {k: tree_to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(tree_to_host(v) for v in obj)
+    try:
+        import jax
+        if isinstance(obj, jax.Array):
+            return np.array(np.asarray(obj), copy=True)
+    except ImportError:
+        pass
+    return obj
+
+
+def default_store_dir(job: Optional[str] = None) -> str:
+    """Shm store for this job: ``PADDLE_REPLICA_DIR`` if set, else a
+    job-scoped directory under ``/dev/shm`` (host RAM) when writable,
+    else the temp dir (still node-local — never the checkpoint FS).
+    ``job`` overrides the ``PADDLE_JOB_ID`` lookup — the LAUNCHER must
+    pass its ``--job_id`` here, since it injects that id into workers'
+    env without carrying it in its own."""
+    d = os.environ.get(REPLICA_DIR_ENV)
+    if d:
+        return d
+    job = job or os.environ.get("PADDLE_JOB_ID", "default")
+    base = "/dev/shm" if os.path.isdir("/dev/shm") \
+        and os.access("/dev/shm", os.W_OK) else tempfile.gettempdir()
+    return os.path.join(base, f"p2t_replica_{job}")
+
+
+def _own_slot(rank: int) -> str:
+    return f"rank_{rank}.replica"
+
+
+def _mirror_slot(buddy: int, owner: int) -> str:
+    return f"rank_{buddy}.holds_{owner}.replica"
+
+
+# a ``*.replica.<pid>.tmp`` left by a rank killed mid-put (chaos
+# kill_rank is exactly this) is reaped once it is older than this; the
+# age guard keeps a live peer's in-flight write safe
+_ORPHAN_TMP_MIN_AGE_S = 60.0
+
+
+def _reap_orphan_tmps(store_dir: str) -> None:
+    """Drop stale put() tmps so repeated mid-put deaths can't grow the
+    RAM-backed store without bound (same shared reaper as the
+    distributed-checkpoint directory, different name predicate)."""
+    io_state.reap_stale_tmps(store_dir,
+                             lambda f: ".replica." in f,
+                             min_age_s=_ORPHAN_TMP_MIN_AGE_S)
+
+
+def _parse_slot(fname: str) -> Optional[Tuple[int, Optional[int]]]:
+    """``rank_{r}.replica`` -> (r, None); ``rank_{b}.holds_{r}.replica``
+    -> (b, r); anything else -> None."""
+    if not (fname.startswith("rank_") and fname.endswith(".replica")):
+        return None
+    stem = fname[len("rank_"):-len(".replica")]
+    if ".holds_" in stem:
+        b, _, r = stem.partition(".holds_")
+        if b.isdigit() and r.isdigit():
+            return int(b), int(r)
+        return None
+    if stem.isdigit():
+        return int(stem), None
+    return None
+
+
+class BuddyReplicator:
+    """Ring-buddy in-memory snapshot replication for ONE rank.
+
+    ::
+
+        rep = BuddyReplicator()                  # rank/world from env
+        rep.put({"w": w, "step": step}, step)    # after each good step
+        ...
+        # respawned rank (or rollback with the local copy lost):
+        step = rep.restore(state)                # RAM, never disk
+
+    ``put`` serializes the host copy once and lands it in the owner slot
+    plus the buddy mirror; ``restore``/``fetch`` walk local copy ->
+    owner slot -> buddy mirror and CRC-verify whatever they read.
+    """
+
+    def __init__(self, store_dir: Optional[str] = None,
+                 rank: Optional[int] = None,
+                 world: Optional[int] = None):
+        self.rank = int(get_rank() if rank is None else rank)
+        self.world = int(get_world_size() if world is None else world)
+        self.store_dir = store_dir or default_store_dir()
+        self._last: Optional[Dict[str, Any]] = None   # this process's RAM
+
+    @property
+    def buddy_rank(self) -> int:
+        return (self.rank + 1) % max(1, self.world)
+
+    # -- write ----------------------------------------------------------
+    def put(self, state: Any, step: int) -> None:
+        """Snapshot ``state`` (any nested dict/list tree; Tensor/jax
+        leaves copied to host) as this rank's last-good step."""
+        rec = {"rank": self.rank, "world": self.world, "step": int(step),
+               "wall_time": time.time(), "tree": tree_to_host(state)}
+        self._last = rec
+        os.makedirs(self.store_dir, exist_ok=True)
+        _reap_orphan_tmps(self.store_dir)
+        own = os.path.join(self.store_dir, _own_slot(self.rank))
+        mirror = os.path.join(self.store_dir,
+                              _mirror_slot(self.buddy_rank, self.rank))
+        # serialize ONCE; the mirror is a byte copy of the same
+        # envelope, not a second pickle pass over a multi-GB state
+        tmp = f"{own}.{os.getpid()}.tmp"
+        io_state.save(rec, tmp)
+        payload_bytes = os.path.getsize(tmp)
+        mtmp = f"{mirror}.{os.getpid()}.tmp"
+        shutil.copyfile(tmp, mtmp)
+        os.replace(tmp, own)
+        os.replace(mtmp, mirror)
+        # a world change moves the buddy: drop mirrors of OUR state
+        # still held at a previous buddy, so a later fetch can never
+        # prefer that stale copy over the live one
+        for fname in list(os.listdir(self.store_dir)):
+            parsed = _parse_slot(fname)
+            if parsed and parsed[1] == self.rank \
+                    and parsed[0] != self.buddy_rank:
+                try:
+                    os.remove(os.path.join(self.store_dir, fname))
+                except OSError:
+                    pass
+        flight_recorder.record("elastic.replica_put", step=int(step),
+                               buddy=self.buddy_rank,
+                               bytes=int(payload_bytes))
+
+    # -- read -----------------------------------------------------------
+    def _read_slot(self, fname: str) -> Optional[Dict[str, Any]]:
+        full = os.path.join(self.store_dir, fname)
+        if not os.path.exists(full):
+            return None
+        try:
+            rec = io_state.load(full)
+        except (CheckpointCorruptionError, OSError, ValueError,
+                pickle.PickleError, EOFError) as e:
+            flight_recorder.record("elastic.replica_corrupt", slot=fname,
+                                   error=str(e)[:200])
+            return None
+        if not isinstance(rec, dict) or "tree" not in rec:
+            return None
+        return rec
+
+    def fetch(self, rank: Optional[int] = None) -> Dict[str, Any]:
+        """Newest intact replica record for ``rank`` (default: this
+        rank): local copy, then the owner slot, then any buddy-held
+        mirror. Raises :class:`ReplicaUnavailableError` when every copy
+        is gone or corrupt."""
+        r = self.rank if rank is None else int(rank)
+        if r == self.rank and self._last is not None:
+            return self._last
+        rec = self._read_slot(_own_slot(r))
+        if rec is not None:
+            return rec
+        # the owner's copy died with it — scan the surviving mirrors
+        # (the buddy index at put time may not match today's world) and
+        # take the NEWEST by recorded step: a leftover mirror from a
+        # previous buddy must never out-rank a fresher one
+        try:
+            names = sorted(os.listdir(self.store_dir))
+        except OSError:
+            names = []
+        best: Optional[Dict[str, Any]] = None
+        best_slot = None
+        for fname in names:
+            parsed = _parse_slot(fname)
+            if parsed and parsed[1] == r:
+                cand = self._read_slot(fname)
+                if cand is not None and (
+                        best is None
+                        or int(cand.get("step", -1))
+                        > int(best.get("step", -1))):
+                    best, best_slot = cand, fname
+        if best is not None:
+            flight_recorder.record("elastic.replica_from_buddy",
+                                   rank=r, slot=best_slot,
+                                   step=int(best.get("step", -1)))
+            return best
+        flight_recorder.record("elastic.replica_miss", rank=r)
+        raise ReplicaUnavailableError(
+            f"no intact in-memory replica for rank {r} under "
+            f"{self.store_dir!r} (buddy gone too — fall back to the "
+            f"disk checkpoint chain)")
+
+    def restore(self, state_dict: Dict[str, Any],
+                rank: Optional[int] = None) -> int:
+        """Write the fetched replica back into ``state_dict`` IN PLACE
+        (Tensor leaves via ``_replace_data``, host leaves re-set);
+        returns the replica's step. A tree/shape mismatch (e.g. the
+        replica predates a resharding world change) raises
+        :class:`ReplicaUnavailableError` so the ladder falls through to
+        the reshard-capable disk load."""
+        rec = self.fetch(rank)
+        from ..checkpoint import flatten_state_dict
+        from ...framework.tensor import Tensor
+        import jax.numpy as jnp
+        if isinstance(rec["tree"], list):
+            # a list envelope was written by ReliableStep's snapshot
+            # mirror — it restores through resume_from_replica(), not
+            # through a state-dict target; say so instead of a silent
+            # miss that reads like "no replica"
+            flight_recorder.record("elastic.replica_format_mismatch",
+                                   of_rank=int(rec.get("rank", -1)))
+            raise ReplicaUnavailableError(
+                "replica holds a ReliableStep holder-list snapshot; "
+                "restore it with ReliableStep.resume_from_replica() "
+                "(or put() a state dict to use restore())")
+        flat_t = flatten_state_dict(state_dict)
+        flat_r = flatten_state_dict(rec["tree"]) \
+            if isinstance(rec["tree"], dict) else None
+        if flat_r is None or any(k not in flat_r for k in flat_t):
+            raise ReplicaUnavailableError(
+                f"replica tree does not cover the target state "
+                f"(replica of rank {rec.get('rank')} step "
+                f"{rec.get('step')})")
+
+        def _set(d, key, value):
+            parts = key.split("/")
+            for p in parts[:-1]:
+                d = d[p]
+            d[parts[-1]] = value
+
+        # validate EVERY leaf before touching the first one: a rejected
+        # replica must leave the live state untouched, never half
+        # overwritten (the ladder's next rung assumes a clean target)
+        for key, target in flat_t.items():
+            val = flat_r[key]
+            if isinstance(target, Tensor) and isinstance(val, np.ndarray) \
+                    and tuple(val.shape) != tuple(target.shape):
+                raise ReplicaUnavailableError(
+                    f"replica shape {tuple(val.shape)} != target "
+                    f"{tuple(target.shape)} for {key!r} (world "
+                    f"changed? reshard from disk instead)")
+        for key, target in flat_t.items():
+            val = flat_r[key]
+            if isinstance(target, Tensor):
+                target._replace_data(
+                    jnp.asarray(val).astype(target.dtype))
+            else:
+                _set(state_dict, key, val)
+        flight_recorder.record("elastic.replica_restore",
+                               step=int(rec["step"]),
+                               of_rank=int(rec.get("rank", -1)))
+        return int(rec["step"])
+
+    # -- hygiene --------------------------------------------------------
+    def clear(self) -> None:
+        """Drop this rank's local copy and its slots in the store."""
+        self._last = None
+        for fname in (_own_slot(self.rank),
+                      _mirror_slot(self.buddy_rank, self.rank)):
+            try:
+                os.remove(os.path.join(self.store_dir, fname))
+            except OSError:
+                pass
+
+
+def prune_store(live_world: int, store_dir: Optional[str] = None,
+                job: Optional[str] = None) -> List[str]:
+    """Elastic scale-in hygiene (launcher-side): drop replica slots
+    owned by OR held at ranks that left the gang, so a later restore
+    can never resurrect a departed rank's stale state. Returns the
+    removed file names; harmless when the store doesn't exist. The
+    launcher passes ``job=args.job_id`` so the default store resolves
+    to the SAME directory the workers write (their env carries the
+    injected ``PADDLE_JOB_ID``; the launcher's may not)."""
+    d = store_dir or default_store_dir(job)
+    removed: List[str] = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return removed
+    for fname in names:
+        parsed = _parse_slot(fname)
+        if parsed is None:
+            continue
+        holder, owner = parsed
+        if holder >= int(live_world) or \
+                (owner is not None and owner >= int(live_world)):
+            try:
+                os.remove(os.path.join(d, fname))
+                removed.append(fname)
+            except OSError:
+                pass
+    return removed
+
+
+def elastic_restore(state_dict: Dict[str, Any],
+                    replicator: Optional[BuddyReplicator] = None,
+                    manager=None) -> Tuple[Optional[int], Optional[str]]:
+    """The recovery ladder, cheapest rung first: (1) buddy in-memory
+    replica — zero checkpoint-directory reads; (2) the
+    :class:`~.manager.CheckpointManager` disk chain, whose
+    ``load_state_dict`` reshards a checkpoint written at any world
+    size onto the current one. Returns ``(step, source)`` where source
+    is ``"replica"``, ``"disk"``, or ``None`` when nothing restored —
+    train from scratch."""
+    if replicator is not None:
+        try:
+            step = replicator.restore(state_dict)
+            flight_recorder.record("elastic.restore", source="replica",
+                                   step=step)
+            return step, "replica"
+        except ReplicaUnavailableError:
+            pass
+    if manager is not None:
+        step = manager.restore(state_dict)
+        if step is not None:
+            flight_recorder.record("elastic.restore", source="disk",
+                                   step=step)
+            return step, "disk"
+    flight_recorder.record("elastic.restore", source=None, step=None)
+    return None, None
+
+
+__all__ = ["BuddyReplicator", "ReplicaUnavailableError",
+           "elastic_restore", "prune_store", "tree_to_host",
+           "default_store_dir", "REPLICA_DIR_ENV"]

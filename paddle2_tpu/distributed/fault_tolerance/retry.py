@@ -15,13 +15,27 @@ import time
 from typing import Any, Callable, Optional, Tuple, Type
 
 
-def backoff_delays(base_delay: float, max_delay: float, attempts: int):
-    """The deterministic delay schedule ``retry_with_backoff`` sleeps
-    through: base, 2*base, 4*base, ... capped at ``max_delay``. Exposed
-    so tests and callers can reason about the worst-case wall time."""
+def backoff_delays(base_delay: float, max_delay: float, attempts: int,
+                   jitter: float = 0.0, rng=None):
+    """The delay schedule ``retry_with_backoff`` sleeps through: base,
+    2*base, 4*base, ... capped at ``max_delay``. Exposed so tests and
+    callers can reason about the worst-case wall time.
+
+    ``jitter`` stretches each delay by a uniform random factor in
+    ``[1, 1 + jitter]`` — BOUNDED decorrelation: a gang of ranks
+    respawning off the same failure would otherwise hit a shared store
+    (the rendezvous master, an NFS heartbeat dir) in lock-step at every
+    backoff rung (thundering herd). Never shrinks below the
+    deterministic schedule, never exceeds ``(1 + jitter) * max_delay``.
+    ``rng`` (an object with ``uniform``) pins the randomness in tests."""
+    if rng is None:
+        import random as rng
     d = base_delay
     for _ in range(attempts):
-        yield min(d, max_delay)
+        delay = min(d, max_delay)
+        if jitter > 0.0:
+            delay *= 1.0 + rng.uniform(0.0, jitter)
+        yield delay
         d *= 2.0
 
 
@@ -33,8 +47,8 @@ def retry_with_backoff(fn: Callable[[], Any], *,
                        = (Exception,),
                        on_retry: Optional[Callable[[int, BaseException],
                                                    None]] = None,
-                       sleep: Optional[Callable[[float], None]]
-                       = None) -> Any:
+                       sleep: Optional[Callable[[float], None]] = None,
+                       jitter: float = 0.0, rng=None) -> Any:
     """Call ``fn()`` up to ``max_attempts`` times, sleeping an
     exponentially growing delay between attempts.
 
@@ -42,13 +56,15 @@ def retry_with_backoff(fn: Callable[[], Any], *,
     anything else propagates immediately (a programming error must not
     burn the retry budget). ``on_retry(attempt, exc)`` is invoked before
     each sleep, for logging / metrics / test introspection. The final
-    failure re-raises the last exception unchanged.
+    failure re-raises the last exception unchanged. ``jitter``/``rng``
+    decorrelate a gang of retriers (see :func:`backoff_delays`).
     """
     if max_attempts < 1:
         raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
     if sleep is None:
         sleep = time.sleep        # bound late: tests may patch time.sleep
-    delays = backoff_delays(base_delay, max_delay, max_attempts - 1)
+    delays = backoff_delays(base_delay, max_delay, max_attempts - 1,
+                            jitter=jitter, rng=rng)
     last: Optional[BaseException] = None
     for attempt in range(max_attempts):
         try:

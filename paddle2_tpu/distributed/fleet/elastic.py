@@ -9,8 +9,10 @@ launcher (--max_restarts). This manager tracks liveness and answers the
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
+import sys
 import time
 from typing import Dict, List, Optional
 
@@ -50,15 +52,48 @@ class ElasticManager:
         # larger run must not restart-thrash the smaller job until they
         # expire
         self._started = time.time()
+        self._deregistered = False
+        self._atexit_armed = False
+        self._last_missing: tuple = ()   # scale-in events fire per
+                                         # TRANSITION, not per poll
 
     def _path(self, rank: int) -> str:
         return os.path.join(self.store_dir, f"rank_{rank}.hb")
+
+    def _tomb_path(self, rank: int) -> str:
+        return os.path.join(self.store_dir, f"rank_{rank}.left")
 
     def heartbeat(self):
         now = time.time()
         if now - self._last_beat < self.interval:
             return
+        self._deregistered = False
         path = self._path(self.rank)
+        # a (re)joining rank cancels its own tombstone: it is a member
+        # again, not a graceful departure
+        try:
+            os.remove(self._tomb_path(self.rank))
+        except OSError:
+            pass
+        if not self._atexit_armed:
+            # a CLEAN interpreter exit deregisters (a rank that simply
+            # returned from main must not read as a dead node for the
+            # next dead_after seconds). Python-level crashes DO run
+            # atexit, so a chained excepthook flags them first — a rank
+            # dying on an unhandled exception must NOT tombstone itself
+            # as a graceful departure (that would misreport a node
+            # failure as deliberate scale-in). SIGKILL/os._exit skip
+            # both hooks, which already reads as a failure.
+            self._atexit_armed = True
+            self._crashed = False
+            prev_hook = sys.excepthook
+
+            def _flag_crash(tp, val, tb):
+                self._crashed = True
+                prev_hook(tp, val, tb)
+
+            sys.excepthook = _flag_crash
+            atexit.register(self._atexit_deregister)
 
         def _write():
             # atomic: temp file + os.replace, so a concurrent
@@ -76,6 +111,59 @@ class ElasticManager:
         retry_with_backoff(_write, max_attempts=3, base_delay=0.05,
                            max_delay=0.5, retry_on=(OSError,))
         self._last_beat = now
+
+    # -- departure lifecycle --------------------------------------------
+    def deregister(self, reason: str = "graceful") -> None:
+        """Remove this rank's heartbeat and leave a ``rank_N.left``
+        tombstone, so the next rendezvous reads the departure as a
+        DELIBERATE scale-in instead of waiting ``dead_after`` seconds
+        and then misdiagnosing a node failure. Called on graceful exit
+        (atexit after the first heartbeat) and by
+        :meth:`exit_for_rescale` before an ``ELASTIC_EXIT_CODE`` exit.
+        Idempotent; shared-FS errors are swallowed (departing is
+        best-effort — the heartbeat will expire regardless)."""
+        if self._deregistered:
+            return
+        self._deregistered = True
+        try:
+            tmp = f"{self._tomb_path(self.rank)}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({"rank": self.rank, "ts": time.time(),
+                           "reason": reason}, f)
+            os.replace(tmp, self._tomb_path(self.rank))
+            os.remove(self._path(self.rank))
+        except OSError:
+            pass
+        from ..fault_tolerance import flight_recorder
+        flight_recorder.record("elastic.deregister", rank=self.rank,
+                               reason=reason)
+
+    def _atexit_deregister(self) -> None:
+        if getattr(self, "_crashed", False):
+            return      # crashed, not graceful: let the heartbeat
+                        # expire and read as the node failure it is
+        try:
+            self.deregister(reason="atexit")
+        except Exception:
+            pass
+
+    def exit_for_rescale(self, reason: str = "scale_in") -> None:
+        """Announce a deliberate scale event: deregister the heartbeat,
+        then exit with :data:`ELASTIC_EXIT_CODE` so the launcher
+        restarts the gang without consuming the failure budget."""
+        self.deregister(reason=reason)
+        raise SystemExit(ELASTIC_EXIT_CODE)
+
+    def departed_gracefully(self) -> List[int]:
+        """Ranks with a live ``.left`` tombstone — deliberate leavers
+        the next rendezvous should NOT count as node failures."""
+        out = []
+        for fname in os.listdir(self.store_dir):
+            if fname.startswith("rank_") and fname.endswith(".left"):
+                stem = fname[len("rank_"):-len(".left")]
+                if stem.isdigit():
+                    out.append(int(stem))
+        return sorted(out)
 
     def _alive_entries(self) -> List[dict]:
         now = time.time()
@@ -108,8 +196,25 @@ class ElasticManager:
         entries = self._alive_entries()
         alive = sorted(int(d["rank"]) for d in entries)
         if len(alive) == self.world:
+            self._last_missing = ()
             return ElasticStatus.HOLD
         if len(alive) < self.world:
+            # distinguish deliberate scale-in (every missing rank left a
+            # tombstone) from a node failure in the evidence stream —
+            # the re-form is the same, the post-mortem is not. Recorded
+            # once per TRANSITION: the watch loop polls every heartbeat
+            # interval, and duplicates would evict real step/collective
+            # evidence from the bounded ring
+            missing = tuple(r for r in range(self.world)
+                            if r not in alive)
+            if missing != self._last_missing:
+                self._last_missing = missing
+                left = set(self.departed_gracefully())
+                from ..fault_tolerance import flight_recorder
+                flight_recorder.record(
+                    "elastic.scale_in", missing=list(missing),
+                    deliberate=bool(missing)
+                    and all(r in left for r in missing))
             return ElasticStatus.RESTART
         # surplus ranks: a JOIN only counts if its heartbeat is fresher
         # than this manager's start — stale files from a previous larger

@@ -71,6 +71,13 @@ def _parse(argv: Optional[List[str]] = None):
                         "after the launcher receives SIGTERM (TPU "
                         "preemption notice); extended while a worker's "
                         "save-in-flight marker exists")
+    p.add_argument("--mttr_budget", type=float, default=0.0,
+                   help="mean-time-to-recovery budget (seconds) for a "
+                        "restart: the launcher times failure-detection "
+                        "-> respawn, records it in the elastic event "
+                        "stream, and warns when the budget is blown "
+                        "(0 = record only). bench.py --elastic gates "
+                        "the full kill->first-step MTTR on top")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -97,7 +104,7 @@ def _launch_session() -> str:
 _SESSION = None
 
 
-def _worker_env(args, local_rank: int, attempt: int = 0) -> dict:
+def _worker_env(args, local_rank: int, generation: int = 0) -> dict:
     global _SESSION
     if _SESSION is None:
         _SESSION = _launch_session()
@@ -113,8 +120,9 @@ def _worker_env(args, local_rank: int, attempt: int = 0) -> dict:
         "PADDLE_PREEMPT_MARKER": f"{_marker_prefix()}.{rank}",
         # gang restart generation: flight-recorder dump headers carry it
         # and CheckpointManager fences latest-pointer commits on it, so
-        # a zombie pre-restart rank cannot clobber the new lineage
-        "PADDLE_RESTART_GENERATION": str(attempt),
+        # a zombie pre-restart rank cannot clobber the new lineage. It
+        # bumps on EVERY re-formation, deliberate scale events included
+        "PADDLE_RESTART_GENERATION": str(generation),
         "PADDLE_LAUNCH_SESSION": _SESSION,
     })
     if args.master:
@@ -131,7 +139,7 @@ def _worker_env(args, local_rank: int, attempt: int = 0) -> dict:
     return env
 
 
-def _spawn(args, attempt: int = 0) -> List[subprocess.Popen]:
+def _spawn(args, generation: int = 0) -> List[subprocess.Popen]:
     procs = []
     for lr in range(args.nproc_per_node):
         cmd = [sys.executable, args.training_script] \
@@ -144,7 +152,7 @@ def _spawn(args, attempt: int = 0) -> List[subprocess.Popen]:
             log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
             f = open(log_path, "ab")
             stdout = stderr = f
-        p = subprocess.Popen(cmd, env=_worker_env(args, lr, attempt),
+        p = subprocess.Popen(cmd, env=_worker_env(args, lr, generation),
                              stdout=stdout, stderr=stderr)
         p.log_path = log_path
         procs.append(p)
@@ -221,6 +229,44 @@ def _prune_gossip(live_world: int) -> None:
         if pruned:
             print(f"[launch] pruned step gossip of departed ranks "
                   f"{pruned}", file=sys.stderr)
+    except Exception:
+        pass
+
+
+def _prune_departed(live_world: int, job_id: Optional[str] = None) -> None:
+    """Scale-event hygiene, all three stores at once: step-time gossip
+    (straggler attribution), flight-recorder dumps (post-mortem
+    evidence of the live lineage only), and buddy-replica slots (a
+    departed rank's stale snapshot must never be restored).
+    ``job_id`` pins the default replica store to the workers' job (the
+    launcher injects PADDLE_JOB_ID into THEIR env, not its own)."""
+    _prune_gossip(live_world)
+    try:
+        from ..fault_tolerance.flight_recorder import prune_ranks
+        pruned = prune_ranks(live_world)
+        if pruned:
+            print(f"[launch] pruned flight-recorder dumps of departed "
+                  f"ranks {pruned}", file=sys.stderr)
+    except Exception:
+        pass
+    try:
+        # covers the default /dev/shm store too (PADDLE_REPLICA_DIR is
+        # optional for workers); prune_store no-ops on a missing dir
+        from ..fault_tolerance.replica import prune_store
+        removed = prune_store(live_world, job=job_id)
+        if removed:
+            print(f"[launch] pruned buddy replicas of departed "
+                  f"ranks: {', '.join(removed)}", file=sys.stderr)
+    except Exception:
+        pass
+
+
+def _elastic_event(kind: str, **fields) -> None:
+    """Launcher-side ``elastic.*`` event: appended to the flight dir's
+    ``elastic_events.jsonl`` (no-op without PADDLE_FLIGHT_DIR)."""
+    try:
+        from ..fault_tolerance.flight_recorder import append_elastic_event
+        append_elastic_event(kind, **fields)
     except Exception:
         pass
 
@@ -335,16 +381,22 @@ def _watch(procs: List[subprocess.Popen],
         time.sleep(0.5)
 
 
-def _spawn_layout(args, layout: dict, me: dict,
+def _spawn_layout(args, layout: dict, me: dict, generation: int,
                   attempt: int) -> List[subprocess.Popen]:
     """Spawn the local gang for one rendezvous layout: global ranks are
-    the master-assigned offset + local rank, world is the layout's."""
+    the master-assigned offset + local rank, world is the layout's.
+    ``generation`` bumps on every re-formation (not just failures) —
+    the checkpoint-fencing / flight-dump stamp; ``attempt`` counts only
+    budget-consuming FAILURES and is what workers see as
+    ``PADDLE_ELASTIC_RESTART_COUNT`` (same semantics as the
+    single-node loop — a deliberate rescale must not read as a
+    failure)."""
     procs = []
     for lr in range(args.nproc_per_node):
         # one shared env builder (_worker_env: devices, master, job id),
         # then override the rank/world vars with the MASTER-ASSIGNED
         # layout instead of the static nnodes*nproc derivation
-        env = _worker_env(args, lr, attempt)
+        env = _worker_env(args, lr, generation)
         rank = me["rank_offset"] + lr
         env.update({
             "PADDLE_TRAINER_ID": str(rank),
@@ -450,7 +502,9 @@ def _elastic_agent(args) -> int:
     client = MasterClient(args.rdzv_master)
     node_id = f"node-{args.node_rank}"
     host = socket.gethostname()
-    attempt = 0
+    attempt = 0        # budget-consuming failures
+    generation = 0     # bumps on EVERY re-formation (fencing stamp)
+    t_detect = None    # set when a gang ends; cleared at the respawn
     forwarder = _PreemptForwarder(args.preempt_grace).install()
     beat_thread_stop = threading.Event()
 
@@ -483,11 +537,23 @@ def _elastic_agent(args) -> int:
             print(f"[launch] job v{version}: world={layout['world']} "
                   f"nnodes={layout['nnodes']} node_rank="
                   f"{me['node_rank']}", file=sys.stderr)
-            _prune_gossip(int(layout["world"]))
-            procs = _spawn_layout(args, layout, me, attempt)
+            _elastic_event("rendezvous", version=version,
+                           world=int(layout["world"]),
+                           nnodes=int(layout["nnodes"]),
+                           node_rank=int(me["node_rank"]),
+                           generation=generation, restart=attempt)
+            _prune_departed(int(layout["world"]), args.job_id)
+            procs = _spawn_layout(args, layout, me, generation, attempt)
+            if t_detect is not None:
+                # the re-formation this span budgets is now COMPLETE:
+                # teardown + rendezvous + settle + prune + spawn
+                _mttr_check(args, t_detect, generation)
+                t_detect = None
             state, rc, _n = _watch_with_master(procs, client, node_id,
                                                version, args.rdzv_beat,
                                                forwarder)
+            t_detect = time.time()
+            generation += 1            # any outcome below re-forms
             if state in ("done", "preempted"):
                 if state == "preempted":
                     print("[launch] preemption: gang checkpointed and "
@@ -500,6 +566,8 @@ def _elastic_agent(args) -> int:
             if state == "rescale":
                 print("[launch] membership changed — rescaling",
                       file=sys.stderr)
+                _elastic_event("rescale", version=version,
+                               generation=generation)
                 continue
             # local failure
             _surface_failure_logs(procs)
@@ -511,11 +579,17 @@ def _elastic_agent(args) -> int:
                     print(f"[launch] gang failed (rc={rc}) after "
                           f"{attempt - 1} restarts; leaving job",
                           file=sys.stderr)
+                    _elastic_event("give_up", rc=rc,
+                                   restarts=attempt - 1,
+                                   generation=generation)
                     try:
                         client.leave(node_id)
                     except Exception:
                         pass
                     return rc
+            else:
+                _elastic_event("scale_request", rc=rc,
+                               generation=generation)
             # leave+rejoin bumps the version twice so OTHER nodes
             # rescale around our restart instead of hanging on dead
             # collectives
@@ -545,9 +619,24 @@ def launch(argv: Optional[List[str]] = None) -> int:
 
 
 def _launch_loop(args, forwarder: _PreemptForwarder, attempt: int) -> int:
+    # `attempt` counts budget-consuming failures; `generation` bumps on
+    # EVERY respawn (failures AND deliberate scale events) — it is the
+    # checkpoint-fencing stamp, and a zombie from before a scale event
+    # must be fenced just like one from before a crash
+    generation = attempt
+    t_detect = None
     while True:
-        procs = _spawn(args, attempt)
+        procs = _spawn(args, generation)
+        _elastic_event("respawn", generation=generation,
+                       world=args.nnodes * args.nproc_per_node,
+                       restart=attempt)
+        if t_detect is not None:
+            # measured AFTER the respawn it budgets: the span covers
+            # teardown, log surfacing, pruning, and the spawn itself
+            _mttr_check(args, t_detect, generation)
+            t_detect = None
         rc, n_failed, preempted = _watch(procs, forwarder)
+        t_detect = time.time()
         if preempted:
             print("[launch] preemption: gang checkpointed and exited",
                   file=sys.stderr)
@@ -566,7 +655,13 @@ def _launch_loop(args, forwarder: _PreemptForwarder, attempt: int) -> int:
                 print(f"[launch] gang failed (rc={rc}) after "
                       f"{attempt - 1} restarts; giving up",
                       file=sys.stderr)
+                _elastic_event("give_up", rc=rc, restarts=attempt - 1,
+                               generation=generation)
                 return rc
+        else:
+            _elastic_event("scale_request", rc=rc,
+                           generation=generation)
+        generation += 1
         if args.elastic_rescale and args.nnodes > 1:
             print("[launch] --elastic_rescale without a rendezvous "
                   "master only rescales the local gang; for multi-node "
@@ -579,12 +674,33 @@ def _launch_loop(args, forwarder: _PreemptForwarder, attempt: int) -> int:
                 print(f"[launch] scale-in: world "
                       f"{args.nproc_per_node} -> {new_world}",
                       file=sys.stderr)
+                _elastic_event("scale_in",
+                               world_from=args.nproc_per_node,
+                               world_to=new_world, rc=rc,
+                               generation=generation)
                 args.nproc_per_node = new_world
-                _prune_gossip(new_world)
+                _prune_departed(new_world, args.job_id)
         os.environ["PADDLE_ELASTIC_RESTART_COUNT"] = str(attempt)
         print(f"[launch] worker failed (rc={rc}); elastic restart "
               f"{attempt}/{args.max_restarts} at world "
               f"{args.nnodes * args.nproc_per_node}", file=sys.stderr)
+
+
+def _mttr_check(args, t_detect: float, generation: int) -> None:
+    """Record how long the launcher took from failure detection to the
+    COMPLETED respawn (callers invoke this right after the new gang is
+    spawned — the span covers teardown, rendezvous, pruning, and the
+    spawn), and warn when an --mttr_budget is blown. The
+    worker-observed MTTR (kill -> first post-recovery step) is gated by
+    ``bench.py --elastic``; this is the launcher's share of it."""
+    detect_to_respawn = time.time() - t_detect
+    _elastic_event("restart_latency",
+                   detect_to_respawn_s=round(detect_to_respawn, 4),
+                   budget_s=args.mttr_budget, generation=generation)
+    if args.mttr_budget > 0 and detect_to_respawn > args.mttr_budget:
+        print(f"[launch] MTTR budget blown: failure-to-respawn took "
+              f"{detect_to_respawn:.2f}s against a budget of "
+              f"{args.mttr_budget:.2f}s", file=sys.stderr)
 
 
 def main():

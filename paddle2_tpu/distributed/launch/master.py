@@ -179,16 +179,27 @@ class RendezvousMaster:
 
 
 class MasterClient:
-    """Agent-side client for :class:`RendezvousMaster`."""
+    """Agent-side client for :class:`RendezvousMaster`.
+
+    Polling backoff carries BOUNDED jitter (``jitter``; each delay is
+    stretched by a uniform factor in ``[1, 1+jitter]``): after a gang
+    failure every surviving agent re-polls off the same wall-clock
+    event, and an unjittered schedule hammers the master in lock-step
+    at every backoff rung. Retries are counted in :attr:`stats` and
+    surfaced to the flight recorder (``master_retry`` events) so a
+    post-mortem can see a flapping rendezvous plane."""
 
     def __init__(self, endpoint: str, timeout: float = 5.0,
-                 retries: int = 12, retry_wait: float = 0.5):
+                 retries: int = 12, retry_wait: float = 0.5,
+                 jitter: float = 0.25):
         if not endpoint.startswith("http"):
             endpoint = "http://" + endpoint
         self.endpoint = endpoint.rstrip("/")
         self.timeout = timeout
         self.retries = retries
         self.retry_wait = retry_wait
+        self.jitter = jitter
+        self.stats = {"requests": 0, "retries": 0}
 
     def _req(self, path: str, body: Optional[dict] = None,
              retries: Optional[int] = None) -> dict:
@@ -208,21 +219,33 @@ class MasterClient:
                 raise
 
         import http.client
+
+        def _note_retry(attempt: int, exc: BaseException) -> None:
+            self.stats["retries"] += 1
+            from ..fault_tolerance import flight_recorder
+            flight_recorder.record("master_retry", path=path,
+                                   attempt=attempt + 1,
+                                   error=str(exc)[:160])
+
+        self.stats["requests"] += 1
         try:
             # shared retry policy (fault_tolerance.retry): exponential
             # backoff from retry_wait capped at 2x, with the default
             # attempt count sized so a PERMANENTLY dead master still
             # surfaces in ~11s of backoff (parity with the old 20x0.5s
-            # fixed loop) while a booting one isn't hammered.
-            # HTTPException covers a master restart tearing a response
-            # mid-read (IncompleteRead/BadStatusLine); ValueError covers
-            # the torn-JSON tail of the same event.
+            # fixed loop) while a booting one isn't hammered, plus
+            # bounded jitter so a respawning gang doesn't arrive in
+            # lock-step. HTTPException covers a master restart tearing
+            # a response mid-read (IncompleteRead/BadStatusLine);
+            # ValueError covers the torn-JSON tail of the same event.
             return retry_with_backoff(
                 _once,
                 max_attempts=retries if retries is not None
                 else self.retries,
                 base_delay=self.retry_wait,
                 max_delay=self.retry_wait * 2,
+                jitter=self.jitter,
+                on_retry=_note_retry,
                 retry_on=(urllib.error.URLError, urllib.error.HTTPError,
                           http.client.HTTPException, ConnectionError,
                           OSError, TimeoutError, ValueError))

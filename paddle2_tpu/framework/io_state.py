@@ -342,3 +342,37 @@ def save_to_bytes(obj: Any, protocol: int = _PROTOCOL_DEFAULT) -> bytes:
 
 def load_from_bytes(data: bytes, return_numpy: bool = False) -> Any:
     return load(_io.BytesIO(data), return_numpy=return_numpy)
+
+
+# default age guard for reap_stale_tmps: old enough that a LIVE
+# concurrent writer (streaming writes keep mtime fresh) is never hit
+STALE_TMP_MIN_AGE_S = 60.0
+
+
+def reap_stale_tmps(directory, match,
+                    min_age_s: float = STALE_TMP_MIN_AGE_S) -> list:
+    """Remove ``*.tmp`` leftovers of a writer killed between its write
+    and its ``os.replace`` — shared by the distributed-checkpoint
+    directory and the buddy-replica store, which differ only in the
+    ``match(fname)`` predicate. Only files past ``min_age_s`` are
+    touched (a live peer's in-flight write must survive); returns the
+    reaped names."""
+    import time
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    now = time.time()
+    reaped = []
+    for fname in names:
+        if not fname.endswith(".tmp") or not match(fname):
+            continue
+        full = os.path.join(directory, fname)
+        try:
+            if now - os.path.getmtime(full) < min_age_s:
+                continue
+            os.remove(full)
+            reaped.append(fname)
+        except OSError:
+            continue
+    return reaped

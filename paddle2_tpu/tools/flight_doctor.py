@@ -104,6 +104,29 @@ def load_gossip(directory: Optional[str]) -> Dict[int, float]:
     return times
 
 
+def load_elastic_events(directory: Optional[str]) -> List[Dict[str, Any]]:
+    """The launcher's ``elastic.*`` event stream
+    (``elastic_events.jsonl``: rendezvous outcomes, scale events,
+    respawns, restart latency), empty if absent or unreadable."""
+    out: List[Dict[str, Any]] = []
+    if not directory:
+        return out
+    path = os.path.join(directory, "elastic_events.jsonl")
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and \
+                        str(rec.get("kind", "")).startswith("elastic."):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
 # ---------------------------------------------------------------- analysis
 def _collective_sig(ev: Dict[str, Any]) -> Tuple:
     shape = ev.get("shape")
@@ -129,9 +152,14 @@ def _rank_list(ranks) -> str:
 
 
 def diagnose(dumps: Dict[int, Dict[str, Any]],
-             gossip: Optional[Dict[int, float]] = None) -> Dict[str, Any]:
+             gossip: Optional[Dict[int, float]] = None,
+             elastic: Optional[List[Dict[str, Any]]] = None
+             ) -> Dict[str, Any]:
     """Merge per-rank dumps into a structured diagnosis (the JSON the
-    CLI prints with ``--json``; the text report renders the same dict)."""
+    CLI prints with ``--json``; the text report renders the same dict).
+    ``elastic`` is the launcher's scale-event timeline — evidence of
+    WHY the world looks the way it does (rescales, give-ups, restart
+    latency), kept in the report verbatim (newest 20)."""
     gossip = gossip or {}
     ranks = sorted(dumps)
     report: Dict[str, Any] = {
@@ -148,6 +176,7 @@ def diagnose(dumps: Dict[int, Dict[str, Any]],
         "desyncs": [],
         "guilty": [],
         "straggler": {},
+        "elastic_events": list(elastic or [])[-20:],
     }
     world = report["world"] or (max(ranks) + 1 if ranks else 0)
     report["missing_dumps"] = [r for r in range(world) if r not in dumps]
@@ -288,6 +317,19 @@ def diagnose(dumps: Dict[int, Dict[str, Any]],
 
 
 # ---------------------------------------------------------------- report
+def _format_elastic_timeline(report: Dict[str, Any]) -> List[str]:
+    ev = report.get("elastic_events") or []
+    if not ev:
+        return []
+    L = ["ELASTIC TIMELINE (launcher)"]
+    for e in ev:
+        extra = {k: v for k, v in e.items()
+                 if k not in ("type", "kind", "t")}
+        detail = " ".join(f"{k}={v}" for k, v in sorted(extra.items()))
+        L.append(f"  t={e.get('t', 0):.3f} {e.get('kind')} {detail}")
+    return L
+
+
 def format_report(report: Dict[str, Any], directory: str) -> str:
     L: List[str] = []
     ranks = report["ranks"]
@@ -295,7 +337,9 @@ def format_report(report: Dict[str, Any], directory: str) -> str:
              f"{directory}")
     if not ranks:
         L.append("  no rank_N.jsonl dumps found — is PADDLE_FLIGHT_DIR "
-                 "set on the workers?")
+                 "set on the workers? (a SIGKILLed gang leaves none; "
+                 "the launcher timeline below may still explain it)")
+        L.extend(_format_elastic_timeline(report))
         return "\n".join(L)
     L.append(f"  ranks: {_rank_list(ranks)} (world "
              f"{report['world'] or '?'}) generations: "
@@ -374,6 +418,8 @@ def format_report(report: Dict[str, Any], directory: str) -> str:
                 L.append(f"  suspected straggler rank(s): "
                          f"{_rank_list(g['suspects'])} "
                          f"(step time > {_STRAGGLER_K:g} x median)")
+
+    L.extend(_format_elastic_timeline(report))
     return "\n".join(L)
 
 
@@ -402,7 +448,8 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
     dumps = load_dumps(args.flight_dir)
-    report = diagnose(dumps, load_gossip(args.gossip_dir))
+    report = diagnose(dumps, load_gossip(args.gossip_dir),
+                      load_elastic_events(args.flight_dir))
     if args.json:
         print(json.dumps(report, indent=2, default=str))
     else:

@@ -9,7 +9,26 @@ import time
 
 import pytest
 
-from paddle2_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+from paddle2_tpu.distributed.fleet.elastic import (
+    ELASTIC_EXIT_CODE as ELASTIC_EXIT_CODE_IMPORTED, ElasticManager,
+    ElasticStatus)
+
+
+@pytest.fixture(autouse=True)
+def _rank_env_guard():
+    """_mgr writes rank/world straight into os.environ; restore after
+    each test so a world-2/rank-1 manager test cannot poison every
+    later checkpoint test in the session (rank 1 never commits the
+    ``latest`` pointer; world > 1 flips saves into legacy-merge
+    mode)."""
+    saved = {k: os.environ.get(k)
+             for k in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM")}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
 
 
 def _mgr(tmp_path, rank, world, dead_after=0.5):
@@ -70,6 +89,88 @@ def test_corrupt_heartbeat_files_ignored(tmp_path):
     m0.heartbeat()
     (tmp_path / "rank_9.hb").write_text("{not json")
     assert m0.alive_ranks() == [0]
+
+
+def test_deregister_removes_heartbeat_and_leaves_tombstone(tmp_path):
+    """Satellite: a deliberate departure removes the host file NOW (no
+    dead_after purgatory) and tombstones itself so the next rendezvous
+    can tell scale-in from node death."""
+    m0 = _mgr(tmp_path, 0, 2, dead_after=300)
+    m1 = _mgr(tmp_path, 1, 2, dead_after=300)
+    m0.heartbeat()
+    m1.heartbeat()
+    assert m0.alive_ranks() == [0, 1]
+    m1.deregister(reason="scale_in")
+    # no expiry wait: the departure is visible immediately
+    assert m0.alive_ranks() == [0]
+    assert m0.watch() == ElasticStatus.RESTART
+    assert m0.departed_gracefully() == [1]
+    m1.deregister()                          # idempotent
+    assert m0.departed_gracefully() == [1]
+
+
+def test_rejoin_cancels_own_tombstone(tmp_path):
+    m1 = _mgr(tmp_path, 1, 2)
+    m1.heartbeat()
+    m1.deregister()
+    assert m1.departed_gracefully() == [1]
+    m1._last_beat = 0.0
+    m1.heartbeat()                           # the rank is back
+    assert m1.departed_gracefully() == []
+    assert 1 in m1.alive_ranks()
+
+
+def test_crash_exit_does_not_tombstone(tmp_path, monkeypatch):
+    """A Python-level crash still runs atexit — the hook must NOT
+    tombstone the rank as a graceful departure (that would misreport a
+    node failure as deliberate scale-in). The chained excepthook flags
+    the crash first."""
+    import sys
+    monkeypatch.setattr(sys, "excepthook", lambda *a: None)
+    m1 = _mgr(tmp_path, 1, 2)
+    m1.heartbeat()
+    # simulate the unhandled exception reaching the interpreter
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError:
+        sys.excepthook(*sys.exc_info())
+    m1._atexit_deregister()              # what atexit would run
+    assert 1 in m1.alive_ranks()         # heartbeat left to expire
+    assert m1.departed_gracefully() == []
+    # a clean exit after recovery deregisters as usual
+    m1._crashed = False
+    m1._atexit_deregister()
+    assert m1.departed_gracefully() == [1]
+
+
+def test_exit_for_rescale_uses_elastic_exit_code(tmp_path):
+    m0 = _mgr(tmp_path, 0, 1)
+    m0.heartbeat()
+    with pytest.raises(SystemExit) as exc:
+        m0.exit_for_rescale()
+    assert exc.value.code == ELASTIC_EXIT_CODE_IMPORTED
+    assert m0.alive_ranks() == []            # deregistered on the way out
+
+
+def test_scale_in_event_marks_deliberate_departure(tmp_path):
+    """The flight ring distinguishes 'every missing rank tombstoned'
+    (deliberate) from a silent death."""
+    from paddle2_tpu.distributed.fault_tolerance import flight_recorder
+    m0 = _mgr(tmp_path, 0, 2, dead_after=300)
+    m1 = _mgr(tmp_path, 1, 2, dead_after=300)
+    m0.heartbeat()
+    m1.heartbeat()
+    fr = flight_recorder.enable(str(tmp_path / "flight"), rank=0,
+                                install_hooks=False)
+    try:
+        m1.deregister(reason="scale_in")
+        assert m0.watch() == ElasticStatus.RESTART
+        events = [(k, f) for _, _, k, f in fr.events()
+                  if k == "elastic.scale_in"]
+    finally:
+        flight_recorder.disable()
+    assert events and events[-1][1]["deliberate"] is True
+    assert events[-1][1]["missing"] == [1]
 
 
 def test_launcher_restarts_failed_worker(tmp_path):

@@ -282,6 +282,26 @@ class TestChaosInjector:
         f.write_bytes(b"abc")
         chaos.mutate_shard_file(str(f))      # disarmed: must be a no-op
         assert f.read_bytes() == b"abc"
+        chaos.maybe_kill_rank(0)             # disarmed: must be a no-op
+
+    def test_kill_rank_only_counts_on_victim(self, monkeypatch):
+        """kill_rank's occurrence counter ticks only on the victim rank
+        ('nth' = the victim's nth step); non-victims never count, never
+        die. (The actual SIGKILL is exercised by the slow gang test —
+        firing it here would kill pytest.)"""
+        inj = chaos.arm("kill_rank:3:1")
+        try:
+            monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+            for step in range(10):
+                chaos.maybe_kill_rank(step)  # wrong rank: no ticks
+            assert inj.counts["kill_rank"] == 0
+            monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+            chaos.maybe_kill_rank(0)
+            chaos.maybe_kill_rank(1)         # 2 ticks, 3rd would fire
+            assert inj.counts["kill_rank"] == 2
+            assert not inj.fired
+        finally:
+            chaos.disarm()
 
 
 # ------------------------------------------------------ CheckpointManager
@@ -665,7 +685,34 @@ def test_master_client_polling_uses_backoff(monkeypatch):
                      retry_wait=0.05)
     with pytest.raises(ConnectionError):
         c.layout()
-    assert delays == [0.05, 0.1]             # exponential, retries-1 sleeps
+    # exponential with BOUNDED jitter: each delay in
+    # [schedule, schedule * (1 + jitter)] — never below the
+    # deterministic rung, never unbounded (thundering-herd guard)
+    assert len(delays) == 2                  # retries-1 sleeps
+    for got, rung in zip(delays, [0.05, 0.1]):
+        assert rung <= got <= rung * (1 + c.jitter) + 1e-9
+    # retry counts surface for the flight recorder / stats
+    assert c.stats["retries"] == 2 and c.stats["requests"] == 1
+
+
+def test_master_client_backoff_jitter_is_bounded_and_decorrelates():
+    """Satellite: two clients retrying off the same schedule must not
+    sleep identical jittered delays (with a seeded rng) and the jitter
+    must stay within its bound."""
+    from paddle2_tpu.distributed.fault_tolerance.retry import \
+        backoff_delays
+    import random
+    a = list(backoff_delays(0.5, 2.0, 6, jitter=0.25,
+                            rng=random.Random(1)))
+    b = list(backoff_delays(0.5, 2.0, 6, jitter=0.25,
+                            rng=random.Random(2)))
+    plain = list(backoff_delays(0.5, 2.0, 6))
+    assert a != b                    # decorrelated ranks
+    for got_a, got_b, rung in zip(a, b, plain):
+        for got in (got_a, got_b):
+            assert rung <= got <= rung * 1.25 + 1e-9
+    # jitter=0 keeps the exact deterministic schedule
+    assert list(backoff_delays(0.5, 2.0, 6, jitter=0.0)) == plain
 
 
 # ------------------------------------------------------------------- hub

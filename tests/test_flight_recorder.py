@@ -367,6 +367,55 @@ class TestGossipPrune:
         det.reset()
 
 
+class TestElasticEvidence:
+    def test_prune_ranks_drops_departed_dumps(self, tmp_path):
+        from paddle2_tpu.distributed.fault_tolerance import \
+            flight_recorder as fr
+        for r in range(4):
+            (tmp_path / f"rank_{r}.jsonl").write_text("{}\n")
+        (tmp_path / "rank_3.stacks").write_text("stack")
+        (tmp_path / "elastic_events.jsonl").write_text("")
+        assert fr.prune_ranks(2, str(tmp_path), min_age_s=0) == [2, 3]
+        left = sorted(os.listdir(str(tmp_path)))
+        assert left == ["elastic_events.jsonl", "rank_0.jsonl",
+                        "rank_1.jsonl"]
+
+    def test_prune_ranks_keeps_fresh_failure_evidence(self, tmp_path):
+        """The dump written seconds ago by the rank whose death caused
+        this scale-in is exactly what the operator was told to read —
+        the default age guard keeps it."""
+        from paddle2_tpu.distributed.fault_tolerance import \
+            flight_recorder as fr
+        (tmp_path / "rank_1.jsonl").write_text("{}\n")   # just dumped
+        assert fr.prune_ranks(1, str(tmp_path)) == []
+        assert (tmp_path / "rank_1.jsonl").exists()
+
+    def test_elastic_event_stream_and_doctor_timeline(self, tmp_path,
+                                                      monkeypatch):
+        """The launcher's elastic.* stream appends (auto-prefixed) and
+        the doctor renders it as the ELASTIC TIMELINE section."""
+        from paddle2_tpu.distributed.fault_tolerance import \
+            flight_recorder as fr
+        monkeypatch.setenv(fr.FLIGHT_DIR_ENV, str(tmp_path))
+        fr.append_elastic_event("rendezvous", version=1, world=4)
+        fr.append_elastic_event("elastic.scale_in", world_from=4,
+                                world_to=3)
+        events = flight_doctor.load_elastic_events(str(tmp_path))
+        assert [e["kind"] for e in events] == ["elastic.rendezvous",
+                                               "elastic.scale_in"]
+        report = flight_doctor.diagnose({}, elastic=events)
+        text = flight_doctor.format_report(report, str(tmp_path))
+        assert "ELASTIC TIMELINE" in text
+        assert "elastic.scale_in" in text and "world_to=3" in text
+
+    def test_append_without_dir_is_noop(self, monkeypatch, tmp_path):
+        from paddle2_tpu.distributed.fault_tolerance import \
+            flight_recorder as fr
+        monkeypatch.delenv(fr.FLIGHT_DIR_ENV, raising=False)
+        fr.append_elastic_event("respawn", generation=1)   # no raise
+        assert flight_doctor.load_elastic_events(str(tmp_path)) == []
+
+
 # ------------------------------------------------------ overhead gate
 class TestOverheadGate:
     def test_recording_overhead_under_3pct_of_step(self, tmp_path):

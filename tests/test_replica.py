@@ -1,0 +1,274 @@
+"""Buddy-replicated in-memory snapshots + the elastic recovery ladder.
+
+RAM first, disk only when the buddy is gone too — and every rung leaves
+an ``elastic.*`` event in the flight recorder.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.nn as nn
+import paddle2_tpu.optimizer as opt
+from paddle2_tpu.distributed.fault_tolerance import (
+    BuddyReplicator, CheckpointManager, ReliableStep,
+    ReplicaUnavailableError, elastic_restore, flight_recorder)
+from paddle2_tpu.distributed.fault_tolerance import replica as rmod
+
+
+def _state(v=1.0):
+    return {"w": paddle.to_tensor(np.full((3, 2), v, np.float32)),
+            "step": int(v)}
+
+
+def _zeros():
+    return {"w": paddle.to_tensor(np.zeros((3, 2), np.float32)),
+            "step": 0}
+
+
+class TestBuddyReplicator:
+    def test_put_restore_roundtrip(self, tmp_path):
+        rep = BuddyReplicator(store_dir=str(tmp_path), rank=0, world=2)
+        rep.put(_state(5.0), step=5)
+        tgt = _zeros()
+        assert rep.restore(tgt) == 5
+        np.testing.assert_array_equal(tgt["w"].numpy(),
+                                      np.full((3, 2), 5.0, np.float32))
+        assert tgt["step"] == 5
+
+    def test_ring_topology_and_slots(self, tmp_path):
+        """rank r's snapshot lands in its own slot AND the buddy
+        (r+1 mod world) mirror — the ring over the gang."""
+        for r, buddy in [(0, 1), (1, 2), (2, 0)]:
+            rep = BuddyReplicator(store_dir=str(tmp_path), rank=r,
+                                  world=3)
+            assert rep.buddy_rank == buddy
+            rep.put(_state(float(r)), step=r)
+        names = set(os.listdir(str(tmp_path)))
+        assert {"rank_0.replica", "rank_1.replica", "rank_2.replica",
+                "rank_1.holds_0.replica", "rank_2.holds_1.replica",
+                "rank_0.holds_2.replica"} <= names
+
+    def test_respawn_reads_own_slot_then_buddy_mirror(self, tmp_path):
+        """A respawned rank (fresh object, no local copy) restores from
+        its own slot; with the owner's RAM gone (slot deleted) it falls
+        to the buddy-held mirror; with BOTH gone it raises."""
+        BuddyReplicator(store_dir=str(tmp_path), rank=0,
+                        world=2).put(_state(3.0), step=3)
+        fresh = BuddyReplicator(store_dir=str(tmp_path), rank=0, world=2)
+        assert fresh.fetch()["step"] == 3
+        os.remove(str(tmp_path / "rank_0.replica"))
+        fresh = BuddyReplicator(store_dir=str(tmp_path), rank=0, world=2)
+        assert fresh.fetch()["step"] == 3        # buddy mirror
+        os.remove(str(tmp_path / "rank_1.holds_0.replica"))
+        fresh = BuddyReplicator(store_dir=str(tmp_path), rank=0, world=2)
+        with pytest.raises(ReplicaUnavailableError):
+            fresh.fetch()
+
+    def test_world_change_cannot_resurrect_stale_mirror(self, tmp_path):
+        """A world change moves the buddy: put() drops the mirror held
+        at the PREVIOUS buddy, and fetch() picks the newest surviving
+        mirror by step — a stale copy never out-ranks a fresh one."""
+        # world 3: rank 2's buddy is 0
+        BuddyReplicator(store_dir=str(tmp_path), rank=2,
+                        world=3).put(_state(1.0), step=50)
+        assert "rank_0.holds_2.replica" in os.listdir(str(tmp_path))
+        # world 4: buddy moves to 3; the old mirror is dropped
+        BuddyReplicator(store_dir=str(tmp_path), rank=2,
+                        world=4).put(_state(2.0), step=200)
+        names = os.listdir(str(tmp_path))
+        assert "rank_3.holds_2.replica" in names
+        assert "rank_0.holds_2.replica" not in names
+        # even WITH a stale mirror planted back (sorts BEFORE the live
+        # one), fetch picks the newest step, not the first name
+        import shutil as _sh
+        stale = str(tmp_path / "stale_copy")
+        BuddyReplicator(store_dir=str(tmp_path), rank=2,
+                        world=3).put(_state(1.0), step=50)
+        _sh.copyfile(str(tmp_path / "rank_0.holds_2.replica"), stale)
+        BuddyReplicator(store_dir=str(tmp_path), rank=2,
+                        world=4).put(_state(2.0), step=200)
+        _sh.copyfile(stale, str(tmp_path / "rank_0.holds_2.replica"))
+        os.remove(stale)
+        os.remove(str(tmp_path / "rank_2.replica"))
+        got = BuddyReplicator(store_dir=str(tmp_path), rank=2,
+                              world=4).fetch()
+        assert got["step"] == 200
+
+    def test_corrupt_replica_is_unavailable_not_garbage(self, tmp_path):
+        rep = BuddyReplicator(store_dir=str(tmp_path), rank=0, world=2)
+        rep.put(_state(9.0), step=9)
+        for fname in ("rank_0.replica", "rank_1.holds_0.replica"):
+            full = str(tmp_path / fname)
+            size = os.path.getsize(full)
+            with open(full, "r+b") as f:
+                f.seek(size // 2)
+                f.write(b"\xde\xad\xbe\xef")
+        fresh = BuddyReplicator(store_dir=str(tmp_path), rank=0, world=2)
+        with pytest.raises(ReplicaUnavailableError):
+            fresh.restore(_zeros())
+
+    def test_shape_mismatch_falls_through(self, tmp_path):
+        """A replica shaped for a different target (e.g. written before
+        a resharding world change) must NOT be force-fed — the ladder
+        needs the reshard-capable disk load instead."""
+        rep = BuddyReplicator(store_dir=str(tmp_path), rank=0, world=2)
+        rep.put({"w": paddle.to_tensor(np.ones((4, 4), np.float32)),
+                 "step": 1}, step=1)
+        with pytest.raises(ReplicaUnavailableError):
+            BuddyReplicator(store_dir=str(tmp_path), rank=0,
+                            world=2).restore(_zeros())
+
+    def test_prune_store_drops_departed_ranks(self, tmp_path):
+        for r in range(4):
+            BuddyReplicator(store_dir=str(tmp_path), rank=r,
+                            world=4).put(_state(float(r)), step=r)
+        removed = rmod.prune_store(2, store_dir=str(tmp_path))
+        left = set(os.listdir(str(tmp_path)))
+        # ranks 2,3: own slots gone, mirrors THEY held gone, and mirrors
+        # OF them (held at surviving ranks) gone too
+        assert not any(".holds_2." in n or ".holds_3." in n
+                       or n.startswith(("rank_2.", "rank_3."))
+                       for n in left), left
+        assert "rank_0.replica" in left and "rank_1.replica" in left
+        assert removed                     # reported what it dropped
+
+
+class TestElasticRestoreLadder:
+    def test_replica_first_zero_disk_reads(self, tmp_path, monkeypatch):
+        """With a live buddy replica the disk chain is NEVER touched —
+        the zero-checkpoint-directory-reads contract."""
+        rep = BuddyReplicator(store_dir=str(tmp_path / "shm"), rank=0,
+                              world=2)
+        rep.put(_state(7.0), step=7)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        calls = []
+        monkeypatch.setattr(
+            mgr, "restore",
+            lambda *a, **k: calls.append(1) or None)
+        tgt = _zeros()
+        step, source = elastic_restore(tgt, rep, mgr)
+        assert (step, source) == (7, "replica")
+        assert calls == []                 # disk chain untouched
+        assert tgt["step"] == 7
+
+    def test_falls_back_to_disk_chain(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(_state(4.0), step=4)
+        rep = BuddyReplicator(store_dir=str(tmp_path / "shm"), rank=0,
+                              world=2)          # never put: replica miss
+        tgt = _zeros()
+        step, source = elastic_restore(tgt, rep, mgr)
+        assert source == "disk"
+        np.testing.assert_array_equal(tgt["w"].numpy(),
+                                      np.full((3, 2), 4.0, np.float32))
+
+    def test_nothing_to_restore(self, tmp_path):
+        rep = BuddyReplicator(store_dir=str(tmp_path / "shm"), rank=0,
+                              world=1)
+        assert elastic_restore(_zeros(), rep, None) == (None, None)
+
+    def test_ladder_events_recorded(self, tmp_path):
+        fr = flight_recorder.enable(str(tmp_path / "flight"), rank=0,
+                                    install_hooks=False)
+        try:
+            rep = BuddyReplicator(store_dir=str(tmp_path / "shm"),
+                                  rank=0, world=2)
+            rep.put(_state(2.0), step=2)
+            elastic_restore(_zeros(), rep, None)
+            kinds = [e[2] for e in fr.events()]
+        finally:
+            flight_recorder.disable()
+        assert "elastic.replica_put" in kinds
+        assert "elastic.replica_restore" in kinds
+        assert "elastic.restore" in kinds
+
+
+class TestReliableStepReplica:
+    def _build(self, tmp_path, rank=0):
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+        rep = BuddyReplicator(store_dir=str(tmp_path), rank=rank,
+                              world=2)
+        return m, o, ReliableStep(m, o, snapshot_every=1,
+                                  replicator=rep)
+
+    def test_snapshot_mirrors_to_buddy(self, tmp_path):
+        m, o, rel = self._build(tmp_path)
+
+        def step(x):
+            loss = (m(x) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            return loss
+
+        for i in range(3):
+            rel.run(step, paddle.to_tensor(
+                np.random.RandomState(i).randn(6, 4).astype(np.float32)))
+        rel.finalize()
+        assert "rank_0.replica" in os.listdir(str(tmp_path))
+
+        # "respawn": fresh process-equivalents adopt the replica
+        m2, o2, rel2 = self._build(tmp_path)
+        resumed = rel2.resume_from_replica()
+        assert resumed == 2          # last snapshot before step 2 ran
+        np.testing.assert_array_equal(
+            m2.weight.numpy(),
+            np.asarray(rel._snapshot[0]["weight"]))
+
+    def test_resume_without_replica_returns_none(self, tmp_path):
+        _, _, rel = self._build(tmp_path)
+        assert rel.resume_from_replica() is None
+
+    def test_resume_rejects_shape_mismatched_replica(self, tmp_path):
+        """A replica shaped for a different world must reject BEFORE
+        touching any holder (the ladder reshards from disk instead)."""
+        paddle.seed(0)
+        m_old = nn.Linear(8, 2)      # different world: different shapes
+        o_old = opt.SGD(learning_rate=0.1,
+                        parameters=m_old.parameters())
+        rep = BuddyReplicator(store_dir=str(tmp_path), rank=0, world=2)
+        ReliableStep(m_old, o_old, replicator=rep).snapshot()
+        m, o, rel = self._build(tmp_path)     # Linear(4, 2) holders
+        before = m.weight.numpy().copy()
+        assert rel.resume_from_replica() is None
+        np.testing.assert_array_equal(m.weight.numpy(), before)
+
+
+class TestStoreHygiene:
+    def test_put_reaps_orphan_tmps(self, tmp_path, monkeypatch):
+        """A mid-put SIGKILL leaves rank_N.replica.<pid>.tmp behind;
+        the next put reaps it (past the age guard) so the RAM store
+        can't grow without bound."""
+        orphan = tmp_path / "rank_1.replica.12345.tmp"
+        orphan.write_bytes(b"half a snapshot")
+        fresh = tmp_path / "rank_0.replica.999.tmp"
+        fresh.write_bytes(b"in flight")
+        monkeypatch.setattr(rmod, "_ORPHAN_TMP_MIN_AGE_S", 0.0)
+        rep = BuddyReplicator(store_dir=str(tmp_path), rank=0, world=2)
+        monkeypatch.setattr(rmod, "_ORPHAN_TMP_MIN_AGE_S", 0.0)
+        rep.put(_state(1.0), step=1)
+        assert not orphan.exists()
+        # age-guard path: a young tmp survives when the guard is real
+        monkeypatch.setattr(rmod, "_ORPHAN_TMP_MIN_AGE_S", 9999.0)
+        fresh.write_bytes(b"in flight")
+        rep.put(_state(2.0), step=2)
+        assert fresh.exists()
+
+    def test_default_store_dir_job_override(self, monkeypatch):
+        """The launcher passes --job_id explicitly: it injects
+        PADDLE_JOB_ID into workers' env, not its own, and must still
+        prune the store those workers actually write."""
+        monkeypatch.delenv(rmod.REPLICA_DIR_ENV, raising=False)
+        monkeypatch.delenv("PADDLE_JOB_ID", raising=False)
+        assert rmod.default_store_dir("jobx").endswith("p2t_replica_jobx")
+        monkeypatch.setenv("PADDLE_JOB_ID", "enviro")
+        assert rmod.default_store_dir().endswith("p2t_replica_enviro")
+        assert rmod.default_store_dir("jobx").endswith(
+            "p2t_replica_jobx")          # explicit wins
+        monkeypatch.setenv(rmod.REPLICA_DIR_ENV, "/custom/store")
+        assert rmod.default_store_dir("jobx") == "/custom/store"

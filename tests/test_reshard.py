@@ -1,0 +1,254 @@
+"""World-size-changing checkpoint resharding round-trips.
+
+A checkpoint written by N ranks must load on M ranks (both directions),
+bitwise-equal after the merge, with per-shard CRC verification intact —
+and each loader must read only the shard files whose recorded bounds
+overlap its local slice. The multi-rank save path is exercised for real
+(the ``multi`` branch of ``_write_phase``: per-rank data files +
+sidecars, coordinator merge, committed file list) by simulating the
+gang rank-by-rank: process_count/barriers are stubbed, the shard
+layout, files, metadata, CRCs, and the whole load path are the
+production code.
+"""
+
+import os
+import pickle
+import types
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.distributed as dist
+from paddle2_tpu.distributed import checkpoint as dck
+from paddle2_tpu.framework.io_state import CheckpointCorruptionError
+
+
+@pytest.fixture(autouse=True)
+def _default_mesh():
+    yield
+    dist.init_mesh({"dp": 8})        # restore for other tests
+
+
+def _row_bounds(world, dim0):
+    """Even row split of dim0 across `world` ranks."""
+    assert dim0 % world == 0
+    step = dim0 // world
+    return [(r * step, (r + 1) * step) for r in range(world)]
+
+
+def _fake_leaf(full, lo, hi):
+    """A duck-typed sharded leaf holding ONLY rows [lo, hi) of `full`
+    (what one host of an N-host gang can address)."""
+    return types.SimpleNamespace(
+        shape=full.shape, dtype=full.dtype,
+        addressable_shards=[types.SimpleNamespace(
+            index=(slice(lo, hi),) + (slice(None),) * (full.ndim - 1),
+            data=full[lo:hi])])
+
+
+def _save_as_gang(path, full_arrays, world, monkeypatch, scalars=None,
+                  per_rank_keys=None):
+    """Emulate an N-rank gang saving a row-sharded checkpoint through
+    the REAL multi-rank save path (coordinator saves last, like the
+    slowest host)."""
+    import jax
+    from jax.experimental import multihost_utils
+    monkeypatch.setattr(jax, "process_count", lambda: world)
+    monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                        lambda tag: None)
+    for rank in reversed(range(world)):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", str(rank))
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", str(world))
+        state = {}
+        for key, full in full_arrays.items():
+            lo, hi = _row_bounds(world, full.shape[0])[rank]
+            state[key] = _fake_leaf(full, lo, hi)
+        if per_rank_keys:
+            state.update(per_rank_keys.get(rank, {}))
+        if rank == 0 and scalars:
+            state.update(scalars)
+        dck.save_state_dict(state, path, unique_id=0)
+    monkeypatch.undo()
+
+
+def _sharded_target(shape, degree, axis="dp"):
+    """A Tensor sharded `degree`-ways over rows on a fresh mesh (the
+    remaining devices fold into a replication axis)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = dist.init_mesh({"dp": degree, "rep": 8 // degree})
+    t = paddle.to_tensor(np.zeros(shape, np.float32))
+    t._replace_data(jax.device_put(t._data,
+                                   NamedSharding(mesh, P(axis, None))))
+    return t
+
+
+@pytest.mark.parametrize("n_save,m_load", [(1, 4), (2, 2), (4, 1),
+                                           (1, 2), (4, 2), (2, 4)])
+def test_reshard_roundtrip_world_sizes(tmp_path, monkeypatch, n_save,
+                                       m_load):
+    """Save at world size N (N shard files), load at world size M:
+    merged state must be BITWISE equal, scalars included."""
+    path = str(tmp_path / f"ck_{n_save}_{m_load}")
+    w = np.arange(8 * 6, dtype=np.float32).reshape(8, 6)
+    b = np.linspace(-3, 3, 8).astype(np.float32).reshape(8, 1)
+    _save_as_gang(path, {"w": w, "b": b}, n_save, monkeypatch,
+                  scalars={"step": 17})
+    data_files = [f for f in os.listdir(path) if f.startswith("data_")]
+    assert len(data_files) == n_save           # one shard file per rank
+
+    tgt = {"w": _sharded_target((8, 6), m_load),
+           "b": _sharded_target((8, 1), m_load), "step": 0}
+    dck.load_state_dict(tgt, path)
+    np.testing.assert_array_equal(np.asarray(tgt["w"]._data), w)
+    np.testing.assert_array_equal(np.asarray(tgt["b"]._data), b)
+    assert tgt["step"] == 17
+    # the target kept its own M-way sharding (reshard, not replace)
+    assert "dp" in str(tgt["w"]._data.sharding.spec)
+
+
+def test_reshard_rejects_corrupted_shard(tmp_path, monkeypatch):
+    """Per-shard CRC verification survives resharding: corrupting ONE
+    of the N shard files makes an M-rank load raise
+    CheckpointCorruptionError instead of merging garbage."""
+    path = str(tmp_path / "ck_crc")
+    w = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    _save_as_gang(path, {"w": w}, 4, monkeypatch)
+    victim = os.path.join(path, "data_0_2.pkl")
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.seek(size // 2)
+        chunk = f.read(32)
+        f.seek(size // 2)
+        f.write(bytes(x ^ 0xFF for x in chunk))
+    tgt = {"w": _sharded_target((8, 4), 2)}
+    with pytest.raises(CheckpointCorruptionError, match="data_0_2"):
+        dck.load_state_dict(tgt, path)
+    # verify_checkpoint (the manager's pre-commit gate) agrees
+    with pytest.raises(CheckpointCorruptionError):
+        dck.verify_checkpoint(path)
+
+
+def test_load_narrows_to_overlapping_files(tmp_path, monkeypatch):
+    """File narrowing end-to-end: a loader whose target touches only
+    rank 0's keys never opens rank 1's shard file (delete it — the load
+    must still succeed); a full-target load must notice it is gone."""
+    path = str(tmp_path / "ck_narrow")
+    a = np.full((4, 4), 2.0, np.float32)
+    b = np.full((3,), 7.0, np.float32)
+    _save_as_gang(
+        path, {}, 2, monkeypatch,
+        per_rank_keys={0: {"a": _fake_leaf(a, 0, 4)},
+                       1: {"b": _fake_leaf(b, 0, 3)}})
+    os.remove(os.path.join(path, "data_0_1.pkl"))
+    tgt = {"a": paddle.to_tensor(np.zeros((4, 4), np.float32))}
+    dck.load_state_dict(tgt, path)             # rank 1's file not needed
+    np.testing.assert_array_equal(tgt["a"].numpy(), a)
+    full = {"a": paddle.to_tensor(np.zeros((4, 4), np.float32)),
+            "b": paddle.to_tensor(np.zeros((3,), np.float32))}
+    with pytest.raises(FileNotFoundError):
+        dck.load_state_dict(full, path)
+
+
+def test_needed_files_narrows_by_bounds():
+    """Unit: a loader whose sharding addresses only rows [0, 4) needs
+    only the shard file holding those rows (the per-host narrowing a
+    multi-host gang relies on)."""
+    meta = {"tensors": {"w": {
+        "global_shape": (8, 2), "dtype": "float32",
+        "shards": [
+            {"bounds": ((0, 4), (0, 2)), "rank": 0, "file": "f0.pkl"},
+            {"bounds": ((4, 8), (0, 2)), "rank": 1, "file": "f1.pkl"},
+        ]}}, "scalars": {}}
+
+    class _HalfSharding:
+        mesh = object()
+
+        def addressable_devices_indices_map(self, shape):
+            return {"dev0": (slice(0, 4), slice(None))}
+
+    leaf = types.SimpleNamespace(shape=(8, 2), dtype=np.float32,
+                                 sharding=_HalfSharding())
+    assert dck._needed_files(meta, {"w": leaf}) == {"f0.pkl"}
+    # an unsharded loader needs every overlapping file
+    plain = np.zeros((8, 2), np.float32)
+    assert dck._needed_files(meta, {"w": plain}) == {"f0.pkl", "f1.pkl"}
+    # a shard without a recorded file (pre-upgrade checkpoint) disables
+    # narrowing entirely rather than silently skipping data
+    legacy = {"tensors": {"w": {
+        "global_shape": (8, 2), "dtype": "float32",
+        "shards": [{"bounds": ((0, 8), (0, 2)), "rank": 0}]}},
+        "scalars": {}}
+    assert dck._needed_files(legacy, {"w": plain}) is None
+
+
+def test_zero_size_tensor_survives_narrowing(tmp_path):
+    """Regression: a (0, N) shard never strictly overlaps anything, so
+    narrowing may skip its file entirely — the load must still produce
+    the empty tensor instead of raising 'no shard data found'."""
+    path = str(tmp_path / "ck_empty")
+    state = {"empty": paddle.to_tensor(np.zeros((0, 4), np.float32)),
+             "w": paddle.to_tensor(np.ones((2, 2), np.float32))}
+    dck.save_state_dict(state, path)
+    tgt = {"empty": paddle.to_tensor(np.zeros((0, 4), np.float32)),
+           "w": paddle.to_tensor(np.zeros((2, 2), np.float32))}
+    dck.load_state_dict(tgt, path)
+    assert tuple(tgt["empty"].shape) == (0, 4)
+    np.testing.assert_array_equal(tgt["w"].numpy(),
+                                  np.ones((2, 2), np.float32))
+
+
+def test_assemble_bounds_stitches_overlaps():
+    """Unit: a requested slice spanning two source shards is stitched
+    from exactly the intersections."""
+    info = {"global_shape": (6,), "dtype": "float32",
+            "shards": [{"bounds": ((0, 3),), "rank": 0, "file": "x"},
+                       {"bounds": ((3, 6),), "rank": 1, "file": "y"}]}
+    data = {("v", ((0, 3),)): np.array([0., 1., 2.], np.float32),
+            ("v", ((3, 6),)): np.array([3., 4., 5.], np.float32)}
+    out = dck._assemble_bounds("v", info, data, ((2, 5),))
+    np.testing.assert_array_equal(out, np.array([2., 3., 4.],
+                                                np.float32))
+    with pytest.raises(ValueError, match="missing shard"):
+        dck._assemble_bounds(
+            "v", info, {("v", ((0, 3),)): data[("v", ((0, 3),))]},
+            ((2, 5),))
+
+
+class TestOrphanTmpReap:
+    def test_orphan_tmps_reaped_on_next_drain(self, tmp_path,
+                                              monkeypatch):
+        """A rank killed mid-_write_phase leaves *.pkl.tmp /
+        metadata.tmp orphans; the next save/load reaps them (past the
+        age guard) so a recovering gang never counts a partial shard."""
+        path = str(tmp_path / "ck")
+        state = {"w": paddle.to_tensor(np.ones((2, 2), np.float32))}
+        dck.save_state_dict(state, path)
+        for orphan in ("data_3_1.pkl.tmp", "shards_3_1.pkl.tmp",
+                       "0.metadata.tmp"):
+            with open(os.path.join(path, orphan), "wb") as f:
+                f.write(b"partial garbage")
+        with open(os.path.join(path, "unrelated.tmp"), "wb") as f:
+            f.write(b"not ours")
+        monkeypatch.setattr(dck, "_ORPHAN_TMP_MIN_AGE_S", 0.0)
+        tgt = {"w": paddle.to_tensor(np.zeros((2, 2), np.float32))}
+        dck.load_state_dict(tgt, path)
+        left = set(os.listdir(path))
+        assert "data_3_1.pkl.tmp" not in left
+        assert "shards_3_1.pkl.tmp" not in left
+        assert "0.metadata.tmp" not in left
+        assert "unrelated.tmp" in left      # only OUR naming is touched
+        np.testing.assert_array_equal(tgt["w"].numpy(),
+                                      np.ones((2, 2), np.float32))
+
+    def test_young_tmp_survives_age_guard(self, tmp_path):
+        """A FRESH .tmp may be a live peer's in-flight write — the age
+        guard keeps it."""
+        path = str(tmp_path / "ck")
+        state = {"w": paddle.to_tensor(np.ones((2, 2), np.float32))}
+        dck.save_state_dict(state, path)
+        with open(os.path.join(path, "data_9_0.pkl.tmp"), "wb") as f:
+            f.write(b"in flight")
+        dck.save_state_dict(state, path)
+        assert "data_9_0.pkl.tmp" in os.listdir(path)
