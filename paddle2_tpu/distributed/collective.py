@@ -38,6 +38,10 @@ from .watchdog import CollectiveTimeout  # re-export: raised by timeouts
 # per-rank sequence number — the key the post-mortem doctor joins ranks
 # on. One attribute load per collective when recording is off.
 from .fault_tolerance import flight_recorder as _flight
+# chaos: flip_bits:collective corrupts the victim rank's payload at
+# dispatch (silent-data-corruption drills); same one-attribute-load
+# clean-path contract as the flight hook.
+from .fault_tolerance import chaos as _chaos
 
 P = PartitionSpec
 
@@ -365,6 +369,11 @@ def _run_process_level(kind: str, t: Tensor, extra=()) -> Tensor:
     collectives (module docstring)."""
     from jax.experimental import multihost_utils as mhu
     local = np.asarray(t._data)
+    if _chaos._ACTIVE is not None:
+        # SDC drill: the victim PROCESS feeds corrupt bits into the
+        # gather — exactly what a marginal host NIC/DMA would do
+        local = np.asarray(
+            _chaos.maybe_flip_bits_array("collective", local))
     cseq = -1
     if _flight._ACTIVE is not None:
         cseq = _flight.collective_enter(
@@ -431,6 +440,11 @@ def _run(kind: str, t: Tensor, group: Optional[Group], extra=(),
          timeout: Optional[float] = None) -> Tensor:
     _check_rank_major(t, group)
     arr = t._data
+    if _chaos._ACTIVE is not None:
+        # SDC drill, single-controller form: corrupt only the victim
+        # LOGICAL rank's dim-0 row of the rank-major payload
+        arr = _chaos.maybe_flip_bits_array("collective", arr,
+                                           rank_axis=True)
     cseq = -1
     if _flight._ACTIVE is not None:
         cseq = _flight.collective_enter(

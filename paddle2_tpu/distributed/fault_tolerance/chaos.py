@@ -19,6 +19,16 @@ Spec grammar (flag ``FLAGS_chaos`` or :func:`arm`)::
     stall_collective:1:30  hold the 1st deadline-watched collective 30 s
     kill_rank:4:1          SIGKILL rank 1's process at its 4th step
                            (node-loss simulation: no dump, no cleanup)
+    flip_bits:WHERE:N      flip N mantissa bits at WHERE ('grads': in
+                           the victim's gradients as the optimizer
+                           reads them; 'collective': in the tensor the
+                           victim feeds its next collective) — the
+                           silent-data-corruption simulation: values
+                           shift, nothing crashes, no NaN appears.
+                           Optional :RANK (victim, default 0) and :NTH
+                           (victim's Nth occurrence, default 1) pieces:
+                           flip_bits:grads:3:1:2 = 3 bits, rank 1,
+                           2nd optimizer step
 
 Clean-path cost is a single module-attribute load per hook site: every
 hook starts with ``if _ACTIVE is None: return`` — no device syncs, no
@@ -40,7 +50,9 @@ from ...flags import define_flag, flag_value
 # consumer (worker_crash), and GradScaler's unscale path (poison_grads)
 KINDS = ("corrupt_shard", "truncate_shard", "fail_commit", "poison_loss",
          "delay_collective", "worker_crash", "poison_grads",
-         "stall_collective", "kill_rank")
+         "stall_collective", "kill_rank", "flip_bits")
+
+_FLIP_WHERES = ("grads", "collective")
 
 
 class ChaosInjector:
@@ -51,6 +63,9 @@ class ChaosInjector:
         self.targets: Dict[str, Tuple[int, Optional[float]]] = {}
         self.counts: Dict[str, int] = {}
         self.fired: List[Tuple[str, str]] = []
+        # flip_bits rides its own grammar (WHERE is a word, not an nth):
+        # flip_bits:WHERE:N[:RANK[:NTH]]
+        self.flip: Optional[Dict[str, Any]] = None
         for part in spec.split(","):
             part = part.strip()
             if not part:
@@ -60,6 +75,22 @@ class ChaosInjector:
             if kind not in KINDS:
                 raise ValueError(
                     f"unknown chaos kind {kind!r}; valid: {KINDS}")
+            if kind == "flip_bits":
+                where = pieces[1] if len(pieces) > 1 else "grads"
+                if where not in _FLIP_WHERES:
+                    raise ValueError(
+                        f"flip_bits WHERE must be one of {_FLIP_WHERES},"
+                        f" got {where!r}")
+                self.flip = {
+                    "where": where,
+                    "bits": int(pieces[2]) if len(pieces) > 2 else 1,
+                    "rank": int(pieces[3]) if len(pieces) > 3 else 0,
+                    "nth": int(pieces[4]) if len(pieces) > 4 else 1,
+                }
+                self.targets[kind] = (self.flip["nth"],
+                                      float(self.flip["bits"]))
+                self.counts[kind] = 0
+                continue
             nth = int(pieces[1]) if len(pieces) > 1 else 1
             param = float(pieces[2]) if len(pieces) > 2 else None
             self.targets[kind] = (nth, param)
@@ -237,6 +268,105 @@ def maybe_kill_rank(step: Any = None) -> None:
         os.kill(os.getpid(), _signal.SIGKILL)
 
 
+def flip_mantissa_bits(arr, n_bits: int, seed: int = 0):
+    """Flip ``n_bits`` mantissa bits of a float array, at deterministic
+    (seeded) flat positions — the SDC stand-in: values shift by a few
+    ULPs-to-percent, nothing goes NaN/Inf, nothing crashes. Flips land
+    in the array's NATIVE word (bf16's 7 mantissa bits, f16's 10,
+    f32's 23, f64's 52) — an upcast-flip-downcast would round a low
+    f32 bit away and silently inject nothing on half-precision
+    gradients. Works on numpy or jax input; returns a same-shape,
+    same-dtype array."""
+    import numpy as np
+    import jax.numpy as jnp
+    src = np.array(np.asarray(arr), copy=True)
+    itemsize = src.dtype.itemsize
+    if itemsize == 2:
+        mant = 7 if "bfloat16" in str(src.dtype) else 10
+        word_t = np.uint16
+    elif itemsize == 8:
+        mant, word_t = 52, np.uint64
+    else:
+        if src.dtype != np.float32:
+            src = src.astype(np.float32)
+        mant, word_t = 23, np.uint32
+    words = np.ascontiguousarray(src).view(word_t).reshape(-1)
+    rs = np.random.RandomState(0x5DC ^ (seed & 0x7FFFFFFF))
+    for _ in range(max(1, int(n_bits))):
+        idx = int(rs.randint(0, words.size))
+        bit = int(rs.randint(0, mant))
+        words[idx] ^= word_t(1) << word_t(bit)
+    out = words.view(src.dtype).reshape(src.shape)
+    if out.dtype != np.asarray(arr).dtype:
+        out = out.astype(np.asarray(arr).dtype)
+    return jnp.asarray(out) if not isinstance(arr, np.ndarray) else out
+
+
+def _flip_armed(where: str) -> bool:
+    return (_ACTIVE is not None and _ACTIVE.flip is not None
+            and _ACTIVE.flip["where"] == where)
+
+
+def maybe_flip_bits_grads(optimizer) -> None:
+    """SDC hook (SDCGuard's wrapped ``optimizer.step``, just before the
+    gradient fingerprint is captured): flip N mantissa bits in the
+    victim rank's first live gradient. The occurrence counter ticks
+    only on the victim — ``nth`` means "the victim's nth optimizer
+    step" regardless of what healthy ranks do (kill_rank idiom)."""
+    if _ACTIVE is None or not _flip_armed("grads"):
+        return
+    from ..env import get_rank
+    if get_rank() != _ACTIVE.flip["rank"]:
+        return
+    if not _ACTIVE.should_fire("flip_bits"):
+        return
+    n = _ACTIVE.flip["bits"]
+    for p in optimizer._parameter_list():
+        if p.grad is None:
+            continue
+        p.grad._replace_data(
+            flip_mantissa_bits(p.grad._data, n,
+                               seed=_ACTIVE.counts["flip_bits"]))
+        _ACTIVE.record("flip_bits",
+                       f"grads:rank{_ACTIVE.flip['rank']}:{n}bits")
+        return
+
+
+def maybe_flip_bits_array(where: str, arr, rank_axis: bool = False):
+    """SDC hook for array-valued sites (``collective.py`` dispatch):
+    returns ``arr`` with N mantissa bits flipped when the injector
+    targets ``where`` and this process is the victim. With
+    ``rank_axis`` (single-controller rank-major tensors) the flips land
+    only in the victim's dim-0 row — one logical rank corrupts, its
+    replicas don't."""
+    if _ACTIVE is None or not _flip_armed(where):
+        return arr
+    import jax.numpy as jnp
+    # dtype gate BEFORE the occurrence counter: a non-float payload
+    # (an int metadata gather, a bool sentinel) must not consume the
+    # one-shot fire and silently turn the drill into a no-op
+    if not hasattr(arr, "dtype") or not jnp.issubdtype(arr.dtype,
+                                                       jnp.floating):
+        return arr
+    from ..env import get_rank
+    victim = _ACTIVE.flip["rank"]
+    if not rank_axis and get_rank() != victim:
+        return arr
+    if not _ACTIVE.should_fire("flip_bits"):
+        return arr
+    n = _ACTIVE.flip["bits"]
+    if rank_axis and getattr(arr, "ndim", 0) >= 1 \
+            and 0 <= victim < arr.shape[0]:
+        row = flip_mantissa_bits(arr[victim], n,
+                                 seed=_ACTIVE.counts["flip_bits"])
+        arr = arr.at[victim].set(row)
+    else:
+        arr = flip_mantissa_bits(arr, n,
+                                 seed=_ACTIVE.counts["flip_bits"])
+    _ACTIVE.record("flip_bits", f"{where}:rank{victim}:{n}bits")
+    return arr
+
+
 def maybe_poison_grads(optimizer) -> None:
     """GradScaler unscale hook: overwrite every gradient with NaN, the
     deterministic stand-in for an fp16 overflow — drives the skip-step
@@ -259,4 +389,5 @@ __all__ = ["ChaosInjector", "arm", "disarm", "active", "fired_log",
            "mutate_shard_file", "maybe_fail_commit", "maybe_poison_loss",
            "maybe_delay_collective", "maybe_stall_collective",
            "maybe_crash_worker", "maybe_poison_grads", "maybe_kill_rank",
-           "KINDS"]
+           "flip_mantissa_bits", "maybe_flip_bits_grads",
+           "maybe_flip_bits_array", "KINDS"]

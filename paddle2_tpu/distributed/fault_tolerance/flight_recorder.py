@@ -192,9 +192,15 @@ class FlightRecorder:
             events = sorted((e for e in self._ring if e is not None),
                             key=lambda e: e[0])
             n = self._n
+        import socket
         header = {
             "type": "header", "rank": self.rank, "world": self.world,
             "pid": os.getpid(), "reason": reason,
+            # quarantine identity (PADDLE_NODE_ID is launcher-stamped):
+            # lets the doctor map a convicted rank to the HOST the
+            # operator must drain — inlined to keep dump() import-free
+            "node": os.environ.get("PADDLE_NODE_ID")
+            or socket.gethostname(),
             "generation": _generation(), "wall_time": time.time(),
             "events_recorded": n,
             "events_dropped": max(0, n - len(events)),

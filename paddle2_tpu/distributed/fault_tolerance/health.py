@@ -1,0 +1,420 @@
+"""Device health probes + the persistent node-quarantine store.
+
+Silent data corruption ("Cores that don't count", Hochschild et al.;
+Meta's SDC-at-scale reports) is the failure mode that does NOT announce
+itself: a marginal chip computes wrong numbers at full speed. The
+defense has two halves, and this module is the *node-level* one (the
+*step-level* half — cross-replica gradient fingerprints — lives in
+:mod:`.sdc`):
+
+* **Device self-test** (:func:`device_selftest`): a fixed-seed
+  matmul + reduction program whose result digest must (a) be bitwise
+  identical across repeated runs on the same chip (a flaky core fails
+  repeat-agreement) and (b) match the recorded **golden** digest for
+  this device kind (first healthy run records it; a later divergence
+  convicts the chip, not the program). Runs as a *preflight* by the
+  launcher before gang formation (``--preflight``) and periodically on
+  a low-frequency timer owned by the watchdog
+  (``FLAGS_health_probe_interval_s``).
+* **Loopback echo** (:func:`loopback_echo`): a host->device->host
+  round-trip of a known bit pattern plus, when more than one device is
+  visible, a psum of ones that must equal the device count — the
+  cheapest end-to-end check that the transfer + collective path
+  returns the bytes it was given.
+* **Quarantine store** (:class:`QuarantineStore`): a persistent
+  directory (``PADDLE_QUARANTINE_DIR``) of per-node verdict files. A
+  node that fails a probe — or is majority-voted corrupt by the
+  gradient-fingerprint vote — lands here with its evidence, and the
+  launcher and ``fleet/elastic.py`` consult the store on **every**
+  re-formation so the job stops restarting onto the bad host. Verdicts
+  survive launcher restarts (that is the point: the Nth respawn must
+  not rediscover the same marginal chip).
+
+Node identity: one process drives one host's chips (the launcher's
+TPU-native model), so the natural quarantine key is the host. The
+launcher stamps each worker with ``PADDLE_NODE_ID`` (hostname, with a
+per-slot suffix when several workers share one host — per-chip
+granularity in the simulated-gang case); :func:`node_id` falls back to
+the bare hostname for standalone runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional
+
+from ...flags import define_flag, flag_value
+from . import flight_recorder
+
+# persistent quarantine store; unset disables quarantine semantics
+QUARANTINE_DIR_ENV = "PADDLE_QUARANTINE_DIR"
+# launcher-stamped node identity (hostname[/sN]); workers inherit it
+NODE_ID_ENV = "PADDLE_NODE_ID"
+
+define_flag("health_probe_interval_s", 0.0,
+            "Period of the watchdog's background device self-test "
+            "(seconds); 0 disables periodic probing. A failed probe "
+            "quarantines this node (PADDLE_QUARANTINE_DIR).")
+
+
+def node_id() -> str:
+    """This process's quarantine identity: the launcher-stamped
+    ``PADDLE_NODE_ID`` when present, else the hostname."""
+    return os.environ.get(NODE_ID_ENV) or socket.gethostname()
+
+
+# ---------------------------------------------------------------- store
+def _sanitize(host: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_." else "_" for c in host)
+
+
+class QuarantineStore:
+    """Per-node verdict files under one directory (NFS/GCS-fuse safe:
+    atomic tmp+replace writes, whole-file JSON reads). One file per
+    quarantined node — ``q_<node>.json`` holding who convicted it, why,
+    and the probe/vote evidence. Reads are cheap (an ``os.path.exists``
+    per lookup), so the launcher and elastic manager can consult the
+    store on every re-formation without a cache."""
+
+    def __init__(self, directory: Optional[str] = None):
+        self.dir = directory or os.environ.get(QUARANTINE_DIR_ENV)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.dir)
+
+    def _path(self, host: str) -> str:
+        return os.path.join(self.dir, f"q_{_sanitize(host)}.json")
+
+    def quarantine(self, host: str, reason: str,
+                   evidence: Optional[Dict[str, Any]] = None,
+                   rank: Optional[int] = None) -> Optional[str]:
+        """Record a verdict for ``host`` (idempotent: a second writer
+        for the same host just refreshes the file — every voter may
+        write). Returns the verdict path, or None when no store is
+        configured (quarantine is opt-in)."""
+        if not self.enabled:
+            return None
+        rec = {"host": host, "reason": reason, "ts": time.time(),
+               "by": node_id(), "rank": rank,
+               "evidence": evidence or {}}
+        os.makedirs(self.dir, exist_ok=True)
+        path = self._path(host)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+        flight_recorder.record("health.quarantine", host=host,
+                               reason=reason, rank=rank)
+        return path
+
+    def is_quarantined(self, host: str) -> bool:
+        return self.enabled and os.path.exists(self._path(host))
+
+    def entry(self, host: str) -> Optional[Dict[str, Any]]:
+        if not self.enabled:
+            return None
+        try:
+            with open(self._path(host)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """Every verdict in the store, oldest first."""
+        if not self.enabled or not os.path.isdir(self.dir):
+            return []
+        out = []
+        for name in sorted(os.listdir(self.dir)):
+            if not (name.startswith("q_") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.dir, name)) as f:
+                    out.append(json.load(f))
+            except (OSError, ValueError):
+                continue
+        return sorted(out, key=lambda r: r.get("ts", 0))
+
+    def release(self, host: str) -> bool:
+        """Operator override: lift a verdict (the chip was swapped)."""
+        if not self.enabled:
+            return False
+        try:
+            os.remove(self._path(host))
+            return True
+        except OSError:
+            return False
+
+
+def get_store(directory: Optional[str] = None) -> QuarantineStore:
+    return QuarantineStore(directory)
+
+
+# ---------------------------------------------------------------- probes
+class HealthReport:
+    """Outcome of one probe: ``ok``, the result ``digest``, and a
+    human-readable ``reason`` when not ok."""
+
+    def __init__(self, ok: bool, digest: Optional[int] = None,
+                 reason: str = "", device: str = "",
+                 probe: str = "selftest"):
+        self.ok = bool(ok)
+        self.digest = digest
+        self.reason = reason
+        self.device = device
+        self.probe = probe
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"ok": self.ok, "digest": self.digest,
+                "reason": self.reason, "device": self.device,
+                "probe": self.probe}
+
+    def __repr__(self):
+        return (f"HealthReport(ok={self.ok}, probe={self.probe!r}, "
+                f"digest={self.digest}, reason={self.reason!r})")
+
+
+_probe_jit = None
+
+
+def _probe_digest(seed: int = 0, size: int = 128) -> int:
+    """One run of the fixed-seed compute program: a chained matmul +
+    mixed reductions whose float32 result bytes are CRC-hashed. The
+    program exercises the MXU path (matmuls), the VPU path (elementwise
+    + reductions), and transcendentals — the units a marginal chip
+    corrupts — while staying far under a millisecond. ONE cached jitted
+    program (module-level): repeat-agreement is only meaningful when
+    every run executes the same compiled artifact, and the periodic
+    prober must not pay a trace+compile per probe."""
+    global _probe_jit
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    rs = np.random.RandomState(seed)
+    a = jnp.asarray(rs.randn(size, size).astype(np.float32))
+    b = jnp.asarray(rs.randn(size, size).astype(np.float32))
+    if _probe_jit is None:
+        def prog(x, y):
+            z = x @ y
+            z = jnp.tanh(z * 0.1) @ y.T
+            return jnp.stack([jnp.sum(z), jnp.sum(z * z),
+                              jnp.max(z), jnp.min(z)])
+
+        _probe_jit = jax.jit(prog)
+    out = np.asarray(_probe_jit(a, b)).astype(np.float32)
+    return zlib.crc32(out.tobytes())
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+        d = jax.devices()[0]
+        return f"{d.platform}:{getattr(d, 'device_kind', '?')}"
+    except Exception:
+        return "unknown"
+
+
+def _golden_path(store: QuarantineStore, device: str) -> Optional[str]:
+    if not store.enabled:
+        return None
+    return os.path.join(store.dir, f"golden_{_sanitize(device)}.json")
+
+
+def device_selftest(store: Optional[QuarantineStore] = None,
+                    repeats: int = 2, seed: int = 0) -> HealthReport:
+    """Fixed-seed matmul/reduction fingerprint, checked two ways:
+
+    1. **repeat agreement** — ``repeats`` runs of the same program must
+       produce bitwise-identical digests (XLA compiles one program; a
+       divergence is the chip, not the compiler);
+    2. **golden comparison** — when a quarantine store is configured,
+       the digest is compared against ``golden_<device>.json``; the
+       first healthy run records it (per device kind, so a CPU golden
+       never judges a TPU).
+    """
+    store = store if store is not None else get_store()
+    device = _device_kind()
+    try:
+        digests = [_probe_digest(seed) for _ in range(max(1, repeats))]
+    except Exception as e:                  # a probe that CRASHES fails
+        return HealthReport(False, reason=f"probe raised: {e!r}",
+                            device=device)
+    if len(set(digests)) != 1:
+        return HealthReport(False, digest=digests[0], device=device,
+                            reason=f"nondeterministic compute: repeated "
+                                   f"fixed-seed runs digested {digests}")
+    digest = digests[0]
+    gpath = _golden_path(store, device)
+    if gpath is not None:
+        golden = None
+        try:
+            with open(gpath) as f:
+                golden = json.load(f)
+        except (OSError, ValueError):
+            pass
+        if golden is None:
+            try:
+                os.makedirs(store.dir, exist_ok=True)
+                tmp = f"{gpath}.{os.getpid()}.tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"device": device, "digest": digest,
+                               "seed": seed, "ts": time.time(),
+                               "by": node_id()}, f)
+                os.replace(tmp, gpath)
+            except OSError:
+                pass
+        elif int(golden.get("digest", digest)) != digest:
+            return HealthReport(
+                False, digest=digest, device=device,
+                reason=f"golden mismatch: this node digested {digest}, "
+                       f"golden for {device} is {golden['digest']} "
+                       f"(recorded by {golden.get('by')})")
+    return HealthReport(True, digest=digest, device=device)
+
+
+def loopback_echo() -> HealthReport:
+    """Transfer/collective loopback: push a known bit pattern to the
+    device and read it back bitwise; with >1 visible device, also psum
+    ones over a throwaway mesh and require exactly the device count.
+    A lying DMA engine or a dropped collective lane fails here even
+    when the compute units are fine."""
+    import numpy as np
+    try:
+        import jax
+        import jax.numpy as jnp
+        pattern = np.arange(4096, dtype=np.uint32) * np.uint32(2654435761)
+        back = np.asarray(jax.device_put(jnp.asarray(pattern)))
+        if not np.array_equal(back, pattern):
+            return HealthReport(False, probe="loopback",
+                                device=_device_kind(),
+                                reason="device round-trip returned "
+                                       "different bytes")
+        n = jax.device_count()
+        if n > 1:
+            total = float(np.asarray(
+                jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+                    jnp.ones((n,), jnp.float32))[0]))
+            if total != float(n):
+                return HealthReport(
+                    False, probe="loopback", device=_device_kind(),
+                    reason=f"collective echo: psum(ones) over {n} "
+                           f"devices returned {total}")
+        return HealthReport(True, probe="loopback",
+                            device=_device_kind())
+    except Exception as e:
+        return HealthReport(False, probe="loopback",
+                            reason=f"loopback raised: {e!r}")
+
+
+def preflight(store: Optional[QuarantineStore] = None,
+              include_loopback: bool = True) -> HealthReport:
+    """Launcher-side gate, run BEFORE gang formation: self-test (+
+    loopback). A failure quarantines this node with the probe evidence
+    and appends an ``elastic.quarantine`` event so the timeline shows
+    why the node never joined. An already-quarantined node short-
+    circuits to a failed report (the launcher must not re-probe its way
+    back in)."""
+    store = store if store is not None else get_store()
+    me = node_id()
+    if store.is_quarantined(me):
+        prior = store.entry(me) or {}
+        return HealthReport(False, probe="quarantined",
+                            reason=f"node {me} already quarantined: "
+                                   f"{prior.get('reason', '?')}")
+    report = device_selftest(store)
+    if report.ok and include_loopback:
+        report = loopback_echo()
+    if not report.ok:
+        store.quarantine(me, reason=f"preflight_{report.probe}",
+                         evidence=report.as_dict())
+        flight_recorder.append_elastic_event(
+            "quarantine", host=me, reason=f"preflight_{report.probe}",
+            detail=report.reason[:300])
+    return report
+
+
+# ------------------------------------------------------- periodic prober
+class HealthProber:
+    """Low-frequency background self-test owned by the watchdog: every
+    ``FLAGS_health_probe_interval_s`` seconds, re-run the device
+    self-test on a daemon thread. A failure quarantines this node,
+    records ``health.probe_failed`` in the flight ring, and appends the
+    ``elastic.quarantine`` timeline event; eviction itself is left to
+    the step boundary (:class:`.sdc.SDCGuard`) or the next
+    re-formation — a probe thread must never yank a rank mid-
+    collective."""
+
+    _instance: Optional["HealthProber"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, interval_s: float,
+                 store: Optional[QuarantineStore] = None):
+        self.interval = float(interval_s)
+        self.store = store if store is not None else get_store()
+        self.probes = 0
+        self.failures = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def ensure(cls) -> Optional["HealthProber"]:
+        """Start the singleton prober iff the flag asks for one. Cheap
+        when off (one flag read); called from the watchdog's hot
+        entry points."""
+        interval = float(flag_value("health_probe_interval_s"))
+        if interval <= 0:
+            return cls._instance
+        with cls._lock:
+            if cls._instance is None or not cls._instance.alive():
+                cls._instance = HealthProber(interval)
+                cls._instance.start()
+            return cls._instance
+
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "HealthProber":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="health-prober")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def probe_once(self) -> HealthReport:
+        self.probes += 1
+        report = device_selftest(self.store)
+        if not report.ok:
+            self.failures += 1
+            me = node_id()
+            self.store.quarantine(me, reason="periodic_probe",
+                                  evidence=report.as_dict())
+            flight_recorder.record("health.probe_failed",
+                                   reason=report.reason[:300],
+                                   digest=report.digest)
+            flight_recorder.append_elastic_event(
+                "quarantine", host=me, reason="periodic_probe",
+                detail=report.reason[:300])
+        return report
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.probe_once()
+            except Exception:
+                pass                        # probing is best-effort
+
+
+__all__ = ["QuarantineStore", "get_store", "HealthReport",
+           "device_selftest", "loopback_echo", "preflight",
+           "HealthProber", "node_id", "QUARANTINE_DIR_ENV",
+           "NODE_ID_ENV"]

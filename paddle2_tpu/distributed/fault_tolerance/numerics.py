@@ -141,6 +141,80 @@ def grads_nonfinite_flag(optimizer, inv_scale: Optional[float] = None):
     return (None if flag is None else flag > 0), unscaled
 
 
+_fp_jit = None
+
+
+def _xor_fold(words):
+    """XOR of every element, as log2(n) vectorized halving passes —
+    ``lax.reduce`` with a custom combiner lowers to a scalar loop on
+    CPU XLA (measured ~10x slower); the fold stays vectorized on every
+    backend. Zero-padding to a power of two is xor-neutral."""
+    import jax.numpy as jnp
+    n = words.shape[0]
+    p = 1 << max(0, int(n - 1).bit_length())
+    if p != n:
+        words = jnp.concatenate(
+            [words, jnp.zeros((p - n,), jnp.uint32)])
+    while words.shape[0] > 1:
+        h = words.shape[0] // 2
+        words = words[:h] ^ words[h:]
+    return words[0]
+
+
+def _fingerprint_impl(leaves):
+    import jax
+    import jax.numpy as jnp
+    total_sum = total_xor = total_norm = None
+    for leaf in leaves:
+        f32 = leaf.astype(jnp.float32)
+        words = jax.lax.bitcast_convert_type(f32, jnp.uint32).ravel()
+        s = jnp.sum(words, dtype=jnp.uint32)        # wraps mod 2**32
+        x = _xor_fold(words)
+        n = jnp.sum(f32 * f32)
+        total_sum = s if total_sum is None else total_sum + s
+        total_xor = x if total_xor is None else total_xor ^ x
+        total_norm = n if total_norm is None else total_norm + n
+    # ONE packed buffer (norm bitcast into lane 2) so the host side
+    # pays a single transfer instead of three scalar readbacks
+    return jnp.stack([total_sum, total_xor,
+                      jax.lax.bitcast_convert_type(total_norm,
+                                                   jnp.uint32)])
+
+
+def tree_fingerprint(tree: Any):
+    """Device-side content fingerprint of every float leaf in ``tree``,
+    packed as a ``uint32[3]`` device array: ``[word_sum, word_xor,
+    bitcast(sqnorm_f32)]`` over the leaves' raw float32 bit patterns.
+    One JITTED program per leaf-shape signature (cached by jax.jit's
+    aval cache) — async-dispatched, NO host sync; the SDC guard reads
+    it back exactly once per step (:func:`fingerprint_to_host`). Any
+    single-bit difference in any leaf changes the xor fold; the
+    wrapping sum and the L2 norm catch multi-bit/compensating patterns
+    and give the post-mortem a magnitude. Returns None when the tree
+    has no float leaves."""
+    global _fp_jit
+    import jax
+    leaves = _float_leaves(tree)
+    if not leaves:
+        return None
+    if _fp_jit is None:
+        _fp_jit = jax.jit(_fingerprint_impl)
+    return _fp_jit(tuple(leaves))
+
+
+def fingerprint_to_host(fp) -> Optional[tuple]:
+    """THE one host readback of a device fingerprint: materializes the
+    packed ``uint32[3]`` as ``(sum:int, xor:int, norm:float)``. Counted
+    for the bench (the SDC overhead gate charges exactly one sync per
+    checked step)."""
+    if fp is None:
+        return None
+    _count_sync()
+    arr = np.asarray(fp)
+    return (int(arr[0]), int(arr[1]),
+            float(arr[2:3].view(np.float32)[0]))
+
+
 def all_reduce_found_inf(flag, group=None):
     """Max-reduce a found_inf sentinel across the data-parallel ranks.
 
@@ -244,7 +318,8 @@ def debug_anomaly(layer):
             r.remove()
 
 
-__all__ = ["nonfinite_flag", "grads_nonfinite_flag", "all_reduce_found_inf",
+__all__ = ["nonfinite_flag", "grads_nonfinite_flag", "tree_fingerprint",
+           "fingerprint_to_host", "all_reduce_found_inf",
            "flag_to_host", "found_nonfinite_host", "assert_finite",
            "debug_anomaly", "debug_anomaly_enabled", "host_sync_count",
            "NonFiniteError", "AnomalyDetected"]

@@ -83,7 +83,7 @@ class ReliableStep:
                  retry_budget: int = 16, base_delay: float = 0.05,
                  max_delay: float = 2.0, check_finite: bool = True,
                  sleep: Callable[[float], None] = time.sleep,
-                 replicator: Any = None):
+                 replicator: Any = None, sdc_guard: Any = None):
         if snapshot_every < 1:
             raise ValueError("snapshot_every must be >= 1")
         # optional BuddyReplicator: every host snapshot is also mirrored
@@ -91,6 +91,12 @@ class ReliableStep:
         # local snapshot) resumes via resume_from_replica() instead of
         # a disk checkpoint
         self._replicator = replicator
+        # optional SDCGuard (fault_tolerance/sdc.py): every step's
+        # gradient fingerprint is majority-voted across data-parallel
+        # replicas; a mismatch raises GradientCorruptionError (a
+        # TransientStepError) and lands in the _replay path below, so
+        # the step is re-run WITHOUT the corrupt contribution
+        self._sdc = sdc_guard
         self._holders: List[Any] = [
             h for h in (model, optimizer)
             if h is not None and hasattr(h, "state_dict")]
@@ -222,19 +228,28 @@ class ReliableStep:
         if self._watchdog_timed_out():
             raise TransientStepError("collective watchdog timeout")
 
-    def _replay(self, step_fn, args, kwargs) -> Any:
-        """Restore + bounded retry of one failed step call."""
+    def _replay(self, step_fn, args, kwargs,
+                step_no: Optional[int] = None,
+                cause: Optional[BaseException] = None) -> Any:
+        """Restore + bounded retry of one failed step call. ``step_no``
+        is the step BEING REPLAYED — callers on the deferred-detection
+        path (``_settle_pending``) must pass the pending step's number,
+        since ``self._step`` has already advanced past it; keying the
+        SDC exchange on the wrong step would post replay fingerprints
+        under the NEXT step's (step, attempt) and could convict an
+        innocent rank retrying that later step."""
+        step_no = self._step if step_no is None else step_no
         delays = backoff_delays(self.base_delay, self.max_delay,
                                 self.max_retries)
-        last: Optional[BaseException] = None
+        last: Optional[BaseException] = cause
         for attempt in range(self.max_retries):
             if self.stats["retries"] >= self.retry_budget:
                 raise RetryBudgetExceededError(
                     f"retry budget ({self.retry_budget}) exhausted at "
-                    f"step {self._step}: {last}")
+                    f"step {step_no}: {last}")
             self.stats["retries"] += 1
             flight_recorder.record(
-                "step_retry", step=self._step, attempt=attempt + 1,
+                "step_retry", step=step_no, attempt=attempt + 1,
                 error=str(last)[:300] if last is not None else None)
             self.restore()
             # a deadline-aware collective signals a timeout twice: the
@@ -246,7 +261,22 @@ class ReliableStep:
             CommWatchdog.get().consume_timeouts()
             self._sleep(next(delays))
             try:
+                if self._sdc is not None:
+                    # replay attempts vote among THEMSELVES: the
+                    # exchange is keyed by (step, attempt), so a
+                    # retried step can never be judged against a
+                    # peer's pre-retry fingerprint. Only an SDC-voted
+                    # failure is replayed by EVERY rank — a rank-local
+                    # transient's replay must not wait the full gather
+                    # timeout for peer records that will never come
+                    from .sdc import GradientCorruptionError
+                    self._sdc.begin(
+                        step_no, attempt=attempt + 1,
+                        expect_peers=isinstance(
+                            last, GradientCorruptionError))
                 out = chaos.maybe_poison_loss(step_fn(*args, **kwargs))
+                if self._sdc is not None:
+                    self._sdc.check()    # repeat corruption re-raises
                 self._check(out)         # eager check while recovering
                 return out
             except (TransientStepError, CollectiveTimeout) as e:
@@ -264,8 +294,9 @@ class ReliableStep:
         self._pending = None
         try:
             self._check(loss)
-        except TransientStepError:
-            self._replay(step_fn, args, kwargs)
+        except TransientStepError as e:
+            self._replay(step_fn, args, kwargs, step_no=step_no,
+                         cause=e)
         # the settled step is now KNOWN GOOD (validated loss, or a
         # successful replay) — the doctor's last-known-good marker
         flight_recorder.record("step_ok", step=step_no)
@@ -279,13 +310,23 @@ class ReliableStep:
             self.snapshot()
         flight_recorder.record("step_begin", step=self._step)
         chaos.maybe_kill_rank(self._step)
+        if self._sdc is not None:
+            # arms the gradient-fingerprint capture for this step; a
+            # node quarantined since the last boundary self-evicts here
+            # (SystemExit(ELASTIC_EXIT_CODE) — deliberate scale event)
+            self._sdc.begin(self._step)
         t0 = time.monotonic()
         try:
             out = chaos.maybe_poison_loss(step_fn(*args, **kwargs))
-        except (TransientStepError, CollectiveTimeout):
+            if self._sdc is not None:
+                # publish + gather + vote BEFORE the result is trusted:
+                # a fingerprint mismatch raises GradientCorruptionError
+                # (a TransientStepError) into the replay path below
+                self._sdc.check()
+        except (TransientStepError, CollectiveTimeout) as e:
             # step_fn self-reported a transient failure (or one of its
             # deadline-aware collectives timed out): recover eagerly
-            out = self._replay(step_fn, args, kwargs)
+            out = self._replay(step_fn, args, kwargs, cause=e)
         # step-time gossip: feeds the straggler suspect list that
         # CollectiveTimeout diagnostics name (dispatch wall-time only —
         # cheap, and slow ranks are slow at dispatch too)

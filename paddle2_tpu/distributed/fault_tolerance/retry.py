@@ -15,6 +15,18 @@ import time
 from typing import Any, Callable, Optional, Tuple, Type
 
 
+def _rank_rng():
+    """Default jitter RNG, SALTED BY RANK: every rank of a gang
+    retrying off the same failure draws a *different* (but per-rank
+    reproducible) jitter sequence, so the gang never hits the shared
+    store (rendezvous master, NFS heartbeat dir) in lock-step at every
+    backoff rung. A fresh generator per schedule keeps one caller's
+    draws from perturbing another's."""
+    import random
+    from ..env import get_rank
+    return random.Random(0x9E3779B9 ^ (get_rank() * 0x85EBCA6B))
+
+
 def backoff_delays(base_delay: float, max_delay: float, attempts: int,
                    jitter: float = 0.0, rng=None):
     """The delay schedule ``retry_with_backoff`` sleeps through: base,
@@ -27,9 +39,12 @@ def backoff_delays(base_delay: float, max_delay: float, attempts: int,
     (the rendezvous master, an NFS heartbeat dir) in lock-step at every
     backoff rung (thundering herd). Never shrinks below the
     deterministic schedule, never exceeds ``(1 + jitter) * max_delay``.
-    ``rng`` (an object with ``uniform``) pins the randomness in tests."""
-    if rng is None:
-        import random as rng
+    ``rng`` (an object with ``uniform``) pins the randomness in tests;
+    the default is a RANK-SALTED generator (:func:`_rank_rng`) so the
+    ranks of one gang decorrelate *by construction* while any single
+    rank's schedule stays reproducible."""
+    if rng is None and jitter > 0.0:
+        rng = _rank_rng()
     d = base_delay
     for _ in range(attempts):
         delay = min(d, max_delay)

@@ -1,0 +1,379 @@
+"""Silent-data-corruption defense: cross-replica gradient fingerprints.
+
+A marginal chip ("Cores that don't count", Hochschild et al.) computes
+*wrong numbers at full speed* — no crash, no NaN, no watchdog trip. The
+only cheap invariant a training job has against it: **data-parallel
+replicas consuming identical inputs must agree bitwise**. This module
+checks that invariant every step, before the corrupt contribution can
+enter the gradient all_reduce or a checkpoint lineage:
+
+* :func:`~.numerics.tree_fingerprint` reduces this rank's gradients to
+  three device-side scalars (wrapping word-sum, xor fold of the raw
+  float32 bit patterns, L2 norm) — async, fused, no host sync;
+* :class:`SDCGuard` reads the triple back once per step (the only
+  added sync), CRC-hashes it into a **digest**, publishes
+  ``rank_R.step_S.aA.fp`` to the exchange dir (``PADDLE_SDC_DIR``;
+  atomic tmp+replace, the same shared-FS transport as the step-time
+  gossip), gathers the peers' records for the same ``(step, attempt)``
+  and **majority-votes** the digest;
+* a minority rank is *convicted*: every rank records
+  ``sdc.fingerprint_mismatch`` in its flight ring, the majority writes
+  the suspect's node into the :class:`~.health.QuarantineStore` (with
+  the digest evidence) plus an ``elastic.quarantine`` timeline event,
+  and ALL ranks raise :class:`GradientCorruptionError` — a
+  :class:`~.reliable.TransientStepError` — so the surrounding
+  :class:`~.reliable.ReliableStep` rewinds to the last snapshot and
+  replays the step *without the corrupt result* (the retry recomputes;
+  a transient flip does not recur, a sticky chip re-convicts and burns
+  the bounded retry budget into a hard failure);
+* at the next step boundary a rank whose own node sits in the
+  quarantine store **evicts itself** (``SystemExit(ELASTIC_EXIT_CODE)``
+  — a deliberate scale event, not a budget-consuming failure), and the
+  launcher's quarantine-aware re-formation keeps it out of the next
+  rendezvous.
+
+Vote semantics: with >= 3 replicas the strict minority is guilty; with
+exactly 2 the mismatch is detected (step retried on both) but nobody is
+convicted — two witnesses, no majority. Peers that vanish mid-gather
+(a crashed rank) are excluded after ``timeout`` and the vote proceeds
+among the present, so a dead rank cannot wedge the healthy ones.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import struct
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..env import get_rank, get_world_size
+from . import chaos
+from . import flight_recorder
+from . import health
+from . import numerics
+from .reliable import TransientStepError
+
+# shared exchange directory for per-step fingerprint records; unset
+# disables the cross-replica compare (the guard still no-ops cheaply)
+SDC_DIR_ENV = "PADDLE_SDC_DIR"
+
+# records older than this many steps behind the writer are garbage-
+# collected by their own rank on the next post (bounded store growth)
+_GC_KEEP_STEPS = 4
+
+
+class GradientCorruptionError(TransientStepError):
+    """The cross-replica fingerprint vote failed: some rank computed
+    different gradient bits from its input-identical peers. Retryable —
+    ReliableStep rewinds and replays the step; the convicted rank's
+    node is already in the quarantine store."""
+
+    def __init__(self, step: int, suspects: List[int],
+                 digests: Dict[int, int]):
+        self.step = step
+        self.suspects = list(suspects)
+        self.digests = dict(digests)
+        who = (f"minority rank(s) {self.suspects} convicted"
+               if self.suspects else
+               "2-replica mismatch (no majority to convict)")
+        super().__init__(
+            f"gradient fingerprint mismatch at step {step}: {who}; "
+            f"digests {digests} — silent data corruption suspected; "
+            f"step will be retried without the corrupt contribution")
+
+
+def digest_fingerprint(host_fp: Tuple[int, int, float]) -> int:
+    """CRC32 of the packed (sum, xor, norm-bits) triple — the value the
+    replicas vote on. Bitwise-stable: equal grads hash equal, any
+    flipped mantissa bit lands in the xor fold and changes the CRC."""
+    s, x, n = host_fp
+    return zlib.crc32(struct.pack("<IIf", s & 0xFFFFFFFF,
+                                  x & 0xFFFFFFFF, n))
+
+
+def vote(digests: Dict[int, int]) -> Tuple[Optional[int], List[int]]:
+    """Majority-vote a per-rank digest map. Returns ``(majority_digest,
+    suspect_ranks)``; suspects is empty when all agree. With exactly two
+    voters disagreeing there is no majority: returns ``(None, [])`` —
+    the CALLER still treats len(set)>1 as a mismatch, just without a
+    conviction."""
+    if not digests:
+        return None, []
+    tally: Dict[int, List[int]] = {}
+    for r, d in digests.items():
+        tally.setdefault(d, []).append(r)
+    ordered = sorted(tally.items(), key=lambda kv: (-len(kv[1]),
+                                                    min(kv[1])))
+    if len(ordered) == 1:
+        return ordered[0][0], []
+    majority_digest, majority_ranks = ordered[0]
+    minority = [r for d, ranks in ordered[1:] for r in ranks]
+    if len(majority_ranks) <= len(minority):
+        return None, []                    # tie: detected, unconvicted
+    return majority_digest, sorted(minority)
+
+
+class SDCGuard:
+    """Per-rank half of the fingerprint vote, wrapped around an
+    optimizer::
+
+        guard = SDCGuard(optimizer)                 # rank/world from env
+        rel = ReliableStep(model, opt, sdc_guard=guard)
+
+    ``attach`` wraps ``optimizer.step`` so the device fingerprint is
+    captured from ``p.grad`` at the moment the update consumes them —
+    after backward, before the weights move, which on the multi-process
+    data-parallel path is *before the grad all_reduce* would run.
+    :class:`~.reliable.ReliableStep` drives the protocol:
+    ``begin(step, attempt)`` arms the capture (and self-evicts a
+    quarantined node at the step boundary), ``check()`` publishes +
+    gathers + votes and raises :class:`GradientCorruptionError` on a
+    mismatch. Standalone loops may call ``begin``/``check`` around their
+    own step."""
+
+    def __init__(self, optimizer: Any = None,
+                 store_dir: Optional[str] = None,
+                 rank: Optional[int] = None,
+                 world: Optional[int] = None,
+                 timeout: float = 10.0,
+                 quarantine: Optional[health.QuarantineStore] = None,
+                 evict: bool = True,
+                 poll_interval: float = 0.02):
+        self.dir = store_dir or os.environ.get(SDC_DIR_ENV)
+        self.rank = int(get_rank() if rank is None else rank)
+        self.world = int(get_world_size() if world is None else world)
+        # generation-scoped records: a respawned gang restarts its step
+        # numbering, so a surviving pre-restart record at the same
+        # (rank, step, attempt) must never be joined against the new
+        # incarnation (the flight doctor's stale-dump fence, applied
+        # to the fingerprint exchange)
+        try:
+            self.gen = int(os.environ.get(flight_recorder.GENERATION_ENV,
+                                          "0") or 0)
+        except ValueError:
+            self.gen = 0
+        self.timeout = float(timeout)
+        self.poll_interval = float(poll_interval)
+        self.quarantine = (quarantine if quarantine is not None
+                           else health.get_store())
+        self.evict = bool(evict)
+        self._armed = False
+        self._step = 0
+        self._attempt = 0
+        self._device_fp = None
+        self._captured = False
+        self._last_digest: Optional[int] = None
+        self._expect_peers = True
+        self.stats: Dict[str, int] = {"checks": 0, "mismatches": 0,
+                                      "convictions": 0, "skips": 0}
+        if optimizer is not None:
+            self.attach(optimizer)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.dir)
+
+    # -- optimizer hook --------------------------------------------------
+    def attach(self, optimizer: Any) -> "SDCGuard":
+        """Wrap ``optimizer.step`` to capture the gradient fingerprint
+        (and give chaos its ``flip_bits:grads`` injection point) just
+        before the update reads the grads. Instance-level shadowing —
+        other optimizers of the same class are untouched."""
+        orig = optimizer.step
+
+        def _step(*a, **k):
+            if self._armed and self.enabled:
+                chaos.maybe_flip_bits_grads(optimizer)
+                grads = [p.grad for p in optimizer._parameter_list()
+                         if p.grad is not None]
+                self._device_fp = numerics.tree_fingerprint(grads)
+                self._captured = True
+            return orig(*a, **k)
+
+        optimizer.step = _step
+        return self
+
+    # -- protocol --------------------------------------------------------
+    def begin(self, step: int, attempt: int = 0,
+              expect_peers: bool = True) -> None:
+        """Arm the capture for one (step, attempt). At attempt 0 — a
+        fresh step boundary — a node that has landed in the quarantine
+        store since the last step evicts itself with
+        ``ELASTIC_EXIT_CODE`` so the launcher re-forms without it.
+
+        ``expect_peers=False`` marks a RANK-LOCAL replay (a worker
+        crash, a local NaN — failures the peers did not see and will
+        not replay): the gather for that attempt uses a short bounded
+        wait instead of the full timeout, since no peer will ever post
+        a record for it. SDC replays keep the full wait — every rank
+        raised, so every rank posts the retry attempt."""
+        if not self.enabled:
+            return
+        if self.evict and attempt == 0 \
+                and self.quarantine.is_quarantined(health.node_id()):
+            entry = self.quarantine.entry(health.node_id()) or {}
+            flight_recorder.record("sdc.evict", step=step,
+                                   host=health.node_id(),
+                                   reason=entry.get("reason"))
+            flight_recorder.dump(f"sdc_evict:{entry.get('reason')}")
+            from ..fleet.elastic import ELASTIC_EXIT_CODE
+            raise SystemExit(ELASTIC_EXIT_CODE)
+        self._step = int(step)
+        self._attempt = int(attempt)
+        self._expect_peers = bool(expect_peers) or attempt == 0
+        self._armed = True
+        self._captured = False
+        self._device_fp = None
+        self._last_digest = None
+
+    def _record_path(self, rank: int, step: int, attempt: int) -> str:
+        return os.path.join(
+            self.dir,
+            f"rank_{rank}.g{self.gen}.step_{step}.a{attempt}.fp")
+
+    def _post(self, digest: Optional[int], norm: Optional[float]) -> None:
+        rec = {"rank": self.rank, "step": self._step,
+               "attempt": self._attempt, "digest": digest,
+               "norm": norm, "node": health.node_id(),
+               "gen": self.gen, "ts": time.time()}
+        os.makedirs(self.dir, exist_ok=True)
+        path = self._record_path(self.rank, self._step, self._attempt)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+        # GC this rank's stale records so the store stays bounded —
+        # amortized to one directory scan every _GC_KEEP_STEPS steps
+        if self._step % _GC_KEEP_STEPS:
+            return
+        for old in glob.glob(os.path.join(
+                self.dir, f"rank_{self.rank}.g*.fp")):
+            base = os.path.basename(old)
+            try:
+                g = int(base.split(".g")[1].split(".")[0])
+                s = int(base.split(".step_")[1].split(".")[0])
+            except (IndexError, ValueError):
+                continue
+            # strictly OLDER generations only: a zombie pre-restart
+            # rank must never delete the respawned incarnation's live
+            # records (it would blind the new gang's gather to this
+            # rank and let a corrupt peer escape the vote)
+            if g < self.gen or (g == self.gen
+                                and s < self._step - _GC_KEEP_STEPS):
+                try:
+                    os.remove(old)
+                except OSError:
+                    pass
+
+    def _gather(self) -> Dict[int, dict]:
+        """Poll the exchange dir until every expected peer has posted a
+        record for this exact (step, attempt), bounded by ``timeout``;
+        late/dead peers are simply absent from the returned map."""
+        want = set(range(self.world))
+        got: Dict[int, dict] = {}
+        wait = self.timeout if self._expect_peers \
+            else min(self.timeout, 1.0)
+        deadline = time.monotonic() + wait
+        while True:
+            for r in sorted(want - set(got)):
+                path = self._record_path(r, self._step, self._attempt)
+                try:
+                    with open(path) as f:
+                        rec = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                if rec.get("step") == self._step \
+                        and rec.get("attempt") == self._attempt:
+                    got[r] = rec
+            if len(got) == len(want) or time.monotonic() >= deadline:
+                return got
+            time.sleep(self.poll_interval)
+
+    def post(self) -> Optional[int]:
+        """Phase 1: read the captured device fingerprint back (the one
+        added host sync), digest it, and publish this rank's record.
+        Returns the digest (None for a skipped step). Split from
+        :meth:`verify` so sequential drivers (the in-process
+        multi-replica sim in ``bench.py --sdc`` and the tests) can
+        publish every replica before any replica votes; live gangs run
+        concurrently and just call :meth:`check`."""
+        if not self.enabled or not self._armed:
+            return None
+        self._armed = False
+        if not self._captured or self._device_fp is None:
+            # the step never reached optimizer.step (AMP skip, pure
+            # eval) — rank-consistent by PR-2's all-reduced found_inf,
+            # so every peer posts the same "skipped" record
+            self.stats["skips"] += 1
+            self._post(None, None)
+            self._last_digest = None
+        else:
+            host_fp = numerics.fingerprint_to_host(self._device_fp)
+            self._device_fp = None
+            self._last_digest = digest_fingerprint(host_fp)
+            self._post(self._last_digest, host_fp[2])
+        self.stats["checks"] += 1
+        return self._last_digest
+
+    def verify(self) -> None:
+        """Phase 2: gather the peers' records for this (step, attempt)
+        and vote. Raises :class:`GradientCorruptionError` on ANY digest
+        disagreement (every rank raises — the rewind must be
+        rank-consistent); the convicted minority's nodes are
+        quarantined with the evidence before the raise."""
+        if not self.enabled:
+            return
+        digest = self._last_digest
+        if self.world < 2:
+            return
+        records = self._gather()
+        digests = {r: rec.get("digest") for r, rec in records.items()
+                   if rec.get("digest") is not None}
+        if digest is None or len(digests) < 2:
+            return                         # nothing comparable
+        if len(set(digests.values())) == 1:
+            return                         # replicas agree bitwise
+        _majority, suspects = vote(digests)
+        self.stats["mismatches"] += 1
+        flight_recorder.record(
+            "sdc.fingerprint_mismatch", step=self._step,
+            attempt=self._attempt, suspects=list(suspects),
+            digests={str(r): d for r, d in sorted(digests.items())})
+        if suspects:
+            self.stats["convictions"] += 1
+            for r in suspects:
+                node = records.get(r, {}).get("node") or f"rank{r}"
+                self.quarantine.quarantine(
+                    node, reason="fingerprint_vote", rank=r,
+                    evidence={
+                        "step": self._step,
+                        "suspect_digest": digests.get(r),
+                        "majority_digest": _majority,
+                        "voters": sorted(digests),
+                    })
+            # one timeline writer: the lowest-ranked healthy voter
+            healthy = [r for r in sorted(digests) if r not in suspects]
+            if healthy and self.rank == healthy[0]:
+                for r in suspects:
+                    node = records.get(r, {}).get("node") or f"rank{r}"
+                    flight_recorder.append_elastic_event(
+                        "quarantine", host=node, rank=r,
+                        reason="fingerprint_vote", step=self._step,
+                        suspect_digest=digests.get(r),
+                        majority_digest=_majority)
+        raise GradientCorruptionError(self._step, suspects, digests)
+
+    def check(self) -> None:
+        """Publish + vote in one call — the live-gang path driven by
+        :class:`~.reliable.ReliableStep` after each step."""
+        was_armed = self._armed
+        self.post()
+        if was_armed:
+            self.verify()
+
+
+__all__ = ["SDCGuard", "GradientCorruptionError", "digest_fingerprint",
+           "vote", "SDC_DIR_ENV"]

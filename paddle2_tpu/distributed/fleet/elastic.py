@@ -56,6 +56,8 @@ class ElasticManager:
         self._atexit_armed = False
         self._last_missing: tuple = ()   # scale-in events fire per
                                          # TRANSITION, not per poll
+        self._last_quarantined: tuple = ()  # same per-transition rule
+                                            # for quarantine evictions
 
     def _path(self, rank: int) -> str:
         return os.path.join(self.store_dir, f"rank_{rank}.hb")
@@ -99,10 +101,13 @@ class ElasticManager:
             # atomic: temp file + os.replace, so a concurrent
             # alive_ranks() reader never sees a partially written JSON
             # (a torn read used to count the rank as dead for a poll)
+            from ..fault_tolerance.health import node_id
             tmp = f"{path}.{os.getpid()}.tmp"
             with open(tmp, "w") as f:
+                # "node": the quarantine identity — lets every peer's
+                # watch() map this rank to the host a verdict names
                 json.dump({"rank": self.rank, "ts": now,
-                           "world": self.world}, f)
+                           "world": self.world, "node": node_id()}, f)
             os.replace(tmp, path)
 
         from ..fault_tolerance.retry import retry_with_backoff
@@ -180,6 +185,24 @@ class ElasticManager:
                 continue
         return out
 
+    def _quarantined(self, entries: List[dict]) -> List[dict]:
+        """Heartbeating ranks whose NODE sits in the quarantine store
+        (``PADDLE_QUARANTINE_DIR``): alive, but no longer welcome. The
+        store is consulted on every poll — cheap (one ``exists`` per
+        distinct node) and it must be, because a fingerprint-vote
+        verdict lands asynchronously to the heartbeat cycle."""
+        from ..fault_tolerance.health import get_store
+        store = get_store()
+        if not store.enabled:
+            return []
+        return [d for d in entries
+                if d.get("node") and store.is_quarantined(d["node"])]
+
+    def quarantined_ranks(self) -> List[int]:
+        """Ranks currently excluded by a quarantine verdict."""
+        return sorted(int(d["rank"])
+                      for d in self._quarantined(self._alive_entries()))
+
     def alive_ranks(self) -> List[int]:
         return sorted(int(d["rank"]) for d in self._alive_entries())
 
@@ -194,6 +217,32 @@ class ElasticManager:
         larger size instead of ignoring the newcomer forever."""
         self.heartbeat()
         entries = self._alive_entries()
+        # quarantine fence: a rank whose node was convicted (failed
+        # probe or fingerprint vote) is dropped from the live set even
+        # while its heartbeat is fresh, forcing a RESTART that re-forms
+        # the gang WITHOUT it. Recorded once per transition, with the
+        # store's evidence, as elastic.quarantine in the timeline.
+        quarantined = self._quarantined(entries)
+        if quarantined:
+            q_ranks = tuple(sorted(int(d["rank"]) for d in quarantined))
+            if q_ranks != self._last_quarantined:
+                self._last_quarantined = q_ranks
+                from ..fault_tolerance import flight_recorder
+                from ..fault_tolerance.health import get_store
+                store = get_store()
+                for d in quarantined:
+                    verdict = store.entry(d["node"]) or {}
+                    flight_recorder.record(
+                        "elastic.quarantine", rank=int(d["rank"]),
+                        host=d["node"],
+                        reason=verdict.get("reason"),
+                        evidence=str(verdict.get("evidence"))[:300])
+                flight_recorder.append_elastic_event(
+                    "quarantine", ranks=list(q_ranks),
+                    hosts=[d["node"] for d in quarantined],
+                    world=self.world)
+            return ElasticStatus.RESTART
+        self._last_quarantined = ()
         alive = sorted(int(d["rank"]) for d in entries)
         if len(alive) == self.world:
             self._last_missing = ()

@@ -66,6 +66,12 @@ def _parse(argv: Optional[List[str]] = None):
     p.add_argument("--rdzv_dead", type=float, default=30.0,
                    help="pod heartbeat timeout before the master sweeps "
                         "it (s)")
+    p.add_argument("--preflight", action="store_true",
+                   help="run the device self-test + loopback echo "
+                        "(fault_tolerance/health.py) BEFORE gang "
+                        "formation; a failing host is written to the "
+                        "quarantine store (PADDLE_QUARANTINE_DIR) and "
+                        "the launcher refuses to start")
     p.add_argument("--preempt_grace", type=float, default=30.0,
                    help="seconds workers get to checkpoint-then-exit "
                         "after the launcher receives SIGTERM (TPU "
@@ -81,6 +87,88 @@ def _parse(argv: Optional[List[str]] = None):
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
+
+
+# a launcher that refuses to run because this host (or every local
+# slot) sits in the quarantine store exits with this code — distinct
+# from worker failures so orchestration can reschedule elsewhere
+QUARANTINED_EXIT_CODE = 113
+
+
+def _node_for_slot(slot: int) -> str:
+    """Quarantine identity of one worker slot: the host, suffixed by
+    the SPAWN slot (stable across rescales — a renumbered rank keeps
+    its original slot id, so a verdict follows the physical position,
+    not the shifting rank). One process per host (the TPU-native
+    default) makes this effectively per-host; several slots on one
+    host get per-chip granularity."""
+    import socket
+    return f"{socket.gethostname()}/s{slot}"
+
+
+def _quarantine_store():
+    """The persistent quarantine store, or None when the operator has
+    not opted in (no PADDLE_QUARANTINE_DIR)."""
+    try:
+        from ..fault_tolerance.health import get_store
+        store = get_store()
+        return store if store.enabled else None
+    except Exception:
+        return None
+
+
+def _filter_quarantined_slots(slots: List[int]) -> Tuple[List[int],
+                                                         List[int]]:
+    """Split ``slots`` into (live, excluded) against the quarantine
+    store: a slot is excluded when its slot identity OR the whole host
+    is quarantined. Consulted on EVERY (re-)formation — the store is
+    how a fingerprint-vote verdict from the previous incarnation
+    reaches the next rendezvous."""
+    store = _quarantine_store()
+    if store is None:
+        return list(slots), []
+    import socket
+    host = socket.gethostname()
+    host_bad = store.is_quarantined(host)
+    live, excluded = [], []
+    for s in slots:
+        if host_bad or store.is_quarantined(_node_for_slot(s)):
+            excluded.append(s)
+        else:
+            live.append(s)
+    return live, excluded
+
+
+def _announce_quarantine(excluded: List[int], generation: int) -> None:
+    store = _quarantine_store()
+    for s in excluded:
+        verdict = (store.entry(_node_for_slot(s)) if store else None) \
+            or {}
+        print(f"[launch] slot {s} ({_node_for_slot(s)}) is QUARANTINED"
+              f" ({verdict.get('reason', 'unknown')}) — excluded from "
+              f"this formation", file=sys.stderr)
+        _elastic_event("quarantine", host=_node_for_slot(s), slot=s,
+                       reason=verdict.get("reason"),
+                       evidence=str(verdict.get("evidence"))[:300],
+                       generation=generation)
+
+
+def _run_preflight() -> bool:
+    """--preflight: device self-test + loopback echo before any gang
+    forms. Returns False (and quarantines this host) on failure."""
+    try:
+        from ..fault_tolerance.health import preflight
+    except Exception as e:
+        print(f"[launch] preflight unavailable: {e}", file=sys.stderr)
+        return True
+    report = preflight()
+    if report.ok:
+        print(f"[launch] preflight ok: {report.probe} digest="
+              f"{report.digest} ({report.device})", file=sys.stderr)
+        return True
+    print(f"[launch] PREFLIGHT FAILED: {report.reason} — host "
+          f"quarantined; refusing to form a gang", file=sys.stderr)
+    return False
 
 
 def _marker_prefix() -> str:
@@ -139,9 +227,11 @@ def _worker_env(args, local_rank: int, generation: int = 0) -> dict:
     return env
 
 
-def _spawn(args, generation: int = 0) -> List[subprocess.Popen]:
+def _spawn(args, generation: int = 0,
+           slots: Optional[List[int]] = None) -> List[subprocess.Popen]:
     procs = []
-    for lr in range(args.nproc_per_node):
+    slots = list(range(args.nproc_per_node)) if slots is None else slots
+    for lr, slot in enumerate(slots):
         cmd = [sys.executable, args.training_script] \
             + args.training_script_args
         stdout = stderr = None
@@ -152,8 +242,12 @@ def _spawn(args, generation: int = 0) -> List[subprocess.Popen]:
             log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
             f = open(log_path, "ab")
             stdout = stderr = f
-        p = subprocess.Popen(cmd, env=_worker_env(args, lr, generation),
-                             stdout=stdout, stderr=stderr)
+        env = _worker_env(args, lr, generation)
+        # quarantine identity: ranks renumber across rescales, the
+        # SPAWN SLOT does not — a fingerprint-vote verdict written by
+        # this worker's peers names a stable physical position
+        env["PADDLE_NODE_ID"] = _node_for_slot(slot)
+        p = subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr)
         p.log_path = log_path
         procs.append(p)
     return procs
@@ -338,29 +432,29 @@ class _PreemptForwarder:
 
 def _watch(procs: List[subprocess.Popen],
            forwarder: Optional[_PreemptForwarder] = None
-           ) -> Tuple[int, int, bool]:
+           ) -> Tuple[int, List[int], bool]:
     """Babysit the local gang: first non-zero exit kills everyone
     (failure-detection parity — a dead rank must not hang the ring).
-    Returns (rc, n_self_failed, preempted): how many workers died on
-    their OWN (not from our teardown) — the scale-in delta for
-    --elastic_rescale — and whether a forwarded SIGTERM (preemption)
-    ended the gang instead."""
+    Returns (rc, failed_local_ranks, preempted): WHICH workers died on
+    their OWN (not from our teardown) — --elastic_rescale retires
+    exactly those workers' slots — and whether a forwarded SIGTERM
+    (preemption) ended the gang instead."""
     from ..fleet.elastic import ELASTIC_EXIT_CODE
     if forwarder is not None:
         forwarder.procs = procs
     while True:
         if forwarder is not None and forwarder.fired.is_set():
             forwarder.drain()
-            return 0, 0, True
+            return 0, [], True
         alive = False
-        failed = 0
+        failed: List[int] = []
         rc_out = 0
-        for p in procs:
+        for i, p in enumerate(procs):
             rc = p.poll()
             if rc is None:
                 alive = True
             elif rc != 0:
-                failed += 1
+                failed.append(i)
                 # a real crash outranks a deliberate scale-event exit
                 # (ELASTIC_EXIT_CODE): simultaneous mixed exits must
                 # consume the restart budget, not bypass it
@@ -377,12 +471,14 @@ def _watch(procs: List[subprocess.Popen],
                     q.kill()
             return rc_out, failed, False
         if not alive:
-            return 0, 0, False
+            return 0, [], False
         time.sleep(0.5)
 
 
 def _spawn_layout(args, layout: dict, me: dict, generation: int,
-                  attempt: int) -> List[subprocess.Popen]:
+                  attempt: int,
+                  slots: Optional[List[int]] = None
+                  ) -> List[subprocess.Popen]:
     """Spawn the local gang for one rendezvous layout: global ranks are
     the master-assigned offset + local rank, world is the layout's.
     ``generation`` bumps on every re-formation (not just failures) —
@@ -392,11 +488,13 @@ def _spawn_layout(args, layout: dict, me: dict, generation: int,
     single-node loop — a deliberate rescale must not read as a
     failure)."""
     procs = []
-    for lr in range(args.nproc_per_node):
+    slots = list(range(args.nproc_per_node)) if slots is None else slots
+    for lr, slot in enumerate(slots):
         # one shared env builder (_worker_env: devices, master, job id),
         # then override the rank/world vars with the MASTER-ASSIGNED
         # layout instead of the static nnodes*nproc derivation
         env = _worker_env(args, lr, generation)
+        env["PADDLE_NODE_ID"] = _node_for_slot(slot)
         rank = me["rank_offset"] + lr
         env.update({
             "PADDLE_TRAINER_ID": str(rank),
@@ -517,8 +615,26 @@ def _elastic_agent(args) -> int:
                 pass
             beat_thread_stop.wait(args.rdzv_beat)
 
+    slots = list(range(args.nproc_per_node))
     try:
         while True:
+            # quarantine fence before EVERY rendezvous join: a pod
+            # whose slots were all convicted leaves the job for good
+            # (the other pods rescale around the hole), a partially
+            # convicted pod re-joins smaller
+            live, excluded = _filter_quarantined_slots(slots)
+            if excluded:
+                _announce_quarantine(excluded, generation)
+                if not live:
+                    print("[launch] every local slot is quarantined — "
+                          "leaving the rendezvous job", file=sys.stderr)
+                    try:
+                        client.leave(node_id)
+                    except Exception:
+                        pass
+                    return QUARANTINED_EXIT_CODE
+                slots = live
+                args.nproc_per_node = len(slots)
             layout = client.join(node_id, host, args.nproc_per_node)
             # settle: let concurrent joins land, then read the final
             # layout all agents will agree on
@@ -543,7 +659,8 @@ def _elastic_agent(args) -> int:
                            node_rank=int(me["node_rank"]),
                            generation=generation, restart=attempt)
             _prune_departed(int(layout["world"]), args.job_id)
-            procs = _spawn_layout(args, layout, me, generation, attempt)
+            procs = _spawn_layout(args, layout, me, generation, attempt,
+                                  slots)
             if t_detect is not None:
                 # the re-formation this span budgets is now COMPLETE:
                 # teardown + rendezvous + settle + prune + spawn
@@ -608,6 +725,8 @@ def _elastic_agent(args) -> int:
 
 def launch(argv: Optional[List[str]] = None) -> int:
     args = _parse(argv)
+    if args.preflight and not _run_preflight():
+        return QUARANTINED_EXIT_CODE
     if args.rdzv_master:
         return _elastic_agent(args)
     attempt = 0
@@ -625,8 +744,39 @@ def _launch_loop(args, forwarder: _PreemptForwarder, attempt: int) -> int:
     # must be fenced just like one from before a crash
     generation = attempt
     t_detect = None
+    # spawn slots: the stable per-position identities behind
+    # PADDLE_NODE_ID; quarantine exclusion and failure scale-in both
+    # shrink this list, never renumber it
+    slots = list(range(args.nproc_per_node))
     while True:
-        procs = _spawn(args, generation)
+        # quarantine fence, consulted on EVERY formation: a slot whose
+        # node was convicted since the last spawn (fingerprint vote,
+        # failed probe) is excluded before the gang re-forms
+        live, excluded = _filter_quarantined_slots(slots)
+        if excluded:
+            _announce_quarantine(excluded, generation)
+            if not live:
+                print("[launch] every local slot is quarantined — "
+                      "refusing to form a gang", file=sys.stderr)
+                return QUARANTINED_EXIT_CODE
+            if args.nnodes > 1:
+                # static multi-node rank/world math cannot absorb a
+                # one-node shrink (same constraint as the failure
+                # rescale below); forming a gang that INCLUDES a
+                # convicted chip would silently poison it instead —
+                # refuse, and point at the elastic agent
+                print("[launch] quarantined slot on a static "
+                      "multi-node launch: cannot rescale without a "
+                      "rendezvous master (--rdzv_master, --rdzv_serve "
+                      "on node 0) — refusing to form a gang with a "
+                      "convicted chip", file=sys.stderr)
+                return QUARANTINED_EXIT_CODE
+            print(f"[launch] quarantine scale-in: world "
+                  f"{len(slots)} -> {len(live)}", file=sys.stderr)
+            slots = live
+            args.nproc_per_node = len(slots)
+            _prune_departed(len(slots), args.job_id)
+        procs = _spawn(args, generation, slots)
         _elastic_event("respawn", generation=generation,
                        world=args.nnodes * args.nproc_per_node,
                        restart=attempt)
@@ -635,7 +785,7 @@ def _launch_loop(args, forwarder: _PreemptForwarder, attempt: int) -> int:
             # teardown, log surfacing, pruning, and the spawn itself
             _mttr_check(args, t_detect, generation)
             t_detect = None
-        rc, n_failed, preempted = _watch(procs, forwarder)
+        rc, failed_idx, preempted = _watch(procs, forwarder)
         t_detect = time.time()
         if preempted:
             print("[launch] preemption: gang checkpointed and exited",
@@ -669,7 +819,8 @@ def _launch_loop(args, forwarder: _PreemptForwarder, attempt: int) -> int:
                   "(--rdzv_serve on node 0) — restarting at full size",
                   file=sys.stderr)
         if args.elastic_rescale and args.nnodes == 1:
-            new_world = max(1, args.nproc_per_node - max(1, n_failed))
+            new_world = max(1, args.nproc_per_node
+                            - max(1, len(failed_idx)))
             if new_world != args.nproc_per_node:
                 print(f"[launch] scale-in: world "
                       f"{args.nproc_per_node} -> {new_world}",
@@ -679,6 +830,14 @@ def _launch_loop(args, forwarder: _PreemptForwarder, attempt: int) -> int:
                                world_to=new_world, rc=rc,
                                generation=generation)
                 args.nproc_per_node = new_world
+                # retire the FAILED workers' slots — the verdict (and
+                # any later quarantine) follows the physical position,
+                # so the marginal chip's slot must be the one dropped,
+                # never a healthy tail slot
+                keep = [s for i, s in enumerate(slots)
+                        if i not in set(failed_idx)]
+                slots = (keep + [s for s in slots
+                                 if s not in keep])[:new_world]
                 _prune_departed(new_world, args.job_id)
         os.environ["PADDLE_ELASTIC_RESTART_COUNT"] = str(attempt)
         print(f"[launch] worker failed (rc={rc}); elastic restart "

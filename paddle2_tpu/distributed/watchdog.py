@@ -198,6 +198,15 @@ class CommWatchdog:
 
     @classmethod
     def get(cls) -> "CommWatchdog":
+        # the watchdog owns the low-frequency device self-test timer
+        # (FLAGS_health_probe_interval_s): get() is on every guarded
+        # step's path, so the prober lazily (re)starts here — one flag
+        # read when the probe is off
+        try:
+            from .fault_tolerance.health import HealthProber
+            HealthProber.ensure()
+        except Exception:
+            pass
         with cls._lock:
             if cls._instance is None:
                 cls._instance = CommWatchdog()

@@ -238,6 +238,15 @@ class Optimizer:
                         if isinstance(a, Tensor)
                         else jnp.asarray(a) if isinstance(a, np.ndarray) else a,
                         state_dict[key])
+                else:
+                    # the snapshot predates this param's lazily-created
+                    # state (e.g. taken before the first step): restore
+                    # means UNINITIALIZED, not "keep whatever moments
+                    # accumulated since" — stale moments make a
+                    # rolled-back Adam step diverge bitwise from the
+                    # original, which the SDC fingerprint vote would
+                    # then misread as corruption
+                    self._states.pop(id(p), None)
                 idx += 1
 
     def _parameter_list(self):

@@ -39,6 +39,7 @@ import argparse
 import json
 import os
 import sys
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 DESYNC_EXIT = 3
@@ -104,6 +105,25 @@ def load_gossip(directory: Optional[str]) -> Dict[int, float]:
     return times
 
 
+def load_quarantine(directory: Optional[str]) -> List[Dict[str, Any]]:
+    """Verdicts in the persistent quarantine store (``q_<node>.json``
+    under ``PADDLE_QUARANTINE_DIR``), oldest first; empty if absent.
+    Read directly (stdlib-only) so the doctor never imports the
+    package it is diagnosing."""
+    out: List[Dict[str, Any]] = []
+    if not directory or not os.path.isdir(directory):
+        return out
+    for name in sorted(os.listdir(directory)):
+        if not (name.startswith("q_") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(directory, name)) as f:
+                out.append(json.load(f))
+        except (OSError, ValueError):
+            continue
+    return sorted(out, key=lambda r: r.get("ts", 0))
+
+
 def load_elastic_events(directory: Optional[str]) -> List[Dict[str, Any]]:
     """The launcher's ``elastic.*`` event stream
     (``elastic_events.jsonl``: rendezvous outcomes, scale events,
@@ -153,7 +173,8 @@ def _rank_list(ranks) -> str:
 
 def diagnose(dumps: Dict[int, Dict[str, Any]],
              gossip: Optional[Dict[int, float]] = None,
-             elastic: Optional[List[Dict[str, Any]]] = None
+             elastic: Optional[List[Dict[str, Any]]] = None,
+             quarantine: Optional[List[Dict[str, Any]]] = None
              ) -> Dict[str, Any]:
     """Merge per-rank dumps into a structured diagnosis (the JSON the
     CLI prints with ``--json``; the text report renders the same dict).
@@ -177,7 +198,27 @@ def diagnose(dumps: Dict[int, Dict[str, Any]],
         "guilty": [],
         "straggler": {},
         "elastic_events": list(elastic or [])[-20:],
+        "quarantine": list(quarantine or []),
+        "nodes": {r: dumps[r]["header"].get("node") for r in ranks},
+        "sdc": [],
     }
+    # SDC evidence: fingerprint-vote mismatches and self-evictions the
+    # workers recorded. Deduped by (rank, step) — every voter records
+    # the same verdict; the report wants the verdict once per witness.
+    for r in ranks:
+        for ev in dumps[r]["events"]:
+            kind = ev.get("kind")
+            if kind == "sdc.fingerprint_mismatch":
+                report["sdc"].append({
+                    "witness": r, "step": ev.get("step"),
+                    "attempt": ev.get("attempt"),
+                    "suspects": ev.get("suspects"),
+                    "digests": ev.get("digests")})
+            elif kind in ("sdc.evict", "elastic.quarantine"):
+                report["sdc"].append({
+                    "witness": r, "kind": kind,
+                    "step": ev.get("step"), "host": ev.get("host"),
+                    "reason": ev.get("reason")})
     world = report["world"] or (max(ranks) + 1 if ranks else 0)
     report["missing_dumps"] = [r for r in range(world) if r not in dumps]
     # restart-generation fence for the ANALYSIS itself: a surviving dump
@@ -419,8 +460,48 @@ def format_report(report: Dict[str, Any], directory: str) -> str:
                          f"{_rank_list(g['suspects'])} "
                          f"(step time > {_STRAGGLER_K:g} x median)")
 
+    L.extend(_format_quarantine(report))
     L.extend(_format_elastic_timeline(report))
     return "\n".join(L)
+
+
+def _format_quarantine(report: Dict[str, Any]) -> List[str]:
+    """QUARANTINE section: the persistent store's verdicts plus the
+    workers' sdc.* evidence (fingerprint-vote mismatches, evictions) —
+    the silent-data-corruption half of the post-mortem."""
+    verdicts = report.get("quarantine") or []
+    sdc = report.get("sdc") or []
+    if not verdicts and not sdc:
+        return []
+    L = ["QUARANTINE"]
+    for v in verdicts:
+        age = ""
+        if v.get("ts"):
+            age = f" ({time.time() - v['ts']:.0f}s ago)"
+        ev = v.get("evidence") or {}
+        detail = " ".join(f"{k}={ev[k]}" for k in sorted(ev)
+                          if isinstance(ev[k], (str, int, float)))
+        L.append(f"  node {v.get('host')}: {v.get('reason')}"
+                 f"{' rank ' + str(v['rank']) if v.get('rank') is not None else ''}"
+                 f"{age}{' — ' + detail if detail else ''}")
+    seen = set()
+    for e in sdc:
+        key = (e.get("kind"), e.get("step"), str(e.get("suspects")),
+               e.get("host"))
+        if key in seen:
+            continue
+        seen.add(key)
+        if e.get("kind") in ("sdc.evict", "elastic.quarantine"):
+            L.append(f"  rank {e['witness']} recorded {e['kind']}: "
+                     f"host {e.get('host')} ({e.get('reason')})")
+        else:
+            L.append(f"  fingerprint mismatch at step {e.get('step')}"
+                     f" (witness rank {e['witness']}): suspect rank(s) "
+                     f"{e.get('suspects')} digests {e.get('digests')}")
+    if verdicts:
+        L.append("  a quarantined node is excluded from every "
+                 "re-formation until its q_<node>.json is removed")
+    return L
 
 
 # ---------------------------------------------------------------- CLI
@@ -438,6 +519,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    default=os.environ.get("PADDLE_STEP_GOSSIP_DIR"),
                    help="step-time gossip dir for straggler attribution "
                         "(default: $PADDLE_STEP_GOSSIP_DIR)")
+    p.add_argument("--quarantine-dir",
+                   default=os.environ.get("PADDLE_QUARANTINE_DIR"),
+                   help="persistent node-quarantine store for the "
+                        "QUARANTINE section "
+                        "(default: $PADDLE_QUARANTINE_DIR)")
     p.add_argument("--json", action="store_true",
                    help="emit the structured diagnosis as JSON")
     args = p.parse_args(argv)
@@ -449,7 +535,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     dumps = load_dumps(args.flight_dir)
     report = diagnose(dumps, load_gossip(args.gossip_dir),
-                      load_elastic_events(args.flight_dir))
+                      load_elastic_events(args.flight_dir),
+                      load_quarantine(args.quarantine_dir))
     if args.json:
         print(json.dumps(report, indent=2, default=str))
     else:

@@ -173,6 +173,7 @@ def test_scale_in_event_marks_deliberate_departure(tmp_path):
     assert events[-1][1]["missing"] == [1]
 
 
+@pytest.mark.gang
 def test_launcher_restarts_failed_worker(tmp_path):
     """--max_restarts relaunches the gang after a worker failure
     (manager.py restart loop / ELASTIC_EXIT_CODE semantics)."""
@@ -197,6 +198,7 @@ sys.exit(1 if n == 0 else 0)   # fail on the first attempt only
     assert marker.read_text() == "2"   # first attempt failed, retry passed
 
 
+@pytest.mark.gang
 def test_elastic_rescale_resumes_from_checkpoint(tmp_path):
     """Round-3 verdict item 7 e2e: kill 1 of 2 workers -> launcher
     relaunches at the surviving world size -> training resumes from the
@@ -274,6 +276,7 @@ if rank == 0:
     assert res["losses"][-1] < res["losses"][0]
 
 
+@pytest.mark.gang
 def test_elastic_exit_code_restart_does_not_consume_budget(tmp_path):
     """rc=101 (ELASTIC_EXIT_CODE) marks a deliberate scale event: the
     launcher restarts even with max_restarts=0."""
@@ -299,6 +302,7 @@ sys.exit(ELASTIC_EXIT_CODE if n == 0 else 0)
     assert marker.read_text() == "2"
 
 
+@pytest.mark.gang
 def test_launcher_surfaces_failed_worker_log(tmp_path):
     """watcher.py parity: the failing worker's log tail appears in the
     launcher's stderr."""
@@ -321,6 +325,7 @@ sys.exit(3)
     assert "log tail" in proc.stderr
 
 
+@pytest.mark.gang
 def test_launcher_surfaces_signal_killed_worker_log(tmp_path):
     """A worker killed by an external signal (SIGSEGV/OOM SIGKILL —
     negative returncode) is the hard-crash class the feature exists for;

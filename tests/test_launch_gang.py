@@ -22,6 +22,11 @@ from paddle2_tpu.distributed.launch.main import launch
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# every test here spawns launcher-managed worker processes: the `gang`
+# marker selects the multiprocess suite (`pytest -m gang`); the heavy
+# drills are additionally `slow` so tier-1 (-m "not slow") stays fast
+pytestmark = pytest.mark.gang
+
 
 @pytest.fixture(autouse=True)
 def _env_guard(monkeypatch):

@@ -15,7 +15,9 @@ import textwrap
 
 import pytest
 
-pytestmark = pytest.mark.slow  # full models / spawned processes
+# full models / spawned processes; `gang` selects the multiprocess
+# suite (pytest -m gang) alongside the launcher drills
+pytestmark = [pytest.mark.slow, pytest.mark.gang]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

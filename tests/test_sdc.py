@@ -1,0 +1,1058 @@
+"""Silent-data-corruption defense: gradient fingerprints, majority
+vote, device health probes, and quarantine-driven re-formation.
+
+Covers the full detect -> diagnose -> evict -> recover loop:
+
+* device-side fingerprint determinism + single-bit sensitivity;
+* the chaos ``flip_bits`` fault (parser, victim gating, mantissa-only);
+* the cross-replica vote (majority convicts, 2-replica tie detects
+  without convicting, dead peers can't wedge the gather);
+* detect-within-1-step + rewind/replay + quarantine in a 3-replica
+  lockstep sim, and through the real ReliableStep wiring with two
+  concurrent replica threads;
+* health probes: fixed-seed self-test vs golden, loopback echo,
+  preflight-quarantines-this-node, the watchdog's periodic prober;
+* elastic re-formation with a quarantined host (manager-level and
+  launcher-level: exclusion, generation bump, ``elastic.quarantine``
+  timeline evidence);
+* the flight doctor's QUARANTINE section;
+* rank-salted retry jitter (satellite).
+
+The slow+gang drill at the bottom runs the whole loop through real
+launcher-spawned worker processes.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.nn as nn
+import paddle2_tpu.nn.functional as F
+import paddle2_tpu.optimizer as opt
+from paddle2_tpu.distributed.fault_tolerance import (
+    GradientCorruptionError, ReliableStep, SDCGuard, TransientStepError,
+    chaos, flight_recorder, health, numerics, sdc)
+from paddle2_tpu.distributed.fault_tolerance.replica import tree_to_host
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    chaos.disarm()
+    yield
+    chaos.disarm()
+
+
+def _mlp(h_in=16, h_mid=32, optimizer=opt.SGD, **opt_kw):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(h_in, h_mid), nn.ReLU(),
+                      nn.Linear(h_mid, h_in))
+    opt_kw.setdefault("learning_rate", 0.01)
+    o = optimizer(parameters=m.parameters(), **opt_kw)
+    return m, o
+
+
+def _step_fn(m, o):
+    def step(x, y):
+        loss = F.mse_loss(m(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+    return step
+
+
+def _batches(n=8, b=8, d=16, seed=0):
+    rs = np.random.RandomState(seed)
+    return [(paddle.to_tensor(rs.randn(b, d).astype(np.float32)),
+             paddle.to_tensor(rs.randn(b, d).astype(np.float32)))
+            for _ in range(n)]
+
+
+# ===================================================== fingerprints
+class TestFingerprint:
+    def test_deterministic_and_bit_sensitive(self):
+        import jax.numpy as jnp
+        g = [jnp.asarray(np.random.RandomState(0)
+                         .randn(32, 8).astype(np.float32)),
+             jnp.asarray(np.random.RandomState(1)
+                         .randn(8).astype(np.float32))]
+        h1 = numerics.fingerprint_to_host(numerics.tree_fingerprint(g))
+        h2 = numerics.fingerprint_to_host(numerics.tree_fingerprint(g))
+        assert h1 == h2
+        d1 = sdc.digest_fingerprint(h1)
+        assert d1 == sdc.digest_fingerprint(h2)
+        # ONE flipped mantissa bit anywhere changes the digest
+        flipped = [chaos.flip_mantissa_bits(g[0], 1), g[1]]
+        h3 = numerics.fingerprint_to_host(
+            numerics.tree_fingerprint(flipped))
+        assert sdc.digest_fingerprint(h3) != d1
+
+    def test_one_host_sync_per_readback(self):
+        import jax.numpy as jnp
+        g = [jnp.ones((64,), jnp.float32)]
+        fp = numerics.tree_fingerprint(g)
+        s0 = numerics.host_sync_count()
+        numerics.fingerprint_to_host(fp)
+        assert numerics.host_sync_count() - s0 == 1
+
+    def test_no_float_leaves_is_none(self):
+        import jax.numpy as jnp
+        assert numerics.tree_fingerprint(
+            [jnp.ones((4,), jnp.int32)]) is None
+        assert numerics.fingerprint_to_host(None) is None
+
+    def test_norm_survives_packing(self):
+        import jax.numpy as jnp
+        g = [jnp.full((16,), 2.0, jnp.float32)]
+        _s, _x, norm = numerics.fingerprint_to_host(
+            numerics.tree_fingerprint(g))
+        assert norm == pytest.approx(64.0)
+
+
+class TestVote:
+    def test_majority_convicts_minority(self):
+        maj, sus = sdc.vote({0: 7, 1: 9, 2: 7, 3: 7})
+        assert maj == 7 and sus == [1]
+
+    def test_unanimous(self):
+        maj, sus = sdc.vote({0: 5, 1: 5})
+        assert maj == 5 and sus == []
+
+    def test_two_way_tie_detects_without_conviction(self):
+        maj, sus = sdc.vote({0: 1, 1: 2})
+        assert maj is None and sus == []
+
+    def test_multi_minority(self):
+        maj, sus = sdc.vote({0: 1, 1: 1, 2: 1, 3: 2, 4: 3})
+        assert maj == 1 and sus == [3, 4]
+
+    def test_empty(self):
+        assert sdc.vote({}) == (None, [])
+
+
+# ===================================================== chaos flip_bits
+class TestChaosFlipBits:
+    def test_kind_registered(self):
+        assert "flip_bits" in chaos.KINDS
+
+    def test_spec_parses_where_bits_rank_nth(self):
+        inj = chaos.arm("flip_bits:grads:3:1:2")
+        assert inj.flip == {"where": "grads", "bits": 3, "rank": 1,
+                            "nth": 2}
+        inj = chaos.arm("flip_bits")
+        assert inj.flip == {"where": "grads", "bits": 1, "rank": 0,
+                            "nth": 1}
+
+    def test_bad_where_raises(self):
+        with pytest.raises(ValueError):
+            chaos.arm("flip_bits:heap:1")
+
+    def test_flip_preserves_shape_dtype_and_stays_finite(self):
+        arr = np.random.RandomState(0).randn(64).astype(np.float32)
+        out = chaos.flip_mantissa_bits(arr, 4)
+        assert out.shape == arr.shape and out.dtype == arr.dtype
+        assert not np.array_equal(out, arr)
+        # mantissa-only flips can never create a NaN/Inf — the whole
+        # point of the SDC simulation is that nothing announces itself
+        assert np.isfinite(out).all()
+        assert (np.asarray(out) != arr).sum() <= 4
+
+    def test_flip_lands_in_bf16_native_word(self):
+        """Regression: a flip must survive the array's own precision —
+        an upcast-flip-downcast would round low f32 bits away and
+        inject nothing on half-precision gradients."""
+        import jax.numpy as jnp
+        for dt in (jnp.bfloat16, jnp.float16):
+            arr = jnp.asarray(np.random.RandomState(0).randn(64),
+                              jnp.float32).astype(dt)
+            for seed in range(4):
+                out = chaos.flip_mantissa_bits(arr, 1, seed=seed)
+                assert out.dtype == arr.dtype
+                assert not np.array_equal(
+                    np.asarray(out.astype(jnp.float32)),
+                    np.asarray(arr.astype(jnp.float32))), (dt, seed)
+
+    def test_nonfloat_payload_does_not_consume_the_fire(self):
+        """Regression: an int/bool collective passing through the hook
+        must not burn the one-shot occurrence counter."""
+        import jax.numpy as jnp
+        inj = chaos.arm("flip_bits:collective:1:0")
+        ints = jnp.ones((4,), jnp.int32)
+        assert chaos.maybe_flip_bits_array("collective", ints) is ints
+        assert inj.counts["flip_bits"] == 0   # fire still pending
+        floats = jnp.ones((4,), jnp.float32)
+        out = chaos.maybe_flip_bits_array("collective", floats)
+        assert not np.array_equal(np.asarray(out), np.asarray(floats))
+
+    def test_grads_hook_fires_only_on_victim(self, monkeypatch):
+        m, o = _mlp()
+        step = _step_fn(m, o)
+        x, y = _batches(1)[0]
+        loss = F.mse_loss(m(x), y)
+        loss.backward()
+        inj = chaos.arm("flip_bits:grads:2:1")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        chaos.maybe_flip_bits_grads(o)       # wrong rank: no tick
+        assert inj.counts["flip_bits"] == 0
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        before = [np.asarray(p.grad._data).copy()
+                  for p in o._parameter_list() if p.grad is not None]
+        chaos.maybe_flip_bits_grads(o)
+        after = [np.asarray(p.grad._data)
+                 for p in o._parameter_list() if p.grad is not None]
+        changed = sum(not np.array_equal(b, a)
+                      for b, a in zip(before, after))
+        assert changed == 1
+        assert inj.fired[0][0] == "flip_bits"
+        # fires exactly once
+        chaos.maybe_flip_bits_grads(o)
+        assert len(inj.fired) == 1
+
+    def test_rank_major_array_flip_hits_victim_row_only(self,
+                                                        monkeypatch):
+        import jax.numpy as jnp
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        chaos.arm("flip_bits:collective:2:1")
+        arr = jnp.asarray(np.random.RandomState(0)
+                          .randn(4, 8).astype(np.float32))
+        out = chaos.maybe_flip_bits_array("collective", arr,
+                                          rank_axis=True)
+        out = np.asarray(out)
+        ref = np.asarray(arr)
+        assert np.array_equal(out[0], ref[0])
+        assert np.array_equal(out[2], ref[2])
+        assert not np.array_equal(out[1], ref[1])
+
+    def test_disarmed_hooks_are_noops(self):
+        m, o = _mlp()
+        chaos.maybe_flip_bits_grads(o)        # no injector: no-op
+        import jax.numpy as jnp
+        a = jnp.ones((4,))
+        assert chaos.maybe_flip_bits_array("collective", a) is a
+
+
+# ===================================================== quarantine store
+class TestQuarantineStore:
+    def test_roundtrip(self, tmp_path):
+        st = health.QuarantineStore(str(tmp_path))
+        assert st.enabled
+        assert not st.is_quarantined("node-a")
+        path = st.quarantine("node-a", "fingerprint_vote",
+                             {"step": 3}, rank=1)
+        assert path and os.path.exists(path)
+        assert st.is_quarantined("node-a")
+        e = st.entry("node-a")
+        assert e["reason"] == "fingerprint_vote" and e["rank"] == 1
+        assert e["evidence"] == {"step": 3}
+        assert [x["host"] for x in st.entries()] == ["node-a"]
+        assert st.release("node-a")
+        assert not st.is_quarantined("node-a")
+
+    def test_disabled_store_noops(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_QUARANTINE_DIR", raising=False)
+        st = health.QuarantineStore()
+        assert not st.enabled
+        assert st.quarantine("x", "r") is None
+        assert not st.is_quarantined("x")
+        assert st.entries() == []
+
+    def test_hostile_hostnames_sanitized(self, tmp_path):
+        st = health.QuarantineStore(str(tmp_path))
+        st.quarantine("tpu-pod/slot:3", "probe")
+        assert st.is_quarantined("tpu-pod/slot:3")
+        assert all(os.sep not in n[2:]
+                   for n in os.listdir(str(tmp_path)))
+
+
+# ===================================================== health probes
+class TestHealth:
+    def test_selftest_ok_and_golden_recorded(self, tmp_path):
+        st = health.QuarantineStore(str(tmp_path))
+        r1 = health.device_selftest(st)
+        assert r1.ok and r1.digest is not None
+        assert any(n.startswith("golden_")
+                   for n in os.listdir(str(tmp_path)))
+        r2 = health.device_selftest(st)
+        assert r2.ok and r2.digest == r1.digest
+
+    def test_golden_mismatch_fails(self, tmp_path):
+        st = health.QuarantineStore(str(tmp_path))
+        health.device_selftest(st)
+        gp = [n for n in os.listdir(str(tmp_path))
+              if n.startswith("golden_")][0]
+        rec = json.load(open(tmp_path / gp))
+        rec["digest"] ^= 1
+        json.dump(rec, open(tmp_path / gp, "w"))
+        r = health.device_selftest(st)
+        assert not r.ok and "golden mismatch" in r.reason
+
+    def test_selftest_without_store_uses_repeat_agreement(self,
+                                                          monkeypatch):
+        monkeypatch.delenv("PADDLE_QUARANTINE_DIR", raising=False)
+        assert health.device_selftest().ok
+
+    def test_loopback_echo(self):
+        assert health.loopback_echo().ok
+
+    def test_preflight_failure_quarantines_with_evidence(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_NODE_ID", "probe-victim")
+        monkeypatch.setenv("PADDLE_FLIGHT_DIR", str(tmp_path / "fl"))
+        st = health.QuarantineStore(str(tmp_path))
+        health.device_selftest(st)            # records golden
+        gp = [n for n in os.listdir(str(tmp_path))
+              if n.startswith("golden_")][0]
+        rec = json.load(open(tmp_path / gp))
+        rec["digest"] ^= 1
+        json.dump(rec, open(tmp_path / gp, "w"))
+        report = health.preflight(st)
+        assert not report.ok
+        assert st.is_quarantined("probe-victim")
+        e = st.entry("probe-victim")
+        assert e["reason"].startswith("preflight")
+        assert "golden mismatch" in e["evidence"]["reason"]
+        # elastic timeline carries the verdict
+        events = [json.loads(ln) for ln in
+                  open(tmp_path / "fl" / "elastic_events.jsonl")]
+        assert any(ev["kind"] == "elastic.quarantine"
+                   and ev["host"] == "probe-victim" for ev in events)
+        # an already-quarantined node short-circuits (no re-probe-in)
+        again = health.preflight(st)
+        assert not again.ok and again.probe == "quarantined"
+
+    def test_preflight_ok(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_NODE_ID", "healthy-node")
+        st = health.QuarantineStore(str(tmp_path))
+        assert health.preflight(st).ok
+        assert not st.is_quarantined("healthy-node")
+
+    def test_prober_failure_quarantines(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_NODE_ID", "flaky-node")
+        st = health.QuarantineStore(str(tmp_path))
+        prober = health.HealthProber(1000.0, store=st)
+        monkeypatch.setattr(
+            health, "device_selftest",
+            lambda *a, **k: health.HealthReport(
+                False, reason="nondeterministic compute"))
+        r = prober.probe_once()
+        assert not r.ok
+        assert prober.failures == 1
+        assert st.is_quarantined("flaky-node")
+
+    def test_prober_ensure_is_flag_gated(self):
+        paddle.set_flags({"FLAGS_health_probe_interval_s": 0.0})
+        before = health.HealthProber._instance
+        health.HealthProber.ensure()
+        assert health.HealthProber._instance is before
+
+
+# ===================================================== guard protocol
+class TestSDCGuardSim:
+    """3 replicas driven in lockstep over a shared exchange dir — the
+    in-process form of the gang drill (phase-split post/verify)."""
+
+    def _replicas(self, tmp_path, n=3, timeout=1.0):
+        out = []
+        for r in range(n):
+            m, o = _mlp()
+            g = SDCGuard(o, store_dir=str(tmp_path / "ex"), rank=r,
+                         world=n, timeout=timeout, evict=False,
+                         quarantine=health.QuarantineStore(
+                             str(tmp_path / "q")))
+            out.append((m, o, _step_fn(m, o), g))
+        return out
+
+    def test_detect_within_one_step_retry_and_quarantine(
+            self, tmp_path, monkeypatch):
+        reps = self._replicas(tmp_path)
+        batches = _batches(6)
+        fr = flight_recorder.enable(str(tmp_path / "fl"), rank=0,
+                                    install_hooks=False)
+        detected = []
+        try:
+            for s in range(4):
+                if s == 2:
+                    chaos.arm("flip_bits:grads:2:1")
+                x, y = batches[s]
+                snaps = [(tree_to_host(m.state_dict()),
+                          tree_to_host(o.state_dict()))
+                         for m, o, st, g in reps]
+                for r, (m, o, st, g) in enumerate(reps):
+                    monkeypatch.setenv("PADDLE_TRAINER_ID", str(r))
+                    monkeypatch.setenv("PADDLE_NODE_ID", f"node-{r}")
+                    g.begin(s)
+                    st(x, y)
+                    g.post()
+                raised = []
+                for m, o, st, g in reps:
+                    try:
+                        g.verify()
+                    except GradientCorruptionError as e:
+                        raised.append(e)
+                if raised:
+                    detected.append(s)
+                    # EVERY replica raises (rank-consistent rewind) and
+                    # the vote convicts exactly the victim
+                    assert len(raised) == 3
+                    assert all(e.suspects == [1] for e in raised)
+                    for (m, o, st, g), (ms, osn) in zip(reps, snaps):
+                        m.set_state_dict(ms)
+                        o.set_state_dict(osn)
+                    for r, (m, o, st, g) in enumerate(reps):
+                        monkeypatch.setenv("PADDLE_TRAINER_ID", str(r))
+                        monkeypatch.setenv("PADDLE_NODE_ID",
+                                           f"node-{r}")
+                        g.begin(s, attempt=1)
+                        st(x, y)
+                        g.post()
+                    for m, o, st, g in reps:
+                        g.verify()            # replay must be clean
+        finally:
+            flight_recorder.disable()
+        # detected AT the injected step, exactly once
+        assert detected == [2]
+        # the victim's node carries the fingerprint-vote verdict
+        st = health.QuarantineStore(str(tmp_path / "q"))
+        e = st.entry("node-1")
+        assert e is not None and e["reason"] == "fingerprint_vote"
+        assert e["rank"] == 1
+        assert e["evidence"]["step"] == 2
+        assert e["evidence"]["suspect_digest"] \
+            != e["evidence"]["majority_digest"]
+        # replicas end bitwise identical
+        ws = [np.asarray(m.state_dict()["0.weight"]._data)
+              for m, o, st2, g in reps]
+        assert np.array_equal(ws[0], ws[1])
+        assert np.array_equal(ws[0], ws[2])
+        # flight evidence
+        kinds = [ev[2] for ev in fr.events()]
+        assert "sdc.fingerprint_mismatch" in kinds
+
+    def test_two_replica_mismatch_detects_without_conviction(
+            self, tmp_path):
+        reps = self._replicas(tmp_path, n=2)
+        x, y = _batches(1)[0]
+        for r, (m, o, st, g) in enumerate(reps):
+            g.begin(0)
+            st(x, y)
+            if r == 1:    # corrupt AFTER capture-by-step: flip by hand
+                pass
+            g.post()
+        for m, o, st, g in reps:
+            g.verify()                        # clean: no raise
+        # now a corrupt second step
+        x, y = _batches(2)[1]
+        for r, (m, o, st, g) in enumerate(reps):
+            g.begin(1)
+            if r == 1:
+                loss = F.mse_loss(m(x), y)
+                loss.backward()
+                p = next(p for p in o._parameter_list()
+                         if p.grad is not None)
+                p.grad._replace_data(
+                    chaos.flip_mantissa_bits(p.grad._data, 1))
+                o.step()
+                o.clear_grad()
+            else:
+                st(x, y)
+            g.post()
+        raised = []
+        for m, o, st, g in reps:
+            with pytest.raises(GradientCorruptionError) as ei:
+                g.verify()
+            raised.append(ei.value)
+        # two witnesses, no majority: retryable but nobody convicted
+        assert all(e.suspects == [] for e in raised)
+        st2 = health.QuarantineStore(str(tmp_path / "q"))
+        assert st2.entries() == []
+
+    def test_missing_peer_cannot_wedge_the_vote(self, tmp_path):
+        # world says 3, but replica 2 is dead: the gather times out and
+        # the two present replicas still agree -> no raise
+        reps = self._replicas(tmp_path, n=3, timeout=0.3)[:2]
+        x, y = _batches(1)[0]
+        t0 = time.monotonic()
+        for m, o, st, g in reps:
+            g.begin(0)
+            st(x, y)
+            g.post()
+        for m, o, st, g in reps:
+            g.verify()
+        assert time.monotonic() - t0 < 5.0
+
+    def test_skipped_step_posts_and_passes(self, tmp_path):
+        reps = self._replicas(tmp_path, n=2, timeout=0.3)
+        for m, o, st, g in reps:
+            g.begin(0)
+            # optimizer.step never runs (AMP skip analog)
+            g.post()
+        for m, o, st, g in reps:
+            g.verify()
+        assert all(g.stats["skips"] == 1 for m, o, st, g in reps)
+
+    def test_quarantined_node_self_evicts_at_step_boundary(
+            self, tmp_path, monkeypatch):
+        from paddle2_tpu.distributed.fleet.elastic import \
+            ELASTIC_EXIT_CODE
+        monkeypatch.setenv("PADDLE_NODE_ID", "evict-me")
+        store = health.QuarantineStore(str(tmp_path / "q"))
+        m, o = _mlp()
+        g = SDCGuard(o, store_dir=str(tmp_path / "ex"), rank=0,
+                     world=1, quarantine=store, evict=True)
+        g.begin(0)                            # healthy: no exit
+        store.quarantine("evict-me", "fingerprint_vote")
+        with pytest.raises(SystemExit) as ei:
+            g.begin(1)
+        assert ei.value.code == ELASTIC_EXIT_CODE
+
+    def test_disabled_guard_is_free(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_SDC_DIR", raising=False)
+        m, o = _mlp()
+        g = SDCGuard(o, rank=0, world=4)
+        assert not g.enabled
+        g.begin(0)
+        _step_fn(m, o)(*_batches(1)[0])
+        g.check()                             # all no-ops
+        assert g.stats["checks"] == 0
+
+
+# ============================================ ReliableStep wiring
+class TestReliableStepSDC:
+    def test_error_is_transient(self):
+        assert issubclass(GradientCorruptionError, TransientStepError)
+
+    def test_world1_clean_run_counts_checks(self, tmp_path):
+        m, o = _mlp()
+        g = SDCGuard(o, store_dir=str(tmp_path), rank=0, world=1,
+                     evict=False)
+        rel = ReliableStep(m, o, snapshot_every=1, sdc_guard=g)
+        step = _step_fn(m, o)
+        for x, y in _batches(3):
+            rel.run(step, x, y)
+        rel.finalize()
+        assert g.stats["checks"] == 3
+        assert g.stats["mismatches"] == 0
+        assert rel.stats["retries"] == 0
+
+    def test_two_concurrent_replicas_retry_through_reliable_step(
+            self, tmp_path):
+        """The REAL wiring: two replica threads, each in its own
+        ReliableStep(sdc_guard=...); replica 1 computes corrupt grads
+        at step 2; both replicas' votes fail, both rewind via the
+        TransientStepError path, the replay is clean, and the replicas
+        end bitwise identical — one injected flip costs one retry,
+        never the run."""
+        n_steps = 4
+        batches = _batches(n_steps)
+        results = {}
+        # models built on the MAIN thread: paddle.seed + tracing are
+        # not thread-safe, and a real gang builds per-process anyway
+        built = [_mlp() for _ in range(2)]
+
+        def run_replica(r):
+            m, o = built[r]
+            g = SDCGuard(o, store_dir=str(tmp_path), rank=r, world=2,
+                         timeout=20.0, poll_interval=0.005,
+                         evict=False)
+            rel = ReliableStep(m, o, snapshot_every=1, sdc_guard=g)
+            fired = {"done": False}
+
+            def step(x, y):
+                loss = F.mse_loss(m(x), y)
+                loss.backward()
+                if r == 1 and rel._step == 2 and not fired["done"]:
+                    fired["done"] = True
+                    p = next(p for p in o._parameter_list()
+                             if p.grad is not None)
+                    p.grad._replace_data(
+                        chaos.flip_mantissa_bits(p.grad._data, 2))
+                o.step()
+                o.clear_grad()
+                return loss
+
+            for x, y in batches:
+                rel.run(step, x, y)
+            rel.finalize()
+            results[r] = {
+                "retries": rel.stats["retries"],
+                "mismatches": g.stats["mismatches"],
+                "weight": np.asarray(
+                    m.state_dict()["0.weight"]._data).copy(),
+            }
+
+        threads = [threading.Thread(target=run_replica, args=(r,))
+                   for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert set(results) == {0, 1}
+        for r in (0, 1):
+            assert results[r]["retries"] == 1, results
+            assert results[r]["mismatches"] == 1, results
+        assert np.array_equal(results[0]["weight"],
+                              results[1]["weight"])
+
+
+    def test_deferred_replay_keys_exchange_by_replayed_step(
+            self, tmp_path):
+        """Regression: a DEFERRED failure (detected when the next step
+        settles the previous one) must post its replay fingerprints
+        under the REPLAYED step's key — keying them on the advanced
+        step counter would let a later retry of the next step gather
+        stale records and convict an innocent rank."""
+        m, o = _mlp()
+        g = SDCGuard(o, store_dir=str(tmp_path), rank=0, world=1,
+                     evict=False)
+        rel = ReliableStep(m, o, snapshot_every=1, sdc_guard=g)
+        step = _step_fn(m, o)
+        chaos.arm("poison_loss:2")        # poisons step index 1; the
+        for x, y in _batches(4):          # failure surfaces at step 2
+            rel.run(step, x, y)
+        rel.finalize()
+        assert rel.stats["retries"] == 1
+        # the replay's record is keyed (step 1, attempt 1) — NOT step 2
+        assert os.path.exists(
+            tmp_path / "rank_0.g0.step_1.a1.fp")
+        assert not os.path.exists(
+            tmp_path / "rank_0.g0.step_2.a1.fp")
+
+    def test_gc_never_deletes_newer_generation_records(self, tmp_path,
+                                                       monkeypatch):
+        """Regression: a zombie pre-restart rank's GC must not delete
+        the respawned incarnation's live fingerprint records."""
+        newer = tmp_path / "rank_0.g5.step_0.a0.fp"
+        older = tmp_path / "rank_0.g0.step_0.a0.fp"
+        for p in (newer, older):
+            p.write_text(json.dumps({"rank": 0, "digest": 1}))
+        # the zombie: generation 3; posts at a GC boundary (step 0)
+        monkeypatch.setenv("PADDLE_RESTART_GENERATION", "3")
+        m, o = _mlp()
+        g = SDCGuard(o, store_dir=str(tmp_path), rank=0, world=1,
+                     evict=False)
+        assert g.gen == 3
+        import jax.numpy as jnp
+        g.begin(0)
+        g._device_fp = numerics.tree_fingerprint(
+            [jnp.ones((4,), jnp.float32)])
+        g._captured = True
+        g.post()
+        assert newer.exists()             # future gen: untouched
+        assert not older.exists()         # stale gen: reaped
+
+
+# ===================================================== retry jitter
+class TestRankSaltedJitter:
+    def test_default_rng_is_rank_salted(self, monkeypatch):
+        from paddle2_tpu.distributed.fault_tolerance.retry import \
+            backoff_delays
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        a1 = list(backoff_delays(0.5, 2.0, 6, jitter=0.25))
+        a2 = list(backoff_delays(0.5, 2.0, 6, jitter=0.25))
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        b = list(backoff_delays(0.5, 2.0, 6, jitter=0.25))
+        # same rank reproduces, different ranks decorrelate
+        assert a1 == a2
+        assert a1 != b
+        plain = [0.5, 1.0, 2.0, 2.0, 2.0, 2.0]
+        for got in (a1, b):
+            for g, rung in zip(got, plain):
+                assert rung <= g <= rung * 1.25 + 1e-9
+
+    def test_zero_jitter_stays_deterministic(self, monkeypatch):
+        from paddle2_tpu.distributed.fault_tolerance.retry import \
+            backoff_delays
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+        assert list(backoff_delays(0.5, 2.0, 4)) == [0.5, 1.0, 2.0, 2.0]
+
+
+# ============================================ elastic re-formation
+class TestElasticQuarantine:
+    """Satellite: re-formation with a quarantined host — the manager
+    drops it from the live set (RESTART), and the timeline records
+    ``elastic.quarantine`` with the probe evidence."""
+
+    def _manager(self, tmp_path, monkeypatch, world=3):
+        from paddle2_tpu.distributed.fleet.elastic import ElasticManager
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", str(world))
+        monkeypatch.setenv("PADDLE_NODE_ID", "host-0")
+        monkeypatch.setenv("PADDLE_QUARANTINE_DIR",
+                           str(tmp_path / "q"))
+        mgr = ElasticManager(store_dir=str(tmp_path / "hb"),
+                             heartbeat_interval=0.0)
+        # peers heartbeat with their own node identities
+        now = time.time()
+        for r in range(1, world):
+            with open(os.path.join(mgr.store_dir,
+                                   f"rank_{r}.hb"), "w") as f:
+                json.dump({"rank": r, "ts": now, "world": world,
+                           "node": f"host-{r}"}, f)
+        return mgr
+
+    def test_quarantined_rank_forces_restart_with_evidence(
+            self, tmp_path, monkeypatch):
+        from paddle2_tpu.distributed.fleet.elastic import ElasticStatus
+        monkeypatch.setenv("PADDLE_FLIGHT_DIR", str(tmp_path / "fl"))
+        mgr = self._manager(tmp_path, monkeypatch)
+        assert mgr.watch() == ElasticStatus.HOLD
+        store = health.QuarantineStore(str(tmp_path / "q"))
+        store.quarantine("host-2", "fingerprint_vote",
+                         {"step": 7, "suspect_digest": 123}, rank=2)
+        fr = flight_recorder.enable(str(tmp_path / "fl"), rank=0,
+                                    install_hooks=False)
+        try:
+            assert mgr.watch() == ElasticStatus.RESTART
+            assert mgr.quarantined_ranks() == [2]
+            # per-transition: a second poll adds no duplicate evidence
+            assert mgr.watch() == ElasticStatus.RESTART
+        finally:
+            flight_recorder.disable()
+        evs = [e for e in fr.events() if e[2] == "elastic.quarantine"]
+        assert len(evs) == 1
+        assert evs[0][3]["rank"] == 2 and evs[0][3]["host"] == "host-2"
+        assert evs[0][3]["reason"] == "fingerprint_vote"
+        timeline = [json.loads(ln) for ln in
+                    open(tmp_path / "fl" / "elastic_events.jsonl")]
+        q = [e for e in timeline if e["kind"] == "elastic.quarantine"]
+        assert q and q[0]["ranks"] == [2] and q[0]["hosts"] == ["host-2"]
+
+    def test_release_returns_to_hold(self, tmp_path, monkeypatch):
+        from paddle2_tpu.distributed.fleet.elastic import ElasticStatus
+        mgr = self._manager(tmp_path, monkeypatch)
+        store = health.QuarantineStore(str(tmp_path / "q"))
+        store.quarantine("host-1", "periodic_probe")
+        assert mgr.watch() == ElasticStatus.RESTART
+        store.release("host-1")
+        assert mgr.watch() == ElasticStatus.HOLD
+
+    def test_no_store_changes_nothing(self, tmp_path, monkeypatch):
+        from paddle2_tpu.distributed.fleet.elastic import ElasticStatus
+        mgr = self._manager(tmp_path, monkeypatch)
+        monkeypatch.delenv("PADDLE_QUARANTINE_DIR")
+        assert mgr.watch() == ElasticStatus.HOLD
+        assert mgr.quarantined_ranks() == []
+
+
+# ===================================================== flight doctor
+class TestFlightDoctorQuarantine:
+    def _write_dump(self, d, rank, events, node=None):
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, f"rank_{rank}.jsonl"), "w") as f:
+            f.write(json.dumps({
+                "type": "header", "rank": rank, "world": 2,
+                "reason": "test", "generation": 0,
+                "node": node or f"host-{rank}"}) + "\n")
+            for i, (kind, fields) in enumerate(events):
+                rec = {"type": "event", "n": i, "t": float(i),
+                       "kind": kind}
+                rec.update(fields)
+                f.write(json.dumps(rec) + "\n")
+            f.write(json.dumps({"type": "stacks", "threads": []})
+                    + "\n")
+
+    def test_quarantine_section_renders(self, tmp_path):
+        from paddle2_tpu.tools import flight_doctor as fd
+        flight = str(tmp_path / "fl")
+        self._write_dump(flight, 0, [
+            ("sdc.fingerprint_mismatch",
+             {"step": 5, "attempt": 0, "suspects": [1],
+              "digests": "{'0': 111, '1': 222}"})])
+        self._write_dump(flight, 1, [
+            ("sdc.evict", {"step": 6, "host": "host-1",
+                           "reason": "fingerprint_vote"})])
+        qdir = str(tmp_path / "q")
+        health.QuarantineStore(qdir).quarantine(
+            "host-1", "fingerprint_vote",
+            {"step": 5, "suspect_digest": 222}, rank=1)
+        dumps = fd.load_dumps(flight)
+        report = fd.diagnose(dumps, {}, [], fd.load_quarantine(qdir))
+        assert report["quarantine"][0]["host"] == "host-1"
+        assert report["nodes"] == {0: "host-0", 1: "host-1"}
+        assert any(e.get("suspects") == [1] for e in report["sdc"])
+        text = fd.format_report(report, flight)
+        assert "QUARANTINE" in text
+        assert "host-1" in text and "fingerprint_vote" in text
+        assert "fingerprint mismatch at step 5" in text
+        assert "excluded from every re-formation" in text
+
+    def test_cli_with_quarantine_dir(self, tmp_path, capsys):
+        from paddle2_tpu.tools import flight_doctor as fd
+        flight = str(tmp_path / "fl")
+        self._write_dump(flight, 0, [])
+        qdir = str(tmp_path / "q")
+        health.QuarantineStore(qdir).quarantine("bad-host",
+                                                "periodic_probe")
+        rc = fd.main([flight, "--quarantine-dir", qdir])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "bad-host" in out and "periodic_probe" in out
+
+    def test_no_quarantine_no_section(self, tmp_path):
+        from paddle2_tpu.tools import flight_doctor as fd
+        flight = str(tmp_path / "fl")
+        self._write_dump(flight, 0, [])
+        report = fd.diagnose(fd.load_dumps(flight), {}, [], [])
+        assert "QUARANTINE" not in fd.format_report(report, flight)
+
+
+# ============================================ launcher re-formation
+@pytest.mark.gang
+class TestLauncherQuarantine:
+    @pytest.fixture(autouse=True)
+    def _env_guard(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_ELASTIC_RESTART_COUNT", "0")
+        monkeypatch.delenv("PADDLE_FLIGHT_DIR", raising=False)
+        yield
+
+    def test_reformation_excludes_quarantined_slot(self, tmp_path,
+                                                   monkeypatch,
+                                                   capsys):
+        """A worker convicted mid-run (verdict in the store) + a scale
+        request: the NEXT formation excludes its slot, the generation
+        bumps, and the timeline records the quarantine."""
+        from paddle2_tpu.distributed.launch.main import launch
+        monkeypatch.setenv("PADDLE_QUARANTINE_DIR",
+                           str(tmp_path / "q"))
+        monkeypatch.setenv("PADDLE_FLIGHT_DIR", str(tmp_path / "fl"))
+        log = tmp_path / "runs.jsonl"
+        script = tmp_path / "w.py"
+        script.write_text(f"""
+import json, os, sys
+log = {str(log)!r}
+rec = {{"rank": os.environ["PADDLE_TRAINER_ID"],
+       "world": os.environ["PADDLE_TRAINERS_NUM"],
+       "gen": os.environ["PADDLE_RESTART_GENERATION"],
+       "node": os.environ["PADDLE_NODE_ID"]}}
+with open(log, "a") as f:
+    f.write(json.dumps(rec) + "\\n")
+if rec["gen"] == "0" and rec["rank"] == "1":
+    # the fingerprint vote convicted this node: write the verdict
+    # (the store's documented file format) and request a scale event
+    qd = os.environ["PADDLE_QUARANTINE_DIR"]
+    os.makedirs(qd, exist_ok=True)
+    node = rec["node"]
+    safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                   for c in node)
+    with open(os.path.join(qd, "q_%s.json" % safe), "w") as f:
+        json.dump({{"host": node, "reason": "fingerprint_vote",
+                   "rank": 1, "ts": 0,
+                   "evidence": {{"step": 3}}}}, f)
+    sys.exit(101)
+sys.exit(0)
+""")
+        rc = launch(["--nproc_per_node", "2", "--max_restarts", "0",
+                     str(script)])
+        assert rc == 0
+        runs = [json.loads(ln) for ln in open(log)]
+        gen0 = [r for r in runs if r["gen"] == "0"]
+        gen1 = [r for r in runs if r["gen"] == "1"]
+        host = socket.gethostname()
+        assert sorted(r["rank"] for r in gen0) == ["0", "1"]
+        assert {r["world"] for r in gen0} == {"2"}
+        assert {r["node"] for r in gen0} \
+            == {f"{host}/s0", f"{host}/s1"}
+        # re-formation: generation bumped, quarantined slot excluded,
+        # the survivor keeps its stable slot identity
+        assert [r["rank"] for r in gen1] == ["0"]
+        assert gen1[0]["world"] == "1"
+        assert gen1[0]["node"] == f"{host}/s0"
+        err = capsys.readouterr().err
+        assert "QUARANTINED" in err
+        assert "quarantine scale-in: world 2 -> 1" in err
+        timeline = [json.loads(ln) for ln in
+                    open(tmp_path / "fl" / "elastic_events.jsonl")]
+        q = [e for e in timeline if e["kind"] == "elastic.quarantine"]
+        assert q and q[0]["host"] == f"{host}/s1"
+        assert q[0]["reason"] == "fingerprint_vote"
+
+    def test_fully_quarantined_node_refuses_to_launch(self, tmp_path,
+                                                      monkeypatch,
+                                                      capsys):
+        from paddle2_tpu.distributed.launch.main import (
+            QUARANTINED_EXIT_CODE, launch)
+        monkeypatch.setenv("PADDLE_QUARANTINE_DIR",
+                           str(tmp_path / "q"))
+        health.QuarantineStore(str(tmp_path / "q")).quarantine(
+            f"{socket.gethostname()}/s0", "periodic_probe")
+        script = tmp_path / "w.py"
+        script.write_text("raise SystemExit(0)\n")
+        marker = tmp_path / "ran"
+        script.write_text(f"open({str(marker)!r}, 'w').write('x')\n")
+        rc = launch(["--nproc_per_node", "1", str(script)])
+        assert rc == QUARANTINED_EXIT_CODE
+        assert not marker.exists()            # never spawned
+        assert "quarantined" in capsys.readouterr().err.lower()
+
+    def test_failure_scale_in_retires_the_failed_slot(self, tmp_path,
+                                                      monkeypatch):
+        """Regression: --elastic_rescale must drop the slot whose
+        worker DIED, not the highest-numbered one — the verdict (and a
+        later quarantine) follows the physical position."""
+        from paddle2_tpu.distributed.launch.main import launch
+        log = tmp_path / "runs.jsonl"
+        script = tmp_path / "w.py"
+        script.write_text(f"""
+import json, os, sys, time
+rec = {{"rank": os.environ["PADDLE_TRAINER_ID"],
+       "gen": os.environ["PADDLE_RESTART_GENERATION"],
+       "world": os.environ["PADDLE_TRAINERS_NUM"],
+       "node": os.environ["PADDLE_NODE_ID"]}}
+with open({str(log)!r}, "a") as f:
+    f.write(json.dumps(rec) + "\\n")
+if rec["gen"] == "0":
+    if rec["rank"] == "0":
+        sys.exit(3)         # slot 0's chip dies
+    time.sleep(30)          # survivors wait for teardown
+sys.exit(0)
+""")
+        rc = launch(["--nproc_per_node", "3", "--max_restarts", "1",
+                     "--elastic_rescale", str(script)])
+        assert rc == 0
+        runs = [json.loads(ln) for ln in open(log)]
+        gen1 = [r for r in runs if r["gen"] == "1"]
+        host = socket.gethostname()
+        assert {r["world"] for r in gen1} == {"2"}
+        # slot 0 (the dead chip) retired; slots 1 and 2 respawned
+        assert {r["node"] for r in gen1} \
+            == {f"{host}/s1", f"{host}/s2"}
+
+    def test_whole_host_verdict_blocks_every_slot(self, tmp_path,
+                                                  monkeypatch):
+        from paddle2_tpu.distributed.launch.main import (
+            QUARANTINED_EXIT_CODE, launch)
+        monkeypatch.setenv("PADDLE_QUARANTINE_DIR",
+                           str(tmp_path / "q"))
+        health.QuarantineStore(str(tmp_path / "q")).quarantine(
+            socket.gethostname(), "preflight_selftest")
+        script = tmp_path / "w.py"
+        script.write_text("raise SystemExit(0)\n")
+        rc = launch(["--nproc_per_node", "2", str(script)])
+        assert rc == QUARANTINED_EXIT_CODE
+
+
+# ===================================================== the gang drill
+@pytest.mark.slow
+@pytest.mark.gang
+class TestSDCGangDrill:
+    def test_flip_bits_detect_retry_quarantine_reform(self, tmp_path):
+        """Acceptance drill, end to end through real processes: a
+        3-rank launcher gang trains on identical inputs with the SDC
+        guard on; chaos flips 2 mantissa bits in rank 1's gradients at
+        its 3rd step. The vote detects it AT that step, every rank
+        rewinds and replays cleanly, rank 1's node lands in the
+        quarantine store, rank 1 self-evicts at the next boundary with
+        ELASTIC_EXIT_CODE, and the launcher re-forms at world 2
+        WITHOUT the quarantined slot."""
+        sdc_dir = tmp_path / "sdc"
+        qdir = tmp_path / "q"
+        flight = tmp_path / "fl"
+        prog = tmp_path / "progress"
+        os.makedirs(prog)
+        script = tmp_path / "train.py"
+        script.write_text(f"""
+import json, os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle2_tpu as paddle
+import paddle2_tpu.nn as nn
+import paddle2_tpu.optimizer as opt
+from paddle2_tpu.distributed import fault_tolerance as ft
+
+rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+gen = int(os.environ.get("PADDLE_RESTART_GENERATION", 0))
+if gen > 0:
+    # the marginal host corrupted once; post-re-formation runs are
+    # clean (rank ids renumber, so the armed victim would otherwise
+    # shift to an innocent slot)
+    ft.chaos.disarm()
+
+paddle.seed(0)
+m = nn.Linear(8, 8)
+o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+guard = ft.SDCGuard(o, timeout=60.0, poll_interval=0.01)
+rel = ft.ReliableStep(m, o, snapshot_every=1, sdc_guard=guard)
+rs = np.random.RandomState(0)          # IDENTICAL inputs on every rank
+loss_fn = nn.MSELoss()
+
+def step(x, y):
+    loss = loss_fn(m(x), y)
+    loss.backward()
+    o.step()
+    o.clear_grad()
+    return loss
+
+first_mismatch = None
+for s in range(6):
+    x = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+    y = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+    rel.run(step, x, y)
+    if first_mismatch is None and guard.stats["mismatches"]:
+        first_mismatch = s
+    path = os.path.join({str(prog)!r}, "g%d_r%d.json" % (gen, rank))
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({{"gen": gen, "rank": rank, "world": world,
+                   "node": os.environ.get("PADDLE_NODE_ID"),
+                   "step": s, "retries": rel.stats["retries"],
+                   "mismatches": guard.stats["mismatches"],
+                   "convictions": guard.stats["convictions"],
+                   "first_mismatch": first_mismatch}}, f)
+    os.replace(tmp, path)
+rel.finalize()
+""")
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("JAX_", "PADDLE_", "FLAGS_"))}
+        env["PYTHONPATH"] = REPO
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PADDLE_SDC_DIR"] = str(sdc_dir)
+        env["PADDLE_QUARANTINE_DIR"] = str(qdir)
+        env["PADDLE_FLIGHT_DIR"] = str(flight)
+        # 2 mantissa bits, victim rank 1, the victim's 3rd optimizer
+        # step (= step index 2)
+        env["FLAGS_chaos"] = "flip_bits:grads:2:1:3"
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle2_tpu.distributed.launch",
+             "--nproc_per_node", "3", "--max_restarts", "2",
+             str(script)],
+            env=env, capture_output=True, text=True, timeout=420)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+
+        host = socket.gethostname()
+        # gen 0, rank 0: detected AT the injected step, retried once
+        g0r0 = json.load(open(prog / "g0_r0.json"))
+        assert g0r0["mismatches"] >= 1
+        assert g0r0["retries"] >= 1
+        assert g0r0["first_mismatch"] == 2      # within 1 step
+        assert g0r0["convictions"] >= 1
+        # the verdict: rank 1's node, convicted by the vote
+        store = health.QuarantineStore(str(qdir))
+        e = store.entry(f"{host}/s1")
+        assert e is not None, store.entries()
+        assert e["reason"] == "fingerprint_vote" and e["rank"] == 1
+        # the re-formed gang ran at world 2 without the quarantined
+        # slot, and stayed mismatch-free
+        g1r0 = json.load(open(prog / "g1_r0.json"))
+        assert g1r0["world"] == 2
+        assert g1r0["step"] == 5                # ran to completion
+        assert g1r0["mismatches"] == 0
+        nodes = {json.load(open(prog / f"g1_r{r}.json"))["node"]
+                 for r in range(2)}
+        assert nodes == {f"{host}/s0", f"{host}/s2"}
+        assert "quarantine scale-in" in proc.stderr
+        timeline = [json.loads(ln)
+                    for ln in open(flight / "elastic_events.jsonl")]
+        kinds = {e["kind"] for e in timeline}
+        assert "elastic.quarantine" in kinds
