@@ -123,6 +123,23 @@ class GradScaler:
         if not st["found_inf"]:
             optimizer.step()
 
+    def note_fused_step(self, found_inf: bool) -> None:
+        """Consume the IN-PROGRAM found_inf sentinel of an instrumented
+        ``jit.train_step`` (the packed aux lane computed inside the
+        donated executable). The compiled program already scaled the
+        loss, unscaled the gradients, and skipped the fused update when
+        the flag fired — this method is the remaining HOST half of the
+        cycle: record the skip/good step and move the dynamic scale,
+        WITHOUT issuing the scaler's own fused-sentinel readback
+        (``unscale_``). One readback total per step — the packed aux —
+        preserving the guardrails one-sync-per-step invariant. The
+        caller (ReliableTrainStep) is responsible for making
+        ``found_inf`` rank-consistent first."""
+        if not self._enable:
+            return
+        self._cycle_found_inf = bool(found_inf) or self._cycle_found_inf
+        self.update()
+
     def minimize(self, optimizer, loss) -> None:
         """step + update in one call (reference minimize semantics)."""
         self.step(optimizer)

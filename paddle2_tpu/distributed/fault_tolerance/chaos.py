@@ -367,6 +367,95 @@ def maybe_flip_bits_array(where: str, arr, rank_axis: bool = False):
     return arr
 
 
+def compiled_grad_fault(amp: bool = False):
+    """Per-dispatch hook of the INSTRUMENTED ``jit.train_step``: decide
+    at call time whether this step's compiled program must carry an
+    injected gradient fault, and return a hashable pure-function spec
+    the builder threads into the trace (``apply_compiled_grad_fault``).
+    The eager hooks mutate ``p.grad`` between backward and
+    ``optimizer.step`` — inside one donated executable there is no such
+    seam, so the fault becomes part of the traced program instead (the
+    spec lands in the compile-cache key: a firing drill compiles a
+    one-off variant, the clean path reuses its entry untouched).
+
+    Gating mirrors the eager hooks exactly so a drill runs identically
+    eager vs compiled: ``poison_grads`` ticks once per fused
+    unscale/check — which exists only when a GradScaler is fused in
+    (``amp``), the same single call site the eager fault has in
+    ``GradScaler.unscale_``; ``flip_bits:grads`` ticks only on the
+    victim rank and flips the same seeded positions as
+    :func:`maybe_flip_bits_grads`."""
+    if _ACTIVE is None:
+        return None
+    if amp and "poison_grads" in _ACTIVE.targets \
+            and _ACTIVE.should_fire("poison_grads"):
+        _ACTIVE.record("poison_grads", "compiled")
+        return ("poison",)
+    if _flip_armed("grads"):
+        from ..env import get_rank
+        if get_rank() == _ACTIVE.flip["rank"] \
+                and _ACTIVE.should_fire("flip_bits"):
+            n = int(_ACTIVE.flip["bits"])
+            seed = int(_ACTIVE.counts["flip_bits"])
+            _ACTIVE.record(
+                "flip_bits",
+                f"grads:rank{_ACTIVE.flip['rank']}:{n}bits:compiled")
+            return ("flip", n, seed)
+    return None
+
+
+def _flip_bits_traced(arr, n_bits: int, seed: int):
+    """Trace-time twin of :func:`flip_mantissa_bits`: flip the SAME
+    seeded (position, bit) pairs, but as pure jnp ops on a traced
+    array — bitcast to the native word, scatter-xor, bitcast back —
+    so the flip compiles INTO the instrumented train step. Bitwise
+    equal to the eager flip on equal input bits."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    if arr.dtype.itemsize == 2:
+        mant = 7 if "bfloat16" in str(arr.dtype) else 10
+        word_t = jnp.uint16
+    elif arr.dtype.itemsize == 8:
+        mant, word_t = 52, jnp.uint64
+    else:
+        mant, word_t = 23, jnp.uint32
+    size = 1
+    for d in arr.shape:
+        size *= d
+    rs = np.random.RandomState(0x5DC ^ (seed & 0x7FFFFFFF))
+    words = jax.lax.bitcast_convert_type(arr, word_t).ravel()
+    for _ in range(max(1, int(n_bits))):
+        idx = int(rs.randint(0, size))
+        bit = int(rs.randint(0, mant))
+        words = words.at[idx].set(
+            words[idx] ^ jnp.asarray(1 << bit, word_t))
+    return jax.lax.bitcast_convert_type(
+        words.reshape(arr.shape), arr.dtype)
+
+
+def apply_compiled_grad_fault(spec, grad_arrays):
+    """Apply a :func:`compiled_grad_fault` spec to the traced gradient
+    list (pure; called at trace time by the train-step builder).
+    ``poison`` NaNs every float gradient (the eager
+    ``maybe_poison_grads`` twin); ``flip`` corrupts the FIRST float
+    gradient's mantissa, like ``maybe_flip_bits_grads``."""
+    if spec is None:
+        return grad_arrays
+    import jax.numpy as jnp
+    if spec[0] == "poison":
+        return [jnp.full(g.shape, jnp.nan, g.dtype)
+                if jnp.issubdtype(g.dtype, jnp.floating) else g
+                for g in grad_arrays]
+    _, n_bits, seed = spec
+    out = list(grad_arrays)
+    for i, g in enumerate(out):
+        if jnp.issubdtype(g.dtype, jnp.floating):
+            out[i] = _flip_bits_traced(g, n_bits, seed)
+            break
+    return out
+
+
 def maybe_poison_grads(optimizer) -> None:
     """GradScaler unscale hook: overwrite every gradient with NaN, the
     deterministic stand-in for an fp16 overflow — drives the skip-step
@@ -390,4 +479,5 @@ __all__ = ["ChaosInjector", "arm", "disarm", "active", "fired_log",
            "maybe_delay_collective", "maybe_stall_collective",
            "maybe_crash_worker", "maybe_poison_grads", "maybe_kill_rank",
            "flip_mantissa_bits", "maybe_flip_bits_grads",
-           "maybe_flip_bits_array", "KINDS"]
+           "maybe_flip_bits_array", "compiled_grad_fault",
+           "apply_compiled_grad_fault", "KINDS"]

@@ -1,0 +1,286 @@
+"""The reliability plane, fused into the compiled train step.
+
+PRs 1-5 built detect->diagnose->evict->recover as wrappers around the
+*eager* optimizer step. On TPU the differentiated, donated
+``jit.train_step`` executable IS the program — so this module moves the
+whole loop inside it:
+
+* the non-finite sentinel (:mod:`.numerics`) and the SDC gradient
+  fingerprint (:mod:`.sdc` word-sum/xor-fold/norm triple) are computed
+  INSIDE the donated executable and returned as ONE packed ``uint32[4]``
+  auxiliary output next to the loss. The clean path reads nothing extra:
+  without SDC the sentinel is folded into the loss (NaN on corrupt
+  grads) and checked deferred at step N+1 exactly like ReliableStep's
+  loss check; with SDC (or AMP) the wrapper pays the single packed
+  readback the vote/skip decision needs anyway.
+* because ``jit.train_step`` donates the parameter buffers themselves,
+  a host snapshot taken after dispatch would read freed memory — the
+  wrapper schedules snapshots BEFORE each submit on snapshot steps
+  (inherited from :class:`~.reliable.ReliableStep`, which copies via
+  :func:`~.replica.tree_to_host` and mirrors to the
+  :class:`~.replica.BuddyReplicator`), and restores by rebuilding the
+  donated argument tree through the holders' ``set_state_dict`` so a
+  rewind+replay runs against the same compiled executable.
+* retry semantics (:class:`~.sdc.GradientCorruptionError`,
+  :class:`~paddle2_tpu.distributed.watchdog.CollectiveTimeout`, chaos
+  faults), flight-recorder step/retry/rollback events, and the
+  quarantine self-evict path are wired ONCE here, so DistModel / ZeRO /
+  pipeline configs get the full loop by building their step through
+  ``jit.train_step(..., reliability=...)`` — no per-feature
+  re-wrapping.
+* recovery recompiles are made cheap: when the persistent compilation
+  cache (``FLAGS_compilation_cache_dir``) is on, each fresh
+  build+first-step is timed, checked against the cache
+  (``compile_cache_hit``), recorded in the elastic event stream, and
+  compared against the ``PADDLE_MTTR_BUDGET`` the launcher propagates
+  from ``--mttr_budget`` — the 18.7s compile+first-step is pure MTTR on
+  every respawn, and a warm cache turns it into milliseconds.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence
+
+from . import flight_recorder
+from . import numerics
+from .reliable import ReliableStep
+
+# the launcher propagates --mttr_budget to workers under this name so
+# compile time can be accounted against the same recovery budget the
+# respawn span is
+MTTR_BUDGET_ENV = "PADDLE_MTTR_BUDGET"
+
+
+class ReliabilityConfig:
+    """Knobs for :func:`paddle2_tpu.jit.train_step`'s ``reliability=``.
+
+    Snapshot/retry fields mirror :class:`~.reliable.ReliableStep`;
+    ``sdc`` is ``True`` (build an :class:`~.sdc.SDCGuard` from the
+    environment), an existing guard, or ``None``; ``scaler`` is an
+    :class:`~paddle2_tpu.amp.GradScaler` whose scale/unscale/skip cycle
+    is fused into the compiled program (its own per-step found_inf
+    readback is skipped — the packed in-program flag is consumed
+    instead, keeping the one-sync-per-step invariant); ``replicator``
+    is a :class:`~.replica.BuddyReplicator` for RAM-first respawn
+    recovery; ``holders`` appends extra stateful objects to the
+    snapshot set."""
+
+    def __init__(self, snapshot_every: int = 1, max_retries: int = 3,
+                 retry_budget: int = 16, base_delay: float = 0.05,
+                 max_delay: float = 2.0, check_finite: bool = True,
+                 sdc: Any = None, replicator: Any = None,
+                 scaler: Any = None, holders: Sequence = (),
+                 mttr_budget: Optional[float] = None):
+        self.snapshot_every = int(snapshot_every)
+        self.max_retries = int(max_retries)
+        self.retry_budget = int(retry_budget)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.check_finite = bool(check_finite)
+        self.sdc = sdc
+        self.replicator = replicator
+        self.scaler = scaler
+        self.holders = list(holders)
+        if mttr_budget is None:
+            env = os.environ.get(MTTR_BUDGET_ENV)
+            mttr_budget = float(env) if env else 0.0
+        self.mttr_budget = float(mttr_budget)
+
+
+class _AccumState:
+    """Snapshot holder for a gradient-accumulation program's hidden
+    training state: the donated f32 accumulation bank AND the
+    microstep phase counter. Without it, a rewind+replay of a
+    microstep double-banks its gradient contribution and shifts the
+    micro/apply cadence — no NaN, no error, silently diverged weights
+    (the exact failure class the plane exists to stop). Attached to
+    the holder set only when ``k > 1``, so the common path pays
+    nothing."""
+
+    def __init__(self, program):
+        self._program = program
+
+    def state_dict(self):
+        import numpy as np
+        bufs = self._program._accum_buffers
+        return {
+            "micro_calls": int(self._program._micro_calls),
+            "buffers": None if bufs is None else
+            [np.array(np.asarray(b), copy=True) for b in bufs],
+        }
+
+    def set_state_dict(self, state):
+        import jax.numpy as jnp
+        self._program._micro_calls = int(state.get("micro_calls", 0))
+        bufs = state.get("buffers")
+        self._program._accum_buffers = None if bufs is None else \
+            [jnp.array(b, copy=True) for b in bufs]
+
+
+class ReliableTrainStep(ReliableStep):
+    """ReliableStep driving an INSTRUMENTED
+    :class:`~paddle2_tpu.jit.train_step.TrainStepProgram`.
+
+    ::
+
+        step = paddle.jit.train_step(train_fn, opt,
+                                     reliability={"snapshot_every": 10})
+        for batch in loader:
+            loss = step(ids, labels)
+        step.finalize()
+
+    Same call surface as the plain program (returns the loss Tensor);
+    same reliability surface as the eager wrapper (``stats``,
+    ``finalize``, ``resume_from_replica``, snapshot/restore). What
+    changes is WHERE the checks run: sentinels and fingerprints ride
+    inside the donated executable, and the wrapper only decides when to
+    look at the packed result."""
+
+    def __init__(self, program, config: Optional[ReliabilityConfig] = None):
+        config = config or ReliabilityConfig()
+        self.program = program
+        self.config = config
+        self._opt = program.inner_optimizer
+        guard = config.sdc
+        if guard is True:
+            from .sdc import SDCGuard
+            # optimizer=None: no attach() — the fingerprint comes from
+            # the program's packed aux, fed via feed_host()
+            guard = SDCGuard(optimizer=None)
+        scaler = config.scaler
+        if scaler is not None and not getattr(scaler, "is_enable",
+                                              lambda: True)():
+            scaler = None
+        self._scaler = scaler
+        program._scaler = scaler
+        # snapshot set = every traced layer + the inner optimizer
+        # (+ the scaler's skip counters + any extra holders): one
+        # snapshot covers the whole donated argument tree, so restore
+        # can REBUILD it after the executable's buffers were donated
+        holders = list(program.layers) + list(config.holders)
+        if scaler is not None:
+            holders.append(scaler)
+        if program._accum_k > 1:
+            holders.append(_AccumState(program))
+        ReliableStep.__init__(
+            self, model=None, optimizer=self._opt,
+            snapshot_every=config.snapshot_every,
+            max_retries=config.max_retries,
+            retry_budget=config.retry_budget,
+            base_delay=config.base_delay, max_delay=config.max_delay,
+            check_finite=config.check_finite,
+            replicator=config.replicator, sdc_guard=guard,
+            holders=holders)
+        self._pending_aux = None
+
+    # -- the compiled step ----------------------------------------------
+    def __call__(self, *args, **kwargs):
+        return self.run(self._program_step, *args, **kwargs)
+
+    def _program_step(self, *args, **kwargs):
+        loss = self.program(*args, **kwargs)
+        self._note_compile()
+        aux = self.program.last_aux
+        self.program.last_aux = None
+        if aux is None:
+            self._pending_aux = None
+            return loss
+        if self._sdc is not None and self._sdc.enabled:
+            # SDC mode: the vote needs the fingerprint NOW (the guard's
+            # check() runs right after this returns) — ONE packed
+            # readback covers the fingerprint AND the found_inf lane
+            res = numerics.packed_sentinel_to_host(aux)
+            if res is not None:
+                found, host_fp = res
+                self._sdc.feed_host(host_fp)
+                if self._scaler is not None:
+                    self._apply_found_inf(found)
+            self._pending_aux = None
+        elif self._scaler is not None:
+            # AMP without SDC: defer the packed read to the next step's
+            # settle (by then the aux has materialized as a by-product
+            # of dispatch — same free-on-the-clean-path contract as the
+            # loss check)
+            self._pending_aux = aux
+        else:
+            # plain reliability: the sentinel was FOLDED into the loss
+            # in-program; the inherited deferred loss check catches it
+            # with zero extra readbacks, and the aux is never read
+            self._pending_aux = None
+        return loss
+
+    def _settle_pending(self) -> None:
+        aux, self._pending_aux = self._pending_aux, None
+        if aux is not None:
+            res = numerics.packed_sentinel_to_host(aux)
+            if res is not None:
+                self._apply_found_inf(res[0])
+        super()._settle_pending()
+
+    def restore(self) -> None:
+        # a rollback voids the failed attempt's step entirely — its
+        # stashed aux must never be applied to the freshly-restored
+        # scaler/step-count state (and the snapshot predates the aux's
+        # step, so any bookkeeping consumed before the failure was
+        # detected is rolled back with everything else)
+        self._pending_aux = None
+        super().restore()
+
+    def finalize(self) -> None:
+        super().finalize()
+        # a replay during the final settle leaves its (accepted)
+        # attempt's aux stashed with no later settle to consume it:
+        # drain it here so the scaler's skip ledger and the optimizer
+        # step count end the run correct
+        aux, self._pending_aux = self._pending_aux, None
+        if aux is not None:
+            res = numerics.packed_sentinel_to_host(aux)
+            if res is not None:
+                self._apply_found_inf(res[0])
+
+    # -- AMP plumbing ---------------------------------------------------
+    def _apply_found_inf(self, found: bool) -> None:
+        """Consume the in-program found_inf lane for the fused
+        GradScaler: rank-consistent reduce (identity under one
+        controller — the flag came out of the SPMD program), undo the
+        optimistic host-side step-count bump for the skipped update,
+        and drive the scaler's skip/backoff state machine."""
+        if self._scaler is None:
+            return
+        found = numerics.flag_to_host(
+            numerics.all_reduce_found_inf(bool(found)))
+        if found:
+            # the in-program where() kept params/states: the update did
+            # NOT happen, so the count (and the Adam bias-correction
+            # step the next dispatch passes) must roll back too
+            self._opt._step_count = max(0, self._opt._step_count - 1)
+        self._scaler.note_fused_step(found)
+
+    # -- MTTR / compile-cache accounting --------------------------------
+    def _note_compile(self) -> None:
+        secs = self.program.last_build_s
+        if secs is None:
+            return
+        self.program.last_build_s = None
+        hit = self.program.last_build_cache_hit
+        flight_recorder.record("compile", seconds=round(secs, 4),
+                               cache_hit=hit)
+        flight_recorder.append_elastic_event(
+            "compile_cache", hit=hit, compile_s=round(secs, 4),
+            programs=self.program.program_cache_size)
+        budget = self.config.mttr_budget
+        if budget > 0 and secs > budget:
+            import sys
+            print(f"[reliable-step] MTTR budget blown by compilation "
+                  f"alone: compile+first-step took {secs:.2f}s against "
+                  f"a budget of {budget:.2f}s — enable "
+                  f"FLAGS_compilation_cache_dir (the launcher's "
+                  f"--compile_cache_dir) so recovery recompiles hit "
+                  f"the persistent cache", file=sys.stderr)
+            flight_recorder.append_elastic_event(
+                "compile_budget_blown", compile_s=round(secs, 4),
+                budget_s=budget)
+
+
+__all__ = ["ReliabilityConfig", "ReliableTrainStep", "MTTR_BUDGET_ENV"]

@@ -202,6 +202,50 @@ def tree_fingerprint(tree: Any):
     return _fp_jit(tuple(leaves))
 
 
+def packed_step_sentinel(grad_arrays):
+    """The IN-PROGRAM reliability sentinel of an instrumented
+    ``jit.train_step``: one ``uint32[4]`` device array packing the
+    whole per-step evidence —
+
+    ``[nonfinite_count, fp_word_sum, fp_word_xor, bitcast(fp_sqnorm)]``
+
+    Lane 0 is the fused non-finite count over every float gradient
+    (the :func:`nonfinite_flag` sentinel, fused into the donated
+    executable); lanes 1-3 are the :func:`tree_fingerprint` SDC triple
+    over the same arrays. Pure jnp — meant to be called AT TRACE TIME
+    inside the compiled train step, so the whole reliability plane
+    rides the step's one dispatch and the host side pays at most ONE
+    packed readback (:func:`packed_sentinel_to_host`), deferred to the
+    next step like ReliableStep's loss check. Returns None when no
+    float leaf exists (nothing to guard)."""
+    import jax.numpy as jnp
+    leaves = _float_leaves(grad_arrays)
+    if not leaves:
+        return None
+    nf = None
+    for leaf in leaves:
+        n = jnp.sum(~jnp.isfinite(leaf), dtype=jnp.uint32)
+        nf = n if nf is None else nf + n
+    fp = _fingerprint_impl(leaves)
+    return jnp.concatenate([nf[None].astype(jnp.uint32), fp])
+
+
+def packed_sentinel_to_host(aux) -> Optional[tuple]:
+    """THE one host readback of a packed step sentinel: materializes
+    the ``uint32[4]`` as ``(found_nonfinite: bool, (sum, xor, norm))``
+    — the found_inf decision and the SDC host fingerprint in a single
+    transfer. Counted for the bench (the instrumented compiled step
+    charges at most one sync per checked step, shared by AMP's skip
+    decision and the fingerprint vote)."""
+    if aux is None:
+        return None
+    _count_sync()
+    arr = np.asarray(aux)
+    return (bool(arr[0] > 0),
+            (int(arr[1]), int(arr[2]),
+             float(arr[3:4].view(np.float32)[0])))
+
+
 def fingerprint_to_host(fp) -> Optional[tuple]:
     """THE one host readback of a device fingerprint: materializes the
     packed ``uint32[3]`` as ``(sum:int, xor:int, norm:float)``. Counted
@@ -319,6 +363,7 @@ def debug_anomaly(layer):
 
 
 __all__ = ["nonfinite_flag", "grads_nonfinite_flag", "tree_fingerprint",
+           "packed_step_sentinel", "packed_sentinel_to_host",
            "fingerprint_to_host", "all_reduce_found_inf",
            "flag_to_host", "found_nonfinite_host", "assert_finite",
            "debug_anomaly", "debug_anomaly_enabled", "host_sync_count",

@@ -52,6 +52,50 @@ class RetryBudgetExceededError(RuntimeError):
 _tree_to_host = tree_to_host
 
 
+def _apply_state(holder: Any, state: Any) -> None:
+    """Write a snapshot back into a holder: ``set_state_dict`` when it
+    exists (Layer/Optimizer), else ``load_state_dict`` (GradScaler)."""
+    if hasattr(holder, "set_state_dict"):
+        holder.set_state_dict(state)
+    else:
+        holder.load_state_dict(state)
+
+
+class SnapshotAliasError(RuntimeError):
+    """A rollback snapshot still references LIVE device buffers while
+    buffer donation is enabled: the next fused update would donate
+    (delete) them out from under the snapshot, and the restore after a
+    failure would read freed memory. Snapshots must be host copies —
+    ``tree_to_host`` every leaf before the step runs."""
+
+
+def _assert_host_snapshot(snapshot: Any) -> None:
+    """Donation-safety fence (checked whenever
+    ``FLAGS_donate_optimizer_buffers`` is on): walk the snapshot and
+    reject any leaf that is still a live jax device array. Cheap — a
+    type check per leaf, no device traffic."""
+    try:
+        import jax
+    except ImportError:
+        return
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            for v in obj.values():
+                walk(v)
+        elif isinstance(obj, (list, tuple)):
+            for v in obj:
+                walk(v)
+        elif isinstance(obj, jax.Array):
+            raise SnapshotAliasError(
+                f"snapshot leaf {type(obj).__name__}{obj.shape} is a "
+                "live device array while donate_optimizer_buffers is "
+                "on — the next optimizer step would donate it and the "
+                "rollback would read freed memory")
+
+    walk(snapshot)
+
+
 def _loss_is_finite(loss: Any) -> bool:
     # the shared numerics sentinel (fault_tolerance/numerics.py) is the
     # single source of truth for what counts as a bad materialized loss
@@ -83,7 +127,8 @@ class ReliableStep:
                  retry_budget: int = 16, base_delay: float = 0.05,
                  max_delay: float = 2.0, check_finite: bool = True,
                  sleep: Callable[[float], None] = time.sleep,
-                 replicator: Any = None, sdc_guard: Any = None):
+                 replicator: Any = None, sdc_guard: Any = None,
+                 holders: Any = ()):
         if snapshot_every < 1:
             raise ValueError("snapshot_every must be >= 1")
         # optional BuddyReplicator: every host snapshot is also mirrored
@@ -97,9 +142,16 @@ class ReliableStep:
         # TransientStepError) and lands in the _replay path below, so
         # the step is re-run WITHOUT the corrupt contribution
         self._sdc = sdc_guard
+        # extra `holders` ride along with (model, optimizer): the
+        # compiled-step wrapper passes every traced layer plus the
+        # GradScaler, so one snapshot covers the whole donated argument
+        # tree. Restore writes back via set_state_dict, falling back to
+        # load_state_dict (GradScaler's torch-style spelling).
         self._holders: List[Any] = [
-            h for h in (model, optimizer)
-            if h is not None and hasattr(h, "state_dict")]
+            h for h in list((model, optimizer)) + list(holders)
+            if h is not None and hasattr(h, "state_dict")
+            and (hasattr(h, "set_state_dict")
+                 or hasattr(h, "load_state_dict"))]
         self.snapshot_every = snapshot_every
         self.max_retries = max_retries
         self.retry_budget = retry_budget
@@ -121,6 +173,13 @@ class ReliableStep:
         is best-effort: a full shm store must not fail the step)."""
         self._snapshot = [_tree_to_host(h.state_dict())
                           for h in self._holders]
+        from ...flags import flag_value
+        if bool(flag_value("donate_optimizer_buffers")):
+            # the copy above must COMPLETE before the step can donate
+            # the buffers it read from: with donation on, a leaf that
+            # is still a device array means the copy silently aliased —
+            # fail loudly NOW, not at the restore after a failure
+            _assert_host_snapshot(self._snapshot)
         self._snapshot_step = self._step
         self.stats["snapshots"] += 1
         if self._replicator is not None:
@@ -191,7 +250,7 @@ class ReliableStep:
                     return None
         try:
             for holder, state in zip(self._holders, tree):
-                holder.set_state_dict(state)
+                _apply_state(holder, state)
         except Exception:
             # a partial application is healed by the caller's disk
             # restore (the ladder overwrites every holder)
@@ -207,7 +266,7 @@ class ReliableStep:
         if self._snapshot is None:
             raise RuntimeError("ReliableStep.restore: no snapshot taken")
         for holder, state in zip(self._holders, self._snapshot):
-            holder.set_state_dict(state)
+            _apply_state(holder, state)
         self.stats["restores"] += 1
 
     # -- failure plumbing ------------------------------------------------
@@ -349,4 +408,4 @@ class ReliableStep:
 
 
 __all__ = ["ReliableStep", "TransientStepError", "WorkerCrashError",
-           "RetryBudgetExceededError"]
+           "RetryBudgetExceededError", "SnapshotAliasError"]

@@ -164,6 +164,7 @@ class SDCGuard:
         self._step = 0
         self._attempt = 0
         self._device_fp = None
+        self._host_fp: Optional[Tuple[int, int, float]] = None
         self._captured = False
         self._last_digest: Optional[int] = None
         self._expect_peers = True
@@ -196,6 +197,23 @@ class SDCGuard:
         optimizer.step = _step
         return self
 
+    def feed_host(self, host_fp: Optional[Tuple[int, int, float]]
+                  ) -> None:
+        """External capture for the INSTRUMENTED compiled train step:
+        the fingerprint was computed inside the donated executable and
+        already read back as one lane of the step's single packed aux
+        readback (:func:`~.numerics.packed_sentinel_to_host`), so the
+        guard must consume the host triple directly instead of issuing
+        its own ``fingerprint_to_host`` sync. No-op unless armed — the
+        protocol (begin/post/verify keying, eviction, GC) is shared
+        with the attach() path."""
+        if not self.enabled or not self._armed:
+            return
+        if host_fp is None:
+            return
+        self._host_fp = tuple(host_fp)
+        self._captured = True
+
     # -- protocol --------------------------------------------------------
     def begin(self, step: int, attempt: int = 0,
               expect_peers: bool = True) -> None:
@@ -227,6 +245,7 @@ class SDCGuard:
         self._armed = True
         self._captured = False
         self._device_fp = None
+        self._host_fp = None
         self._last_digest = None
 
     def _record_path(self, rank: int, step: int, attempt: int) -> str:
@@ -303,7 +322,8 @@ class SDCGuard:
         if not self.enabled or not self._armed:
             return None
         self._armed = False
-        if not self._captured or self._device_fp is None:
+        if not self._captured or (self._device_fp is None
+                                  and self._host_fp is None):
             # the step never reached optimizer.step (AMP skip, pure
             # eval) — rank-consistent by PR-2's all-reduced found_inf,
             # so every peer posts the same "skipped" record
@@ -311,7 +331,11 @@ class SDCGuard:
             self._post(None, None)
             self._last_digest = None
         else:
-            host_fp = numerics.fingerprint_to_host(self._device_fp)
+            if self._host_fp is not None:     # fed by the compiled step
+                host_fp = self._host_fp
+                self._host_fp = None
+            else:
+                host_fp = numerics.fingerprint_to_host(self._device_fp)
             self._device_fp = None
             self._last_digest = digest_fingerprint(host_fp)
             self._post(self._last_digest, host_fp[2])

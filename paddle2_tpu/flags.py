@@ -100,8 +100,28 @@ def _apply_compilation_cache(path: str) -> None:
     # empty REALLY disables (clears a previously-set directory)
     jax.config.update("jax_compilation_cache_dir", path or None)
     if path:
+        # min compile time gates what is worth persisting; the elastic
+        # restart path (and tests) override via env — a respawned
+        # worker wants EVERY train-step executable cached, since each
+        # one is pure MTTR on the next recovery
+        min_s = float(os.environ.get("PADDLE2_TPU_CACHE_MIN_COMPILE_S",
+                                     "1.0"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          1.0)
+                          min_s)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+        except Exception:
+            pass
+    # the in-process cache singleton latches its configuration on first
+    # compile: without a reset, enabling the directory AFTER anything
+    # has compiled (the elastic restart path re-enables it at resume
+    # time) would silently leave the persistent cache off
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:
+        pass
 
 
 define_flag("compilation_cache_dir", os.environ.get(

@@ -61,9 +61,45 @@ class TrainStepProgram:
       variants (accumulate / apply) share the cache entry.
     """
 
-    def __init__(self, fn: Callable, optimizer, layers: Sequence = ()):
+    def __init__(self, fn: Callable, optimizer, layers: Sequence = (),
+                 instrument: bool = False):
         self.fn = fn
         self.optimizer = optimizer
+        # instrument=True fuses the reliability plane INTO the donated
+        # executable: the program additionally returns ONE packed
+        # uint32[4] auxiliary output (non-finite count + SDC
+        # fingerprint triple over the gradients the update consumed,
+        # numerics.packed_step_sentinel) stashed on `self.last_aux` —
+        # never read here, so the clean path pays zero extra host
+        # syncs; the ReliableTrainStep wrapper decides when (and
+        # whether) to pay the single packed readback
+        self._instrument = bool(instrument)
+        # optional GradScaler (set by the reliability wrapper): the
+        # program scales the loss and unscales the grads IN-PROGRAM
+        # (scale rides in as a runtime scalar — no recompile when it
+        # moves) and makes the fused update conditional on the packed
+        # found_inf lane, so an overflow step is skipped inside the
+        # executable exactly like eager GradScaler.step would
+        self._scaler = None
+        self.last_aux = None
+        # compile/MTTR accounting (instrumented path): wall time of the
+        # most recent build+first-execution of a NEW cache entry, and
+        # whether the persistent XLA cache served it (None = no fresh
+        # build happened on the last call / no cache dir configured)
+        self.last_build_s: Optional[float] = None
+        self.last_build_cache_hit: Optional[bool] = None
+        # bench hook: when set, a fresh build also runs XLA
+        # cost_analysis on the lowered program (deterministic op
+        # accounting — no wall clock) into last_cost_flops
+        self.collect_cost = False
+        self.last_cost_flops: Optional[float] = None
+        # pure-function fault hook threaded through the builder (the
+        # chaos drill's seam into the jitted step): a callable polled
+        # once per dispatch returning None or a hashable spec for
+        # chaos.apply_compiled_grad_fault. Per-PROGRAM, so an
+        # in-process multi-replica drill can corrupt one replica while
+        # the env-gated FLAGS_chaos path serves real gangs
+        self.grad_fault_hook: Optional[Callable] = None
         # unwrap the wrapper chain down to the plain Optimizer that owns
         # update math and state storage
         self._accum_k = 1
@@ -159,14 +195,36 @@ class TrainStepProgram:
                         for a, p in zip(self._accum_buffers, opt_params)]
         accum = self._accum_buffers if k > 1 else []
 
+        # instrumented extras decided PER DISPATCH: a firing chaos drill
+        # compiles a one-off variant (the spec keys the cache); the
+        # clean path sees a single module-attribute check
+        fault = None
+        has_scaler = False
+        if self._instrument:
+            from ..distributed.fault_tolerance import chaos as _chaos
+            has_scaler = (self._scaler is not None
+                          and self._scaler.is_enable())
+            if self.grad_fault_hook is not None:
+                fault = self.grad_fault_hook()
+            if fault is None:
+                fault = _chaos.compiled_grad_fault(amp=has_scaler)
+            if has_scaler and k > 1:
+                raise NotImplementedError(
+                    "jit.train_step: GradScaler inside an instrumented "
+                    "gradient-accumulation step is not supported — "
+                    "run AMP without dist.shard_optimizer accumulation")
+
         key = _guard_key(template, arg_arrays, self.layers) + (
             len(opt_params), need_clip, decay_flags, donate, k,
-            apply_update, self._accum_avg)
+            apply_update, self._accum_avg, self._instrument,
+            has_scaler, fault)
         entry = self._compiled.get(key)
-        if entry is None:
+        built_now = entry is None
+        if built_now:
             entry = self._build(template, opt_params, frozen, buffers,
                                 need_clip, decay_flags, donate,
-                                apply_update, states, accum)
+                                apply_update, states, accum,
+                                has_scaler, fault)
             self._compiled[key] = entry
 
         if apply_update:
@@ -175,12 +233,33 @@ class TrainStepProgram:
         step_no = jnp.asarray(max(1, opt._step_count), jnp.int32)
         rng_key = fr.next_key()
 
-        loss, new_params, new_states, post_buffers, new_accum = entry(
+        call_args = (
             [p._data for p in opt_params],
             states,
             [p._data for p in frozen],
             [b._data for b in buffers],
             arg_arrays, rng_key, lr, step_no, accum)
+        if self._instrument:
+            scale = jnp.asarray(
+                self._scaler.get_loss_scaling() if has_scaler else 1.0,
+                jnp.float32)
+            call_args = call_args + (scale,)
+
+        self.last_build_s = None
+        self.last_build_cache_hit = None
+        if built_now and self.collect_cost:
+            self.last_cost_flops = _entry_flops(entry, call_args)
+        if built_now and self._instrument:
+            out = self._timed_first_call(entry, call_args)
+        else:
+            out = entry(*call_args)
+
+        if self._instrument:
+            (loss, aux, new_params, new_states, post_buffers,
+             new_accum) = out
+            self.last_aux = aux
+        else:
+            loss, new_params, new_states, post_buffers, new_accum = out
 
         for p, a in zip(opt_params, new_params):
             p._replace_data(a)
@@ -192,10 +271,67 @@ class TrainStepProgram:
             self._accum_buffers = list(new_accum)
         return Tensor(loss, stop_gradient=True)
 
+    def _timed_first_call(self, entry, call_args):
+        """Execute a FRESHLY BUILT entry blocking, timing compile +
+        first step — the span that is pure MTTR on every respawn — and
+        detect whether the persistent XLA cache served the executable.
+        Hit detection listens to the compiler's own CACHE HIT/MISS log
+        records during the call: counting cache FILES would misreport a
+        sub-threshold compile (below
+        ``jax_persistent_cache_min_compile_time_secs`` nothing is
+        written, so "no new file" does NOT mean "served from cache").
+        Only the instrumented path pays this (one blocking step per new
+        program variant); steady state never re-enters."""
+        import logging
+        import time as _time
+        from ..flags import flag_value
+        cache_dir = str(flag_value("compilation_cache_dir") or "")
+        tally = {"hit": 0, "miss": 0}
+
+        class _CacheTap(logging.Handler):
+            def emit(self, record):
+                try:
+                    msg = record.getMessage()
+                except Exception:
+                    return
+                # jax logs the miss ALL-CAPS and the hit sentence-case
+                # (jax/_src/compiler.py) — match case-insensitively so
+                # a style change in either doesn't blind the tap
+                low = msg.lower()
+                if "persistent compilation cache hit" in low:
+                    tally["hit"] += 1
+                elif "persistent compilation cache miss" in low:
+                    tally["miss"] += 1
+
+        logger = logging.getLogger("jax._src.compiler")
+        tap = _CacheTap(level=logging.DEBUG)
+        prev_level = logger.level
+        if cache_dir:
+            logger.addHandler(tap)
+            if not logger.isEnabledFor(logging.DEBUG):
+                logger.setLevel(logging.DEBUG)
+        try:
+            t0 = _time.perf_counter()
+            out = entry(*call_args)
+            jax.block_until_ready(out)
+            self.last_build_s = _time.perf_counter() - t0
+        finally:
+            if cache_dir:
+                logger.removeHandler(tap)
+                logger.setLevel(prev_level)
+        if cache_dir and (tally["hit"] or tally["miss"]):
+            self.last_build_cache_hit = tally["miss"] == 0
+        # else: compiler logged nothing (cache off for this backend, or
+        # log plumbing changed) — leave None, "unknown" must never be
+        # reported as a hit
+        return out
+
     def _build(self, template, opt_params, frozen, buffers, need_clip,
-               decay_flags, donate, apply_update, states, accum):
+               decay_flags, donate, apply_update, states, accum,
+               has_scaler=False, fault=None):
         fn = self.fn
         k, avg = self._accum_k, self._accum_avg
+        instrument = self._instrument
         update = self.inner_optimizer._build_update(need_clip, decay_flags)
         state_tensors = list(opt_params) + list(frozen) + list(buffers)
 
@@ -243,6 +379,92 @@ class TrainStepProgram:
                                             states, lr, step_no)
             return loss, new_params, new_states, post_buffers, new_accum
 
+        def pure_step_instrumented(param_arrays, states, frozen_arrays,
+                                   buffer_arrays, arg_arrays, rng_key,
+                                   lr, step_no, accum, loss_scale):
+            """The reliability plane fused into the donated executable:
+            AMP loss scale/unscale, injected chaos faults, the
+            non-finite sentinel and the SDC fingerprint all become part
+            of THIS program — one dispatch, one packed uint32[4] aux
+            output, no extra host round-trips on the clean path."""
+            from ..distributed.fault_tolerance import chaos as _chaos
+            from ..distributed.fault_tolerance import numerics as _num
+
+            def loss_of(p_arrays):
+                loss, post_b = run_model(p_arrays, frozen_arrays,
+                                         buffer_arrays, arg_arrays,
+                                         rng_key)
+                l32 = loss.astype(jnp.float32)
+                scaled = l32 * loss_scale if has_scaler else l32
+                return scaled, (l32, post_b)
+            (_, (loss, post_buffers)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(list(param_arrays))
+            if has_scaler:
+                # fused unscale-and-check: the eager GradScaler's
+                # unscale_ multiply, traced into the step (the sentinel
+                # below then sees the UNSCALED f32 values, matching
+                # numerics.grads_nonfinite_flag(optimizer, inv))
+                inv = 1.0 / loss_scale
+                grads = [(g.astype(jnp.float32) * inv).astype(g.dtype)
+                         for g in grads]
+            # chaos parity: flip_bits:grads / poison_grads land INSIDE
+            # the jitted step (pure transform, baked per firing call)
+            grads = _chaos.apply_compiled_grad_fault(fault, grads)
+
+            def sentinel(gs):
+                aux = _num.packed_step_sentinel(gs)
+                return (jnp.zeros((4,), jnp.uint32) if aux is None
+                        else aux)
+
+            def guard_loss(l, aux):
+                # fold the grad sentinel into the loss so the wrapper's
+                # DEFERRED loss check (free — the loss materializes for
+                # logging anyway) sees grad corruption with zero extra
+                # readbacks. With a scaler the flag means "skip", not
+                # "retry": the update below absorbs it instead.
+                if has_scaler:
+                    return l
+                return jnp.where(aux[0] > 0, jnp.full_like(l, jnp.nan),
+                                 l)
+            if k > 1:
+                totals = [a + g.astype(jnp.float32)
+                          for a, g in zip(accum, grads)]
+                if not apply_update:
+                    # microstep: fingerprint THIS microstep's grads (the
+                    # contribution being banked — what replicas must
+                    # agree on) and bank them untouched
+                    aux = sentinel(grads)
+                    return (guard_loss(loss, aux), aux,
+                            list(param_arrays), states, post_buffers,
+                            totals)
+                scale = 1.0 / k if avg else 1.0
+                grads = [(t * scale).astype(g.dtype)
+                         for t, g in zip(totals, grads)]
+                new_accum = [jnp.zeros_like(a) for a in accum]
+            else:
+                new_accum = []
+            # sentinel + fingerprint over the grads the update CONSUMES
+            # (post-unscale, post-fold) — the same capture point as
+            # SDCGuard's wrapped optimizer.step on the eager path
+            aux = sentinel(grads)
+            new_params, new_states = update(list(param_arrays), grads,
+                                            states, lr, step_no)
+            if has_scaler:
+                # in-program skip: non-finite grads keep params/states
+                # bit-identical (eager GradScaler.step's "don't step"),
+                # decided on device — the host learns from the packed
+                # flag, deferred, without a second readback
+                found = aux[0] > 0
+
+                def keep(new, old):
+                    return jnp.where(found, old, new)
+                new_params = [keep(n, o) for n, o
+                              in zip(new_params, list(param_arrays))]
+                new_states = jax.tree_util.tree_map(keep, new_states,
+                                                    states)
+            return (guard_loss(loss, aux), aux, new_params, new_states,
+                    post_buffers, new_accum)
+
         out_shardings = None
         if self._zero is not None:
             # pin the ZeRO placements across steps: without this, GSPMD
@@ -256,13 +478,30 @@ class TrainStepProgram:
                 None,
                 [sh(a) for a in accum] if accum else [],
             )
-        return jax.jit(pure_step,
+            if instrument:
+                out_shardings = (out_shardings[0], None) + out_shardings[1:]
+        return jax.jit(pure_step_instrumented if instrument else pure_step,
                        donate_argnums=(0, 1, 3, 8) if donate else (),
                        out_shardings=out_shardings)
 
 
-def train_step(fn: Callable, optimizer, layers: Optional[Sequence] = None
-               ) -> TrainStepProgram:
+def _entry_flops(entry, call_args) -> Optional[float]:
+    """Deterministic op accounting of one compiled entry: XLA
+    cost_analysis FLOPs from the lowered program — no wall clock, so
+    ``bench.py --reliable-step`` can gate instrumentation overhead as
+    ops-added x count instead of noisy A/B timing."""
+    try:
+        lowered = entry.lower(*call_args)
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float((ca or {}).get("flops", 0.0))
+    except Exception:
+        return None
+
+
+def train_step(fn: Callable, optimizer, layers: Optional[Sequence] = None,
+               reliability: Any = None):
     """Compile `fn` (returning a scalar loss) plus `optimizer`'s update
     into one donated XLA executable. Layers are discovered from `fn`'s
     closure/globals like `to_static` when not given explicitly.
@@ -272,8 +511,36 @@ def train_step(fn: Callable, optimizer, layers: Optional[Sequence] = None
     nesting) — wrapper policies are folded INTO the donated executable:
     ZeRO as buffer placements + pinned out_shardings, accumulation as a
     donated f32 grad bank with a k-th-call fused update. Unknown wrapper
-    types raise."""
+    types raise.
+
+    ``reliability`` folds the fault-tolerance plane INTO the compiled
+    step and returns a
+    :class:`~paddle2_tpu.distributed.fault_tolerance.compiled_step.ReliableTrainStep`
+    instead: the non-finite sentinel and the SDC gradient fingerprint
+    are computed inside the donated executable (one packed aux output,
+    zero extra host readbacks on the clean path), snapshots are
+    scheduled donation-safely before each submit, and ReliableStep's
+    rewind+replay, flight-recorder events, buddy replication, and
+    quarantine self-eviction all apply to the compiled program. Pass
+    ``True`` for defaults, a
+    :class:`~paddle2_tpu.distributed.fault_tolerance.compiled_step.ReliabilityConfig`,
+    or a dict of its kwargs."""
     if layers is None:
         from .api import _discover_layers
         layers = _discover_layers(fn)
-    return TrainStepProgram(fn, optimizer, layers)
+    if reliability is None or reliability is False:
+        return TrainStepProgram(fn, optimizer, layers)
+    from ..distributed.fault_tolerance.compiled_step import (
+        ReliabilityConfig, ReliableTrainStep)
+    if reliability is True:
+        config = ReliabilityConfig()
+    elif isinstance(reliability, dict):
+        config = ReliabilityConfig(**reliability)
+    elif isinstance(reliability, ReliabilityConfig):
+        config = reliability
+    else:
+        raise TypeError(
+            "reliability must be True, a ReliabilityConfig, or a dict "
+            f"of its kwargs; got {type(reliability).__name__}")
+    program = TrainStepProgram(fn, optimizer, layers, instrument=True)
+    return ReliableTrainStep(program, config)

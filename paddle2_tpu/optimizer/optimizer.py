@@ -232,11 +232,18 @@ class Optimizer:
                 key = p.name or f"param_{idx}"
                 if key in state_dict:
                     # copy on load: the restored arrays become donation
-                    # candidates, which must not delete the caller's data
+                    # candidates, which must not delete the caller's
+                    # data. The numpy branch must copy EXPLICITLY too —
+                    # jnp.asarray may alias a suitably-aligned host
+                    # buffer on the CPU backend, and a donated alias of
+                    # a rollback snapshot frees the snapshot itself (a
+                    # second restore of the same step would then read
+                    # freed memory)
                     self._states[id(p)] = jax.tree_util.tree_map(
                         lambda a: jnp.array(a._data, copy=True)
                         if isinstance(a, Tensor)
-                        else jnp.asarray(a) if isinstance(a, np.ndarray) else a,
+                        else jnp.array(a, copy=True)
+                        if isinstance(a, np.ndarray) else a,
                         state_dict[key])
                 else:
                     # the snapshot predates this param's lazily-created
