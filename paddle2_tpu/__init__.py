@@ -59,6 +59,7 @@ from .nn.layer.layers import ParamAttr  # noqa: F401
 from . import incubate  # noqa: F401
 from . import static  # noqa: F401
 from . import profiler  # noqa: F401
+from . import observability  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import sparse  # noqa: F401

@@ -157,8 +157,10 @@ class GradScaler:
         # exactly the events a post-mortem needs to see (a rank whose
         # scale diverged from its peers skipped different steps)
         from ..distributed.fault_tolerance import flight_recorder
+        from ..observability import metrics as _metrics
         prev_scale = self._scale
         if self._cycle_found_inf:
+            _metrics.inc("amp_skipped_steps_total")
             self._consecutive_skips += 1
             if self._consecutive_skips >= self._max_consecutive_skips:
                 flight_recorder.record(
@@ -195,6 +197,7 @@ class GradScaler:
                     prev_scale=prev_scale, consecutive_skips=0)
         self._opt_state.clear()
         self._cycle_found_inf = False
+        _metrics.set_gauge("amp_loss_scale", self._scale)
 
     def state_dict(self) -> Dict:
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,

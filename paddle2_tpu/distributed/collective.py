@@ -42,6 +42,15 @@ from .fault_tolerance import flight_recorder as _flight
 # dispatch (silent-data-corruption drills); same one-attribute-load
 # clean-path contract as the flight hook.
 from .fault_tolerance import chaos as _chaos
+# metrics plane: every dispatched collective accrues wall time to the
+# step window's "collective" component and bumps the bytes/count
+# counters the cost model and perf_doctor read (one _metered() site
+# rule for every dispatch path). One attribute load per collective
+# when the plane is off.
+from contextlib import contextmanager as _contextmanager
+from contextlib import nullcontext
+
+from ..observability import metrics as _metrics
 
 P = PartitionSpec
 
@@ -358,7 +367,50 @@ def _mp_group_guard(group: Optional["Group"]) -> None:
             "axis-aligned sub-groups are a single-controller feature")
 
 
+# Shared no-op span for dispatch sites whose body can't early-return
+# (e.g. the all_gather list form): `with _NO_METER if off else
+# _metered(...)` keeps the off path at one attribute load — the
+# conditional never evaluates _metered's arguments, so no generator or
+# axes-string is built.
+_NO_METER = nullcontext()
+
+
+@_contextmanager
+def _metered(kind: str, t: Tensor, axes: str, rank_major: bool = False):
+    """THE metering rule for every eager collective dispatch site:
+    count the op, charge the PER-RANK payload bytes (controller-mode
+    invariant — ``cost_model.wire_bytes`` multiplies the group effect
+    back in), and accrue the span to the step window's "collective"
+    component. ``rank_major`` payloads carry the mesh world size as
+    dim 0 (``_check_rank_major``), so the per-rank slice divides by
+    ``shape[0]`` — NOT the group size: a subgroup collective still
+    moves a rank-major [W, ...] tensor."""
+    pl = _metrics._ACTIVE
+    if pl is None:
+        yield
+        return
+    nbytes = float(getattr(t._data, "nbytes", 0))
+    if rank_major:
+        shape = getattr(t._data, "shape", None)
+        if shape:
+            nbytes /= max(int(shape[0]), 1)
+    pl.inc("collectives_total", op=kind)
+    pl.inc("collective_bytes_total", nbytes, op=kind, axes=axes)
+    pl.phase_enter("collective")
+    try:
+        yield
+    finally:
+        pl.phase_exit()
+
+
 def _run_process_level(kind: str, t: Tensor, extra=()) -> Tensor:
+    if _metrics._ACTIVE is None:   # one attribute load on the off path
+        return _run_process_level_impl(kind, t, extra=extra)
+    with _metered(kind, t, "process"):
+        return _run_process_level_impl(kind, t, extra=extra)
+
+
+def _run_process_level_impl(kind: str, t: Tensor, extra=()) -> Tensor:
     """Multi-process (multi-controller) collectives: each PROCESS passes
     its own local tensor and the group ranks are processes — the
     reference's ProcessGroup semantics (process_group.h:48). Built on
@@ -438,6 +490,15 @@ def _group_desc(group: Optional[Group]) -> str:
 
 def _run(kind: str, t: Tensor, group: Optional[Group], extra=(),
          timeout: Optional[float] = None) -> Tensor:
+    if _metrics._ACTIVE is None:   # one attribute load on the off path
+        return _run_impl(kind, t, group, extra=extra, timeout=timeout)
+    g = group if group is not None else _world_group()
+    with _metered(kind, t, "x".join(g.axes), rank_major=True):
+        return _run_impl(kind, t, group, extra=extra, timeout=timeout)
+
+
+def _run_impl(kind: str, t: Tensor, group: Optional[Group], extra=(),
+              timeout: Optional[float] = None) -> Tensor:
     _check_rank_major(t, group)
     arr = t._data
     if _chaos._ACTIVE is not None:
@@ -496,10 +557,18 @@ def _deadline_process_level(kind: str, t: Tensor, extra=(),
     shadow = Tensor(t._data)
 
     def _dispatch():
-        return _run_process_level(kind, shadow, extra=extra)
+        # UN-metered impl: this closure runs on the deadline helper
+        # thread, and run_with_deadline requires late completion to be
+        # side-effect-free — an abandoned thread's phase_exit would pop
+        # whatever frame the caller opened since. Metering happens on
+        # the caller thread, around the deadline wait, below.
+        return _run_process_level_impl(kind, shadow, extra=extra)
 
-    out = run_with_deadline(kind, _dispatch, float(timeout),
-                            group_desc=f"processes={jax.process_count()}")
+    with (_NO_METER if _metrics._ACTIVE is None
+          else _metered(kind, t, "process")):
+        out = run_with_deadline(
+            kind, _dispatch, float(timeout),
+            group_desc=f"processes={jax.process_count()}")
     t._replace_data(out._data)
     return t
 
@@ -525,21 +594,26 @@ def all_gather(tensor_or_list, tensor: Optional[Tensor] = None,
         out_list, t = tensor_or_list, tensor
         if _multiprocess():
             _mp_group_guard(group)
-            from jax.experimental import multihost_utils as mhu
-            g = mhu.process_allgather(np.asarray(t._data))
+            with (_NO_METER if _metrics._ACTIVE is None
+                  else _metered("all_gather", t, "process")):
+                from jax.experimental import multihost_utils as mhu
+                g = mhu.process_allgather(np.asarray(t._data))
             out_list.extend(Tensor(jnp.asarray(row)) for row in g)
             return _Task()
         _check_rank_major(t, group)
         g = group if group is not None else _world_group()
-        arr = t._data
-        scalar_per_rank = arr.ndim == 1
-        if scalar_per_rank:
-            arr = arr[:, None]
-        fn = _kernel("all_gather", _axes(group),
-                     jax.ShapeDtypeStruct(arr.shape, arr.dtype))
-        out = fn(_to_mesh(arr))  # [W, G*S0, ...]
-        from .watchdog import watch as _watch
-        _watch("all_gather", out)
+        with (_NO_METER if _metrics._ACTIVE is None
+              else _metered("all_gather", t, "x".join(g.axes),
+                            rank_major=True)):
+            arr = t._data
+            scalar_per_rank = arr.ndim == 1
+            if scalar_per_rank:
+                arr = arr[:, None]
+            fn = _kernel("all_gather", _axes(group),
+                         jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+            out = fn(_to_mesh(arr))  # [W, G*S0, ...]
+            from .watchdog import watch as _watch
+            _watch("all_gather", out)
         s0 = arr.shape[1]
         for i in range(g.nranks):
             block = out[:, i * s0:(i + 1) * s0]

@@ -264,6 +264,11 @@ class ReliableTrainStep(ReliableStep):
             return
         self.program.last_build_s = None
         hit = self.program.last_build_cache_hit
+        from ...observability import metrics
+        metrics.inc("compiles_total")
+        if hit:
+            metrics.inc("compile_cache_hits_total")
+        metrics.observe("compile_seconds", secs)
         flight_recorder.record("compile", seconds=round(secs, 4),
                                cache_hit=hit)
         flight_recorder.append_elastic_event(

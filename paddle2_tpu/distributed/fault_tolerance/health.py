@@ -109,6 +109,8 @@ class QuarantineStore:
         with open(tmp, "w") as f:
             json.dump(rec, f)
         os.replace(tmp, path)
+        from ...observability import metrics as _metrics
+        _metrics.inc("quarantines_total", reason=reason)
         flight_recorder.record("health.quarantine", host=host,
                                reason=reason, rank=rank)
         return path

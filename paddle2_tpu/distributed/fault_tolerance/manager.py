@@ -193,6 +193,9 @@ class CheckpointManager:
         prune run on rank 0 only."""
         path = self._dir(step)
         flight_recorder.record("checkpoint_save_begin", step=step)
+        from ...observability import metrics as _metrics
+        import time as _time
+        t0 = _time.perf_counter()
         try:
             save_state_dict(state_dict, path)
             if self._stateful:
@@ -203,6 +206,7 @@ class CheckpointManager:
             verify_checkpoint(path)
             flight_recorder.record("checkpoint_verified", step=step)
         except (CheckpointCorruptionError, OSError, ValueError) as e:
+            _metrics.inc("checkpoint_save_failures_total")
             flight_recorder.record("checkpoint_save_failed", step=step,
                                    error=str(e)[:300])
             try:
@@ -220,6 +224,9 @@ class CheckpointManager:
             self._commit_latest(step)
             self._prune()
             flight_recorder.record("checkpoint_committed", step=step)
+        _metrics.inc("checkpoint_saves_total")
+        _metrics.observe("checkpoint_save_seconds",
+                         _time.perf_counter() - t0)
         return path
 
     def restore(self, state_dict: Dict[str, Any]) -> Optional[int]:
@@ -236,6 +243,9 @@ class CheckpointManager:
         on-disk content (identical across ranks); if your filesystem
         serves torn reads, verify on rank 0 and broadcast the chosen
         step before calling restore."""
+        from ...observability import metrics as _metrics
+        import time as _time
+        t0 = _time.perf_counter()
         candidates = sorted(set(self.steps()), reverse=True)
         pointed = self.latest_step()
         if pointed is not None and pointed in candidates:
@@ -256,8 +266,12 @@ class CheckpointManager:
                         self._commit_latest(step)
                 flight_recorder.record("checkpoint_restored", step=step,
                                        rolled_back=step != pointed)
+                _metrics.inc("checkpoint_restores_total")
+                _metrics.observe("checkpoint_restore_seconds",
+                                 _time.perf_counter() - t0)
                 return step
             except (CheckpointCorruptionError, OSError, ValueError) as e:
+                _metrics.inc("checkpoint_restore_failures_total")
                 flight_recorder.record("checkpoint_restore_failed",
                                        step=step, error=str(e)[:300])
                 print(f"[fault_tolerance] checkpoint step {step} failed "

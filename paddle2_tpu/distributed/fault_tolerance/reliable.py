@@ -182,6 +182,8 @@ class ReliableStep:
             _assert_host_snapshot(self._snapshot)
         self._snapshot_step = self._step
         self.stats["snapshots"] += 1
+        from ...observability import metrics as _metrics
+        _metrics.inc("reliability_snapshots_total")
         if self._replicator is not None:
             try:
                 self._replicator.put(list(self._snapshot),
@@ -268,6 +270,8 @@ class ReliableStep:
         for holder, state in zip(self._holders, self._snapshot):
             _apply_state(holder, state)
         self.stats["restores"] += 1
+        from ...observability import metrics as _metrics
+        _metrics.inc("reliability_restores_total")
 
     # -- failure plumbing ------------------------------------------------
     def _watchdog_timed_out(self) -> bool:
@@ -307,6 +311,8 @@ class ReliableStep:
                     f"retry budget ({self.retry_budget}) exhausted at "
                     f"step {step_no}: {last}")
             self.stats["retries"] += 1
+            from ...observability import metrics as _metrics
+            _metrics.inc("step_retries_total")
             flight_recorder.record(
                 "step_retry", step=step_no, attempt=attempt + 1,
                 error=str(last)[:300] if last is not None else None)

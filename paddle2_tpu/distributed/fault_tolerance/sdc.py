@@ -362,6 +362,10 @@ class SDCGuard:
             return                         # replicas agree bitwise
         _majority, suspects = vote(digests)
         self.stats["mismatches"] += 1
+        from ...observability import metrics as _metrics
+        _metrics.inc("sdc_mismatches_total")
+        if suspects:
+            _metrics.inc("sdc_convictions_total", len(suspects))
         flight_recorder.record(
             "sdc.fingerprint_mismatch", step=self._step,
             attempt=self._attempt, suspects=list(suspects),

@@ -97,6 +97,14 @@ def _parse(argv: Optional[List[str]] = None):
                         "step is pure MTTR on every respawn/rescale, "
                         "and a warm cache turns the recovery recompile "
                         "into a cache read ('none' disables)")
+    p.add_argument("--metrics_dir", default=None,
+                   help="always-on metrics plane directory forwarded "
+                        "to workers as PADDLE_METRICS_DIR: every rank "
+                        "streams metrics_rank_N.jsonl (step-time "
+                        "breakdown, tokens/s, reliability counters) "
+                        "that `python -m paddle2_tpu.tools."
+                        "perf_doctor` reads; an existing "
+                        "PADDLE_METRICS_DIR in the operator env wins")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -235,6 +243,11 @@ def _worker_env(args, local_rank: int, generation: int = 0) -> dict:
     if cache and "PADDLE2_TPU_CACHE_DIR" not in os.environ \
             and "FLAGS_compilation_cache_dir" not in os.environ:
         env["PADDLE2_TPU_CACHE_DIR"] = cache
+    if args.metrics_dir and "PADDLE_METRICS_DIR" not in os.environ:
+        # workers auto-enable on import (PADDLE_TRAINER_ID guard);
+        # an operator-exported PADDLE_METRICS_DIR wins, same
+        # precedence as the compile cache above
+        env["PADDLE_METRICS_DIR"] = args.metrics_dir
     if args.master:
         env.update({
             "PADDLE_MASTER": args.master,

@@ -35,6 +35,30 @@ def _tensorize(batch):
     return out
 
 
+def _metered_iter(loader):
+    """Iterate ``loader`` attributing blocking time to the metrics
+    plane's "input" phase — the input-wait component of the step-time
+    breakdown. Zero-overhead passthrough when the plane is off."""
+    from ..observability import metrics as _metrics
+    it = iter(loader)
+    while True:
+        pl = _metrics._ACTIVE
+        if pl is None:
+            try:
+                yield next(it)
+            except StopIteration:
+                return
+            continue
+        pl.phase_enter("input")
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        finally:
+            pl.phase_exit()
+        yield batch
+
+
 class Model:
     """hapi/model.py:918 parity: wraps a Layer with train/eval/predict
     loops, metric bookkeeping, and checkpoint save/load."""
@@ -70,23 +94,36 @@ class Model:
         ins = _tensorize(inputs)
         lbs = _tensorize(labels)
         from ..distributed.fault_tolerance import numerics
-        if numerics.debug_anomaly_enabled():
-            # opt-in bisection: raises AnomalyDetected naming the first
-            # sublayer whose output goes non-finite
-            with numerics.debug_anomaly(self.network):
+        from ..observability import metrics as _obs
+        pl = _obs._ACTIVE
+        if pl is not None:
+            pl.phase_enter("compute")
+        try:
+            if numerics.debug_anomaly_enabled():
+                # opt-in bisection: raises AnomalyDetected naming the
+                # first sublayer whose output goes non-finite
+                with numerics.debug_anomaly(self.network):
+                    outs = self.network(*ins)
+            else:
                 outs = self.network(*ins)
-        else:
-            outs = self.network(*ins)
-        losses = self._compute_loss(outs, lbs)
-        total = losses[0]
-        for l in losses[1:]:
-            total = total + l
-        total.backward()
-        if update:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+            losses = self._compute_loss(outs, lbs)
+            total = losses[0]
+            for l in losses[1:]:
+                total = total + l
+            total.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        finally:
+            if pl is not None:
+                pl.phase_exit()
         metrics = self._update_metrics(outs, lbs)
         loss_vals = [float(np.asarray(l.numpy())) for l in losses]
+        if pl is not None:
+            samples = int(ins[0].shape[0]) if ins and ins[0].shape \
+                else None
+            pl.step_end(samples=samples,
+                        loss=loss_vals[0] if loss_vals else None)
         return (loss_vals, metrics) if metrics else loss_vals
 
     def eval_batch(self, inputs, labels=None):
@@ -201,7 +238,14 @@ class Model:
                 for m in self._metrics:
                     m.reset()
                 logs = {}  # an empty loader must still yield epoch logs
-                for step, batch in enumerate(loader):
+                from ..observability import metrics as _obs
+                pl = _obs._ACTIVE
+                if pl is not None:
+                    # epoch boundary: eval/callback/checkpoint time since
+                    # the previous epoch's last step must not be billed
+                    # to this epoch's first step record
+                    pl.step_window_reset()
+                for step, batch in enumerate(_metered_iter(loader)):
                     cbk.on_train_batch_begin(step)
                     ins, lbs = self._split_batch(batch)
                     # end-of-epoch flush so a trailing partial

@@ -255,6 +255,8 @@ class ShmProcessIter:
         that resubmits the in-flight batches."""
         self._restarts[w] += 1
         from ..distributed.fault_tolerance import flight_recorder
+        from ..observability import metrics as _metrics
+        _metrics.inc("data_worker_respawns_total")
         flight_recorder.record("worker_respawn", worker=w,
                                restarts=self._restarts[w],
                                salvaged=len(self._stash),
@@ -265,6 +267,19 @@ class ShmProcessIter:
         self._procs[w] = self._fork_worker(w)
 
     def __next__(self):
+        # metrics: blocking on the ring is INPUT WAIT in the step-time
+        # breakdown (one attribute load when the plane is off)
+        from ..observability import metrics as _metrics
+        pl = _metrics._ACTIVE
+        if pl is None:
+            return self._next_impl()
+        pl.phase_enter("input")
+        try:
+            return self._next_impl()
+        finally:
+            pl.phase_exit()
+
+    def _next_impl(self):
         if self.next_emit >= len(self.batches):
             self.close()
             self._note_epoch_end()

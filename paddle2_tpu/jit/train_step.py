@@ -40,6 +40,7 @@ import jax.numpy as jnp
 
 from ..framework import random as fr
 from ..framework.tensor import Tensor
+from ..observability import metrics as _metrics
 from .functional import (_collect_state, _guard_key, _rebound_call,
                          _split_tensors, _trace_lock)
 
@@ -90,9 +91,14 @@ class TrainStepProgram:
         self.last_build_cache_hit: Optional[bool] = None
         # bench hook: when set, a fresh build also runs XLA
         # cost_analysis on the lowered program (deterministic op
-        # accounting — no wall clock) into last_cost_flops
+        # accounting — no wall clock) into last_cost_flops, and stashes
+        # the entry + abstract (donation-safe) call args so the
+        # observability cost model can re-lower it later
         self.collect_cost = False
         self.last_cost_flops: Optional[float] = None
+        self.last_cost: Optional[Dict[str, float]] = None
+        self.last_entry = None
+        self.last_abstract_args = None
         # pure-function fault hook threaded through the builder (the
         # chaos drill's seam into the jitted step): a callable polled
         # once per dispatch returning None or a hashable spec for
@@ -247,12 +253,28 @@ class TrainStepProgram:
 
         self.last_build_s = None
         self.last_build_cache_hit = None
-        if built_now and self.collect_cost:
-            self.last_cost_flops = _entry_flops(entry, call_args)
-        if built_now and self._instrument:
-            out = self._timed_first_call(entry, call_args)
-        else:
-            out = entry(*call_args)
+        if built_now:
+            _metrics.inc("train_step_compiles_total")
+            if self.collect_cost:
+                from ..observability import cost_model as _cm
+                self.last_entry = entry
+                self.last_abstract_args = _cm.abstractify(call_args)
+                self.last_cost = _cm.program_cost(
+                    entry, self.last_abstract_args)
+                self.last_cost_flops = (
+                    None if not self.last_cost
+                    else self.last_cost.get("flops"))
+        pl = _metrics._ACTIVE
+        if pl is not None:
+            pl.phase_enter("compute")
+        try:
+            if built_now and self._instrument:
+                out = self._timed_first_call(entry, call_args)
+            else:
+                out = entry(*call_args)
+        finally:
+            if pl is not None:
+                pl.phase_exit()
 
         if self._instrument:
             (loss, aux, new_params, new_states, post_buffers,
@@ -269,7 +291,31 @@ class TrainStepProgram:
             b._replace_data(a)
         if k > 1:
             self._accum_buffers = list(new_accum)
+        if pl is not None:
+            self._note_step_metrics(pl, args_t, has_scaler)
         return Tensor(loss, stop_gradient=True)
+
+    def _note_step_metrics(self, pl, args_t, has_scaler: bool) -> None:
+        """Close this dispatch's step window: tokens/samples inferred
+        from the first batch argument (exactly-2-D SIGNED-int ids ->
+        B*S tokens; uint8 image batches and >2-D int features must not
+        masquerade as token counts), loss scale when AMP is fused,
+        program-cache gauge. Reads NOTHING off the device — host-known
+        values only."""
+        tokens = samples = None
+        if args_t:
+            shp = tuple(args_t[0].shape)
+            if shp:
+                samples = int(shp[0])
+            if (len(shp) == 2
+                    and str(args_t[0].dtype) in
+                    ("int8", "int16", "int32", "int64")):
+                tokens = int(shp[0]) * int(shp[1])
+        scale = (self._scaler.get_loss_scaling()
+                 if has_scaler and self._scaler is not None else None)
+        pl.set_gauge("train_step_program_cache_size",
+                     len(self._compiled))
+        pl.step_end(tokens=tokens, samples=samples, loss_scale=scale)
 
     def _timed_first_call(self, entry, call_args):
         """Execute a FRESHLY BUILT entry blocking, timing compile +
@@ -483,21 +529,6 @@ class TrainStepProgram:
         return jax.jit(pure_step_instrumented if instrument else pure_step,
                        donate_argnums=(0, 1, 3, 8) if donate else (),
                        out_shardings=out_shardings)
-
-
-def _entry_flops(entry, call_args) -> Optional[float]:
-    """Deterministic op accounting of one compiled entry: XLA
-    cost_analysis FLOPs from the lowered program — no wall clock, so
-    ``bench.py --reliable-step`` can gate instrumentation overhead as
-    ops-added x count instead of noisy A/B timing."""
-    try:
-        lowered = entry.lower(*call_args)
-        ca = lowered.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        return float((ca or {}).get("flops", 0.0))
-    except Exception:
-        return None
 
 
 def train_step(fn: Callable, optimizer, layers: Optional[Sequence] = None,

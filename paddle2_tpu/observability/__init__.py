@@ -1,0 +1,32 @@
+"""paddle2_tpu.observability — the performance & health observatory.
+
+Three coordinated planes over one training process:
+
+* :mod:`.metrics` — always-on Counter/Gauge/Histogram registry with a
+  per-rank JSONL stream (``PADDLE_METRICS_DIR/metrics_rank_N.jsonl``)
+  and Prometheus textfile export; step-time breakdown via step windows
+  (input / compute / collective / host, summing exactly to the step
+  total);
+* :mod:`.cost_model` — deterministic XLA step-cost accounting (FLOPs,
+  HBM bytes, collective wire traffic under an ICI-vs-DCN link model,
+  MFU, roofline) — the cost x rate gating primitive the perf benches
+  use instead of wall-clock A/B;
+* ``tools/perf_doctor`` (sibling CLI of ``flight_doctor``) — joins the
+  metrics stream with flight rings and merged chrome traces into a
+  triage report, and diffs two streams to name the top regressed
+  component.
+
+The metrics hooks follow the flight recorder's zero-overhead
+discipline: one module-attribute load per site when disabled.
+"""
+
+from . import cost_model, metrics  # noqa: F401
+from .cost_model import (CollectiveTraffic, LinkModel, StepCost,  # noqa: F401
+                         chip_peak, program_cost, wire_bytes)
+from .metrics import (Counter, Gauge, Histogram, MetricsPlane,  # noqa: F401
+                      METRICS_DIR_ENV)
+
+__all__ = ["metrics", "cost_model", "Counter", "Gauge", "Histogram",
+           "MetricsPlane", "METRICS_DIR_ENV", "CollectiveTraffic",
+           "LinkModel", "StepCost", "chip_peak", "program_cost",
+           "wire_bytes"]

@@ -1,0 +1,346 @@
+"""Deterministic XLA step-cost model: FLOPs, bytes, wire traffic, MFU.
+
+Wall clocks lie in shared sandboxes (and on real pods they conflate the
+thing you changed with whatever the neighbors are doing), so every perf
+gate in this repo is **cost x rate**: deterministic op accounting from
+the compiled program itself, times a hardware rate model. This module
+is the accounting half:
+
+* :func:`program_cost` — XLA ``cost_analysis`` of a lowered executable
+  (FLOPs, bytes accessed, transcendentals). Deterministic: the same
+  program lowers to the same numbers on every run.
+* :func:`wire_bytes` — algorithm bytes-on-wire per rank for each
+  collective kind (ring all_reduce moves ``2(n-1)/n`` of the payload,
+  gather/scatter variants ``(n-1)/n``, ...), the standard bandwidth-
+  optimal-algorithm accounting.
+* :class:`LinkModel` — per-mesh-axis bandwidth: ICI (intra-pod torus
+  links) vs DCN (cross-pod data-center network), because a collective
+  over a DCN-mapped axis is an order of magnitude slower per byte and
+  the sharding-defaults work on ROADMAP item 1 is exactly about keeping
+  heavy collectives off that axis.
+* :class:`CollectiveTraffic` — an accumulator the eager collective path
+  (and hybrid-parallel planners) feed; converts to seconds under a
+  :class:`LinkModel`.
+* :class:`StepCost` — joins program FLOPs + HBM bytes + wire traffic
+  into a roofline (compute- / memory- / network-bound verdict), MFU
+  against the chip peak, and a deterministic step-time lower bound —
+  the gating primitive the pod-scale scaling bench uses instead of
+  wall-clock A/B.
+
+Everything here is jax-optional at import (the ``perf_doctor`` CLI and
+the analytic helpers work anywhere); only :func:`program_cost` touches
+jax, lazily.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# -- hardware rate tables ------------------------------------------------
+# nominal bf16 dense peak per chip (FLOP/s) and HBM bandwidth (B/s),
+# keyed on device_kind substrings; env-overridable for odd deployments
+CHIP_PEAKS: Dict[str, Tuple[float, float]] = {
+    # kind-substring: (peak_flops, hbm_bytes_per_s)
+    "v5 lite": (197e12, 819e9), "v5e": (197e12, 819e9),
+    "v5litepod": (197e12, 819e9),
+    "v4": (275e12, 1228e9), "v5p": (459e12, 2765e9),
+    "v6 lite": (918e12, 1640e9), "v6e": (918e12, 1640e9),
+    "trillium": (918e12, 1640e9),
+}
+_DEFAULT_PEAK = (197e12, 819e9)          # v5e-assumed
+# CPU fallback: a deliberately round nominal figure so MFU numbers off
+# accelerators are obviously synthetic rather than silently wrong
+_CPU_PEAK = (1e11, 5e10)
+
+PEAK_ENV = "PADDLE_PEAK_TFLOPS"
+HBM_ENV = "PADDLE_HBM_GBPS"
+ICI_ENV = "PADDLE_ICI_GBPS"
+DCN_ENV = "PADDLE_DCN_GBPS"
+DCN_AXES_ENV = "PADDLE_DCN_AXES"
+
+# defaults: v4/v5 ICI is ~100 GB/s per link per direction; DCN per host
+# lands around 12.5 GB/s (100 Gbps) — both env-overridable
+_DEFAULT_ICI_GBPS = 90.0
+_DEFAULT_DCN_GBPS = 12.5
+
+
+def chip_peak(device=None) -> Tuple[float, float, str]:
+    """(peak_flops, hbm_bytes_per_s, label) for ``device`` (default:
+    jax device 0; falls back to the CPU nominal figure without jax)."""
+    env_peak = os.environ.get(PEAK_ENV)
+    env_hbm = os.environ.get(HBM_ENV)
+    if env_peak and env_hbm:
+        return (float(env_peak) * 1e12, float(env_hbm) * 1e9,
+                "env-override")
+    kind = ""
+    try:
+        if device is None:
+            import jax
+            device = jax.devices()[0]
+        kind = getattr(device, "device_kind", "") or ""
+        platform = getattr(device, "platform", "").lower()
+    except Exception:
+        platform = "cpu"
+    low = kind.lower()
+    peak, hbm, label = None, None, ""
+    for key, (p, h) in CHIP_PEAKS.items():
+        if key in low:
+            peak, hbm, label = p, h, key
+            break
+    if peak is None:
+        if platform in ("", "cpu"):
+            (peak, hbm), label = \
+                _CPU_PEAK, f"cpu-nominal({low or 'unknown'})"
+        else:
+            (peak, hbm), label = \
+                _DEFAULT_PEAK, f"v5e-assumed({low or 'unknown'})"
+    # each override applies independently (an operator may know only
+    # one of the two figures for an odd deployment)
+    if env_peak:
+        peak, label = float(env_peak) * 1e12, label + "+peak-env"
+    if env_hbm:
+        hbm, label = float(env_hbm) * 1e9, label + "+hbm-env"
+    return peak, hbm, label
+
+
+# -- program accounting --------------------------------------------------
+def cost_analysis_of(lowered) -> Dict[str, float]:
+    """Normalize jax's ``lowered.cost_analysis()`` result (dict, or a
+    per-device list of dicts on older jax) to one flat dict."""
+    ca = lowered.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return {k: float(v) for k, v in (ca or {}).items()
+            if isinstance(v, (int, float))}
+
+
+def program_cost(entry, call_args: Sequence[Any]) -> Optional[Dict[str, float]]:
+    """Deterministic op accounting of one compiled callable: lowers
+    ``entry`` against ``call_args`` (concrete arrays OR
+    ``jax.ShapeDtypeStruct`` avals — donation-safe) and returns XLA
+    ``cost_analysis`` as ``{"flops", "bytes_accessed", ...}``. ``None``
+    when the backend exposes no cost analysis."""
+    try:
+        lowered = entry.lower(*call_args)
+        out = cost_analysis_of(lowered)
+        return out or None
+    except Exception:
+        return None
+
+
+def abstractify(call_args: Sequence[Any]) -> List[Any]:
+    """Shape/dtype skeleton of ``call_args`` — safe to hold across a
+    donating dispatch (the concrete buffers die with the donation) and
+    accepted by ``jit(...).lower``."""
+    import jax
+
+    def _one(a):
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+        return a
+    return jax.tree_util.tree_map(_one, list(call_args))
+
+
+# -- collective traffic --------------------------------------------------
+# bytes-on-wire factor per rank, as a multiple of the per-rank payload,
+# for the bandwidth-optimal algorithm of each collective family
+_WIRE_FACTORS = (
+    ("all_reduce", lambda n: 2.0 * (n - 1) / n),
+    ("reduce_scatter", lambda n: (n - 1) / n),
+    ("all_gather", lambda n: (n - 1) / n),
+    ("all_to_all", lambda n: (n - 1) / n),
+    ("alltoall", lambda n: (n - 1) / n),
+    ("broadcast", lambda n: (n - 1) / n),
+    ("reduce", lambda n: (n - 1) / n),
+    ("scatter", lambda n: (n - 1) / n),
+    ("gather", lambda n: (n - 1) / n),
+    ("ppermute", lambda n: 1.0),
+    ("send", lambda n: 1.0),
+    ("recv", lambda n: 1.0),
+    ("barrier", lambda n: 0.0),
+)
+
+
+def wire_bytes(op: str, payload_bytes: float, group_size: int) -> float:
+    """Per-rank bytes on the wire for one collective: payload x the
+    algorithm factor. ``op`` matches by prefix (``all_reduce_sum`` ->
+    ``all_reduce``). Unknown ops are charged the conservative full
+    payload."""
+    n = max(1, int(group_size))
+    if n == 1:
+        return 0.0
+    for prefix, factor in _WIRE_FACTORS:
+        if op.startswith(prefix):
+            return float(payload_bytes) * factor(n)
+    return float(payload_bytes)
+
+
+class LinkModel:
+    """Per-mesh-axis link bandwidth: ICI unless the axis is named in
+    ``dcn_axes`` (default: any axis whose name contains ``"dcn"``, plus
+    the ``PADDLE_DCN_AXES`` comma list)."""
+
+    def __init__(self, ici_gbps: Optional[float] = None,
+                 dcn_gbps: Optional[float] = None,
+                 dcn_axes: Optional[Iterable[str]] = None):
+        self.ici_bps = float(
+            ici_gbps if ici_gbps is not None
+            else os.environ.get(ICI_ENV, _DEFAULT_ICI_GBPS)) * 1e9
+        self.dcn_bps = float(
+            dcn_gbps if dcn_gbps is not None
+            else os.environ.get(DCN_ENV, _DEFAULT_DCN_GBPS)) * 1e9
+        env_axes = os.environ.get(DCN_AXES_ENV, "")
+        self.dcn_axes = set(a.strip() for a in env_axes.split(",")
+                            if a.strip())
+        if dcn_axes is not None:
+            self.dcn_axes |= set(dcn_axes)
+
+    def is_dcn(self, axis: Optional[str]) -> bool:
+        if axis is None:
+            return False
+        return axis in self.dcn_axes or "dcn" in str(axis).lower()
+
+    def bandwidth(self, axis: Optional[str]) -> float:
+        return self.dcn_bps if self.is_dcn(axis) else self.ici_bps
+
+    def seconds(self, bytes_on_wire: float,
+                axes: Sequence[str] = ()) -> float:
+        """Transfer time under the SLOWEST link the collective crosses
+        (a multi-axis group is gated by its weakest hop)."""
+        if bytes_on_wire <= 0:
+            return 0.0
+        bw = min((self.bandwidth(a) for a in axes),
+                 default=self.ici_bps)
+        return float(bytes_on_wire) / bw
+
+
+class CollectiveTraffic:
+    """Accumulator of per-step collective dispatches -> wire bytes and
+    a deterministic transfer-time estimate."""
+
+    def __init__(self):
+        self.entries: List[Dict[str, Any]] = []
+
+    def add(self, op: str, payload_bytes: float,
+            axes: Sequence[str] = (), group_size: int = 1) -> None:
+        self.entries.append({
+            "op": op, "payload_bytes": float(payload_bytes),
+            "axes": tuple(axes), "group_size": int(group_size),
+            "wire_bytes": wire_bytes(op, payload_bytes, group_size)})
+
+    def wire_bytes_total(self) -> float:
+        return sum(e["wire_bytes"] for e in self.entries)
+
+    def payload_bytes_total(self) -> float:
+        return sum(e["payload_bytes"] for e in self.entries)
+
+    def seconds(self, link: Optional[LinkModel] = None) -> float:
+        link = link or LinkModel()
+        return sum(link.seconds(e["wire_bytes"], e["axes"])
+                   for e in self.entries)
+
+    def by_op(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for e in self.entries:
+            out[e["op"]] = out.get(e["op"], 0.0) + e["wire_bytes"]
+        return out
+
+
+class StepCost:
+    """One compiled step's deterministic cost: program FLOPs + HBM
+    bytes + wire traffic -> roofline verdict, time lower bound, MFU."""
+
+    def __init__(self, flops: float, hbm_bytes: float = 0.0,
+                 traffic: Optional[CollectiveTraffic] = None,
+                 link: Optional[LinkModel] = None,
+                 peak_flops: Optional[float] = None,
+                 hbm_bps: Optional[float] = None):
+        if peak_flops is None or hbm_bps is None:
+            p, h, self.chip = chip_peak()
+            peak_flops = peak_flops if peak_flops is not None else p
+            hbm_bps = hbm_bps if hbm_bps is not None else h
+        else:
+            self.chip = "caller-supplied"
+        self.flops = float(flops)
+        self.hbm_bytes = float(hbm_bytes)
+        self.traffic = traffic or CollectiveTraffic()
+        self.link = link or LinkModel()
+        self.peak_flops = float(peak_flops)
+        self.hbm_bps = float(hbm_bps)
+
+    def compute_s(self) -> float:
+        return self.flops / self.peak_flops if self.peak_flops else 0.0
+
+    def memory_s(self) -> float:
+        return self.hbm_bytes / self.hbm_bps if self.hbm_bps else 0.0
+
+    def network_s(self) -> float:
+        return self.traffic.seconds(self.link)
+
+    def step_time_lower_bound_s(self) -> float:
+        """Perfect-overlap model: the step cannot run faster than its
+        slowest resource."""
+        return max(self.compute_s(), self.memory_s(), self.network_s())
+
+    def bound(self) -> str:
+        times = {"compute": self.compute_s(), "memory": self.memory_s(),
+                 "network": self.network_s()}
+        return max(times, key=times.get)
+
+    def arithmetic_intensity(self) -> Optional[float]:
+        if not self.hbm_bytes:
+            return None
+        return self.flops / self.hbm_bytes
+
+    def ridge_point(self) -> float:
+        """FLOP/byte where the chip flips memory- to compute-bound."""
+        return self.peak_flops / self.hbm_bps if self.hbm_bps else 0.0
+
+    def mfu(self, measured_step_s: float) -> Optional[float]:
+        """Model FLOPs utilization against the chip peak for a measured
+        step time (the ONE place a wall clock enters — supplied by the
+        caller, typically a metrics-plane step record)."""
+        if measured_step_s <= 0 or not self.peak_flops:
+            return None
+        return self.flops / (self.peak_flops * measured_step_s)
+
+    def roofline(self) -> Dict[str, Any]:
+        ai = self.arithmetic_intensity()
+        return {
+            "flops": self.flops,
+            "hbm_bytes": self.hbm_bytes,
+            "wire_bytes": self.traffic.wire_bytes_total(),
+            "compute_s": self.compute_s(),
+            "memory_s": self.memory_s(),
+            "network_s": self.network_s(),
+            "step_time_lower_bound_s": self.step_time_lower_bound_s(),
+            "bound": self.bound(),
+            "arithmetic_intensity": ai,
+            "ridge_point": self.ridge_point(),
+            "chip": self.chip,
+        }
+
+
+def step_cost_of_program(program, link: Optional[LinkModel] = None
+                         ) -> Optional[StepCost]:
+    """Build a :class:`StepCost` from a
+    :class:`~paddle2_tpu.jit.train_step.TrainStepProgram` that ran with
+    ``collect_cost = True`` (its last fresh build stashed the lowered
+    cost analysis and abstract call args)."""
+    entry = getattr(program, "last_entry", None)
+    aargs = getattr(program, "last_abstract_args", None)
+    if entry is None or aargs is None:
+        return None
+    ca = program_cost(entry, aargs)
+    if not ca:
+        return None
+    return StepCost(flops=ca.get("flops", 0.0),
+                    hbm_bytes=ca.get("bytes accessed", 0.0),
+                    link=link)
+
+
+__all__ = ["CHIP_PEAKS", "chip_peak", "cost_analysis_of", "program_cost",
+           "abstractify", "wire_bytes", "LinkModel", "CollectiveTraffic",
+           "StepCost", "step_cost_of_program", "PEAK_ENV", "HBM_ENV",
+           "ICI_ENV", "DCN_ENV", "DCN_AXES_ENV"]

@@ -97,22 +97,59 @@ class _Collector:
 _collector = _Collector()
 
 
+# True while a jax.profiler device trace is running (set by
+# Profiler._sync_device_trace): RecordEvent mirrors its spans into the
+# xprof timeline only when there IS one to land in
+_device_trace_active = False
+
+
 class RecordEvent:
-    """User-annotated span (reference utils.py RecordEvent / the nvtx-range
-    analog). Usable as context manager or begin()/end()."""
+    """User-annotated span (reference utils.py RecordEvent / the
+    nvtx-range analog). Usable as context manager or begin()/end().
+
+    One annotation, three correlated timelines:
+
+    * the host chrome trace (always, when a Profiler is recording);
+    * the xprof device timeline — when a ``jax.profiler`` trace is
+      active the span also opens a ``TraceAnnotation``, so user marks
+      line up against the XLA execution rows in TensorBoard;
+    * the flight-recorder ring — ``user_span`` events carry the name
+      and duration into crash dumps, so a post-mortem can say WHICH
+      phase of the step the gang died in.
+    """
 
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._start: Optional[float] = None
+        self._annotation = None
 
     def begin(self):
+        if _device_trace_active:
+            try:
+                import jax
+                self._annotation = jax.profiler.TraceAnnotation(
+                    self.name)
+                self._annotation.__enter__()
+            except Exception:
+                self._annotation = None
+        from ..distributed.fault_tolerance import flight_recorder
+        flight_recorder.record("user_span_begin", name=self.name)
         self._start = time.perf_counter()
 
     def end(self):
         if self._start is not None:
-            _collector.add(self.name, "user", self._start,
-                           time.perf_counter() - self._start)
+            dur = time.perf_counter() - self._start
+            _collector.add(self.name, "user", self._start, dur)
             self._start = None
+            if self._annotation is not None:
+                try:
+                    self._annotation.__exit__(None, None, None)
+                except Exception:
+                    pass
+                self._annotation = None
+            from ..distributed.fault_tolerance import flight_recorder
+            flight_recorder.record("user_span_end", name=self.name,
+                                   dur_s=round(dur, 6))
 
     def __enter__(self):
         self.begin()
@@ -235,7 +272,10 @@ class Profiler:
 
     def _sync_device_trace(self):
         """xprof tracing follows the scheduler: device capture runs only
-        inside RECORD windows (skip_first/closed steps stay untraced)."""
+        inside RECORD windows (skip_first/closed steps stay untraced).
+        The module-level ``_device_trace_active`` flag tracks the trace
+        state so RecordEvent spans mirror into the xprof timeline."""
+        global _device_trace_active
         if self._timer_only:
             return
         import jax
@@ -246,6 +286,7 @@ class Profiler:
                 self._jax_trace_dir = os.environ.get(
                     "PADDLE2_TPU_XPROF_DIR", "/tmp/paddle2_tpu_xprof")
                 jax.profiler.start_trace(self._jax_trace_dir)
+                _device_trace_active = True
             except Exception:
                 self._jax_trace_dir = None
         elif not want and have:
@@ -254,6 +295,7 @@ class Profiler:
             except Exception:
                 pass
             self._jax_trace_dir = None
+            _device_trace_active = False
 
     def step(self, num_samples: Optional[int] = None):
         now = time.perf_counter()
@@ -269,6 +311,7 @@ class Profiler:
         self._sync_device_trace()
 
     def stop(self):
+        global _device_trace_active
         if self._jax_trace_dir is not None:
             try:
                 import jax
@@ -276,6 +319,7 @@ class Profiler:
             except Exception:
                 pass
             self._jax_trace_dir = None
+            _device_trace_active = False
         self._events = list(_collector.events)
         _collector.enabled = False
         if self._on_trace_ready is not None:
@@ -291,17 +335,36 @@ class Profiler:
     # -- reporting -------------------------------------------------------
     def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail: bool = True,
                 thread_sep: bool = False, time_unit: str = "ms"):
-        """Aggregated per-name table (reference profiler summary)."""
+        """Aggregated per-name table (reference profiler summary).
+        ``sorted_by`` picks the ordering column (``SortedKeys.CPUTotal``
+        / ``CPUAvg`` / ``CPUMax``; ``GPUTotal`` aliases to total — the
+        device stream is the TPU timeline here, same mapping as
+        ``ProfilerTarget.GPU``) and ``time_unit`` scales the duration
+        columns (``"s" | "ms" | "us" | "ns"``, reflected in the row
+        keys: ``total_ms`` / ``avg_ms`` / ``max_ms`` for the default)."""
+        try:
+            scale = {"s": 1e6, "ms": 1e3, "us": 1.0,
+                     "ns": 1e-3}[time_unit]          # events store us
+        except KeyError:
+            raise ValueError(
+                f"time_unit must be one of 's', 'ms', 'us', 'ns'; got "
+                f"{time_unit!r}")
+        ndigits = {"s": 6, "ms": 3, "us": 1, "ns": 0}[time_unit]
         agg: Dict[str, List[float]] = {}
         for e in self._events:
-            agg.setdefault(e["name"], []).append(e["dur"] / 1e3)  # ms
+            agg.setdefault(e["name"], []).append(e["dur"] / scale)
+        sort_col = {SortedKeys.CPUTotal: sum,
+                    SortedKeys.GPUTotal: sum,
+                    SortedKeys.CPUAvg: lambda d: sum(d) / len(d),
+                    SortedKeys.CPUMax: max}.get(sorted_by, sum)
         rows = []
         for name, durs in sorted(agg.items(),
-                                 key=lambda kv: -sum(kv[1])):
+                                 key=lambda kv: -sort_col(kv[1])):
             rows.append({"name": name, "calls": len(durs),
-                         "total_ms": round(sum(durs), 3),
-                         "avg_ms": round(sum(durs) / len(durs), 3),
-                         "max_ms": round(max(durs), 3)})
+                         f"total_{time_unit}": round(sum(durs), ndigits),
+                         f"avg_{time_unit}": round(sum(durs) / len(durs),
+                                                   ndigits),
+                         f"max_{time_unit}": round(max(durs), ndigits)})
         return rows
 
     @property

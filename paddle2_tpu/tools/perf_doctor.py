@@ -1,0 +1,489 @@
+"""perf_doctor: triage "where did the step time go" from metrics streams.
+
+The performance sibling of ``flight_doctor``::
+
+    python -m paddle2_tpu.tools.perf_doctor /path/to/metrics_dir
+    python -m paddle2_tpu.tools.perf_doctor diff BASELINE_DIR NEW_DIR
+    python -m paddle2_tpu.tools.perf_doctor --json metrics_dir
+
+Reads the per-rank JSONL streams the always-on metrics plane writes
+(``metrics_rank_N.jsonl`` under ``PADDLE_METRICS_DIR``) and answers the
+three triage questions a slow training job raises:
+
+1. **Where does the step go?** Per-rank step-time breakdown — mean
+   input-wait / compute / collective / host seconds (components that by
+   construction sum to the step total), tokens/s, plus the reliability
+   counter set (retries, SDC convictions, quarantines, worker respawns,
+   compile-cache hits) so the detect->recover loop is VISIBLE, not just
+   logged post-mortem.
+2. **Who is slow, and why?** Straggler attribution: ranks whose mean
+   step time exceeds ``k x median`` across ranks; slow-INPUT
+   attribution: ranks whose input-wait share of the step is an outlier
+   (a straggler whose extra time is input wait has a data problem, not
+   a chip problem).
+3. **What regressed?** ``diff A B`` aligns two streams and names the
+   top regressed breakdown component by mean per-step delta, exiting
+   ``REGRESSION_EXIT`` (4) when the total regression passes the
+   threshold — the CI-gating primitive.
+
+Joins (optional, both best-effort):
+
+* ``--flight-dir`` — flight-recorder rank dumps: step retries, worker
+  respawns, chaos events, and dump reasons land in the report, so one
+  triage view correlates perf and health;
+* ``--trace`` — a merged chrome trace (``profiler.merge_traces``
+  output): per-lane ``ProfileStep#`` span means cross-check the
+  metrics-plane step totals against the profiler's deep view.
+
+Stdlib-only (the same posture as ``flight_doctor``): runs anywhere the
+JSONL lands, never imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REGRESSION_EXIT = 4
+COMPONENTS = ("input_wait_s", "compute_s", "collective_s", "host_s")
+_COMPONENT_LABEL = {"input_wait_s": "input-wait", "compute_s": "compute",
+                    "collective_s": "collective", "host_s": "host"}
+# straggler rule shared with flight_doctor / watchdog defaults
+_STRAGGLER_K = 2.0
+# reliability counters surfaced in every report (when present)
+_RELIABILITY_COUNTERS = (
+    "steps_total", "step_retries_total", "reliability_snapshots_total",
+    "reliability_restores_total", "sdc_mismatches_total",
+    "sdc_convictions_total", "quarantines_total",
+    "data_worker_respawns_total", "amp_skipped_steps_total",
+    "compiles_total", "compile_cache_hits_total",
+    "train_step_compiles_total", "checkpoint_saves_total",
+    "checkpoint_restores_total", "checkpoint_save_failures_total",
+    "checkpoint_restore_failures_total",
+)
+
+
+# ---------------------------------------------------------------- loading
+def load_stream(path: str) -> Dict[str, Any]:
+    """Parse one ``metrics_rank_N.jsonl``: step records in order plus
+    the LAST metrics snapshot (counters are cumulative — the newest
+    snapshot is the total). Unparseable lines are skipped."""
+    steps: List[Dict[str, Any]] = []
+    snapshot: Dict[str, Any] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            t = rec.get("type")
+            if t == "step":
+                steps.append(rec)
+            elif t == "metrics":
+                snapshot = rec
+    steps.sort(key=lambda r: r.get("step", 0))
+    return {"steps": steps, "snapshot": snapshot, "path": path}
+
+
+def load_streams(directory: str) -> Dict[int, Dict[str, Any]]:
+    """Every ``metrics_rank_N.jsonl`` under ``directory``, keyed by
+    rank. A single FILE path is accepted too (rank parsed from the
+    name, else 0)."""
+    out: Dict[int, Dict[str, Any]] = {}
+    if os.path.isfile(directory):
+        out[_rank_of(os.path.basename(directory))] = load_stream(directory)
+        return out
+    if not os.path.isdir(directory):
+        return out
+    for name in sorted(os.listdir(directory)):
+        if name.startswith("metrics_rank_") and name.endswith(".jsonl"):
+            out[_rank_of(name)] = load_stream(
+                os.path.join(directory, name))
+    return out
+
+
+def _rank_of(name: str) -> int:
+    stem = name[len("metrics_rank_"):-len(".jsonl")] \
+        if name.startswith("metrics_rank_") else ""
+    return int(stem) if stem.isdigit() else 0
+
+
+def _counter_total(snapshot: Dict[str, Any], name: str) -> float:
+    """Sum a counter over all its label sets in a metrics snapshot."""
+    series = (snapshot.get("counters") or {}).get(name)
+    if not isinstance(series, dict):
+        return 0.0
+    return sum(v for v in series.values()
+               if isinstance(v, (int, float)))
+
+
+def load_flight_counters(flight_dir: Optional[str]) -> Dict[str, Any]:
+    """Best-effort join with flight-recorder dumps: event-kind counts
+    and per-rank dump reasons. Parsing is delegated to
+    ``flight_doctor.load_dumps`` — ONE reader owns the dump format."""
+    out: Dict[str, Any] = {"reasons": {}, "event_counts": {}}
+    if not flight_dir or not os.path.isdir(flight_dir):
+        return out
+    from . import flight_doctor
+    try:
+        dumps = flight_doctor.load_dumps(flight_dir)
+    except OSError:
+        return out
+    for rank, dump in dumps.items():
+        out["reasons"][rank] = dump["header"].get("reason")
+        for ev in dump["events"]:
+            k = ev.get("kind")
+            out["event_counts"][k] = out["event_counts"].get(k, 0) + 1
+    return out
+
+
+def load_trace_steps(trace_path: Optional[str]) -> Dict[str, Any]:
+    """Per-lane ``ProfileStep#`` span stats from a (merged) chrome
+    trace — the profiler's view of the same step cadence."""
+    out: Dict[str, Any] = {}
+    if not trace_path or not os.path.isfile(trace_path):
+        return out
+    try:
+        with open(trace_path) as f:
+            trace = json.load(f)
+    except (OSError, ValueError):
+        return out
+    lanes: Dict[Any, str] = {}
+    spans: Dict[Any, List[float]] = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            lanes[e.get("pid")] = (e.get("args") or {}).get("name")
+        elif str(e.get("name", "")).startswith("ProfileStep#"):
+            spans.setdefault(e.get("pid"), []).append(
+                float(e.get("dur", 0.0)) / 1e6)
+    for pid, durs in sorted(spans.items()):
+        out[str(lanes.get(pid, pid))] = {
+            "steps": len(durs),
+            "mean_step_s": sum(durs) / len(durs) if durs else 0.0}
+    return out
+
+
+# ---------------------------------------------------------------- analysis
+def _mean(vals: List[float]) -> float:
+    return statistics.fmean(vals) if vals else 0.0
+
+
+def _median(vals: List[float]) -> float:
+    return statistics.median(vals) if vals else 0.0
+
+
+def summarize(streams: Dict[int, Dict[str, Any]],
+              warmup: int = 1) -> Dict[str, Any]:
+    """Merge per-rank streams into the triage report dict. The first
+    ``warmup`` step records per rank are excluded from the means (step
+    0 carries compile+first-dispatch; averaging it in would misname
+    compute as the top component of every short run)."""
+    report: Dict[str, Any] = {"ranks": sorted(streams), "per_rank": {},
+                              "aggregate": {}, "counters": {},
+                              "straggler": {}, "warmup_excluded": warmup}
+    totals_by_rank: Dict[int, float] = {}
+    input_share_by_rank: Dict[int, float] = {}
+    all_counters: Dict[str, float] = {}
+    for r, s in sorted(streams.items()):
+        short = len(s["steps"]) <= warmup
+        steps = s["steps"] if short else s["steps"][warmup:]
+        if not steps:
+            continue
+        entry: Dict[str, Any] = {
+            "steps": len(steps),
+            # a stream shorter than the warmup window can only report
+            # its compile-tainted records — flag it rather than hide it
+            "warmup_included": short,
+            "mean_total_s": _mean([x.get("total_s", 0.0)
+                                   for x in steps]),
+        }
+        for c in COMPONENTS:
+            entry[f"mean_{c}"] = _mean([x.get(c, 0.0) for x in steps])
+        toks = [x["tokens"] for x in steps if "tokens" in x]
+        secs = [x["total_s"] for x in steps if "tokens" in x]
+        if toks and sum(secs) > 0:
+            entry["tokens_per_s"] = sum(toks) / sum(secs)
+        samp = [x["samples"] for x in steps if "samples" in x]
+        if samp and entry["mean_total_s"] > 0:
+            entry["samples_per_s"] = _mean(samp) / entry["mean_total_s"]
+        if any("loss_scale" in x for x in steps):
+            entry["last_loss_scale"] = [
+                x["loss_scale"] for x in steps
+                if "loss_scale" in x][-1]
+        report["per_rank"][r] = entry
+        totals_by_rank[r] = entry["mean_total_s"]
+        if entry["mean_total_s"] > 0:
+            input_share_by_rank[r] = (entry["mean_input_wait_s"]
+                                      / entry["mean_total_s"])
+        for cname in _RELIABILITY_COUNTERS:
+            v = _counter_total(s.get("snapshot") or {}, cname)
+            if v:
+                all_counters[cname] = all_counters.get(cname, 0.0) + v
+    report["counters"] = all_counters
+    per = report["per_rank"]
+    if per:
+        agg = {"steps": sum(e["steps"] for e in per.values()),
+               "mean_total_s": _mean([e["mean_total_s"]
+                                      for e in per.values()])}
+        for c in COMPONENTS:
+            agg[f"mean_{c}"] = _mean([e[f"mean_{c}"]
+                                      for e in per.values()])
+        tps = [e["tokens_per_s"] for e in per.values()
+               if "tokens_per_s" in e]
+        if tps:
+            agg["tokens_per_s_total"] = sum(tps)
+        if agg["mean_total_s"] > 0:
+            agg["breakdown_pct"] = {
+                _COMPONENT_LABEL[c]: 100.0 * agg[f"mean_{c}"]
+                / agg["mean_total_s"] for c in COMPONENTS}
+        report["aggregate"] = agg
+
+    # straggler + slow-input attribution (>= 2 ranks to compare)
+    if len(totals_by_rank) >= 2:
+        med = _median(list(totals_by_rank.values()))
+        report["straggler"]["step_time"] = {
+            "median_s": med,
+            "suspects": sorted(
+                (r for r, t in totals_by_rank.items()
+                 if med > 0 and t > _STRAGGLER_K * med),
+                key=lambda r: -totals_by_rank[r])}
+        med_share = _median(list(input_share_by_rank.values()))
+        report["straggler"]["input_wait"] = {
+            "median_share": med_share,
+            "suspects": sorted(
+                (r for r, sh in input_share_by_rank.items()
+                 if sh > max(_STRAGGLER_K * med_share, 0.05)),
+                key=lambda r: -input_share_by_rank[r])}
+    return report
+
+
+def diff(base: Dict[str, Any], new: Dict[str, Any],
+         threshold_pct: float = 10.0) -> Dict[str, Any]:
+    """Compare two summarize() reports: per-component mean-step deltas,
+    the top regressed component, and the regression verdict."""
+    a = base.get("aggregate") or {}
+    b = new.get("aggregate") or {}
+    comps: Dict[str, Dict[str, float]] = {}
+    top: Optional[str] = None
+    top_delta = 0.0
+    for c in COMPONENTS:
+        va, vb = a.get(f"mean_{c}", 0.0), b.get(f"mean_{c}", 0.0)
+        delta = vb - va
+        # None = "new component" (base was 0): inf would serialize as
+        # a bare Infinity literal and break --json consumers
+        comps[_COMPONENT_LABEL[c]] = {
+            "base_s": va, "new_s": vb, "delta_s": delta,
+            "delta_pct": (100.0 * delta / va) if va > 0 else
+            (None if delta > 0 else 0.0)}
+        if delta > top_delta:
+            top_delta = delta
+            top = _COMPONENT_LABEL[c]
+    ta, tb = a.get("mean_total_s", 0.0), b.get("mean_total_s", 0.0)
+    total_delta_pct = (100.0 * (tb - ta) / ta) if ta > 0 else 0.0
+    out = {
+        "components": comps,
+        "top_regressed": top,
+        "base_total_s": ta, "new_total_s": tb,
+        "total_delta_pct": total_delta_pct,
+        "threshold_pct": threshold_pct,
+        "regressed": total_delta_pct > threshold_pct,
+    }
+    # counter deltas that explain a regression (retries eat wall time)
+    cdeltas = {}
+    for cname in _RELIABILITY_COUNTERS:
+        va = (base.get("counters") or {}).get(cname, 0.0)
+        vb = (new.get("counters") or {}).get(cname, 0.0)
+        if vb != va:
+            cdeltas[cname] = {"base": va, "new": vb}
+    out["counter_deltas"] = cdeltas
+    return out
+
+
+# ---------------------------------------------------------------- report
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    return f"{v * 1e3:.3f}ms"
+
+
+def format_summary(report: Dict[str, Any], directory: str) -> str:
+    L: List[str] = []
+    ranks = report["ranks"]
+    L.append(f"perf_doctor: merged {len(ranks)} rank stream(s) from "
+             f"{directory}")
+    if not report["per_rank"]:
+        L.append("  no step records found — is PADDLE_METRICS_DIR set "
+                 "on the workers (and did the run call metrics.flush() "
+                 "or exit cleanly)?")
+        return "\n".join(L)
+    agg = report["aggregate"]
+    L.append(f"  steps: {agg['steps']} (first {report['warmup_excluded']}"
+             f" per rank excluded as warmup)   mean step: "
+             f"{_fmt_s(agg['mean_total_s'])}")
+    if "breakdown_pct" in agg:
+        parts = "  ".join(
+            f"{name} {_fmt_s(agg['mean_' + c])} "
+            f"({agg['breakdown_pct'][name]:.1f}%)"
+            for c, name in _COMPONENT_LABEL.items())
+        L.append(f"  breakdown: {parts}")
+    if "tokens_per_s_total" in agg:
+        L.append(f"  throughput: {agg['tokens_per_s_total']:,.0f} "
+                 f"tokens/s aggregate")
+    for r, e in sorted(report["per_rank"].items()):
+        extra = ""
+        if "tokens_per_s" in e:
+            extra = f"  {e['tokens_per_s']:,.0f} tok/s"
+        if e.get("warmup_included"):
+            extra += "  [WARMUP INCLUDED: stream shorter than warmup]"
+        L.append(f"  rank {r}: {e['steps']} steps, mean "
+                 f"{_fmt_s(e['mean_total_s'])} (input "
+                 f"{_fmt_s(e['mean_input_wait_s'])}, compute "
+                 f"{_fmt_s(e['mean_compute_s'])}, collective "
+                 f"{_fmt_s(e['mean_collective_s'])}, host "
+                 f"{_fmt_s(e['mean_host_s'])}){extra}")
+    if report["counters"]:
+        L.append("RELIABILITY COUNTERS")
+        for name, v in sorted(report["counters"].items()):
+            L.append(f"  {name}: {v:g}")
+    s = report.get("straggler", {})
+    st = s.get("step_time", {})
+    si = s.get("input_wait", {})
+    if st.get("suspects"):
+        L.append(f"STRAGGLER: rank(s) "
+                 f"{','.join(map(str, st['suspects']))} mean step time "
+                 f"> {_STRAGGLER_K:g}x the {_fmt_s(st['median_s'])} "
+                 f"median")
+    if si.get("suspects"):
+        L.append(f"SLOW INPUT: rank(s) "
+                 f"{','.join(map(str, si['suspects']))} input-wait "
+                 f"share is an outlier (median share "
+                 f"{si['median_share']:.1%}) — a data-pipeline "
+                 f"problem, not a chip problem")
+    fl = report.get("flight") or {}
+    if fl.get("reasons") or fl.get("event_counts"):
+        L.append("FLIGHT-RECORDER JOIN")
+        for r, reason in sorted(fl.get("reasons", {}).items()):
+            L.append(f"  rank {r} dumped for {reason!r}")
+        interesting = {k: v for k, v in fl.get("event_counts",
+                                               {}).items()
+                       if k in ("step_retry", "worker_respawn", "chaos",
+                                "collective_timeout", "watchdog_overrun",
+                                "scale_update", "compile")}
+        if interesting:
+            L.append("  events: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(interesting.items())))
+    tr = report.get("trace") or {}
+    if tr:
+        L.append("MERGED-TRACE JOIN (ProfileStep spans)")
+        for lane, e in sorted(tr.items()):
+            L.append(f"  {lane}: {e['steps']} steps, mean "
+                     f"{_fmt_s(e['mean_step_s'])}")
+    return "\n".join(L)
+
+
+def format_diff(d: Dict[str, Any]) -> str:
+    L: List[str] = []
+    L.append(f"perf_doctor diff: mean step {_fmt_s(d['base_total_s'])} "
+             f"-> {_fmt_s(d['new_total_s'])} "
+             f"({d['total_delta_pct']:+.1f}%)")
+    for name, c in d["components"].items():
+        pct = c["delta_pct"]
+        pct_s = f"{pct:+.1f}%" if pct is not None else "new"
+        L.append(f"  {name:<11} {_fmt_s(c['base_s'])} -> "
+                 f"{_fmt_s(c['new_s'])} ({pct_s})")
+    if d["top_regressed"]:
+        L.append(f"TOP REGRESSED COMPONENT: {d['top_regressed']} "
+                 f"(+{_fmt_s(d['components'][d['top_regressed']]['delta_s'])}"
+                 f" per step)")
+    else:
+        L.append("no component regressed")
+    for name, c in sorted(d.get("counter_deltas", {}).items()):
+        L.append(f"  counter {name}: {c['base']:g} -> {c['new']:g}")
+    L.append(f"verdict: "
+             + (f"REGRESSION (total {d['total_delta_pct']:+.1f}% > "
+                f"{d['threshold_pct']:g}% threshold)" if d["regressed"]
+                else f"ok (total {d['total_delta_pct']:+.1f}% within "
+                     f"{d['threshold_pct']:g}%)"))
+    return "\n".join(L)
+
+
+# ---------------------------------------------------------------- CLI
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "diff":
+        return _main_diff(argv[1:])
+    if argv and argv[0] == "summary":
+        argv = argv[1:]
+    p = argparse.ArgumentParser(
+        prog="paddle2_tpu.tools.perf_doctor",
+        description="step-time breakdown, throughput, and reliability-"
+                    "counter triage from the always-on metrics plane "
+                    "(see also: the `diff` subcommand)")
+    p.add_argument("metrics_dir", nargs="?",
+                   default=os.environ.get("PADDLE_METRICS_DIR"),
+                   help="directory holding metrics_rank_N.jsonl "
+                        "(default: $PADDLE_METRICS_DIR)")
+    p.add_argument("--flight-dir",
+                   default=os.environ.get("PADDLE_FLIGHT_DIR"),
+                   help="flight-recorder dump dir to join "
+                        "(default: $PADDLE_FLIGHT_DIR)")
+    p.add_argument("--trace", default=None,
+                   help="merged chrome trace (profiler.merge_traces "
+                        "output) to cross-check step spans against")
+    p.add_argument("--warmup", type=int, default=1,
+                   help="per-rank step records excluded from means "
+                        "(default 1: the compile step)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the structured report as JSON")
+    args = p.parse_args(argv)
+    if not args.metrics_dir:
+        p.error("no metrics dir: pass one or set PADDLE_METRICS_DIR")
+    streams = load_streams(args.metrics_dir)
+    report = summarize(streams, warmup=max(0, args.warmup))
+    report["flight"] = load_flight_counters(args.flight_dir)
+    report["trace"] = load_trace_steps(args.trace)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(format_summary(report, args.metrics_dir))
+    return 0 if report["per_rank"] else 2
+
+
+def _main_diff(argv: List[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="paddle2_tpu.tools.perf_doctor diff",
+        description="diff two metrics streams; exits "
+                    f"{REGRESSION_EXIT} on regression (CI gate)")
+    p.add_argument("base_dir", help="baseline metrics dir (or file)")
+    p.add_argument("new_dir", help="candidate metrics dir (or file)")
+    p.add_argument("--threshold", type=float, default=10.0,
+                   help="total mean-step regression %% that fails the "
+                        "gate (default 10)")
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    base = summarize(load_streams(args.base_dir),
+                     warmup=max(0, args.warmup))
+    new = summarize(load_streams(args.new_dir),
+                    warmup=max(0, args.warmup))
+    if not base["per_rank"] or not new["per_rank"]:
+        print("perf_doctor diff: one side has no step records",
+              file=sys.stderr)
+        return 2
+    d = diff(base, new, threshold_pct=args.threshold)
+    if args.json:
+        print(json.dumps(d, indent=2, default=str))
+    else:
+        print(format_diff(d))
+    return REGRESSION_EXIT if d["regressed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,536 @@
+"""Observability plane: metrics registry, step windows, cost model,
+perf_doctor triage, and the telemetry wiring through the train paths."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.nn as nn
+import paddle2_tpu.optimizer as opt
+from paddle2_tpu.observability import cost_model, metrics
+from paddle2_tpu.tools import perf_doctor
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    metrics.disable()
+    yield
+    metrics.disable()
+
+
+# ---------------------------------------------------------------- registry
+class TestRegistry:
+    def test_counter_gauge_histogram(self, tmp_path):
+        pl = metrics.enable(str(tmp_path), rank=0)
+        pl.inc("requests_total", op="a")
+        pl.inc("requests_total", 2.0, op="a")
+        pl.inc("requests_total", op="b")
+        assert pl.counter("requests_total").value(op="a") == 3.0
+        assert pl.counter("requests_total").value(op="b") == 1.0
+        pl.set_gauge("scale", 42.0)
+        pl.set_gauge("scale", 7.0)
+        assert pl.gauge("scale").value() == 7.0
+        pl.observe("lat_seconds", 0.003)
+        pl.observe("lat_seconds", 4.0)
+        snap = pl.snapshot()
+        assert snap["histograms"]["lat_seconds"][""]["count"] == 2
+        assert snap["histograms"]["lat_seconds"][""]["sum"] == \
+            pytest.approx(4.003)
+
+    def test_counter_cannot_decrease(self, tmp_path):
+        pl = metrics.enable(str(tmp_path), rank=0)
+        with pytest.raises(ValueError):
+            pl.inc("x_total", -1.0)
+
+    def test_kind_collision_raises(self, tmp_path):
+        pl = metrics.enable(str(tmp_path), rank=0)
+        pl.inc("thing")
+        with pytest.raises(TypeError):
+            pl.set_gauge("thing", 1.0)
+
+    def test_disabled_hooks_are_noops(self):
+        assert metrics.active() is None
+        metrics.inc("never")                # must not raise
+        metrics.set_gauge("never", 1.0)
+        metrics.observe("never", 1.0)
+        assert metrics.step_end() is None
+        with metrics.phase("compute"):
+            pass
+
+    def test_enable_requires_dir(self, monkeypatch):
+        monkeypatch.delenv(metrics.METRICS_DIR_ENV, raising=False)
+        with pytest.raises(ValueError):
+            metrics.enable()
+
+
+# ------------------------------------------------------------ step windows
+class TestStepWindows:
+    def test_components_sum_exactly(self, tmp_path):
+        pl = metrics.enable(str(tmp_path), rank=0)
+        import time
+        with pl.phase("input"):
+            time.sleep(0.002)
+        with pl.phase("compute"):
+            time.sleep(0.004)
+            with pl.phase("collective"):    # nested: innermost owns it
+                time.sleep(0.003)
+        rec = pl.step_end(tokens=1024)
+        parts = (rec["input_wait_s"] + rec["compute_s"]
+                 + rec["collective_s"] + rec["host_s"])
+        assert rec["total_s"] == pytest.approx(parts, abs=1e-12)
+        assert rec["host_s"] >= 0
+        assert rec["collective_s"] >= 0.003
+        assert rec["compute_s"] >= 0.004    # excludes the nested span
+        assert rec["tokens"] == 1024 and rec["tokens_per_s"] > 0
+
+    def test_unclosed_phase_is_swept_at_step_end(self, tmp_path):
+        pl = metrics.enable(str(tmp_path), rank=0)
+        pl.phase_enter("compute")           # never exited (error path)
+        rec = pl.step_end()
+        assert rec["compute_s"] > 0
+        parts = (rec["input_wait_s"] + rec["compute_s"]
+                 + rec["collective_s"] + rec["host_s"])
+        assert rec["total_s"] == pytest.approx(parts, abs=1e-12)
+
+    def test_step_window_reset_discards_boundary_time(self, tmp_path):
+        # epoch boundary: eval/callback time between step_end and the
+        # next epoch's first step must not be billed to that step
+        pl = metrics.enable(str(tmp_path), rank=0)
+        pl.step_end()
+        time.sleep(0.05)                    # inter-epoch work
+        pl.phase_enter("compute")           # open phase discarded too
+        pl.step_window_reset()
+        rec = pl.step_end()
+        assert rec["total_s"] < 0.05
+        assert rec["compute_s"] == 0.0
+
+    def test_reenable_clamps_flush_steps(self, tmp_path):
+        pl = metrics.enable(str(tmp_path), rank=0, flush_steps=2)
+        again = metrics.enable(str(tmp_path), flush_steps=0)
+        assert again is pl
+        assert pl.flush_steps == 1          # clamped like the ctor
+        pl.step_end()                       # must not ZeroDivisionError
+
+    def test_background_thread_inc_races_flush(self, tmp_path):
+        # health prober / watchdog threads inc() concurrently with the
+        # training thread's step_end snapshot; unguarded label upserts
+        # raise "dictionary changed size during iteration" out of
+        # step_end
+        import threading
+        pl = metrics.enable(str(tmp_path), rank=0, flush_steps=1)
+        stop = threading.Event()
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                pl.inc("quarantines_total", reason=f"r{i}")  # new label
+                i += 1
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            for _ in range(200):
+                pl.step_end()               # flushes a snapshot each step
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+    def test_stream_and_flush(self, tmp_path):
+        pl = metrics.enable(str(tmp_path), rank=3, flush_steps=2)
+        pl.step_end()
+        pl.step_end()                       # auto-flush here
+        assert os.path.exists(pl.stream_path)
+        assert pl.stream_path.endswith("metrics_rank_3.jsonl")
+        pl.inc("late_total")
+        pl.flush()
+        lines = [json.loads(ln) for ln in open(pl.stream_path)]
+        steps = [r for r in lines if r["type"] == "step"]
+        snaps = [r for r in lines if r["type"] == "metrics"]
+        assert len(steps) == 2 and steps[0]["rank"] == 3
+        assert snaps and snaps[-1]["counters"]["late_total"][""] == 1.0
+
+
+# ------------------------------------------------------------- prometheus
+class TestPrometheus:
+    def test_textfile_format(self, tmp_path):
+        pl = metrics.enable(str(tmp_path), rank=0)
+        pl.inc("req_total", 3, op="all_reduce")
+        pl.set_gauge("scale", 2.5)
+        pl.observe("dur_seconds", 0.004)
+        path = pl.export_prometheus()
+        text = open(path).read()
+        assert "# TYPE req_total counter" in text
+        assert 'req_total{op="all_reduce"} 3.0' in text
+        assert "# TYPE scale gauge" in text and "scale 2.5" in text
+        assert "# TYPE dur_seconds histogram" in text
+        assert 'dur_seconds_bucket{le="+Inf"} 1' in text
+        assert "dur_seconds_count 1" in text
+
+
+# -------------------------------------------------------------- cost model
+class TestCostModel:
+    def test_wire_bytes_formulas(self):
+        n, b = 8, 1024.0
+        assert cost_model.wire_bytes("all_reduce_sum", b, n) == \
+            pytest.approx(2 * (n - 1) / n * b)
+        assert cost_model.wire_bytes("all_gather", b, n) == \
+            pytest.approx((n - 1) / n * b)
+        assert cost_model.wire_bytes("reduce_scatter", b, n) == \
+            pytest.approx((n - 1) / n * b)
+        assert cost_model.wire_bytes("barrier", b, n) == 0.0
+        assert cost_model.wire_bytes("all_reduce_sum", b, 1) == 0.0
+        assert cost_model.wire_bytes("mystery_op", b, n) == b
+
+    def test_link_model_dcn_vs_ici(self):
+        lm = cost_model.LinkModel(ici_gbps=100.0, dcn_gbps=10.0,
+                                  dcn_axes=["pp"])
+        assert lm.bandwidth("dp") == 100e9
+        assert lm.bandwidth("pp") == 10e9
+        assert lm.is_dcn("dp_dcn")          # name convention
+        # a multi-axis group is gated by its weakest hop
+        assert lm.seconds(1e9, ["dp", "pp"]) == pytest.approx(0.1)
+        assert lm.seconds(1e9, ["dp"]) == pytest.approx(0.01)
+
+    def test_traffic_accumulator(self):
+        tr = cost_model.CollectiveTraffic()
+        tr.add("all_reduce_sum", 1000, axes=("dp",), group_size=4)
+        tr.add("all_gather", 2000, axes=("fsdp",), group_size=4)
+        assert tr.wire_bytes_total() == pytest.approx(
+            1000 * 1.5 + 2000 * 0.75)
+        assert set(tr.by_op()) == {"all_reduce_sum", "all_gather"}
+        lm = cost_model.LinkModel(ici_gbps=1.0)   # 1 GB/s
+        assert tr.seconds(lm) == pytest.approx(
+            (1500 + 1500) / 1e9)
+
+    def test_step_cost_roofline_and_mfu(self):
+        sc = cost_model.StepCost(
+            flops=1e12, hbm_bytes=1e9, peak_flops=1e14, hbm_bps=1e12)
+        assert sc.bound() == "compute"
+        assert sc.step_time_lower_bound_s() == pytest.approx(0.01)
+        assert sc.mfu(0.02) == pytest.approx(0.5)
+        r = sc.roofline()
+        assert r["arithmetic_intensity"] == pytest.approx(1000.0)
+        assert r["ridge_point"] == pytest.approx(100.0)
+        tr = cost_model.CollectiveTraffic()
+        tr.add("all_reduce_sum", 1e12, axes=("dp",), group_size=2)
+        slow_net = cost_model.StepCost(
+            flops=1e12, hbm_bytes=1e9, traffic=tr,
+            link=cost_model.LinkModel(ici_gbps=1.0),
+            peak_flops=1e14, hbm_bps=1e12)
+        assert slow_net.bound() == "network"
+
+    def test_program_cost_matches_cost_analysis(self):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(a, b):
+            return a @ b
+        args = [jnp.ones((32, 64), jnp.float32),
+                jnp.ones((64, 16), jnp.float32)]
+        got = cost_model.program_cost(f, args)
+        direct = cost_model.cost_analysis_of(f.lower(*args))
+        assert got is not None and got["flops"] == direct["flops"]
+        # abstractified args lower to the same numbers (donation-safe)
+        a_args = cost_model.abstractify(args)
+        assert cost_model.program_cost(f, a_args)["flops"] == \
+            got["flops"]
+
+
+# ------------------------------------------------------------- perf_doctor
+def _write_stream(d, rank, steps, inp=0.002, comp=0.010, coll=0.001,
+                  host=0.0005, tokens=2048, counters=None):
+    os.makedirs(d, exist_ok=True)
+    lines = []
+    for i in range(steps):
+        lines.append(json.dumps({
+            "type": "step", "rank": rank, "step": i,
+            "total_s": inp + comp + coll + host, "input_wait_s": inp,
+            "compute_s": comp, "collective_s": coll, "host_s": host,
+            "tokens": tokens}))
+    lines.append(json.dumps({
+        "type": "metrics", "rank": rank,
+        "counters": {"steps_total": {"": steps}, **(counters or {})},
+        "gauges": {}, "histograms": {}}))
+    with open(os.path.join(d, f"metrics_rank_{rank}.jsonl"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+class TestPerfDoctor:
+    def test_summary_breakdown_and_counters(self, tmp_path):
+        d = str(tmp_path / "m")
+        _write_stream(d, 0, 10,
+                      counters={"step_retries_total": {"": 2}})
+        rep = perf_doctor.summarize(perf_doctor.load_streams(d))
+        agg = rep["aggregate"]
+        assert agg["steps"] == 9            # warmup excluded
+        assert agg["mean_total_s"] == pytest.approx(0.0135)
+        assert agg["breakdown_pct"]["compute"] == pytest.approx(
+            100 * 0.010 / 0.0135)
+        assert rep["counters"]["step_retries_total"] == 2
+        assert "tokens_per_s_total" in agg
+
+    def test_straggler_and_slow_input_attribution(self, tmp_path):
+        d = str(tmp_path / "m")
+        _write_stream(d, 0, 10)
+        _write_stream(d, 1, 10)
+        _write_stream(d, 2, 10, comp=0.200)          # straggler
+        _write_stream(d, 3, 10, inp=0.040)           # slow input
+        rep = perf_doctor.summarize(perf_doctor.load_streams(d))
+        assert 2 in rep["straggler"]["step_time"]["suspects"]
+        assert 3 in rep["straggler"]["input_wait"]["suspects"]
+        assert 0 not in rep["straggler"]["step_time"]["suspects"]
+
+    def test_diff_names_top_regressed_component(self, tmp_path):
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        _write_stream(a, 0, 10)
+        _write_stream(b, 0, 10, coll=0.020)
+        rep_a = perf_doctor.summarize(perf_doctor.load_streams(a))
+        rep_b = perf_doctor.summarize(perf_doctor.load_streams(b))
+        d = perf_doctor.diff(rep_a, rep_b, threshold_pct=10)
+        assert d["top_regressed"] == "collective"
+        assert d["regressed"] is True
+        assert d["components"]["compute"]["delta_s"] == \
+            pytest.approx(0.0)
+        # improvement is not a regression
+        d2 = perf_doctor.diff(rep_b, rep_a, threshold_pct=10)
+        assert d2["regressed"] is False and d2["top_regressed"] is None
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        _write_stream(a, 0, 10)
+        _write_stream(b, 0, 10, coll=0.020)
+        assert perf_doctor.main([a]) == 0
+        assert perf_doctor.main(["diff", a, b]) == \
+            perf_doctor.REGRESSION_EXIT
+        assert perf_doctor.main(["diff", a, a]) == 0
+        assert perf_doctor.main([str(tmp_path / "empty")]) == 2
+        out = capsys.readouterr().out
+        assert "TOP REGRESSED COMPONENT: collective" in out
+
+    def test_flight_join(self, tmp_path):
+        d = str(tmp_path / "m")
+        fd = str(tmp_path / "flight")
+        _write_stream(d, 0, 5)
+        os.makedirs(fd)
+        with open(os.path.join(fd, "rank_0.jsonl"), "w") as f:
+            f.write(json.dumps({"type": "header", "rank": 0,
+                                "reason": "sigterm"}) + "\n")
+            f.write(json.dumps({"type": "event", "n": 0,
+                                "kind": "step_retry"}) + "\n")
+            f.write(json.dumps({"type": "event", "n": 1,
+                                "kind": "step_retry"}) + "\n")
+        fl = perf_doctor.load_flight_counters(fd)
+        assert fl["reasons"][0] == "sigterm"
+        assert fl["event_counts"]["step_retry"] == 2
+        rep = perf_doctor.summarize(perf_doctor.load_streams(d))
+        rep["flight"] = fl
+        text = perf_doctor.format_summary(rep, d)
+        assert "FLIGHT-RECORDER JOIN" in text
+        assert "step_retry=2" in text
+
+    def test_trace_join(self, tmp_path):
+        trace = {"traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 0,
+             "args": {"name": "rank0"}},
+            {"name": "ProfileStep#0", "ph": "X", "pid": 0,
+             "ts": 0.0, "dur": 5000.0},
+            {"name": "ProfileStep#1", "ph": "X", "pid": 0,
+             "ts": 6000.0, "dur": 7000.0}]}
+        p = tmp_path / "merged.paddle_trace.json"
+        p.write_text(json.dumps(trace))
+        tr = perf_doctor.load_trace_steps(str(p))
+        assert tr["rank0"]["steps"] == 2
+        assert tr["rank0"]["mean_step_s"] == pytest.approx(0.006)
+
+
+# ------------------------------------------------------------ wiring
+class TestWiring:
+    def test_train_step_emits_step_records(self, tmp_path):
+        pl = metrics.enable(str(tmp_path), rank=0)
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                          nn.Linear(16, 8))
+        o = opt.AdamW(learning_rate=1e-3,
+                      parameters=m.parameters())
+        step = paddle.jit.train_step(
+            lambda x, y: ((m(x) - y) ** 2).mean(), o, layers=[m])
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(4, 8).astype(np.float32))
+        y = paddle.to_tensor(rs.randn(4, 8).astype(np.float32))
+        for _ in range(3):
+            step(x, y)
+        metrics.flush()
+        lines = [json.loads(ln) for ln in open(pl.stream_path)]
+        steps = [r for r in lines if r["type"] == "step"]
+        assert len(steps) == 3
+        assert all(s["samples"] == 4 for s in steps)
+        assert all(s["compute_s"] > 0 for s in steps)
+        assert pl.counter("train_step_compiles_total").value() == 1.0
+        assert pl.gauge("train_step_program_cache_size").value() == 1.0
+
+    def test_train_step_infers_tokens_from_int_ids(self, tmp_path):
+        pl = metrics.enable(str(tmp_path), rank=0)
+        paddle.seed(0)
+        emb = nn.Embedding(16, 8)
+        head = nn.Linear(8, 16)
+        o = opt.SGD(learning_rate=0.1, parameters=list(
+            emb.parameters()) + list(head.parameters()))
+        ce = nn.CrossEntropyLoss()
+
+        def fn(ids, labels):
+            return ce(head(emb(ids)).reshape([-1, 16]),
+                      labels.reshape([-1]))
+        step = paddle.jit.train_step(fn, o, layers=[emb, head])
+        ids = paddle.to_tensor(
+            np.arange(12, dtype=np.int64).reshape(2, 6) % 16)
+        step(ids, ids)
+        metrics.flush()
+        steps = [json.loads(ln) for ln in open(pl.stream_path)
+                 if json.loads(ln)["type"] == "step"]
+        assert steps[0]["tokens"] == 12    # [2, 6] integer ids
+
+    def test_eager_collective_phase_and_bytes(self, tmp_path):
+        from paddle2_tpu.distributed import collective as C
+        pl = metrics.enable(str(tmp_path), rank=0)
+        import paddle2_tpu.distributed as dist
+        dist.init_mesh()
+        w = dist.world_size()
+        t = paddle.to_tensor(np.ones((w, 16), np.float32))
+        C.all_reduce(t)
+        rec = pl.step_end()
+        assert rec["collective_s"] > 0
+        assert pl.counter("collectives_total").values  # labeled entry
+        total = sum(pl.counter("collective_bytes_total").values
+                    .values())
+        # rank-major [world, 16] f32 payload: the counter charges the
+        # PER-RANK slice (controller-mode-invariant wire accounting)
+        assert total == 16 * 4.0
+        snap = pl.snapshot()
+        assert any("all_reduce" in k for k in
+                   snap["counters"]["collectives_total"])
+
+    def test_subgroup_bytes_charge_per_rank_slice(self, tmp_path):
+        # the payload stays rank-major [W, ...] even for a SUBGROUP
+        # collective: the per-rank charge divides by the mesh world
+        # size (shape[0]), not the group size — regression for the
+        # 2x-overcount on hybrid-parallel (subgroup) configs
+        from paddle2_tpu.distributed import collective as C
+        pl = metrics.enable(str(tmp_path), rank=0)
+        import paddle2_tpu.distributed as dist
+        dist.init_mesh({"dp": dist.world_size() // 2, "mp": 2})
+        try:
+            g = dist.new_group([0, 1])  # one mp pair
+            w = dist.world_size()
+            t = paddle.to_tensor(np.ones((w, 16), np.float32))
+            C.all_reduce(t, group=g)
+            total = sum(pl.counter("collective_bytes_total").values
+                        .values())
+            assert total == 16 * 4.0   # per-rank slice, NOT nbytes/2
+        finally:
+            dist.init_mesh()
+
+    def test_hapi_fit_records_input_and_compute(self, tmp_path):
+        from paddle2_tpu.io.dataloader import Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                rs = np.random.RandomState(i)
+                return (rs.randn(4).astype(np.float32),
+                        rs.randn(1).astype(np.float32))
+
+        pl = metrics.enable(str(tmp_path), rank=0)
+        model = paddle.Model(nn.Linear(4, 1))
+        model.prepare(opt.SGD(learning_rate=0.01,
+                              parameters=model.parameters()),
+                      nn.MSELoss())
+        model.fit(DS(), batch_size=4, epochs=1, verbose=0)
+        metrics.flush()
+        steps = [json.loads(ln) for ln in open(pl.stream_path)
+                 if json.loads(ln)["type"] == "step"]
+        assert len(steps) == 2             # 8 samples / batch 4
+        assert all(s["compute_s"] > 0 for s in steps)
+        assert all("loss" in s for s in steps)
+        # the loader ran under the input phase at least once
+        assert sum(s["input_wait_s"] for s in steps) >= 0.0
+
+    def test_reliable_step_retry_counter(self, tmp_path):
+        from paddle2_tpu.distributed.fault_tolerance import (ReliableStep,
+                                                             chaos)
+        pl = metrics.enable(str(tmp_path), rank=0)
+        paddle.seed(0)
+        m = nn.Linear(4, 4)
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+        rel = ReliableStep(model=m, optimizer=o)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+
+        def one(x):
+            loss = (m(x) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            return loss
+        chaos.arm("poison_loss:2")
+        for _ in range(4):
+            rel.run(one, x)
+        rel.finalize()
+        chaos.disarm()
+        assert rel.stats["retries"] == 1
+        assert pl.counter("step_retries_total").value() == 1.0
+        assert pl.counter("reliability_restores_total").value() >= 1.0
+        assert pl.counter("reliability_snapshots_total").value() >= 1.0
+
+    def test_grad_scaler_gauge_and_skip_counter(self, tmp_path):
+        from paddle2_tpu.amp import GradScaler
+        pl = metrics.enable(str(tmp_path), rank=0)
+        scaler = GradScaler(init_loss_scaling=1024.0)
+        scaler.note_fused_step(found_inf=True)   # skip -> scale backs off
+        assert pl.counter("amp_skipped_steps_total").value() == 1.0
+        assert pl.gauge("amp_loss_scale").value() == \
+            scaler.get_loss_scaling()
+
+    def test_checkpoint_counters(self, tmp_path):
+        from paddle2_tpu.distributed.fault_tolerance import (
+            CheckpointManager)
+        pl = metrics.enable(str(tmp_path / "m"), rank=0)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        w = paddle.to_tensor(np.ones((2, 2), np.float32))
+        state = {"w": w}
+        mgr.save(state, step=1)
+        assert mgr.restore(state) == 1
+        assert pl.counter("checkpoint_saves_total").value() == 1.0
+        assert pl.counter("checkpoint_restores_total").value() == 1.0
+        snap = pl.snapshot()
+        assert snap["histograms"]["checkpoint_save_seconds"][""][
+            "count"] == 1
+
+    def test_auto_enable_env_guard(self, tmp_path):
+        """Auto-enable requires BOTH the dir and the worker guard (the
+        flight-recorder posture) — exercised via a fresh interpreter."""
+        import subprocess
+        import sys as _sys
+        code = ("import paddle2_tpu.observability.metrics as m; "
+                "print(m.active() is not None)")
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        base = {k: v for k, v in os.environ.items()
+                if not k.startswith(("PADDLE_", "FLAGS_"))}
+        base.update({"PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"})
+        off = subprocess.run(
+            [_sys.executable, "-c", code],
+            env={**base, "PADDLE_METRICS_DIR": str(tmp_path)},
+            capture_output=True, text=True)
+        assert off.stdout.strip() == "False"
+        on = subprocess.run(
+            [_sys.executable, "-c", code],
+            env={**base, "PADDLE_METRICS_DIR": str(tmp_path),
+                 "PADDLE_TRAINER_ID": "0"},
+            capture_output=True, text=True)
+        assert on.stdout.strip() == "True"
