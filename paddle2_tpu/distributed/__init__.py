@@ -12,11 +12,16 @@ from .mesh import (HYBRID_AXES, axis_size, constrain, get_mesh, init_mesh,
 from .collective import (Group, P2POp, ReduceOp, all_gather,
                          all_gather_object, all_reduce, all_to_all, alltoall,
                          barrier, batch_isend_irecv, broadcast,
-                         destroy_process_group, get_group, irecv,
-                         is_initialized, isend, new_group, ppermute, recv,
-                         reduce, reduce_scatter, scatter, send, wait)
+                         destroy_process_group, fused_all_reduce, get_group,
+                         irecv, is_initialized, isend, new_group, ppermute,
+                         recv, reduce, reduce_scatter, scatter, send, wait)
 from .parallel import DataParallel, init_parallel_env, parallel_initialized
 from .sharding import ShardedOptimizer, group_sharded_parallel
+from . import bucket  # noqa: F401
+from .bucket import (BucketPlan, GradientBucketManager,  # noqa: F401
+                     bucketed_pmean, bucketed_psum, plan_buckets)
+from . import spec_layout  # noqa: F401
+from .spec_layout import SpecLayout, hybrid_mesh  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import (DistModel, Partial, Placement,  # noqa: F401
                             ProcessMesh, Replicate, Shard, ShardDataloader,

@@ -66,6 +66,49 @@ def axis_size(name: str) -> int:
     return int(mesh.shape[name])
 
 
+def axis_degrees() -> Dict[str, int]:
+    """Axis name -> degree of the installed mesh, in rank-major order
+    (outermost first — the DCN-tolerant end; see spec_layout)."""
+    return {k: int(v) for k, v in get_mesh().shape.items()}
+
+
+def group_size(axes: Sequence[str]) -> int:
+    """Number of ranks in the communication group spanned by ``axes``
+    (the group-size input to wire-traffic accounting)."""
+    mesh = get_mesh()
+    n = 1
+    for a in axes:
+        n *= int(mesh.shape[a])
+    return n
+
+
+def dcn_axes() -> set:
+    """Mesh axes mapped onto the data-center network, per the cost
+    model's :class:`~paddle2_tpu.observability.cost_model.LinkModel`
+    convention (ONE owner of the rule): the ``PADDLE_DCN_AXES`` env
+    list, any installed axis whose name contains ``"dcn"``, and the
+    dcn axes of the :func:`~paddle2_tpu.distributed.spec_layout.\
+hybrid_mesh`-installed layout — the same set its link model prices
+    traffic with."""
+    from ..observability.cost_model import LinkModel
+    link = LinkModel()
+    named = set(link.dcn_axes)
+    mesh = get_mesh(auto_init=False)
+    if mesh is not None:
+        named |= {a for a in mesh.axis_names if link.is_dcn(a)}
+    from .spec_layout import installed_layout
+    layout = installed_layout()
+    if layout is not None:
+        declared = set(layout.dcn_axes)
+        if mesh is not None:
+            # a later init_mesh may have replaced the hybrid mesh with
+            # different axes — only honor declarations that still name
+            # an installed axis
+            declared &= set(mesh.axis_names)
+        named |= declared
+    return named
+
+
 def world_size() -> int:
     return int(np.prod(list(get_mesh().shape.values())))
 

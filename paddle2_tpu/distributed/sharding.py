@@ -15,7 +15,7 @@ so XLA emits exactly the ZeRO collective pattern.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +26,8 @@ from . import mesh as mesh_mod
 
 P = PartitionSpec
 
-__all__ = ["group_sharded_parallel", "ShardedOptimizer", "shard_optimizer"]
+__all__ = ["group_sharded_parallel", "ShardedOptimizer", "shard_optimizer",
+           "layer_param_groups", "prefetch_gather"]
 
 _LEVELS = {"os": 1, "os_g": 2, "p_g_os": 3}
 
@@ -52,15 +53,29 @@ def _place(arr, spec: P):
 
 
 class ShardedOptimizer:
-    """Wraps an Optimizer with a ZeRO placement policy (stage 1/2/3)."""
+    """Wraps an Optimizer with a ZeRO placement policy (stage 1/2/3).
+
+    ``prefetch`` (stage 3 only) turns the on-demand forward re-gather
+    into a LAYER-AHEAD schedule inside the compiled train step: each
+    module group's parameter all-gather is issued as its own explicit
+    collective, chained so group ``i`` cannot start before group
+    ``i - prefetch_depth`` finished — the latency-hiding scheduler then
+    overlaps the in-flight gather with the previous layer's compute
+    while live replicated memory stays bounded to ~``prefetch_depth``
+    layers instead of the whole model. Values are bitwise identical to
+    the eager (non-prefetch) path; it is purely a schedule shape.
+    """
 
     def __init__(self, optimizer, level: str = "os",
-                 group=None, offload: bool = False):
+                 group=None, offload: bool = False,
+                 prefetch: bool = False, prefetch_depth: int = 1):
         if level not in _LEVELS:
             raise ValueError(f"level must be one of {list(_LEVELS)}")
         self._inner = optimizer
         self._level = _LEVELS[level]
         self._axis = group.axes[0] if group is not None else _axis_name()
+        self._prefetch = bool(prefetch) and self._level >= 3
+        self._prefetch_depth = max(1, int(prefetch_depth))
 
     # -- placement policies ----------------------------------------------
     def _shard_states(self):
@@ -102,13 +117,53 @@ class ShardedOptimizer:
         self.step()
 
     def state_dict(self):
-        return self._inner.state_dict()
+        # placement metadata rides along so a restore can verify it is
+        # re-establishing the same ZeRO policy (the reshard-on-load path
+        # reslices by the LIVE placement, so axis/level must round-trip)
+        state = self._inner.state_dict()
+        state["_zero_placement"] = {"level": self._level,
+                                    "axis": self._axis}
+        return state
+
+    def _restore(self, state, loader):
+        state = dict(state)
+        meta = state.pop("_zero_placement", None)
+        # validate BEFORE touching the inner optimizer: a caller that
+        # catches the mismatch (elastic ladder trying the next
+        # snapshot) must not be left with a half-applied checkpoint
+        if meta is not None:
+            if int(meta.get("level", self._level)) != self._level:
+                raise ValueError(
+                    f"ZeRO level mismatch on restore: checkpoint was "
+                    f"saved at stage {meta['level']}, this optimizer "
+                    f"is stage {self._level} — rebuild with the "
+                    f"matching level")
+            axis = meta.get("axis", self._axis)
+            if axis != self._axis:
+                raise ValueError(
+                    f"ZeRO shard-axis mismatch on restore: checkpoint "
+                    f"was sharded over {axis!r}, this optimizer over "
+                    f"{self._axis!r} — reshard through the elastic "
+                    f"checkpoint path instead")
+        out = loader(state)
+        # re-establish the shard placement: the inner restore copies
+        # leaves onto the default device (replicated), and a donated
+        # fused step whose out_shardings pin the ZeRO placement would
+        # otherwise see differently-placed arguments on the next
+        # dispatch — a fresh compile at best, a silent memory-footprint
+        # regression (states materialized replicated) at worst. Pure
+        # placement: values stay bitwise identical.
+        self._shard_states()
+        self._place_params_and_grads()
+        return out
 
     def load_state_dict(self, state):
-        return self._inner.load_state_dict(state)
+        loader = getattr(self._inner, "load_state_dict",
+                         self._inner.set_state_dict)
+        return self._restore(state, loader)
 
     def set_state_dict(self, state):
-        return self._inner.set_state_dict(state)
+        return self._restore(state, self._inner.set_state_dict)
 
     def get_lr(self):
         return self._inner.get_lr()
@@ -124,12 +179,17 @@ def group_sharded_parallel(model, optimizer, level: str, scaler=None,
                            group=None, offload: bool = False,
                            sync_buffers: bool = False, buffer_max_size=None,
                            segment_size=None, sync_comm: bool = False,
-                           dp_group=None, exclude_layer=None):
+                           dp_group=None, exclude_layer=None,
+                           prefetch: bool = False, prefetch_depth: int = 1):
     """python/paddle/distributed/sharding/group_sharded.py parity: returns
-    (model, sharded_optimizer, scaler)."""
+    (model, sharded_optimizer, scaler). ``prefetch`` enables the
+    layer-ahead parameter all-gather schedule at stage 3 (see
+    :class:`ShardedOptimizer`)."""
     if not mesh_mod.mesh_initialized():
         mesh_mod.init_mesh()
-    opt = ShardedOptimizer(optimizer, level=level, group=group)
+    opt = ShardedOptimizer(optimizer, level=level, group=group,
+                           prefetch=prefetch,
+                           prefetch_depth=prefetch_depth)
     if _LEVELS[level] >= 3:
         axis = opt._axis
         for p in model.parameters():
@@ -140,3 +200,123 @@ def group_sharded_parallel(model, optimizer, level: str, scaler=None,
 def shard_optimizer(optimizer, shard_fn=None, group=None):
     """auto_parallel/api.py:1591 parity: ZeRO-1 the optimizer states."""
     return ShardedOptimizer(optimizer, level="os", group=group)
+
+
+# ------------------------------------------------------------- prefetch
+def layer_param_groups(layers: Sequence, params: Sequence
+                       ) -> List[List[int]]:
+    """Indices of ``params`` grouped by owning sub-module, in forward
+    (registration) order — the prefetch granularity.
+
+    The owning module is the dotted-name prefix from
+    ``named_parameters()``; consecutive parameters of the same module
+    form one group (a Linear's weight+bias gather together). Parameters
+    not reachable from ``layers`` land in one trailing group. Pure
+    function of the layer tree — deterministic across ranks.
+    """
+    index = {id(p): i for i, p in enumerate(params)}
+    groups: List[List[int]] = []
+    last_key = None
+    for lyr in layers:
+        for name, p in lyr.named_parameters():
+            i = index.pop(id(p), None)
+            if i is None:
+                continue
+            owner = name.rsplit(".", 1)[0] if "." in name else ""
+            key = (id(lyr), owner)
+            if key != last_key:
+                groups.append([])
+                last_key = key
+            groups[-1].append(i)
+    leftover = sorted(index.values())
+    if leftover:
+        groups.append(leftover)
+    return groups
+
+
+def _identity_barrier_fwd(args):
+    import jax
+    return jax.lax.optimization_barrier(args), None
+
+
+def _identity_barrier_bwd(_, cts):
+    return (cts,)
+
+
+_identity_barrier = None
+
+
+def _get_identity_barrier():
+    """``optimization_barrier`` with an identity VJP: the barrier is a
+    SCHEDULING constraint only, so cotangents pass straight through
+    (jax 0.4.x has no differentiation rule for the primitive). Built
+    lazily so this module stays importable without tracing jax."""
+    global _identity_barrier
+    if _identity_barrier is None:
+        import jax
+
+        @jax.custom_vjp
+        def barrier(args):
+            return jax.lax.optimization_barrier(args)
+
+        barrier.defvjp(_identity_barrier_fwd, _identity_barrier_bwd)
+        _identity_barrier = barrier
+    return _identity_barrier
+
+
+def prefetch_gather(param_arrays: Sequence, groups: Sequence[Sequence[int]],
+                    depth: int = 1) -> List:
+    """Traced ZeRO-3 parameter gather, optionally layer-ahead-chained.
+
+    For each module group (``layer_param_groups`` order) emit an
+    EXPLICIT all-gather of its sharded parameters (a replicated
+    sharding constraint — GSPMD lowers it to the gather). With
+    ``depth >= 1`` the gathers are chained with an optimization barrier
+    so group ``i``'s gather cannot issue before group ``i - depth``'s
+    gathered values exist: live replicated memory stays bounded to
+    ~``depth`` module groups while each gather is free to overlap the
+    PREVIOUS groups' compute (a gather depends only on earlier gathers,
+    never on compute). ``depth <= 0`` emits the same gathers UNCHAINED
+    (the eager gather-all schedule — XLA may hoist every gather to the
+    step start). Both shapes feed the model math the SAME gathered
+    (replicated) values, so eager-vs-prefetch is bitwise by
+    construction; the identity-VJP barrier keeps gradients bitwise
+    too.
+    """
+    import jax
+    from jax.sharding import PartitionSpec
+    out = list(param_arrays)
+    gathered_groups: List[List] = []
+    barrier = _get_identity_barrier()
+    chained_depth = int(depth)
+    for gi, idxs in enumerate(groups):
+        arrs = [out[i] for i in idxs]
+        if not arrs:
+            gathered_groups.append([])
+            continue
+        anchors = ()
+        if chained_depth >= 1:
+            anchor_gi = gi - chained_depth
+            if anchor_gi >= 0:
+                for back in range(anchor_gi, -1, -1):
+                    if gathered_groups[back]:
+                        # anchor on EVERY array of the group: an edge to
+                        # only its first member would leave the
+                        # scheduler free to hoist the siblings' gathers
+                        # arbitrarily early, voiding the ~depth-groups
+                        # live-memory bound
+                        anchors = tuple(gathered_groups[back])
+                        break
+        if anchors:
+            # anchors ride through stop_gradient: their only role is
+            # ordering, and a second cotangent path through the barrier
+            # would perturb the anchors' gradient accumulation order
+            chained = barrier(tuple(arrs) + tuple(
+                jax.lax.stop_gradient(a) for a in anchors))
+            arrs = list(chained[:len(arrs)])
+        gathered = [mesh_mod.constrain(a, PartitionSpec())
+                    for a in arrs]
+        for i, g in zip(idxs, gathered):
+            out[i] = g
+        gathered_groups.append(gathered)
+    return out

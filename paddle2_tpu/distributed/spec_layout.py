@@ -1,0 +1,143 @@
+"""ICI-vs-DCN-aware sharding defaults for hybrid parallelism.
+
+A pod slice has two very different wire classes: ICI (the intra-slice
+torus, ~90 GB/s per link) and DCN (the cross-slice data-center network,
+~12.5 GB/s per host). A collective over a DCN-mapped mesh axis is an
+order of magnitude slower per byte, so the axis PLACEMENT decides
+whether hybrid parallelism scales:
+
+* **tp** (tensor parallel) all-reduces activations on the critical path
+  every layer — it must live on the innermost (ICI-adjacent) axis;
+* **fsdp/sharding** (ZeRO) gathers parameters every step — ICI;
+* **pp** (pipeline) moves only microbatch activations point-to-point —
+  tolerant, between the two;
+* **dp** (data parallel) all-reduces gradients ONCE per step and the
+  reduction overlaps backward — the only traffic that survives DCN, so
+  dp goes outermost (cross-slice).
+
+:class:`SpecLayout` (the SNIPPETS [3] idiom) names the axes once and
+hands out canonical PartitionSpecs for transformer parameters plus the
+matching :class:`~paddle2_tpu.observability.cost_model.LinkModel`;
+:func:`hybrid_mesh` builds the global mesh in that DCN-outermost /
+ICI-innermost order and (on TPU) applies the latency-hiding-scheduler
+XLA flags from :mod:`paddle2_tpu.flags`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as mesh_mod
+
+__all__ = ["SpecLayout", "hybrid_mesh", "installed_layout"]
+
+# the layout hybrid_mesh last installed alongside the global mesh —
+# mesh.dcn_axes() consults it so the axis placement and the link model
+# pricing that traffic can never disagree
+_installed: Optional["SpecLayout"] = None
+
+
+def installed_layout() -> Optional["SpecLayout"]:
+    """The :class:`SpecLayout` of the last :func:`hybrid_mesh` install
+    (None when the mesh was built some other way)."""
+    return _installed
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Canonical PartitionSpecs for hybrid-parallel transformer state.
+
+    Axis names follow this repo's ``HYBRID_AXES`` convention (``dp``,
+    ``pp``, ``sharding``, ``mp``); ``dcn_axes`` names the axes that map
+    onto the data-center network — by default only ``dp``, the one kind
+    of traffic whose once-per-step overlappable gradient reduction
+    tolerates the slow wire.
+    """
+
+    data_axis: str = "dp"
+    pp_axis: str = "pp"
+    fsdp_axis: str = "sharding"
+    tp_axis: str = "mp"
+    dcn_axes: Tuple[str, ...] = ("dp",)
+
+    # -- activation / batch placement -----------------------------------
+    def batch(self, ndim: int = 2) -> P:
+        """Batch dim sharded over dp (and fsdp when present): the global
+        batch splits across every data-ish axis."""
+        return P((self.data_axis, self.fsdp_axis),
+                 *([None] * max(0, ndim - 1)))
+
+    # -- parameter placement (Megatron-style transformer) ----------------
+    def embeddings(self) -> P:
+        """Embedding tables: vocab dim over fsdp×tp, hidden replicated."""
+        return P((self.fsdp_axis, self.tp_axis), None)
+
+    def qkv_projection(self) -> P:
+        """Column-parallel [hidden, 3*head_dim]: fsdp rows, tp cols."""
+        return P(self.fsdp_axis, self.tp_axis)
+
+    def attn_output(self) -> P:
+        """Row-parallel output projection: tp rows, fsdp cols."""
+        return P(self.tp_axis, self.fsdp_axis)
+
+    def ffn_up(self) -> P:
+        return P(self.fsdp_axis, self.tp_axis)
+
+    def ffn_down(self) -> P:
+        return P(self.tp_axis, self.fsdp_axis)
+
+    def norm_scale(self) -> P:
+        """Norm gains/biases: tiny — replicate everywhere."""
+        return P()
+
+    # -- mesh / link topology -------------------------------------------
+    def mesh_axes(self, dp: int = 1, pp: int = 1, fsdp: int = 1,
+                  tp: int = 1) -> Dict[str, int]:
+        """Axis→degree in rank-major mesh order: dp OUTERMOST (adjacent
+        ranks differ in the innermost axis, so the innermost axes land
+        on ICI-adjacent chips), tp INNERMOST. Degree-1 axes are kept so
+        PartitionSpecs naming them stay valid on every topology."""
+        return {self.data_axis: int(dp), self.pp_axis: int(pp),
+                self.fsdp_axis: int(fsdp), self.tp_axis: int(tp)}
+
+    def is_dcn(self, axis: str) -> bool:
+        """Delegates to the matching :class:`LinkModel` so there is ONE
+        owner of the rule (this layout's axes + the ``PADDLE_DCN_AXES``
+        env list + the ``"dcn"`` name convention)."""
+        return self.link_model().is_dcn(axis)
+
+    def link_model(self, ici_gbps: Optional[float] = None,
+                   dcn_gbps: Optional[float] = None):
+        """The matching cost-model link table: this layout's dcn axes
+        charged at DCN bandwidth, everything else ICI."""
+        from ..observability.cost_model import LinkModel
+        return LinkModel(ici_gbps=ici_gbps, dcn_gbps=dcn_gbps,
+                         dcn_axes=self.dcn_axes)
+
+
+def hybrid_mesh(dp: int = 1, pp: int = 1, fsdp: int = 1, tp: int = 1,
+                layout: Optional[SpecLayout] = None,
+                devices: Optional[Sequence] = None,
+                apply_xla_flags: bool = True):
+    """Build (and install) the hybrid mesh in DCN-outermost order and
+    return ``(mesh, layout)``.
+
+    On TPU platforms this also applies the latency-hiding-scheduler /
+    async-collective XLA flags registered in :mod:`paddle2_tpu.flags`
+    (a no-op on CPU, and a no-op once the backend is initialized —
+    call before the first compile, launcher-style)."""
+    layout = layout or SpecLayout()
+    axes = layout.mesh_axes(dp=dp, pp=pp, fsdp=fsdp, tp=tp)
+    n = 1
+    for v in axes.values():
+        n *= v
+    if apply_xla_flags and n > 1:
+        from ..flags import apply_multichip_xla_env
+        apply_multichip_xla_env()
+    mesh = mesh_mod.init_mesh(axes, devices=devices)
+    global _installed
+    _installed = layout
+    return mesh, layout

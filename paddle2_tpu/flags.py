@@ -159,3 +159,113 @@ define_flag("donate_optimizer_buffers", True,
             "executable (XLA in-place aliasing; saves ~3x model size of HBM "
             "traffic per step). Disable if you hold aliases of parameter "
             "arrays across optimizer steps.")
+
+
+# -- XLA comm/compute-overlap knobs (multichip) -----------------------------
+# The latency-hiding scheduler and async collectives are what turn the
+# bucketed grad reduces and ZeRO-3 prefetch gathers from SERIAL wire
+# time into overlapped wire time. They are compiler-process-wide
+# XLA_FLAGS, so they are NEVER applied implicitly: only
+# apply_multichip_xla_env() (called by launchers / hybrid_mesh for
+# multichip TPU runs) mutates the environment, and only before backend
+# init — a single-chip CPU test compile never sees them.
+define_flag("xla_latency_hiding_scheduler", True,
+            "Schedule XLA collectives with the latency-hiding scheduler "
+            "so in-flight collectives overlap independent compute "
+            "(bucketed grad reduces under backward, ZeRO-3 prefetch "
+            "gathers under the previous layer). Takes effect only via "
+            "apply_multichip_xla_env() before backend init; no-op on "
+            "CPU.")
+define_flag("xla_async_collectives", True,
+            "Lower all-gather / all-reduce / collective-permute as "
+            "async start/done pairs so the scheduler can move compute "
+            "between them. Takes effect only via "
+            "apply_multichip_xla_env() before backend init; no-op on "
+            "CPU.")
+
+# flag name -> XLA_FLAGS tokens it expands to (tokens carry explicit
+# ={true|false} so disabling a knob can OVERRIDE an operator default)
+_XLA_PERF_FLAG_TOKENS = {
+    "xla_latency_hiding_scheduler": (
+        "--xla_tpu_enable_latency_hiding_scheduler={v}",
+        "--xla_tpu_overlap_compute_collective_tc={v}",
+    ),
+    "xla_async_collectives": (
+        "--xla_enable_async_all_gather={v}",
+        "--xla_enable_async_collective_permute={v}",
+        "--xla_tpu_enable_async_collective_fusion={v}",
+        "--xla_tpu_enable_async_collective_fusion_fuse_all_gather={v}",
+    ),
+}
+
+
+def multichip_xla_flag_tokens() -> List[str]:
+    """The XLA_FLAGS tokens the current knob values expand to."""
+    out: List[str] = []
+    for name, tokens in _XLA_PERF_FLAG_TOKENS.items():
+        v = "true" if flag_value(name) else "false"
+        out.extend(t.format(v=v) for t in tokens)
+    return out
+
+
+def _probe_tpu_devices() -> bool:
+    """Host exposes TPU device nodes: ``/dev/accel*`` (the TPU driver's
+    char devices), or a VFIO group backed by a Google (PCI vendor
+    0x1ae0) accelerator (v5e+ attach via vfio). Bare ``/dev/vfio/*`` is
+    NOT sufficient — GPU-passthrough VMs expose those too, and the
+    TPU-only XLA flags abort XLA startup on non-TPU backends."""
+    import glob
+    if glob.glob("/dev/accel*"):
+        return True
+    if glob.glob("/dev/vfio/*"):
+        for vf in glob.glob("/sys/bus/pci/devices/*/vendor"):
+            try:
+                with open(vf) as f:
+                    if f.read().strip().lower() == "0x1ae0":
+                        return True
+            except OSError:
+                continue
+    return False
+
+
+def _env_platform(env) -> str:
+    """Best-effort target platform from the environment WITHOUT
+    importing (or initializing) jax."""
+    for key in ("JAX_PLATFORMS", "JAX_PLATFORM_NAME", "PJRT_DEVICE"):
+        val = str(env.get(key, "")).strip().lower()
+        if val:
+            return val.split(",")[0]
+    # no explicit platform: a Cloud TPU VM typically sets NONE of the
+    # above (jax autodetects the chips) — probe the accelerator device
+    # files directly so the overlap flags are not silently skipped on
+    # the very hosts they exist for. Only consulted when env is the
+    # real process environment (a caller-supplied env dict describes a
+    # DIFFERENT process whose host we cannot see).
+    if env is os.environ and _probe_tpu_devices():
+        return "tpu"
+    return ""
+
+
+def apply_multichip_xla_env(env=None, platform: Optional[str] = None
+                            ) -> str:
+    """Append the overlap-scheduling XLA flags to ``env['XLA_FLAGS']``
+    and return the resulting string.
+
+    Guard rails, because XLA_FLAGS is process-wide: (a) NO-OP unless
+    the target platform is TPU — ``platform`` explicit, else detected
+    from env vars without touching jax, so a CPU test process is never
+    mutated; (b) idempotent — a token already present (from the
+    operator or a previous call) is never duplicated, and the
+    operator's existing value WINS over the knob default."""
+    env = os.environ if env is None else env
+    plat = (platform or _env_platform(env) or "").lower()
+    if not plat.startswith("tpu"):
+        return env.get("XLA_FLAGS", "")
+    existing = env.get("XLA_FLAGS", "")
+    have = {t.split("=", 1)[0] for t in existing.split() if t}
+    added = [t for t in multichip_xla_flag_tokens()
+             if t.split("=", 1)[0] not in have]
+    if added:
+        env["XLA_FLAGS"] = " ".join(([existing] if existing else [])
+                                    + added)
+    return env.get("XLA_FLAGS", "")

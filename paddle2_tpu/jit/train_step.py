@@ -220,10 +220,16 @@ class TrainStepProgram:
                     "gradient-accumulation step is not supported — "
                     "run AMP without dist.shard_optimizer accumulation")
 
+        # ZeRO-3 prefetch is a schedule shape baked into the trace —
+        # toggling it must key a distinct cache entry
+        prefetch = (self._zero is not None and self._zero._level >= 3
+                    and getattr(self._zero, "_prefetch", False))
+        prefetch_depth = (getattr(self._zero, "_prefetch_depth", 1)
+                          if prefetch else 0)
         key = _guard_key(template, arg_arrays, self.layers) + (
             len(opt_params), need_clip, decay_flags, donate, k,
             apply_update, self._accum_avg, self._instrument,
-            has_scaler, fault)
+            has_scaler, fault, prefetch, prefetch_depth)
         entry = self._compiled.get(key)
         built_now = entry is None
         if built_now:
@@ -381,8 +387,31 @@ class TrainStepProgram:
         update = self.inner_optimizer._build_update(need_clip, decay_flags)
         state_tensors = list(opt_params) + list(frozen) + list(buffers)
 
+        # ZeRO-3: the forward re-gather of sharded params is made
+        # EXPLICIT — one all-gather (replicated constraint) per module
+        # group — on BOTH schedules, so the model math always sees the
+        # same gathered values and eager-vs-prefetch stays bitwise by
+        # construction (GSPMD left to regather implicitly may partition
+        # the consuming matmuls differently — a rounding-order change).
+        # prefetch=False: gathers unchained (gather-all, scheduler
+        # free). prefetch=True: barrier-chained so gather i waits only
+        # on gather i-depth (never on compute) — the latency-hiding
+        # scheduler overlaps it with the previous layer's math while
+        # replicated live memory stays bounded to ~depth groups.
+        prefetch_groups = None
+        prefetch_depth = 0
+        if self._zero is not None and self._zero._level >= 3:
+            from ..distributed.sharding import layer_param_groups
+            prefetch_groups = layer_param_groups(self.layers, opt_params)
+            if getattr(self._zero, "_prefetch", False):
+                prefetch_depth = self._zero._prefetch_depth
+
         def run_model(param_arrays, frozen_arrays, buffer_arrays,
                       arg_arrays, rng_key):
+            if prefetch_groups is not None:
+                from ..distributed.sharding import prefetch_gather
+                param_arrays = prefetch_gather(
+                    list(param_arrays), prefetch_groups, prefetch_depth)
             out, post_buffers = _rebound_call(
                 fn, state_tensors,
                 list(param_arrays) + list(frozen_arrays)

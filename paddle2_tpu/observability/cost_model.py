@@ -217,16 +217,26 @@ class LinkModel:
 
 class CollectiveTraffic:
     """Accumulator of per-step collective dispatches -> wire bytes and
-    a deterministic transfer-time estimate."""
+    a deterministic transfer-time estimate.
+
+    Each entry carries an ``overlappable`` mark: whether the program's
+    schedule leaves independent compute for this collective to hide
+    under (a bucketed grad reduce issued while backward still produces
+    later buckets, a ZeRO-3 prefetch gather issued a layer ahead). The
+    overlap split below is what turns "bytes on wire" into "EXPOSED
+    wire time" — the only part of communication that actually extends
+    the step."""
 
     def __init__(self):
         self.entries: List[Dict[str, Any]] = []
 
     def add(self, op: str, payload_bytes: float,
-            axes: Sequence[str] = (), group_size: int = 1) -> None:
+            axes: Sequence[str] = (), group_size: int = 1,
+            overlappable: bool = False) -> None:
         self.entries.append({
             "op": op, "payload_bytes": float(payload_bytes),
             "axes": tuple(axes), "group_size": int(group_size),
+            "overlappable": bool(overlappable),
             "wire_bytes": wire_bytes(op, payload_bytes, group_size)})
 
     def wire_bytes_total(self) -> float:
@@ -235,10 +245,41 @@ class CollectiveTraffic:
     def payload_bytes_total(self) -> float:
         return sum(e["payload_bytes"] for e in self.entries)
 
+    def overlappable_wire_bytes(self) -> float:
+        return sum(e["wire_bytes"] for e in self.entries
+                   if e["overlappable"])
+
+    def exposed_wire_bytes(self) -> float:
+        return sum(e["wire_bytes"] for e in self.entries
+                   if not e["overlappable"])
+
     def seconds(self, link: Optional[LinkModel] = None) -> float:
         link = link or LinkModel()
         return sum(link.seconds(e["wire_bytes"], e["axes"])
                    for e in self.entries)
+
+    def overlap_split(self, link: Optional[LinkModel] = None,
+                      compute_s: float = 0.0) -> Dict[str, float]:
+        """Split this step's wire time into EXPOSED vs HIDDEN given the
+        link model and the compute time available as overlap budget.
+
+        Deterministic model: overlappable entries hide under compute up
+        to ``compute_s`` total (the latency-hiding scheduler cannot
+        conjure more independent compute than the step has);
+        non-overlappable entries are always exposed. Returns
+        ``{"serial_s", "hideable_s", "hidden_s", "exposed_s"}`` with
+        ``serial_s == hidden_s + exposed_s`` exactly."""
+        link = link or LinkModel()
+        hideable = sum(link.seconds(e["wire_bytes"], e["axes"])
+                       for e in self.entries if e["overlappable"])
+        base_exposed = sum(link.seconds(e["wire_bytes"], e["axes"])
+                           for e in self.entries
+                           if not e["overlappable"])
+        hidden = min(hideable, max(0.0, float(compute_s)))
+        return {"serial_s": hideable + base_exposed,
+                "hideable_s": hideable,
+                "hidden_s": hidden,
+                "exposed_s": base_exposed + (hideable - hidden)}
 
     def by_op(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
@@ -278,6 +319,33 @@ class StepCost:
     def network_s(self) -> float:
         return self.traffic.seconds(self.link)
 
+    def overlap(self) -> Dict[str, float]:
+        """The exposed/hidden wire-time split under this step's own
+        compute budget (``CollectiveTraffic.overlap_split``)."""
+        return self.traffic.overlap_split(self.link, self.compute_s())
+
+    def exposed_network_s(self) -> float:
+        """Wire time that actually EXTENDS the step: non-overlappable
+        collectives plus whatever overlappable wire time exceeds the
+        compute available to hide it."""
+        return self.overlap()["exposed_s"]
+
+    def exposed_comm_fraction(self) -> float:
+        """Exposed wire time as a fraction of the modeled step
+        (``exposed / (max(compute, memory) + exposed)``) — the number
+        perf_doctor reports as exposed-comm %."""
+        t = self.step_time_modeled_s()
+        return self.exposed_network_s() / t if t > 0 else 0.0
+
+    def step_time_modeled_s(self) -> float:
+        """Schedule-aware step-time model: compute (or HBM, whichever
+        binds) runs back-to-back while overlappable collectives hide
+        under it; only EXPOSED wire time extends the step. This is the
+        cost x rate number the scaling-efficiency gate compares across
+        chip counts — deterministic, no wall clock anywhere."""
+        return max(self.compute_s(), self.memory_s()) \
+            + self.exposed_network_s()
+
     def step_time_lower_bound_s(self) -> float:
         """Perfect-overlap model: the step cannot run faster than its
         slowest resource."""
@@ -307,6 +375,7 @@ class StepCost:
 
     def roofline(self) -> Dict[str, Any]:
         ai = self.arithmetic_intensity()
+        ov = self.overlap()
         return {
             "flops": self.flops,
             "hbm_bytes": self.hbm_bytes,
@@ -314,6 +383,10 @@ class StepCost:
             "compute_s": self.compute_s(),
             "memory_s": self.memory_s(),
             "network_s": self.network_s(),
+            "exposed_network_s": ov["exposed_s"],
+            "hidden_network_s": ov["hidden_s"],
+            "exposed_comm_fraction": self.exposed_comm_fraction(),
+            "step_time_modeled_s": self.step_time_modeled_s(),
             "step_time_lower_bound_s": self.step_time_lower_bound_s(),
             "bound": self.bound(),
             "arithmetic_intensity": ai,

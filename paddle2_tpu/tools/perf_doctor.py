@@ -206,6 +206,25 @@ def summarize(streams: Dict[int, Dict[str, Any]],
         }
         for c in COMPONENTS:
             entry[f"mean_{c}"] = _mean([x.get(c, 0.0) for x in steps])
+        # exposed-comm %: wire time that EXTENDED the step. Prefer the
+        # modeled figure (cost-model overlap accounting stamped into
+        # step records as `exposed_comm_s` by cost x rate benches);
+        # fall back to the measured collective phase — eager collective
+        # dispatch wall time is exposed by construction (the host
+        # blocked on it), while compiled-step collectives never show up
+        # there at all.
+        exp = [x["exposed_comm_s"] for x in steps
+               if "exposed_comm_s" in x]
+        if exp:
+            entry["mean_exposed_comm_s"] = _mean(exp)
+            entry["exposed_comm_source"] = "modeled"
+        else:
+            entry["mean_exposed_comm_s"] = entry["mean_collective_s"]
+            entry["exposed_comm_source"] = "collective-wall"
+        if entry["mean_total_s"] > 0:
+            entry["exposed_comm_pct"] = (
+                100.0 * entry["mean_exposed_comm_s"]
+                / entry["mean_total_s"])
         toks = [x["tokens"] for x in steps if "tokens" in x]
         secs = [x["total_s"] for x in steps if "tokens" in x]
         if toks and sum(secs) > 0:
@@ -239,6 +258,14 @@ def summarize(streams: Dict[int, Dict[str, Any]],
                if "tokens_per_s" in e]
         if tps:
             agg["tokens_per_s_total"] = sum(tps)
+        pcts = [e["exposed_comm_pct"] for e in per.values()
+                if "exposed_comm_pct" in e]
+        if pcts:
+            agg["exposed_comm_pct"] = _mean(pcts)
+            srcs = {e["exposed_comm_source"] for e in per.values()
+                    if "exposed_comm_source" in e}
+            agg["exposed_comm_source"] = (srcs.pop() if len(srcs) == 1
+                                          else "mixed")
         if agg["mean_total_s"] > 0:
             agg["breakdown_pct"] = {
                 _COMPONENT_LABEL[c]: 100.0 * agg[f"mean_{c}"]
@@ -295,6 +322,21 @@ def diff(base: Dict[str, Any], new: Dict[str, Any],
         "threshold_pct": threshold_pct,
         "regressed": total_delta_pct > threshold_pct,
     }
+    # exposed-comm % delta: an overlap regression (a bucket that
+    # stopped hiding under backward, a prefetch that went eager) shows
+    # up HERE even when total step time moved for other reasons too.
+    # Only COMPARABLE when both sides measured it the same way — a
+    # modeled stream diffed against a collective-wall fallback stream
+    # is a metric-source change, not an overlap change.
+    if "exposed_comm_pct" in a or "exposed_comm_pct" in b:
+        sa = a.get("exposed_comm_source")
+        sb = b.get("exposed_comm_source")
+        out["exposed_comm_pct"] = {
+            "base": a.get("exposed_comm_pct", 0.0),
+            "new": b.get("exposed_comm_pct", 0.0),
+            "base_source": sa, "new_source": sb,
+            "comparable": sa == sb and sa is not None
+            and sa != "mixed"}
     # counter deltas that explain a regression (retries eat wall time)
     cdeltas = {}
     for cname in _RELIABILITY_COUNTERS:
@@ -336,10 +378,16 @@ def format_summary(report: Dict[str, Any], directory: str) -> str:
     if "tokens_per_s_total" in agg:
         L.append(f"  throughput: {agg['tokens_per_s_total']:,.0f} "
                  f"tokens/s aggregate")
+    if "exposed_comm_pct" in agg:
+        L.append(f"  exposed-comm: {agg['exposed_comm_pct']:.1f}% of "
+                 f"step (wire time NOT hidden under compute)")
     for r, e in sorted(report["per_rank"].items()):
         extra = ""
         if "tokens_per_s" in e:
             extra = f"  {e['tokens_per_s']:,.0f} tok/s"
+        if "exposed_comm_pct" in e:
+            extra += (f"  exposed-comm {e['exposed_comm_pct']:.1f}% "
+                      f"[{e['exposed_comm_source']}]")
         if e.get("warmup_included"):
             extra += "  [WARMUP INCLUDED: stream shorter than warmup]"
         L.append(f"  rank {r}: {e['steps']} steps, mean "
@@ -404,6 +452,16 @@ def format_diff(d: Dict[str, Any]) -> str:
                  f" per step)")
     else:
         L.append("no component regressed")
+    ec = d.get("exposed_comm_pct")
+    if ec:
+        if ec.get("comparable"):
+            tag = ("  (OVERLAP REGRESSION)"
+                   if ec["new"] > ec["base"] + 1.0 else "")
+        else:
+            tag = (f"  [incomparable: {ec['base_source']} vs "
+                   f"{ec['new_source']}]")
+        L.append(f"  exposed-comm: {ec['base']:.1f}% -> "
+                 f"{ec['new']:.1f}% of step{tag}")
     for name, c in sorted(d.get("counter_deltas", {}).items()):
         L.append(f"  counter {name}: {c['base']:g} -> {c['new']:g}")
     L.append(f"verdict: "
