@@ -10,6 +10,7 @@ recorded but inert — XLA owns codegen on TPU.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -26,17 +27,23 @@ class Config:
         if prog_file and prog_file.endswith(".pdmodel"):
             prog_file = prog_file[:-len(".pdmodel")]
         self._prefix = prog_file
+        self._params_file = params_file          # explicit path wins
         self._use_gpu = False
         self._device_id = 0
         self._cpu_math_threads = 1
         self._memory_optim = True
         self._ir_optim = True
         self._switches: Dict[str, bool] = {}
+        self._serving: Optional[dict] = None
 
     # -- model location --------------------------------------------------
     def set_model(self, prog_file: str, params_file: Optional[str] = None):
         self._prefix = prog_file[:-len(".pdmodel")] \
             if prog_file.endswith(".pdmodel") else prog_file
+        # an explicit params_file is honored verbatim (the reference
+        # contract — weights may live under a different prefix than the
+        # program); omitting it falls back to prefix-derived
+        self._params_file = params_file
 
     def model_dir(self):
         return os.path.dirname(self._prefix or "")
@@ -45,7 +52,33 @@ class Config:
         return (self._prefix or "") + ".pdmodel"
 
     def params_file(self):
+        if self._params_file:
+            return self._params_file
         return (self._prefix or "") + ".pdiparams"
+
+    # -- serving (paddle2_tpu.serving integration) -----------------------
+    def enable_continuous_batching(self, **engine_kwargs):
+        """Route this config to the continuous-batching
+        :class:`~paddle2_tpu.serving.ServingEngine` instead of the
+        one-request-at-a-time Predictor. ``engine_kwargs`` are
+        :class:`~paddle2_tpu.serving.EngineConfig` fields (block_size,
+        num_blocks, max_batch, weight_only_int8, ...). Build the
+        engine with :meth:`create_serving_engine` — it needs the GPT
+        architecture config, which the serialized artifact does not
+        carry."""
+        self._serving = dict(engine_kwargs)
+
+    def continuous_batching_enabled(self) -> bool:
+        return self._serving is not None
+
+    def create_serving_engine(self, gpt_config):
+        from .serving import EngineConfig, ServingEngine
+        if self._serving is None:
+            raise ValueError("call enable_continuous_batching() first")
+        return ServingEngine(artifact_path=self._prefix,
+                             artifact_params_path=self.params_file(),
+                             gpt_config=gpt_config,
+                             config=EngineConfig(**self._serving))
 
     # -- device knobs (recorded; XLA decides on TPU) ---------------------
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
@@ -105,7 +138,9 @@ class Predictor:
             raise ValueError(
                 f"no program at {config.prog_file()}; produce it with "
                 "paddle.jit.save(layer, path, input_spec=[...])")
-        self._loaded = jit_load(config._prefix)
+        # honor an explicitly-set params file (set_model's second arg)
+        self._loaded = jit_load(config._prefix,
+                                params_path=config.params_file())
         self._config = config
         self._n_inputs = None
         self._feed: Dict[str, np.ndarray] = {}
@@ -151,11 +186,45 @@ def create_predictor(config: Config) -> Predictor:
 
 
 class PredictorPool:
+    """Fixed pool of Predictors for multi-threaded callers
+    (paddle_infer::services::PredictorPool parity).
+
+    Hand-out is thread-safe: ``acquire()`` pops the oldest free slot
+    (FIFO) under a condition variable and ``release()`` returns it —
+    the free-list bookkeeping is the shared state; Predictor.run
+    itself is per-instance. ``retrieve(idx)`` keeps the reference's
+    direct-index contract."""
+
     def __init__(self, config: Config, size: int = 1):
         self._preds = [Predictor(config) for _ in range(size)]
+        self._mu = threading.Lock()
+        self._free = list(range(size))
+        self._cv = threading.Condition(self._mu)
 
     def retrieve(self, idx: int) -> Predictor:
         return self._preds[idx]
+
+    def acquire(self, timeout: Optional[float] = None) -> Predictor:
+        """Check out a free Predictor (blocks until one is released;
+        raises TimeoutError past ``timeout`` seconds)."""
+        with self._cv:
+            if not self._cv.wait_for(lambda: bool(self._free),
+                                     timeout=timeout):
+                raise TimeoutError("no free Predictor in pool")
+            idx = self._free.pop(0)
+            pred = self._preds[idx]
+            pred._pool_idx = idx
+            return pred
+
+    def release(self, pred: Predictor) -> None:
+        with self._cv:
+            idx = getattr(pred, "_pool_idx", None)
+            if idx is None or self._preds[idx] is not pred:
+                raise ValueError("predictor does not belong to this pool")
+            if idx in self._free:
+                raise ValueError(f"double release of pool slot {idx}")
+            self._free.append(idx)
+            self._cv.notify()
 
 
 def get_version() -> str:

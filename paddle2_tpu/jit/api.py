@@ -343,10 +343,13 @@ def save(layer, path, input_spec=None, **configs):
                     protocol=4)
 
 
-def load(path, **configs) -> TranslatedLayer:
+def load(path, params_path=None, **configs) -> TranslatedLayer:
     """jit.load: returns a CALLABLE TranslatedLayer executing the exported
-    program (api.py:1065 contract)."""
-    with open(path + ".pdiparams", "rb") as f:
+    program (api.py:1065 contract). ``params_path`` overrides the
+    prefix-derived ``path + '.pdiparams'`` — the hook
+    ``inference.Config.set_model(prog_file, params_file)`` uses when
+    weights live under a different prefix than the program."""
+    with open(params_path or (path + ".pdiparams"), "rb") as f:
         payload = pickle.load(f)
     exported = treedef = None
     model_path = path + ".pdmodel"

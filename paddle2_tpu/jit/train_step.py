@@ -303,11 +303,14 @@ class TrainStepProgram:
 
     def _note_step_metrics(self, pl, args_t, has_scaler: bool) -> None:
         """Close this dispatch's step window: tokens/samples inferred
-        from the first batch argument (exactly-2-D SIGNED-int ids ->
+        from the first batch argument (exactly-2-D int16/32/64 ids ->
         B*S tokens; uint8 image batches and >2-D int features must not
-        masquerade as token counts), loss scale when AMP is fused,
-        program-cache gauge. Reads NOTHING off the device — host-known
-        values only."""
+        masquerade as token counts, and int8 is EXCLUDED outright —
+        2-D int8 first args are quantized payloads, e.g. a serving
+        engine's int8 KV blocks, never plausible token ids; serving
+        reports its token counts explicitly via step_end(tokens=...)),
+        loss scale when AMP is fused, program-cache gauge. Reads
+        NOTHING off the device — host-known values only."""
         tokens = samples = None
         if args_t:
             shp = tuple(args_t[0].shape)
@@ -315,7 +318,7 @@ class TrainStepProgram:
                 samples = int(shp[0])
             if (len(shp) == 2
                     and str(args_t[0].dtype) in
-                    ("int8", "int16", "int32", "int64")):
+                    ("int16", "int32", "int64")):
                 tokens = int(shp[0]) * int(shp[1])
         scale = (self._scaler.get_loss_scaling()
                  if has_scaler and self._scaler is not None else None)

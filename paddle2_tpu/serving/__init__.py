@@ -1,0 +1,47 @@
+"""paddle2_tpu.serving — production LLM inference serving.
+
+The millions-of-users path on top of the single-request
+``paddle.inference`` surface (ROADMAP item 2): continuous batching
+(Orca, Yu et al. OSDI'22), a paged KV cache with a Pallas
+paged-attention decode kernel (vLLM PagedAttention, Kwon et al.
+SOSP'23), prefill/decode disaggregation, opt-in int8 weight-only
+quantization of the projection matmuls, and a deterministic
+discrete-event serving bench driven by the PR 7 XLA cost model.
+
+Entry points:
+
+* :class:`ServingEngine` (``engine.py``) — wraps a ``jit.save``'d GPT
+  artifact (or a live model) behind ``submit()``/``step()``;
+  ``inference.Config.enable_continuous_batching()`` routes here.
+* :func:`paged_attention_decode` (``paged_attention.py``) — the
+  decode kernel; ``paged_attention_reference`` is its proven-bitwise
+  dense twin.
+* :class:`ContinuousBatchingScheduler` (``scheduler.py``) —
+  admit/evict per decode step with bucketed batch shapes.
+* :func:`simulate` (``simulate.py``) — the cost x rate
+  discrete-event driver ``bench.py --serving`` gates on.
+"""
+
+from .block_cache import (BlockAllocator, BlockTable, PagedKVCache,
+                          blocks_for_tokens, GARBAGE_BLOCK)
+from .block_cache import OutOfBlocksError
+from .paged_attention import (paged_attention_decode,
+                              paged_attention_reference,
+                              gathered_dense_kv)
+from .scheduler import (Request, Sequence, SeqState,
+                        ContinuousBatchingScheduler, SchedulerConfig)
+from .engine import ServingEngine, EngineConfig
+from .simulate import (ServingSimReport, simulate_serving,
+                       simulate_predictor_baseline, poisson_trace)
+
+__all__ = [
+    "BlockAllocator", "BlockTable", "PagedKVCache", "blocks_for_tokens",
+    "GARBAGE_BLOCK", "OutOfBlocksError",
+    "paged_attention_decode", "paged_attention_reference",
+    "gathered_dense_kv",
+    "Request", "Sequence", "SeqState", "ContinuousBatchingScheduler",
+    "SchedulerConfig",
+    "ServingEngine", "EngineConfig",
+    "ServingSimReport", "simulate_serving", "simulate_predictor_baseline",
+    "poisson_trace",
+]

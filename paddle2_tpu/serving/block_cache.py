@@ -1,0 +1,238 @@
+"""Paged KV cache: fixed-size blocks + per-sequence block tables.
+
+The vLLM PagedAttention memory model (Kwon et al. SOSP'23) adapted to
+the TPU serving engine: the KV cache for ALL sequences lives in one
+pool of fixed-size blocks per layer, and each sequence owns an ordered
+list of block ids (its *block table*). Appending a token never copies
+anything — the new K/V lands in the next free slot of the sequence's
+last block, and a fresh block is taken from the free list only when
+the last one fills. Fragmentation is bounded to < one block per
+sequence instead of the (max_seq_len - actual_len) waste of a
+contiguous per-request cache — the source of the >= 45% memory win the
+serving bench gates.
+
+Host/device split:
+
+* :class:`BlockAllocator` / :class:`BlockTable` are pure-host
+  bookkeeping (free list, per-sequence id lists, high-water mark) —
+  cheap python between decode steps, never traced.
+* :class:`PagedKVCache` owns the device pools — one
+  ``[layers, num_blocks, block_size, heads, head_dim]`` array for K
+  and one for V — and the jnp scatter/gather helpers the compiled
+  decode program uses. The pools are donated through the decode
+  program, so appends are in-place on device.
+
+Block 0 is RESERVED as the garbage block: padded (inactive) rows of a
+bucketed decode batch point their table entries at it, so their
+writes land somewhere harmless and never clobber a live sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["BlockAllocator", "BlockTable", "PagedKVCache",
+           "blocks_for_tokens", "GARBAGE_BLOCK"]
+
+# physical block id every padded/inactive batch row writes into
+GARBAGE_BLOCK = 0
+
+# jitted prefill-scatter programs, keyed by array signature
+_PREFILL_SCATTER_CACHE: Dict = {}
+
+
+def blocks_for_tokens(n_tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``n_tokens`` (ceil division)."""
+    return -(-int(n_tokens) // int(block_size))
+
+
+class OutOfBlocksError(RuntimeError):
+    """Free list exhausted — the scheduler turns this into an eviction."""
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_blocks`` fixed-size blocks.
+
+    Block 0 (:data:`GARBAGE_BLOCK`) is reserved at construction and is
+    never handed out. ``high_water`` tracks the peak number of
+    simultaneously-allocated blocks — the serving bench compares
+    ``high_water * block_bytes`` against the contiguous
+    max-seq-len cache a non-paged engine would have to reserve."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is reserved)")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # LIFO free list: recently-freed blocks are re-used first (their
+        # pool slots are warm in cache on real hardware)
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self.high_water = 0
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def allocate(self, n: int = 1) -> List[int]:
+        if n > len(self._free):
+            raise OutOfBlocksError(
+                f"need {n} blocks, {len(self._free)} free "
+                f"(of {self.num_blocks - 1} usable)")
+        out = [self._free.pop() for _ in range(n)]
+        self.high_water = max(self.high_water, self.used_count)
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not (0 < b < self.num_blocks):
+                raise ValueError(f"bad block id {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(blocks)
+
+
+class BlockTable:
+    """One sequence's ordered block ids + token count.
+
+    ``num_tokens`` counts K/V entries actually written; appends extend
+    the table lazily through the owning allocator."""
+
+    def __init__(self, allocator: BlockAllocator):
+        self._alloc = allocator
+        self.blocks: List[int] = []
+        self.num_tokens = 0
+
+    @property
+    def capacity(self) -> int:
+        return len(self.blocks) * self._alloc.block_size
+
+    def ensure_capacity(self, n_tokens: int) -> None:
+        """Grow the table to hold ``n_tokens`` total. Raises
+        :class:`OutOfBlocksError` (eviction trigger) when the free
+        list cannot cover the growth — the table is left unchanged."""
+        need = blocks_for_tokens(n_tokens, self._alloc.block_size) \
+            - len(self.blocks)
+        if need > 0:
+            self.blocks.extend(self._alloc.allocate(need))
+
+    def append_slot(self) -> tuple:
+        """(physical_block, offset) for the NEXT token, growing the
+        table if the current block is full. Bumps ``num_tokens``."""
+        self.ensure_capacity(self.num_tokens + 1)
+        bs = self._alloc.block_size
+        slot = (self.blocks[self.num_tokens // bs],
+                self.num_tokens % bs)
+        self.num_tokens += 1
+        return slot
+
+    def release(self) -> None:
+        """Free every block back to the allocator (eviction / finish)."""
+        if self.blocks:
+            self._alloc.free(self.blocks)
+        self.blocks = []
+        self.num_tokens = 0
+
+    def padded(self, n_pages: int) -> np.ndarray:
+        """int32 table row padded to ``n_pages`` with the garbage
+        block (safe for bucketed kernels: dead pages are masked by the
+        context length, and padded-row writes land in block 0)."""
+        row = np.full((n_pages,), GARBAGE_BLOCK, np.int32)
+        row[:len(self.blocks)] = self.blocks
+        return row
+
+
+class PagedKVCache:
+    """Device pools for a whole model: K and V, each
+    ``[num_layers, num_blocks, block_size, num_heads, head_dim]``.
+
+    Pools start zeroed; stale data in freed blocks is harmless — the
+    paged-attention kernel masks every slot past a sequence's context
+    length, and masked probabilities are exactly 0.0 in fp32."""
+
+    def __init__(self, num_layers: int, num_blocks: int, block_size: int,
+                 num_heads: int, head_dim: int, dtype="float32"):
+        import jax.numpy as jnp
+        self.num_layers = int(num_layers)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = jnp.dtype(dtype)
+        shape = (num_layers, num_blocks, block_size, num_heads, head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+
+    @property
+    def block_bytes(self) -> int:
+        """Bytes one block holds across K+V and all layers."""
+        return (2 * self.num_layers * self.block_size * self.num_heads
+                * self.head_dim * self.dtype.itemsize)
+
+    def bytes_for_blocks(self, n_blocks: int) -> int:
+        return n_blocks * self.block_bytes
+
+    def contiguous_bytes(self, batch: int, max_seq_len: int) -> int:
+        """What a contiguous per-request max-seq-len cache would
+        reserve for ``batch`` sequences — the paged-vs-contiguous
+        comparator the serving bench gates on."""
+        return (2 * self.num_layers * batch * max_seq_len
+                * self.num_heads * self.head_dim * self.dtype.itemsize)
+
+    # -- device ops (traced inside the compiled programs) ---------------
+    @staticmethod
+    def scatter_decode(pool, layer, phys, slot, new_kv):
+        """Write one new token per sequence into ONE layer's lane:
+        ``pool[layer, phys[b], slot[b]] = new_kv[b]``.
+        pool: [L, N, bs, H, D]; phys/slot: int32 [B]; new_kv:
+        [B, H, D]. Traced inside the compiled decode program (which
+        donates the pool), per layer — the decode loop appends each
+        layer's K/V right where it is produced."""
+        return pool.at[:, phys, slot].set(new_kv) if layer is None \
+            else pool.at[layer, phys, slot].set(new_kv)
+
+    @staticmethod
+    def scatter_prefill(pool, layer_kv, block_row, n_tokens, block_size):
+        """Write a prefilled sequence's K/V into its blocks as ONE
+        jitted scatter with the pool DONATED — the eager per-page
+        ``.at[].set`` loop this replaces copied the ENTIRE pool once
+        per page per lane (O(pool x pages) allocator traffic at
+        production pool sizes). pool: [L, N, bs, H, D]; layer_kv:
+        [L, T, H, D] (T >= n_tokens when the prefill ran padded);
+        block_row: int array [n_pages] physical ids. The tiny scatter
+        program is cached per (pool, T, n_tokens) signature."""
+        import jax
+        import jax.numpy as jnp
+        idx = np.arange(int(n_tokens))
+        phys = jnp.asarray(np.asarray(block_row)[idx // block_size],
+                           jnp.int32)
+        slot = jnp.asarray(idx % block_size, jnp.int32)
+        key = (tuple(pool.shape), str(pool.dtype),
+               tuple(layer_kv.shape), int(n_tokens))
+        fn = _PREFILL_SCATTER_CACHE.get(key)
+        if fn is None:
+            n = int(n_tokens)
+            fn = jax.jit(
+                lambda p, kv, ph, sl: p.at[:, ph, sl].set(kv[:, :n]),
+                donate_argnums=(0,))
+            if len(_PREFILL_SCATTER_CACHE) > 1024:
+                _PREFILL_SCATTER_CACHE.clear()
+            _PREFILL_SCATTER_CACHE[key] = fn
+        return fn(pool, layer_kv, phys, slot)
+
+    @staticmethod
+    def gather_dense(pool_layer, block_row, n_pages):
+        """Dense [n_pages*bs, H, D] view of one sequence's K or V via
+        its block table — the reference path's gather."""
+        import jax.numpy as jnp
+        idx = jnp.asarray(block_row[:n_pages], jnp.int32)
+        g = pool_layer[idx]                      # [P, bs, H, D]
+        return g.reshape((-1,) + g.shape[2:])

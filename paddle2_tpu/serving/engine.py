@@ -1,0 +1,314 @@
+"""ServingEngine: continuous batching + paged KV over a GPT model.
+
+The production serving loop (ROADMAP item 2): requests come in via
+``submit()``, the engine prefills them into paged KV blocks, and every
+``decode_once()`` runs ONE bucketed compiled decode step over the
+whole running batch — admissions and evictions happen between steps
+(iteration-level scheduling). Construct it from a live
+``GPTForCausalLM`` or from a ``jit.save``'d artifact (the artifact's
+weights are loaded into a rebuilt architecture — the exported forward
+program itself has no KV surface to page).
+
+Decode-step telemetry flows through the PR 7 metrics plane when it is
+enabled: ``serving_*`` counters/gauges plus one step window per decode
+step with EXPLICIT token counts (``step_end(tokens=...)``) — serving
+never relies on the train-step token heuristic, whose int-id shape
+sniffing must not see block tables or int8 KV payloads as token
+batches. The modeled step cost (XLA cost model) rides in the step
+record as ``modeled_step_s`` so ``perf_doctor diff`` can compare
+serving streams deterministically.
+
+Greedy decoding; time enters only through the caller-supplied ``now``
+stamps (the serving bench passes a virtual cost-model clock — no wall
+clocks in any gate).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence as Seq, Tuple
+
+import numpy as np
+
+from .block_cache import (BlockAllocator, PagedKVCache, blocks_for_tokens,
+                          GARBAGE_BLOCK)
+from .model_runner import PagedGPTRunner
+from .scheduler import (ContinuousBatchingScheduler, Request, SchedulerConfig,
+                        Sequence, SeqState)
+
+__all__ = ["EngineConfig", "ServingEngine"]
+
+
+def _pow2_ladder(lo: int, hi: int) -> Tuple[int, ...]:
+    out, v = [], lo
+    while v < hi:
+        out.append(v)
+        v *= 2
+    out.append(hi)
+    return tuple(sorted(set(out)))
+
+
+@dataclass
+class EngineConfig:
+    block_size: int = 16
+    num_blocks: int = 64
+    max_batch: int = 8
+    # None -> power-of-two ladders derived from max_batch /
+    # max_model_len; the compiled decode program count is bounded by
+    # len(batch_buckets) * len(page_buckets)
+    batch_buckets: Optional[Tuple[int, ...]] = None
+    page_buckets: Optional[Tuple[int, ...]] = None
+    prefill_budget_tokens: int = 512
+    weight_only_int8: bool = False
+    max_model_len: Optional[int] = None
+    kv_dtype: str = "float32"
+    interpret: Optional[bool] = None
+
+
+class ServingEngine:
+    """Continuous-batching serving engine over one GPT model."""
+
+    def __init__(self, model=None, *, artifact_path: Optional[str] = None,
+                 artifact_params_path: Optional[str] = None,
+                 gpt_config=None, config: Optional[EngineConfig] = None):
+        self.config = config or EngineConfig()
+        if model is None:
+            if artifact_path is None:
+                raise ValueError("pass model= or artifact_path=")
+            model = self._load_artifact(artifact_path, gpt_config,
+                                        artifact_params_path)
+        cfg = model.cfg
+        if getattr(cfg, "stacked_blocks", False):
+            raise ValueError(
+                "serving requires addressable blocks; rebuild with "
+                "stacked_blocks=False (the decode program wires the "
+                "paged append between qkv and attention per block)")
+        self.model = model
+        model.eval()
+        self.max_model_len = int(self.config.max_model_len
+                                 or cfg.max_position_embeddings)
+        if self.max_model_len > cfg.max_position_embeddings:
+            # jnp gathers CLAMP out-of-range indices, so positions past
+            # the wpe table would silently decode with the wrong
+            # embedding instead of raising
+            raise ValueError(
+                f"max_model_len {self.max_model_len} exceeds the "
+                f"model's max_position_embeddings "
+                f"{cfg.max_position_embeddings}")
+        if self.config.weight_only_int8:
+            from ..quantization import weight_only_quantize
+            # projection matmuls only: qkv/out_proj/up/down inside the
+            # blocks — embeddings and the (tied) head stay fp
+            for block in model.gpt.h:
+                weight_only_quantize(block)
+        self.cache = PagedKVCache(
+            cfg.num_layers, self.config.num_blocks, self.config.block_size,
+            cfg.num_heads, cfg.head_dim, dtype=self.config.kv_dtype)
+        self.allocator = BlockAllocator(self.config.num_blocks,
+                                        self.config.block_size)
+        max_pages = blocks_for_tokens(self.max_model_len,
+                                      self.config.block_size)
+        sched_cfg = SchedulerConfig(
+            max_batch=self.config.max_batch,
+            batch_buckets=(self.config.batch_buckets
+                           or _pow2_ladder(1, self.config.max_batch)),
+            page_buckets=(self.config.page_buckets
+                          or _pow2_ladder(1, max_pages)),
+            prefill_budget_tokens=self.config.prefill_budget_tokens)
+        self.scheduler = ContinuousBatchingScheduler(sched_cfg,
+                                                     self.allocator)
+        self.runner = PagedGPTRunner(model, cfg.num_heads, cfg.head_dim,
+                                     interpret=self.config.interpret)
+        self._next_req_id = 0
+        self._seqs: Dict[int, Sequence] = {}
+        self.decode_steps = 0
+
+    # -- construction helpers --------------------------------------------
+    @staticmethod
+    def _load_artifact(artifact_path: str, gpt_config,
+                       params_path: Optional[str] = None):
+        """Rebuild the architecture from ``gpt_config`` and load the
+        ``jit.save``'d weights into it. ``params_path`` overrides the
+        prefix-derived weights file — the same contract
+        ``Config.set_model(prog_file, params_file)`` gives the
+        Predictor path."""
+        if gpt_config is None:
+            raise ValueError(
+                "artifact_path needs gpt_config= (the architecture is "
+                "rebuilt; the serialized program has no pageable KV)")
+        from ..jit.api import load as jit_load
+        from ..models.gpt import GPTForCausalLM
+        loaded = jit_load(artifact_path, params_path=params_path)
+        model = GPTForCausalLM(gpt_config)
+        model.set_state_dict(loaded.state_dict())
+        return model
+
+    # -- request intake --------------------------------------------------
+    def submit(self, prompt: Seq[int], max_new_tokens: int,
+               arrival_t: float = 0.0) -> int:
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1 (prefill "
+                             "always produces the first token)")
+        if len(prompt) + max_new_tokens > self.max_model_len:
+            raise ValueError(
+                f"prompt({len(prompt)}) + max_new({max_new_tokens}) "
+                f"exceeds max_model_len {self.max_model_len}")
+        rid = self._next_req_id
+        self._next_req_id += 1
+        req = Request(rid, prompt, int(max_new_tokens), arrival_t)
+        seq = Sequence(req, self.allocator)
+        self._seqs[rid] = seq
+        self.scheduler.submit(seq)
+        self._gauge()
+        return rid
+
+    def sequence(self, req_id: int) -> Sequence:
+        return self._seqs[req_id]
+
+    # -- admission + prefill ---------------------------------------------
+    def admit_and_prefill(self, now: float = 0.0,
+                          ready_at_fn=None) -> List[dict]:
+        """One admission round: FIFO-admit within the prefill budget,
+        prefill each admitted sequence (ALL its tokens — first
+        admission or post-eviction recompute), scatter K/V into its
+        blocks, and sample its next token. Returns per-admission info
+        dicts (seq, prompt_tokens, padded_len, cost) for the caller's
+        clock; ``ready_at_fn(info) -> float`` (default: ``now``)
+        stamps when each sequence may join the decode batch — the sim
+        sets it to the prefill LANE's completion time, which is the
+        whole point of disaggregation: decode never waits on it."""
+        from ..observability import metrics
+        out = []
+        for seq in self.scheduler.admit():
+            n = len(seq.tokens)
+            tok, k_stack, v_stack = self.runner.prefill(seq.tokens)
+            row = np.asarray(seq.table.blocks, np.int64)
+            self.cache.k = PagedKVCache.scatter_prefill(
+                self.cache.k, k_stack, row, n, self.cache.block_size)
+            self.cache.v = PagedKVCache.scatter_prefill(
+                self.cache.v, v_stack, row, n, self.cache.block_size)
+            seq.table.num_tokens = n
+            seq.tokens.append(tok)
+            padded = self.runner.prefill_padded_len(n)
+            info = {"seq": seq, "prompt_tokens": n, "padded_len": padded,
+                    "cost": self.runner.prefill_cost(padded)}
+            seq.ready_at = (ready_at_fn(info) if ready_at_fn is not None
+                            else now)
+            if seq.first_token_t is None:
+                seq.first_token_t = seq.ready_at
+                metrics.observe("serving_ttft_s",
+                                max(0.0, seq.first_token_t
+                                    - seq.request.arrival_t))
+            self.scheduler.mark_running(seq)
+            metrics.inc("serving_prefill_tokens_total", n)
+            if seq.done:
+                # its only token materializes when the prefill LANE
+                # finishes — finishing at the admission instant would
+                # stamp finish_t before first_token_t
+                self.scheduler.finish(seq, seq.ready_at)
+            out.append(info)
+        self._gauge()
+        return out
+
+    # -- one decode step -------------------------------------------------
+    def decode_once(self, now: float = 0.0) -> Optional[dict]:
+        """Run ONE compiled decode step over every running sequence
+        whose prefill has completed (``ready_at <= now``). Returns a
+        step info dict, or None when nothing is ready."""
+        from ..observability import metrics
+        active = [s for s in self.scheduler.running()
+                  if getattr(s, "ready_at", 0.0) <= now]
+        if not active:
+            return None
+        victims = self.scheduler.reserve_decode_slots(active)
+        if victims:
+            # counted HERE, not after the step: evicting every ready
+            # sequence aborts the step below, and those evictions must
+            # not vanish from the counter
+            metrics.inc("serving_evictions_total", len(victims))
+        active = [s for s in active if s.state is SeqState.RUNNING]
+        if not active:
+            return None
+        cfg = self.scheduler.config
+        b_bucket, p_bucket = self.scheduler.decode_bucket(active)
+        ids = np.zeros((b_bucket, 1), np.int32)
+        positions = np.zeros((b_bucket,), np.int32)
+        tables = np.full((b_bucket, p_bucket), GARBAGE_BLOCK, np.int32)
+        for i, s in enumerate(active):
+            ids[i, 0] = s.tokens[s.num_cached]
+            positions[i] = s.num_cached
+            tables[i] = s.table.padded(p_bucket)
+        with metrics.phase("compute"):
+            toks = self.runner.decode(self.cache, ids, positions, tables)
+        cost = self.runner.decode_cost((b_bucket, p_bucket))
+        modeled_s = None
+        if cost and "flops" in cost:
+            from ..observability.cost_model import StepCost
+            sc = StepCost(flops=cost.get("flops", 0.0),
+                          hbm_bytes=cost.get("bytes accessed", 0.0))
+            modeled_s = sc.step_time_modeled_s()
+        # tokens exist at the step's END: finishing at `now` would cut
+        # the final step's cost out of the virtual-clock makespan and
+        # overstate the benched tokens/s
+        done_at = now + (modeled_s or 0.0)
+        for i, s in enumerate(active):
+            s.table.append_slot()
+            s.tokens.append(int(toks[i]))
+            if s.done:
+                self.scheduler.finish(s, done_at)
+        self.decode_steps += 1
+        info = {"bucket": (b_bucket, p_bucket), "n_active": len(active),
+                "tokens": len(active), "evictions": len(victims),
+                "cost": cost}
+        metrics.inc("serving_decode_tokens_total", len(active))
+        self._gauge()
+        extra = {"serving": 1,
+                 "batch_occupancy": len(active) / cfg.max_batch}
+        if modeled_s is not None:
+            extra["modeled_step_s"] = modeled_s
+        metrics.step_end(tokens=len(active), **extra)
+        return info
+
+    def tick(self, now: float = 0.0) -> Optional[dict]:
+        """Convenience round for live serving: admissions then one
+        decode step, both stamped with ``now``."""
+        self.admit_and_prefill(now)
+        return self.decode_once(now)
+
+    # -- reporting -------------------------------------------------------
+    def _gauge(self) -> None:
+        from ..observability import metrics
+        metrics.set_gauge("serving_queue_depth",
+                          self.scheduler.queue_depth)
+        metrics.set_gauge("serving_batch_occupancy",
+                          len(self.scheduler.running())
+                          / self.scheduler.config.max_batch)
+        metrics.set_gauge("serving_kv_blocks_in_use",
+                          self.allocator.used_count)
+        metrics.set_gauge("serving_kv_blocks_high_water",
+                          self.allocator.high_water)
+        metrics.set_gauge("serving_decode_programs",
+                          self.runner.num_decode_programs)
+
+    @property
+    def num_decode_programs(self) -> int:
+        return self.runner.num_decode_programs
+
+    @property
+    def program_budget(self) -> int:
+        return self.scheduler.config.program_budget
+
+    def kv_high_water_bytes(self) -> int:
+        return self.cache.bytes_for_blocks(self.allocator.high_water)
+
+    def contiguous_cache_bytes(self) -> int:
+        """The comparator: a contiguous per-slot max-seq-len cache for
+        the full decode batch."""
+        return self.cache.contiguous_bytes(self.config.max_batch,
+                                           self.max_model_len)
+
+    def idle(self) -> bool:
+        return not self.scheduler.waiting and not self.scheduler.running()

@@ -1,0 +1,243 @@
+"""Pallas paged-attention decode kernel + dense reference path.
+
+The decode-side half of PagedAttention (Kwon et al. SOSP'23) on the
+flash kernel's machinery (``kernels/pallas_flash.py``): at decode each
+sequence contributes ONE query token and attends over its whole cached
+prefix, whose K/V live scattered across fixed-size blocks of the
+shared pool (``serving/block_cache.py``). The kernel walks the
+sequence's block table — scalar-prefetched so the index maps can
+compute DMA source blocks before the body runs (the
+``PrefetchScalarGridSpec`` pattern from the official TPU paged
+kernels) — and gathers K/V blocks into VMEM.
+
+Numerics contract (the serving acceptance gate): the kernel's output
+is **bitwise identical in fp32** to :func:`paged_attention_reference`
+(dense gather through the same table) which in turn is bitwise
+identical to ``nn.functional.flash_attention`` on the contiguously
+gathered K/V. That chain holds because all three run the *same op
+sequence*: ``dot(q, k) * scale`` -> mask with ``finfo.min`` ->
+``jax.nn.softmax(f32)`` -> ``dot(p, v)``, i.e. the exact arithmetic of
+``kernels/attention._sdpa_xla`` (the dense decode path — decode shapes
+never hit the tiled flash kernel, whose online softmax would reorder
+the reductions). The per-page score dots write into one
+``[8, n_pages*block_size]`` score buffer and the softmax runs ONCE
+over the full row, so block fragmentation cannot change a single bit:
+the gathered values, not their physical placement, define the result.
+Pad slots hold ``finfo.min`` scores, which underflow to exactly 0.0
+probability, and context lengths are kept multiples of 8 (the repo's
+row-tiling minimum) so padded-width reductions group lanes identically
+to exact-width ones.
+
+VMEM: scores 8 x S_max + V S_max x D per (batch, head) program — at
+the serving ceiling (S 2048, D 128, f32) ~1.1 MB, comfortably scoped.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..kernels.pallas_flash import _interpret_default
+
+# jax renamed TPUCompilerParams -> CompilerParams; support both so the
+# kernel loads on every jax this repo meets
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
+__all__ = ["paged_attention_decode", "paged_attention_reference",
+           "gathered_dense_kv"]
+
+
+def _precision(dtype):
+    # mirror ops.linalg._mxu_precision: bf16/f16 pinned to DEFAULT so
+    # the MXU keeps its native-rate path; f32 inherits the global
+    # setting — the same choice _sdpa_xla makes, which the bitwise
+    # contract depends on
+    if jnp.dtype(dtype) in (jnp.bfloat16, jnp.float16):
+        return jax.lax.Precision.DEFAULT
+    return None
+
+
+def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   s_buf, v_buf, *, scale, block_size, n_pages):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        # dead/pad slots: finfo.min scores (exactly-0 probability after
+        # the f32 softmax) and zero V
+        s_buf[:] = jnp.full_like(s_buf, jnp.finfo(s_buf.dtype).min)
+        v_buf[:] = jnp.zeros_like(v_buf)
+
+    ctx = len_ref[b]
+
+    @pl.when(j * block_size < ctx)
+    def _gather():
+        # the score dot runs on a SINGLE query row: the gemm's row
+        # count changes XLA's reduction grouping (an 8-row dot drifts
+        # ~1 ulp from the 1-row dot flash_attention's decode einsum
+        # collapses to), and the bitwise contract hinges on matching
+        # it exactly. The tile itself stays 8 rows for TPU sublane
+        # layout; rows 1..7 are dead weight.
+        q = q_ref[0, 0][:1]                   # (1, D) native dtype
+        k = k_ref[0, :, 0, :]                 # (bs, D)
+        v = v_ref[0, :, 0, :]                 # (bs, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            precision=_precision(q.dtype)) * scale
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1) + j * block_size
+        s = jnp.where(cols < ctx, s, jnp.finfo(s.dtype).min)
+        s_buf[:1, pl.ds(j * block_size, block_size)] = \
+            s.astype(s_buf.dtype)
+        v_buf[pl.ds(j * block_size, block_size), :] = v
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        # ONE global softmax over the assembled row — the same
+        # softmax(f32)-then-matmul sequence as _sdpa_xla, NOT the
+        # online-softmax recurrence (whose per-block rescaling would
+        # round differently and break the bitwise contract)
+        probs = jax.nn.softmax(
+            s_buf[:1].astype(jnp.float32), axis=-1).astype(o_ref.dtype)
+        o = jax.lax.dot_general(
+            probs, v_buf[:], (((1,), (0,)), ((), ())),
+            precision=_precision(o_ref.dtype))       # (1, D)
+        o_ref[0, 0] = jnp.broadcast_to(o, o_ref.shape[2:]) \
+            .astype(o_ref.dtype)
+
+
+def paged_attention_decode(q, k_pool, v_pool, block_tables, ctx_lens,
+                           scale=None, interpret=None):
+    """Paged decode attention.
+
+    q: ``[B, 1, H, D]`` (paddle layout) — one new token per sequence.
+    k_pool/v_pool: ``[num_blocks, block_size, H, D]`` shared pools.
+    block_tables: int32 ``[B, n_pages]`` physical block ids per
+    sequence (pad rows with the garbage block).
+    ctx_lens: int32 ``[B]`` valid keys per sequence (including the
+    token just appended). Returns ``[B, 1, H, D]``.
+    """
+    B, _, H, D = q.shape
+    n_blocks, bs, _, _ = k_pool.shape
+    n_pages = block_tables.shape[1]
+    s_pad = n_pages * bs
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    if interpret is None:
+        interpret = _interpret_default()
+    # q rides as [B, H, 8, D]: 8 identical rows satisfy the TPU
+    # sublane-tiling minimum; row 0 is the answer
+    qr = jnp.broadcast_to(jnp.swapaxes(q, 1, 2), (B, H, 8, D))
+    bt = jnp.asarray(block_tables, jnp.int32)
+    ln = jnp.asarray(ctx_lens, jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, 8, D),
+                         lambda b, h, j, bt, ln: (b, h, 0, 0)),
+            pl.BlockSpec((1, bs, 1, D),
+                         lambda b, h, j, bt, ln: (bt[b, j], 0, h, 0)),
+            pl.BlockSpec((1, bs, 1, D),
+                         lambda b, h, j, bt, ln: (bt[b, j], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 8, D),
+                               lambda b, h, j, bt, ln: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((8, s_pad), q.dtype),
+            pltpu.VMEM((s_pad, D), q.dtype),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=float(scale),
+                          block_size=bs, n_pages=n_pages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, 8, D), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(bt, ln, qr, k_pool, v_pool)
+    return out[:, :, 0][:, None]        # [B, H, 8, D] -> [B, 1, H, D]
+
+
+def gathered_dense_kv(pool, block_tables):
+    """Dense ``[B, n_pages*block_size, H, D]`` view of every
+    sequence's K or V through its block table (one vectorized
+    gather)."""
+    g = pool[jnp.asarray(block_tables, jnp.int32)]   # [B, P, bs, H, D]
+    return g.reshape(g.shape[:1] + (-1,) + g.shape[3:])
+
+
+# reference programs cached per (shape, dtype, scale): the bitwise
+# contract is a COMPILED-program property — eager per-op dispatch lets
+# XLA compile each op alone and round reductions differently (observed
+# 1-ulp drift CPU-side), so the reference always runs jitted
+_REF_CACHE: dict = {}
+
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables, ctx_lens,
+                              scale=None):
+    """Dense reference: gather K/V through the block table, then run
+    the kernel's exact op sequence — per (sequence, head) single-row
+    2-D dots, ``finfo.min`` pad mask, one ``jax.nn.softmax(f32)`` —
+    compiled as ONE jitted program. Bitwise-equal (fp32) to the
+    kernel (the loops mirror its grid steps one-for-one) and to a
+    jitted ``nn.functional.flash_attention`` on H=1 slices of the
+    contiguous K/V: at H=1 the dense path's batched einsum collapses
+    to the same 2-D ``dot_general``, while an H-batched gemm is free
+    to reassociate its reduction (observed 1-ulp drift on XLA CPU) —
+    which is also why this reference loops heads instead of batching
+    them. The flash equality is exact when the context is
+    block-aligned (equal reduction widths); at ragged contexts the
+    padded-width softmax/out reductions may regroup and drift 1 ulp
+    vs the exact-width dense path — kernel-vs-reference stays bitwise
+    regardless, since both run at the padded width."""
+    B, _, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    key = (tuple(q.shape), str(jnp.asarray(q).dtype),
+           tuple(k_pool.shape), int(np.asarray(block_tables).shape[1]),
+           float(scale))
+    fn = _REF_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(functools.partial(_reference_impl, scale=float(scale),
+                                       B=B, H=H))
+        if len(_REF_CACHE) > 256:
+            _REF_CACHE.clear()
+        _REF_CACHE[key] = fn
+    return fn(jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+              jnp.asarray(block_tables, jnp.int32),
+              jnp.asarray(ctx_lens, jnp.int32))
+
+
+def _reference_impl(q, k_pool, v_pool, block_tables, ctx_lens, *,
+                    scale, B, H):
+    kd = gathered_dense_kv(k_pool, block_tables)     # [B, S_pad, H, D]
+    vd = gathered_dense_kv(v_pool, block_tables)
+    prec = _precision(q.dtype)
+    s_pad = kd.shape[1]
+    out = []
+    for b in range(B):
+        valid = jnp.arange(s_pad) < ctx_lens[b]
+        heads = []
+        for h in range(H):
+            s = jax.lax.dot_general(
+                q[b, :, h], kd[b, :, h], (((1,), (1,)), ((), ())),
+                precision=prec) * scale              # (1, S_pad)
+            s = jnp.where(valid[None, :], s, jnp.finfo(s.dtype).min)
+            p = jax.nn.softmax(s.astype(jnp.float32),
+                               axis=-1).astype(q.dtype)
+            heads.append(jax.lax.dot_general(
+                p, vd[b, :, h], (((1,), (0,)), ((), ())),
+                precision=prec))                     # (1, D)
+        out.append(jnp.stack(heads, axis=1))         # (1, H, D)
+    return jnp.stack(out)                            # (B, 1, H, D)

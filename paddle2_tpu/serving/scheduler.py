@@ -1,0 +1,248 @@
+"""Continuous-batching scheduler: admit/evict per decode step.
+
+The Orca iteration-level scheduling model (Yu et al. OSDI'22): the
+decode batch is re-formed at EVERY step — finished sequences leave
+immediately, waiting requests join as soon as a batch slot and KV
+blocks are free — instead of the static-batch regime where the whole
+batch waits for its slowest member.
+
+Three policies live here, all host-side and deterministic:
+
+* **Admission** (FIFO + prefill budget): waiting requests are admitted
+  oldest-first when (a) a decode slot is free, (b) the allocator can
+  cover their prompt blocks, and (c) the per-round prefill token
+  budget is not exhausted. The budget is the prefill/decode
+  disaggregation knob: prefill compute runs on its own lane (a
+  separate instance in a disaggregated deployment; between decode
+  steps on one chip), and capping admitted prefill tokens per round
+  bounds how long the decode batch can go without a step even on the
+  single-chip fallback.
+* **Preemption by eviction** (LIFO victim): when a running sequence
+  needs a block and the free list is empty, the NEWEST running
+  sequence is evicted — all its blocks freed, state back to WAITING at
+  the FRONT of the queue (it re-prefills prompt+generated-so-far on
+  re-admission, the vLLM recompute strategy). LIFO keeps the oldest
+  requests making progress, so no request starves.
+* **Bucketed shapes**: the decode batch is padded to a fixed set of
+  (batch, pages) buckets so the compiled decode program is reused
+  across compositions — the serving bench gates that the number of
+  compiled decode programs never exceeds ``len(batch_buckets) x
+  len(page_buckets)``.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .block_cache import (BlockAllocator, BlockTable, OutOfBlocksError,
+                          blocks_for_tokens)
+
+__all__ = ["Request", "Sequence", "SeqState", "SchedulerConfig",
+           "ContinuousBatchingScheduler"]
+
+
+@dataclass
+class Request:
+    """One generation request as submitted by a client."""
+    req_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    arrival_t: float = 0.0
+
+
+class SeqState(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+class Sequence:
+    """Scheduler-side state of one request."""
+
+    def __init__(self, request: Request, allocator: BlockAllocator):
+        self.request = request
+        self.tokens: List[int] = list(request.prompt)
+        self.table = BlockTable(allocator)
+        self.state = SeqState.WAITING
+        self.first_token_t: Optional[float] = None
+        self.finish_t: Optional[float] = None
+        self.evictions = 0
+
+    @property
+    def req_id(self) -> int:
+        return self.request.req_id
+
+    @property
+    def num_cached(self) -> int:
+        return self.table.num_tokens
+
+    @property
+    def generated(self) -> List[int]:
+        return self.tokens[len(self.request.prompt):]
+
+    @property
+    def done(self) -> bool:
+        return len(self.generated) >= self.request.max_new_tokens
+
+    def __repr__(self):
+        return (f"Sequence(req={self.req_id}, state={self.state.value}, "
+                f"tokens={len(self.tokens)}, cached={self.num_cached})")
+
+
+@dataclass
+class SchedulerConfig:
+    max_batch: int = 8
+    # power-of-two-ish ladders; padded shapes key the compiled decode
+    # programs, so these two lists BOUND the program count
+    batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
+    page_buckets: Tuple[int, ...] = (2, 4, 8, 16)
+    # prefill/decode disaggregation: max prompt tokens admitted per
+    # scheduling round (0 = unlimited)
+    prefill_budget_tokens: int = 512
+
+    def __post_init__(self):
+        self.batch_buckets = tuple(sorted(set(self.batch_buckets)))
+        self.page_buckets = tuple(sorted(set(self.page_buckets)))
+        if self.batch_buckets[-1] < self.max_batch:
+            raise ValueError("largest batch bucket must cover max_batch")
+
+    @property
+    def program_budget(self) -> int:
+        return len(self.batch_buckets) * len(self.page_buckets)
+
+    def batch_bucket(self, n: int) -> int:
+        for b in self.batch_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"batch {n} exceeds largest bucket "
+                         f"{self.batch_buckets[-1]}")
+
+    def page_bucket(self, n: int) -> int:
+        for p in self.page_buckets:
+            if n <= p:
+                return p
+        raise ValueError(f"{n} pages exceed largest bucket "
+                         f"{self.page_buckets[-1]}")
+
+
+class ContinuousBatchingScheduler:
+    """Pure-host scheduling core; the engine owns the actual compute.
+
+    The engine drives it as::
+
+        admitted = sched.admit()            # -> seqs to prefill
+        ...prefill each, mark running...
+        batch = sched.running()             # current decode batch
+        victims = sched.reserve_decode_slots()   # may evict
+        ...run one decode step over sched.running()...
+    """
+
+    def __init__(self, config: SchedulerConfig, allocator: BlockAllocator):
+        self.config = config
+        self.allocator = allocator
+        self.waiting: List[Sequence] = []
+        self._running: List[Sequence] = []      # admission order
+        self.finished: List[Sequence] = []
+        self.total_evictions = 0
+
+    # -- introspection ---------------------------------------------------
+    def running(self) -> List[Sequence]:
+        return list(self._running)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    # -- submission ------------------------------------------------------
+    def submit(self, seq: Sequence) -> None:
+        self.waiting.append(seq)
+
+    # -- admission -------------------------------------------------------
+    def admit(self) -> List[Sequence]:
+        """Pick waiting sequences to prefill this round: FIFO, bounded
+        by free decode slots, allocator coverage for the WHOLE current
+        token list (prompt + any pre-eviction generation), and the
+        prefill token budget. Admitted sequences get their blocks
+        allocated here; the engine must prefill and mark them RUNNING.
+        A request whose blocks cannot be covered blocks the queue
+        (FIFO — skipping it would starve long prompts forever)."""
+        admitted: List[Sequence] = []
+        budget = self.config.prefill_budget_tokens or float("inf")
+        spent = 0
+        while self.waiting:
+            seq = self.waiting[0]
+            if len(self._running) + len(admitted) >= self.config.max_batch:
+                break
+            need_tokens = len(seq.tokens)
+            need_blocks = blocks_for_tokens(
+                need_tokens + 1, self.allocator.block_size)
+            if spent and spent + need_tokens > budget:
+                break                      # budget spent: next round
+            if not self.allocator.can_allocate(need_blocks):
+                break                      # head-of-line until blocks free
+            self.waiting.pop(0)
+            seq.table.ensure_capacity(need_tokens + 1)
+            spent += need_tokens
+            admitted.append(seq)
+        return admitted
+
+    def mark_running(self, seq: Sequence) -> None:
+        seq.state = SeqState.RUNNING
+        self._running.append(seq)
+
+    # -- decode-step block reservation ----------------------------------
+    def reserve_decode_slots(self, seqs: Optional[List[Sequence]] = None
+                             ) -> List[Sequence]:
+        """Make sure every sequence in ``seqs`` (default: all running)
+        has a block slot for the token the next decode step appends,
+        evicting LIFO on exhaustion. Returns the evicted sequences
+        (already requeued)."""
+        victims: List[Sequence] = []
+        todo = list(self._running) if seqs is None else list(seqs)
+        i = 0
+        while i < len(todo):
+            seq = todo[i]
+            if seq.state is not SeqState.RUNNING:
+                i += 1      # evicted while reserving an earlier seq
+                continue
+            try:
+                seq.table.ensure_capacity(seq.num_cached + 1)
+                i += 1
+            except OutOfBlocksError:
+                victim = self._running[-1]
+                self._evict(victim)
+                victims.append(victim)
+                if victim is seq:
+                    continue    # re-check the same index (list shrank)
+        return victims
+
+    def _evict(self, seq: Sequence) -> None:
+        self._running.remove(seq)
+        seq.table.release()
+        seq.state = SeqState.WAITING
+        seq.evictions += 1
+        self.total_evictions += 1
+        # front of the queue: preempted work resumes before new arrivals
+        self.waiting.insert(0, seq)
+
+    # -- completion ------------------------------------------------------
+    def finish(self, seq: Sequence, now: float = 0.0) -> None:
+        self._running.remove(seq)
+        seq.table.release()
+        seq.state = SeqState.FINISHED
+        seq.finish_t = now
+        self.finished.append(seq)
+
+    # -- bucket shape of the current batch -------------------------------
+    def decode_bucket(self, seqs: Optional[List[Sequence]] = None
+                      ) -> Tuple[int, int]:
+        """(batch_bucket, page_bucket) for the NEXT decode step over
+        ``seqs`` (default: all running) — the compiled-program cache
+        key; the engine passes the ready subset."""
+        seqs = self._running if seqs is None else seqs
+        n = len(seqs)
+        pages = max((len(s.table.blocks) for s in seqs), default=1)
+        return (self.config.batch_bucket(max(n, 1)),
+                self.config.page_bucket(max(pages, 1)))

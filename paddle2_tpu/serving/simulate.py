@@ -1,0 +1,236 @@
+"""Deterministic discrete-event serving simulation (cost x rate).
+
+Wall clocks are banned from every perf gate in this repo (gVisor/CI
+sandboxes make them noise), so the serving bench drives the REAL
+engine — real scheduler, real paged blocks, real compiled decode
+programs producing real tokens — under a VIRTUAL clock: each decode
+step advances time by the step's modeled cost (XLA ``cost_analysis``
+FLOPs/bytes through the PR 7 :class:`StepCost` rate model), and
+arrivals come from a seeded Poisson trace. Everything downstream
+(tokens/s, TTFT percentiles, queueing) is a pure function of
+(program costs, trace seed) — bit-stable across runs and machines.
+
+Two lanes, per the prefill/decode disaggregation design: admitted
+prompts are prefilled on the PREFILL lane (its own clock — a separate
+instance in a real disaggregated deployment) and join the decode
+batch when that lane finishes them; the decode clock only ever pays
+decode-step costs, so a long prefill cannot stall token production
+for running sequences.
+
+The baseline (:func:`simulate_predictor_baseline`) models today's
+``paddle.inference.Predictor`` loop — one request at a time, prefill
+then token-by-token decode at batch 1 — over the SAME trace and the
+same cost primitives. The bench gates continuous batching at >= 3x
+its aggregate tokens/s.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["poisson_trace", "ServingSimReport", "simulate_serving",
+           "simulate_predictor_baseline", "cost_seconds"]
+
+
+def poisson_trace(n_requests: int, rate_per_s: float,
+                  prompt_lens, gen_tokens, vocab: int, seed: int = 0
+                  ) -> List[dict]:
+    """Seeded synthetic heavy-traffic trace: exponential interarrivals
+    at ``rate_per_s``, prompt lengths/gen budgets cycled from the
+    given lists, token ids uniform over ``vocab``. Deterministic in
+    ``seed``."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    out = []
+    for i in range(n_requests):
+        t += float(rng.exponential(1.0 / rate_per_s))
+        plen = int(prompt_lens[i % len(prompt_lens)])
+        out.append({
+            "arrival_t": t,
+            "prompt": rng.integers(0, vocab, size=plen).tolist(),
+            "max_new_tokens": int(gen_tokens[i % len(gen_tokens)]),
+        })
+    return out
+
+
+def cost_seconds(cost: Optional[Dict[str, float]],
+                 fallback_s: float = 1e-3) -> float:
+    """XLA cost dict -> modeled seconds: ``max(compute, memory)``
+    under the chip rate model (CPU falls back to the fixed nominal
+    figures in ``cost_model.CHIP_PEAKS`` — deterministic everywhere).
+    ``fallback_s`` covers backends that expose no cost analysis."""
+    if not cost or not cost.get("flops"):
+        return fallback_s
+    from ..observability.cost_model import StepCost
+    sc = StepCost(flops=cost.get("flops", 0.0),
+                  hbm_bytes=cost.get("bytes accessed", 0.0))
+    return sc.step_time_modeled_s()
+
+
+@dataclass
+class ServingSimReport:
+    total_tokens: int = 0
+    makespan_s: float = 0.0
+    tokens_per_s: float = 0.0
+    ttft_s: List[float] = field(default_factory=list)
+    p99_ttft_s: float = 0.0
+    mean_ttft_s: float = 0.0
+    decode_steps: int = 0
+    evictions: int = 0
+    kv_high_water_bytes: int = 0
+    contiguous_cache_bytes: int = 0
+    kv_ratio: float = 0.0
+    decode_programs: int = 0
+    program_budget: int = 0
+    mean_batch_occupancy: float = 0.0
+
+    def finalize(self, first_arrival: float, last_finish: float):
+        self.makespan_s = max(last_finish - first_arrival, 1e-12)
+        self.tokens_per_s = self.total_tokens / self.makespan_s
+        if self.ttft_s:
+            self.p99_ttft_s = float(np.percentile(self.ttft_s, 99))
+            self.mean_ttft_s = float(np.mean(self.ttft_s))
+        return self
+
+
+def simulate_serving(engine, trace: List[dict],
+                     max_steps: int = 100_000) -> ServingSimReport:
+    """Drive ``engine`` through ``trace`` under the virtual clock.
+    Requests are submitted at their arrival times; the report carries
+    every gated quantity. The engine does REAL compute — final tokens
+    are available via ``engine.sequence(rid).generated``."""
+    pending = sorted(trace, key=lambda r: r["arrival_t"])
+    first_arrival = pending[0]["arrival_t"] if pending else 0.0
+    decode_clock = float(first_arrival)
+    prefill_clock = 0.0
+    evictions_before = engine.scheduler.total_evictions
+    submitted: List[int] = []
+    occupancy: List[float] = []
+    rep = ServingSimReport()
+
+    def submit_due(now: float):
+        while pending and pending[0]["arrival_t"] <= now:
+            r = pending.pop(0)
+            submitted.append(engine.submit(
+                r["prompt"], r["max_new_tokens"],
+                arrival_t=r["arrival_t"]))
+
+    for _ in range(max_steps):
+        submit_due(decode_clock)
+        if engine.idle() and not pending:
+            break
+
+        def lane_ready(info):
+            # prefill lane: starts no earlier than the admission
+            # instant or the lane's previous completion
+            nonlocal prefill_clock
+            start = max(prefill_clock, decode_clock,
+                        info["seq"].request.arrival_t)
+            prefill_clock = start + cost_seconds(info["cost"])
+            return prefill_clock
+
+        engine.admit_and_prefill(decode_clock, ready_at_fn=lane_ready)
+
+        step = engine.decode_once(decode_clock)
+        if step is not None:
+            decode_clock += cost_seconds(step["cost"])
+            occupancy.append(step["n_active"]
+                             / engine.scheduler.config.max_batch)
+        else:
+            # nothing ready: jump to the next event (arrival or a
+            # prefill completing on its lane)
+            nxt = []
+            if pending:
+                nxt.append(pending[0]["arrival_t"])
+            nxt.extend(getattr(s, "ready_at", 0.0)
+                       for s in engine.scheduler.running())
+            if not nxt:
+                if engine.scheduler.waiting:
+                    raise RuntimeError(
+                        "head-of-line request can never be admitted "
+                        "(prompt needs more blocks than the pool has)")
+                break
+            decode_clock = max(decode_clock, min(nxt)) + 1e-9
+    else:
+        raise RuntimeError(f"simulation did not converge in "
+                           f"{max_steps} steps")
+
+    finished = [engine.sequence(rid) for rid in submitted]
+    last_finish = max((s.finish_t or 0.0) for s in finished) \
+        if finished else 0.0
+    # every generated token counts — including each request's FIRST
+    # token, produced by its prefill (the baseline counts all of
+    # max_new_tokens too; counting only decode-step tokens would bias
+    # the throughput ratio against continuous batching)
+    rep.total_tokens = sum(len(s.generated) for s in finished)
+    # from the scheduler's own ledger, not per-step info dicts: an
+    # eviction that empties the ready batch aborts the step and would
+    # otherwise go uncounted
+    rep.evictions = engine.scheduler.total_evictions - evictions_before
+    rep.ttft_s = [max(0.0, s.first_token_t - s.request.arrival_t)
+                  for s in finished if s.first_token_t is not None]
+    rep.decode_steps = engine.decode_steps
+    rep.kv_high_water_bytes = engine.kv_high_water_bytes()
+    rep.contiguous_cache_bytes = engine.contiguous_cache_bytes()
+    rep.kv_ratio = (rep.kv_high_water_bytes
+                    / max(rep.contiguous_cache_bytes, 1))
+    rep.decode_programs = engine.num_decode_programs
+    rep.program_budget = engine.program_budget
+    rep.mean_batch_occupancy = float(np.mean(occupancy)) if occupancy \
+        else 0.0
+    return rep.finalize(first_arrival, last_finish)
+
+
+def simulate_predictor_baseline(engine, trace: List[dict]
+                                ) -> ServingSimReport:
+    """The one-request-at-a-time ``create_predictor`` loop over the
+    SAME trace and cost primitives: serve requests in arrival order,
+    each paying its full prefill then ``max_new_tokens - 1`` decode
+    steps at batch 1, next request waits. Uses a throwaway decode
+    build at bucket (1, max pages) for the step cost so the gated
+    engine's program census stays untouched."""
+    from .block_cache import blocks_for_tokens
+    runner = engine.runner
+    bs = engine.cache.block_size
+    max_pages = blocks_for_tokens(engine.max_model_len, bs)
+    # lower (never execute) a batch-1 decode for its cost analysis
+    b1 = runner._build_decode(1, max_pages, bs)
+    import jax
+    import jax.numpy as jnp
+    aval = lambda shape, dt: jax.ShapeDtypeStruct(shape, jnp.dtype(dt))
+    shape = engine.cache.k.shape
+    b1_cost = runner._cost_of(b1, (
+        [aval(tuple(t.shape), t._data.dtype) for t in runner._state],
+        aval(shape, engine.cache.dtype), aval(shape, engine.cache.dtype),
+        aval((1, 1), "int32"), aval((1,), "int32"),
+        aval((1, max_pages), "int32")))
+    decode_s = cost_seconds(b1_cost)
+
+    rep = ServingSimReport()
+    t = 0.0
+    first_arrival = min(r["arrival_t"] for r in trace) if trace else 0.0
+    last_finish = 0.0
+    for r in sorted(trace, key=lambda x: x["arrival_t"]):
+        n = len(r["prompt"])
+        padded = runner.prefill_padded_len(n)
+        pcost = runner.prefill_cost(padded)
+        if pcost is None:
+            # make sure the prefill program exists so its cost does
+            runner.prefill(list(r["prompt"]))
+            pcost = runner.prefill_cost(padded)
+        start = max(t, r["arrival_t"])
+        first_tok = start + cost_seconds(pcost)
+        rep.ttft_s.append(first_tok - r["arrival_t"])
+        t = first_tok + max(0, r["max_new_tokens"] - 1) * decode_s
+        rep.total_tokens += r["max_new_tokens"]
+        last_finish = t
+    # contiguous max-seq-len cache, one slot: that IS the predictor's
+    # KV footprint per in-flight request
+    rep.kv_high_water_bytes = engine.cache.contiguous_bytes(
+        1, engine.max_model_len)
+    rep.contiguous_cache_bytes = rep.kv_high_water_bytes
+    rep.kv_ratio = 1.0
+    return rep.finalize(first_arrival, last_finish)

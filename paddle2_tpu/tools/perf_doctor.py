@@ -229,6 +229,17 @@ def summarize(streams: Dict[int, Dict[str, Any]],
         secs = [x["total_s"] for x in steps if "tokens" in x]
         if toks and sum(secs) > 0:
             entry["tokens_per_s"] = sum(toks) / sum(secs)
+        # modeled step cost (cost x rate benches — the serving engine
+        # stamps `modeled_step_s` per decode step): deterministic, so
+        # diffing it across runs carries zero sandbox wall-clock noise
+        mod = [x["modeled_step_s"] for x in steps
+               if "modeled_step_s" in x]
+        if mod:
+            entry["mean_modeled_step_s"] = _mean(mod)
+            mtoks = [x["tokens"] for x in steps
+                     if "modeled_step_s" in x and "tokens" in x]
+            if mtoks and sum(mod) > 0:
+                entry["modeled_tokens_per_s"] = sum(mtoks) / sum(mod)
         samp = [x["samples"] for x in steps if "samples" in x]
         if samp and entry["mean_total_s"] > 0:
             entry["samples_per_s"] = _mean(samp) / entry["mean_total_s"]
@@ -266,6 +277,15 @@ def summarize(streams: Dict[int, Dict[str, Any]],
                     if "exposed_comm_source" in e}
             agg["exposed_comm_source"] = (srcs.pop() if len(srcs) == 1
                                           else "mixed")
+        # aggregate modeled lane only when EVERY rank carries it —
+        # a mixed stream would average a cost model against nothing
+        mods = [e.get("mean_modeled_step_s") for e in per.values()]
+        if mods and all(m is not None for m in mods):
+            agg["mean_modeled_step_s"] = _mean(mods)
+            mtps = [e["modeled_tokens_per_s"] for e in per.values()
+                    if "modeled_tokens_per_s" in e]
+            if mtps:
+                agg["modeled_tokens_per_s_total"] = sum(mtps)
         if agg["mean_total_s"] > 0:
             agg["breakdown_pct"] = {
                 _COMPONENT_LABEL[c]: 100.0 * agg[f"mean_{c}"]
@@ -321,7 +341,29 @@ def diff(base: Dict[str, Any], new: Dict[str, Any],
         "total_delta_pct": total_delta_pct,
         "threshold_pct": threshold_pct,
         "regressed": total_delta_pct > threshold_pct,
+        "verdict_source": "wall",
     }
+    # when BOTH streams carry the modeled-step lane (cost x rate
+    # benches, the serving engine), the regression verdict uses the
+    # MODELED delta: it is a pure function of (program, rate model),
+    # so CI diffs of identical code are exactly 0% instead of sandbox
+    # wall-clock noise tripping the threshold
+    ma = a.get("mean_modeled_step_s")
+    mb = b.get("mean_modeled_step_s")
+    if ma is not None or mb is not None:
+        comparable = ma is not None and mb is not None
+        mdelta = (100.0 * (mb - ma) / ma) if comparable and ma > 0 \
+            else None
+        out["modeled_step"] = {
+            "base_s": ma, "new_s": mb, "delta_pct": mdelta,
+            "comparable": comparable,
+            "base_tokens_per_s": a.get("modeled_tokens_per_s_total"),
+            "new_tokens_per_s": b.get("modeled_tokens_per_s_total"),
+        }
+        if mdelta is not None:
+            out["total_delta_pct"] = mdelta
+            out["regressed"] = mdelta > threshold_pct
+            out["verdict_source"] = "modeled"
     # exposed-comm % delta: an overlap regression (a bucket that
     # stopped hiding under backward, a prefetch that went eager) shows
     # up HERE even when total step time moved for other reasons too.
@@ -462,12 +504,26 @@ def format_diff(d: Dict[str, Any]) -> str:
                    f"{ec['new_source']}]")
         L.append(f"  exposed-comm: {ec['base']:.1f}% -> "
                  f"{ec['new']:.1f}% of step{tag}")
+    ms = d.get("modeled_step")
+    if ms:
+        if ms.get("comparable"):
+            L.append(f"  modeled step: {_fmt_s(ms['base_s'])} -> "
+                     f"{_fmt_s(ms['new_s'])} "
+                     f"({ms['delta_pct']:+.2f}%, deterministic)")
+            if ms.get("base_tokens_per_s") and ms.get("new_tokens_per_s"):
+                L.append(f"  modeled tokens/s: "
+                         f"{ms['base_tokens_per_s']:,.0f} -> "
+                         f"{ms['new_tokens_per_s']:,.0f}")
+        else:
+            L.append("  modeled step: [incomparable: only one stream "
+                     "carries modeled_step_s]")
     for name, c in sorted(d.get("counter_deltas", {}).items()):
         L.append(f"  counter {name}: {c['base']:g} -> {c['new']:g}")
+    src = d.get("verdict_source", "wall")
     L.append(f"verdict: "
-             + (f"REGRESSION (total {d['total_delta_pct']:+.1f}% > "
+             + (f"REGRESSION ({src} {d['total_delta_pct']:+.1f}% > "
                 f"{d['threshold_pct']:g}% threshold)" if d["regressed"]
-                else f"ok (total {d['total_delta_pct']:+.1f}% within "
+                else f"ok ({src} {d['total_delta_pct']:+.1f}% within "
                      f"{d['threshold_pct']:g}%)"))
     return "\n".join(L)
 

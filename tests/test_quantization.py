@@ -140,3 +140,59 @@ def test_ptq_convert_produces_int8_linear_close_to_fp():
     sd = m.state_dict()
     assert any("weight_int8" in k for k in sd)
     assert any("w_scale" in k for k in sd)
+
+
+def test_quanted_inference_linear_error_bound_vs_fp32():
+    """SATELLITE (ISSUE 9): direct QuantedInferenceLinear parity on
+    CPU — quantize->dequantize matmul error bounded by the analytic
+    per-element rounding budget vs the fp32 reference."""
+    rs = np.random.RandomState(1)
+    d_in, d_out, B = 24, 12, 16
+    w = rs.randn(d_in, d_out).astype(np.float32)
+    bias = rs.randn(d_out).astype(np.float32)
+    x = (rs.randn(B, d_in) * 0.5).astype(np.float32)
+    qmax = 127.0
+    w_scale = np.maximum(np.abs(w).max(axis=0), 1e-8)
+    w_int8 = np.clip(np.round(w / w_scale * qmax), -qmax,
+                     qmax).astype(np.int8)
+    act_scale = float(np.abs(x).max())
+    layer = QuantedInferenceLinear(w_int8, w_scale, bias, act_scale)
+    out = np.asarray(layer(paddle.to_tensor(x)).numpy())
+    ref = x @ w + bias
+    # worst case per output element: d_in accumulated products, each
+    # operand off by at most half an int8 step of its scale
+    bound = d_in * (0.5 * act_scale / qmax * np.abs(w).max()
+                    + 0.5 * w_scale.max() / qmax * np.abs(x).max()
+                    + 0.25 * (act_scale / qmax) * (w_scale.max() / qmax))
+    assert np.abs(out - ref).max() <= bound
+    # and the bound is tight enough to be meaningful (within ~2% of
+    # the output range on this data)
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert rel < 0.02, rel
+
+
+def test_weight_only_linear_parity_and_swap():
+    """Weight-only int8 (serving's opt-in engine config): only the
+    WEIGHT is quantized, so the error budget is d_in * half a weight
+    step — tighter than full int8."""
+    from paddle2_tpu.quantization import (WeightOnlyLinear,
+                                          weight_only_quantize)
+    paddle.seed(2)
+    rs = np.random.RandomState(2)
+    m = nn.Sequential(nn.Linear(20, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = paddle.to_tensor(rs.randn(8, 20).astype(np.float32))
+    ref = np.asarray(m(x).numpy())
+    w0 = np.asarray(m[0].weight.numpy())
+    weight_only_quantize(m)
+    swapped = [l for _, l in m.named_sublayers()
+               if isinstance(l, WeightOnlyLinear)]
+    assert len(swapped) == 2
+    assert swapped[0].weight_int8.dtype == np.int8
+    out = np.asarray(m(x).numpy())
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert rel < 0.02, rel
+    # per-channel scales really are per OUTPUT channel of [in, out]
+    assert tuple(swapped[0].w_scale.shape) == (w0.shape[1],)
+    # int8 payload + scales ride state_dict (jit.save carries them)
+    sd = m.state_dict()
+    assert any("weight_int8" in k for k in sd)
