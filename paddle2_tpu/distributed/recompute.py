@@ -15,12 +15,32 @@ import jax
 from ..framework.tensor import Tensor
 from ..ops.dispatch import apply_op
 
-__all__ = ["recompute", "recompute_sequential"]
+__all__ = ["recompute", "recompute_sequential", "resolve_policy"]
 
 
-def recompute(function, *args, use_reentrant: bool = True, **kwargs):
+def resolve_policy(policy):
+    """Normalize a remat policy: None/"full" -> full recompute (plain
+    ``jax.checkpoint``); a granularity name ("dots", "dots_plus",
+    "dots_plus_ln", "offload", "nothing") -> the matching
+    ``kernels.attention.remat_policy``; a callable passes through
+    (already a jax checkpoint policy)."""
+    if policy is None or policy == "full":
+        return None
+    if callable(policy):
+        return policy
+    from ..kernels.attention import remat_policy
+    return remat_policy(str(policy))
+
+
+def recompute(function, *args, use_reentrant: bool = True, policy=None,
+              **kwargs):
     """Run ``function`` (Layer or callable) over ``args`` with activation
-    checkpointing: only the inputs (and params) are saved for backward."""
+    checkpointing: only the inputs (and params) are saved for backward.
+
+    ``policy`` selects WHAT is saved beyond the inputs: a granularity
+    name or jax checkpoint policy (see :func:`resolve_policy`) — the
+    seam the cost-model remat searcher wires its winner through on the
+    non-scan path."""
     from ..nn.layer.layers import Layer
 
     params: List[Tensor] = []
@@ -51,7 +71,9 @@ def recompute(function, *args, use_reentrant: bool = True, **kwargs):
             return tuple(o._data for o in out)
         return out._data
 
-    ckpt = jax.checkpoint(pure)
+    resolved = resolve_policy(policy)
+    ckpt = jax.checkpoint(pure) if resolved is None \
+        else jax.checkpoint(pure, policy=resolved)
     return apply_op("recompute", ckpt, tuple(state + arg_tensors), {})
 
 

@@ -159,6 +159,14 @@ define_flag("donate_optimizer_buffers", True,
             "executable (XLA in-place aliasing; saves ~3x model size of HBM "
             "traffic per step). Disable if you hold aliases of parameter "
             "arrays across optimizer steps.")
+define_flag("fused_optimizer_step", False,
+            "Route AdamW/Momentum updates through the one-pass Pallas "
+            "step kernels (kernels/pallas_fused.py fused_*_step): one "
+            "HBM pass over (param, grad, moments) with in-place output "
+            "aliases instead of XLA's multi-op chain and its staging "
+            "copies. Bitwise-identical to the generic update on f32 "
+            "state (bench --single-chip-speed gates it); per-optimizer "
+            "fused= ctor kwarg overrides the flag either way.")
 
 
 # -- XLA comm/compute-overlap knobs (multichip) -----------------------------

@@ -226,10 +226,22 @@ class TrainStepProgram:
                     and getattr(self._zero, "_prefetch", False))
         prefetch_depth = (getattr(self._zero, "_prefetch_depth", 1)
                           if prefetch else 0)
+        # searched remat policies are resolved BEFORE the key is
+        # computed (layers expose the _prepare_remat protocol — the
+        # GPT trunk runs the cost-model search against this call's
+        # batch shape) and the resolved plan keys the cache: two
+        # models differing only in searched policy trace different
+        # programs
+        remat_tokens = tuple(
+            l._prepare_remat(arg_arrays)
+            if hasattr(l, "_prepare_remat")
+            else getattr(l, "_remat_token", None)
+            for l in self.layers)
         key = _guard_key(template, arg_arrays, self.layers) + (
             len(opt_params), need_clip, decay_flags, donate, k,
             apply_update, self._accum_avg, self._instrument,
-            has_scaler, fault, prefetch, prefetch_depth)
+            has_scaler, fault, prefetch, prefetch_depth, remat_tokens,
+            opt._use_fused_step())
         entry = self._compiled.get(key)
         built_now = entry is None
         if built_now:

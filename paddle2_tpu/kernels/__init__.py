@@ -1,0 +1,15 @@
+"""TPU kernels: Pallas flash attention, fused elementwise/optimizer
+steps, low-precision matmul paths, and the XLA reference attention.
+
+Submodules (imported lazily by their call sites — importing this
+package stays cheap):
+
+* ``attention`` — `scaled_dot_product_attention` + remat policies.
+* ``pallas_flash`` — the tiled online-softmax flash kernel.
+* ``pallas_fused`` — fused AdamW/momentum STEP kernels (bitwise eager
+  twins, in-place aliased), rmsnorm, rope.
+* ``pallas_matmul`` — int8 weight-only / int8xint8 / fp8-shaped matmul
+  kernels with analytic error bounds (ISSUE 10).
+* ``pallas_ln`` — fused LayerNorm (flag-gated).
+* ``fused_ce`` — chunked fused head + cross-entropy.
+"""

@@ -77,6 +77,21 @@ def remat_policy(base: str = "dots"):
                                         "mlp_gelu", "ln_out")
         return cp.save_from_both_policies(
             cp.dots_with_no_batch_dims_saveable, more)
+    if base == "offload":
+        # park the matmul outputs + named residuals in pinned host
+        # memory instead of recomputing OR holding them in HBM (the
+        # remat searcher's "offload_dots" candidate). Approximation of
+        # the modeled candidate: only dot outputs and tagged names
+        # offload — cheap elementwise still recomputes, exactly the
+        # backward work the search charged it.
+        offload_names = cp.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["flash_out", "flash_lse",
+                                          "mlp_gelu", "ln_out"],
+            offload_src="device", offload_dst="pinned_host")
+        return cp.save_from_both_policies(
+            cp.offload_dot_with_no_batch_dims("device", "pinned_host"),
+            offload_names)
     return names
 
 

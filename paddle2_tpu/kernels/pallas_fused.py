@@ -98,6 +98,165 @@ def fused_adamw(param, grad, m, v, master, lr, beta1=0.9, beta2=0.999,
             vo[:n].reshape(param.shape), wo[:n].reshape(param.shape))
 
 
+# ----------------------------------------------- fused optimizer STEP
+# Bitwise twins of the eager Optimizer update rules: unlike
+# fused_adamw above (which fuses the decay into one multiply-add —
+# fast, but a different rounding order), these kernels replicate the
+# EXACT op sequence of optimizer/optimizers.py `_update_one` +
+# `_apply_one`, so the fused step is provably a pure layout/fusion
+# change — the bench gate asserts params AND moments bitwise equal to
+# the eager path on f32 state. One kernel pass reads (p, g, m, v) and
+# writes (p, m, v) with input_output_aliases pinning the update in
+# place — none of the transpose/copy staging XLA inserts around the
+# multi-op eager chain.
+
+def _pad_flat(arrs, blk):
+    n = arrs[0].size
+    npad = -(-n // blk) * blk
+    out = []
+    for a in arrs:
+        f = a.reshape(-1)
+        if npad != n:
+            f = jnp.concatenate([f, jnp.zeros(npad - n, f.dtype)])
+        out.append(f)
+    return out, n, npad
+
+
+def _adamw_step_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
+                       p_out, m_out, v_out, *, apply_wd):
+    """sc: [lr, b1, 1-b1, b2, 1-b2, eps, wd, bc1, bc2]. The 1-b* and
+    bc* values are computed OUTSIDE exactly as the eager expressions
+    compute them (python-f64 constants, runtime pow) — recomputing
+    1-b1 here in f32 would round differently and break bitwise."""
+    lr = sc_ref[0]
+    b1 = sc_ref[1]
+    om1 = sc_ref[2]
+    b2 = sc_ref[3]
+    om2 = sc_ref[4]
+    eps = sc_ref[5]
+    wd = sc_ref[6]
+    bc1 = sc_ref[7]
+    bc2 = sc_ref[8]
+    g = g_ref[:]
+    p = p_ref[:]
+    m = b1 * m_ref[:] + om1 * g
+    v = b2 * v_ref[:] + om2 * jnp.square(g)
+    mhat = m / bc1
+    vhat = v / bc2
+    new_p = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+    if apply_wd:
+        # decoupled decay against the PRE-update param, as a separate
+        # subtract — the eager AdamW order
+        new_p = new_p - lr * wd * p
+    p_out[:] = new_p
+    m_out[:] = m
+    v_out[:] = v
+
+
+def adamw_step_supported(work, grad) -> bool:
+    """The bitwise-fused path serves f32 math only: f32 working param
+    (plain f32, or the multi-precision master) and an f32 grad (the
+    master path casts explicitly, matching eager). A bf16 grad without
+    a master promotes through bf16 intermediates on the eager path —
+    that rounding order is not worth replicating in-kernel, so it
+    falls back."""
+    return (work.dtype == jnp.float32 and grad.dtype == jnp.float32)
+
+
+def fused_adamw_step(param, grad, m, v, lr, step, beta1=0.9,
+                     beta2=0.999, eps=1e-8, weight_decay=0.0,
+                     block=None, interpret=None):
+    """One-pass eager-order AdamW: returns (new_param, new_m, new_v)
+    BITWISE equal to `Adam._update_one` + decoupled decay on f32
+    state. `lr`/`step` are traced scalars; betas/eps/wd python floats.
+    `weight_decay=0.0` skips the decay subtract entirely (the eager
+    `if wd and decay` branch)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    t = step.astype(jnp.float32)
+    # eager-twin scalar staging: 1-b computed in python f64 (the eager
+    # closure constant), bias corrections at runtime from the weak-f32
+    # pow — identical HLO to `1 - b1 ** t`
+    sc = jnp.stack([
+        lr.astype(jnp.float32), jnp.float32(beta1),
+        jnp.float32(1 - beta1), jnp.float32(beta2),
+        jnp.float32(1 - beta2), jnp.float32(eps),
+        jnp.float32(weight_decay),
+        (1 - beta1 ** t).astype(jnp.float32),
+        (1 - beta2 ** t).astype(jnp.float32)])
+    blk = block or min(param.size, 1 << 17)
+    flats, n, npad = _pad_flat([param, grad, m, v], blk)
+    p1, g1, m1, v1 = flats
+    grid = (npad // blk,)
+    spec = pl.BlockSpec((blk,), lambda i: (i,))
+    po, mo, vo = pl.pallas_call(
+        functools.partial(_adamw_step_kernel,
+                          apply_wd=bool(weight_decay)),
+        grid=grid,
+        in_specs=[spec, spec, spec, spec,
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[spec, spec, spec],
+        out_shape=[jax.ShapeDtypeStruct((npad,), jnp.float32)] * 3,
+        # layout pinning: update in place — no staging copies
+        input_output_aliases={0: 0, 2: 1, 3: 2},
+        interpret=interpret,
+    )(p1, g1, m1, v1, sc)
+    shape = param.shape
+    return (po[:n].reshape(shape), mo[:n].reshape(shape),
+            vo[:n].reshape(shape))
+
+
+def _momentum_step_kernel(p_ref, g_ref, v_ref, sc_ref, p_out, v_out,
+                          *, nesterov, apply_wd):
+    """sc: [lr, momentum, wd]. Eager-order Momentum (l2 decay folded
+    into the grad BEFORE the velocity update, like `_apply_one`)."""
+    lr = sc_ref[0]
+    mom = sc_ref[1]
+    wd = sc_ref[2]
+    g = g_ref[:]
+    p = p_ref[:]
+    if apply_wd:
+        g = g + wd * p
+    v = mom * v_ref[:] + g
+    if nesterov:
+        new_p = p - lr * (g + mom * v)
+    else:
+        new_p = p - lr * v
+    p_out[:] = new_p
+    v_out[:] = v
+
+
+def fused_momentum_step(param, grad, velocity, lr, momentum=0.9,
+                        nesterov=False, weight_decay=0.0, block=None,
+                        interpret=None):
+    """One-pass eager-order (possibly Nesterov) momentum: bitwise
+    equal to `Momentum._update_one` (+ the pre-update l2 fold) on f32
+    state."""
+    if interpret is None:
+        interpret = _interpret_default()
+    sc = jnp.stack([lr.astype(jnp.float32), jnp.float32(momentum),
+                    jnp.float32(weight_decay)])
+    blk = block or min(param.size, 1 << 17)
+    flats, n, npad = _pad_flat([param, grad, velocity], blk)
+    p1, g1, v1 = flats
+    grid = (npad // blk,)
+    spec = pl.BlockSpec((blk,), lambda i: (i,))
+    po, vo = pl.pallas_call(
+        functools.partial(_momentum_step_kernel,
+                          nesterov=bool(nesterov),
+                          apply_wd=bool(weight_decay)),
+        grid=grid,
+        in_specs=[spec, spec, spec,
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[spec, spec],
+        out_shape=[jax.ShapeDtypeStruct((npad,), jnp.float32)] * 2,
+        input_output_aliases={0: 0, 2: 1},
+        interpret=interpret,
+    )(p1, g1, v1, sc)
+    shape = param.shape
+    return po[:n].reshape(shape), vo[:n].reshape(shape)
+
+
 # ------------------------------------------------------------ fused rmsnorm
 
 def _rmsnorm_fwd_kernel(x_ref, w_ref, o_ref, r_ref, *, eps):

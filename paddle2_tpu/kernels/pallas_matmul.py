@@ -1,0 +1,269 @@
+"""Low-precision Pallas matmul paths for the big GPT projections
+(fused QKV, out_proj, MLP up/down, lm_head).
+
+Two dtype families:
+
+* **int8 weight-only** — weights ride as int8 with per-OUT-CHANNEL f32
+  absmax scales (the ``quantization`` module's channel-wise observer
+  convention; :func:`channel_absmax` here is the shared primitive the
+  observers reduce with). The kernel streams the int8 weight tile into
+  VMEM (HALF the HBM bytes of bf16 — decode and lm_head matmuls are
+  weight-bandwidth-bound), dequantizes in-register, and runs the MXU in
+  the activation dtype. Error is ANALYTICALLY bounded:
+  per-element weight error <= s_j / (2*qmax) (round-to-nearest half
+  step), so ``|y_ref - y_q|[i, j] <= ||x_i||_1 * s_j / (2*qmax)`` —
+  :func:`weight_quant_error_bound` computes it and the bench gate
+  asserts it holds AND is non-vacuous (a mis-scaled payload violates
+  it).
+* **int8 x int8** — both operands int8, int32 MXU accumulation (2x the
+  bf16 rate on v5e), dequantized at the epilogue: the
+  ``QuantedInferenceLinear`` full-int8 path as a Pallas kernel.
+* **fp8-shaped** (:func:`fp8_matmul`) — where the jax build exposes
+  ``float8_e4m3fn``, the same tiling with fp8 operand casts; gated by
+  :func:`fp8_supported` and never chosen implicitly.
+
+Dispatch: :func:`int8_weight_only_matmul` runs the Pallas kernel on TPU
+for aligned shapes and falls back to the numerically-equivalent XLA
+lowering elsewhere (CPU/CI, ragged shapes) — both produce the same
+dequantized product, so the analytic bound gates BOTH lowerings.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_M = 256
+DEFAULT_BLOCK_N = 256
+DEFAULT_BLOCK_K = 512
+
+
+def _interpret_default() -> bool:
+    try:
+        return jax.devices()[0].platform.lower() == "cpu"
+    except Exception:
+        return True
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform.lower() == "tpu"
+    except Exception:
+        return False
+
+
+# ------------------------------------------------------------ primitives
+def channel_absmax(arr, axis: int):
+    """Per-channel absmax of ``arr`` along ``axis`` (reduced over every
+    OTHER axis) — the one reduction the quantization observers, the
+    weight-only packers, and the training-time fake-quant head all
+    share, so their scales agree bitwise."""
+    axis = axis % arr.ndim
+    red = tuple(i for i in range(arr.ndim) if i != axis)
+    return jnp.max(jnp.abs(arr), axis=red).astype(jnp.float32)
+
+
+def quantize_channelwise(w, quant_bits: int = 8, axis: int = 1
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(w_int8, scale): symmetric per-channel absmax quantization of a
+    weight along ``axis`` (out-channel for ``[in, out]`` Linear
+    weights)."""
+    qmax = float(2 ** (quant_bits - 1) - 1)
+    scale = jnp.maximum(channel_absmax(w, axis), 1e-8)
+    shape = [1] * w.ndim
+    shape[axis % w.ndim] = -1
+    s = scale.reshape(shape)
+    w_q = jnp.clip(jnp.round(w.astype(jnp.float32) / s * qmax),
+                   -qmax, qmax).astype(jnp.int8)
+    return w_q, scale
+
+
+def weight_quant_error_bound(x, w_scale, quant_bits: int = 8):
+    """Analytic per-(row, out-channel) bound on the weight-only
+    quantization error of ``x @ W``: each dequantized weight element is
+    within ``s_j / (2*qmax)`` of the original (round-to-nearest), so
+    the product error is bounded by the l1 norm of the activation row
+    times that half-step. Returns ``[..., out]`` f32."""
+    qmax = float(2 ** (quant_bits - 1) - 1)
+    l1 = jnp.sum(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                 keepdims=True)
+    return l1 * (w_scale.astype(jnp.float32) / (2.0 * qmax))
+
+
+# ------------------------------------------------- int8 weight-only kernel
+def _wo_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, qmax, k_steps):
+    """Grid (M/bm, N/bn, K/bk): f32 VMEM accumulator, int8 weight tile
+    dequantized in-register, per-out-channel scale applied once at the
+    epilogue (the matmul is linear in the weight, so scaling the
+    accumulated column equals scaling every tile)."""
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    acc_ref[...] += jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(2) == k_steps - 1)
+    def _epilogue():
+        o_ref[...] = (acc_ref[...] * (s_ref[:] / qmax)).astype(
+            o_ref.dtype)
+
+
+def _wo_pallas(x2, w_int8, scale, qmax, out_dtype, bm, bn, bk,
+               interpret):
+    M, K = x2.shape
+    N = w_int8.shape[1]
+    grid = (M // bm, N // bn, K // bk)
+    return pl.pallas_call(
+        functools.partial(_wo_kernel, qmax=qmax, k_steps=grid[2]),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+    )(x2, w_int8, scale.reshape(1, N))
+
+
+def wo_supported(m: int, k: int, n: int, bm: int = DEFAULT_BLOCK_M,
+                 bn: int = DEFAULT_BLOCK_N,
+                 bk: int = DEFAULT_BLOCK_K) -> bool:
+    """Pallas path needs block-aligned operands (the XLA fallback
+    serves ragged shapes with identical numerics)."""
+    bm, bn, bk = min(bm, m), min(bn, n), min(bk, k)
+    return m % bm == 0 and k % bk == 0 and n % bn == 0
+
+
+def int8_weight_only_matmul(x, w_int8, w_scale, bias=None,
+                            quant_bits: int = 8,
+                            block_m: int = DEFAULT_BLOCK_M,
+                            block_n: int = DEFAULT_BLOCK_N,
+                            block_k: int = DEFAULT_BLOCK_K,
+                            interpret: Optional[bool] = None):
+    """``x @ dequant(w_int8)`` with per-out-channel scales: the Pallas
+    weight-only kernel on TPU for aligned shapes, the equivalent XLA
+    dequant-matmul elsewhere. ``x``: ``[..., K]`` float; ``w_int8``:
+    ``[K, N]``; ``w_scale``: ``[N]``."""
+    qmax = float(2 ** (quant_bits - 1) - 1)
+    lead = x.shape[:-1]
+    K = x.shape[-1]
+    N = w_int8.shape[1]
+    m = 1
+    for d in lead:
+        m *= int(d)
+    aligned = wo_supported(m, K, N, block_m, block_n, block_k)
+    use_pallas = aligned and (interpret is True or _on_tpu())
+    if use_pallas:
+        x2 = x.reshape(m, K)
+        # with a bias the kernel keeps its epilogue in f32 so the bias
+        # folds in BEFORE the single output cast — the same rounding
+        # order as the XLA fallback below (casting first would make
+        # the two lowerings diverge at the last ulp for bf16)
+        out_dtype = jnp.float32 if bias is not None else x.dtype
+        out = _wo_pallas(x2, w_int8, jnp.asarray(w_scale, jnp.float32),
+                         qmax, out_dtype, min(block_m, m),
+                         min(block_n, N), min(block_k, K),
+                         bool(interpret) if interpret is not None
+                         else _interpret_default())
+        out = out.reshape(lead + (N,))
+        if bias is not None:
+            out = (out + bias).astype(x.dtype)
+        return out
+    w = w_int8.astype(jnp.float32) * (
+        jnp.asarray(w_scale, jnp.float32) / qmax)
+    out = jax.lax.dot_general(
+        x.astype(jnp.float32), w,
+        (((x.ndim - 1,), (0,)), ((), ())))
+    if bias is not None:
+        # bias folds in at f32 BEFORE the output cast — the exact
+        # order of the pre-kernel WeightOnlyLinear lowering
+        out = out + bias
+    return out.astype(x.dtype)
+
+
+# --------------------------------------------------- int8 x int8 kernel
+def _i8i8_kernel(x_ref, w_ref, o_ref, acc_ref, *, k_steps):
+    """int8 x int8 -> int32 MXU accumulation (v5e runs this at 2x the
+    bf16 rate); dequant happens OUTSIDE (caller owns both scales)."""
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[:], w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+    @pl.when(pl.program_id(2) == k_steps - 1)
+    def _epilogue():
+        o_ref[...] = acc_ref[...]
+
+
+def int8_matmul(x_int8, w_int8,
+                block_m: int = DEFAULT_BLOCK_M,
+                block_n: int = DEFAULT_BLOCK_N,
+                block_k: int = DEFAULT_BLOCK_K,
+                interpret: Optional[bool] = None):
+    """Full-int8 ``[M, K] @ [K, N] -> int32``: the Pallas twin of
+    ``QuantedInferenceLinear``'s dot (TPU, aligned), XLA
+    ``dot_general`` with int32 accumulation elsewhere."""
+    M, K = x_int8.shape
+    N = w_int8.shape[1]
+    aligned = wo_supported(M, K, N, block_m, block_n, block_k)
+    use_pallas = aligned and (interpret is True or _on_tpu())
+    if not use_pallas:
+        return jax.lax.dot_general(
+            x_int8, w_int8, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    bm, bn, bk = min(block_m, M), min(block_n, N), min(block_k, K)
+    grid = (M // bm, N // bn, K // bk)
+    return pl.pallas_call(
+        functools.partial(_i8i8_kernel, k_steps=grid[2]),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.int32)],
+        interpret=bool(interpret) if interpret is not None
+        else _interpret_default(),
+    )(x_int8, w_int8)
+
+
+# ------------------------------------------------------------- fp8-shaped
+def fp8_supported() -> bool:
+    """True when this jax build carries the fp8 dtypes (the kernels are
+    SHAPE-compatible with fp8 — actual fp8 MXU rate needs v5p+)."""
+    return hasattr(jnp, "float8_e4m3fn")
+
+
+def fp8_matmul(x, w, interpret: Optional[bool] = None):
+    """fp8-shaped matmul: both operands cast to ``float8_e4m3fn``,
+    accumulated in f32. Opt-in only (caller owns the accuracy story);
+    raises where the dtype does not exist."""
+    if not fp8_supported():
+        raise NotImplementedError(
+            "fp8_matmul: this jax build has no float8_e4m3fn dtype")
+    f8 = jnp.float8_e4m3fn
+    out = jax.lax.dot_general(
+        x.astype(f8), w.astype(f8),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return out.astype(x.dtype)
+
+
+__all__ = ["channel_absmax", "quantize_channelwise",
+           "weight_quant_error_bound", "int8_weight_only_matmul",
+           "int8_matmul", "fp8_matmul", "fp8_supported", "wo_supported",
+           "DEFAULT_BLOCK_M", "DEFAULT_BLOCK_N", "DEFAULT_BLOCK_K"]

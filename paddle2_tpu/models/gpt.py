@@ -49,8 +49,17 @@ class GPTConfig:
     # remat selectivity: "full" recomputes everything (min memory);
     # "dots" saves matmul outputs and recomputes elementwise only
     # (jax checkpoint_policies.dots_with_no_batch_dims_saveable) — the
-    # usual best speed/memory point on TPU
+    # usual best speed/memory point on TPU; "dots_plus"/"dots_plus_ln"
+    # additionally pin the gelu / LN outputs; "offload" parks the
+    # heavies in pinned host memory; "search" runs the deterministic
+    # cost-model policy search (incubate.autotune.search_remat_policy)
+    # once per (batch, seq) and wires the minimal-recompute policy
+    # that fits remat_budget_gb
     recompute_granularity: str = "full"
+    # HBM budget the "search" granularity must fit (params + grads +
+    # optimizer state + saved activations, cost-model accounting).
+    # None: $PADDLE_REMAT_BUDGET_GB, else the v5e 16 GB default.
+    remat_budget_gb: Optional[float] = None
     # compile the block stack as ONE lax.scan body under to_static —
     # compile time (and HLO size) become depth-independent, the standard
     # TPU recipe for deep transformers. Falls back to the Python loop in
@@ -71,6 +80,16 @@ class GPTConfig:
     # vocab] f32 logits are never materialized. forward(labels=...) then
     # returns (None, loss). Single-device / non-TP path only.
     fused_head_loss: bool = False
+    # opt-in TRAINING-TIME int8 weight-only path for the lm_head /
+    # logits matmul: the head weight is per-vocab-channel absmax
+    # fake-quantized (straight-through gradients back to the fp
+    # weight), so the forward logits equal the int8 weight-only
+    # serving matmul within its analytic error bound while training
+    # stays differentiable. Shared-embedding aware: with tied
+    # embeddings only the HEAD read of wte is quantized, never the
+    # embedding lookup. Mutually exclusive with fused_head_loss
+    # (whose chunked kernel owns the head matmul).
+    quantized_lm_head: bool = False
 
     @property
     def ffn_size(self) -> int:
@@ -204,10 +223,14 @@ class GPTMLP(nn.Layer):
         self.up, self.down = _linear_pair(cfg, cfg.hidden_size, cfg.ffn_size,
                                           cfg.initializer_range)
         # the gelu residual tag only matters when the dots_plus remat
-        # policy will consume it; other configs skip the extra dispatch
+        # policy will consume it; other configs skip the extra dispatch.
+        # "search"/"offload" tag unconditionally: the resolved policy
+        # may pin the name, and an unconsumed checkpoint_name is a
+        # bitwise-neutral identity
         self._tag_gelu = (cfg.use_recompute
                           and cfg.recompute_granularity in
-                          ("dots_plus", "dots_plus_ln"))
+                          ("dots_plus", "dots_plus_ln", "search",
+                           "offload"))
 
     def forward(self, x):
         h = F.gelu(self.up(x))
@@ -235,7 +258,8 @@ class GPTBlock(nn.Layer):
         self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
         self._tag_ln = (cfg.use_recompute
-                        and cfg.recompute_granularity == "dots_plus_ln")
+                        and cfg.recompute_granularity in
+                        ("dots_plus_ln", "search", "offload"))
 
     def _ln(self, norm, x):
         out = norm(x)
@@ -285,16 +309,97 @@ class GPTModel(nn.Layer):
             self.h = nn.LayerList(blocks)
         self.ln_f = nn.LayerNorm(cfg.hidden_size,
                                  epsilon=cfg.layer_norm_epsilon)
+        # "search" granularity: plans resolved per (batch, seq) by the
+        # deterministic cost-model searcher; the per-shape cache token
+        # keys the jit.train_step program cache so two models differing
+        # only in resolved policy never share a compiled entry
+        self._remat_plans: dict = {}
+        # untied-head models register their extra head params here
+        # (GPTForCausalLM ctor): the budget's fixed-bytes accounting
+        # must see EVERY trained parameter, not just the trunk's
+        self._remat_fixed_params_extra = 0
+
+    # -- remat policy resolution ----------------------------------------
+    def _resolved_remat(self, batch: int, seq: int):
+        """(use_recompute, granularity) for this forward. Non-"search"
+        configs pass through; "search" resolves (and caches) a
+        :class:`~paddle2_tpu.incubate.autotune.RematPlan` for the
+        (batch, seq) shape — a pure function of config + rate model,
+        so every host resolves the same policy."""
+        cfg = self.cfg
+        if not cfg.use_recompute:
+            return False, cfg.recompute_granularity
+        if cfg.recompute_granularity != "search":
+            return True, cfg.recompute_granularity
+        key = (int(batch), int(seq))
+        plan = self._remat_plans.get(key)
+        if plan is None:
+            import os as _os
+            from ..incubate import autotune
+            budget_gb = cfg.remat_budget_gb
+            if budget_gb is None:
+                budget_gb = float(_os.environ.get(
+                    "PADDLE_REMAT_BUDGET_GB", 16.0))
+            # fixed footprint: bf16 params + bf16 grads + f32 master +
+            # two f32 Adam moments (the multi-precision AdamW worst
+            # case the BENCH config trains with); the extra term covers
+            # params owned OUTSIDE the trunk (an untied lm_head)
+            n_params = (sum(int(p.size) for p in self.parameters())
+                        + int(self._remat_fixed_params_extra))
+            fixed = float(n_params) * (2.0 + 2.0 + 3 * 4.0)
+            plan = autotune.search_remat_policy(
+                hidden=cfg.hidden_size, num_layers=cfg.num_layers,
+                num_heads=cfg.num_heads, seq=seq, batch=batch,
+                ffn=cfg.ffn_size, budget_bytes=budget_gb * 1e9,
+                fixed_bytes=fixed)
+            self._remat_plans[key] = plan
+        return plan.use_recompute, plan.granularity
+
+    def _remat_token_for(self, batch: int, seq: int):
+        """The program-cache token of THIS shape's resolved plan —
+        per shape, never the last-resolved one (a stale global token
+        would force a duplicate compile every time shapes alternate)."""
+        plan = self._remat_plans.get((int(batch), int(seq)))
+        if plan is None:
+            return None
+        return plan.cache_token() + (int(batch), int(seq))
+
+    def _prepare_remat(self, arg_arrays):
+        """jit.train_step protocol: resolve the searched policy from
+        the call's batch shape BEFORE the program-cache key is
+        computed, and return THIS SHAPE's cache token (None when
+        nothing is searched). Keeps the first compiled entry and every
+        later same-shape call under the SAME key — no wasted duplicate
+        compile, even when batch shapes alternate."""
+        cfg = self.cfg
+        if not (cfg.use_recompute
+                and cfg.recompute_granularity == "search"
+                and self.training):
+            return None
+        for a in arg_arrays:
+            shp = getattr(a, "shape", None)
+            if shp is not None and len(shp) == 2:
+                self._resolved_remat(int(shp[0]), int(shp[1]))
+                return self._remat_token_for(int(shp[0]), int(shp[1]))
+        return None
+
+    def remat_plan(self, batch: int, seq: int):
+        """The resolved searched plan for a shape (resolving it if
+        needed) — None unless granularity is "search"."""
+        self._resolved_remat(batch, seq)
+        return self._remat_plans.get((int(batch), int(seq)))
 
     def forward(self, input_ids):
         b, s = input_ids.shape
         pos = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
         x = self.wte(input_ids) + self.wpe(pos)
         x = _seq_constrain(self.drop(x), self.cfg)
+        use_rc, gran = (self._resolved_remat(b, s) if self.training
+                        else (False, None))
         if self._can_scan(x):
-            x = self._scan_blocks(x)
+            x = self._scan_blocks(x, use_rc, gran)
         else:
-            x = self._fallback_loop(x)
+            x = self._fallback_loop(x, use_rc, gran)
         return self.ln_f(x)
 
     def _can_scan(self, x) -> bool:
@@ -305,7 +410,7 @@ class GPTModel(nn.Layer):
                      and cfg.attention_dropout_prob == 0.0
                      or not self.training))
 
-    def _scan_blocks(self, x: Tensor) -> Tensor:
+    def _scan_blocks(self, x: Tensor, use_rc: bool, gran) -> Tensor:
         """Run the homogeneous block stack as one lax.scan (shared
         machinery in models/_scan.py). With use_recompute the body is
         jax.checkpoint-ed with kernels.attention.remat_policy: 'dots' +
@@ -314,27 +419,32 @@ class GPTModel(nn.Layer):
         from ._scan import scan_layer_stack
 
         wrap = None
-        if self.cfg.use_recompute and self.training:
+        if use_rc and self.training:
             from ..kernels.attention import remat_policy
-            gran = self.cfg.recompute_granularity
             policy = remat_policy(
-                gran if gran in ("dots", "dots_plus", "dots_plus_ln")
+                gran if gran in ("dots", "dots_plus", "dots_plus_ln",
+                                 "offload")
                 else "nothing")
             wrap = lambda body: jax.checkpoint(body, policy=policy)
         if self.cfg.stacked_blocks:
             return self.h(x, wrap_body=wrap)
         out = scan_layer_stack(list(self.h), x, wrap_body=wrap)
-        return out if out is not None else self._fallback_loop(x)
+        return out if out is not None else \
+            self._fallback_loop(x, use_rc, gran)
 
-    def _fallback_loop(self, x: Tensor) -> Tensor:
+    def _fallback_loop(self, x: Tensor, use_rc: bool = None,
+                       gran=None) -> Tensor:
+        if use_rc is None:
+            use_rc, gran = (self._resolved_remat(*x.shape[:2])
+                            if self.training else (False, None))
         if self.cfg.stacked_blocks:
             # allow_scan=False: this path is taken exactly when _can_scan
             # said no (eager, or dropout needs per-layer rng streams)
             return self.h(x, allow_scan=False)
         for block in self.h:
-            if self.cfg.use_recompute and self.training:
+            if use_rc and self.training:
                 from ..distributed.recompute import recompute
-                x = recompute(block, x)
+                x = recompute(block, x, policy=gran)
             else:
                 x = block(x)
         return x
@@ -366,14 +476,45 @@ class GPTForCausalLM(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.cfg = cfg
+        if cfg.quantized_lm_head and cfg.fused_head_loss:
+            raise ValueError(
+                "quantized_lm_head and fused_head_loss are mutually "
+                "exclusive: the chunked fused-CE kernel owns the head "
+                "matmul, so there is no logits matmul to quantize")
         self.gpt = GPTModel(cfg)
         if not cfg.tie_word_embeddings:
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                      weight_attr=_init_attr(
                                          cfg.initializer_range),
                                      bias_attr=False)
+            # the remat searcher's fixed-bytes budget must count the
+            # head params the trunk cannot see
+            self.gpt._remat_fixed_params_extra = int(
+                self.lm_head.weight.size)
+
+    def _prepare_remat(self, arg_arrays):
+        """jit.train_step cache-key protocol — delegate to the trunk."""
+        return self.gpt._prepare_remat(arg_arrays)
 
     def _head(self, hidden):
+        # serving-time int8 payload installed by
+        # quantization.quantize_lm_head (shared-embedding aware: the
+        # embedding LOOKUP stays fp — only this head read is int8)
+        wo = getattr(self, "_wo_head", None)
+        if wo is not None:
+            return wo(hidden)
+        if self.cfg.quantized_lm_head:
+            # training-time int8 weight-only path: per-vocab-channel
+            # absmax fake quantization (STE) — forward logits equal
+            # the int8 serving matmul's dequantized product within its
+            # analytic bound, gradients flow straight through to the
+            # fp weight (and the tied embedding)
+            from ..quantization import channel_absmax, fake_quant
+            w = (self.gpt.wte.weight.T if self.cfg.tie_word_embeddings
+                 else self.lm_head.weight)
+            scale = channel_absmax(w, axis=1)
+            w = fake_quant(w, scale, bits=8, quant_axis=1)
+            return F.linear(hidden, w)
         if self.cfg.tie_word_embeddings:
             return F.linear(hidden, self.gpt.wte.weight.T)
         return self.lm_head(hidden)

@@ -48,7 +48,15 @@ CHIP_PEAKS: Dict[str, Tuple[float, float]] = {
     "v6 lite": (918e12, 1640e9), "v6e": (918e12, 1640e9),
     "trillium": (918e12, 1640e9),
 }
+# HBM capacity per chip generation (GB) — the remat searcher's budget
+# denominator and the single-chip bench's declared-budget source
+CHIP_HBM_GB: Dict[str, float] = {
+    "v5 lite": 16.0, "v5e": 16.0, "v5litepod": 16.0,
+    "v4": 32.0, "v5p": 95.0,
+    "v6 lite": 32.0, "v6e": 32.0, "trillium": 32.0,
+}
 _DEFAULT_PEAK = (197e12, 819e9)          # v5e-assumed
+_DEFAULT_HBM_GB = 16.0
 # CPU fallback: a deliberately round nominal figure so MFU numbers off
 # accelerators are obviously synthetic rather than silently wrong
 _CPU_PEAK = (1e11, 5e10)
@@ -395,6 +403,88 @@ class StepCost:
         }
 
 
+def chip_hbm_gb(device=None) -> float:
+    """HBM capacity (GB) of ``device`` (default: jax device 0), from
+    the generation table; ``PADDLE_HBM_CAPACITY_GB`` overrides, CPU /
+    unknown falls back to the v5e 16 GB figure."""
+    env = os.environ.get("PADDLE_HBM_CAPACITY_GB")
+    if env:
+        return float(env)
+    kind = ""
+    try:
+        if device is None:
+            import jax
+            device = jax.devices()[0]
+        kind = (getattr(device, "device_kind", "") or "").lower()
+    except Exception:
+        pass
+    for key, gb in CHIP_HBM_GB.items():
+        if key in kind:
+            return gb
+    return _DEFAULT_HBM_GB
+
+
+class PhasedStepCost:
+    """A step modeled as a SEQUENCE of roofline phases.
+
+    One :class:`StepCost` folds the whole program into a single
+    ``max(compute, memory)`` — fine for matmul-dominated fwd+bwd, but
+    it hides serial tails whose binding resource differs: the
+    optimizer update is HBM-bound and runs strictly AFTER the last
+    gradient; remat recompute is extra backward work the matmul phase
+    cannot absorb. Each phase is its own roofline and the step is the
+    SUM — the accounting the single-chip speed gate and the
+    perf_doctor MFU lane read."""
+
+    def __init__(self):
+        self.phases: List[Tuple[str, StepCost]] = []
+
+    def add(self, name: str, cost: StepCost) -> "PhasedStepCost":
+        self.phases.append((name, cost))
+        return self
+
+    def step_time_modeled_s(self) -> float:
+        return sum(c.step_time_modeled_s() for _, c in self.phases)
+
+    def flops(self) -> float:
+        return sum(c.flops for _, c in self.phases)
+
+    def hbm_bytes(self) -> float:
+        return sum(c.hbm_bytes for _, c in self.phases)
+
+    def mfu_modeled(self) -> Optional[float]:
+        """Model FLOPs over the chip peak for the MODELED step time —
+        the deterministic MFU ceiling of this program shape (the
+        number the perf_doctor MFU lane aggregates). Uses the FIRST
+        phase's peak (phases share a chip)."""
+        t = self.step_time_modeled_s()
+        if not self.phases or t <= 0:
+            return None
+        peak = self.phases[0][1].peak_flops
+        return self.flops() / (peak * t) if peak else None
+
+    def breakdown(self) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        for name, c in self.phases:
+            out[name] = {
+                "flops": c.flops, "hbm_bytes": c.hbm_bytes,
+                "compute_s": c.compute_s(), "memory_s": c.memory_s(),
+                "step_time_modeled_s": c.step_time_modeled_s(),
+                "bound": c.bound()}
+        return out
+
+    def step_record_fields(self) -> Dict[str, float]:
+        """The metrics-plane step-record lane: stamp these through
+        ``metrics.step_end(**fields)`` and ``perf_doctor`` renders the
+        MFU/roofline columns (aggregated only when every rank carries
+        them)."""
+        peak = self.phases[0][1].peak_flops if self.phases else 0.0
+        return {"modeled_step_s": self.step_time_modeled_s(),
+                "roofline_s": self.step_time_modeled_s(),
+                "modeled_flops": self.flops(),
+                "peak_flops": peak}
+
+
 def step_cost_of_program(program, link: Optional[LinkModel] = None
                          ) -> Optional[StepCost]:
     """Build a :class:`StepCost` from a
@@ -413,7 +503,8 @@ def step_cost_of_program(program, link: Optional[LinkModel] = None
                     link=link)
 
 
-__all__ = ["CHIP_PEAKS", "chip_peak", "cost_analysis_of", "program_cost",
+__all__ = ["CHIP_PEAKS", "CHIP_HBM_GB", "chip_peak", "chip_hbm_gb",
+           "cost_analysis_of", "program_cost",
            "abstractify", "wire_bytes", "LinkModel", "CollectiveTraffic",
-           "StepCost", "step_cost_of_program", "PEAK_ENV", "HBM_ENV",
-           "ICI_ENV", "DCN_ENV", "DCN_AXES_ENV"]
+           "StepCost", "PhasedStepCost", "step_cost_of_program",
+           "PEAK_ENV", "HBM_ENV", "ICI_ENV", "DCN_ENV", "DCN_AXES_ENV"]

@@ -21,6 +21,18 @@ from ..framework.tensor import Parameter, Tensor
 from .lr import LRScheduler
 
 
+def _clip_arrays(grad_clip, grads, need_clip_flags):
+    """Gradient clipping over the clippable subset — shared by the
+    generic and fused update builders (one owner, identical ops)."""
+    if grad_clip is None:
+        return grads
+    clippable = [g for g, c in zip(grads, need_clip_flags) if c]
+    clipped = grad_clip.apply_arrays(clippable)
+    it = iter(clipped)
+    return [next(it) if c else g
+            for g, c in zip(grads, need_clip_flags)]
+
+
 class Optimizer:
     _hyper: Dict[str, float] = {}
 
@@ -101,49 +113,122 @@ class Optimizer:
     def _decoupled_wd(self) -> bool:
         return False  # AdamW overrides
 
-    def _build_update(self, need_clip_flags, decay_flags):
-        """The pure fused update `(params, grads, states, lr, step) ->
-        (new_params, new_states)` over flat lists — the TPU analog of the
-        reference's multi_tensor/fused optimizer kernels
-        (paddle/phi/kernels/fusion/fused_adam_kernel.cu): one traced
-        program updates every parameter. Used jitted-with-donation by
-        step() and inlined by jit.train_step's single-executable path."""
+    def _use_fused_step(self) -> bool:
+        """Opt-in Pallas fused-step routing: the explicit ``fused=``
+        ctor kwarg wins, else FLAGS_fused_optimizer_step."""
+        explicit = getattr(self, "_fused_step", None)
+        if explicit is not None:
+            return bool(explicit)
+        from ..flags import flag_value
+        return bool(flag_value("fused_optimizer_step"))
+
+    def _fused_update_builder(self, need_clip_flags, decay_flags):
+        """Subclasses with a Pallas one-pass kernel (AdamW, Momentum)
+        return a drop-in `update` here; None falls back to the generic
+        per-op chain. Any fused update MUST be bitwise equal to the
+        generic path — it is a layout/fusion change, never a numerics
+        change (bench --single-chip-speed gates this)."""
+        return None
+
+    def _fused_paramwise_builder(self, need_clip_flags, decay_flags,
+                                 kernel):
+        """ONE owner for the fused-update scaffolding every subclass
+        shares: clipping, multi-precision master unwrap/re-wrap, the
+        explicit f32 grad cast, and the per-tensor fallback to
+        `_apply_one`. ``kernel(work, g, inner, lr, step, wd_eff)``
+        returns ``(new_work, new_inner)`` or None when this tensor is
+        unsupported (then the generic chain serves it, still bitwise
+        by construction). l1 decay falls back wholesale — the kernels
+        implement the l2 fold only."""
         wd_kind, wd = self._weight_decay
-        decoupled = self._decoupled_wd()
+        if wd and wd_kind != "l2":
+            return None
         grad_clip = self._grad_clip
-        update_one = self._update_one
         multi_prec = self._multi_precision
+        apply_one = self._apply_one
 
         def update(params, grads, states, lr, step):
-            if grad_clip is not None:
-                clippable = [g for g, c in zip(grads, need_clip_flags) if c]
-                clipped = grad_clip.apply_arrays(clippable)
-                it = iter(clipped)
-                grads = [next(it) if c else g
-                         for g, c in zip(grads, need_clip_flags)]
+            grads = _clip_arrays(grad_clip, grads, need_clip_flags)
             new_params, new_states = [], []
-            for p, g, s, decay in zip(params, grads, states, decay_flags):
+            for p, g, s, decay in zip(params, grads, states,
+                                      decay_flags):
                 master = None
                 inner = s
                 if multi_prec and isinstance(s, dict) and "master" in s:
                     master, inner = s["master"], s["inner"]
-                    work_p = master
-                    g = g.astype(jnp.float32)
-                else:
-                    work_p = p
-                if wd and decay and not decoupled:
-                    reg = jnp.sign(work_p) if wd_kind == "l1" else work_p
-                    g = g + wd * reg
-                np_, ns_ = update_one(work_p, g, inner, lr, step)
-                if wd and decay and decoupled:
-                    reg = jnp.sign(work_p) if wd_kind == "l1" else work_p
-                    np_ = np_ - lr * wd * reg
+                work = master if master is not None else p
+                g_eff = g.astype(jnp.float32) if master is not None \
+                    else g
+                res = kernel(work, g_eff, inner, lr, step,
+                             wd if (wd and decay) else 0.0)
+                if res is None:
+                    np_, ns_ = apply_one(p, g, s, lr, step, decay)
+                    new_params.append(np_)
+                    new_states.append(ns_)
+                    continue
+                np_, ns_ = res
                 if master is not None:
                     new_params.append(np_.astype(p.dtype))
                     new_states.append({"master": np_, "inner": ns_})
                 else:
                     new_params.append(np_)
                     new_states.append(ns_)
+            return new_params, new_states
+        return update
+
+    def _apply_one(self, p, g, s, lr, step, decay):
+        """The per-parameter update body (weight decay + _update_one +
+        multi-precision master handling) shared by the generic update
+        and, as the per-tensor fallback, the fused paths."""
+        wd_kind, wd = self._weight_decay
+        decoupled = self._decoupled_wd()
+        master = None
+        inner = s
+        if self._multi_precision and isinstance(s, dict) \
+                and "master" in s:
+            master, inner = s["master"], s["inner"]
+            work_p = master
+            g = g.astype(jnp.float32)
+        else:
+            work_p = p
+        if wd and decay and not decoupled:
+            reg = jnp.sign(work_p) if wd_kind == "l1" else work_p
+            g = g + wd * reg
+        np_, ns_ = self._update_one(work_p, g, inner, lr, step)
+        if wd and decay and decoupled:
+            reg = jnp.sign(work_p) if wd_kind == "l1" else work_p
+            np_ = np_ - lr * wd * reg
+        if master is not None:
+            return np_.astype(p.dtype), {"master": np_, "inner": ns_}
+        return np_, ns_
+
+    def _build_update(self, need_clip_flags, decay_flags):
+        """The pure fused update `(params, grads, states, lr, step) ->
+        (new_params, new_states)` over flat lists — the TPU analog of the
+        reference's multi_tensor/fused optimizer kernels
+        (paddle/phi/kernels/fusion/fused_adam_kernel.cu): one traced
+        program updates every parameter. Used jitted-with-donation by
+        step() and inlined by jit.train_step's single-executable path.
+
+        With the fused-step opt-in, subclasses may swap the per-param
+        op chain for a one-pass Pallas kernel (bitwise-identical by
+        contract); everything else — clipping, decay flags, master
+        weights — is unchanged."""
+        if self._use_fused_step():
+            fused = self._fused_update_builder(need_clip_flags,
+                                               decay_flags)
+            if fused is not None:
+                return fused
+        apply_one = self._apply_one
+        grad_clip = self._grad_clip
+
+        def update(params, grads, states, lr, step):
+            grads = _clip_arrays(grad_clip, grads, need_clip_flags)
+            new_params, new_states = [], []
+            for p, g, s, decay in zip(params, grads, states, decay_flags):
+                np_, ns_ = apply_one(p, g, s, lr, step, decay)
+                new_params.append(np_)
+                new_states.append(ns_)
             return new_params, new_states
         return update
 
@@ -180,6 +265,7 @@ class Optimizer:
         from ..flags import flag_value
         donate = bool(flag_value("donate_optimizer_buffers"))
         cache_key = (len(params), need_clip, decay_flags, donate,
+                     self._use_fused_step(),
                      tuple(p.shape + (str(p.dtype),) for p in params))
         fn = self._jit_cache.get(cache_key)
         if fn is None:

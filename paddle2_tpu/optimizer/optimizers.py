@@ -29,11 +29,13 @@ class SGD(Optimizer):
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 multi_precision=False, name=None):
+                 multi_precision=False, name=None, fused=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._momentum = momentum
         self._nesterov = use_nesterov
+        # fused=True/False overrides FLAGS_fused_optimizer_step
+        self._fused_step = fused
 
     def _init_state(self, p):
         return {"velocity": jnp.zeros_like(
@@ -46,6 +48,27 @@ class Momentum(Optimizer):
         else:
             new_p = param - lr * v
         return new_p, {"velocity": v}
+
+    def _fused_update_builder(self, need_clip_flags, decay_flags):
+        """One-pass Pallas momentum (kernels/pallas_fused.py
+        fused_momentum_step), bitwise vs the generic chain on f32
+        state; l1 decay and unsupported dtypes fall back per tensor.
+        Scaffolding lives in the base `_fused_paramwise_builder`."""
+        from ..kernels import pallas_fused as pf
+        mom, nesterov = self._momentum, self._nesterov
+
+        def kernel(work, g, inner, lr, step, wd_eff):
+            if not (isinstance(inner, dict)
+                    and set(inner) == {"velocity"}
+                    and inner["velocity"].dtype == jnp.float32
+                    and pf.adamw_step_supported(work, g)):
+                return None
+            np_, nv = pf.fused_momentum_step(
+                work, g, inner["velocity"], lr, momentum=mom,
+                nesterov=nesterov, weight_decay=wd_eff)
+            return np_, {"velocity": nv}
+        return self._fused_paramwise_builder(need_clip_flags,
+                                             decay_flags, kernel)
 
 
 class Adam(Optimizer):
@@ -89,11 +112,15 @@ class AdamW(Adam):
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None,
-                 amsgrad=False):
+                 amsgrad=False, fused=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision,
                          name, amsgrad)
         self._apply_decay_fn = apply_decay_param_fun
+        # fused=True/False overrides FLAGS_fused_optimizer_step: route
+        # the per-param update through the one-pass Pallas kernel
+        # (bitwise vs the generic chain — bench-gated)
+        self._fused_step = fused
         if apply_decay_param_fun is not None:
             # mark params excluded from decay so the fused update skips them
             for g in self._param_groups:
@@ -103,6 +130,32 @@ class AdamW(Adam):
 
     def _decoupled_wd(self):
         return True
+
+    def _fused_update_builder(self, need_clip_flags, decay_flags):
+        """One-pass Pallas AdamW (kernels/pallas_fused.py
+        fused_adamw_step): reads (p, g, m, v), writes (p, m, v) with
+        in-place aliases — no staging copies — in the EXACT eager op
+        order, so params and moments stay bitwise. amsgrad / l1 decay
+        configs and non-f32 math fall back (per tensor) to the
+        generic chain. Scaffolding lives in the base
+        `_fused_paramwise_builder`."""
+        if self._amsgrad:
+            return None
+        from ..kernels import pallas_fused as pf
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+
+        def kernel(work, g, inner, lr, step, wd_eff):
+            if not (isinstance(inner, dict)
+                    and set(inner) == {"m", "v"}
+                    and inner["m"].dtype == jnp.float32
+                    and pf.adamw_step_supported(work, g)):
+                return None
+            np_, nm, nv = pf.fused_adamw_step(
+                work, g, inner["m"], inner["v"], lr, step,
+                beta1=b1, beta2=b2, eps=eps, weight_decay=wd_eff)
+            return np_, {"m": nm, "v": nv}
+        return self._fused_paramwise_builder(need_clip_flags,
+                                             decay_flags, kernel)
 
 
 class Adamax(Optimizer):

@@ -22,8 +22,20 @@ from .. import nn
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
            "FakeQuanterChannelWiseAbsMaxObserver", "AbsmaxObserver",
            "ChannelWiseAbsMaxObserver", "QuantedInferenceLinear",
-           "WeightOnlyLinear", "weight_only_quantize",
-           "quant_aware", "fake_quant"]
+           "WeightOnlyLinear", "WeightOnlyLMHead",
+           "weight_only_quantize", "quantize_lm_head",
+           "channel_absmax", "quant_aware", "fake_quant"]
+
+
+def channel_absmax(w, axis: int = 1):
+    """Per-channel absmax along ``axis`` — the ONE reduction the
+    channel-wise observers, the weight-only packers, and the
+    training-time quantized lm_head share (kernels/pallas_matmul.py
+    owns the primitive, so the scales agree bitwise everywhere).
+    Accepts a Tensor or array; returns a jnp f32 array."""
+    from ..kernels.pallas_matmul import channel_absmax as _ca
+    arr = w._data if isinstance(w, Tensor) else jnp.asarray(w)
+    return _ca(arr, axis)
 
 
 def _fake_quant_fn(x, scale, bits, axis=None):
@@ -165,8 +177,8 @@ class ChannelWiseAbsMaxObserver(nn.Layer):
                     "first.", RuntimeWarning, stacklevel=2)
                 return x
             self._make_buffers(int(x.shape[axis]))
-        red = tuple(i for i in range(x.ndim) if i != axis)
-        cur = jnp.max(jnp.abs(x._data), axis=red).astype(jnp.float32)
+        from ..kernels.pallas_matmul import channel_absmax as _ca
+        cur = _ca(x._data, axis)
         prev, seen = self._absmax._data, self._seen._data
         fb = getattr(self, "_frozen_buf", None)
         frozen = fb._data > 0 if fb is not None else jnp.asarray(False)
@@ -373,50 +385,139 @@ class WeightOnlyLinear(nn.Layer):
         self.register_buffer(
             "bias", None if bias is None else Tensor(jnp.asarray(bias)))
         self.qmax = float(2 ** (quant_bits - 1) - 1)
+        self.quant_bits = quant_bits
 
     def forward(self, x):
         from ..ops.dispatch import ensure_tensor
+        from ..kernels.pallas_matmul import int8_weight_only_matmul
         t = ensure_tensor(x)
+        quant_bits = getattr(self, "quant_bits", None)
+        if quant_bits is None:
+            # pre-r10 pickled instances carry only qmax; the bit width
+            # is exactly recoverable from qmax = 2**(bits-1) - 1 —
+            # assuming 8 would mis-scale any non-8-bit payload by
+            # qmax_true/127
+            import math
+            quant_bits = int(round(math.log2(self.qmax + 1))) + 1
 
         def fn(a):
-            w = self.weight_int8._data.astype(jnp.float32) \
-                * (self.w_scale._data / self.qmax)
-            out = jax.lax.dot_general(
-                a.astype(jnp.float32), w,
-                (((a.ndim - 1,), (0,)), ((), ())))
-            if self.bias is not None:
-                out = out + self.bias._data
+            # kernels/pallas_matmul dispatch: the Pallas weight-only
+            # kernel on TPU for aligned shapes (int8 tiles streamed —
+            # half the weight HBM bytes), the equivalent XLA dequant
+            # matmul elsewhere
+            out = int8_weight_only_matmul(
+                a, self.weight_int8._data, self.w_scale._data,
+                bias=None if self.bias is None else self.bias._data,
+                quant_bits=quant_bits)
             return out.astype(a.dtype)
 
         return apply_op("weight_only_linear", fn, (t,), {})
 
 
-def weight_only_quantize(model: nn.Layer, quant_bits: int = 8) -> nn.Layer:
+class WeightOnlyLMHead(nn.Layer):
+    """INT8 weight-only LM head: the ``[hidden, vocab]`` head read of a
+    GPT-style model, quantized per VOCAB channel. Shared-embedding
+    aware by construction: it stores its OWN int8 payload of
+    ``wte.weight.T`` (or the untied ``lm_head.weight``), so the
+    embedding lookup keeps the fp table while the logits matmul — the
+    biggest single projection in the model — streams int8. Installed by
+    :func:`quantize_lm_head`; ``GPTForCausalLM._head`` routes through
+    it when present."""
+
+    def __init__(self, weight_int8, w_scale, quant_bits: int = 8):
+        super().__init__()
+        self.register_buffer("weight_int8",
+                             Tensor(jnp.asarray(weight_int8, jnp.int8)))
+        self.register_buffer("w_scale",
+                             Tensor(jnp.asarray(w_scale, jnp.float32)))
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        from ..ops.dispatch import ensure_tensor
+        from ..kernels.pallas_matmul import int8_weight_only_matmul
+        t = ensure_tensor(x)
+
+        def fn(a):
+            out = int8_weight_only_matmul(
+                a, self.weight_int8._data, self.w_scale._data,
+                quant_bits=self.quant_bits)
+            return out.astype(a.dtype)
+
+        return apply_op("weight_only_lm_head", fn, (t,), {})
+
+
+def _pack_weight_only(w_arr, quant_bits: int):
+    """One observation of a static weight through the channel-wise
+    observer (the shared calibration path), frozen, then packed int8 +
+    f32 scales. Returns (w_int8, scale) numpy arrays."""
+    import numpy as np
+    out_ch = int(w_arr.shape[1])
+    obs = ChannelWiseAbsMaxObserver(quant_bits=quant_bits,
+                                    quant_axis=1, channels=out_ch)
+    obs(w_arr if isinstance(w_arr, Tensor) else Tensor(jnp.asarray(w_arr)))
+    obs.freeze()
+    scale = np.maximum(np.asarray(obs.scale(), np.float32), 1e-8)
+    qmax = 2 ** (quant_bits - 1) - 1
+    w = np.asarray(
+        w_arr.numpy() if isinstance(w_arr, Tensor) else w_arr,
+        np.float32)
+    w_int8 = np.clip(np.round(w / scale * qmax),
+                     -qmax, qmax).astype(np.int8)
+    return w_int8, scale
+
+
+def quantize_lm_head(model: nn.Layer, quant_bits: int = 8) -> nn.Layer:
+    """Quantize a causal-LM head to int8 weight-only, SHARED-EMBEDDING
+    aware: with tied embeddings the packed payload is ``wte.weight.T``
+    — the fp embedding table keeps serving the lookup — and with an
+    untied head it is ``lm_head.weight``. Installs a
+    :class:`WeightOnlyLMHead` sublayer the model's ``_head`` dispatch
+    prefers; serving (``weight_only_int8``) and the training-time
+    ``quantized_lm_head`` config share this one entry point (same
+    observer, same scales — the fake-quant training forward equals
+    this payload's dequantized product)."""
+    cfg = getattr(model, "cfg", None)
+    tied = bool(getattr(cfg, "tie_word_embeddings", False))
+    if tied:
+        w = model.gpt.wte.weight.T
+    elif hasattr(model, "lm_head"):
+        w = model.lm_head.weight
+    else:
+        raise ValueError(
+            "quantize_lm_head: model has neither tied embeddings nor "
+            "an lm_head Linear")
+    w_int8, scale = _pack_weight_only(w, quant_bits)
+    model.add_sublayer("_wo_head", WeightOnlyLMHead(
+        w_int8, scale, quant_bits=quant_bits))
+    return model
+
+
+def weight_only_quantize(model: nn.Layer, quant_bits: int = 8,
+                         include_lm_head: bool = False) -> nn.Layer:
     """Swap every ``nn.Linear`` under ``model`` (recursively, in place)
     for a :class:`WeightOnlyLinear`. Scales come from a frozen
     :class:`ChannelWiseAbsMaxObserver` pass over the weight (one
     observation — weights are static at serving time), per OUTPUT
     channel (axis 1 of the ``[in, out]`` Linear weight). Call it on the
     projection-bearing submodules only (e.g. each transformer block) to
-    keep embeddings and the tied LM head in floating point."""
-    import numpy as np
+    keep embeddings and the tied LM head in floating point — or pass
+    ``include_lm_head=True`` on a causal-LM root to ALSO quantize the
+    head through :func:`quantize_lm_head` (shared-embedding aware: the
+    embedding lookup stays fp)."""
+    if include_lm_head:
+        # pack the head FIRST (the untied lm_head Linear must be read
+        # as a head, not swept up by the generic swap below — _head
+        # prefers the installed payload either way)
+        quantize_lm_head(model, quant_bits=quant_bits)
     for name, child in list(model.named_children()):
+        if include_lm_head and name in ("lm_head", "_wo_head"):
+            continue
         if isinstance(child, nn.Linear):
-            out_ch = int(child.weight.shape[1])
-            obs = ChannelWiseAbsMaxObserver(quant_bits=quant_bits,
-                                            quant_axis=1, channels=out_ch)
-            obs(child.weight)
-            obs.freeze()
-            scale = np.maximum(np.asarray(obs.scale(), np.float32), 1e-8)
-            qmax = 2 ** (quant_bits - 1) - 1
-            w = np.asarray(child.weight.numpy(), np.float32)
-            w_int8 = np.clip(np.round(w / scale * qmax),
-                             -qmax, qmax).astype(np.int8)
-            bias = None if child.bias is None else \
-                np.asarray(child.bias.numpy())
+            w_int8, scale = _pack_weight_only(child.weight, quant_bits)
+            bias = None if child.bias is None else child.bias.numpy()
             model.add_sublayer(name, WeightOnlyLinear(
                 w_int8, scale, bias, quant_bits=quant_bits))
-        else:
+        elif not isinstance(child, (WeightOnlyLMHead,)):
             weight_only_quantize(child, quant_bits=quant_bits)
     return model
 

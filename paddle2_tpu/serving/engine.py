@@ -60,6 +60,11 @@ class EngineConfig:
     page_buckets: Optional[Tuple[int, ...]] = None
     prefill_budget_tokens: int = 512
     weight_only_int8: bool = False
+    # also quantize the lm_head / logits matmul (shared-embedding
+    # aware: the fp embedding table keeps serving the lookup) through
+    # quantization.quantize_lm_head — the same entry point the
+    # training-time quantized_lm_head config calibrates against
+    weight_only_lm_head: bool = False
     max_model_len: Optional[int] = None
     kv_dtype: str = "float32"
     interpret: Optional[bool] = None
@@ -98,9 +103,13 @@ class ServingEngine:
         if self.config.weight_only_int8:
             from ..quantization import weight_only_quantize
             # projection matmuls only: qkv/out_proj/up/down inside the
-            # blocks — embeddings and the (tied) head stay fp
+            # blocks — embeddings and the (tied) head stay fp unless
+            # weight_only_lm_head opts the logits matmul in below
             for block in model.gpt.h:
                 weight_only_quantize(block)
+        if self.config.weight_only_lm_head:
+            from ..quantization import quantize_lm_head
+            quantize_lm_head(model)
         self.cache = PagedKVCache(
             cfg.num_layers, self.config.num_blocks, self.config.block_size,
             cfg.num_heads, cfg.head_dim, dtype=self.config.kv_dtype)

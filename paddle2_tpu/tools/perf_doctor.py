@@ -240,6 +240,18 @@ def summarize(streams: Dict[int, Dict[str, Any]],
                      if "modeled_step_s" in x and "tokens" in x]
             if mtoks and sum(mod) > 0:
                 entry["modeled_tokens_per_s"] = sum(mtoks) / sum(mod)
+        # MFU / roofline lane: records stamped with the cost model's
+        # (modeled_flops, roofline_s, peak_flops) triple — modeled
+        # FLOPs over the roofline time, as a fraction of the chip
+        # peak. Deterministic (pure function of program + rate model),
+        # so the diff verdict below can gate on it without wall-clock
+        # noise.
+        mfus = [x["modeled_flops"] / (x["roofline_s"] * x["peak_flops"])
+                for x in steps
+                if x.get("modeled_flops") and x.get("roofline_s")
+                and x.get("peak_flops")]
+        if mfus:
+            entry["mfu_modeled"] = _mean(mfus)
         samp = [x["samples"] for x in steps if "samples" in x]
         if samp and entry["mean_total_s"] > 0:
             entry["samples_per_s"] = _mean(samp) / entry["mean_total_s"]
@@ -286,6 +298,11 @@ def summarize(streams: Dict[int, Dict[str, Any]],
                     if "modeled_tokens_per_s" in e]
             if mtps:
                 agg["modeled_tokens_per_s_total"] = sum(mtps)
+        # MFU lane aggregates only when EVERY rank carries it — one
+        # rank's cost model averaged against nothing is not a fleet MFU
+        mfu_vals = [e.get("mfu_modeled") for e in per.values()]
+        if mfu_vals and all(m is not None for m in mfu_vals):
+            agg["mfu_modeled"] = _mean(mfu_vals)
         if agg["mean_total_s"] > 0:
             agg["breakdown_pct"] = {
                 _COMPONENT_LABEL[c]: 100.0 * agg[f"mean_{c}"]
@@ -364,6 +381,26 @@ def diff(base: Dict[str, Any], new: Dict[str, Any],
             out["total_delta_pct"] = mdelta
             out["regressed"] = mdelta > threshold_pct
             out["verdict_source"] = "modeled"
+    # MFU / roofline delta: deterministic like the modeled step, so a
+    # drop IS a program-shape regression (a remat policy that stopped
+    # fitting, a fast path that fell back) — comparable only when both
+    # streams carry the lane, and then it FAILS the gate exactly like
+    # a modeled-step regression does
+    fa = a.get("mfu_modeled")
+    fb = b.get("mfu_modeled")
+    if fa is not None or fb is not None:
+        comparable = fa is not None and fb is not None
+        drop_pct = (100.0 * (fa - fb) / fa) if comparable and fa > 0 \
+            else None
+        out["mfu_modeled"] = {
+            "base": fa, "new": fb, "drop_pct": drop_pct,
+            "comparable": comparable,
+            "regressed": bool(drop_pct is not None
+                              and drop_pct > threshold_pct)}
+        if out["mfu_modeled"]["regressed"] and not out["regressed"]:
+            out["regressed"] = True
+            out["verdict_source"] = "mfu"
+            out["total_delta_pct"] = drop_pct
     # exposed-comm % delta: an overlap regression (a bucket that
     # stopped hiding under backward, a prefetch that went eager) shows
     # up HERE even when total step time moved for other reasons too.
@@ -423,6 +460,10 @@ def format_summary(report: Dict[str, Any], directory: str) -> str:
     if "exposed_comm_pct" in agg:
         L.append(f"  exposed-comm: {agg['exposed_comm_pct']:.1f}% of "
                  f"step (wire time NOT hidden under compute)")
+    if "mfu_modeled" in agg:
+        L.append(f"  MFU (modeled): {100.0 * agg['mfu_modeled']:.1f}% "
+                 f"of chip peak over the roofline step time "
+                 f"(deterministic cost model)")
     for r, e in sorted(report["per_rank"].items()):
         extra = ""
         if "tokens_per_s" in e:
@@ -430,6 +471,8 @@ def format_summary(report: Dict[str, Any], directory: str) -> str:
         if "exposed_comm_pct" in e:
             extra += (f"  exposed-comm {e['exposed_comm_pct']:.1f}% "
                       f"[{e['exposed_comm_source']}]")
+        if "mfu_modeled" in e:
+            extra += f"  MFU {100.0 * e['mfu_modeled']:.1f}%"
         if e.get("warmup_included"):
             extra += "  [WARMUP INCLUDED: stream shorter than warmup]"
         L.append(f"  rank {r}: {e['steps']} steps, mean "
@@ -504,6 +547,15 @@ def format_diff(d: Dict[str, Any]) -> str:
                    f"{ec['new_source']}]")
         L.append(f"  exposed-comm: {ec['base']:.1f}% -> "
                  f"{ec['new']:.1f}% of step{tag}")
+    mf = d.get("mfu_modeled")
+    if mf:
+        if mf.get("comparable"):
+            tag = "  (MFU REGRESSION)" if mf["regressed"] else ""
+            L.append(f"  MFU (modeled): {100.0 * mf['base']:.1f}% -> "
+                     f"{100.0 * mf['new']:.1f}% of peak{tag}")
+        else:
+            L.append("  MFU (modeled): [incomparable: only one stream "
+                     "carries the roofline lane]")
     ms = d.get("modeled_step")
     if ms:
         if ms.get("comparable"):

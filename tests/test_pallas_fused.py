@@ -215,3 +215,63 @@ class TestPallasLayerNorm:
         assert out.shape == (2, 8, 128)
         assert not supported((16, 100))   # lane-unaligned H
         assert not supported((128,))      # 1-D
+
+
+def test_fused_adamw_step_eager_order_twin():
+    """fused_adamw_step (the ISSUE-10 STEP kernel, distinct from the
+    fuse-everything fused_adamw above) replicates the eager op ORDER:
+    bitwise vs a jitted twin, including the decoupled-decay subtract
+    against the pre-update param."""
+    rs = np.random.RandomState(0)
+    p = jnp.asarray(rs.randn(1000), jnp.float32)
+    g = jnp.asarray(rs.randn(1000), jnp.float32)
+    m = jnp.asarray(rs.rand(1000), jnp.float32)
+    v = jnp.asarray(rs.rand(1000), jnp.float32)
+    lr, step = jnp.float32(1e-3), jnp.int32(5)
+    b1, b2, eps, wd = 0.9, 0.999, 1e-8, 0.01
+
+    @jax.jit
+    def twin(p, g, m, v, lr, step):
+        t = step.astype(jnp.float32)
+        em = b1 * m + (1 - b1) * g
+        ev = b2 * v + (1 - b2) * jnp.square(g)
+        ep = p - lr * (em / (1 - b1 ** t)) / (
+            jnp.sqrt(ev / (1 - b2 ** t)) + eps)
+        return ep - lr * wd * p, em, ev
+    ref = [np.asarray(a).copy() for a in twin(p, g, m, v, lr, step)]
+    out = pf.fused_adamw_step(p, g, m, v, lr, step, beta1=b1, beta2=b2,
+                              eps=eps, weight_decay=wd)
+    for o, r in zip(out, ref):
+        np.testing.assert_array_equal(np.asarray(o), r)
+
+
+def test_fused_momentum_step_nesterov_twin():
+    rs = np.random.RandomState(1)
+    p = jnp.asarray(rs.randn(513), jnp.float32)   # forces padding
+    g = jnp.asarray(rs.randn(513), jnp.float32)
+    vel = jnp.asarray(rs.randn(513), jnp.float32)
+    lr = jnp.float32(1e-2)
+    mom, wd = 0.9, 0.01
+
+    @jax.jit
+    def twin(p, g, vel, lr):
+        g2 = g + wd * p
+        v = mom * vel + g2
+        return p - lr * (g2 + mom * v), v
+    ref = [np.asarray(a).copy() for a in twin(p, g, vel, lr)]
+    out = pf.fused_momentum_step(p, g, vel, lr, momentum=mom,
+                                 nesterov=True, weight_decay=wd)
+    for o, r in zip(out, ref):
+        np.testing.assert_array_equal(np.asarray(o), r)
+
+
+def test_fused_step_kernels_preserve_shape_and_dtype():
+    rs = np.random.RandomState(2)
+    p = jnp.asarray(rs.randn(7, 33), jnp.float32)   # 2-D, ragged
+    g = jnp.asarray(rs.randn(7, 33), jnp.float32)
+    m = jnp.zeros((7, 33), jnp.float32)
+    v = jnp.zeros((7, 33), jnp.float32)
+    np_, nm, nv = pf.fused_adamw_step(p, g, m, v, jnp.float32(1e-3),
+                                      jnp.int32(1))
+    assert np_.shape == (7, 33) and np_.dtype == jnp.float32
+    assert nm.shape == (7, 33) and nv.shape == (7, 33)

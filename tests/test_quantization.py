@@ -196,3 +196,143 @@ def test_weight_only_linear_parity_and_swap():
     # int8 payload + scales ride state_dict (jit.save carries them)
     sd = m.state_dict()
     assert any("weight_int8" in k for k in sd)
+
+
+def test_quantize_lm_head_tied_is_shared_embedding_aware():
+    """ISSUE 10 satellite: the lm_head projection joins the weight-only
+    entry point. Tied embeddings: the HEAD read is int8 while the
+    embedding table (and its lookup) stays fp."""
+    from paddle2_tpu.models import GPTForCausalLM
+    from paddle2_tpu.models.gpt import gpt_tiny
+    from paddle2_tpu.quantization import (WeightOnlyLMHead,
+                                          quantize_lm_head)
+    paddle.seed(5)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    rs = np.random.RandomState(5)
+    ids = paddle.to_tensor(rs.randint(0, 128, (2, 16)).astype(np.int32))
+    ref = np.asarray(m(ids).numpy(), np.float32)
+    wte_before = np.asarray(m.gpt.wte.weight.numpy()).copy()
+    quantize_lm_head(m)
+    assert isinstance(m._wo_head, WeightOnlyLMHead)
+    # embedding table untouched (fp lookup still serves wte)
+    np.testing.assert_array_equal(
+        np.asarray(m.gpt.wte.weight.numpy()), wte_before)
+    out = np.asarray(m(ids).numpy(), np.float32)
+    # weight-only error budget: per (row, vocab channel) analytic
+    # bound from the shared kernel helper
+    from paddle2_tpu.kernels.pallas_matmul import \
+        weight_quant_error_bound
+    import jax.numpy as jnp
+    hidden = np.asarray(m.gpt(ids).numpy(), np.float32)
+    bound = np.asarray(weight_quant_error_bound(
+        jnp.asarray(hidden.reshape(-1, hidden.shape[-1])),
+        m._wo_head.w_scale._data))
+    err = np.abs(out - ref).reshape(-1, out.shape[-1])
+    assert (err <= bound + 1e-4).all()
+    # payload rides state_dict (serving artifacts carry it)
+    assert any("_wo_head" in k and "weight_int8" in k
+               for k in m.state_dict())
+
+
+def test_quantize_lm_head_untied_uses_lm_head_weight():
+    from paddle2_tpu.models import GPTForCausalLM
+    from paddle2_tpu.models.gpt import gpt_tiny
+    from paddle2_tpu.quantization import quantize_lm_head
+    paddle.seed(6)
+    m = GPTForCausalLM(gpt_tiny(tie_word_embeddings=False))
+    m.eval()
+    rs = np.random.RandomState(6)
+    ids = paddle.to_tensor(rs.randint(0, 128, (2, 8)).astype(np.int32))
+    ref = np.asarray(m(ids).numpy(), np.float32)
+    quantize_lm_head(m)
+    assert tuple(m._wo_head.weight_int8.shape) == \
+        tuple(m.lm_head.weight.shape)
+    out = np.asarray(m(ids).numpy(), np.float32)
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert rel < 0.05, rel
+
+
+def test_weight_only_quantize_include_lm_head_one_entry_point():
+    """weight_only_quantize(include_lm_head=True) covers blocks AND
+    head; the untied lm_head Linear is routed through the head packer
+    rather than the generic swap."""
+    from paddle2_tpu.models import GPTForCausalLM
+    from paddle2_tpu.models.gpt import gpt_tiny
+    from paddle2_tpu.quantization import (WeightOnlyLinear,
+                                          WeightOnlyLMHead,
+                                          weight_only_quantize)
+    paddle.seed(7)
+    m = GPTForCausalLM(gpt_tiny(tie_word_embeddings=False))
+    m.eval()
+    weight_only_quantize(m, include_lm_head=True)
+    assert isinstance(m._wo_head, WeightOnlyLMHead)
+    assert not isinstance(m.lm_head, WeightOnlyLinear)
+    swapped = [l for _, l in m.named_sublayers()
+               if isinstance(l, WeightOnlyLinear)]
+    assert len(swapped) > 0      # the block projections
+
+
+def test_training_time_quantized_lm_head_matches_serving_payload():
+    """The opt-in training path (GPTConfig.quantized_lm_head fake
+    quant with STE) must produce the SAME logits as the serving int8
+    payload built by quantize_lm_head — one calibration, two
+    consumers."""
+    from paddle2_tpu.models import GPTForCausalLM
+    from paddle2_tpu.models.gpt import gpt_tiny
+    from paddle2_tpu.quantization import quantize_lm_head
+    rs = np.random.RandomState(8)
+    ids = paddle.to_tensor(rs.randint(0, 128, (2, 8)).astype(np.int32))
+    paddle.seed(8)
+    m_train = GPTForCausalLM(gpt_tiny(quantized_lm_head=True))
+    m_train.eval()
+    out_train = np.asarray(m_train(ids).numpy(), np.float32)
+    paddle.seed(8)
+    m_serve = GPTForCausalLM(gpt_tiny())
+    m_serve.eval()
+    quantize_lm_head(m_serve)
+    out_serve = np.asarray(m_serve(ids).numpy(), np.float32)
+    np.testing.assert_allclose(out_train, out_serve,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_lm_head_trains_with_ste_gradients():
+    """Gradients flow through the fake-quant head to the tied
+    embedding: a train step moves wte."""
+    import paddle2_tpu.optimizer as opt
+    from paddle2_tpu.models import GPTForCausalLM
+    from paddle2_tpu.models.gpt import gpt_tiny
+    paddle.seed(9)
+    m = GPTForCausalLM(gpt_tiny(quantized_lm_head=True))
+    o = opt.AdamW(learning_rate=1e-2, parameters=m.parameters())
+    step = paddle.jit.train_step(
+        lambda ids, lab: m(ids, labels=lab)[1], o, layers=[m])
+    rs = np.random.RandomState(9)
+    w0 = np.asarray(m.gpt.wte.weight.numpy()).copy()
+    ids = paddle.to_tensor(rs.randint(0, 128, (2, 8)).astype(np.int32))
+    loss = step(ids, ids)
+    assert np.isfinite(float(np.asarray(loss._data)))
+    assert not np.array_equal(np.asarray(m.gpt.wte.weight.numpy()), w0)
+
+
+def test_quantized_lm_head_excludes_fused_head_loss():
+    from paddle2_tpu.models import GPTForCausalLM
+    from paddle2_tpu.models.gpt import gpt_tiny
+    with pytest.raises(ValueError):
+        GPTForCausalLM(gpt_tiny(quantized_lm_head=True,
+                                fused_head_loss=True))
+
+
+def test_serving_engine_weight_only_lm_head_opt_in():
+    """EngineConfig.weight_only_lm_head routes decode logits through
+    the shared head payload."""
+    from paddle2_tpu.models import GPTForCausalLM
+    from paddle2_tpu.models.gpt import gpt_tiny
+    from paddle2_tpu.quantization import WeightOnlyLMHead
+    from paddle2_tpu.serving import EngineConfig, ServingEngine
+    paddle.seed(10)
+    m = GPTForCausalLM(gpt_tiny(use_scan=False, stacked_blocks=False))
+    eng = ServingEngine(model=m, config=EngineConfig(
+        num_blocks=16, block_size=8, max_batch=2,
+        weight_only_int8=True, weight_only_lm_head=True))
+    assert isinstance(eng.model._wo_head, WeightOnlyLMHead)
