@@ -20,7 +20,9 @@ the loop with RECOVERY across four layers:
    by the elastic store IO and launch-master polling.
 4. **Chaos harness** — :mod:`.chaos`, a deterministic flag-controlled
    fault injector (``FLAGS_chaos``) the test suite and
-   ``bench.py --inject-fault`` drive end-to-end.
+   ``bench.py --inject-fault`` drive end-to-end. PR 11 extends it to
+   the serving plane (``kill_engine``, ``drop_decode_step``,
+   ``corrupt_block_table``) for the ``--serving-reliability`` drills.
 5. **Self-healing input pipeline** — the shm DataLoader respawns
    crashed workers (bounded budget, in-flight batches resubmitted) and
    escalates with :class:`WorkerCrashError` (a

@@ -19,6 +19,20 @@ Spec grammar (flag ``FLAGS_chaos`` or :func:`arm`)::
     stall_collective:1:30  hold the 1st deadline-watched collective 30 s
     kill_rank:4:1          SIGKILL rank 1's process at its 4th step
                            (node-loss simulation: no dump, no cleanup)
+    kill_engine:3:1        fail serving engine 1 at ITS 3rd decode
+                           step (param selects the victim engine id,
+                           default 0) — in-flight sequences must be
+                           recovered from their host token logs
+    drop_decode_step:2     the 2nd decode step's tokens are computed
+                           then DISCARDED (a transient step failure);
+                           the engine retries by recomputing the same
+                           positions next step — token-for-token
+                           identical, one step's cost wasted
+    corrupt_block_table:4:1  at the 4th decode round, scribble an
+                           out-of-range id into the table of active
+                           sequence index 1 (param, default 0) — the
+                           engine's table validator must catch it and
+                           rebuild the sequence by re-prefill
     flip_bits:WHERE:N      flip N mantissa bits at WHERE ('grads': in
                            the victim's gradients as the optimizer
                            reads them; 'collective': in the tensor the
@@ -50,7 +64,8 @@ from ...flags import define_flag, flag_value
 # consumer (worker_crash), and GradScaler's unscale path (poison_grads)
 KINDS = ("corrupt_shard", "truncate_shard", "fail_commit", "poison_loss",
          "delay_collective", "worker_crash", "poison_grads",
-         "stall_collective", "kill_rank", "flip_bits")
+         "stall_collective", "kill_rank", "flip_bits",
+         "kill_engine", "drop_decode_step", "corrupt_block_table")
 
 _FLIP_WHERES = ("grads", "collective")
 
@@ -456,6 +471,75 @@ def apply_compiled_grad_fault(spec, grad_arrays):
     return out
 
 
+# ------------------------------------------------------- serving faults
+def maybe_kill_engine(engine_id: int, step: int = -1) -> bool:
+    """Serving-engine step hook (``ServingEngine.decode_once``): True
+    when THIS engine must die now. The occurrence counter ticks only on
+    the victim engine (the ``kill_rank`` idiom), so ``nth`` means "the
+    victim's nth decode step" regardless of what the rest of the fleet
+    is doing. The engine marks itself failed and raises
+    ``EngineFailedError`` — the failover router recovers its in-flight
+    sequences from their host token logs."""
+    if _ACTIVE is None:
+        return False
+    tgt = _ACTIVE.targets.get("kill_engine")
+    if tgt is None:
+        return False
+    victim = 0 if tgt[1] is None else int(tgt[1])
+    if int(engine_id) != victim:
+        return False
+    if _ACTIVE.should_fire("kill_engine"):
+        _ACTIVE.record("kill_engine", f"engine{victim}:step{step}")
+        return True
+    return False
+
+
+def maybe_drop_decode_step(engine_id: int = 0) -> bool:
+    """Serving-engine step hook: True when this decode step's freshly
+    computed tokens must be DISCARDED — a transient step failure (a
+    dropped readback, a preempted device). Because the engine only
+    advances sequence state AFTER a successful step, the retry is
+    implicit: the next step recomputes the same positions (same
+    inputs, same weights — same tokens, and the KV rewrite is
+    idempotent), costing one extra step of modeled time."""
+    if _ACTIVE is None:
+        return False
+    if "drop_decode_step" not in _ACTIVE.targets:
+        return False
+    if _ACTIVE.should_fire("drop_decode_step"):
+        _ACTIVE.record("drop_decode_step", f"engine{engine_id}")
+        return True
+    return False
+
+
+# deterministic far-out-of-range id the table validator must reject
+CORRUPT_BLOCK_ID = 1_000_003
+
+
+def maybe_corrupt_block_table(block_lists) -> Optional[int]:
+    """Serving-engine step hook: scribble :data:`CORRUPT_BLOCK_ID`
+    into the middle of one active sequence's block-id list (param
+    selects which active index, default 0; wraps). Mutates in place and
+    returns the corrupted index, or None. Ticks only when there is a
+    table to corrupt, so the one-shot fire is never consumed by an
+    empty round."""
+    if _ACTIVE is None or not block_lists:
+        return None
+    tgt = _ACTIVE.targets.get("corrupt_block_table")
+    if tgt is None:
+        return None
+    if not _ACTIVE.should_fire("corrupt_block_table"):
+        return None
+    pos = (0 if tgt[1] is None else int(tgt[1])) % len(block_lists)
+    blocks = block_lists[pos]
+    if blocks:
+        blocks[len(blocks) // 2] = CORRUPT_BLOCK_ID
+    else:
+        blocks.append(CORRUPT_BLOCK_ID)
+    _ACTIVE.record("corrupt_block_table", f"seq_pos{pos}")
+    return pos
+
+
 def maybe_poison_grads(optimizer) -> None:
     """GradScaler unscale hook: overwrite every gradient with NaN, the
     deterministic stand-in for an fp16 overflow — drives the skip-step
@@ -480,4 +564,6 @@ __all__ = ["ChaosInjector", "arm", "disarm", "active", "fired_log",
            "maybe_crash_worker", "maybe_poison_grads", "maybe_kill_rank",
            "flip_mantissa_bits", "maybe_flip_bits_grads",
            "maybe_flip_bits_array", "compiled_grad_fault",
-           "apply_compiled_grad_fault", "KINDS"]
+           "apply_compiled_grad_fault", "maybe_kill_engine",
+           "maybe_drop_decode_step", "maybe_corrupt_block_table",
+           "CORRUPT_BLOCK_ID", "KINDS"]

@@ -20,28 +20,46 @@ Entry points:
   admit/evict per decode step with bucketed batch shapes.
 * :func:`simulate` (``simulate.py``) — the cost x rate
   discrete-event driver ``bench.py --serving`` gates on.
+* :mod:`reliability` (PR 11) — the serving robustness plane: typed
+  failure semantics (:class:`RequestRejected` /
+  :class:`DeadlineExceeded` / :class:`EngineFailedError`), bounded
+  admission with priority shedding (:class:`ReliabilityConfig`),
+  engine-failure recovery from host token logs, the
+  :class:`EngineFailoverRouter`, and zero-drop weight hot-swap
+  (:class:`HotSwapController`) — gated by
+  ``bench.py --serving-reliability``.
 """
 
 from .block_cache import (BlockAllocator, BlockTable, PagedKVCache,
                           blocks_for_tokens, GARBAGE_BLOCK)
-from .block_cache import OutOfBlocksError
+from .block_cache import OutOfBlocksError, BlockFreeError
 from .paged_attention import (paged_attention_decode,
                               paged_attention_reference,
                               gathered_dense_kv)
+from .reliability import (ServingError, RequestRejected, QueueFullError,
+                          PromptTooLongError, DeadlineExceeded,
+                          EngineFailedError, WeightSwapError,
+                          ReliabilityConfig, HotSwapController)
 from .scheduler import (Request, Sequence, SeqState,
                         ContinuousBatchingScheduler, SchedulerConfig)
 from .engine import ServingEngine, EngineConfig
 from .simulate import (ServingSimReport, simulate_serving,
-                       simulate_predictor_baseline, poisson_trace)
+                       simulate_predictor_baseline, poisson_trace,
+                       EngineFailoverRouter, RouterSimReport,
+                       simulate_router)
 
 __all__ = [
     "BlockAllocator", "BlockTable", "PagedKVCache", "blocks_for_tokens",
-    "GARBAGE_BLOCK", "OutOfBlocksError",
+    "GARBAGE_BLOCK", "OutOfBlocksError", "BlockFreeError",
     "paged_attention_decode", "paged_attention_reference",
     "gathered_dense_kv",
+    "ServingError", "RequestRejected", "QueueFullError",
+    "PromptTooLongError", "DeadlineExceeded", "EngineFailedError",
+    "WeightSwapError", "ReliabilityConfig", "HotSwapController",
     "Request", "Sequence", "SeqState", "ContinuousBatchingScheduler",
     "SchedulerConfig",
     "ServingEngine", "EngineConfig",
     "ServingSimReport", "simulate_serving", "simulate_predictor_baseline",
     "poisson_trace",
+    "EngineFailoverRouter", "RouterSimReport", "simulate_router",
 ]

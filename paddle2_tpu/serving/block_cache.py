@@ -34,7 +34,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["BlockAllocator", "BlockTable", "PagedKVCache",
-           "blocks_for_tokens", "GARBAGE_BLOCK"]
+           "blocks_for_tokens", "GARBAGE_BLOCK", "BlockFreeError"]
 
 # physical block id every padded/inactive batch row writes into
 GARBAGE_BLOCK = 0
@@ -50,6 +50,15 @@ def blocks_for_tokens(n_tokens: int, block_size: int) -> int:
 
 class OutOfBlocksError(RuntimeError):
     """Free list exhausted — the scheduler turns this into an eviction."""
+
+
+class BlockFreeError(ValueError):
+    """A ``free()`` that would corrupt the free list: double-free,
+    free of the reserved garbage block 0, an out-of-range id, or a
+    duplicate WITHIN the freed list itself. The allocator validates
+    the whole list before mutating anything, so a raised free leaves
+    the free list exactly as it was. (``ValueError`` base keeps
+    pre-typed ``except ValueError`` callers working.)"""
 
 
 class BlockAllocator:
@@ -92,12 +101,49 @@ class BlockAllocator:
         return out
 
     def free(self, blocks: List[int]) -> None:
+        """Return blocks to the free list. Every id is validated
+        BEFORE any mutation: out-of-range, the reserved garbage block
+        (:data:`GARBAGE_BLOCK`), already-free ids, and duplicates
+        inside ``blocks`` itself all raise :class:`BlockFreeError`
+        instead of silently corrupting the LIFO free list (a corrupt
+        list hands the same block to two sequences — cross-request KV
+        bleed, the worst silent failure a serving engine can have)."""
+        free_now = set(self._free)
+        seen = set()
         for b in blocks:
+            if b == GARBAGE_BLOCK:
+                raise BlockFreeError(
+                    f"free of reserved garbage block {GARBAGE_BLOCK}")
             if not (0 < b < self.num_blocks):
-                raise ValueError(f"bad block id {b}")
-            if b in self._free:
-                raise ValueError(f"double free of block {b}")
+                raise BlockFreeError(f"bad block id {b} (usable range "
+                                     f"1..{self.num_blocks - 1})")
+            if b in free_now:
+                raise BlockFreeError(f"double free of block {b}")
+            if b in seen:
+                raise BlockFreeError(
+                    f"block {b} appears twice in one free() call")
+            seen.add(b)
         self._free.extend(blocks)
+
+    def rebuild_free_list(self, live_block_lists) -> None:
+        """Recovery path: recompute the free list as everything NOT
+        owned by the given live tables — used after a block-table
+        corruption, when one table's ids can no longer be trusted
+        enough to ``free()`` them (a corrupt id could double-free a
+        live block). Ground truth is the surviving tables; the
+        corrupted sequence's blocks implicitly return to the pool."""
+        used = set()
+        for blocks in live_block_lists:
+            used.update(int(b) for b in blocks)
+        used.discard(GARBAGE_BLOCK)
+        bad = [b for b in used if not (0 < b < self.num_blocks)]
+        if bad:
+            raise BlockFreeError(
+                f"rebuild_free_list given out-of-range ids {bad} — "
+                f"survivors must be validated tables")
+        self._free = [b for b in range(self.num_blocks - 1, 0, -1)
+                      if b not in used]
+        self.high_water = max(self.high_water, len(used))
 
 
 class BlockTable:

@@ -33,6 +33,9 @@ import numpy as np
 from .block_cache import (BlockAllocator, PagedKVCache, blocks_for_tokens,
                           GARBAGE_BLOCK)
 from .model_runner import PagedGPTRunner
+from .reliability import (EngineFailedError, PromptTooLongError,
+                          ReliabilityConfig, RequestRejected,
+                          flight_record as _flight_record)
 from .scheduler import (ContinuousBatchingScheduler, Request, SchedulerConfig,
                         Sequence, SeqState)
 
@@ -68,6 +71,9 @@ class EngineConfig:
     max_model_len: Optional[int] = None
     kv_dtype: str = "float32"
     interpret: Optional[bool] = None
+    # admission control / load shedding (None = unbounded PR 9
+    # behavior); see serving.reliability.ReliabilityConfig
+    reliability: Optional[ReliabilityConfig] = None
 
 
 class ServingEngine:
@@ -123,7 +129,8 @@ class ServingEngine:
                            or _pow2_ladder(1, self.config.max_batch)),
             page_buckets=(self.config.page_buckets
                           or _pow2_ladder(1, max_pages)),
-            prefill_budget_tokens=self.config.prefill_budget_tokens)
+            prefill_budget_tokens=self.config.prefill_budget_tokens,
+            reliability=self.config.reliability)
         self.scheduler = ContinuousBatchingScheduler(sched_cfg,
                                                      self.allocator)
         self.runner = PagedGPTRunner(model, cfg.num_heads, cfg.head_dim,
@@ -131,6 +138,13 @@ class ServingEngine:
         self._next_req_id = 0
         self._seqs: Dict[int, Sequence] = {}
         self.decode_steps = 0
+        # failure plane: set by fail() (chaos kill_engine, an operator
+        # kill, a poisoned device) — a failed engine refuses all work
+        # and its in-flight sequences are harvested for failover
+        self.engine_id = 0
+        self.failed = False
+        self.fail_reason: Optional[str] = None
+        self.failed_t: Optional[float] = None
 
     # -- construction helpers --------------------------------------------
     @staticmethod
@@ -154,28 +168,151 @@ class ServingEngine:
 
     # -- request intake --------------------------------------------------
     def submit(self, prompt: Seq[int], max_new_tokens: int,
-               arrival_t: float = 0.0) -> int:
+               arrival_t: float = 0.0, priority: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> int:
+        """Submit one request. Typed rejections at submit time:
+        :class:`~.reliability.PromptTooLongError` when the request can
+        never fit the model's context,
+        :class:`~.reliability.QueueFullError` when the bounded
+        admission queue is full and the overload policy finds nothing
+        lower-priority to shed. ``priority`` (higher = more important)
+        and ``deadline_s`` (relative to ``arrival_t``) default from
+        the engine's :class:`~.reliability.ReliabilityConfig`."""
+        self._check_alive()
         prompt = [int(t) for t in prompt]
         if not prompt:
-            raise ValueError("empty prompt")
+            raise RequestRejected("empty prompt")
         if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1 (prefill "
-                             "always produces the first token)")
+            raise RequestRejected(
+                "max_new_tokens must be >= 1 (prefill always produces "
+                "the first token)")
         if len(prompt) + max_new_tokens > self.max_model_len:
-            raise ValueError(
+            # typed + at submit time: letting this through would only
+            # surface later as a block-coverage stall or a clamped
+            # position — far less legible than refusing the request
+            raise PromptTooLongError(
                 f"prompt({len(prompt)}) + max_new({max_new_tokens}) "
                 f"exceeds max_model_len {self.max_model_len}")
+        rel = self.scheduler.reliability
         rid = self._next_req_id
         self._next_req_id += 1
-        req = Request(rid, prompt, int(max_new_tokens), arrival_t)
+        req = Request(rid, prompt, int(max_new_tokens), arrival_t,
+                      priority=(rel.default_priority if priority is None
+                                else int(priority)),
+                      deadline_t=rel.deadline_for(arrival_t, deadline_s))
         seq = Sequence(req, self.allocator)
+        self.scheduler.submit(seq)     # may shed, may raise QueueFull
         self._seqs[rid] = seq
-        self.scheduler.submit(seq)
         self._gauge()
         return rid
 
     def sequence(self, req_id: int) -> Sequence:
         return self._seqs[req_id]
+
+    # -- failure plane ---------------------------------------------------
+    def _check_alive(self) -> None:
+        if self.failed:
+            raise EngineFailedError(
+                f"engine {self.engine_id} failed: {self.fail_reason}")
+
+    def fail(self, reason: str, now: float = 0.0) -> None:
+        """Mark this engine dead (idempotent). Device state — pools,
+        compiled programs — is considered lost; host state (token
+        logs, the scheduler ledger) survives for
+        :meth:`recover_inflight`."""
+        from ..observability import metrics
+        if self.failed:
+            return
+        self.failed = True
+        self.fail_reason = reason
+        self.failed_t = now
+        metrics.inc("serving_engine_failures_total")
+        _flight_record(event="engine_failed", engine=self.engine_id,
+                       reason=reason, t=now)
+
+    def recover_inflight(self) -> List[Sequence]:
+        """Harvest every unfinished sequence of a FAILED engine for
+        adoption elsewhere: running first (admission order — oldest
+        work resumes first), then the waiting queue in order. Tables
+        are dead with the engine; each sequence's accepted tokens live
+        in its host-side token log, and re-prefilling that log
+        reproduces the lost KV exactly (the eviction-exactness
+        guarantee), so the continuation is token-for-token identical
+        to a fault-free run."""
+        if not self.failed:
+            raise EngineFailedError(
+                "recover_inflight is only valid on a failed engine "
+                "(a healthy engine's sequences are still being served)")
+        running = list(self.scheduler._running)
+        waiting = [s for s in self.scheduler.waiting
+                   if s.state is SeqState.WAITING]
+        self.scheduler._running = []
+        self.scheduler.waiting = []
+        for s in running:
+            # only ever-ADMITTED work counts as a recovery: the
+            # recoveries counter feeds _in_flight(), which exempts a
+            # sequence from shedding/deadlines on the adopter — a
+            # never-admitted waiting request must keep fresh-arrival
+            # admission semantics there (its deadline still applies)
+            s.state = SeqState.WAITING
+            s.recoveries += 1
+        return running + waiting
+
+    def adopt(self, seq: Sequence) -> int:
+        """Adopt a sequence recovered from a dead engine: re-key it
+        into this engine's request map and bind a fresh table on this
+        engine's allocator. Ever-ADMITTED work (tokens accepted)
+        requeues at the FRONT, exempt from the admission bound —
+        in-flight is honored. A never-admitted fresh arrival keeps
+        fresh-arrival semantics: it goes through the normal bounded
+        ``submit`` path, so the adopter's queue depth and shed policy
+        still govern it (a refusal marks it SHED with the typed
+        error, never silently over-fills the queue)."""
+        from ..observability import metrics
+        from .reliability import QueueFullError
+        self._check_alive()
+        rid = self._next_req_id
+        self._next_req_id += 1
+        seq.request.req_id = rid
+        seq.rebind(self.allocator)
+        seq.ready_at = 0.0
+        self._seqs[rid] = seq
+        if self.scheduler._in_flight(seq):
+            self.scheduler.requeue_front(seq)
+        else:
+            try:
+                self.scheduler.submit(seq)
+            except QueueFullError as e:
+                self.scheduler.mark_shed(seq, e)
+        if seq.state is not SeqState.SHED:
+            # an adoption the bounded queue refused is a shed (counted
+            # by mark_shed), not a recovery
+            metrics.inc("serving_recovered_seqs_total")
+        _flight_record(event="adopt", engine=self.engine_id, req=rid,
+                       tokens=len(seq.tokens),
+                       shed=seq.state is SeqState.SHED)
+        self._gauge()
+        return rid
+
+    # -- weight hot-swap -------------------------------------------------
+    def swap_weights(self, weights, now: float = 0.0) -> List:
+        """Swap new checkpoint weights into the running engine between
+        decode steps. ``weights`` is a model (``GPTForCausalLM``) or a
+        flat array list matching the runner state. Weights-as-args
+        means the compiled programs are untouched — the swap can never
+        grow the decode program census. Returns the previous weight
+        arrays (the rollback payload)."""
+        from ..observability import metrics
+        self._check_alive()
+        arrays = weights
+        if hasattr(weights, "state_dict"):       # a live model
+            from ..jit.functional import _collect_state
+            params, buffers = _collect_state([weights])
+            arrays = [t._data for t in params + buffers]
+        prev = self.runner.swap_weights(arrays)
+        metrics.inc("serving_hot_swaps_total")
+        _flight_record(event="hot_swap", engine=self.engine_id, t=now)
+        return prev
 
     # -- admission + prefill ---------------------------------------------
     def admit_and_prefill(self, now: float = 0.0,
@@ -190,8 +327,9 @@ class ServingEngine:
         sets it to the prefill LANE's completion time, which is the
         whole point of disaggregation: decode never waits on it."""
         from ..observability import metrics
+        self._check_alive()
         out = []
-        for seq in self.scheduler.admit():
+        for seq in self.scheduler.admit(now):
             n = len(seq.tokens)
             tok, k_stack, v_stack = self.runner.prefill(seq.tokens)
             row = np.asarray(seq.table.blocks, np.int64)
@@ -222,14 +360,73 @@ class ServingEngine:
         self._gauge()
         return out
 
+    # -- block-table integrity --------------------------------------------
+    def _validate_tables(self, active: List[Sequence]) -> List[Sequence]:
+        """Integrity-check every RUNNING sequence's block table before
+        the decode step consumes it: ids in the usable range, no block
+        owned by two sequences, coverage for the cached tokens. A
+        violator (chaos ``corrupt_block_table``, a real scribble) is
+        requeued for re-prefill from its token log and the allocator's
+        free list is rebuilt from the SURVIVING tables — the corrupt
+        ids cannot be trusted enough to free() (double-free risk).
+        Returns the still-running subset of ``active``."""
+        from ..observability import metrics
+        owner: Dict[int, Sequence] = {}
+        bad: List[Sequence] = []
+        for s in self.scheduler.running():
+            ok = len(s.table.blocks) >= blocks_for_tokens(
+                max(s.table.num_tokens, 1), self.config.block_size)
+            for b in s.table.blocks:
+                if not (0 < b < self.config.num_blocks):
+                    ok = False
+                    break
+                prev = owner.get(b)
+                if prev is not None:
+                    # every live block is owned exactly once GLOBALLY,
+                    # so any repeat — within one table or across two —
+                    # aliases two token pages onto one block (silently
+                    # wrong KV). A cross-sequence dup cannot say WHICH
+                    # table was scribbled, so both claimants are
+                    # rebuilt — re-prefill is exact either way.
+                    ok = False
+                    if prev is not s and prev not in bad:
+                        bad.append(prev)
+                    break
+                owner[b] = s
+            if not ok:
+                bad.append(s)
+        if not bad:
+            return active
+        for s in bad:
+            metrics.inc("serving_table_corruptions_total")
+            _flight_record(event="table_corrupt", engine=self.engine_id,
+                           req=s.req_id, blocks=list(s.table.blocks))
+            self.scheduler.requeue_corrupt(s)
+        self.allocator.rebuild_free_list(
+            [s.table.blocks for s in self.scheduler.running()])
+        return [s for s in active if s.state is SeqState.RUNNING]
+
     # -- one decode step -------------------------------------------------
     def decode_once(self, now: float = 0.0) -> Optional[dict]:
         """Run ONE compiled decode step over every running sequence
         whose prefill has completed (``ready_at <= now``). Returns a
-        step info dict, or None when nothing is ready."""
+        step info dict, or None when nothing is ready. Raises
+        :class:`~.reliability.EngineFailedError` when the engine is
+        (or chaos makes it) dead."""
+        from ..distributed.fault_tolerance import chaos
         from ..observability import metrics
+        self._check_alive()
         active = [s for s in self.scheduler.running()
                   if getattr(s, "ready_at", 0.0) <= now]
+        if not active:
+            return None
+        # chaos scribbles land BEFORE validation — the validator must
+        # catch them like any organic corruption (the active() guard
+        # keeps the disarmed path free of the list allocation)
+        if chaos.active() is not None:
+            chaos.maybe_corrupt_block_table(
+                [s.table.blocks for s in active])
+        active = self._validate_tables(active)
         if not active:
             return None
         victims = self.scheduler.reserve_decode_slots(active)
@@ -241,6 +438,11 @@ class ServingEngine:
         active = [s for s in active if s.state is SeqState.RUNNING]
         if not active:
             return None
+        if chaos.maybe_kill_engine(self.engine_id, self.decode_steps + 1):
+            self.fail("chaos:kill_engine", now=now)
+            raise EngineFailedError(
+                f"engine {self.engine_id} killed by chaos at decode "
+                f"step {self.decode_steps + 1}")
         cfg = self.scheduler.config
         b_bucket, p_bucket = self.scheduler.decode_bucket(active)
         ids = np.zeros((b_bucket, 1), np.int32)
@@ -253,6 +455,20 @@ class ServingEngine:
         with metrics.phase("compute"):
             toks = self.runner.decode(self.cache, ids, positions, tables)
         cost = self.runner.decode_cost((b_bucket, p_bucket))
+        if chaos.maybe_drop_decode_step(self.engine_id):
+            # transient step failure: the tokens are discarded and NO
+            # sequence state advances, so the next step recomputes the
+            # same positions (same inputs -> same tokens; the KV
+            # rewrite is idempotent) — retry costs one modeled step
+            metrics.inc("serving_retries_total")
+            _flight_record(event="decode_step_dropped",
+                           engine=self.engine_id,
+                           step=self.decode_steps + 1)
+            self.decode_steps += 1
+            return {"bucket": (b_bucket, p_bucket),
+                    "n_active": len(active), "tokens": 0,
+                    "evictions": len(victims), "dropped": True,
+                    "cost": cost}
         modeled_s = None
         if cost and "flops" in cost:
             from ..observability.cost_model import StepCost
@@ -272,6 +488,9 @@ class ServingEngine:
         info = {"bucket": (b_bucket, p_bucket), "n_active": len(active),
                 "tokens": len(active), "evictions": len(victims),
                 "cost": cost}
+        _flight_record(event="decode_step", engine=self.engine_id,
+                       step=self.decode_steps, batch=len(active),
+                       bucket=[b_bucket, p_bucket])
         metrics.inc("serving_decode_tokens_total", len(active))
         self._gauge()
         extra = {"serving": 1,

@@ -54,6 +54,10 @@ class PagedGPTRunner:
         self.interpret = interpret
         params, buffers = _collect_state([model])
         self._state = params + buffers
+        # hot-swap overlay: when set, these arrays (NOT the live model
+        # tensors) ride as the programs' weight arguments — per-runner,
+        # so engines sharing one model object swap independently
+        self._swap_arrays: Optional[List] = None
         self._decode_programs: Dict[Tuple[int, int], object] = {}
         self._prefill_programs: Dict[int, object] = {}
         self._decode_costs: Dict[Tuple[int, int], Optional[dict]] = {}
@@ -61,7 +65,42 @@ class PagedGPTRunner:
 
     # -- state plumbing --------------------------------------------------
     def _weights(self) -> List:
+        if self._swap_arrays is not None:
+            return list(self._swap_arrays)
         return [t._data for t in self._state]
+
+    def swap_weights(self, arrays) -> List:
+        """Live weight hot-swap: replace the arrays every compiled
+        program receives as its weight ARGUMENTS. Because weights ride
+        as arguments (the ``TracedProgram`` pattern), a swap between
+        decode steps is just different operands to the SAME compiled
+        programs — no recompile, so the decode program census cannot
+        grow (the zero-extra-programs half of the hot-swap gate).
+
+        ``arrays`` must match the model state leaf-for-leaf (length,
+        shape, dtype); any mismatch raises
+        :class:`~.reliability.WeightSwapError` BEFORE anything is
+        applied — a swap is atomic. Returns the previous weight list
+        (the rollback payload)."""
+        import jax.numpy as jnp
+        from .reliability import WeightSwapError
+        arrays = list(arrays)
+        if len(arrays) != len(self._state):
+            raise WeightSwapError(
+                f"swap payload has {len(arrays)} leaves, model has "
+                f"{len(self._state)}")
+        staged = []
+        for t, a in zip(self._state, arrays):
+            a = jnp.asarray(a)
+            if tuple(a.shape) != tuple(t._data.shape) \
+                    or a.dtype != t._data.dtype:
+                raise WeightSwapError(
+                    f"swap leaf mismatch: got {a.shape}/{a.dtype}, "
+                    f"model has {tuple(t._data.shape)}/{t._data.dtype}")
+            staged.append(a)
+        prev = self._weights()
+        self._swap_arrays = staged
+        return prev
 
     def _swapped(self, weight_arrays):
         """Context manager: point every model param/buffer at the

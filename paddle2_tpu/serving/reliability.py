@@ -1,0 +1,218 @@
+"""Serving reliability plane: typed failure semantics, admission
+control, and zero-drop weight hot-swap.
+
+PRs 1-6 gave *training* an industrial fault-tolerance discipline
+(retry, chaos drills, elastic recovery, SDC defense); this module
+gives the PR 9 serving stack the same plane. Three concerns live
+here, all host-side and deterministic (time enters only through the
+caller-supplied virtual-clock stamps — no wall clocks):
+
+* **Typed errors** — every way a request can fail is a distinct
+  exception type, so callers (and the troubleshooting matrix in the
+  README) can tell *shed* from *evicted* from *deadline-exceeded*
+  from *engine-failed* without string-matching. ``RequestRejected``
+  subclasses are raised AT SUBMIT TIME; ``DeadlineExceeded`` /
+  ``EngineFailedError`` also land on ``Sequence.error`` when the
+  failure happens after submission (shed from the queue, engine
+  death) — :meth:`Sequence.check` re-raises them.
+
+* **Admission control** (:class:`ReliabilityConfig`) — a bounded
+  admission queue with per-request deadlines and priorities. The
+  overload policy sheds the LOWEST-priority waiting request first
+  (ties: youngest) and NEVER touches in-flight sequences — an
+  admitted request is either served or evicted-and-requeued (PR 9
+  semantics), not dropped. Deadlines are enforced at admission
+  boundaries against the virtual clock: an expired waiting request
+  is shed with :class:`DeadlineExceeded` instead of wasting prefill
+  compute on an answer nobody is waiting for.
+
+* **Weight hot-swap** (:class:`HotSwapController`) — staged rollout
+  of new checkpoint weights across a fleet of running engines, with
+  rollback. ``TracedProgram``-style weights-as-args (the PR 9 runner
+  design) makes a swap an ARGUMENT change between decode steps, not
+  a recompile: the controller's contract is zero dropped requests
+  and zero extra compiled programs, gated by
+  ``bench.py --serving-reliability``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence as Seq
+
+__all__ = [
+    "ServingError", "RequestRejected", "QueueFullError",
+    "PromptTooLongError", "DeadlineExceeded", "EngineFailedError",
+    "WeightSwapError", "ReliabilityConfig", "HotSwapController",
+    "flight_record",
+]
+
+
+def flight_record(**fields) -> None:
+    """One shared emitter for every serving flight-recorder span
+    (``kind="serving"``) — scheduler, engine, router, and hot-swap all
+    route through here so the span format has a single owner. Inherits
+    the recorder's one-attribute-load no-op when disabled."""
+    from ..distributed.fault_tolerance import flight_recorder
+    flight_recorder.record("serving", **fields)
+
+
+# ---------------------------------------------------------------- errors
+class ServingError(RuntimeError):
+    """Base of every typed serving failure."""
+
+
+class RequestRejected(ServingError, ValueError):
+    """The request was refused at (or after) submission — admission
+    control, not a server fault. Subclasses say why. Also a
+    ``ValueError`` so callers of the pre-typed submit API keep
+    working."""
+
+
+class QueueFullError(RequestRejected):
+    """Bounded admission queue is full and the overload policy found
+    no lower-priority waiting request to shed."""
+
+
+class PromptTooLongError(RequestRejected):
+    """``len(prompt) + max_new_tokens`` exceeds ``max_model_len`` —
+    rejected at submit time, before any blocks or compute are spent
+    (letting it through surfaces later as an illegible block-coverage
+    stall)."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed before it could be admitted (or
+    the caller observed it expired). Shed requests carry this on
+    ``Sequence.error``."""
+
+
+class EngineFailedError(ServingError):
+    """The engine died (chaos ``kill_engine``, a poisoned device, an
+    operator kill). In-flight sequences are recoverable from their
+    host-side token logs via ``ServingEngine.recover_inflight`` — the
+    failover router re-prefills them on a healthy engine."""
+
+
+class WeightSwapError(ServingError):
+    """A hot-swap payload does not match the running model (length /
+    shape / dtype) — the swap is refused atomically, nothing is
+    half-applied."""
+
+
+# ---------------------------------------------------- admission control
+@dataclass
+class ReliabilityConfig:
+    """Admission-control & load-shedding knobs for one engine.
+
+    ``max_queue_depth=None`` keeps the PR 9 unbounded-queue behavior;
+    everything else only matters once a bound is set. Priorities are
+    ints, HIGHER = more important. ``default_deadline_s`` is relative
+    to each request's ``arrival_t`` (virtual clock)."""
+    max_queue_depth: Optional[int] = None
+    default_priority: int = 0
+    default_deadline_s: Optional[float] = None
+    # overload policy: shed the lowest-priority waiting request to
+    # make room for a strictly-higher-priority arrival (False =
+    # always reject the arrival when full)
+    shed_on_full: bool = True
+
+    def deadline_for(self, arrival_t: float,
+                     deadline_s: Optional[float]) -> Optional[float]:
+        d = self.default_deadline_s if deadline_s is None else deadline_s
+        return None if d is None else float(arrival_t) + float(d)
+
+
+# ---------------------------------------------------------- hot swap
+class HotSwapController:
+    """Staged zero-drop rollout of new weights across running engines.
+
+    Lifecycle::
+
+        ctl = HotSwapController(engines, new_weights)
+        while ctl.stage_next(now) is not None:   # one engine per stage
+            ...serve traffic, watch ctl.healthy(verify)...
+        # ctl.state == "committed", or on a bad canary:
+        ctl.rollback(now)                        # restore old weights
+
+    Each stage swaps ONE engine between its decode steps (weights ride
+    as program arguments — same shapes/dtypes, so the compiled decode
+    census cannot grow). The previous weights are captured per engine
+    at stage time, so ``rollback`` is itself just another swap, applied
+    in reverse stage order. An engine that died before its stage is
+    skipped (the failover router owns its sequences); an engine that
+    dies MID-stage leaves the controller free to roll the healthy
+    stages back.
+
+    ``verify`` (optional) runs after every stage; returning ``False``
+    triggers an automatic rollback and marks the controller
+    ``rolled_back`` — the staged-canary pattern."""
+
+    def __init__(self, engines: Seq, new_weights,
+                 verify: Optional[Callable] = None):
+        self.engines = list(engines)
+        self.new_weights = new_weights
+        self.verify = verify
+        self._prev = {}              # engine idx -> pre-swap arrays
+        self.staged: List[int] = []
+        self.state = "pending"       # rolling|committed|rolled_back
+
+    def _record(self, event: str, **fields) -> None:
+        flight_record(event=event, **fields)
+
+    def _done_staging(self) -> bool:
+        return all(i in self._prev or getattr(e, "failed", False)
+                   for i, e in enumerate(self.engines))
+
+    def _commit(self, now: float) -> None:
+        """The ONE owner of the commit transition (idempotent)."""
+        if self.state != "committed":
+            self.state = "committed"
+            self._record("hot_swap_commit", t=now,
+                         stages=len(self.staged))
+
+    def stage_next(self, now: float = 0.0) -> Optional[int]:
+        """Swap the next alive, unstaged engine. Returns its index, or
+        None when every engine is staged (state -> "committed")."""
+        if self.state in ("committed", "rolled_back"):
+            return None
+        self.state = "rolling"
+        for idx, eng in enumerate(self.engines):
+            if idx in self._prev or getattr(eng, "failed", False):
+                continue
+            self._prev[idx] = eng.swap_weights(self.new_weights, now=now)
+            self.staged.append(idx)
+            self._record("hot_swap_stage", engine=idx, t=now,
+                         stage=len(self.staged))
+            if self.verify is not None and not self.verify(eng):
+                self._record("hot_swap_canary_failed", engine=idx, t=now)
+                self.rollback(now)
+                return idx
+            if self._done_staging():
+                self._commit(now)
+            return idx
+        if self.staged:
+            # nothing left to stage AND at least one engine got the
+            # new weights; a fleet that was entirely dead/unstageable
+            # must NOT report "committed" for a rollout that touched
+            # nothing
+            self._commit(now)
+        return None
+
+    def rollback(self, now: float = 0.0) -> List[int]:
+        """Restore the pre-swap weights on every staged engine, newest
+        stage first. Engines that died since their stage are skipped.
+        Returns the indices rolled back. A rollback before any stage
+        is a no-op (nothing was touched, the state is unchanged)."""
+        if not self.staged:
+            return []
+        rolled = []
+        for idx in reversed(self.staged):
+            eng = self.engines[idx]
+            if getattr(eng, "failed", False):
+                continue
+            eng.swap_weights(self._prev[idx], now=now)
+            rolled.append(idx)
+        self.state = "rolled_back"
+        self._record("hot_swap_rollback", t=now, engines=rolled)
+        return rolled

@@ -28,6 +28,18 @@ Three policies live here, all host-side and deterministic:
   across compositions — the serving bench gates that the number of
   compiled decode programs never exceeds ``len(batch_buckets) x
   len(page_buckets)``.
+* **Admission control & load shedding** (PR 11, opt-in via
+  ``SchedulerConfig.reliability``): a bounded admission queue with
+  per-request priorities and deadlines. When the queue is full, the
+  overload policy sheds the LOWEST-priority waiting request (ties:
+  youngest) to admit a strictly-higher-priority arrival — in-flight
+  sequences are always honored (eviction requeues, shedding only ever
+  removes WAITING work). Expired deadlines are shed at every
+  admission boundary against the caller's virtual clock.
+
+Scheduler decisions (admit / evict / requeue / shed) land in the
+flight-recorder ring (one-attribute-load no-op when off) so
+``flight_doctor`` can post-mortem a serving crash.
 """
 
 from __future__ import annotations
@@ -38,6 +50,9 @@ from typing import Dict, List, Optional, Tuple
 
 from .block_cache import (BlockAllocator, BlockTable, OutOfBlocksError,
                           blocks_for_tokens)
+from .reliability import (DeadlineExceeded, QueueFullError,
+                          ReliabilityConfig, ServingError,
+                          flight_record as _flight_record)
 
 __all__ = ["Request", "Sequence", "SeqState", "SchedulerConfig",
            "ContinuousBatchingScheduler"]
@@ -45,17 +60,24 @@ __all__ = ["Request", "Sequence", "SeqState", "SchedulerConfig",
 
 @dataclass
 class Request:
-    """One generation request as submitted by a client."""
+    """One generation request as submitted by a client.
+
+    ``priority`` (higher = more important) and ``deadline_t``
+    (ABSOLUTE virtual-clock stamp, None = none) drive the admission
+    controller; both default to the PR 9 don't-care values."""
     req_id: int
     prompt: List[int]
     max_new_tokens: int
     arrival_t: float = 0.0
+    priority: int = 0
+    deadline_t: Optional[float] = None
 
 
 class SeqState(enum.Enum):
     WAITING = "waiting"
     RUNNING = "running"
     FINISHED = "finished"
+    SHED = "shed"
 
 
 class Sequence:
@@ -69,10 +91,36 @@ class Sequence:
         self.first_token_t: Optional[float] = None
         self.finish_t: Optional[float] = None
         self.evictions = 0
+        self.recoveries = 0          # corruption / engine-failure rebuilds
+        self.error: Optional[ServingError] = None   # set when SHED
+
+    def check(self) -> "Sequence":
+        """Raise the typed error a post-submission failure recorded
+        (shed / deadline / engine death); returns self when healthy."""
+        if self.error is not None:
+            raise self.error
+        return self
+
+    def rebind(self, allocator: BlockAllocator) -> None:
+        """Point the sequence at a FRESH empty table on ``allocator``
+        WITHOUT releasing the old blocks — used when the old table is
+        untrustworthy (corruption) or gone (its engine died). The
+        token log is host state and survives; re-admission re-prefills
+        it, which the eviction-exactness guarantee proves is
+        token-for-token identical to never having lost the KV."""
+        self.table = BlockTable(allocator)
 
     @property
     def req_id(self) -> int:
         return self.request.req_id
+
+    @property
+    def priority(self) -> int:
+        return self.request.priority
+
+    @property
+    def deadline_t(self) -> Optional[float]:
+        return self.request.deadline_t
 
     @property
     def num_cached(self) -> int:
@@ -101,6 +149,9 @@ class SchedulerConfig:
     # prefill/decode disaggregation: max prompt tokens admitted per
     # scheduling round (0 = unlimited)
     prefill_budget_tokens: int = 512
+    # admission control / load shedding (None = PR 9 behavior:
+    # unbounded queue, no deadlines)
+    reliability: Optional[ReliabilityConfig] = None
 
     def __post_init__(self):
         self.batch_buckets = tuple(sorted(set(self.batch_buckets)))
@@ -142,10 +193,13 @@ class ContinuousBatchingScheduler:
     def __init__(self, config: SchedulerConfig, allocator: BlockAllocator):
         self.config = config
         self.allocator = allocator
+        self.reliability = config.reliability or ReliabilityConfig()
         self.waiting: List[Sequence] = []
         self._running: List[Sequence] = []      # admission order
         self.finished: List[Sequence] = []
+        self.shed: List[Sequence] = []
         self.total_evictions = 0
+        self.total_shed = 0
 
     # -- introspection ---------------------------------------------------
     def running(self) -> List[Sequence]:
@@ -155,19 +209,104 @@ class ContinuousBatchingScheduler:
     def queue_depth(self) -> int:
         return len(self.waiting)
 
+    @staticmethod
+    def _in_flight(seq: Sequence) -> bool:
+        """True once a sequence has ever been admitted: an evicted or
+        recovered sequence waiting to resume is IN-FLIGHT work (tokens
+        already accepted), not a fresh arrival — it is never a shed
+        candidate and its deadline no longer applies (deadlines gate
+        ADMISSION; admitted work runs to completion)."""
+        return seq.evictions > 0 or seq.recoveries > 0
+
     # -- submission ------------------------------------------------------
     def submit(self, seq: Sequence) -> None:
+        """Enqueue a new request. With a bounded admission queue
+        (``reliability.max_queue_depth``), a full queue either sheds
+        the lowest-priority waiting request (only if STRICTLY lower
+        priority than the arrival — ties reject the arrival, FIFO
+        fairness) or raises :class:`QueueFullError`. In-flight
+        sequences are never candidates: eviction requeues bypass this
+        bound via :meth:`requeue_front`, and an evicted/recovered
+        sequence back in the queue is exempt from victim selection."""
+        depth = self.reliability.max_queue_depth
+        if depth is not None and len(self.waiting) >= depth:
+            victim = None
+            shippable = [s for s in self.waiting
+                         if not self._in_flight(s)]
+            if self.reliability.shed_on_full and shippable:
+                # lowest priority first; ties broken by YOUNGEST
+                # (latest queue position) so older work keeps its place
+                victim = min(reversed(shippable),
+                             key=lambda s: s.priority)
+            if victim is None or victim.priority >= seq.priority:
+                raise QueueFullError(
+                    f"admission queue full ({len(self.waiting)} >= "
+                    f"{depth}) and no waiting request has priority < "
+                    f"{seq.priority}")
+            self._shed(victim, QueueFullError(
+                f"shed (priority {victim.priority}) for arrival "
+                f"req {seq.req_id} (priority {seq.priority})"))
         self.waiting.append(seq)
 
+    def requeue_front(self, seq: Sequence) -> None:
+        """Put a previously-admitted sequence back at the FRONT of the
+        queue (eviction, corruption recovery, engine-failover
+        adoption): preempted work resumes before new arrivals and is
+        exempt from the admission bound — in-flight is honored."""
+        seq.state = SeqState.WAITING
+        self.waiting.insert(0, seq)
+        _flight_record(event="requeue", req=seq.req_id,
+                       tokens=len(seq.tokens))
+
+    # -- load shedding ---------------------------------------------------
+    def _shed(self, seq: Sequence, err: ServingError) -> None:
+        self.waiting.remove(seq)
+        self.mark_shed(seq, err)
+
+    def mark_shed(self, seq: Sequence, err: ServingError) -> None:
+        """Shed bookkeeping for a sequence NOT in the waiting queue —
+        e.g. a recovered fresh arrival the adopting engine's bounded
+        queue refuses at failover time."""
+        from ..observability import metrics
+        seq.state = SeqState.SHED
+        seq.error = err
+        self.shed.append(seq)
+        self.total_shed += 1
+        reason = ("deadline" if isinstance(err, DeadlineExceeded)
+                  else "overload")
+        metrics.inc("serving_shed_total", reason=reason)
+        if reason == "deadline":
+            metrics.inc("serving_deadline_exceeded_total")
+        _flight_record(event="shed", req=seq.req_id, reason=reason,
+                       priority=seq.priority)
+
+    def expire_deadlines(self, now: float) -> List[Sequence]:
+        """Shed every never-admitted WAITING sequence whose deadline
+        has passed — called at each admission boundary. In-flight work
+        is honored to completion: RUNNING sequences are untouched, and
+        an evicted/recovered sequence back in the queue already has
+        accepted tokens, so its (admission) deadline no longer
+        applies."""
+        expired = [s for s in self.waiting
+                   if s.deadline_t is not None and s.deadline_t < now
+                   and not self._in_flight(s)]
+        for s in expired:
+            self._shed(s, DeadlineExceeded(
+                f"req {s.req_id} deadline {s.deadline_t:.6f} < now "
+                f"{now:.6f} before admission"))
+        return expired
+
     # -- admission -------------------------------------------------------
-    def admit(self) -> List[Sequence]:
+    def admit(self, now: float = 0.0) -> List[Sequence]:
         """Pick waiting sequences to prefill this round: FIFO, bounded
         by free decode slots, allocator coverage for the WHOLE current
         token list (prompt + any pre-eviction generation), and the
         prefill token budget. Admitted sequences get their blocks
         allocated here; the engine must prefill and mark them RUNNING.
         A request whose blocks cannot be covered blocks the queue
-        (FIFO — skipping it would starve long prompts forever)."""
+        (FIFO — skipping it would starve long prompts forever).
+        Expired deadlines are shed first, against ``now``."""
+        self.expire_deadlines(now)
         admitted: List[Sequence] = []
         budget = self.config.prefill_budget_tokens or float("inf")
         spent = 0
@@ -186,6 +325,9 @@ class ContinuousBatchingScheduler:
             seq.table.ensure_capacity(need_tokens + 1)
             spent += need_tokens
             admitted.append(seq)
+            _flight_record(event="admit", req=seq.req_id,
+                           tokens=need_tokens,
+                           blocks=len(seq.table.blocks))
         return admitted
 
     def mark_running(self, seq: Sequence) -> None:
@@ -221,11 +363,24 @@ class ContinuousBatchingScheduler:
     def _evict(self, seq: Sequence) -> None:
         self._running.remove(seq)
         seq.table.release()
-        seq.state = SeqState.WAITING
         seq.evictions += 1
         self.total_evictions += 1
+        _flight_record(event="evict", req=seq.req_id,
+                       evictions=seq.evictions)
         # front of the queue: preempted work resumes before new arrivals
-        self.waiting.insert(0, seq)
+        self.requeue_front(seq)
+
+    def requeue_corrupt(self, seq: Sequence) -> None:
+        """Pull a RUNNING sequence whose block table can no longer be
+        trusted (chaos ``corrupt_block_table``, a real scribble): the
+        table is REBOUND to a fresh empty one instead of released —
+        freeing corrupted ids could double-free a live block. The
+        caller must rebuild the allocator's free list from the
+        surviving tables (``BlockAllocator.rebuild_free_list``)."""
+        self._running.remove(seq)
+        seq.rebind(self.allocator)
+        seq.recoveries += 1
+        self.requeue_front(seq)
 
     # -- completion ------------------------------------------------------
     def finish(self, seq: Sequence, now: float = 0.0) -> None:

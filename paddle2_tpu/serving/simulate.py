@@ -32,7 +32,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["poisson_trace", "ServingSimReport", "simulate_serving",
-           "simulate_predictor_baseline", "cost_seconds"]
+           "simulate_predictor_baseline", "cost_seconds",
+           "EngineFailoverRouter", "RouterSimReport", "simulate_router"]
 
 
 def poisson_trace(n_requests: int, rate_per_s: float,
@@ -181,6 +182,349 @@ def simulate_serving(engine, trace: List[dict],
     rep.program_budget = engine.program_budget
     rep.mean_batch_occupancy = float(np.mean(occupancy)) if occupancy \
         else 0.0
+    return rep.finalize(first_arrival, last_finish)
+
+
+# ------------------------------------------------- multi-engine failover
+class EngineFailoverRouter:
+    """Deterministic multi-engine router with session affinity, health
+    probes, and engine-failure recovery (ROADMAP 2(c)/(d)).
+
+    Routing: a request with a ``session`` sticks to its session's
+    engine (KV/prefix locality); otherwise the least-loaded alive
+    engine wins (ties: lowest index). Health is probed on a fixed
+    virtual-clock cadence using the ``fault_tolerance/health.py``
+    idiom — each sweep yields one :class:`HealthReport` per engine —
+    and a probe that finds an engine dead triggers failover: every
+    in-flight sequence is harvested from the dead engine's host-side
+    token logs (``recover_inflight``) and adopted at the FRONT of a
+    healthy engine's queue, preserving admission order. Re-prefill of
+    the token log reproduces the lost KV exactly, so recovered
+    requests complete token-for-token identical to a fault-free run.
+    MTTR (engine death -> every recovered sequence re-prefilled and
+    producing tokens again) is measured on the virtual clock and gated
+    by ``bench.py --serving-reliability``."""
+
+    def __init__(self, engines: List, probe_interval_s: float = 1e-3):
+        if not engines:
+            raise ValueError("need at least one engine")
+        if not probe_interval_s > 0.0:
+            # maybe_probe advances in probe_interval_s steps; a
+            # non-positive cadence would spin forever
+            raise ValueError(
+                f"probe_interval_s must be > 0, got {probe_interval_s}")
+        self.engines = list(engines)
+        for i, e in enumerate(self.engines):
+            e.engine_id = i
+        self.probe_interval_s = float(probe_interval_s)
+        # anchored lazily to the FIRST maybe_probe stamp: a fixed 0.0
+        # anchor would make a first call at a large `now` spin through
+        # one catch-up sweep per interval since time zero
+        self._next_probe_t: Optional[float] = None
+        self._affinity: Dict[object, int] = {}
+        self._seqs: Dict[int, object] = {}      # global rid -> Sequence
+        self._home: Dict[int, int] = {}         # global rid -> engine idx
+        self._next_rid = 0
+        self._handled_failures: set = set()
+        self.failovers: List[dict] = []
+        self.probes = 0
+
+    # -- routing ---------------------------------------------------------
+    def alive(self) -> List[int]:
+        return [i for i, e in enumerate(self.engines) if not e.failed]
+
+    def _load(self, idx: int) -> int:
+        e = self.engines[idx]
+        return len(e.scheduler.running()) + len(e.scheduler.waiting)
+
+    def _pick(self, session=None) -> int:
+        alive = self.alive()
+        if not alive:
+            from .reliability import EngineFailedError
+            raise EngineFailedError("no alive engine to route to")
+        if session is not None:
+            idx = self._affinity.get(session)
+            if idx is not None and not self.engines[idx].failed:
+                return idx
+        idx = min(alive, key=lambda i: (self._load(i), i))
+        if session is not None:
+            self._affinity[session] = idx
+        return idx
+
+    def submit(self, prompt, max_new_tokens: int, arrival_t: float = 0.0,
+               session=None, priority: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> int:
+        """Route one request; returns a router-global request id.
+        Typed rejections (queue full, prompt too long) propagate from
+        the target engine."""
+        idx = self._pick(session)
+        local = self.engines[idx].submit(
+            prompt, max_new_tokens, arrival_t=arrival_t,
+            priority=priority, deadline_s=deadline_s)
+        rid = self._next_rid
+        self._next_rid += 1
+        self._seqs[rid] = self.engines[idx].sequence(local)
+        self._home[rid] = idx
+        return rid
+
+    def sequence(self, rid: int):
+        return self._seqs[rid]
+
+    def home_of(self, rid: int) -> int:
+        """Engine index currently serving ``rid`` (updated when a
+        failover re-homes the sequence)."""
+        return self._home[rid]
+
+    # -- health + failover -----------------------------------------------
+    def maybe_probe(self, now: float) -> None:
+        """Run every probe sweep whose cadence stamp has passed; the
+        cadence anchors at the first call's ``now``."""
+        if self._next_probe_t is None:
+            self._next_probe_t = float(now)
+        while now >= self._next_probe_t:
+            self.probe(self._next_probe_t)
+            self._next_probe_t += self.probe_interval_s
+
+    def probe(self, now: float) -> List:
+        """One health sweep (``health.py`` HealthReport idiom); a
+        newly-dead engine fails over HERE — detection latency is part
+        of the gated MTTR."""
+        from ..distributed.fault_tolerance.health import HealthReport
+        self.probes += 1
+        reports = []
+        for i, e in enumerate(self.engines):
+            rep = HealthReport(ok=not e.failed,
+                               reason=e.fail_reason or "",
+                               probe="serving_engine")
+            reports.append(rep)
+            if not rep.ok and i not in self._handled_failures:
+                # no adopter alive -> leave the failure UNhandled (and
+                # the dead engine's sequences unharvested, so nothing
+                # is lost); a later sweep retries once capacity exists
+                if not self.alive():
+                    continue
+                self._handled_failures.add(i)
+                self._failover(i, now)
+        return reports
+
+    def _failover(self, dead_idx: int, now: float) -> None:
+        from ..observability import metrics
+        from .reliability import flight_record
+        alive = self.alive()
+        if not alive:
+            from .reliability import EngineFailedError
+            raise EngineFailedError(
+                "no alive engine to adopt recovered sequences")
+        dead = self.engines[dead_idx]
+        recovered = dead.recover_inflight()
+        # drop dead-engine affinity; sessions re-pin on next submit
+        for sess in [s for s, i in self._affinity.items()
+                     if i == dead_idx]:
+            del self._affinity[sess]
+        # assign targets in recovery order, least-loaded alive first
+        # with each assignment counted (so a big recovery spreads
+        # across the fleet instead of piling on one engine), then
+        # adopt per target in REVERSE so front-insertion preserves the
+        # original in-flight order
+        loads = {i: self._load(i) for i in alive}
+        targets: Dict[int, List] = {}
+        for seq in recovered:
+            idx = min(alive, key=lambda i: (loads[i], i))
+            loads[idx] += 1
+            targets.setdefault(idx, []).append(seq)
+        rid_of = {id(s): rid for rid, s in self._seqs.items()}
+        for idx, seqs in sorted(targets.items()):
+            eng = self.engines[idx]
+            # adopt() front-inserts ever-ADMITTED work and APPENDS
+            # never-admitted arrivals (normal bounded submit), so the
+            # two groups need opposite iteration orders to preserve
+            # the original admission/FIFO order on the adopter
+            inflight = [s for s in seqs if eng.scheduler._in_flight(s)]
+            fresh = [s for s in seqs if not eng.scheduler._in_flight(s)]
+            for seq in list(reversed(inflight)) + fresh:
+                eng.adopt(seq)
+                if id(seq) in rid_of:       # keep home_of() truthful
+                    self._home[rid_of[id(seq)]] = idx
+        metrics.inc("serving_failovers_total")
+        flight_record(
+            event="failover", engine=dead_idx, t=now,
+            failed_t=dead.failed_t, recovered=len(recovered),
+            targets={str(k): len(v) for k, v in targets.items()})
+        self.failovers.append({
+            "engine": dead_idx, "failed_t": dead.failed_t,
+            "detected_t": now, "seqs": recovered,
+            "recovered": len(recovered), "recovered_t": None,
+            "mttr_s": None})
+
+    def note_recovery(self, now: float) -> None:
+        """Stamp MTTR for failovers whose every recovered sequence has
+        SETTLED: re-prefilled (RUNNING with a fresh ``ready_at``),
+        finished, or shed by the adopter's admission control (a
+        never-admitted fresh arrival refused at adoption counts as
+        settled — recovery is about resuming ACCEPTED work)."""
+        from .scheduler import SeqState
+        settled = (SeqState.RUNNING, SeqState.FINISHED, SeqState.SHED)
+        for fo in self.failovers:
+            if fo["recovered_t"] is not None:
+                continue
+            seqs = fo["seqs"]
+            if all(s.state in settled for s in seqs):
+                done = max((getattr(s, "ready_at", now) for s in seqs
+                            if s.state is not SeqState.SHED),
+                           default=now)
+                fo["recovered_t"] = done
+                fo["mttr_s"] = done - (fo["failed_t"] or 0.0)
+
+    @property
+    def mttr_s(self) -> float:
+        """Worst recovered-failover MTTR (0.0 when none)."""
+        vals = [fo["mttr_s"] for fo in self.failovers
+                if fo["mttr_s"] is not None]
+        return max(vals) if vals else 0.0
+
+
+@dataclass
+class RouterSimReport(ServingSimReport):
+    engines: int = 0
+    completed: int = 0
+    submitted: int = 0
+    rejected: int = 0
+    shed: int = 0
+    failovers: int = 0
+    recovered_seqs: int = 0
+    mttr_s: float = 0.0
+    probes: int = 0
+    hot_swaps: int = 0
+    rids: List[int] = field(default_factory=list)
+
+
+def simulate_router(router: EngineFailoverRouter, trace: List[dict],
+                    max_rounds: int = 100_000,
+                    on_round=None) -> RouterSimReport:
+    """Drive a fleet through ``trace`` under ONE virtual clock,
+    lockstep: each round, every alive engine admits+prefills (its own
+    prefill lane) and runs at most one decode step; the clock advances
+    by the SLOWEST engine's step cost that round (engines run in
+    parallel, so a round costs its straggler — conservative for every
+    gated quantity). Health probes fire on their cadence at round
+    boundaries; a chaos ``kill_engine`` fires inside ``decode_once``
+    and surfaces as ``EngineFailedError``, which the loop absorbs —
+    the ROUTER only learns at its next probe, so detection latency is
+    inside the gated MTTR. Trace entries may carry ``session``,
+    ``priority``, ``deadline_s``; typed rejections are counted, not
+    raised. ``on_round(router, clock, round_idx)`` is the
+    deterministic hook the hot-swap drill uses to stage rollouts."""
+    from .reliability import EngineFailedError, RequestRejected
+    from .scheduler import SeqState
+
+    pending = sorted(trace, key=lambda r: r["arrival_t"])
+    first_arrival = pending[0]["arrival_t"] if pending else 0.0
+    clock = float(first_arrival)
+    prefill_clocks = [0.0] * len(router.engines)
+    rep = RouterSimReport(engines=len(router.engines))
+
+    def submit_due(now: float):
+        while pending and pending[0]["arrival_t"] <= now:
+            r = pending.pop(0)
+            try:
+                rid = router.submit(
+                    r["prompt"], r["max_new_tokens"],
+                    arrival_t=r["arrival_t"], session=r.get("session"),
+                    priority=r.get("priority"),
+                    deadline_s=r.get("deadline_s"))
+                rep.rids.append(rid)
+                rep.submitted += 1
+            except (RequestRejected, EngineFailedError):
+                # typed rejections are COUNTED, not raised — including
+                # "no alive engine to route to" under total fleet death
+                rep.rejected += 1
+
+    def lane_ready_fn(idx: int, now: float):
+        def lane_ready(info):
+            start = max(prefill_clocks[idx], now,
+                        info["seq"].request.arrival_t)
+            prefill_clocks[idx] = start + cost_seconds(info["cost"])
+            return prefill_clocks[idx]
+        return lane_ready
+
+    for round_idx in range(max_rounds):
+        router.maybe_probe(clock)
+        submit_due(clock)
+        if on_round is not None:
+            on_round(router, clock, round_idx)
+        costs = []
+        for idx in router.alive():
+            eng = router.engines[idx]
+            try:
+                eng.admit_and_prefill(clock,
+                                      ready_at_fn=lane_ready_fn(idx, clock))
+                step = eng.decode_once(clock)
+            except EngineFailedError:
+                continue            # died this round; next probe sees it
+            if step is not None:
+                costs.append(cost_seconds(step["cost"]))
+        router.note_recovery(clock)
+        if not router.alive():
+            # total fleet death: nothing can ever serve the remainder
+            rep.rejected += len(pending)
+            pending.clear()
+            break
+        busy = any(not router.engines[i].idle() for i in router.alive())
+        undetected = [i for i, e in enumerate(router.engines)
+                      if e.failed and i not in router._handled_failures]
+        if not pending and not busy and not undetected:
+            break
+        if costs:
+            clock += max(costs)
+        else:
+            # legible stall diagnosis (simulate_serving's twin): an
+            # idle engine whose head-of-line prompt needs more blocks
+            # than its whole pool holds can never make progress
+            from .block_cache import blocks_for_tokens
+            for i in router.alive():
+                eng = router.engines[i]
+                w = eng.scheduler.waiting
+                if w and not eng.scheduler.running() and not pending:
+                    need = blocks_for_tokens(
+                        len(w[0].tokens) + 1, eng.cache.block_size)
+                    if need > eng.allocator.num_blocks - 1:
+                        raise RuntimeError(
+                            "head-of-line request can never be "
+                            "admitted (prompt needs more blocks than "
+                            "the pool has)")
+            nxt = [r["arrival_t"] for r in pending[:1]]
+            if (undetected or busy) and router._next_probe_t is not None:
+                nxt.append(router._next_probe_t)
+            for i in router.alive():
+                nxt.extend(getattr(s, "ready_at", 0.0) for s in
+                           router.engines[i].scheduler.running())
+            if not nxt:
+                break
+            clock = max(clock, min(nxt)) + 1e-9
+    else:
+        raise RuntimeError(
+            f"router simulation did not converge in {max_rounds} rounds")
+
+    seqs = [router.sequence(rid) for rid in rep.rids]
+    done = [s for s in seqs if s.state is SeqState.FINISHED]
+    rep.completed = len(done)
+    rep.shed = sum(e.scheduler.total_shed for e in router.engines)
+    rep.total_tokens = sum(len(s.generated) for s in done)
+    rep.ttft_s = [max(0.0, s.first_token_t - s.request.arrival_t)
+                  for s in done if s.first_token_t is not None]
+    rep.decode_steps = sum(e.decode_steps for e in router.engines)
+    rep.evictions = sum(e.scheduler.total_evictions
+                        for e in router.engines)
+    rep.failovers = len(router.failovers)
+    rep.recovered_seqs = sum(fo["recovered"] for fo in router.failovers)
+    rep.mttr_s = router.mttr_s
+    rep.probes = router.probes
+    alive = router.alive()
+    rep.decode_programs = sum(router.engines[i].num_decode_programs
+                              for i in alive)
+    rep.program_budget = sum(router.engines[i].program_budget
+                             for i in alive)
+    last_finish = max((s.finish_t or 0.0) for s in done) if done else 0.0
     return rep.finalize(first_arrival, last_finish)
 
 

@@ -201,7 +201,25 @@ def diagnose(dumps: Dict[int, Dict[str, Any]],
         "quarantine": list(quarantine or []),
         "nodes": {r: dumps[r]["header"].get("node") for r in ranks},
         "sdc": [],
+        "serving": {},
     }
+    # serving plane (PR 11): scheduler admit/evict/requeue/shed, engine
+    # decode steps, failures/failovers, and hot-swap events — per-event
+    # counts plus the newest few, so a serving crash post-mortem shows
+    # what the reliability plane was doing when the engine died
+    serving_counts: Dict[str, int] = {}
+    serving_tail: List[Dict[str, Any]] = []
+    for r in ranks:
+        for ev in dumps[r]["events"]:
+            if ev.get("kind") != "serving":
+                continue
+            name = ev.get("event", "?")
+            serving_counts[name] = serving_counts.get(name, 0) + 1
+            serving_tail.append({"rank": r, **{k: v for k, v in ev.items()
+                                               if k != "kind"}})
+    if serving_counts:
+        report["serving"] = {"counts": serving_counts,
+                             "last": serving_tail[-10:]}
     # SDC evidence: fingerprint-vote mismatches and self-evictions the
     # workers recorded. Deduped by (rank, step) — every voter records
     # the same verdict; the report wants the verdict once per witness.
@@ -460,9 +478,29 @@ def format_report(report: Dict[str, Any], directory: str) -> str:
                          f"{_rank_list(g['suspects'])} "
                          f"(step time > {_STRAGGLER_K:g} x median)")
 
+    L.extend(_format_serving(report))
     L.extend(_format_quarantine(report))
     L.extend(_format_elastic_timeline(report))
     return "\n".join(L)
+
+
+def _format_serving(report: Dict[str, Any]) -> List[str]:
+    """SERVING section: what the serving reliability plane recorded —
+    admit/evict/requeue/shed counts, decode steps, engine failures,
+    failovers, hot-swap stages — plus the newest events verbatim."""
+    sv = report.get("serving") or {}
+    if not sv:
+        return []
+    L = ["SERVING"]
+    counts = sv.get("counts") or {}
+    L.append("  events: " + " ".join(f"{k}={counts[k]}"
+                                     for k in sorted(counts)))
+    for ev in (sv.get("last") or [])[-5:]:
+        rank = ev.get("rank", "?")
+        detail = " ".join(f"{k}={ev[k]}" for k in sorted(ev)
+                          if k not in ("rank", "event"))
+        L.append(f"  rank {rank}: {ev.get('event', '?')} {detail}")
+    return L
 
 
 def _format_quarantine(report: Dict[str, Any]) -> List[str]:

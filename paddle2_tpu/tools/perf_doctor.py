@@ -64,6 +64,14 @@ _RELIABILITY_COUNTERS = (
     "train_step_compiles_total", "checkpoint_saves_total",
     "checkpoint_restores_total", "checkpoint_save_failures_total",
     "checkpoint_restore_failures_total",
+    # serving reliability plane (PR 11): shed/retry/failover lanes —
+    # a serving regression often shows up here before it shows up in
+    # step time (sheds eat requests, failovers eat re-prefill compute)
+    "serving_shed_total", "serving_deadline_exceeded_total",
+    "serving_retries_total", "serving_evictions_total",
+    "serving_engine_failures_total", "serving_failovers_total",
+    "serving_recovered_seqs_total", "serving_table_corruptions_total",
+    "serving_hot_swaps_total",
 )
 
 

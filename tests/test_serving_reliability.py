@@ -1,0 +1,642 @@
+"""PR 11: serving reliability plane.
+
+Admission control & load shedding (typed errors, priorities,
+deadlines), engine-failure recovery (chaos kill_engine /
+drop_decode_step / corrupt_block_table with token-for-token replay),
+the deterministic multi-engine failover router, and zero-drop weight
+hot-swap. Everything runs the REAL engine on CPU under virtual-clock
+stamps — no wall clocks anywhere.
+"""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+from paddle2_tpu.distributed.fault_tolerance import chaos
+from paddle2_tpu.serving import (
+    BlockAllocator, BlockFreeError, ContinuousBatchingScheduler,
+    DeadlineExceeded, EngineConfig, EngineFailedError,
+    EngineFailoverRouter, HotSwapController, OutOfBlocksError,
+    PromptTooLongError, QueueFullError, ReliabilityConfig, Request,
+    RequestRejected, SchedulerConfig, Sequence, SeqState, ServingEngine,
+    WeightSwapError, simulate_router)
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    chaos.disarm()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from paddle2_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+    paddle.seed(0)
+    return GPTForCausalLM(gpt_tiny(use_scan=False))
+
+
+def _engine(model, **over):
+    kw = dict(block_size=8, num_blocks=32, max_batch=4,
+              prefill_budget_tokens=64, max_model_len=64)
+    kw.update(over)
+    return ServingEngine(model, config=EngineConfig(**kw))
+
+
+def _drain(eng, max_steps=300):
+    steps = 0
+    while not eng.idle() and steps < max_steps:
+        eng.tick(now=float(steps))
+        steps += 1
+    assert eng.idle(), "engine did not drain"
+
+
+def _prompts(model, n, size=10, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, model.cfg.vocab_size, size=size).tolist()
+            for _ in range(n)]
+
+
+# ---------------------------------------------------- allocator (satellite)
+def test_block_free_typed_errors():
+    a = BlockAllocator(num_blocks=8, block_size=16)
+    blocks = a.allocate(3)
+    a.free(blocks)
+    state = list(a._free)
+    with pytest.raises(BlockFreeError):
+        a.free(blocks)                          # double free
+    with pytest.raises(BlockFreeError):
+        a.free([0])                             # reserved garbage block
+    with pytest.raises(BlockFreeError):
+        a.free([99])                            # out of range
+    b = a.allocate(2)
+    with pytest.raises(BlockFreeError):
+        a.free([b[0], b[0]])                    # duplicate IN the call
+    # every raise left the free list untouched (validate-then-mutate)
+    assert a._free == [x for x in state if x not in b]
+    a.free(b)                                   # clean free still works
+    assert BlockFreeError.__mro__.index(ValueError) > 0  # typed + compat
+
+
+def test_rebuild_free_list_recovers_pool():
+    a = BlockAllocator(num_blocks=10, block_size=8)
+    t1, t2 = a.allocate(3), a.allocate(2)
+    # t2's table got corrupted: rebuild from the survivor t1 only
+    a.rebuild_free_list([t1])
+    assert a.used_count == 3
+    assert sorted(a._free) == sorted(
+        b for b in range(1, 10) if b not in t1)
+    with pytest.raises(BlockFreeError):
+        a.rebuild_free_list([[0, 55]])
+
+
+# -------------------------------------------------- typed submit rejection
+def test_submit_prompt_too_long_typed(tiny_model):
+    eng = _engine(tiny_model, max_model_len=32)
+    with pytest.raises(PromptTooLongError):
+        eng.submit(list(range(30)), max_new_tokens=8)
+    # typed AND backward compatible with the pre-typed ValueError API
+    with pytest.raises(ValueError):
+        eng.submit(list(range(30)), max_new_tokens=8)
+    with pytest.raises(RequestRejected):
+        eng.submit([], max_new_tokens=2)
+    with pytest.raises(RequestRejected):
+        eng.submit([1, 2], max_new_tokens=0)
+    # a fitting request still goes through
+    eng.submit(list(range(8)), max_new_tokens=4)
+    assert eng.scheduler.queue_depth == 1
+
+
+# ------------------------------------------------------- admission control
+def _sched(max_queue_depth=None, **rel):
+    alloc = BlockAllocator(num_blocks=64, block_size=4)
+    cfg = SchedulerConfig(
+        max_batch=2, batch_buckets=(1, 2), page_buckets=(2, 4, 8, 16),
+        prefill_budget_tokens=0,
+        reliability=ReliabilityConfig(max_queue_depth=max_queue_depth,
+                                      **rel))
+    return ContinuousBatchingScheduler(cfg, alloc), alloc
+
+
+def _seq(alloc, rid, n=6, priority=0, deadline_t=None, arrival=0.0):
+    return Sequence(Request(rid, list(range(1, n + 1)), 4, arrival,
+                            priority=priority, deadline_t=deadline_t),
+                    alloc)
+
+
+def test_bounded_queue_sheds_lowest_priority_first():
+    sched, alloc = _sched(max_queue_depth=2)
+    lo = _seq(alloc, 0, priority=0)
+    lo2 = _seq(alloc, 1, priority=0)
+    sched.submit(lo)
+    sched.submit(lo2)
+    # same priority arrival: the ARRIVAL is rejected (FIFO fairness)
+    with pytest.raises(QueueFullError):
+        sched.submit(_seq(alloc, 2, priority=0))
+    # higher-priority arrival sheds the YOUNGEST lowest-priority waiter
+    hi = _seq(alloc, 3, priority=5)
+    sched.submit(hi)
+    assert sched.waiting == [lo, hi]
+    assert lo2.state is SeqState.SHED
+    assert isinstance(lo2.error, QueueFullError)
+    with pytest.raises(QueueFullError):
+        lo2.check()
+    assert sched.total_shed == 1
+    # shed_on_full=False always rejects the arrival
+    sched2, alloc2 = _sched(max_queue_depth=1, shed_on_full=False)
+    sched2.submit(_seq(alloc2, 0, priority=0))
+    with pytest.raises(QueueFullError):
+        sched2.submit(_seq(alloc2, 1, priority=9))
+
+
+def test_admission_with_already_expired_deadline():
+    """SATELLITE: a request whose deadline passed before admission is
+    shed with DeadlineExceeded, never admitted, never prefilled."""
+    sched, alloc = _sched()
+    dead = _seq(alloc, 0, deadline_t=1.0)
+    live = _seq(alloc, 1, deadline_t=50.0)
+    sched.submit(dead)
+    sched.submit(live)
+    admitted = sched.admit(now=2.0)
+    assert admitted == [live]
+    assert dead.state is SeqState.SHED
+    assert isinstance(dead.error, DeadlineExceeded)
+    with pytest.raises(DeadlineExceeded):
+        dead.check()
+    assert dead.table.blocks == []          # no blocks ever allocated
+    # boundary: deadline exactly == now is NOT expired
+    sched2, alloc2 = _sched()
+    edge = _seq(alloc2, 0, deadline_t=2.0)
+    sched2.submit(edge)
+    assert sched2.admit(now=2.0) == [edge]
+
+
+def test_engine_deadline_defaults_from_reliability_config(tiny_model):
+    eng = _engine(tiny_model, reliability=ReliabilityConfig(
+        default_deadline_s=5.0, default_priority=3))
+    rid = eng.submit([1, 2, 3], max_new_tokens=2, arrival_t=10.0)
+    seq = eng.sequence(rid)
+    assert seq.priority == 3 and seq.deadline_t == 15.0
+    rid2 = eng.submit([1, 2, 3], max_new_tokens=2, arrival_t=10.0,
+                      priority=7, deadline_s=1.0)
+    assert eng.sequence(rid2).deadline_t == 11.0
+    # expired at the admission boundary -> shed, typed
+    eng.admit_and_prefill(now=100.0)
+    assert seq.state is SeqState.SHED
+    assert isinstance(seq.error, DeadlineExceeded)
+
+
+def test_evicted_sequence_exempt_from_shed_and_deadline():
+    """In-flight is honored END TO END: an evicted sequence back in
+    the queue (tokens already accepted) is never a shed victim and its
+    admission deadline no longer applies."""
+    sched, alloc = _sched(max_queue_depth=2)
+    evicted = _seq(alloc, 0, priority=0, deadline_t=1.0)
+    evicted.table.ensure_capacity(4)
+    sched.mark_running(evicted)
+    sched._evict(evicted)                   # front of queue, WAITING
+    fresh = _seq(alloc, 1, priority=0)
+    sched.submit(fresh)
+    # queue full; the high-priority arrival must shed the FRESH
+    # request, not the evicted one, despite equal priorities
+    hi = _seq(alloc, 2, priority=5)
+    sched.submit(hi)
+    assert fresh.state is SeqState.SHED
+    assert evicted.state is SeqState.WAITING
+    # expired deadline does not touch previously-admitted work either
+    assert sched.expire_deadlines(now=100.0) == []
+    assert evicted.state is SeqState.WAITING
+    # ...and when ONLY in-flight work waits, the arrival is rejected
+    # rather than displacing it
+    sched._shed(hi, QueueFullError("clear"))
+    evicted2 = _seq(alloc, 3, priority=0)
+    evicted2.table.ensure_capacity(4)
+    sched.mark_running(evicted2)
+    sched._evict(evicted2)                  # queue: 2 in-flight seqs
+    with pytest.raises(QueueFullError):
+        sched.submit(_seq(alloc, 4, priority=9))
+    assert evicted.state is SeqState.WAITING
+    assert evicted2.state is SeqState.WAITING
+
+
+def test_validate_tables_catches_self_duplicate(tiny_model):
+    """A scribble that duplicates a block WITHIN one table (in-range,
+    so the range check is blind to it) aliases two token pages onto
+    one block — the validator must catch it and rebuild the victim."""
+    eng = _engine(tiny_model)
+    sched, alloc = eng.scheduler, eng.allocator
+    a = _seq(alloc, 0, n=9)
+    b = _seq(alloc, 1, n=9)
+    for s in (a, b):
+        s.table.ensure_capacity(10)         # 2 blocks of 8
+        s.table.num_tokens = 10
+        s.ready_at = 0.0
+        sched.mark_running(s)
+    free_before = alloc.free_count
+    b.table.blocks[1] = b.table.blocks[0]   # self-dup, in range
+    survivors = eng._validate_tables(sched.running())
+    assert survivors == [a]
+    assert b.state is SeqState.WAITING and b.recoveries == 1
+    assert b.table.blocks == []
+    # the dup'd block stays owned by nobody twice: ledger consistent,
+    # and the victim's (untrustworthy) blocks returned to the pool
+    assert alloc.free_count == free_before + 2
+    # cross-sequence dup: blame is ambiguous -> BOTH claimants rebuilt
+    eng2 = _engine(tiny_model)
+    s2, s3 = _seq(eng2.allocator, 0, n=9), _seq(eng2.allocator, 1, n=9)
+    for s in (s2, s3):
+        s.table.ensure_capacity(10)
+        s.table.num_tokens = 10
+        eng2.scheduler.mark_running(s)
+    s3.table.blocks[0] = s2.table.blocks[0]
+    assert eng2._validate_tables(eng2.scheduler.running()) == []
+    assert s2.recoveries == 1 and s3.recoveries == 1
+
+
+# ------------------------------------------------- scheduler edge cases
+def test_preemption_with_zero_free_blocks():
+    """SATELLITE edge case: the free list is COMPLETELY empty when a
+    running sequence needs its next block — eviction must free a
+    victim and the reservation must then succeed."""
+    alloc = BlockAllocator(num_blocks=9, block_size=4)   # 8 usable
+    cfg = SchedulerConfig(max_batch=2, batch_buckets=(1, 2),
+                          page_buckets=(2, 4), prefill_budget_tokens=0)
+    sched = ContinuousBatchingScheduler(cfg, alloc)
+    a = _seq(alloc, 0, n=15)                 # 4 blocks for 16 tokens
+    b = _seq(alloc, 1, n=15)
+    sched.submit(a)
+    sched.submit(b)
+    assert sched.admit() == [a, b]
+    sched.mark_running(a)
+    sched.mark_running(b)
+    a.table.num_tokens = 16                  # tables exactly full,
+    b.table.num_tokens = 16
+    assert alloc.free_count == 0             # ...zero blocks free
+    victims = sched.reserve_decode_slots()
+    assert victims == [b]                    # LIFO victim
+    assert b.state is SeqState.WAITING and sched.waiting[0] is b
+    assert a.table.capacity >= 17            # survivor got its block
+    assert alloc.free_count == 3             # victim's 4 freed, 1 taken
+
+
+def test_requeue_front_ordering_under_repeated_eviction():
+    """SATELLITE edge case: repeated evictions stack at the FRONT in
+    LIFO order, ahead of fresh arrivals, and re-admission drains them
+    front-first."""
+    alloc = BlockAllocator(num_blocks=64, block_size=4)
+    cfg = SchedulerConfig(max_batch=4, batch_buckets=(1, 2, 4),
+                          page_buckets=(2, 4, 8, 16),
+                          prefill_budget_tokens=0)
+    sched = ContinuousBatchingScheduler(cfg, alloc)
+    seqs = [_seq(alloc, i) for i in range(3)]
+    fresh = _seq(alloc, 99)
+    for s in seqs:
+        sched.submit(s)
+    for s in sched.admit():
+        sched.mark_running(s)
+    sched.submit(fresh)
+    sched._evict(seqs[1])
+    sched._evict(seqs[2])
+    # LIFO stack: the LAST evicted sits at the very front; the fresh
+    # arrival waits behind every preempted sequence
+    assert sched.waiting == [seqs[2], seqs[1], fresh]
+    assert seqs[1].evictions == 1 and seqs[2].evictions == 1
+    assert sched.total_evictions == 2
+    readmitted = sched.admit()
+    assert readmitted[:2] == [seqs[2], seqs[1]]
+
+
+# ------------------------------------------------------- serving chaos
+def _clean_run(model, prompts, max_new=6, **eng_over):
+    eng = _engine(model, **eng_over)
+    rids = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+    _drain(eng)
+    return eng, [eng.sequence(r).generated for r in rids]
+
+
+@pytest.mark.slow
+def test_drop_decode_step_retries_token_for_token(tiny_model):
+    prompts = _prompts(tiny_model, 3, seed=11)
+    _, clean = _clean_run(tiny_model, prompts)
+    chaos.arm("drop_decode_step:2")
+    eng = _engine(tiny_model)
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    _drain(eng)
+    assert ("drop_decode_step", "engine0") in chaos.fired_log()
+    got = [eng.sequence(r).generated for r in rids]
+    assert got == clean                     # retry is invisible in tokens
+    # the dropped step still burned a decode step (its cost is real)
+    assert eng.decode_steps >= 1
+
+
+@pytest.mark.slow
+def test_corrupt_block_table_detected_and_recovered(tiny_model):
+    prompts = _prompts(tiny_model, 3, seed=13)
+    _, clean = _clean_run(tiny_model, prompts)
+    chaos.arm("corrupt_block_table:3:1")
+    eng = _engine(tiny_model)
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    _drain(eng)
+    assert any(k == "corrupt_block_table" for k, _ in chaos.fired_log())
+    got = [eng.sequence(r).generated for r in rids]
+    assert got == clean                     # re-prefill replay is exact
+    assert sum(eng.sequence(r).recoveries for r in rids) >= 1
+    # allocator ledger is consistent after the rebuild: every block is
+    # exactly once free or owned, and all tables fully drained
+    assert eng.allocator.free_count == eng.allocator.num_blocks - 1
+
+
+@pytest.mark.slow
+def test_kill_engine_fails_engine_typed(tiny_model):
+    chaos.arm("kill_engine:2")
+    eng = _engine(tiny_model)
+    eng.submit(_prompts(tiny_model, 1, seed=17)[0], max_new_tokens=6)
+    eng.tick(now=0.0)                       # step 1 survives
+    with pytest.raises(EngineFailedError):
+        eng.tick(now=1.0)                   # step 2 dies
+    assert eng.failed and eng.fail_reason == "chaos:kill_engine"
+    with pytest.raises(EngineFailedError):
+        eng.submit([1, 2], max_new_tokens=2)
+    with pytest.raises(EngineFailedError):
+        eng.decode_once(now=2.0)
+    # harvest is only legal on a failed engine
+    healthy = _engine(tiny_model)
+    with pytest.raises(EngineFailedError):
+        healthy.recover_inflight()
+    harvested = eng.recover_inflight()
+    assert len(harvested) == 1
+    assert harvested[0].state is SeqState.WAITING
+
+
+# ------------------------------------------------------ failover router
+def _trace(model, n, seed, rate=2000.0, max_new=6, size=10,
+           session_mod=None):
+    rng = np.random.default_rng(seed)
+    t, out = 0.0, []
+    for i in range(n):
+        t += float(rng.exponential(1.0 / rate))
+        out.append({
+            "arrival_t": t,
+            "prompt": rng.integers(0, model.cfg.vocab_size,
+                                   size=size).tolist(),
+            "max_new_tokens": max_new,
+            "session": None if session_mod is None else i % session_mod,
+        })
+    return out
+
+
+def _router(model, n_engines=2, probe_interval_s=1e-4, **eng_over):
+    engines = [_engine(model, **eng_over) for _ in range(n_engines)]
+    return EngineFailoverRouter(engines,
+                                probe_interval_s=probe_interval_s)
+
+
+@pytest.mark.slow
+def test_router_kill_engine_failover_token_for_token(tiny_model):
+    """ACCEPTANCE: engine kill mid-decode -> every accepted in-flight
+    request completes, token-for-token identical to the fault-free
+    run, via re-prefill from the host token logs on the survivor."""
+    trace = _trace(tiny_model, 10, seed=23)
+    router0 = _router(tiny_model)
+    clean = simulate_router(router0, list(trace))
+    assert clean.completed == 10 and clean.failovers == 0
+    clean_toks = [router0.sequence(r).generated for r in clean.rids]
+
+    chaos.arm("kill_engine:3:1")            # engine 1's 3rd decode step
+    router = _router(tiny_model)
+    rep = simulate_router(router, list(trace))
+    assert any(k == "kill_engine" for k, _ in chaos.fired_log())
+    assert rep.failovers == 1
+    assert rep.recovered_seqs >= 1
+    assert rep.completed == 10              # zero lost requests
+    got = [router.sequence(r).generated for r in rep.rids]
+    assert got == clean_toks                # token-for-token replay
+    assert rep.mttr_s is not None and rep.mttr_s > 0.0
+
+
+def test_router_session_affinity_and_remap(tiny_model):
+    router = _router(tiny_model, n_engines=2)
+    p = _prompts(tiny_model, 1, seed=29)[0]
+    r1 = router.submit(p, 4, arrival_t=0.0, session="alice")
+    r2 = router.submit(p, 4, arrival_t=0.0, session="alice")
+    assert router.home_of(r1) == router.home_of(r2)     # sticky
+    home = router.home_of(r1)
+    other = router.submit(p, 4, arrival_t=0.0, session="bob")
+    assert router.home_of(other) != home                # least-loaded
+    # kill the home engine: the failover re-homes alice's sequences
+    # (home_of stays truthful) and the session re-pins on next submit
+    router.engines[home].fail("test", now=0.0)
+    router.probe(now=0.0)
+    assert router.home_of(r1) != home
+    r3 = router.submit(p, 4, arrival_t=0.0, session="alice")
+    assert router.home_of(r3) != home
+    assert not router.engines[router.home_of(r3)].failed
+
+
+def test_router_whole_fleet_dead_defers_failover(tiny_model):
+    """With no alive adopter, a probe must NOT harvest the dead
+    engine's sequences (they would be lost) — the failure stays
+    unhandled for a later sweep, and nothing raises mid-probe."""
+    router = _router(tiny_model, n_engines=2)
+    p = _prompts(tiny_model, 1, seed=53)[0]
+    rid = router.submit(p, 4, arrival_t=0.0)
+    home = router.home_of(rid)
+    for e in router.engines:
+        e.fail("test", now=0.0)
+    router.probe(now=0.0)                   # must not raise
+    assert router._handled_failures == set()
+    assert router.failovers == []
+    # the sequence is still on its dead engine, harvestable later
+    assert router.sequence(rid) in router.engines[home].scheduler.waiting
+    with pytest.raises(ValueError):
+        EngineFailoverRouter([_engine(tiny_model)], probe_interval_s=0.0)
+
+
+def test_failover_preserves_fifo_of_never_admitted_work(tiny_model):
+    """Never-admitted arrivals recovered from a dead engine APPEND to
+    the adopter's queue in their original FIFO order (the reversed
+    iteration is only for the front-inserted in-flight group)."""
+    router = _router(tiny_model, n_engines=2)
+    p = _prompts(tiny_model, 1, seed=59)[0]
+    rids = [router.submit(p, 4, arrival_t=0.0, session="x")
+            for _ in range(3)]
+    home = router.home_of(rids[0])
+    seqs = [router.sequence(r) for r in rids]
+    router.engines[home].fail("test", now=0.0)
+    router.probe(now=0.0)
+    adopter = router.engines[1 - home]
+    assert adopter.scheduler.waiting == seqs    # FIFO preserved
+    assert [router.home_of(r) for r in rids] == [1 - home] * 3
+
+
+def test_hot_swap_all_dead_fleet_never_commits(tiny_model):
+    eng = _engine(tiny_model)
+    eng.fail("test", now=0.0)
+    ctl = HotSwapController([eng], [0])          # payload never used
+    assert ctl.stage_next(now=0.0) is None
+    assert ctl.state != "committed" and ctl.staged == []
+    assert ctl.rollback(now=0.0) == []
+
+
+def test_recover_inflight_keeps_waiting_seqs_sheddable(tiny_model):
+    """A never-admitted waiting request recovered from a dead engine
+    keeps fresh-arrival semantics on the adopter: its deadline still
+    applies (only ever-ADMITTED work is exempt)."""
+    eng = _engine(tiny_model)
+    rid = eng.submit([1, 2, 3], max_new_tokens=2, arrival_t=0.0,
+                     deadline_s=1.0)
+    eng.fail("test", now=0.0)
+    (seq,) = eng.recover_inflight()
+    assert seq.recoveries == 0              # never admitted
+    target = _engine(tiny_model)
+    target.adopt(seq)
+    target.scheduler.expire_deadlines(now=5.0)
+    assert seq.state is SeqState.SHED
+    assert isinstance(seq.error, DeadlineExceeded)
+
+
+@pytest.mark.slow
+def test_router_overload_sheds_low_priority_completes_admitted(tiny_model):
+    """Bounded queue + mixed priorities under an overload burst: the
+    shed set is exactly the low-priority tail, every admitted request
+    completes, and in-flight work is never shed."""
+    rel = ReliabilityConfig(max_queue_depth=3)
+    trace = _trace(tiny_model, 12, seed=31, rate=1e6)  # burst at t~0
+    for i, r in enumerate(trace):
+        r["priority"] = 1 if i % 3 == 0 else 0
+    router = _router(tiny_model, n_engines=1, reliability=rel)
+    rep = simulate_router(router, trace)
+    assert rep.rejected + rep.shed > 0      # overload actually shed
+    assert rep.completed == rep.submitted - rep.shed
+    eng = router.engines[0]
+    for s in eng.scheduler.shed:            # typed + priority policy
+        assert isinstance(s.error, RequestRejected)
+        assert s.priority == 0
+
+
+# --------------------------------------------------------- weight hot-swap
+def _variant_weights(engine, scale=1.001):
+    return [w * scale if hasattr(w, "dtype") and "float" in str(w.dtype)
+            else w for w in engine.runner._weights()]
+
+
+@pytest.mark.slow
+def test_hot_swap_zero_drop_and_census(tiny_model):
+    """ACCEPTANCE: staged rollout + rollback with zero dropped
+    requests and ZERO extra compiled decode programs (weights are
+    arguments, not constants)."""
+    engines = [_engine(tiny_model) for _ in range(2)]
+    router = EngineFailoverRouter(engines, probe_interval_s=1e-4)
+    new_w = _variant_weights(engines[0])
+    ctl = HotSwapController(engines, new_w)
+    staged_at = {}
+
+    def on_round(rt, clock, idx):
+        if idx in (4, 6):                   # one engine per stage
+            i = ctl.stage_next(now=clock)
+            if i is not None:
+                staged_at[i] = idx
+        if idx == 10 and ctl.state == "committed":
+            ctl.rollback(now=clock)
+
+    census_before = [e.num_decode_programs for e in engines]
+    trace = _trace(tiny_model, 12, seed=37, rate=3000.0)
+    rep = simulate_router(router, trace, on_round=on_round)
+    assert ctl.state == "rolled_back" and len(staged_at) == 2
+    assert rep.completed == 12              # zero dropped requests
+    # the compiled decode census never grew past the clean-run set
+    for e, before in zip(engines, census_before):
+        assert e.num_decode_programs <= max(before, e.program_budget)
+    assert all(e.runner._swap_arrays is not None for e in engines)
+    # rolled-back weights are bitwise the originals
+    for e in engines:
+        for a, b in zip(e.runner._swap_arrays,
+                        [t._data for t in e.runner._state]):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_hot_swap_changes_tokens_and_rollback_restores(tiny_model):
+    eng = _engine(tiny_model)
+    p = _prompts(tiny_model, 1, seed=41)[0]
+    r0 = eng.submit(p, max_new_tokens=4)
+    _drain(eng)
+    base = eng.sequence(r0).generated
+    prev = eng.swap_weights(_variant_weights(eng, scale=4.0))
+    r1 = eng.submit(p, max_new_tokens=4)
+    _drain(eng)
+    swapped = eng.sequence(r1).generated
+    eng.swap_weights(prev)                  # rollback
+    r2 = eng.submit(p, max_new_tokens=4)
+    _drain(eng)
+    assert eng.sequence(r2).generated == base
+    assert swapped != base                  # the swap was real
+
+
+def test_hot_swap_mismatch_is_atomic_typed(tiny_model):
+    eng = _engine(tiny_model)
+    good = eng.runner._weights()
+    with pytest.raises(WeightSwapError):
+        eng.swap_weights(good[:-1])         # wrong leaf count
+    with pytest.raises(WeightSwapError):
+        bad = list(good)
+        bad[0] = np.zeros((3, 3), np.float32)
+        eng.swap_weights(bad)               # wrong shape
+    assert eng.runner._swap_arrays is None  # nothing half-applied
+
+
+def test_hot_swap_controller_canary_rolls_back(tiny_model):
+    engines = [_engine(tiny_model) for _ in range(2)]
+    ctl = HotSwapController(engines, _variant_weights(engines[0]),
+                            verify=lambda e: False)
+    ctl.stage_next(now=0.0)
+    assert ctl.state == "rolled_back"
+    for a, b in zip(engines[0].runner._weights(),
+                    [t._data for t in engines[0].runner._state]):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------- flight-recorder spans
+@pytest.mark.slow
+def test_flight_recorder_serving_spans(tiny_model, tmp_path):
+    """SATELLITE: scheduler admit / evict / requeue, engine decode
+    steps, and hot-swap events all land in the flight ring so
+    flight_doctor can post-mortem a serving crash."""
+    from paddle2_tpu.distributed.fault_tolerance import flight_recorder
+    flight_recorder.enable(str(tmp_path), rank=0)
+    try:
+        eng = _engine(tiny_model, num_blocks=10)   # tight -> evictions
+        for p in _prompts(tiny_model, 3, size=14, seed=43):
+            eng.submit(p, max_new_tokens=6)
+        _drain(eng)
+        eng.swap_weights(_variant_weights(eng))
+        fr = flight_recorder.active()
+        events = [f for _, _, kind, f in fr.events() if kind == "serving"]
+    finally:
+        flight_recorder.disable()
+    kinds = {e.get("event") for e in events}
+    assert {"admit", "decode_step", "hot_swap"} <= kinds
+    if eng.scheduler.total_evictions:
+        assert {"evict", "requeue"} <= kinds
+    # decode-step spans carry the bucket the program was keyed by
+    step_ev = next(e for e in events if e.get("event") == "decode_step")
+    assert "bucket" in step_ev and "batch" in step_ev
+
+
+def test_flight_doctor_serving_section(tiny_model, tmp_path):
+    from paddle2_tpu.distributed.fault_tolerance import flight_recorder
+    from paddle2_tpu.tools import flight_doctor
+    flight_recorder.enable(str(tmp_path), rank=0)
+    try:
+        eng = _engine(tiny_model)
+        eng.submit(_prompts(tiny_model, 1, seed=47)[0], max_new_tokens=3)
+        _drain(eng)
+        flight_recorder.dump("test_serving_postmortem")
+    finally:
+        flight_recorder.disable()
+    dumps = flight_doctor.load_dumps(str(tmp_path))
+    report = flight_doctor.diagnose(dumps)
+    assert report["serving"], "serving events missing from diagnosis"
+    text = flight_doctor.format_report(report, str(tmp_path))
+    assert "SERVING" in text and "decode_step" in text
