@@ -11,22 +11,28 @@ Three coordinated planes over one training process:
   HBM bytes, collective wire traffic under an ICI-vs-DCN link model,
   MFU, roofline) — the cost x rate gating primitive the perf benches
   use instead of wall-clock A/B;
+* :mod:`.tracing` — per-REQUEST lifecycle span trees for the serving
+  fleet (``PADDLE_TRACE_DIR/trace_rank_N.jsonl`` + chrome-trace
+  export) with an exact tail-latency decomposition — the
+  ``serve_doctor`` CLI's substrate;
 * ``tools/perf_doctor`` (sibling CLI of ``flight_doctor``) — joins the
   metrics stream with flight rings and merged chrome traces into a
   triage report, and diffs two streams to name the top regressed
   component.
 
-The metrics hooks follow the flight recorder's zero-overhead
-discipline: one module-attribute load per site when disabled.
+The metrics and tracing hooks follow the flight recorder's
+zero-overhead discipline: one module-attribute load per site when
+disabled.
 """
 
-from . import cost_model, metrics  # noqa: F401
+from . import cost_model, metrics, tracing  # noqa: F401
 from .cost_model import (CollectiveTraffic, LinkModel, StepCost,  # noqa: F401
                          chip_peak, program_cost, wire_bytes)
 from .metrics import (Counter, Gauge, Histogram, MetricsPlane,  # noqa: F401
                       METRICS_DIR_ENV)
+from .tracing import TracePlane, TRACE_DIR_ENV  # noqa: F401
 
-__all__ = ["metrics", "cost_model", "Counter", "Gauge", "Histogram",
-           "MetricsPlane", "METRICS_DIR_ENV", "CollectiveTraffic",
-           "LinkModel", "StepCost", "chip_peak", "program_cost",
-           "wire_bytes"]
+__all__ = ["metrics", "cost_model", "tracing", "Counter", "Gauge",
+           "Histogram", "MetricsPlane", "METRICS_DIR_ENV", "TracePlane",
+           "TRACE_DIR_ENV", "CollectiveTraffic", "LinkModel", "StepCost",
+           "chip_peak", "program_cost", "wire_bytes"]

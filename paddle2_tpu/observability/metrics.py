@@ -166,8 +166,17 @@ class Histogram(_Metric):
         return sorted(self.series.items())
 
     def snapshot(self) -> Dict[str, Any]:
-        return {_fmt_labels(k): {"sum": s["sum"], "count": s["count"]}
-                for k, s in self.labels_list()}
+        # per-bucket CUMULATIVE counts ride the JSONL snapshot too (the
+        # Prometheus export always had them): sum/count alone cannot
+        # reconstruct percentiles downstream, which left perf_doctor
+        # without p50/p99 lanes. The +Inf upper bound serializes as
+        # None — a bare Infinity literal breaks strict-JSON consumers.
+        return {_fmt_labels(k): {
+            "sum": s["sum"], "count": s["count"],
+            "buckets": [None if ub == float("inf") else ub
+                        for ub in self.buckets],
+            "counts": list(s["counts"]),
+        } for k, s in self.labels_list()}
 
 
 def _fmt_labels(key: Tuple) -> str:

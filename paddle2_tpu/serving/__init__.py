@@ -39,7 +39,8 @@ from .paged_attention import (paged_attention_decode,
 from .reliability import (ServingError, RequestRejected, QueueFullError,
                           PromptTooLongError, DeadlineExceeded,
                           EngineFailedError, WeightSwapError,
-                          ReliabilityConfig, HotSwapController)
+                          ReliabilityConfig, SLOConfig,
+                          HotSwapController)
 from .scheduler import (Request, Sequence, SeqState,
                         ContinuousBatchingScheduler, SchedulerConfig)
 from .engine import ServingEngine, EngineConfig
@@ -55,7 +56,8 @@ __all__ = [
     "gathered_dense_kv",
     "ServingError", "RequestRejected", "QueueFullError",
     "PromptTooLongError", "DeadlineExceeded", "EngineFailedError",
-    "WeightSwapError", "ReliabilityConfig", "HotSwapController",
+    "WeightSwapError", "ReliabilityConfig", "SLOConfig",
+    "HotSwapController",
     "Request", "Sequence", "SeqState", "ContinuousBatchingScheduler",
     "SchedulerConfig",
     "ServingEngine", "EngineConfig",

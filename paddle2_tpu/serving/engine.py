@@ -146,6 +146,18 @@ class ServingEngine:
         self.fail_reason: Optional[str] = None
         self.failed_t: Optional[float] = None
 
+    @property
+    def engine_id(self) -> int:
+        return self._engine_id
+
+    @engine_id.setter
+    def engine_id(self, value: int) -> None:
+        # mirrored onto the scheduler so ITS flight/trace spans carry
+        # the same lane id the engine's do (the router re-numbers
+        # engines after construction — a copied id would go stale)
+        self._engine_id = int(value)
+        self.scheduler.engine_id = self._engine_id
+
     # -- construction helpers --------------------------------------------
     @staticmethod
     def _load_artifact(artifact_path: str, gpt_config,
@@ -169,7 +181,8 @@ class ServingEngine:
     # -- request intake --------------------------------------------------
     def submit(self, prompt: Seq[int], max_new_tokens: int,
                arrival_t: float = 0.0, priority: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[int] = None) -> int:
         """Submit one request. Typed rejections at submit time:
         :class:`~.reliability.PromptTooLongError` when the request can
         never fit the model's context,
@@ -177,7 +190,10 @@ class ServingEngine:
         admission queue is full and the overload policy finds nothing
         lower-priority to shed. ``priority`` (higher = more important)
         and ``deadline_s`` (relative to ``arrival_t``) default from
-        the engine's :class:`~.reliability.ReliabilityConfig`."""
+        the engine's :class:`~.reliability.ReliabilityConfig`.
+        ``trace_id`` is the stable id the request-tracing plane keys
+        this request's span tree by (the failover router stamps its
+        fleet-global id; default: this engine's request id)."""
         self._check_alive()
         prompt = [int(t) for t in prompt]
         if not prompt:
@@ -199,10 +215,15 @@ class ServingEngine:
         req = Request(rid, prompt, int(max_new_tokens), arrival_t,
                       priority=(rel.default_priority if priority is None
                                 else int(priority)),
-                      deadline_t=rel.deadline_for(arrival_t, deadline_s))
+                      deadline_t=rel.deadline_for(arrival_t, deadline_s),
+                      trace_id=(rid if trace_id is None else trace_id))
         seq = Sequence(req, self.allocator)
         self.scheduler.submit(seq)     # may shed, may raise QueueFull
         self._seqs[rid] = seq
+        _flight_record(event="submit", req=rid, tid=req.trace_id,
+                       t=arrival_t, engine=self.engine_id,
+                       prompt_tokens=len(prompt),
+                       max_new=int(max_new_tokens))
         self._gauge()
         return rid
 
@@ -227,8 +248,14 @@ class ServingEngine:
         self.fail_reason = reason
         self.failed_t = now
         metrics.inc("serving_engine_failures_total")
+        # the span carries every in-flight trace id: each of those
+        # requests' failover_stall starts at THIS stamp (detection
+        # latency is part of the stall), and the chaos fault that
+        # killed the engine is attributable to specific requests
+        tids = [s.trace_id for s in self.scheduler.running()
+                + self.scheduler.waiting if s.trace_id is not None]
         _flight_record(event="engine_failed", engine=self.engine_id,
-                       reason=reason, t=now)
+                       reason=reason, t=now, tids=tids or None)
 
     def recover_inflight(self) -> List[Sequence]:
         """Harvest every unfinished sequence of a FAILED engine for
@@ -258,16 +285,18 @@ class ServingEngine:
             s.recoveries += 1
         return running + waiting
 
-    def adopt(self, seq: Sequence) -> int:
+    def adopt(self, seq: Sequence, now: Optional[float] = None) -> int:
         """Adopt a sequence recovered from a dead engine: re-key it
         into this engine's request map and bind a fresh table on this
-        engine's allocator. Ever-ADMITTED work (tokens accepted)
-        requeues at the FRONT, exempt from the admission bound —
-        in-flight is honored. A never-admitted fresh arrival keeps
-        fresh-arrival semantics: it goes through the normal bounded
-        ``submit`` path, so the adopter's queue depth and shed policy
-        still govern it (a refusal marks it SHED with the typed
-        error, never silently over-fills the queue)."""
+        engine's allocator (``trace_id`` survives the re-key — spans
+        stay joined across the failover). Ever-ADMITTED work (tokens
+        accepted) requeues at the FRONT, exempt from the admission
+        bound — in-flight is honored. A never-admitted fresh arrival
+        keeps fresh-arrival semantics: it goes through the normal
+        bounded ``submit`` path, so the adopter's queue depth and shed
+        policy still govern it (a refusal marks it SHED with the typed
+        error, never silently over-fills the queue). ``now`` stamps
+        the adoption span (the router passes its probe time)."""
         from ..observability import metrics
         from .reliability import QueueFullError
         self._check_alive()
@@ -278,18 +307,18 @@ class ServingEngine:
         seq.ready_at = 0.0
         self._seqs[rid] = seq
         if self.scheduler._in_flight(seq):
-            self.scheduler.requeue_front(seq)
+            self.scheduler.requeue_front(seq, now=now, cause="adopt")
         else:
             try:
                 self.scheduler.submit(seq)
             except QueueFullError as e:
-                self.scheduler.mark_shed(seq, e)
+                self.scheduler.mark_shed(seq, e, now=now)
         if seq.state is not SeqState.SHED:
             # an adoption the bounded queue refused is a shed (counted
             # by mark_shed), not a recovery
             metrics.inc("serving_recovered_seqs_total")
         _flight_record(event="adopt", engine=self.engine_id, req=rid,
-                       tokens=len(seq.tokens),
+                       tid=seq.trace_id, t=now, tokens=len(seq.tokens),
                        shed=seq.state is SeqState.SHED)
         self._gauge()
         return rid
@@ -311,7 +340,14 @@ class ServingEngine:
             arrays = [t._data for t in params + buffers]
         prev = self.runner.swap_weights(arrays)
         metrics.inc("serving_hot_swaps_total")
-        _flight_record(event="hot_swap", engine=self.engine_id, t=now)
+        # weights-as-args means the swap costs the running batch ZERO
+        # pause (pause_s stays 0.0); the span still stamps WHICH
+        # requests were in flight, so a future engine that must
+        # quiesce can price its pause into their swap_stall component
+        tids = [s.trace_id for s in self.scheduler.running()
+                if s.trace_id is not None]
+        _flight_record(event="hot_swap", engine=self.engine_id, t=now,
+                       tids=tids or None, pause_s=0.0)
         return prev
 
     # -- admission + prefill ---------------------------------------------
@@ -350,6 +386,14 @@ class ServingEngine:
                                 max(0.0, seq.first_token_t
                                     - seq.request.arrival_t))
             self.scheduler.mark_running(seq)
+            # prefill span: admission -> first-token-ready on the
+            # prefill lane (lane queueing included — the decode lane
+            # never waits on it). `end` is the EXACT ready_at stamp so
+            # a finish-at-prefill closes the sum bitwise.
+            _flight_record(event="prefill", req=seq.req_id,
+                           tid=seq.trace_id, t=now, end=seq.ready_at,
+                           engine=self.engine_id, tokens=n,
+                           padded=padded)
             metrics.inc("serving_prefill_tokens_total", n)
             if seq.done:
                 # its only token materializes when the prefill LANE
@@ -361,7 +405,8 @@ class ServingEngine:
         return out
 
     # -- block-table integrity --------------------------------------------
-    def _validate_tables(self, active: List[Sequence]) -> List[Sequence]:
+    def _validate_tables(self, active: List[Sequence],
+                         now: Optional[float] = None) -> List[Sequence]:
         """Integrity-check every RUNNING sequence's block table before
         the decode step consumes it: ids in the usable range, no block
         owned by two sequences, coverage for the cached tokens. A
@@ -400,8 +445,9 @@ class ServingEngine:
         for s in bad:
             metrics.inc("serving_table_corruptions_total")
             _flight_record(event="table_corrupt", engine=self.engine_id,
-                           req=s.req_id, blocks=list(s.table.blocks))
-            self.scheduler.requeue_corrupt(s)
+                           req=s.req_id, tid=s.trace_id, t=now,
+                           blocks=list(s.table.blocks))
+            self.scheduler.requeue_corrupt(s, now=now)
         self.allocator.rebuild_free_list(
             [s.table.blocks for s in self.scheduler.running()])
         return [s for s in active if s.state is SeqState.RUNNING]
@@ -426,10 +472,10 @@ class ServingEngine:
         if chaos.active() is not None:
             chaos.maybe_corrupt_block_table(
                 [s.table.blocks for s in active])
-        active = self._validate_tables(active)
+        active = self._validate_tables(active, now=now)
         if not active:
             return None
-        victims = self.scheduler.reserve_decode_slots(active)
+        victims = self.scheduler.reserve_decode_slots(active, now=now)
         if victims:
             # counted HERE, not after the step: evicting every ready
             # sequence aborts the step below, and those evictions must
@@ -455,6 +501,18 @@ class ServingEngine:
         with metrics.phase("compute"):
             toks = self.runner.decode(self.cache, ids, positions, tables)
         cost = self.runner.decode_cost((b_bucket, p_bucket))
+        modeled_s = None
+        if cost and "flops" in cost:
+            from ..observability.cost_model import StepCost
+            sc = StepCost(flops=cost.get("flops", 0.0),
+                          hbm_bytes=cost.get("bytes accessed", 0.0))
+            modeled_s = sc.step_time_modeled_s()
+        # per-step span for the whole batch: each covered request's
+        # decode_compute grows by the modeled step cost — the SAME
+        # float the finish stamp below is built from, so the interval
+        # end and a final-step finish quantize identically
+        step_tids = [s.trace_id for s in active
+                     if s.trace_id is not None]
         if chaos.maybe_drop_decode_step(self.engine_id):
             # transient step failure: the tokens are discarded and NO
             # sequence state advances, so the next step recomputes the
@@ -462,35 +520,34 @@ class ServingEngine:
             # rewrite is idempotent) — retry costs one modeled step
             metrics.inc("serving_retries_total")
             _flight_record(event="decode_step_dropped",
-                           engine=self.engine_id,
+                           engine=self.engine_id, t=now,
+                           dur=modeled_s or 0.0,
+                           tids=step_tids or None,
+                           chaos="drop_decode_step",
                            step=self.decode_steps + 1)
             self.decode_steps += 1
             return {"bucket": (b_bucket, p_bucket),
                     "n_active": len(active), "tokens": 0,
                     "evictions": len(victims), "dropped": True,
                     "cost": cost}
-        modeled_s = None
-        if cost and "flops" in cost:
-            from ..observability.cost_model import StepCost
-            sc = StepCost(flops=cost.get("flops", 0.0),
-                          hbm_bytes=cost.get("bytes accessed", 0.0))
-            modeled_s = sc.step_time_modeled_s()
         # tokens exist at the step's END: finishing at `now` would cut
         # the final step's cost out of the virtual-clock makespan and
         # overstate the benched tokens/s
         done_at = now + (modeled_s or 0.0)
+        self.decode_steps += 1
+        _flight_record(event="decode_step", engine=self.engine_id,
+                       t=now, dur=modeled_s or 0.0,
+                       tids=step_tids or None,
+                       step=self.decode_steps, batch=len(active),
+                       bucket=[b_bucket, p_bucket])
         for i, s in enumerate(active):
             s.table.append_slot()
             s.tokens.append(int(toks[i]))
             if s.done:
                 self.scheduler.finish(s, done_at)
-        self.decode_steps += 1
         info = {"bucket": (b_bucket, p_bucket), "n_active": len(active),
                 "tokens": len(active), "evictions": len(victims),
                 "cost": cost}
-        _flight_record(event="decode_step", engine=self.engine_id,
-                       step=self.decode_steps, batch=len(active),
-                       bucket=[b_bucket, p_bucket])
         metrics.inc("serving_decode_tokens_total", len(active))
         self._gauge()
         extra = {"serving": 1,

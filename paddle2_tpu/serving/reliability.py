@@ -43,18 +43,28 @@ from typing import Callable, List, Optional, Sequence as Seq
 __all__ = [
     "ServingError", "RequestRejected", "QueueFullError",
     "PromptTooLongError", "DeadlineExceeded", "EngineFailedError",
-    "WeightSwapError", "ReliabilityConfig", "HotSwapController",
-    "flight_record",
+    "WeightSwapError", "ReliabilityConfig", "SLOConfig",
+    "HotSwapController", "flight_record",
 ]
 
 
 def flight_record(**fields) -> None:
     """One shared emitter for every serving flight-recorder span
     (``kind="serving"``) — scheduler, engine, router, and hot-swap all
-    route through here so the span format has a single owner. Inherits
-    the recorder's one-attribute-load no-op when disabled."""
+    route through here so the span format has a single owner. Spans
+    that carry a clock stamp (``t=``) and a trace id (``tid=`` /
+    ``tids=``) are mirrored into the request-tracing plane
+    (:mod:`~paddle2_tpu.observability.tracing`), so the flight ring,
+    the per-request traces, and the chrome view all share one set of
+    instrumentation sites and event names. Each plane inherits its own
+    one-attribute-load no-op when disabled."""
     from ..distributed.fault_tolerance import flight_recorder
+    from ..observability import tracing
+    # None-valued fields (an unstamped clock, an untraced request) are
+    # dropped rather than serialized as nulls in every dump
+    fields = {k: v for k, v in fields.items() if v is not None}
     flight_recorder.record("serving", **fields)
+    tracing.serving_span(fields)
 
 
 # ---------------------------------------------------------------- errors
@@ -100,6 +110,36 @@ class WeightSwapError(ServingError):
     half-applied."""
 
 
+# --------------------------------------------------------------- SLOs
+@dataclass
+class SLOConfig:
+    """Service-level objectives for one engine (ISSUE 13).
+
+    Targets are per-request, evaluated on the engine's clock at finish
+    time (virtual in the simulators — the SLO counters are then
+    bit-stable): TTFT (arrival -> first token), TPOT (mean seconds per
+    generated token after the first), and e2e latency. ``None`` skips
+    a dimension. A request is GOOD when every configured dimension
+    meets its target; shed / deadline-expired / failed requests are
+    BAD by definition (they consumed error budget without an answer).
+
+    The burn rate follows the SRE error-budget convention:
+    ``bad_fraction / (1 - availability_target)`` — 1.0 means the
+    budget burns exactly at the sustainable rate, above 1.0 the budget
+    exhausts early. Exported through the metrics plane as
+    ``serving_slo_{good,bad}_total`` counters (plus per-dimension
+    ``serving_slo_checks_total{slo=...,verdict=...}``) and the
+    ``serving_slo_burn_rate`` gauge."""
+    ttft_target_s: Optional[float] = None
+    tpot_target_s: Optional[float] = None
+    e2e_target_s: Optional[float] = None
+    availability_target: float = 0.99
+
+    @property
+    def error_budget(self) -> float:
+        return max(1.0 - float(self.availability_target), 1e-9)
+
+
 # ---------------------------------------------------- admission control
 @dataclass
 class ReliabilityConfig:
@@ -108,7 +148,8 @@ class ReliabilityConfig:
     ``max_queue_depth=None`` keeps the PR 9 unbounded-queue behavior;
     everything else only matters once a bound is set. Priorities are
     ints, HIGHER = more important. ``default_deadline_s`` is relative
-    to each request's ``arrival_t`` (virtual clock)."""
+    to each request's ``arrival_t`` (virtual clock). ``slo`` opts the
+    engine into per-request :class:`SLOConfig` accounting."""
     max_queue_depth: Optional[int] = None
     default_priority: int = 0
     default_deadline_s: Optional[float] = None
@@ -116,6 +157,7 @@ class ReliabilityConfig:
     # make room for a strictly-higher-priority arrival (False =
     # always reject the arrival when full)
     shed_on_full: bool = True
+    slo: Optional[SLOConfig] = None
 
     def deadline_for(self, arrival_t: float,
                      deadline_s: Optional[float]) -> Optional[float]:

@@ -64,13 +64,19 @@ class Request:
 
     ``priority`` (higher = more important) and ``deadline_t``
     (ABSOLUTE virtual-clock stamp, None = none) drive the admission
-    controller; both default to the PR 9 don't-care values."""
+    controller; both default to the PR 9 don't-care values.
+    ``trace_id`` is the STABLE identity the request-tracing plane keys
+    spans by: ``req_id`` is re-keyed when a failover adoption moves
+    the sequence to another engine, ``trace_id`` never changes (the
+    engine defaults it to the original ``req_id``; the router stamps
+    its fleet-global id)."""
     req_id: int
     prompt: List[int]
     max_new_tokens: int
     arrival_t: float = 0.0
     priority: int = 0
     deadline_t: Optional[float] = None
+    trace_id: Optional[int] = None
 
 
 class SeqState(enum.Enum):
@@ -113,6 +119,10 @@ class Sequence:
     @property
     def req_id(self) -> int:
         return self.request.req_id
+
+    @property
+    def trace_id(self) -> Optional[int]:
+        return self.request.trace_id
 
     @property
     def priority(self) -> int:
@@ -194,12 +204,17 @@ class ContinuousBatchingScheduler:
         self.config = config
         self.allocator = allocator
         self.reliability = config.reliability or ReliabilityConfig()
+        self.engine_id = 0          # mirrored by the owning engine
         self.waiting: List[Sequence] = []
         self._running: List[Sequence] = []      # admission order
         self.finished: List[Sequence] = []
         self.shed: List[Sequence] = []
         self.total_evictions = 0
         self.total_shed = 0
+        # SLO ledger (reliability.slo opt-in): good/bad request counts
+        # driving the burn-rate gauge
+        self.slo_good = 0
+        self.slo_bad = 0
 
     # -- introspection ---------------------------------------------------
     def running(self) -> List[Sequence]:
@@ -245,10 +260,12 @@ class ContinuousBatchingScheduler:
                     f"{seq.priority}")
             self._shed(victim, QueueFullError(
                 f"shed (priority {victim.priority}) for arrival "
-                f"req {seq.req_id} (priority {seq.priority})"))
+                f"req {seq.req_id} (priority {seq.priority})"),
+                now=seq.request.arrival_t)
         self.waiting.append(seq)
 
-    def requeue_front(self, seq: Sequence) -> None:
+    def requeue_front(self, seq: Sequence, now: Optional[float] = None,
+                      cause: str = "evict") -> None:
         """Put a previously-admitted sequence back at the FRONT of the
         queue (eviction, corruption recovery, engine-failover
         adoption): preempted work resumes before new arrivals and is
@@ -256,14 +273,17 @@ class ContinuousBatchingScheduler:
         seq.state = SeqState.WAITING
         self.waiting.insert(0, seq)
         _flight_record(event="requeue", req=seq.req_id,
-                       tokens=len(seq.tokens))
+                       tid=seq.trace_id, t=now, cause=cause,
+                       engine=self.engine_id, tokens=len(seq.tokens))
 
     # -- load shedding ---------------------------------------------------
-    def _shed(self, seq: Sequence, err: ServingError) -> None:
+    def _shed(self, seq: Sequence, err: ServingError,
+              now: Optional[float] = None) -> None:
         self.waiting.remove(seq)
-        self.mark_shed(seq, err)
+        self.mark_shed(seq, err, now=now)
 
-    def mark_shed(self, seq: Sequence, err: ServingError) -> None:
+    def mark_shed(self, seq: Sequence, err: ServingError,
+                  now: Optional[float] = None) -> None:
         """Shed bookkeeping for a sequence NOT in the waiting queue —
         e.g. a recovered fresh arrival the adopting engine's bounded
         queue refuses at failover time."""
@@ -277,7 +297,11 @@ class ContinuousBatchingScheduler:
         metrics.inc("serving_shed_total", reason=reason)
         if reason == "deadline":
             metrics.inc("serving_deadline_exceeded_total")
-        _flight_record(event="shed", req=seq.req_id, reason=reason,
+        if self.reliability.slo is not None:
+            # a shed request consumed error budget without an answer
+            self._note_slo_verdict(False)
+        _flight_record(event="shed", req=seq.req_id, tid=seq.trace_id,
+                       t=now, reason=reason, engine=self.engine_id,
                        priority=seq.priority)
 
     def expire_deadlines(self, now: float) -> List[Sequence]:
@@ -293,7 +317,7 @@ class ContinuousBatchingScheduler:
         for s in expired:
             self._shed(s, DeadlineExceeded(
                 f"req {s.req_id} deadline {s.deadline_t:.6f} < now "
-                f"{now:.6f} before admission"))
+                f"{now:.6f} before admission"), now=now)
         return expired
 
     # -- admission -------------------------------------------------------
@@ -326,7 +350,8 @@ class ContinuousBatchingScheduler:
             spent += need_tokens
             admitted.append(seq)
             _flight_record(event="admit", req=seq.req_id,
-                           tokens=need_tokens,
+                           tid=seq.trace_id, t=now, tokens=need_tokens,
+                           engine=self.engine_id,
                            blocks=len(seq.table.blocks))
         return admitted
 
@@ -335,12 +360,13 @@ class ContinuousBatchingScheduler:
         self._running.append(seq)
 
     # -- decode-step block reservation ----------------------------------
-    def reserve_decode_slots(self, seqs: Optional[List[Sequence]] = None
+    def reserve_decode_slots(self, seqs: Optional[List[Sequence]] = None,
+                             now: Optional[float] = None
                              ) -> List[Sequence]:
         """Make sure every sequence in ``seqs`` (default: all running)
         has a block slot for the token the next decode step appends,
         evicting LIFO on exhaustion. Returns the evicted sequences
-        (already requeued)."""
+        (already requeued). ``now`` stamps the eviction spans."""
         victims: List[Sequence] = []
         todo = list(self._running) if seqs is None else list(seqs)
         i = 0
@@ -354,23 +380,25 @@ class ContinuousBatchingScheduler:
                 i += 1
             except OutOfBlocksError:
                 victim = self._running[-1]
-                self._evict(victim)
+                self._evict(victim, now=now)
                 victims.append(victim)
                 if victim is seq:
                     continue    # re-check the same index (list shrank)
         return victims
 
-    def _evict(self, seq: Sequence) -> None:
+    def _evict(self, seq: Sequence, now: Optional[float] = None) -> None:
         self._running.remove(seq)
         seq.table.release()
         seq.evictions += 1
         self.total_evictions += 1
-        _flight_record(event="evict", req=seq.req_id,
+        _flight_record(event="evict", req=seq.req_id, tid=seq.trace_id,
+                       t=now, engine=self.engine_id,
                        evictions=seq.evictions)
         # front of the queue: preempted work resumes before new arrivals
-        self.requeue_front(seq)
+        self.requeue_front(seq, now=now, cause="evict")
 
-    def requeue_corrupt(self, seq: Sequence) -> None:
+    def requeue_corrupt(self, seq: Sequence,
+                        now: Optional[float] = None) -> None:
         """Pull a RUNNING sequence whose block table can no longer be
         trusted (chaos ``corrupt_block_table``, a real scribble): the
         table is REBOUND to a fresh empty one instead of released —
@@ -380,7 +408,7 @@ class ContinuousBatchingScheduler:
         self._running.remove(seq)
         seq.rebind(self.allocator)
         seq.recoveries += 1
-        self.requeue_front(seq)
+        self.requeue_front(seq, now=now, cause="corrupt")
 
     # -- completion ------------------------------------------------------
     def finish(self, seq: Sequence, now: float = 0.0) -> None:
@@ -389,6 +417,54 @@ class ContinuousBatchingScheduler:
         seq.state = SeqState.FINISHED
         seq.finish_t = now
         self.finished.append(seq)
+        self._note_slo(seq, now)
+        _flight_record(event="finish", req=seq.req_id, tid=seq.trace_id,
+                       t=now, engine=self.engine_id,
+                       tokens=len(seq.generated))
+
+    # -- SLO accounting --------------------------------------------------
+    def _note_slo(self, seq: Sequence, now: float) -> None:
+        """Evaluate the engine's SLO targets against one FINISHED
+        request (reliability.slo opt-in): TTFT, TPOT, e2e — all on the
+        caller's clock, so the verdicts are as deterministic as the
+        clock. Per-dimension verdicts and the good/bad totals flow
+        through the metrics plane; the burn-rate gauge follows."""
+        slo = self.reliability.slo
+        if slo is None:
+            return
+        from ..observability import metrics
+        arrival = seq.request.arrival_t
+        first = seq.first_token_t if seq.first_token_t is not None else now
+        gen = len(seq.generated)
+        dims = {
+            "ttft": (slo.ttft_target_s, first - arrival),
+            "tpot": (slo.tpot_target_s,
+                     (now - first) / (gen - 1) if gen > 1 else 0.0),
+            "e2e": (slo.e2e_target_s, now - arrival),
+        }
+        good = True
+        for name, (target, value) in dims.items():
+            if target is None:
+                continue
+            ok = value <= target
+            good = good and ok
+            metrics.inc("serving_slo_checks_total", slo=name,
+                        verdict="good" if ok else "bad")
+        self._note_slo_verdict(good)
+
+    def _note_slo_verdict(self, good: bool) -> None:
+        from ..observability import metrics
+        slo = self.reliability.slo
+        if good:
+            self.slo_good += 1
+            metrics.inc("serving_slo_good_total")
+        else:
+            self.slo_bad += 1
+            metrics.inc("serving_slo_bad_total")
+        total = self.slo_good + self.slo_bad
+        bad_frac = self.slo_bad / total if total else 0.0
+        metrics.set_gauge("serving_slo_burn_rate",
+                          bad_frac / slo.error_budget)
 
     # -- bucket shape of the current batch -------------------------------
     def decode_bucket(self, seqs: Optional[List[Sequence]] = None

@@ -87,6 +87,9 @@ class ServingSimReport:
     decode_programs: int = 0
     program_budget: int = 0
     mean_batch_occupancy: float = 0.0
+    # total modeled FLOPs executed (prefills + decode steps): the
+    # denominator of the deterministic tracing-overhead gate
+    modeled_flops: float = 0.0
 
     def finalize(self, first_arrival: float, last_finish: float):
         self.makespan_s = max(last_finish - first_arrival, 1e-12)
@@ -133,11 +136,15 @@ def simulate_serving(engine, trace: List[dict],
             prefill_clock = start + cost_seconds(info["cost"])
             return prefill_clock
 
-        engine.admit_and_prefill(decode_clock, ready_at_fn=lane_ready)
+        infos = engine.admit_and_prefill(decode_clock,
+                                         ready_at_fn=lane_ready)
+        rep.modeled_flops += sum(
+            (i["cost"] or {}).get("flops", 0.0) for i in infos)
 
         step = engine.decode_once(decode_clock)
         if step is not None:
             decode_clock += cost_seconds(step["cost"])
+            rep.modeled_flops += (step["cost"] or {}).get("flops", 0.0)
             occupancy.append(step["n_active"]
                              / engine.scheduler.config.max_batch)
         else:
@@ -258,10 +265,11 @@ class EngineFailoverRouter:
         Typed rejections (queue full, prompt too long) propagate from
         the target engine."""
         idx = self._pick(session)
+        rid = self._next_rid
         local = self.engines[idx].submit(
             prompt, max_new_tokens, arrival_t=arrival_t,
-            priority=priority, deadline_s=deadline_s)
-        rid = self._next_rid
+            priority=priority, deadline_s=deadline_s,
+            trace_id=rid)      # fleet-global span identity
         self._next_rid += 1
         self._seqs[rid] = self.engines[idx].sequence(local)
         self._home[rid] = idx
@@ -342,13 +350,15 @@ class EngineFailoverRouter:
             inflight = [s for s in seqs if eng.scheduler._in_flight(s)]
             fresh = [s for s in seqs if not eng.scheduler._in_flight(s)]
             for seq in list(reversed(inflight)) + fresh:
-                eng.adopt(seq)
+                eng.adopt(seq, now=now)
                 if id(seq) in rid_of:       # keep home_of() truthful
                     self._home[rid_of[id(seq)]] = idx
         metrics.inc("serving_failovers_total")
         flight_record(
             event="failover", engine=dead_idx, t=now,
             failed_t=dead.failed_t, recovered=len(recovered),
+            tids=[s.trace_id for s in recovered
+                  if s.trace_id is not None] or None,
             targets={str(k): len(v) for k, v in targets.items()})
         self.failovers.append({
             "engine": dead_idx, "failed_t": dead.failed_t,
@@ -456,13 +466,17 @@ def simulate_router(router: EngineFailoverRouter, trace: List[dict],
         for idx in router.alive():
             eng = router.engines[idx]
             try:
-                eng.admit_and_prefill(clock,
-                                      ready_at_fn=lane_ready_fn(idx, clock))
+                infos = eng.admit_and_prefill(
+                    clock, ready_at_fn=lane_ready_fn(idx, clock))
+                rep.modeled_flops += sum(
+                    (i["cost"] or {}).get("flops", 0.0) for i in infos)
                 step = eng.decode_once(clock)
             except EngineFailedError:
                 continue            # died this round; next probe sees it
             if step is not None:
                 costs.append(cost_seconds(step["cost"]))
+                rep.modeled_flops += (step["cost"] or {}).get(
+                    "flops", 0.0)
         router.note_recovery(clock)
         if not router.alive():
             # total fleet death: nothing can ever serve the remainder

@@ -487,7 +487,10 @@ def format_report(report: Dict[str, Any], directory: str) -> str:
 def _format_serving(report: Dict[str, Any]) -> List[str]:
     """SERVING section: what the serving reliability plane recorded —
     admit/evict/requeue/shed counts, decode steps, engine failures,
-    failovers, hot-swap stages — plus the newest events verbatim."""
+    failovers, hot-swap stages — plus the newest events with their
+    trace id and clock stamp leading, so a flight dump JOINS the
+    request-tracing streams (``serve_doctor``'s trace_rank_N.jsonl)
+    on ``tid``/``t`` instead of dead-ending at per-event counts."""
     sv = report.get("serving") or {}
     if not sv:
         return []
@@ -495,11 +498,22 @@ def _format_serving(report: Dict[str, Any]) -> List[str]:
     counts = sv.get("counts") or {}
     L.append("  events: " + " ".join(f"{k}={counts[k]}"
                                      for k in sorted(counts)))
-    for ev in (sv.get("last") or [])[-5:]:
+    for ev in (sv.get("last") or [])[-10:]:
         rank = ev.get("rank", "?")
+        # the JOIN KEYS lead: tid (stable across failover re-keying,
+        # shared with the trace streams) and the virtual-clock stamp
+        join = []
+        if "tid" in ev:
+            join.append(f"tid={ev['tid']}")
+        elif "tids" in ev:
+            join.append(f"tids={ev['tids']}")
+        if "t" in ev:
+            join.append(f"t={ev['t']:.9f}")
         detail = " ".join(f"{k}={ev[k]}" for k in sorted(ev)
-                          if k not in ("rank", "event"))
-        L.append(f"  rank {rank}: {ev.get('event', '?')} {detail}")
+                          if k not in ("rank", "event", "tid", "tids",
+                                       "t"))
+        L.append(f"  rank {rank}: {ev.get('event', '?')} "
+                 + " ".join(join + [detail]).strip())
     return L
 
 

@@ -72,6 +72,9 @@ _RELIABILITY_COUNTERS = (
     "serving_engine_failures_total", "serving_failovers_total",
     "serving_recovered_seqs_total", "serving_table_corruptions_total",
     "serving_hot_swaps_total",
+    # SLO ledger (ISSUE 13): good/bad requests against the configured
+    # TTFT/TPOT/e2e targets — the burn-rate gauge rides the snapshot
+    "serving_slo_good_total", "serving_slo_bad_total",
 )
 
 
@@ -179,6 +182,82 @@ def load_trace_steps(trace_path: Optional[str]) -> Dict[str, Any]:
 
 
 # ---------------------------------------------------------------- analysis
+def hist_quantile(buckets: List[Optional[float]], counts: List[float],
+                  q: float) -> Optional[float]:
+    """Prometheus-style ``histogram_quantile``: cumulative per-bucket
+    counts (``None`` upper bound = +Inf) -> the ``q``-quantile
+    estimate, linearly interpolated inside the owning bucket. The
+    +Inf bucket returns the highest finite bound (the standard
+    convention — the true value is only known to be beyond it)."""
+    if not counts or counts[-1] <= 0 or len(buckets) != len(counts):
+        return None
+    total = counts[-1]
+    target = q / 100.0 * total
+    prev_cum, prev_ub = 0.0, 0.0
+    for ub, cum in zip(buckets, counts):
+        if cum >= target:
+            if ub is None:                 # +Inf bucket owns it
+                return prev_ub
+            in_bucket = cum - prev_cum
+            if in_bucket <= 0:
+                return float(ub)
+            frac = (target - prev_cum) / in_bucket
+            return prev_ub + (float(ub) - prev_ub) * frac
+        prev_cum = cum
+        if ub is not None:
+            prev_ub = float(ub)
+    return prev_ub
+
+
+def histogram_lanes(streams: Dict[int, Dict[str, Any]]
+                    ) -> Dict[str, Dict[str, Any]]:
+    """Merge every rank's newest histogram snapshot into p50/p99 lanes
+    (bucket counts are cumulative AND mergeable: same bucket layout ->
+    element-wise sum). Series without bucket counts (pre-ISSUE-13
+    streams) are skipped — sum/count alone cannot give percentiles."""
+    merged: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for s in streams.values():
+        hists = (s.get("snapshot") or {}).get("histograms") or {}
+        for name, series in hists.items():
+            if not isinstance(series, dict):
+                continue
+            for labels, h in series.items():
+                if not isinstance(h, dict) or "counts" not in h:
+                    continue
+                key = (name, labels)
+                m = merged.get(key)
+                if m is None:
+                    merged[key] = {"buckets": list(h["buckets"]),
+                                   "counts": list(h["counts"]),
+                                   "sum": float(h.get("sum", 0.0)),
+                                   "count": float(h.get("count", 0)),
+                                   "skipped_series": 0}
+                elif m["buckets"] == list(h["buckets"]):
+                    m["counts"] = [a + b for a, b in
+                                   zip(m["counts"], h["counts"])]
+                    m["sum"] += float(h.get("sum", 0.0))
+                    m["count"] += float(h.get("count", 0))
+                else:
+                    # mismatched bucket layout (mixed builds): counts
+                    # cannot merge — SAY so instead of silently
+                    # presenting one rank's view as the fleet's
+                    m["skipped_series"] += 1
+    out: Dict[str, Dict[str, Any]] = {}
+    for (name, labels), m in sorted(merged.items()):
+        if m["count"] <= 0:
+            continue
+        key = f"{name}{{{labels}}}" if labels else name
+        out[key] = {
+            "count": m["count"],
+            "mean": m["sum"] / m["count"],
+            "p50": hist_quantile(m["buckets"], m["counts"], 50.0),
+            "p99": hist_quantile(m["buckets"], m["counts"], 99.0),
+        }
+        if m["skipped_series"]:
+            out[key]["skipped_series"] = m["skipped_series"]
+    return out
+
+
 def _mean(vals: List[float]) -> float:
     return statistics.fmean(vals) if vals else 0.0
 
@@ -316,6 +395,10 @@ def summarize(streams: Dict[int, Dict[str, Any]],
                 _COMPONENT_LABEL[c]: 100.0 * agg[f"mean_{c}"]
                 / agg["mean_total_s"] for c in COMPONENTS}
         report["aggregate"] = agg
+
+    # histogram p50/p99 lanes from the cumulative bucket counts the
+    # snapshots carry (checkpoint-save seconds, serving TTFT, ...)
+    report["histograms"] = histogram_lanes(streams)
 
     # straggler + slow-input attribution (>= 2 ranks to compare)
     if len(totals_by_rank) >= 2:
@@ -493,6 +576,17 @@ def format_summary(report: Dict[str, Any], directory: str) -> str:
         L.append("RELIABILITY COUNTERS")
         for name, v in sorted(report["counters"].items()):
             L.append(f"  {name}: {v:g}")
+    if report.get("histograms"):
+        L.append("HISTOGRAMS (p50/p99 from cumulative bucket counts)")
+        for name, h in report["histograms"].items():
+            p50 = _fmt_s(h["p50"]) if h["p50"] is not None else "n/a"
+            p99 = _fmt_s(h["p99"]) if h["p99"] is not None else "n/a"
+            tag = (f"  [INCOMPLETE: {h['skipped_series']} series "
+                   f"with a different bucket layout skipped]"
+                   if h.get("skipped_series") else "")
+            L.append(f"  {name}: n={h['count']:g} "
+                     f"mean={_fmt_s(h['mean'])} p50~{p50} p99~{p99}"
+                     f"{tag}")
     s = report.get("straggler", {})
     st = s.get("step_time", {})
     si = s.get("input_wait", {})
