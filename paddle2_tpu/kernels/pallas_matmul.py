@@ -15,6 +15,14 @@ Two dtype families:
   :func:`weight_quant_error_bound` computes it and the bench gate
   asserts it holds AND is non-vacuous (a mis-scaled payload violates
   it).
+* **int4 weight-only** (ISSUE 14 satellite, ROADMAP item 4) — the same
+  machinery at ``quant_bits=4``: :func:`pack_int4` stores two weights
+  per byte (QUARTER the bf16 HBM bytes — decode is weight-bandwidth
+  bound, so this is the aggressive end of the same trade), and
+  :func:`weight_quant_error_bound` generalizes unchanged — the bench
+  gates the 4-bit bound both HOLDS (f64 reference) and is NON-VACUOUS
+  (a 2-bit payload must violate it, and it must beat the trivial
+  ``|y|`` bound).
 * **int8 x int8** — both operands int8, int32 MXU accumulation (2x the
   bf16 rate on v5e), dequantized at the epilogue: the
   ``QuantedInferenceLinear`` full-int8 path as a Pallas kernel.
@@ -93,6 +101,54 @@ def weight_quant_error_bound(x, w_scale, quant_bits: int = 8):
     l1 = jnp.sum(jnp.abs(x.astype(jnp.float32)), axis=-1,
                  keepdims=True)
     return l1 * (w_scale.astype(jnp.float32) / (2.0 * qmax))
+
+
+# ---------------------------------------------------------- int4 storage
+def pack_int4(w_q):
+    """Pack a ``[K, N]`` int4-valued int8 array (values in [-7, 7])
+    into ``[K, N/2]`` uint8 nibbles (even column in the low nibble) —
+    QUARTER the bf16 weight bytes in HBM. N must be even. The compute
+    paths consume the unpacked int8 form (the MXU has no int4 lanes on
+    this generation; the win is bandwidth, which is what decode and
+    lm_head matmuls are bound by)."""
+    w_q = jnp.asarray(w_q, jnp.int8)
+    if w_q.shape[-1] % 2:
+        raise ValueError("pack_int4 needs an even out-channel count")
+    lo = (w_q[..., 0::2] & 0xF).astype(jnp.uint8)
+    hi = (w_q[..., 1::2] & 0xF).astype(jnp.uint8)
+    return lo | (hi << 4)
+
+
+def unpack_int4(packed, n: int):
+    """Inverse of :func:`pack_int4`: ``[K, N/2]`` uint8 -> ``[K, N]``
+    sign-extended int8 (values in [-8, 7])."""
+    packed = jnp.asarray(packed, jnp.uint8)
+    lo = (packed & 0xF).astype(jnp.int8)
+    hi = ((packed >> 4) & 0xF).astype(jnp.int8)
+
+    def sext(v):
+        return jnp.where(v >= 8, v - 16, v).astype(jnp.int8)
+
+    out = jnp.stack([sext(lo), sext(hi)], axis=-1)
+    return out.reshape(packed.shape[:-1] + (2 * packed.shape[-1],))[..., :n]
+
+
+def int4_weight_only_matmul(x, w_packed, w_scale, bias=None,
+                            block_m: int = DEFAULT_BLOCK_M,
+                            block_n: int = DEFAULT_BLOCK_N,
+                            block_k: int = DEFAULT_BLOCK_K,
+                            interpret: Optional[bool] = None):
+    """int4 weight-only ``x @ dequant(W)``: unpack the nibble payload
+    in-register and run the shared weight-only path at
+    ``quant_bits=4`` (the PR 10 error-bound machinery generalizes —
+    ``weight_quant_error_bound(x, s, quant_bits=4)`` bounds THIS
+    product, and the bench gates it non-vacuous). ``w_packed``:
+    ``[K, N/2]`` uint8 from :func:`pack_int4`; ``w_scale``: ``[N]``."""
+    n = 2 * w_packed.shape[-1]
+    w_q = unpack_int4(w_packed, n)
+    return int8_weight_only_matmul(
+        x, w_q, w_scale, bias=bias, quant_bits=4, block_m=block_m,
+        block_n=block_n, block_k=block_k, interpret=interpret)
 
 
 # ------------------------------------------------- int8 weight-only kernel
@@ -265,5 +321,6 @@ def fp8_matmul(x, w, interpret: Optional[bool] = None):
 
 __all__ = ["channel_absmax", "quantize_channelwise",
            "weight_quant_error_bound", "int8_weight_only_matmul",
+           "int4_weight_only_matmul", "pack_int4", "unpack_int4",
            "int8_matmul", "fp8_matmul", "fp8_supported", "wo_supported",
            "DEFAULT_BLOCK_M", "DEFAULT_BLOCK_N", "DEFAULT_BLOCK_K"]

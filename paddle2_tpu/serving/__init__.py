@@ -31,11 +31,13 @@ Entry points:
 """
 
 from .block_cache import (BlockAllocator, BlockTable, PagedKVCache,
-                          blocks_for_tokens, GARBAGE_BLOCK)
+                          PrefixCache, blocks_for_tokens, GARBAGE_BLOCK)
 from .block_cache import OutOfBlocksError, BlockFreeError
 from .paged_attention import (paged_attention_decode,
                               paged_attention_reference,
+                              paged_attention_split_reference,
                               gathered_dense_kv)
+from .spec import SpeculativeConfig, ngram_draft, accept_drafts
 from .reliability import (ServingError, RequestRejected, QueueFullError,
                           PromptTooLongError, DeadlineExceeded,
                           EngineFailedError, WeightSwapError,
@@ -50,10 +52,12 @@ from .simulate import (ServingSimReport, simulate_serving,
                        simulate_router)
 
 __all__ = [
-    "BlockAllocator", "BlockTable", "PagedKVCache", "blocks_for_tokens",
+    "BlockAllocator", "BlockTable", "PagedKVCache", "PrefixCache",
+    "blocks_for_tokens",
     "GARBAGE_BLOCK", "OutOfBlocksError", "BlockFreeError",
     "paged_attention_decode", "paged_attention_reference",
-    "gathered_dense_kv",
+    "paged_attention_split_reference", "gathered_dense_kv",
+    "SpeculativeConfig", "ngram_draft", "accept_drafts",
     "ServingError", "RequestRejected", "QueueFullError",
     "PromptTooLongError", "DeadlineExceeded", "EngineFailedError",
     "WeightSwapError", "ReliabilityConfig", "SLOConfig",

@@ -25,16 +25,46 @@ Host/device split:
 Block 0 is RESERVED as the garbage block: padded (inactive) rows of a
 bucketed decode batch point their table entries at it, so their
 writes land somewhere harmless and never clobber a live sequence.
+
+**Copy-on-write sharing (ISSUE 14 / ROADMAP 2(a)).** Every allocated
+block carries a REFCOUNT. ``allocate`` hands out blocks at refcount 1;
+:meth:`BlockAllocator.share` adds an owner; ``free`` drops one
+reference and only returns the block to the free list at refcount 0 —
+so releasing a sequence that shares a system-prompt prefix can never
+yank blocks out from under its siblings (eviction of a shared block is
+DEFERRED by construction). Shared blocks are always FULL blocks
+(appends only ever touch a private tail), which is what makes sharing
+read-only and therefore exact:
+
+* :class:`PrefixCache` — content-addressed cache of full prompt-prefix
+  blocks, keyed by the block-aligned token prefix itself (a chain of
+  prefix tuples, so identical content under different prefixes never
+  conflates). A lookup shares the longest cached prefix into a new
+  sequence's table; the cache holds its OWN reference on every cached
+  block, so finished sequences leave their prefix KV resident. LRU
+  eviction reclaims cache-only (refcount-1) blocks when the allocator
+  runs dry — via the allocator's reclaimer hook, so schedulers see the
+  reclaimable headroom without knowing the cache exists.
+* :meth:`BlockTable.fork` — CoW duplication of a live sequence: full
+  blocks are shared (refcount bump), ONLY the partial tail block is
+  copied (:meth:`PagedKVCache.copy_block` moves the device bytes), so
+  a fork costs at most one block regardless of context length.
+* :meth:`BlockAllocator.rebuild_free_list` recomputes refcounts as
+  claim MULTIPLICITY across the surviving tables (+ the cache's
+  holds): a block claimed by two survivors is legitimately shared
+  state, not corruption — the PR 11 recovery path understands sharing.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 __all__ = ["BlockAllocator", "BlockTable", "PagedKVCache",
-           "blocks_for_tokens", "GARBAGE_BLOCK", "BlockFreeError"]
+           "PrefixCache", "blocks_for_tokens", "GARBAGE_BLOCK",
+           "BlockFreeError"]
 
 # physical block id every padded/inactive batch row writes into
 GARBAGE_BLOCK = 0
@@ -79,6 +109,16 @@ class BlockAllocator:
         # pool slots are warm in cache on real hardware)
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self.high_water = 0
+        # CoW plane: per-block reference count (absent/0 = free).
+        # total_allocated counts allocate() handouts MONOTONICALLY and
+        # NOT share() bumps — it is the "KV bytes actually materialized"
+        # numerator the prefix-cache bench gate divides by requests.
+        self._rc: Dict[int, int] = {}
+        self.total_allocated = 0
+        # optional reclaimer (the PrefixCache): consulted when the free
+        # list alone cannot cover a request — must expose
+        # reclaimable() -> int and reclaim(n) -> int
+        self._reclaimer = None
 
     @property
     def free_count(self) -> int:
@@ -88,27 +128,68 @@ class BlockAllocator:
     def used_count(self) -> int:
         return (self.num_blocks - 1) - len(self._free)
 
+    def refcount(self, block: int) -> int:
+        """Current owner count of ``block`` (0 = on the free list)."""
+        return self._rc.get(int(block), 0)
+
+    def set_reclaimer(self, reclaimer) -> None:
+        """Install the cache that can give blocks back on demand
+        (``reclaimable()``/``reclaim(n)`` protocol; None clears)."""
+        self._reclaimer = reclaimer
+
+    def _reclaimable(self) -> int:
+        return self._reclaimer.reclaimable() if self._reclaimer else 0
+
     def can_allocate(self, n: int) -> bool:
-        return n <= len(self._free)
+        return n <= len(self._free) + self._reclaimable()
 
     def allocate(self, n: int = 1) -> List[int]:
+        if n > len(self._free) and self._reclaimer is not None:
+            # cached prefix blocks nobody references are headroom, not
+            # occupancy: LRU-evict just enough of them
+            self._reclaimer.reclaim(n - len(self._free))
         if n > len(self._free):
             raise OutOfBlocksError(
                 f"need {n} blocks, {len(self._free)} free "
                 f"(of {self.num_blocks - 1} usable)")
         out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._rc[b] = 1
+        self.total_allocated += n
         self.high_water = max(self.high_water, self.used_count)
         return out
 
+    def share(self, blocks: List[int]) -> List[int]:
+        """Add one owner to each (already-allocated) block — the CoW
+        primitive behind prefix hits and :meth:`BlockTable.fork`.
+        Validates every id BEFORE bumping anything (sharing a free or
+        out-of-range block would be silent cross-request KV bleed)."""
+        blocks = [int(b) for b in blocks]
+        for b in blocks:
+            if b == GARBAGE_BLOCK:
+                raise BlockFreeError(
+                    f"share of reserved garbage block {GARBAGE_BLOCK}")
+            if not (0 < b < self.num_blocks):
+                raise BlockFreeError(f"bad block id {b} (usable range "
+                                     f"1..{self.num_blocks - 1})")
+            if self._rc.get(b, 0) < 1:
+                raise BlockFreeError(
+                    f"share of unallocated block {b}")
+        for b in blocks:
+            self._rc[b] += 1
+        return blocks
+
     def free(self, blocks: List[int]) -> None:
-        """Return blocks to the free list. Every id is validated
-        BEFORE any mutation: out-of-range, the reserved garbage block
+        """Drop one reference per block; blocks reaching refcount 0
+        return to the free list. Every id is validated BEFORE any
+        mutation: out-of-range, the reserved garbage block
         (:data:`GARBAGE_BLOCK`), already-free ids, and duplicates
         inside ``blocks`` itself all raise :class:`BlockFreeError`
         instead of silently corrupting the LIFO free list (a corrupt
         list hands the same block to two sequences — cross-request KV
-        bleed, the worst silent failure a serving engine can have)."""
-        free_now = set(self._free)
+        bleed, the worst silent failure a serving engine can have).
+        A shared block survives the free with one owner fewer — the
+        deferred-eviction contract."""
         seen = set()
         for b in blocks:
             if b == GARBAGE_BLOCK:
@@ -117,33 +198,45 @@ class BlockAllocator:
             if not (0 < b < self.num_blocks):
                 raise BlockFreeError(f"bad block id {b} (usable range "
                                      f"1..{self.num_blocks - 1})")
-            if b in free_now:
+            if self._rc.get(b, 0) < 1:
                 raise BlockFreeError(f"double free of block {b}")
             if b in seen:
                 raise BlockFreeError(
                     f"block {b} appears twice in one free() call")
             seen.add(b)
-        self._free.extend(blocks)
+        for b in blocks:
+            self._rc[b] -= 1
+            if self._rc[b] == 0:
+                del self._rc[b]
+                self._free.append(b)
 
     def rebuild_free_list(self, live_block_lists) -> None:
-        """Recovery path: recompute the free list as everything NOT
-        owned by the given live tables — used after a block-table
+        """Recovery path: recompute the free list — and the refcounts
+        — from the surviving claims. Used after a block-table
         corruption, when one table's ids can no longer be trusted
         enough to ``free()`` them (a corrupt id could double-free a
-        live block). Ground truth is the surviving tables; the
-        corrupted sequence's blocks implicitly return to the pool."""
-        used = set()
+        live block). Ground truth is the surviving tables (plus the
+        prefix cache's holds, which the engine passes as one more
+        claim list); a block claimed by SEVERAL survivors is
+        legitimately shared and its refcount is rebuilt as the claim
+        multiplicity. The corrupted sequence's blocks implicitly
+        return to the pool."""
+        claims: Dict[int, int] = {}
         for blocks in live_block_lists:
-            used.update(int(b) for b in blocks)
-        used.discard(GARBAGE_BLOCK)
-        bad = [b for b in used if not (0 < b < self.num_blocks)]
+            for b in blocks:
+                b = int(b)
+                if b == GARBAGE_BLOCK:
+                    continue
+                claims[b] = claims.get(b, 0) + 1
+        bad = [b for b in claims if not (0 < b < self.num_blocks)]
         if bad:
             raise BlockFreeError(
                 f"rebuild_free_list given out-of-range ids {bad} — "
                 f"survivors must be validated tables")
+        self._rc = dict(claims)
         self._free = [b for b in range(self.num_blocks - 1, 0, -1)
-                      if b not in used]
-        self.high_water = max(self.high_water, len(used))
+                      if b not in claims]
+        self.high_water = max(self.high_water, len(claims))
 
 
 class BlockTable:
@@ -172,16 +265,75 @@ class BlockTable:
 
     def append_slot(self) -> tuple:
         """(physical_block, offset) for the NEXT token, growing the
-        table if the current block is full. Bumps ``num_tokens``."""
+        table if the current block is full. Bumps ``num_tokens``.
+        Appending INTO a shared block (refcount > 1) is refused: the
+        CoW invariant is that shared blocks are always FULL (prefix
+        hits and forks only ever share whole blocks), so a shared
+        append target means the bookkeeping upstream is broken and a
+        write would bleed into a sibling sequence's KV."""
         self.ensure_capacity(self.num_tokens + 1)
         bs = self._alloc.block_size
-        slot = (self.blocks[self.num_tokens // bs],
-                self.num_tokens % bs)
+        target = self.blocks[self.num_tokens // bs]
+        if self.num_tokens % bs and self._alloc.refcount(target) > 1:
+            raise BlockFreeError(
+                f"append into shared block {target} (refcount "
+                f"{self._alloc.refcount(target)}) — shared blocks are "
+                f"read-only; fork() copies the partial tail")
+        slot = (target, self.num_tokens % bs)
         self.num_tokens += 1
         return slot
 
+    def attach_shared(self, blocks: List[int]) -> None:
+        """Adopt already-shared blocks (the caller — a prefix-cache
+        hit — bumped their refcounts) as this table's leading blocks.
+        Only valid on an EMPTY table: shared blocks are a prefix, by
+        construction."""
+        if self.blocks:
+            raise BlockFreeError(
+                "attach_shared on a non-empty table — shared prefix "
+                "blocks must come first")
+        self.blocks = [int(b) for b in blocks]
+
+    def fork(self) -> Tuple["BlockTable", Optional[Tuple[int, int]]]:
+        """Copy-on-write duplicate of this table: full blocks are
+        SHARED (refcount bump — zero bytes moved), only the partial
+        tail block is freshly allocated. Returns ``(new_table,
+        copy)`` where ``copy`` is ``(src_block, dst_block)`` for the
+        device-side tail copy the caller must perform
+        (:meth:`PagedKVCache.copy_block` on both pools), or ``None``
+        when the token count is block-aligned."""
+        bs = self._alloc.block_size
+        n_full = self.num_tokens // bs
+        new = BlockTable(self._alloc)
+        shared = self.blocks[:n_full]
+        if shared:
+            self._alloc.share(shared)
+        new.blocks = list(shared)
+        copy = None
+        if self.num_tokens % bs:
+            src = self.blocks[n_full]
+            dst = self._alloc.allocate(1)[0]
+            new.blocks.append(dst)
+            copy = (src, dst)
+        new.num_tokens = self.num_tokens
+        return new, copy
+
+    def truncate(self) -> List[int]:
+        """Roll back surplus tail blocks past what ``num_tokens``
+        needs — the speculative-decoding rejection path (a verify
+        round reserves ``k + 1`` slots up front; the rejected tail's
+        blocks go straight back). Returns the freed block ids."""
+        keep = blocks_for_tokens(self.num_tokens, self._alloc.block_size)
+        surplus = self.blocks[keep:]
+        if surplus:
+            self._alloc.free(surplus)
+            self.blocks = self.blocks[:keep]
+        return surplus
+
     def release(self) -> None:
-        """Free every block back to the allocator (eviction / finish)."""
+        """Drop this table's reference on every block (eviction /
+        finish); unshared blocks return to the allocator, shared ones
+        stay with their surviving owners."""
         if self.blocks:
             self._alloc.free(self.blocks)
         self.blocks = []
@@ -246,33 +398,59 @@ class PagedKVCache:
             else pool.at[layer, phys, slot].set(new_kv)
 
     @staticmethod
-    def scatter_prefill(pool, layer_kv, block_row, n_tokens, block_size):
+    def scatter_prefill(pool, layer_kv, block_row, n_tokens, block_size,
+                        start: int = 0):
         """Write a prefilled sequence's K/V into its blocks as ONE
         jitted scatter with the pool DONATED — the eager per-page
         ``.at[].set`` loop this replaces copied the ENTIRE pool once
         per page per lane (O(pool x pages) allocator traffic at
         production pool sizes). pool: [L, N, bs, H, D]; layer_kv:
         [L, T, H, D] (T >= n_tokens when the prefill ran padded);
-        block_row: int array [n_pages] physical ids. The tiny scatter
-        program is cached per (pool, T, n_tokens) signature."""
+        block_row: int array [n_pages] physical ids. ``start`` skips
+        the leading positions — a prefix-cache hit must NOT rewrite
+        the shared blocks it reads (their bytes belong to every
+        sharer), so only the private tail ``[start, n_tokens)`` is
+        scattered. The tiny scatter program is cached per
+        (pool, T, start, n_tokens) signature."""
         import jax
         import jax.numpy as jnp
-        idx = np.arange(int(n_tokens))
+        start = int(start)
+        if start >= int(n_tokens):
+            return pool
+        idx = np.arange(start, int(n_tokens))
         phys = jnp.asarray(np.asarray(block_row)[idx // block_size],
                            jnp.int32)
         slot = jnp.asarray(idx % block_size, jnp.int32)
         key = (tuple(pool.shape), str(pool.dtype),
-               tuple(layer_kv.shape), int(n_tokens))
+               tuple(layer_kv.shape), start, int(n_tokens))
         fn = _PREFILL_SCATTER_CACHE.get(key)
         if fn is None:
             n = int(n_tokens)
             fn = jax.jit(
-                lambda p, kv, ph, sl: p.at[:, ph, sl].set(kv[:, :n]),
+                lambda p, kv, ph, sl: p.at[:, ph, sl].set(
+                    kv[:, start:n]),
                 donate_argnums=(0,))
             if len(_PREFILL_SCATTER_CACHE) > 1024:
                 _PREFILL_SCATTER_CACHE.clear()
             _PREFILL_SCATTER_CACHE[key] = fn
         return fn(pool, layer_kv, phys, slot)
+
+    @staticmethod
+    def copy_block(pool, src: int, dst: int):
+        """Device-side CoW tail copy for :meth:`BlockTable.fork`:
+        ``pool[:, dst] = pool[:, src]`` across all layers, as one
+        jitted donated program (cached per pool signature)."""
+        import jax
+        import jax.numpy as jnp
+        key = ("copy", tuple(pool.shape), str(pool.dtype))
+        fn = _PREFILL_SCATTER_CACHE.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda p, s, d: p.at[:, d].set(p[:, s]),
+                donate_argnums=(0,))
+            _PREFILL_SCATTER_CACHE[key] = fn
+        return fn(pool, jnp.asarray(int(src), jnp.int32),
+                  jnp.asarray(int(dst), jnp.int32))
 
     @staticmethod
     def gather_dense(pool_layer, block_row, n_pages):
@@ -282,3 +460,141 @@ class PagedKVCache:
         idx = jnp.asarray(block_row[:n_pages], jnp.int32)
         g = pool_layer[idx]                      # [P, bs, H, D]
         return g.reshape((-1,) + g.shape[2:])
+
+
+class PrefixCache:
+    """Content-addressed cache of full prompt-prefix blocks (CoW
+    prefix sharing, the vLLM automatic-prefix-caching design).
+
+    Keying: block ``i`` of a prompt is cached under the TUPLE of the
+    first ``(i+1) * block_size`` tokens — a chain of prefix keys, so a
+    block's identity includes everything before it (the same 16 tokens
+    after two different prefixes hold DIFFERENT KV — position and
+    history are baked into the values). KV at a position depends only
+    on the tokens at and before it, so any request whose prompt starts
+    with a cached prefix can share those blocks bit-exactly.
+
+    Reference discipline: the cache holds its OWN reference on every
+    cached block (``share`` at insert), so cached KV survives its
+    inserting sequence. A block whose only reference is the cache's
+    (refcount 1) is *reclaimable*; the allocator's reclaimer hook
+    LRU-evicts exactly as many as a starved ``allocate`` needs. Blocks
+    still shared with live sequences (refcount > 1) are NEVER
+    reclaimed — eviction of a shared block is deferred until its last
+    sequence releases it.
+    """
+
+    def __init__(self, allocator: BlockAllocator,
+                 max_blocks: Optional[int] = None):
+        self._alloc = allocator
+        self.block_size = allocator.block_size
+        # prefix-key tuple -> block id; _lru tracks use recency for
+        # reclaim order (oldest first)
+        self._entries: Dict[tuple, int] = {}
+        self._lru: "OrderedDict[tuple, int]" = OrderedDict()
+        self.max_blocks = max_blocks
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        allocator.set_reclaimer(self)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def _keys(self, tokens) -> List[tuple]:
+        bs = self.block_size
+        return [tuple(tokens[:(i + 1) * bs])
+                for i in range(len(tokens) // bs)]
+
+    # -- lookup / insert -------------------------------------------------
+    def lookup(self, tokens, share: bool = True
+               ) -> Tuple[List[int], int]:
+        """Longest cached block-aligned prefix of ``tokens`` ->
+        ``(blocks, n_cached_tokens)``. With ``share=True`` (the commit
+        path) every returned block gains this sequence's reference and
+        the hit/miss ledger advances; ``share=False`` peeks (admission
+        feasibility checks)."""
+        blocks: List[int] = []
+        for key in self._keys(tokens):
+            b = self._entries.get(key)
+            if b is None:
+                break
+            blocks.append(b)
+            if share:
+                self._lru.move_to_end(key)
+        if share:
+            if blocks:
+                self.hits += 1
+                self._alloc.share(blocks)
+            else:
+                self.misses += 1
+        return blocks, len(blocks) * self.block_size
+
+    def insert(self, tokens, blocks: List[int],
+               n_prefix_tokens: Optional[int] = None) -> int:
+        """Register the FULL blocks covering ``tokens[:n_prefix]``
+        (default: the whole list) from a just-prefilled table. Already
+        -cached prefixes are skipped (the owning sequence simply keeps
+        its private copy — correct either way, the cached block serves
+        future lookups). Each newly cached block gains the cache's own
+        reference. Returns how many blocks were newly cached."""
+        n = len(tokens) if n_prefix_tokens is None \
+            else min(int(n_prefix_tokens), len(tokens))
+        added = 0
+        for i, key in enumerate(self._keys(list(tokens)[:n])):
+            if key in self._entries:
+                continue
+            b = int(blocks[i])
+            self._alloc.share([b])
+            self._entries[key] = b
+            self._lru[key] = b
+            added += 1
+        if self.max_blocks is not None and len(self._entries) > \
+                self.max_blocks:
+            self.reclaim(len(self._entries) - self.max_blocks)
+        return added
+
+    # -- accounting ------------------------------------------------------
+    def held_blocks(self) -> List[int]:
+        """Every block the cache itself holds a reference on — ONE
+        claim list for ``rebuild_free_list`` (the cache is a survivor
+        too)."""
+        return list(self._entries.values())
+
+    def holds(self, block: int) -> bool:
+        return int(block) in set(self._entries.values())
+
+    def shared_bytes(self, block_bytes: int) -> int:
+        """KV bytes currently deduplicated: for every cached block,
+        each reference beyond the first would have been a private copy
+        without the cache."""
+        return sum(max(self._alloc.refcount(b) - 1, 0)
+                   for b in self._entries.values()) * int(block_bytes)
+
+    # -- reclaim (the allocator hook) ------------------------------------
+    def reclaimable(self) -> int:
+        """Blocks the cache could hand back RIGHT NOW: cached blocks
+        whose only reference is the cache's own."""
+        return sum(1 for b in self._entries.values()
+                   if self._alloc.refcount(b) == 1)
+
+    def reclaim(self, n: int) -> int:
+        """Evict up to ``n`` reclaimable blocks, least-recently-used
+        first; blocks still shared with live sequences are skipped
+        (deferred until their last release). Returns how many were
+        actually freed."""
+        if n <= 0:
+            return 0
+        freed = 0
+        for key in list(self._lru.keys()):
+            if freed >= n:
+                break
+            b = self._entries[key]
+            if self._alloc.refcount(b) != 1:
+                continue
+            del self._entries[key]
+            del self._lru[key]
+            self._alloc.free([b])
+            self.evictions += 1
+            freed += 1
+        return freed

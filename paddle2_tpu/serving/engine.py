@@ -30,14 +30,15 @@ from typing import Dict, List, Optional, Sequence as Seq, Tuple
 
 import numpy as np
 
-from .block_cache import (BlockAllocator, PagedKVCache, blocks_for_tokens,
-                          GARBAGE_BLOCK)
+from .block_cache import (BlockAllocator, PagedKVCache, PrefixCache,
+                          blocks_for_tokens, GARBAGE_BLOCK)
 from .model_runner import PagedGPTRunner
 from .reliability import (EngineFailedError, PromptTooLongError,
                           ReliabilityConfig, RequestRejected,
                           flight_record as _flight_record)
 from .scheduler import (ContinuousBatchingScheduler, Request, SchedulerConfig,
                         Sequence, SeqState)
+from .spec import SpeculativeConfig, accept_drafts, ngram_draft
 
 __all__ = ["EngineConfig", "ServingEngine"]
 
@@ -74,6 +75,17 @@ class EngineConfig:
     # admission control / load shedding (None = unbounded PR 9
     # behavior); see serving.reliability.ReliabilityConfig
     reliability: Optional[ReliabilityConfig] = None
+    # copy-on-write prefix caching (ISSUE 14): shared system prompts
+    # collapse to one refcounted KV copy; prefix_cache_blocks bounds
+    # the cache (None = bounded only by LRU reclaim pressure)
+    enable_prefix_cache: bool = False
+    prefix_cache_blocks: Optional[int] = None
+    # speculative decoding (None = off): see serving.spec
+    spec: Optional[SpeculativeConfig] = None
+    # split-K width for the paged-attention kernel (None = the
+    # kernel's own VMEM-fit auto dispatch — PR 9 behavior at every
+    # context PR 9 could serve)
+    split_pages: Optional[int] = None
 
 
 class ServingEngine:
@@ -123,18 +135,44 @@ class ServingEngine:
                                         self.config.block_size)
         max_pages = blocks_for_tokens(self.max_model_len,
                                       self.config.block_size)
+        # a speculative verify round rides k extra rows per sequence
+        # through the SAME decode program family — the batch-bucket
+        # ladder must cover the widest verify batch so the program
+        # census stays inside the bucket grid (the PR 9 gate)
+        max_rows = self.config.max_batch
+        if self.config.spec is not None:
+            max_rows *= 1 + self.config.spec.num_draft_tokens
+            if self.config.batch_buckets is not None and \
+                    max(self.config.batch_buckets) < max_rows:
+                # fail at construction, not mid-decode: the first full
+                # verify round would otherwise hit batch_bucket() with
+                # a row count the explicit ladder cannot cover
+                raise ValueError(
+                    f"batch_buckets {self.config.batch_buckets} cannot "
+                    f"cover speculative verify rows (max_batch "
+                    f"{self.config.max_batch} x (1 + "
+                    f"{self.config.spec.num_draft_tokens} drafts) = "
+                    f"{max_rows})")
         sched_cfg = SchedulerConfig(
             max_batch=self.config.max_batch,
             batch_buckets=(self.config.batch_buckets
-                           or _pow2_ladder(1, self.config.max_batch)),
+                           or _pow2_ladder(1, max_rows)),
             page_buckets=(self.config.page_buckets
                           or _pow2_ladder(1, max_pages)),
             prefill_budget_tokens=self.config.prefill_budget_tokens,
             reliability=self.config.reliability)
         self.scheduler = ContinuousBatchingScheduler(sched_cfg,
                                                      self.allocator)
+        self.prefix_cache: Optional[PrefixCache] = None
+        if self.config.enable_prefix_cache:
+            self.prefix_cache = PrefixCache(
+                self.allocator, max_blocks=self.config.prefix_cache_blocks)
+            self.scheduler.prefix_cache = self.prefix_cache
         self.runner = PagedGPTRunner(model, cfg.num_heads, cfg.head_dim,
-                                     interpret=self.config.interpret)
+                                     interpret=self.config.interpret,
+                                     split_pages=self.config.split_pages)
+        self.spec_accepted = 0
+        self.spec_rejected = 0
         self._next_req_id = 0
         self._seqs: Dict[int, Sequence] = {}
         self.decode_steps = 0
@@ -369,10 +407,19 @@ class ServingEngine:
             n = len(seq.tokens)
             tok, k_stack, v_stack = self.runner.prefill(seq.tokens)
             row = np.asarray(seq.table.blocks, np.int64)
+            # prefix-cache hit: the leading cached positions' KV is
+            # ALREADY in the pool (and shared — rewriting it would
+            # scribble on every sibling), so only the private tail is
+            # scattered. The prefill still computed the full prompt:
+            # the tail's hidden states need the prefix context, and
+            # the first generated token comes from the last position.
+            start = min(seq.prefix_cached_tokens, n)
             self.cache.k = PagedKVCache.scatter_prefill(
-                self.cache.k, k_stack, row, n, self.cache.block_size)
+                self.cache.k, k_stack, row, n, self.cache.block_size,
+                start=start)
             self.cache.v = PagedKVCache.scatter_prefill(
-                self.cache.v, v_stack, row, n, self.cache.block_size)
+                self.cache.v, v_stack, row, n, self.cache.block_size,
+                start=start)
             seq.table.num_tokens = n
             seq.tokens.append(tok)
             padded = self.runner.prefill_padded_len(n)
@@ -408,38 +455,52 @@ class ServingEngine:
     def _validate_tables(self, active: List[Sequence],
                          now: Optional[float] = None) -> List[Sequence]:
         """Integrity-check every RUNNING sequence's block table before
-        the decode step consumes it: ids in the usable range, no block
-        owned by two sequences, coverage for the cached tokens. A
-        violator (chaos ``corrupt_block_table``, a real scribble) is
-        requeued for re-prefill from its token log and the allocator's
-        free list is rebuilt from the SURVIVING tables — the corrupt
-        ids cannot be trusted enough to free() (double-free risk).
-        Returns the still-running subset of ``active``."""
+        the decode step consumes it: ids in the usable range, coverage
+        for the cached tokens, and every block claimed no more often
+        than its REFCOUNT covers. A repeat WITHIN one table is always
+        corruption; a block claimed by several tables is legitimate
+        copy-on-write sharing exactly when the claim count (plus the
+        prefix cache's own hold) stays within the allocator's
+        refcount — a scribble that aliases someone's block overshoots
+        it. A violator (chaos ``corrupt_block_table``, a real
+        scribble) is requeued for re-prefill from its token log and
+        the allocator's free list AND refcounts are rebuilt from the
+        SURVIVING claims — the corrupt ids cannot be trusted enough to
+        free() (double-free risk); the prefix cache's held blocks are
+        one more survivor claim list. Returns the still-running subset
+        of ``active``."""
         from ..observability import metrics
-        owner: Dict[int, Sequence] = {}
+        claimants: Dict[int, List[Sequence]] = {}
         bad: List[Sequence] = []
         for s in self.scheduler.running():
             ok = len(s.table.blocks) >= blocks_for_tokens(
                 max(s.table.num_tokens, 1), self.config.block_size)
+            seen = set()
             for b in s.table.blocks:
                 if not (0 < b < self.config.num_blocks):
                     ok = False
                     break
-                prev = owner.get(b)
-                if prev is not None:
-                    # every live block is owned exactly once GLOBALLY,
-                    # so any repeat — within one table or across two —
-                    # aliases two token pages onto one block (silently
-                    # wrong KV). A cross-sequence dup cannot say WHICH
-                    # table was scribbled, so both claimants are
-                    # rebuilt — re-prefill is exact either way.
+                if b in seen:
+                    # a self-dup aliases two of this sequence's own
+                    # token pages onto one block — never legitimate
                     ok = False
-                    if prev is not s and prev not in bad:
-                        bad.append(prev)
                     break
-                owner[b] = s
+                seen.add(b)
+                claimants.setdefault(b, []).append(s)
             if not ok:
                 bad.append(s)
+        held = (set(self.prefix_cache.held_blocks())
+                if self.prefix_cache is not None else ())
+        for b, owners in claimants.items():
+            hold = 1 if b in held else 0
+            if len(owners) + hold > self.allocator.refcount(b):
+                # over-claimed: sharing must be covered by references.
+                # A cross-table alias cannot say WHICH table was
+                # scribbled, so every claimant is rebuilt — re-prefill
+                # is exact either way.
+                for s in owners:
+                    if s not in bad:
+                        bad.append(s)
         if not bad:
             return active
         for s in bad:
@@ -448,8 +509,10 @@ class ServingEngine:
                            req=s.req_id, tid=s.trace_id, t=now,
                            blocks=list(s.table.blocks))
             self.scheduler.requeue_corrupt(s, now=now)
-        self.allocator.rebuild_free_list(
-            [s.table.blocks for s in self.scheduler.running()])
+        survivors = [s.table.blocks for s in self.scheduler.running()]
+        if self.prefix_cache is not None:
+            survivors.append(self.prefix_cache.held_blocks())
+        self.allocator.rebuild_free_list(survivors)
         return [s for s in active if s.state is SeqState.RUNNING]
 
     # -- one decode step -------------------------------------------------
@@ -490,13 +553,54 @@ class ServingEngine:
                 f"engine {self.engine_id} killed by chaos at decode "
                 f"step {self.decode_steps + 1}")
         cfg = self.scheduler.config
-        b_bucket, p_bucket = self.scheduler.decode_bucket(active)
+        # -- speculative drafts (host, deterministic): each sequence
+        # may contribute 1 + k chunk rows to this round's verify batch.
+        # spec=None degenerates to EXACTLY the PR 9 single-row step —
+        # same buckets, same arrays, same program.
+        spec = self.config.spec
+        drafts: Dict[int, List[int]] = {}
+        if spec is not None:
+            for s in active:
+                room = s.request.max_new_tokens - len(s.generated)
+                k = min(spec.num_draft_tokens, room - 1)
+                if k < 1:
+                    continue
+                d = (spec.draft_fn(s) if spec.draft_fn is not None
+                     else ngram_draft(s.tokens, spec.ngram, k))
+                d = [int(t) for t in d][:k]
+                if d:
+                    drafts[id(s)] = d
+        if drafts:
+            # verify rows need their slots reserved UP FRONT (the
+            # program scatters the whole chunk's KV); rejected tails
+            # roll back via truncate below
+            slots = [1 + len(drafts.get(id(s), ())) for s in active]
+            spec_victims = self.scheduler.reserve_decode_slots(
+                active, now=now, slots=slots)
+            if spec_victims:
+                metrics.inc("serving_evictions_total",
+                            len(spec_victims))
+                victims += spec_victims
+                active = [s for s in active
+                          if s.state is SeqState.RUNNING]
+                drafts = {k: v for k, v in drafts.items()
+                          if k in {id(s) for s in active}}
+            if not active:
+                return None
+        rows = []                      # (seq, token, position)
+        for s in active:
+            p0 = s.num_cached
+            rows.append((s, s.tokens[p0], p0))
+            for i, d in enumerate(drafts.get(id(s), ())):
+                rows.append((s, d, p0 + 1 + i))
+        b_bucket = cfg.batch_bucket(len(rows))
+        p_bucket = self.scheduler.decode_bucket(active)[1]
         ids = np.zeros((b_bucket, 1), np.int32)
         positions = np.zeros((b_bucket,), np.int32)
         tables = np.full((b_bucket, p_bucket), GARBAGE_BLOCK, np.int32)
-        for i, s in enumerate(active):
-            ids[i, 0] = s.tokens[s.num_cached]
-            positions[i] = s.num_cached
+        for i, (s, tok_in, pos) in enumerate(rows):
+            ids[i, 0] = tok_in
+            positions[i] = pos
             tables[i] = s.table.padded(p_bucket)
         with metrics.phase("compute"):
             toks = self.runner.decode(self.cache, ids, positions, tables)
@@ -517,7 +621,8 @@ class ServingEngine:
             # transient step failure: the tokens are discarded and NO
             # sequence state advances, so the next step recomputes the
             # same positions (same inputs -> same tokens; the KV
-            # rewrite is idempotent) — retry costs one modeled step
+            # rewrite is idempotent; the drafts are a pure function of
+            # the unchanged token log) — retry costs one modeled step
             metrics.inc("serving_retries_total")
             _flight_record(event="decode_step_dropped",
                            engine=self.engine_id, t=now,
@@ -539,22 +644,54 @@ class ServingEngine:
                        t=now, dur=modeled_s or 0.0,
                        tids=step_tids or None,
                        step=self.decode_steps, batch=len(active),
+                       rows=len(rows) if drafts else None,
                        bucket=[b_bucket, p_bucket])
-        for i, s in enumerate(active):
-            s.table.append_slot()
-            s.tokens.append(int(toks[i]))
+        emitted_total = 0
+        accepted_total = 0
+        rejected_total = 0
+        ri = 0
+        for s in active:
+            n_rows = 1 + len(drafts.get(id(s), ()))
+            outs = [int(toks[ri + j]) for j in range(n_rows)]
+            ri += n_rows
+            if n_rows == 1:
+                emitted = [outs[0]]
+            else:
+                room = s.request.max_new_tokens - len(s.generated)
+                accepted, bonus = accept_drafts(drafts[id(s)], outs,
+                                                room)
+                emitted = accepted + [bonus]
+                accepted_total += len(accepted)
+                rejected_total += len(drafts[id(s)]) - len(accepted)
+            for tok in emitted:
+                s.table.append_slot()
+                s.tokens.append(tok)
+            if n_rows > 1:
+                # rejected tail: its KV writes sit past num_tokens and
+                # are overwritten before any read; surplus blocks roll
+                # back to the allocator here
+                s.table.truncate()
+            emitted_total += len(emitted)
             if s.done:
                 self.scheduler.finish(s, done_at)
+        if accepted_total:
+            metrics.inc("serving_spec_accepted_total", accepted_total)
+            self.spec_accepted += accepted_total
+        if rejected_total:
+            metrics.inc("serving_spec_rejected_total", rejected_total)
+            self.spec_rejected += rejected_total
         info = {"bucket": (b_bucket, p_bucket), "n_active": len(active),
-                "tokens": len(active), "evictions": len(victims),
+                "tokens": emitted_total, "evictions": len(victims),
+                "spec_accepted": accepted_total,
+                "spec_rejected": rejected_total,
                 "cost": cost}
-        metrics.inc("serving_decode_tokens_total", len(active))
+        metrics.inc("serving_decode_tokens_total", emitted_total)
         self._gauge()
         extra = {"serving": 1,
                  "batch_occupancy": len(active) / cfg.max_batch}
         if modeled_s is not None:
             extra["modeled_step_s"] = modeled_s
-        metrics.step_end(tokens=len(active), **extra)
+        metrics.step_end(tokens=emitted_total, **extra)
         return info
 
     def tick(self, now: float = 0.0) -> Optional[dict]:
@@ -577,6 +714,12 @@ class ServingEngine:
                           self.allocator.high_water)
         metrics.set_gauge("serving_decode_programs",
                           self.runner.num_decode_programs)
+        if self.prefix_cache is not None:
+            metrics.set_gauge(
+                "serving_shared_kv_bytes",
+                self.prefix_cache.shared_bytes(self.cache.block_bytes))
+            metrics.set_gauge("serving_prefix_cached_blocks",
+                              len(self.prefix_cache))
 
     @property
     def num_decode_programs(self) -> int:

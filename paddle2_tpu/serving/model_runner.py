@@ -45,13 +45,18 @@ class PagedGPTRunner:
     stated for."""
 
     def __init__(self, model, num_heads: int, head_dim: int,
-                 interpret: Optional[bool] = None):
+                 interpret: Optional[bool] = None,
+                 split_pages: Optional[int] = None):
         from ..jit.functional import _collect_state
         self.model = model
         model.eval()
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
         self.interpret = interpret
+        # split-K width for the paged-attention kernel (None = the
+        # kernel's VMEM-fit auto dispatch); rides into every compiled
+        # decode program
+        self.split_pages = split_pages
         params, buffers = _collect_state([model])
         self._state = params + buffers
         # hot-swap overlay: when set, these arrays (NOT the live model
@@ -222,7 +227,8 @@ class PagedGPTRunner:
                                                v._data[:, 0])
                     attn = paged_attention_decode(
                         q._data, k_pool[li], v_pool[li], block_tables,
-                        ctx, interpret=self.interpret)
+                        ctx, interpret=self.interpret,
+                        pages_per_split=self.split_pages)
                     a = block.attn.out_proj(
                         Tensor(attn.reshape(B, 1, nh * hd)))
                     x = x + block.dropout(a)

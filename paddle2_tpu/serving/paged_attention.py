@@ -1,4 +1,4 @@
-"""Pallas paged-attention decode kernel + dense reference path.
+"""Pallas paged-attention decode kernel + dense reference paths.
 
 The decode-side half of PagedAttention (Kwon et al. SOSP'23) on the
 flash kernel's machinery (``kernels/pallas_flash.py``): at decode each
@@ -10,26 +10,51 @@ compute DMA source blocks before the body runs (the
 ``PrefetchScalarGridSpec`` pattern from the official TPU paged
 kernels) — and gathers K/V blocks into VMEM.
 
-Numerics contract (the serving acceptance gate): the kernel's output
-is **bitwise identical in fp32** to :func:`paged_attention_reference`
-(dense gather through the same table) which in turn is bitwise
-identical to ``nn.functional.flash_attention`` on the contiguously
-gathered K/V. That chain holds because all three run the *same op
-sequence*: ``dot(q, k) * scale`` -> mask with ``finfo.min`` ->
-``jax.nn.softmax(f32)`` -> ``dot(p, v)``, i.e. the exact arithmetic of
-``kernels/attention._sdpa_xla`` (the dense decode path — decode shapes
-never hit the tiled flash kernel, whose online softmax would reorder
-the reductions). The per-page score dots write into one
-``[8, n_pages*block_size]`` score buffer and the softmax runs ONCE
-over the full row, so block fragmentation cannot change a single bit:
-the gathered values, not their physical placement, define the result.
-Pad slots hold ``finfo.min`` scores, which underflow to exactly 0.0
-probability, and context lengths are kept multiples of 8 (the repo's
-row-tiling minimum) so padded-width reductions group lanes identically
-to exact-width ones.
+Two bodies behind ONE dispatcher (:func:`paged_attention_decode`):
 
-VMEM: scores 8 x S_max + V S_max x D per (batch, head) program — at
-the serving ceiling (S 2048, D 128, f32) ~1.1 MB, comfortably scoped.
+* **Single-split (global softmax)** — the PR 9 body: per-page score
+  dots write into one ``[8, n_pages*block_size]`` score buffer and
+  the softmax runs ONCE over the full row. Numerics contract (the
+  serving acceptance gate): bitwise identical in fp32 to
+  :func:`paged_attention_reference` (dense gather through the same
+  table) which in turn is bitwise identical to
+  ``nn.functional.flash_attention`` on the contiguously gathered K/V —
+  all three run the *same op sequence*: ``dot(q, k) * scale`` -> mask
+  with ``finfo.min`` -> ``jax.nn.softmax(f32)`` -> ``dot(p, v)``, the
+  exact arithmetic of ``kernels/attention._sdpa_xla``. Pad slots hold
+  ``finfo.min`` scores (exactly-0.0 probability), and context lengths
+  are kept multiples of 8 so padded-width reductions group lanes
+  identically. VMEM scales with the context: scores ``8 x S`` + V
+  ``S x D`` — ~1.1 MB at S 2048 / D 128 f32, but ~17.8 MB at S 32768 /
+  D 128, PAST the ~16 MB/core budget: this body cannot serve 32k
+  contexts, which is exactly what the split body exists for.
+
+* **Split-K flash-decode (online softmax)** — ISSUE 14 / ROADMAP
+  item 4: the context is carved into splits of ``pages_per_split``
+  pages; each split runs the flash recurrence epilogue over its own
+  bounded score row (running max ``m``, denominator ``l``, and the
+  UNNORMALIZED value accumulator ``o`` — the ``pallas_flash.py``
+  pattern) and emits ``(m_i, l_i, o_i)`` partials; a tiny cross-split
+  reduction (:func:`_merge_splits`, jitted XLA) rescales by
+  ``exp(m_i - max m)`` and normalizes once. VMEM is bounded by the
+  SPLIT, not the context — any context length fits — and the splits
+  are independent (flash-decode parallelism on real hardware; the
+  in-kernel grid runs them sequentially per core). Acceptance:
+  bitwise (fp32) == :func:`paged_attention_split_reference` (the
+  dense twin that mirrors the split body's op sequence one-for-one),
+  allclose (1-ulp class) vs the global-softmax reference — the
+  per-split rescaling legally reassociates the reductions, so
+  bitwise-vs-global is not claimable, which is why SHORT contexts
+  keep dispatching to the single-split body and its stricter chain.
+
+Dispatch: ``pages_per_split=None`` (the default) picks the
+single-split body whenever its scratch fits the VMEM budget —
+bitwise-identical behavior to PR 9 at every context the PR 9 kernel
+could serve — and falls over to split-K with an auto-halved split
+width beyond it (:func:`auto_pages_per_split`). The deterministic
+accounting (:func:`decode_scratch_vmem_bytes`,
+:func:`modeled_decode_latency_s`) is what ``bench.py
+--serving-throughput`` gates the 32k story on.
 """
 
 from __future__ import annotations
@@ -43,7 +68,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..kernels.pallas_flash import _interpret_default
+from ..kernels.pallas_flash import NEG_INF, _interpret_default
 
 # jax renamed TPUCompilerParams -> CompilerParams; support both so the
 # kernel loads on every jax this repo meets
@@ -51,7 +76,18 @@ _CompilerParams = getattr(pltpu, "CompilerParams",
                           getattr(pltpu, "TPUCompilerParams", None))
 
 __all__ = ["paged_attention_decode", "paged_attention_reference",
-           "gathered_dense_kv"]
+           "paged_attention_split_reference", "gathered_dense_kv",
+           "decode_scratch_vmem_bytes", "fits_single_softmax",
+           "auto_pages_per_split", "modeled_decode_latency_s",
+           "VMEM_BYTES", "VMEM_FIT_BUDGET"]
+
+# v5e-class VMEM per core (the pallas guide's ~16 MB/core figure) and
+# the fraction a decode body may claim for its score/value scratch —
+# q/k/v tiles, the compiler's own spills, and double-buffering share
+# the rest. Both are accounting constants (deterministic on every
+# host), not runtime probes.
+VMEM_BYTES = 16 * 2 ** 20
+VMEM_FIT_BUDGET = VMEM_BYTES // 2
 
 
 def _precision(dtype):
@@ -114,8 +150,155 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
             .astype(o_ref.dtype)
 
 
+# ------------------------------------------------- VMEM / cost accounting
+def decode_scratch_vmem_bytes(ctx_pad: int, head_dim: int,
+                              dtype="float32") -> int:
+    """VMEM scratch bytes a SINGLE-SPLIT decode body needs for a
+    padded context of ``ctx_pad`` keys: the ``[8, S]`` score buffer
+    plus the ``[S, D]`` gathered-V buffer (scores ride at f32 in the
+    split body; this accounting uses the wider of score/input dtype so
+    the figure upper-bounds both bodies)."""
+    it = max(jnp.dtype(dtype).itemsize, 4)
+    return (8 * ctx_pad + ctx_pad * head_dim) * it
+
+
+def fits_single_softmax(n_pages: int, block_size: int, head_dim: int,
+                        dtype="float32",
+                        budget: int = None) -> bool:
+    """Can the PR 9 global-softmax body serve this context at all?
+    False at 32k (D 128): its whole-context scratch blows the VMEM
+    budget — the feasibility half of the bench's 32k gate."""
+    if budget is None:
+        budget = VMEM_FIT_BUDGET
+    return decode_scratch_vmem_bytes(n_pages * block_size, head_dim,
+                                     dtype) <= budget
+
+
+def auto_pages_per_split(n_pages: int, block_size: int, head_dim: int,
+                         dtype="float32",
+                         budget: int = None) -> int:
+    """Largest halving of ``n_pages`` whose per-split scratch fits the
+    VMEM budget (deterministic — no device probing)."""
+    pps = max(int(n_pages), 1)
+    while pps > 1 and not fits_single_softmax(pps, block_size, head_dim,
+                                              dtype, budget):
+        pps = -(-pps // 2)
+    return pps
+
+
+def modeled_decode_latency_s(ctx_tokens: int, num_heads: int,
+                             head_dim: int, batch: int = 1,
+                             dtype="float32", block_size: int = 16,
+                             pages_per_split=None,
+                             peak_flops=None, hbm_bps=None) -> dict:
+    """Deterministic cost x rate model of one paged-attention decode
+    step (attention only — the projections are priced by the runner's
+    program costs): HBM traffic = K+V streamed once plus, for a split
+    kernel, the ``(o, m, l)`` partials' round-trip; FLOPs = the two
+    row dots per (batch, head). Returns the modeled seconds next to a
+    ``feasible`` verdict from the VMEM accounting — a body whose
+    scratch cannot fit has NO latency to model, which is how the PR 9
+    kernel fails the 32k gate."""
+    from ..observability.cost_model import chip_peak
+    if peak_flops is None or hbm_bps is None:
+        p, h, _ = chip_peak()
+        peak_flops = peak_flops if peak_flops is not None else p
+        hbm_bps = hbm_bps if hbm_bps is not None else h
+    it = jnp.dtype(dtype).itemsize
+    n_pages = -(-int(ctx_tokens) // int(block_size))
+    if pages_per_split is None:
+        pps = n_pages
+    else:
+        pps = min(int(pages_per_split), n_pages)
+    n_splits = -(-n_pages // pps)
+    feasible = fits_single_softmax(pps, block_size, head_dim, dtype)
+    kv_bytes = 2.0 * ctx_tokens * num_heads * head_dim * it * batch
+    # split partials: o [S, D] f32 + m/l scalars per (b, h), written
+    # then re-read by the merge
+    part_bytes = (2.0 * batch * num_heads * n_splits * (head_dim + 2)
+                  * 4 if n_splits > 1 else 0.0)
+    flops = 2.0 * 2.0 * ctx_tokens * num_heads * head_dim * batch
+    latency = max(flops / peak_flops, (kv_bytes + part_bytes) / hbm_bps)
+    return {"feasible": feasible, "latency_s": latency,
+            "kv_bytes": kv_bytes, "partial_bytes": part_bytes,
+            "flops": flops, "n_splits": n_splits,
+            "pages_per_split": pps,
+            "scratch_vmem_bytes": decode_scratch_vmem_bytes(
+                pps * block_size, head_dim, dtype)}
+
+
+# ------------------------------------------- split-K flash-decode body
+def _decode_kernel_split(bt_ref, len_ref, q_ref, k_ref, v_ref,
+                         o_ref, m_ref, l_ref, s_buf, v_buf, *,
+                         scale, block_size, pages_per_split, n_pages):
+    """One (batch, head, split) program: gather the split's pages,
+    then the flash epilogue over the split's bounded score row —
+    ``m_i = max``, ``p = exp(s - m_i)``, ``l_i = sum p``,
+    ``o_i = p @ V`` (UNNORMALIZED) — written out as partials for the
+    cross-split merge. A fully-dead split (every page past the
+    context) emits ``m = -inf, l = 0, o = 0`` and the merge drops it.
+    """
+    b = pl.program_id(0)
+    sp = pl.program_id(2)
+    j = pl.program_id(3)                 # page within this split
+    jg = sp * pages_per_split + j        # global page index
+
+    @pl.when(j == 0)
+    def _init():
+        s_buf[:] = jnp.full_like(s_buf, NEG_INF)
+        v_buf[:] = jnp.zeros_like(v_buf)
+
+    ctx = len_ref[b]
+
+    @pl.when((jg * block_size < ctx) & (jg < n_pages))
+    def _gather():
+        # single query row, same discipline as the global body: the
+        # per-row dot's reduction grouping is what the bitwise
+        # contract vs the split reference is stated over
+        q = q_ref[0, 0][:1]                   # (1, D) native dtype
+        k = k_ref[0, :, 0, :]                 # (bs, D)
+        v = v_ref[0, :, 0, :]                 # (bs, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            precision=_precision(q.dtype)) * scale
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1) + jg * block_size
+        s = jnp.where(cols < ctx, s.astype(jnp.float32), NEG_INF)
+        s_buf[:1, pl.ds(j * block_size, block_size)] = s
+        v_buf[pl.ds(j * block_size, block_size), :] = v
+
+    @pl.when(j == pages_per_split - 1)
+    def _partial():
+        s = s_buf[:1]                               # (1, S_split) f32
+        m = jnp.max(s, axis=1, keepdims=True)       # -inf when dead
+        safe_m = jnp.where(m == NEG_INF, 0.0, m)
+        p = jnp.exp(s - safe_m)
+        p = jnp.where(s == NEG_INF, 0.0, p)
+        l = jnp.sum(p, axis=1, keepdims=True)
+        o = jax.lax.dot_general(
+            p.astype(v_buf.dtype), v_buf[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)     # (1, D) f32
+        o_ref[0, 0, 0] = jnp.broadcast_to(o, o_ref.shape[3:])
+        m_ref[0, 0, 0] = jnp.broadcast_to(m, m_ref.shape[3:])
+        l_ref[0, 0, 0] = jnp.broadcast_to(l, l_ref.shape[3:])
+
+
+def _merge_splits(o_parts, m, l, out_dtype):
+    """Cross-split reduction (f32): rescale every split's partial by
+    ``exp(m_i - max m)``, sum, normalize once. ``o_parts``
+    ``[B, H, S, D]``; ``m``/``l`` ``[B, H, S]``."""
+    m_max = jnp.max(m, axis=2, keepdims=True)           # (B, H, 1)
+    safe = jnp.where(m_max == NEG_INF, 0.0, m_max)
+    w = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - safe))  # (B, H, S)
+    l_tot = jnp.sum(w * l, axis=2)                       # (B, H)
+    o = jnp.sum(w[..., None] * o_parts, axis=2)          # (B, H, D)
+    l_safe = jnp.where(l_tot == 0.0, 1.0, l_tot)
+    return (o / l_safe[..., None]).astype(out_dtype)
+
+
 def paged_attention_decode(q, k_pool, v_pool, block_tables, ctx_lens,
-                           scale=None, interpret=None):
+                           scale=None, interpret=None,
+                           pages_per_split=None):
     """Paged decode attention.
 
     q: ``[B, 1, H, D]`` (paddle layout) — one new token per sequence.
@@ -124,20 +307,35 @@ def paged_attention_decode(q, k_pool, v_pool, block_tables, ctx_lens,
     sequence (pad rows with the garbage block).
     ctx_lens: int32 ``[B]`` valid keys per sequence (including the
     token just appended). Returns ``[B, 1, H, D]``.
+
+    ``pages_per_split``: split-K width for the flash-decode body.
+    ``None`` auto-dispatches — the PR 9 single-split global-softmax
+    body (and its bitwise chain) whenever its whole-context scratch
+    fits the VMEM budget, else :func:`auto_pages_per_split`. An
+    explicit value forces split-K whenever more than one split
+    results.
     """
     B, _, H, D = q.shape
     n_blocks, bs, _, _ = k_pool.shape
     n_pages = block_tables.shape[1]
-    s_pad = n_pages * bs
     if scale is None:
         scale = 1.0 / math.sqrt(D)
     if interpret is None:
         interpret = _interpret_default()
+    if pages_per_split is None:
+        pps = (n_pages if fits_single_softmax(n_pages, bs, D, q.dtype)
+               else auto_pages_per_split(n_pages, bs, D, q.dtype))
+    else:
+        pps = max(1, min(int(pages_per_split), n_pages))
     # q rides as [B, H, 8, D]: 8 identical rows satisfy the TPU
     # sublane-tiling minimum; row 0 is the answer
     qr = jnp.broadcast_to(jnp.swapaxes(q, 1, 2), (B, H, 8, D))
     bt = jnp.asarray(block_tables, jnp.int32)
     ln = jnp.asarray(ctx_lens, jnp.int32)
+    if pps < n_pages:
+        return _paged_decode_split(qr, k_pool, v_pool, bt, ln,
+                                   float(scale), pps, interpret)
+    s_pad = n_pages * bs
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -167,6 +365,74 @@ def paged_attention_decode(q, k_pool, v_pool, block_tables, ctx_lens,
         interpret=interpret,
     )(bt, ln, qr, k_pool, v_pool)
     return out[:, :, 0][:, None]        # [B, H, 8, D] -> [B, 1, H, D]
+
+
+def _paged_decode_split(qr, k_pool, v_pool, bt, ln, scale, pps,
+                        interpret):
+    """Split-K driver: pad the table out to whole splits, run the
+    flash-decode body per (batch, head, split), merge the partials in
+    one tiny jitted XLA reduction."""
+    B, H, _, D = qr.shape
+    _, bs, _, _ = k_pool.shape
+    n_pages = bt.shape[1]
+    n_splits = -(-n_pages // pps)
+    pad_pages = n_splits * pps
+    if pad_pages > n_pages:
+        # padded pages point at block 0 (the garbage block); the
+        # in-kernel (jg < n_pages) guard keeps them out of the scores
+        bt = jnp.pad(bt, ((0, 0), (0, pad_pages - n_pages)))
+    s_split = pps * bs
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, n_splits, pps),
+        in_specs=[
+            pl.BlockSpec((1, 1, 8, D),
+                         lambda b, h, sp, j, bt, ln: (b, h, 0, 0)),
+            pl.BlockSpec((1, bs, 1, D),
+                         lambda b, h, sp, j, bt, ln:
+                         (bt[b, sp * pps + j], 0, h, 0)),
+            pl.BlockSpec((1, bs, 1, D),
+                         lambda b, h, sp, j, bt, ln:
+                         (bt[b, sp * pps + j], 0, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, 1, 8, D),
+                         lambda b, h, sp, j, bt, ln: (b, h, sp, 0, 0)),
+            pl.BlockSpec((1, 1, 1, 8, 128),
+                         lambda b, h, sp, j, bt, ln: (b, h, sp, 0, 0)),
+            pl.BlockSpec((1, 1, 1, 8, 128),
+                         lambda b, h, sp, j, bt, ln: (b, h, sp, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((8, s_split), jnp.float32),
+            pltpu.VMEM((s_split, D), qr.dtype),
+        ],
+    )
+    o_parts, m, l = pl.pallas_call(
+        functools.partial(_decode_kernel_split, scale=scale,
+                          block_size=bs, pages_per_split=pps,
+                          n_pages=n_pages),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, n_splits, 8, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, n_splits, 8, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, n_splits, 8, 128), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(bt, ln, qr, k_pool, v_pool)
+    out = _merge_split_jit(str(jnp.dtype(qr.dtype)))(
+        o_parts[:, :, :, 0, :], m[:, :, :, 0, 0], l[:, :, :, 0, 0])
+    return out[:, None]                     # [B, H, D] -> [B, 1, H, D]
+
+
+@functools.lru_cache(maxsize=None)
+def _merge_split_jit(out_dtype: str):
+    return jax.jit(functools.partial(_merge_splits,
+                                     out_dtype=jnp.dtype(out_dtype)))
 
 
 def gathered_dense_kv(pool, block_tables):
@@ -217,6 +483,113 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, ctx_lens,
     return fn(jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
               jnp.asarray(block_tables, jnp.int32),
               jnp.asarray(ctx_lens, jnp.int32))
+
+
+def paged_attention_split_reference(q, k_pool, v_pool, block_tables,
+                                    ctx_lens, scale=None,
+                                    pages_per_split=1):
+    """Dense twin of the SPLIT-K body: gather K/V through the block
+    table, then mirror the split kernel's op sequence one-for-one —
+    per-page single-row score dots, per-split ``max/exp/sum`` and the
+    unnormalized ``p @ V`` partial dot (f32 accumulation), then the
+    exact :func:`_merge_splits` reduction — compiled as ONE jitted
+    program. Bitwise-equal (fp32) to the split kernel by construction;
+    vs the global-softmax :func:`paged_attention_reference` it is
+    1-ulp class (the per-split rescaling reassociates the softmax
+    reductions), which the tests assert as tight allclose."""
+    B, _, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    key = ("split", tuple(q.shape), str(jnp.asarray(q).dtype),
+           tuple(k_pool.shape), int(np.asarray(block_tables).shape[1]),
+           float(scale), int(pages_per_split))
+    fn = _REF_CACHE.get(key)
+    if fn is None:
+        # TWO compiled stages, mirroring the kernel path's program
+        # structure (pallas partials, then the shared merge program):
+        # fusing partials + merge into one XLA program lets the
+        # compiler reassociate across the boundary (~1 ulp observed on
+        # CPU), so the reference reuses the EXACT _merge_split_jit
+        # program the kernel path runs
+        fn = jax.jit(functools.partial(
+            _split_partials_impl, scale=float(scale), B=B, H=H,
+            pps=int(pages_per_split)))
+        if len(_REF_CACHE) > 256:
+            _REF_CACHE.clear()
+        _REF_CACHE[key] = fn
+    o_parts, m, l = fn(jnp.asarray(q), jnp.asarray(k_pool),
+                       jnp.asarray(v_pool),
+                       jnp.asarray(block_tables, jnp.int32),
+                       jnp.asarray(ctx_lens, jnp.int32))
+    out = _merge_split_jit(str(jnp.dtype(jnp.asarray(q).dtype)))(
+        o_parts, m, l)
+    return out[:, None]                              # (B, 1, H, D)
+
+
+def _split_partials_impl(q, k_pool, v_pool, block_tables, ctx_lens, *,
+                         scale, B, H, pps):
+    """Dense mirror of the split kernel's per-(batch, head, split)
+    partial computation: returns ``(o_parts [B,H,S,D] f32,
+    m [B,H,S] f32, l [B,H,S] f32)``."""
+    kd = gathered_dense_kv(k_pool, block_tables)     # [B, S_pad, H, D]
+    vd = gathered_dense_kv(v_pool, block_tables)
+    prec = _precision(q.dtype)
+    bs = k_pool.shape[1]
+    n_pages = block_tables.shape[1]
+    n_splits = -(-n_pages // pps)
+    D = q.shape[-1]
+    all_o, all_m, all_l = [], [], []
+    for b in range(B):
+        heads_o, heads_m, heads_l = [], [], []
+        for h in range(H):
+            parts_o, parts_m, parts_l = [], [], []
+            for sp in range(n_splits):
+                cols = []
+                vals = []
+                for j in range(pps):
+                    jg = sp * pps + j
+                    if jg >= n_pages:
+                        # padded page: NEG_INF scores, zero V — the
+                        # kernel's untouched-scratch state
+                        cols.append(jnp.full((1, bs), NEG_INF,
+                                             jnp.float32))
+                        vals.append(jnp.zeros((bs, D), q.dtype))
+                        continue
+                    lo = jg * bs
+                    s = jax.lax.dot_general(
+                        q[b, :, h], kd[b, lo:lo + bs, h],
+                        (((1,), (1,)), ((), ())),
+                        precision=prec) * scale       # (1, bs)
+                    valid = (jnp.arange(bs) + lo) < ctx_lens[b]
+                    s = jnp.where(valid[None, :],
+                                  s.astype(jnp.float32), NEG_INF)
+                    # the kernel skips pages wholly past the context:
+                    # its scratch keeps NEG_INF/0 there
+                    dead = jnp.asarray(lo, jnp.int32) >= ctx_lens[b]
+                    cols.append(jnp.where(dead, NEG_INF, s))
+                    vals.append(jnp.where(
+                        dead, jnp.zeros_like(vd[b, lo:lo + bs, h]),
+                        vd[b, lo:lo + bs, h]))
+                s = jnp.concatenate(cols, axis=1)     # (1, S_split) f32
+                v = jnp.concatenate(vals, axis=0)     # (S_split, D)
+                m = jnp.max(s, axis=1, keepdims=True)
+                safe_m = jnp.where(m == NEG_INF, 0.0, m)
+                p = jnp.exp(s - safe_m)
+                p = jnp.where(s == NEG_INF, 0.0, p)
+                l = jnp.sum(p, axis=1, keepdims=True)
+                o = jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                parts_o.append(o[0])                  # (D,) f32
+                parts_m.append(m[0, 0])
+                parts_l.append(l[0, 0])
+            heads_o.append(jnp.stack(parts_o))        # (S, D)
+            heads_m.append(jnp.stack(parts_m))        # (S,)
+            heads_l.append(jnp.stack(parts_l))
+        all_o.append(jnp.stack(heads_o))              # (H, S, D)
+        all_m.append(jnp.stack(heads_m))
+        all_l.append(jnp.stack(heads_l))
+    return (jnp.stack(all_o), jnp.stack(all_m), jnp.stack(all_l))
 
 
 def _reference_impl(q, k_pool, v_pool, block_tables, ctx_lens, *,

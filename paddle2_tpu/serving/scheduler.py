@@ -99,6 +99,9 @@ class Sequence:
         self.evictions = 0
         self.recoveries = 0          # corruption / engine-failure rebuilds
         self.error: Optional[ServingError] = None   # set when SHED
+        # leading tokens whose KV came from the prefix cache at the
+        # LAST admission (the engine scatters only past this point)
+        self.prefix_cached_tokens = 0
 
     def check(self) -> "Sequence":
         """Raise the typed error a post-submission failure recorded
@@ -115,6 +118,7 @@ class Sequence:
         it, which the eviction-exactness guarantee proves is
         token-for-token identical to never having lost the KV."""
         self.table = BlockTable(allocator)
+        self.prefix_cached_tokens = 0     # re-resolved at re-admission
 
     @property
     def req_id(self) -> int:
@@ -204,6 +208,10 @@ class ContinuousBatchingScheduler:
         self.config = config
         self.allocator = allocator
         self.reliability = config.reliability or ReliabilityConfig()
+        # CoW prefix cache (engine-installed; None = PR 9 behavior):
+        # admission consults it so a hit's shared blocks don't count
+        # against the free list
+        self.prefix_cache = None
         self.engine_id = 0          # mirrored by the owning engine
         self.waiting: List[Sequence] = []
         self._running: List[Sequence] = []      # admission order
@@ -339,20 +347,66 @@ class ContinuousBatchingScheduler:
             if len(self._running) + len(admitted) >= self.config.max_batch:
                 break
             need_tokens = len(seq.tokens)
+            cached: List[int] = []
+            if self.prefix_cache is not None and not seq.table.blocks:
+                # peek first (no refcount bump): the hit only commits
+                # once admission is certain, so a blocked head-of-line
+                # request never leaks shared references
+                cached, _ = self.prefix_cache.lookup(seq.tokens,
+                                                     share=False)
             need_blocks = blocks_for_tokens(
-                need_tokens + 1, self.allocator.block_size)
+                need_tokens + 1, self.allocator.block_size) - len(cached)
             if spent and spent + need_tokens > budget:
                 break                      # budget spent: next round
             if not self.allocator.can_allocate(need_blocks):
                 break                      # head-of-line until blocks free
             self.waiting.pop(0)
-            seq.table.ensure_capacity(need_tokens + 1)
+            seq.prefix_cached_tokens = 0
+            shared: List[int] = []
+            if self.prefix_cache is not None:
+                from ..observability import metrics
+                shared, n_cached = self.prefix_cache.lookup(seq.tokens)
+                if shared:
+                    seq.table.attach_shared(shared)
+                    seq.prefix_cached_tokens = n_cached
+                    metrics.inc("serving_prefix_hits_total")
+                    metrics.inc("serving_prefix_hit_blocks_total",
+                                len(shared))
+                else:
+                    metrics.inc("serving_prefix_misses_total")
+            try:
+                seq.table.ensure_capacity(need_tokens + 1)
+            except OutOfBlocksError:
+                # the can_allocate check above counted reclaimable
+                # cached blocks as headroom — but THIS request's own
+                # cached prefix may be exactly that headroom, and the
+                # commit share just pinned it (refcount 2 = no longer
+                # reclaimable). Undo the hit and put the request back
+                # at the head: it stays head-of-line until real blocks
+                # free up, nothing is lost or leaked.
+                if shared:
+                    self.allocator.free(shared)
+                seq.table.blocks = []
+                seq.prefix_cached_tokens = 0
+                self.waiting.insert(0, seq)
+                break
+            if self.prefix_cache is not None:
+                # publish the prompt's full blocks NOW, not after
+                # prefill: a same-round sibling with the same system
+                # prompt can then share them — every admitted
+                # sequence's prefill scatters before any decode reads,
+                # so the registered blocks' KV exists by first use
+                self.prefix_cache.insert(seq.request.prompt,
+                                         seq.table.blocks,
+                                         len(seq.request.prompt))
             spent += need_tokens
             admitted.append(seq)
             _flight_record(event="admit", req=seq.req_id,
                            tid=seq.trace_id, t=now, tokens=need_tokens,
                            engine=self.engine_id,
-                           blocks=len(seq.table.blocks))
+                           blocks=len(seq.table.blocks),
+                           shared_blocks=(len(seq.table.blocks)
+                                          - need_blocks) or None)
         return admitted
 
     def mark_running(self, seq: Sequence) -> None:
@@ -361,14 +415,21 @@ class ContinuousBatchingScheduler:
 
     # -- decode-step block reservation ----------------------------------
     def reserve_decode_slots(self, seqs: Optional[List[Sequence]] = None,
-                             now: Optional[float] = None
+                             now: Optional[float] = None,
+                             slots: Optional[List[int]] = None
                              ) -> List[Sequence]:
         """Make sure every sequence in ``seqs`` (default: all running)
-        has a block slot for the token the next decode step appends,
-        evicting LIFO on exhaustion. Returns the evicted sequences
-        (already requeued). ``now`` stamps the eviction spans."""
+        has block slots for the token(s) the next decode step appends
+        — ``slots[i]`` per sequence (default 1; a speculative verify
+        round reserves ``1 + len(drafts)``) — evicting LIFO on
+        exhaustion. Returns the evicted sequences (already requeued).
+        ``now`` stamps the eviction spans."""
         victims: List[Sequence] = []
         todo = list(self._running) if seqs is None else list(seqs)
+        want = [1] * len(todo) if slots is None else \
+            [max(1, int(s)) for s in slots]
+        if len(want) != len(todo):
+            raise ValueError("slots must parallel seqs")
         i = 0
         while i < len(todo):
             seq = todo[i]
@@ -376,7 +437,7 @@ class ContinuousBatchingScheduler:
                 i += 1      # evicted while reserving an earlier seq
                 continue
             try:
-                seq.table.ensure_capacity(seq.num_cached + 1)
+                seq.table.ensure_capacity(seq.num_cached + want[i])
                 i += 1
             except OutOfBlocksError:
                 victim = self._running[-1]

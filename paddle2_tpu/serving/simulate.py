@@ -90,6 +90,18 @@ class ServingSimReport:
     # total modeled FLOPs executed (prefills + decode steps): the
     # denominator of the deterministic tracing-overhead gate
     modeled_flops: float = 0.0
+    # CoW prefix-cache economics (ISSUE 14): KV blocks actually
+    # MATERIALIZED (allocator handouts, not shares) — the bytes/request
+    # figure the shared-prefix bench gate divides down
+    kv_allocated_blocks: int = 0
+    kv_allocated_bytes: int = 0
+    kv_bytes_per_request: float = 0.0
+    prefix_hits: int = 0
+    prefix_misses: int = 0
+    # speculative-decoding ledger: drafts the verify pass kept/killed
+    spec_accepted: int = 0
+    spec_rejected: int = 0
+    spec_acceptance: float = 0.0
 
     def finalize(self, first_arrival: float, last_finish: float):
         self.makespan_s = max(last_finish - first_arrival, 1e-12)
@@ -97,6 +109,9 @@ class ServingSimReport:
         if self.ttft_s:
             self.p99_ttft_s = float(np.percentile(self.ttft_s, 99))
             self.mean_ttft_s = float(np.mean(self.ttft_s))
+        proposed = self.spec_accepted + self.spec_rejected
+        self.spec_acceptance = (self.spec_accepted / proposed
+                                if proposed else 0.0)
         return self
 
 
@@ -111,6 +126,10 @@ def simulate_serving(engine, trace: List[dict],
     decode_clock = float(first_arrival)
     prefill_clock = 0.0
     evictions_before = engine.scheduler.total_evictions
+    alloc_before = engine.allocator.total_allocated
+    spec_before = (engine.spec_accepted, engine.spec_rejected)
+    pfx_before = ((engine.prefix_cache.hits, engine.prefix_cache.misses)
+                  if engine.prefix_cache is not None else (0, 0))
     submitted: List[int] = []
     occupancy: List[float] = []
     rep = ServingSimReport()
@@ -189,6 +208,17 @@ def simulate_serving(engine, trace: List[dict],
     rep.program_budget = engine.program_budget
     rep.mean_batch_occupancy = float(np.mean(occupancy)) if occupancy \
         else 0.0
+    rep.kv_allocated_blocks = (engine.allocator.total_allocated
+                               - alloc_before)
+    rep.kv_allocated_bytes = engine.cache.bytes_for_blocks(
+        rep.kv_allocated_blocks)
+    rep.kv_bytes_per_request = (rep.kv_allocated_bytes
+                                / max(len(submitted), 1))
+    rep.spec_accepted = engine.spec_accepted - spec_before[0]
+    rep.spec_rejected = engine.spec_rejected - spec_before[1]
+    if engine.prefix_cache is not None:
+        rep.prefix_hits = engine.prefix_cache.hits - pfx_before[0]
+        rep.prefix_misses = engine.prefix_cache.misses - pfx_before[1]
     return rep.finalize(first_arrival, last_finish)
 
 
@@ -432,6 +462,14 @@ def simulate_router(router: EngineFailoverRouter, trace: List[dict],
     clock = float(first_arrival)
     prefill_clocks = [0.0] * len(router.engines)
     rep = RouterSimReport(engines=len(router.engines))
+    # per-engine snapshots so the report carries THIS simulation's
+    # deltas, not lifetime totals (an engine warmed by a prior sim
+    # must not inflate the gated figures)
+    before = {id(e): (e.allocator.total_allocated, e.spec_accepted,
+                      e.spec_rejected,
+                      (e.prefix_cache.hits, e.prefix_cache.misses)
+                      if e.prefix_cache is not None else (0, 0))
+              for e in router.engines}
 
     def submit_due(now: float):
         while pending and pending[0]["arrival_t"] <= now:
@@ -529,6 +567,18 @@ def simulate_router(router: EngineFailoverRouter, trace: List[dict],
     rep.decode_steps = sum(e.decode_steps for e in router.engines)
     rep.evictions = sum(e.scheduler.total_evictions
                         for e in router.engines)
+    for e in router.engines:
+        alloc0, acc0, rej0, (hit0, miss0) = before[id(e)]
+        blocks = e.allocator.total_allocated - alloc0
+        rep.kv_allocated_blocks += blocks
+        rep.kv_allocated_bytes += e.cache.bytes_for_blocks(blocks)
+        rep.spec_accepted += e.spec_accepted - acc0
+        rep.spec_rejected += e.spec_rejected - rej0
+        if e.prefix_cache is not None:
+            rep.prefix_hits += e.prefix_cache.hits - hit0
+            rep.prefix_misses += e.prefix_cache.misses - miss0
+    rep.kv_bytes_per_request = (rep.kv_allocated_bytes
+                                / max(rep.submitted, 1))
     rep.failovers = len(router.failovers)
     rep.recovered_seqs = sum(fo["recovered"] for fo in router.failovers)
     rep.mttr_s = router.mttr_s

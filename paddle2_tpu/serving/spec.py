@@ -1,0 +1,110 @@
+"""Speculative decoding: self-drafting n-gram proposals + in-program
+verify-and-accept through the paged decode program (ISSUE 14 /
+ROADMAP 2(b); Leviathan et al., "Fast Inference from Transformers via
+Speculative Decoding").
+
+Design — draft cheap, verify exact:
+
+* **Draft** (host, free): :func:`ngram_draft` proposes up to ``k``
+  continuation tokens by matching the sequence's own token log — the
+  newest earlier occurrence of the last ``n`` tokens nominates what
+  followed it ("prompt lookup" drafting: no draft model, no extra
+  weights, deterministic). A custom ``draft_fn`` slots in for a real
+  draft model (or the bench's fixed-acceptance oracle).
+* **Verify** (device, one program): the engine feeds the pending true
+  token plus the drafts as EXTRA BATCH ROWS of the SAME compiled
+  paged-decode program — row ``i`` carries token ``i`` of the chunk at
+  position ``p0 + i`` with the sequence's own block table, so every
+  row runs the identical single-query-row attention a sequential
+  decode would (the kernel is row-independent; per-row K/V scatters
+  land before the attention reads them, and causal masking via the
+  per-row context length keeps later drafts invisible to earlier
+  rows). No new program shapes beyond a wider batch bucket — the
+  program census stays inside the scheduler's bucket grid.
+* **Accept** (:func:`accept_drafts`, host): greedy speculative
+  acceptance — drafts are accepted while they match the model's own
+  argmax continuation, then the model's next token rides along as the
+  bonus. With greedy decoding this is EXACT by construction: the
+  emitted stream is token-for-token the non-speculative stream, no
+  matter how wrong the drafts are (wrong drafts only cost the wasted
+  rows). The rejected tail's KV writes land past the accepted
+  ``num_tokens`` and are overwritten before any later row can read
+  them; its surplus blocks roll back via ``BlockTable.truncate``.
+
+Throughput story (priced, not wall-clocked): decode is weight-bytes
+bound, so a verify step over ``B * (k+1)`` rows costs barely more than
+a plain ``B``-row step in the cost model while emitting
+``1 + accepted`` tokens per sequence — ``bench.py
+--serving-throughput`` gates the modeled tokens/s uplift at a fixed
+70% acceptance rate against the non-speculative run, plus token-CRC
+equality (the exactness half).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence as Seq, Tuple
+
+__all__ = ["SpeculativeConfig", "ngram_draft", "accept_drafts"]
+
+
+@dataclass
+class SpeculativeConfig:
+    """Knobs for the engine's speculative decode rounds.
+
+    ``num_draft_tokens`` (k) bounds the chunk a verify round covers
+    (``k + 1`` rows per sequence — the engine widens its batch-bucket
+    ladder to ``max_batch * (k + 1)`` so the program census stays
+    bounded). ``ngram`` is the self-draft match length.
+    ``draft_fn(seq) -> List[int]`` overrides the drafter entirely
+    (return at most ``num_draft_tokens`` proposals; empty list =
+    plain 1-token decode for that sequence this round)."""
+    num_draft_tokens: int = 3
+    ngram: int = 2
+    draft_fn: Optional[Callable] = None
+
+    def __post_init__(self):
+        if self.num_draft_tokens < 1:
+            raise ValueError("num_draft_tokens must be >= 1")
+        if self.ngram < 1:
+            raise ValueError("ngram must be >= 1")
+
+
+def ngram_draft(tokens: Seq[int], ngram: int, k: int) -> List[int]:
+    """Self-draft by n-gram lookup: find the NEWEST earlier occurrence
+    of the trailing ``ngram`` tokens in ``tokens`` and propose the up
+    to ``k`` tokens that followed it. Deterministic, pure host. Empty
+    when the log is too short or nothing matches."""
+    toks = [int(t) for t in tokens]
+    n = len(toks)
+    if k < 1 or n <= ngram:
+        return []
+    pat = toks[-ngram:]
+    # newest match first: recent continuations predict better
+    for j in range(n - ngram - 1, -1, -1):
+        if toks[j:j + ngram] == pat:
+            return toks[j + ngram:j + ngram + k]
+    return []
+
+
+def accept_drafts(drafts: Seq[int], outs: Seq[int], budget: int
+                  ) -> Tuple[List[int], int]:
+    """Greedy verify: ``outs[i]`` is the model's argmax after
+    consuming chunk row ``i`` (row 0 = the pending true token, row
+    ``i >= 1`` = ``drafts[i-1]``). Accept drafts while
+    ``drafts[i] == outs[i]`` — i.e. while the draft IS what the model
+    would have emitted — then the next model output rides along as the
+    bonus token. ``budget`` caps total emitted tokens (accepted +
+    bonus), so a sequence never overshoots ``max_new_tokens``.
+    Returns ``(accepted, bonus)``."""
+    if budget < 1:
+        raise ValueError("accept budget must be >= 1")
+    accepted: List[int] = []
+    for i, d in enumerate(drafts):
+        if len(accepted) + 1 >= budget:
+            break
+        if int(d) == int(outs[i]):
+            accepted.append(int(d))
+        else:
+            break
+    return accepted, int(outs[len(accepted)])

@@ -75,6 +75,13 @@ _RELIABILITY_COUNTERS = (
     # SLO ledger (ISSUE 13): good/bad requests against the configured
     # TTFT/TPOT/e2e targets — the burn-rate gauge rides the snapshot
     "serving_slo_good_total", "serving_slo_bad_total",
+    # serving throughput plane (ISSUE 14): a prefix-cache hit-rate or
+    # speculation acceptance-rate regression is a silent KV-bytes /
+    # tokens-per-second regression — surfacing the raw counters in
+    # diff makes it NAMEABLE before the modeled throughput moves
+    "serving_prefix_hits_total", "serving_prefix_misses_total",
+    "serving_prefix_hit_blocks_total",
+    "serving_spec_accepted_total", "serving_spec_rejected_total",
 )
 
 

@@ -155,6 +155,7 @@ def summarize(records: List[Dict[str, Any]],
                            for k, v in sorted(chaos.items())}
     if metrics_dir:
         report["slo"] = load_slo(metrics_dir)
+        report["throughput"] = load_throughput(metrics_dir)
     return report
 
 
@@ -185,6 +186,41 @@ def load_slo(metrics_dir: str) -> Dict[str, Any]:
                 out["burn_rate"] = v
     total = out["good"] + out["bad"]
     out["attainment"] = out["good"] / total if total else None
+    return out
+
+
+def load_throughput(metrics_dir: str) -> Dict[str, Any]:
+    """Join the serving-throughput economics (ISSUE 14) from the
+    metrics snapshots: prefix-cache hits/misses (+ the shared-KV-bytes
+    gauge) and the speculative-decoding accepted/rejected ledger with
+    its derived acceptance rate — the one number an acceptance-rate
+    regression moves first."""
+    from . import perf_doctor
+    streams = perf_doctor.load_streams(metrics_dir)
+    out: Dict[str, Any] = {"prefix_hits": 0.0, "prefix_misses": 0.0,
+                           "shared_kv_bytes": None,
+                           "spec_accepted": 0.0, "spec_rejected": 0.0}
+    for s in streams.values():
+        snap = s.get("snapshot") or {}
+        out["prefix_hits"] += perf_doctor._counter_total(
+            snap, "serving_prefix_hits_total")
+        out["prefix_misses"] += perf_doctor._counter_total(
+            snap, "serving_prefix_misses_total")
+        out["spec_accepted"] += perf_doctor._counter_total(
+            snap, "serving_spec_accepted_total")
+        out["spec_rejected"] += perf_doctor._counter_total(
+            snap, "serving_spec_rejected_total")
+        gauges = (snap.get("gauges") or {}).get(
+            "serving_shared_kv_bytes") or {}
+        for v in gauges.values():
+            out["shared_kv_bytes"] = (v if out["shared_kv_bytes"] is None
+                                      else out["shared_kv_bytes"] + v)
+    lookups = out["prefix_hits"] + out["prefix_misses"]
+    out["prefix_hit_rate"] = (out["prefix_hits"] / lookups
+                              if lookups else None)
+    proposed = out["spec_accepted"] + out["spec_rejected"]
+    out["spec_acceptance"] = (out["spec_accepted"] / proposed
+                              if proposed else None)
     return out
 
 
@@ -294,6 +330,26 @@ def format_summary(report: Dict[str, Any], directory: str) -> str:
         L.append("CHAOS ATTRIBUTION (injected faults -> requests)")
         for fault, tids in ch.items():
             L.append(f"  {fault}: tids {tids}")
+    thr = report.get("throughput")
+    if thr and (thr["prefix_hits"] or thr["prefix_misses"]
+                or thr["spec_accepted"] or thr["spec_rejected"]):
+        L.append("THROUGHPUT (prefix cache / speculation)")
+        if thr["prefix_hits"] or thr["prefix_misses"]:
+            hr = thr.get("prefix_hit_rate")
+            shared = thr.get("shared_kv_bytes")
+            L.append(
+                f"  prefix cache: {thr['prefix_hits']:g} hits / "
+                f"{thr['prefix_misses']:g} misses"
+                + (f" ({hr:.1%} hit rate)" if hr is not None else "")
+                + (f", {shared:,.0f} B KV shared"
+                   if shared else ""))
+        if thr["spec_accepted"] or thr["spec_rejected"]:
+            acc = thr.get("spec_acceptance")
+            L.append(
+                f"  speculation: {thr['spec_accepted']:g} accepted / "
+                f"{thr['spec_rejected']:g} rejected drafts"
+                + (f" ({acc:.1%} acceptance rate)"
+                   if acc is not None else ""))
     slo = report.get("slo")
     if slo and (slo["good"] or slo["bad"]):
         att = slo.get("attainment")
