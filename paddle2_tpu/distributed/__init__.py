@@ -13,13 +13,16 @@ from .collective import (Group, P2POp, ReduceOp, all_gather,
                          all_gather_object, all_reduce, all_to_all, alltoall,
                          barrier, batch_isend_irecv, broadcast,
                          destroy_process_group, fused_all_reduce, get_group,
+                         hierarchical_pmean, hierarchical_psum,
                          irecv, is_initialized, isend, new_group, ppermute,
                          recv, reduce, reduce_scatter, scatter, send, wait)
 from .parallel import DataParallel, init_parallel_env, parallel_initialized
 from .sharding import ShardedOptimizer, group_sharded_parallel
 from . import bucket  # noqa: F401
 from .bucket import (BucketPlan, GradientBucketManager,  # noqa: F401
-                     bucketed_pmean, bucketed_psum, plan_buckets)
+                     bucketed_hierarchical_pmean, bucketed_pmean,
+                     bucketed_psum, link_bucket_bytes, plan_buckets,
+                     plan_buckets_for_link)
 from . import spec_layout  # noqa: F401
 from .spec_layout import SpecLayout, hybrid_mesh  # noqa: F401
 from . import auto_parallel  # noqa: F401

@@ -38,11 +38,18 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["plan_buckets", "BucketPlan", "GradientBucketManager",
-           "bucketed_pmean", "bucketed_psum", "DEFAULT_BUCKET_MB"]
+           "bucketed_pmean", "bucketed_psum", "bucketed_hierarchical_pmean",
+           "link_bucket_bytes", "plan_buckets_for_link",
+           "DEFAULT_BUCKET_MB", "DEFAULT_LATENCY_FRACTION"]
 
 # DDP's classic default: large enough to amortize dispatch, small enough
 # that the tail bucket's exposed wire time stays a rounding error
 DEFAULT_BUCKET_MB = 25.0
+
+# link-aware sizing target: per-dispatch latency (the link's α) may eat
+# at most this fraction of a bucket's α+β time — latency-dominated DCN
+# links therefore get FEWER, BIGGER buckets than ICI
+DEFAULT_LATENCY_FRACTION = 0.1
 
 
 def _nbytes(shape: Sequence[int], dtype) -> int:
@@ -96,6 +103,43 @@ def _plan(avals, bucket_bytes: float) -> Tuple[List[List[int]], int]:
         open_bytes[dt] += nb
     buckets.extend(open_idx.values())
     return buckets, len(open_idx)
+
+
+def link_bucket_bytes(link, axes: Sequence[str],
+                      base_bucket_bytes: float = DEFAULT_BUCKET_MB * 1e6,
+                      latency_fraction: float = DEFAULT_LATENCY_FRACTION
+                      ) -> float:
+    """Per-LINK-CLASS bucket size target under an α+β
+    :class:`~paddle2_tpu.observability.cost_model.LinkModel`: the
+    smallest bucket whose per-dispatch latency α stays under
+    ``latency_fraction`` of its α+β time, floored at
+    ``base_bucket_bytes``. ``α <= f * (α + B/bw)`` solves to
+    ``B >= α * bw * (1 - f) / f`` — a latency-dominated DCN hop
+    (α ~100us at 12.5 GB/s) wants few, big buckets, while a ~1us ICI
+    hop keeps the bandwidth-era default. Pure function of (link rates,
+    axes, knobs): every rank computes the identical target with no
+    negotiation, preserving the ``plan_buckets`` determinism contract.
+    """
+    if not 0.0 < float(latency_fraction) < 1.0:
+        raise ValueError(
+            f"latency_fraction must be in (0, 1), got {latency_fraction}")
+    alpha = link.latency(axes)
+    bw = min((link.bandwidth(a) for a in axes), default=link.ici_bps)
+    floor = alpha * bw * (1.0 - latency_fraction) / latency_fraction
+    return max(float(base_bucket_bytes), floor)
+
+
+def plan_buckets_for_link(avals: Sequence[Tuple[Sequence[int], Any]],
+                          link, axes: Sequence[str],
+                          base_bucket_bytes: float = DEFAULT_BUCKET_MB * 1e6,
+                          latency_fraction: float = DEFAULT_LATENCY_FRACTION
+                          ) -> List[List[int]]:
+    """:func:`plan_buckets` at the :func:`link_bucket_bytes` target for
+    the link class the collective will cross — still a pure
+    deterministic function of (param order, shapes, dtypes, link
+    class)."""
+    return plan_buckets(avals, link_bucket_bytes(
+        link, axes, base_bucket_bytes, latency_fraction))
 
 
 class BucketPlan:
@@ -194,6 +238,24 @@ def bucketed_pmean(tree, axis_name, bucket_bytes: float = 25e6):
     import jax
     return _bucketed_reduce(tree, lambda x: jax.lax.pmean(x, axis_name),
                             bucket_bytes)
+
+
+def bucketed_hierarchical_pmean(tree, ici_axes, dcn_axes,
+                                bucket_bytes: float = 25e6):
+    """Hierarchical mean of every leaf over the combined
+    (ici x dcn) group, fused into size-targeted buckets: each fused
+    flat payload rides the ``collective.hierarchical_pmean`` schedule
+    (in-slice ICI reduce-scatter, cross-slice DCN all-reduce of the
+    partials, in-slice all-gather) instead of a flat pmean across the
+    slow wire. Same value contract as the hierarchical primitives:
+    exact-sum payloads are bitwise equal to the flat ``bucketed_pmean``
+    over both axes; arbitrary floats agree to reassociation rounding.
+    ``bucket_bytes`` should come from :func:`link_bucket_bytes` for the
+    DCN hop (latency-dominated links want fewer, bigger buckets)."""
+    from .collective import hierarchical_pmean
+    return _bucketed_reduce(
+        tree, lambda x: hierarchical_pmean(x, ici_axes, dcn_axes),
+        bucket_bytes)
 
 
 # ----------------------------------------------------------------- eager

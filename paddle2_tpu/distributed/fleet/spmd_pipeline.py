@@ -50,6 +50,54 @@ from .. import mesh as mesh_mod
 
 __all__ = ["pipeline_spmd", "pipeline_spmd_1f1b", "pipeline_spmd_vpp"]
 
+# --- old-jax compatibility -------------------------------------------------
+# jax < 0.6 has neither lax.pvary/lax.pcast nor the vma type system the
+# varying-marks below talk to. The schedules themselves are plain
+# psum/ppermute programs that old jax runs fine — so on such builds the
+# varying-marks degrade to identity and shard_map skips the replication
+# check it cannot express (`check_rep=False`). On modern jax nothing
+# changes: the pvary path and the default rep check run exactly as
+# before.
+_HAS_VMA = hasattr(jax.lax, "pvary") or hasattr(jax.lax, "pcast")
+
+
+def _pvary(v, axes):
+    if not axes:
+        return v
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(v, tuple(axes))
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(v, tuple(axes), to="varying")
+    return v
+
+
+def _vma_of(v):
+    if hasattr(jax, "typeof"):
+        return getattr(jax.typeof(v), "vma", frozenset())
+    return frozenset()
+
+
+def _axis_size(name):
+    # one shared resolution (trace-bound axis first, installed-mesh
+    # fallback on old jax) — see mesh.traced_axis_size
+    return mesh_mod.traced_axis_size(name)
+
+
+def _shard_map(*args, **kwargs):
+    if not _HAS_VMA:
+        kwargs.setdefault("check_rep", False)
+    return shard_map(*args, **kwargs)
+
+
+def _claim_mean(g, axis):
+    """Finalize a grad whose cross-``axis`` reduction modern jax already
+    performed via the pvary-transpose auto-psum: there the values are
+    equal across the axis and pmean merely CLAIMS the invariance for
+    the out_specs. Old jax has no vma transpose — each shard still
+    holds its LOCAL (1/degree-scaled) contribution, so the reduction
+    must be issued for real: psum of the scaled locals IS the mean."""
+    return (jax.lax.pmean if _HAS_VMA else jax.lax.psum)(g, axis)
+
 
 def _local_body(params, x_micro, *, stage_fn, n_stages, n_micro, axis):
     """Per-device program. params: this device's stage params (leading
@@ -80,9 +128,7 @@ def _local_body(params, x_micro, *, stage_fn, n_stages, n_micro, axis):
     # the carry becomes device-varying (ppermute / stage writes): mark the
     # replicated initial values as varying so scan's carry types match
     def _varying(v):
-        if hasattr(jax.lax, "pcast"):
-            return jax.lax.pcast(v, (axis,), to="varying")
-        return jax.lax.pvary(v, (axis,))
+        return _pvary(v, (axis,))
 
     (act, outs), _ = jax.lax.scan(tick, (_varying(zero), _varying(outs0)),
                                   jnp.arange(T))
@@ -126,7 +172,7 @@ def pipeline_spmd(stage_fn: Callable, stacked_params, x_micro,
             stacked_params)
         body = partial(_local_body, stage_fn=stage_fn, n_stages=S,
                        n_micro=M, axis=mesh_axis)
-        fn = jax.jit(shard_map(
+        fn = jax.jit(_shard_map(
             body, mesh=mesh,
             in_specs=(param_specs, P()),
             out_specs=P()))
@@ -169,19 +215,19 @@ def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
     def _vary(v):
         """pvary only the axes v is not ALREADY varying over (dp-sharded
         inputs arrive dp-varying; pvary rejects redundant axes)."""
-        cur = getattr(jax.typeof(v), "vma", frozenset())
+        cur = _vma_of(v)
         missing = tuple(a for a in vaxes if a not in cur)
-        return jax.lax.pvary(v, missing) if missing else v
+        return _pvary(v, missing) if missing else v
 
     tp_scale = 1.0
     for a in tp_axes:
-        tp_scale = tp_scale / jax.lax.axis_size(a)
+        tp_scale = tp_scale / _axis_size(a)
     if dp_axis is not None:
         # params are dp-INVARIANT while data is dp-varying: the vjp
         # auto-inserts a dp-psum into their cotangents (pvary transpose),
         # so seed each dp shard with 1/D to make that psum the dp-MEAN
         # of the per-shard grads — the reference's averaged allreduce
-        tp_scale = tp_scale / jax.lax.axis_size(dp_axis)
+        tp_scale = tp_scale / _axis_size(dp_axis)
     s = jax.lax.axis_index(axis)
     S, M = n_stages, n_micro
     T = 2 * (M + S) - 2           # last op: B_{M-1} at stage 0, t = 2S+2M-3
@@ -287,7 +333,7 @@ def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
         # claims the invariance and averages any numeric jitter
         def _unvary(g, extra):
             for a in extra:
-                g = jax.lax.pmean(g, a)
+                g = _claim_mean(g, a)
             return g
         grads = jax.tree_util.tree_map(
             _unvary, grads, grad_extra,
@@ -305,12 +351,12 @@ def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
             # with the update math of already-reduced buckets. Bitwise
             # identical (pmean of a concatenation == concatenation of
             # pmeans).
-            from ..bucket import bucketed_pmean
-            grads = bucketed_pmean(grads, dp_axis,
-                                   float(grad_bucket_bytes))
+            from ..bucket import bucketed_pmean, bucketed_psum
+            fused = bucketed_pmean if _HAS_VMA else bucketed_psum
+            grads = fused(grads, dp_axis, float(grad_bucket_bytes))
         else:
             grads = jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(g, dp_axis), grads)
+                lambda g: _claim_mean(g, dp_axis), grads)
     grads = jax.tree_util.tree_map(lambda g: g[None], grads)
     return jnp.sum(losses) / M, grads
 
@@ -343,7 +389,8 @@ def _f1b_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
 # is F-then-B over virtual stages, which XLA overlaps freely.)
 
 def _vpp_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
-              n_stages, n_chunks, n_micro, axis):
+              n_stages, n_chunks, n_micro, axis, dp_axis=None,
+              grad_bucket_bytes=None):
     s = jax.lax.axis_index(axis)
     S, V, M = n_stages, n_chunks, n_micro
     P = V * S
@@ -352,9 +399,19 @@ def _vpp_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
     zero = jnp.zeros_like(x_micro[0])
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
     perm_bwd = [((i + 1) % S, i) for i in range(S)]
+    vaxes = (axis,) + ((dp_axis,) if dp_axis else ())
+    # params are dp-INVARIANT while data is dp-varying: the vjp
+    # auto-inserts a dp-psum into their cotangents (pvary transpose), so
+    # seed each dp shard with 1/D to make that psum the dp-MEAN of the
+    # per-shard grads — the same scaled-seed trick as _f1b_body
+    seed_scale = 1.0
+    if dp_axis is not None:
+        seed_scale = seed_scale / _axis_size(dp_axis)
 
     def _varying(v):
-        return jax.lax.pvary(v, (axis,))
+        cur = _vma_of(v)
+        missing = tuple(a for a in vaxes if a not in cur)
+        return _pvary(v, missing) if missing else v
 
     def chunk_params(v):
         return jax.tree_util.tree_map(lambda a: a[v], p_chunks)
@@ -405,8 +462,9 @@ def _vpp_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
                 return lo, y
 
             (lo, _y), vjp = jax.vjp(f, chunk_params(v), x)
-            dlo = jnp.where(is_last, 1.0 / M, 0.0).astype(lo.dtype)
-            dlo = dlo + jax.lax.pvary(jnp.zeros((), lo.dtype), (axis,))
+            dlo = jnp.where(is_last, (1.0 / M) * seed_scale,
+                            0.0).astype(lo.dtype)
+            dlo = dlo + _varying(jnp.zeros((), lo.dtype))
             dy = jnp.where(is_last, jnp.zeros_like(cts[v]), cts[v])
             dp, dx = vjp((dlo, dy))
             gsel = jnp.float32(valid)
@@ -431,13 +489,28 @@ def _vpp_body(params, shared, x_micro, labels_micro, *, stage_fn, loss_fn,
         btick, (_varying(acts0), _varying(grads0), _varying(losses0)),
         jnp.arange(T))
     losses = jax.lax.psum(losses, axis)
+    if dp_axis is not None:
+        # each dp shard holds the local-mean losses of ITS batch shard:
+        # this pmean is a REAL reduction to the global mean. Grads are
+        # already the dp-mean via the scaled seed + auto-psum above, so
+        # their reduction below only claims the (equal-valued) dp
+        # invariance for the out_specs — exactly like _f1b_body
+        losses = jax.lax.pmean(losses, dp_axis)
+        if grad_bucket_bytes:
+            from ..bucket import bucketed_pmean, bucketed_psum
+            fused = bucketed_pmean if _HAS_VMA else bucketed_psum
+            grads = fused(grads, dp_axis, float(grad_bucket_bytes))
+        else:
+            grads = jax.tree_util.tree_map(
+                lambda g: _claim_mean(g, dp_axis), grads)
     grads = jax.tree_util.tree_map(lambda g: g[:, None], grads)
     return jnp.sum(losses) / M, grads
 
 
 def pipeline_spmd_vpp(stage_fn: Callable, stacked_params, x_micro,
                       labels_micro, loss_fn: Callable, n_chunks: int,
-                      shared_params=None, mesh_axis: str = "pp"):
+                      shared_params=None, mesh_axis: str = "pp",
+                      dp_axis: str = None, grad_bucket_bytes=None):
     """Compiled interleaved virtual-pipeline (reference
     PipelineParallelWithInterleave, meta_parallel/pipeline_parallel.py:
     1174, as a single SPMD program). Each device holds ``n_chunks`` model
@@ -449,6 +522,12 @@ def pipeline_spmd_vpp(stage_fn: Callable, stacked_params, x_micro,
     Returns (mean loss, grads with the same [V, S, ...] leading axes).
     Backward recomputes each chunk from its saved input, so per-device
     residuals are the V*M chunk inputs only.
+
+    ``dp_axis`` / ``grad_bucket_bytes`` compose data parallelism the
+    same way ``pipeline_spmd_1f1b`` does: microbatches shard their
+    batch dim over ``dp_axis``, returned loss/grads are dp-means, and
+    the in-program dp grad reduction optionally coalesces into the
+    deterministic ``distributed.bucket`` plan.
     """
     mesh = mesh_mod.get_mesh()
     S = int(mesh.shape[mesh_axis])
@@ -456,6 +535,16 @@ def pipeline_spmd_vpp(stage_fn: Callable, stacked_params, x_micro,
     V = int(n_chunks)
     if shared_params is None:
         shared_params = ()
+    if dp_axis is not None:
+        if dp_axis not in mesh.shape or dp_axis == mesh_axis:
+            raise ValueError(
+                f"dp_axis {dp_axis!r} must name a mesh axis distinct "
+                f"from {mesh_axis!r}; mesh has {tuple(mesh.shape)}")
+        D = int(mesh.shape[dp_axis])
+        if x_micro.shape[1] % D != 0:
+            raise ValueError(
+                f"microbatch size {x_micro.shape[1]} not divisible by "
+                f"{dp_axis!r} degree {D}")
     for leaf in jax.tree_util.tree_leaves(stacked_params):
         if leaf.shape[0] != V or leaf.shape[1] != S:
             raise ValueError(
@@ -467,7 +556,8 @@ def pipeline_spmd_vpp(stage_fn: Callable, stacked_params, x_micro,
                   jax.tree_util.tree_leaves((stacked_params,
                                              shared_params)))
     key = ("vpp", id(mesh), mesh_axis, stage_fn, loss_fn, V, treedef,
-           avals, tuple(x_micro.shape), str(x_micro.dtype))
+           avals, tuple(x_micro.shape), str(x_micro.dtype), dp_axis,
+           None if not grad_bucket_bytes else float(grad_bucket_bytes))
     fn = _PIPE_CACHE.get(key)
     if fn is None:
         param_specs = jax.tree_util.tree_map(
@@ -475,10 +565,13 @@ def pipeline_spmd_vpp(stage_fn: Callable, stacked_params, x_micro,
             stacked_params)
         shared_specs = jax.tree_util.tree_map(lambda a: P(), shared_params)
         body = partial(_vpp_body, stage_fn=stage_fn, loss_fn=loss_fn,
-                       n_stages=S, n_chunks=V, n_micro=M, axis=mesh_axis)
-        fn = jax.jit(shard_map(
+                       n_stages=S, n_chunks=V, n_micro=M, axis=mesh_axis,
+                       dp_axis=dp_axis,
+                       grad_bucket_bytes=grad_bucket_bytes)
+        data_spec = P() if dp_axis is None else P(None, dp_axis)
+        fn = jax.jit(_shard_map(
             body, mesh=mesh,
-            in_specs=(param_specs, shared_specs, P(), P()),
+            in_specs=(param_specs, shared_specs, data_spec, data_spec),
             out_specs=(P(), param_specs)))
         _PIPE_CACHE[key] = fn
     loss, grads = fn(stacked_params, shared_params, x_micro, labels_micro)
@@ -488,7 +581,8 @@ def pipeline_spmd_vpp(stage_fn: Callable, stacked_params, x_micro,
 def pipeline_spmd_1f1b(stage_fn: Callable, stacked_params, x_micro,
                        labels_micro, loss_fn: Callable, shared_params=None,
                        mesh_axis: str = "pp", param_specs=None,
-                       dp_axis: str = None, grad_bucket_bytes=None):
+                       dp_axis: str = None, grad_bucket_bytes=None,
+                       virtual_stages: int = 1):
     """Compiled 1F1B: mean loss + stacked parameter grads in ONE program.
 
     stage_fn(stage_params, shared_params, x, stage_idx) -> y. Stage
@@ -518,10 +612,56 @@ def pipeline_spmd_1f1b(stage_fn: Callable, stacked_params, x_micro,
     grad reduction into deterministic size-targeted fused buckets
     (``distributed.bucket``): fewer collective dispatches, overlappable
     with the update math, bitwise identical to the per-leaf path.
+
+    ``virtual_stages`` (v, the Megatron interleaved-VPP knob) places
+    ``v`` model chunks on each pipeline device: stacked_params grow a
+    leading VIRTUAL-stage axis of size ``v * S`` (virtual stage
+    ``vs = v_chunk * S + s`` is chunk ``v_chunk`` on device ``s``) and
+    the schedule interleaves the chunks, shrinking the pipeline bubble
+    from ``(p-1)/m`` toward ``(p-1)/(v*m)``
+    (``cost_model.pipeline_bubble_fraction``). The interleaving is a
+    PURE SCHEDULE SHAPE: at any ``v`` the returned loss/grads are
+    bitwise identical to the non-interleaved run of the same
+    ``v * S``-virtual-stage model (every virtual stage applies the same
+    math and accumulates its microbatch grads in the same order) — the
+    bench gate executes exactly that comparison on the virtual mesh.
+    ``virtual_stages > 1`` composes with ``dp_axis`` /
+    ``grad_bucket_bytes`` but not (yet) with ``param_specs`` TP
+    sharding. Grads come back with the ``[v * S, ...]`` leading axis of
+    the stacked input.
     """
+    v = int(virtual_stages)
+    if v < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {v}")
     mesh = mesh_mod.get_mesh()
     S = int(mesh.shape[mesh_axis])
     M = int(x_micro.shape[0])
+    if v > 1:
+        if param_specs is not None:
+            raise NotImplementedError(
+                "pipeline_spmd_1f1b: virtual_stages > 1 does not "
+                "compose with param_specs TP sharding yet — shard the "
+                "stage body manually or run v=1")
+        for leaf in jax.tree_util.tree_leaves(stacked_params):
+            if leaf.shape[0] != v * S:
+                raise ValueError(
+                    f"stacked param leading axis {leaf.shape[0]} != "
+                    f"virtual_stages * pipeline degree = {v}*{S}="
+                    f"{v * S}")
+        # chunk-major placement: [v*S, ...] -> [V, S, ...] (virtual
+        # stage vs = chunk * S + s, i.e. contiguous runs of S virtual
+        # stages form one chunk ring lap)
+        chunked = jax.tree_util.tree_map(
+            lambda a: a.reshape((v, S) + tuple(a.shape[1:])),
+            stacked_params)
+        loss, grads = pipeline_spmd_vpp(
+            stage_fn, chunked, x_micro, labels_micro, loss_fn,
+            n_chunks=v, shared_params=shared_params,
+            mesh_axis=mesh_axis, dp_axis=dp_axis,
+            grad_bucket_bytes=grad_bucket_bytes)
+        grads = jax.tree_util.tree_map(
+            lambda g: g.reshape((v * S,) + tuple(g.shape[2:])), grads)
+        return loss, grads
     if shared_params is None:
         shared_params = ()
     if dp_axis is not None:
@@ -582,7 +722,7 @@ def pipeline_spmd_1f1b(stage_fn: Callable, stacked_params, x_micro,
                        tp_axes=tp_axes, grad_extra=grad_extra,
                        dp_axis=dp_axis, grad_bucket_bytes=grad_bucket_bytes)
         data_spec = P() if dp_axis is None else P(None, dp_axis)
-        fn = jax.jit(shard_map(
+        fn = jax.jit(_shard_map(
             body, mesh=mesh,
             in_specs=(param_specs, shared_specs, data_spec, data_spec),
             out_specs=(P(), param_specs)))

@@ -72,6 +72,20 @@ def axis_degrees() -> Dict[str, int]:
     return {k: int(v) for k, v in get_mesh().shape.items()}
 
 
+def traced_axis_size(name: str) -> int:
+    """Degree of mesh axis ``name`` as seen INSIDE a traced
+    shard_map/pmap body: prefers ``jax.lax.axis_size`` (the axis bound
+    in the trace — correct even for a caller-constructed Mesh that was
+    never installed via :func:`init_mesh`), falling back to the
+    installed mesh on old jax without the API. The ONE axis-size
+    resolution shared by the hierarchical collectives, the compiled
+    pipelines, and the collective-matmul kernels."""
+    import jax
+    if hasattr(jax.lax, "axis_size"):
+        return int(jax.lax.axis_size(name))
+    return axis_size(name)
+
+
 def group_size(axes: Sequence[str]) -> int:
     """Number of ranks in the communication group spanned by ``axes``
     (the group-size input to wire-traffic accounting)."""

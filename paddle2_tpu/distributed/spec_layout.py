@@ -109,12 +109,28 @@ class SpecLayout:
         env list + the ``"dcn"`` name convention)."""
         return self.link_model().is_dcn(axis)
 
+    def split_link_classes(self, axes: Sequence[str]
+                           ) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+        """Split a collective's mesh axes into ``(ici_axes,
+        dcn_axes)`` — the axis split the hierarchical collectives
+        (``collective.hierarchical_psum``) and the ladder's cost
+        accounting consume. Order within each class is preserved."""
+        lm = self.link_model()
+        ici = tuple(a for a in axes if not lm.is_dcn(a))
+        dcn = tuple(a for a in axes if lm.is_dcn(a))
+        return ici, dcn
+
     def link_model(self, ici_gbps: Optional[float] = None,
-                   dcn_gbps: Optional[float] = None):
+                   dcn_gbps: Optional[float] = None,
+                   ici_latency_us: Optional[float] = None,
+                   dcn_latency_us: Optional[float] = None):
         """The matching cost-model link table: this layout's dcn axes
-        charged at DCN bandwidth, everything else ICI."""
+        charged at DCN bandwidth (and, when given, per-dispatch DCN
+        latency), everything else ICI."""
         from ..observability.cost_model import LinkModel
         return LinkModel(ici_gbps=ici_gbps, dcn_gbps=dcn_gbps,
+                         ici_latency_us=ici_latency_us,
+                         dcn_latency_us=dcn_latency_us,
                          dcn_axes=self.dcn_axes)
 
 

@@ -297,6 +297,129 @@ def int8_matmul(x_int8, w_int8,
     )(x_int8, w_int8)
 
 
+# ------------------------------------------------------- collective matmul
+# Tensor-parallel projections spend their ICI time on the tp all-gather
+# that feeds (or follows) the matmul. A collective matmul decomposes the
+# gather into CHUNK-granular transfers interleaved with chunk-granular
+# MXU work, so each transfer rides under a dot it is independent of —
+# the latency-hiding scheduler (flags.apply_multichip_xla_env) then
+# hides the ICI time inside the MXU time instead of serializing
+# gather -> matmul. Two standard forms, both PURE SCHEDULE SHAPES
+# (bitwise identical to the unfused gather-then-matmul, gated on the
+# virtual mesh):
+#
+# * :func:`allgather_matmul` — input form (sequence-parallel Megatron):
+#   ``all_gather(x, tp) @ w`` as a ring; each step runs the chunk it
+#   holds through the dot while ``ppermute`` brings the next chunk in.
+# * :func:`matmul_allgather` — EPILOGUE form (column-parallel output
+#   re-replication): ``all_gather(x @ w_shard, tp)`` with the gather
+#   issued per OUTPUT TILE from the epilogue, so tile t's wire time
+#   overlaps tile t+1's dot.
+#
+# The per-chunk dot is pluggable (``matmul_fn``): the int8/int4
+# weight-only Pallas kernels above slot straight in, composing the
+# PR 10 quantized paths with the collective schedule. Cost accounting
+# goes through :func:`collective_matmul_traffic`: the gather's wire
+# bytes enter the model marked OVERLAPPABLE, which is exactly what the
+# cost model's exposed-vs-hidden overlap split prices.
+
+
+def _resolve_axis_size(axis_name, axis_size: Optional[int]) -> int:
+    if axis_size is not None:
+        return int(axis_size)
+    from ..distributed import mesh as _mesh  # lazy: avoid import cycle
+    return _mesh.traced_axis_size(axis_name)
+
+
+def allgather_matmul(x_shard, w, axis_name: str,
+                     axis_size: Optional[int] = None,
+                     matmul_fn=None):
+    """Ring collective matmul of the INPUT all-gather (shard_map
+    context): computes ``all_gather(x_shard, axis) @ w`` — ``x_shard``
+    is this rank's ``[rows/tp, K]`` slice — as ``tp`` chunk dots, each
+    independent of the in-flight ``ppermute`` bringing the next chunk,
+    so the gather's ICI time hides inside MXU time. Bitwise identical
+    to the unfused path: every output row block is produced by the
+    same-shaped dot on the same values, and the ring only moves data.
+    ``matmul_fn(chunk, w) -> [rows/tp, N]`` swaps the per-chunk dot
+    (e.g. a weight-only Pallas kernel); default is a plain ``@``."""
+    n = _resolve_axis_size(axis_name, axis_size)
+    dot = matmul_fn if matmul_fn is not None else (lambda c, ww: c @ ww)
+    if n == 1:
+        return dot(x_shard, w)
+    r = jax.lax.axis_index(axis_name)
+    rows = x_shard.shape[0]
+    first = dot(x_shard, w)
+    out = jnp.zeros((n * rows,) + first.shape[1:], first.dtype)
+    out = jax.lax.dynamic_update_slice_in_dim(out, first, r * rows, 0)
+    # descending ring: after k hops this rank holds rank (r + k) % n's
+    # original shard
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    cur = x_shard
+    for step in range(1, n):
+        cur = jax.lax.ppermute(cur, axis_name, perm)
+        src = (r + step) % n
+        y = dot(cur, w)
+        out = jax.lax.dynamic_update_slice_in_dim(out, y, src * rows, 0)
+    return out
+
+
+def matmul_allgather(x, w_shard, axis_name: str,
+                     axis_size: Optional[int] = None,
+                     tiles: int = 1, matmul_fn=None):
+    """Column-parallel matmul with the tp all-gather of the OUTPUT
+    fused into the epilogue: computes
+    ``all_gather(x @ w_shard, axis)`` (rank-major column blocks,
+    ``[..., tp * N_shard]``) but issues the gather per output TILE —
+    ``tiles`` column tiles per rank, each gathered as soon as its dot
+    finishes, so tile t's wire time overlaps tile t+1's MXU work.
+    Bitwise identical to the unfused gather: column tiles of a dot are
+    independent K-reductions and the gather only places blocks. (Keep
+    tiles MODERATE — a degenerate 1-wide column tile can change the
+    XLA CPU dot's reduction grouping by ~1 ulp, the same effect PR 9
+    pinned for gemm row counts; the acceptance tests run 1/2/4 tiles.)
+    ``matmul_fn(x, w_tile) -> [..., tile]`` swaps the per-tile dot."""
+    n = _resolve_axis_size(axis_name, axis_size)
+    dot = matmul_fn if matmul_fn is not None else (lambda xx, ww: xx @ ww)
+    nl = w_shard.shape[-1]
+    t = max(1, min(int(tiles), nl))
+    if nl % t:
+        raise ValueError(
+            f"matmul_allgather: {t} tiles must divide the local "
+            f"out-channel count {nl}")
+    bn = nl // t
+    y0 = dot(x, w_shard[..., :bn])
+    out = jnp.zeros(y0.shape[:-1] + (n * nl,), y0.dtype)
+    for ti in range(t):
+        y_t = y0 if ti == 0 else dot(
+            x, w_shard[..., ti * bn:(ti + 1) * bn])
+        if n == 1:
+            g = y_t[None]
+        else:
+            # leading rank dim [n, ..., bn]: rank r's tile block
+            g = jax.lax.all_gather(y_t, axis_name)
+        for rank in range(n):
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, g[rank], rank * nl + ti * bn, out.ndim - 1)
+    return out
+
+
+def collective_matmul_traffic(payload_bytes: float, tp: int,
+                              axes, traffic=None):
+    """Price one collective matmul's gather into a
+    :class:`~paddle2_tpu.observability.cost_model.CollectiveTraffic`
+    (created if not given): the all-gather's wire bytes enter the model
+    marked OVERLAPPABLE — hidden under the step's MXU time up to the
+    compute budget by the cost model's exposed-vs-hidden overlap split,
+    which is the whole point of fusing the gather into the matmul. The
+    unfused comparison prices the same bytes non-overlappable."""
+    from ..observability.cost_model import CollectiveTraffic
+    t = traffic if traffic is not None else CollectiveTraffic()
+    t.add("all_gather", float(payload_bytes), axes=tuple(axes),
+          group_size=int(tp), overlappable=True)
+    return t
+
+
 # ------------------------------------------------------------- fp8-shaped
 def fp8_supported() -> bool:
     """True when this jax build carries the fp8 dtypes (the kernels are
@@ -323,4 +446,6 @@ __all__ = ["channel_absmax", "quantize_channelwise",
            "weight_quant_error_bound", "int8_weight_only_matmul",
            "int4_weight_only_matmul", "pack_int4", "unpack_int4",
            "int8_matmul", "fp8_matmul", "fp8_supported", "wo_supported",
+           "allgather_matmul", "matmul_allgather",
+           "collective_matmul_traffic",
            "DEFAULT_BLOCK_M", "DEFAULT_BLOCK_N", "DEFAULT_BLOCK_K"]

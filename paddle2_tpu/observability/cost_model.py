@@ -65,12 +65,28 @@ PEAK_ENV = "PADDLE_PEAK_TFLOPS"
 HBM_ENV = "PADDLE_HBM_GBPS"
 ICI_ENV = "PADDLE_ICI_GBPS"
 DCN_ENV = "PADDLE_DCN_GBPS"
+ICI_LATENCY_ENV = "PADDLE_ICI_LATENCY_US"
+DCN_LATENCY_ENV = "PADDLE_DCN_LATENCY_US"
 DCN_AXES_ENV = "PADDLE_DCN_AXES"
 
 # defaults: v4/v5 ICI is ~100 GB/s per link per direction; DCN per host
-# lands around 12.5 GB/s (100 Gbps) — both env-overridable
-_DEFAULT_ICI_GBPS = 90.0
-_DEFAULT_DCN_GBPS = 12.5
+# lands around 12.5 GB/s (100 Gbps) — both env-overridable. These are
+# THE nominal wire rates every bench lane prices with: one shared pair
+# of names, so efficiencies stay comparable across lanes (a literal
+# duplicated inline would silently drift).
+DEFAULT_ICI_GBPS = 90.0
+DEFAULT_DCN_GBPS = 12.5
+_DEFAULT_ICI_GBPS = DEFAULT_ICI_GBPS
+_DEFAULT_DCN_GBPS = DEFAULT_DCN_GBPS
+# nominal per-dispatch collective setup cost (the α of an α+β link
+# model): ICI collectives launch in ~microseconds; a cross-slice DCN
+# collective pays multi-hop fabric + rendezvous setup (hundreds of
+# microseconds at pod scale). LinkModel defaults its latencies to ZERO
+# so existing cost×rate artifacts are bitwise unchanged — a lane that
+# wants latency-aware accounting opts in explicitly with these
+# nominals (or via env).
+DEFAULT_ICI_LATENCY_US = 1.0
+DEFAULT_DCN_LATENCY_US = 250.0
 
 
 def chip_peak(device=None) -> Tuple[float, float, str]:
@@ -185,19 +201,36 @@ def wire_bytes(op: str, payload_bytes: float, group_size: int) -> float:
 
 
 class LinkModel:
-    """Per-mesh-axis link bandwidth: ICI unless the axis is named in
+    """Per-mesh-axis link α+β cost: latency (α, per dispatch) plus
+    bandwidth (β, per byte). An axis is ICI unless named in
     ``dcn_axes`` (default: any axis whose name contains ``"dcn"``, plus
-    the ``PADDLE_DCN_AXES`` comma list)."""
+    the ``PADDLE_DCN_AXES`` comma list).
+
+    Latencies DEFAULT TO ZERO (pure-bandwidth model — every pre-ladder
+    artifact stays bitwise identical); a latency-aware lane passes
+    ``ici_latency_us``/``dcn_latency_us`` explicitly or sets the
+    ``PADDLE_{ICI,DCN}_LATENCY_US`` env. The α term is what makes
+    bucket sizing link-class-dependent: a latency-dominated DCN hop
+    wants FEWER, BIGGER buckets than ICI (see
+    ``distributed.bucket.link_bucket_bytes``)."""
 
     def __init__(self, ici_gbps: Optional[float] = None,
                  dcn_gbps: Optional[float] = None,
-                 dcn_axes: Optional[Iterable[str]] = None):
+                 dcn_axes: Optional[Iterable[str]] = None,
+                 ici_latency_us: Optional[float] = None,
+                 dcn_latency_us: Optional[float] = None):
         self.ici_bps = float(
             ici_gbps if ici_gbps is not None
             else os.environ.get(ICI_ENV, _DEFAULT_ICI_GBPS)) * 1e9
         self.dcn_bps = float(
             dcn_gbps if dcn_gbps is not None
             else os.environ.get(DCN_ENV, _DEFAULT_DCN_GBPS)) * 1e9
+        self.ici_latency_s = float(
+            ici_latency_us if ici_latency_us is not None
+            else os.environ.get(ICI_LATENCY_ENV, 0.0)) * 1e-6
+        self.dcn_latency_s = float(
+            dcn_latency_us if dcn_latency_us is not None
+            else os.environ.get(DCN_LATENCY_ENV, 0.0)) * 1e-6
         env_axes = os.environ.get(DCN_AXES_ENV, "")
         self.dcn_axes = set(a.strip() for a in env_axes.split(",")
                             if a.strip())
@@ -209,18 +242,33 @@ class LinkModel:
             return False
         return axis in self.dcn_axes or "dcn" in str(axis).lower()
 
+    def link_class(self, axes: Sequence[str] = ()) -> str:
+        """``"dcn"`` when the collective crosses ANY DCN-mapped axis
+        (the slow hop gates the whole group), else ``"ici"``."""
+        return "dcn" if any(self.is_dcn(a) for a in axes) else "ici"
+
     def bandwidth(self, axis: Optional[str]) -> float:
         return self.dcn_bps if self.is_dcn(axis) else self.ici_bps
 
+    def latency(self, axes: Sequence[str] = ()) -> float:
+        """Per-dispatch setup cost (α) of one collective over ``axes``:
+        the slowest link class it crosses."""
+        return (self.dcn_latency_s if self.link_class(axes) == "dcn"
+                else self.ici_latency_s)
+
     def seconds(self, bytes_on_wire: float,
                 axes: Sequence[str] = ()) -> float:
-        """Transfer time under the SLOWEST link the collective crosses
-        (a multi-axis group is gated by its weakest hop)."""
+        """α+β time of ONE collective dispatch: setup latency plus
+        transfer under the SLOWEST link it crosses (a multi-axis group
+        is gated by its weakest hop). With the default zero latencies
+        this is the pure-bandwidth figure it always was; multi-dispatch
+        cost is modeled as one :class:`CollectiveTraffic` entry per
+        dispatch."""
         if bytes_on_wire <= 0:
             return 0.0
         bw = min((self.bandwidth(a) for a in axes),
                  default=self.ici_bps)
-        return float(bytes_on_wire) / bw
+        return float(bytes_on_wire) / bw + self.latency(axes)
 
 
 class CollectiveTraffic:
@@ -247,6 +295,27 @@ class CollectiveTraffic:
             "overlappable": bool(overlappable),
             "wire_bytes": wire_bytes(op, payload_bytes, group_size)})
 
+    def add_hierarchical_all_reduce(self, payload_bytes: float,
+                                    ici_axes: Sequence[str],
+                                    dcn_axes: Sequence[str],
+                                    ici_group: int, dcn_group: int,
+                                    overlappable: bool = False) -> None:
+        """Price one HIERARCHICAL all-reduce (the ladder's grad sync):
+        in-slice reduce-scatter over the ICI axes, cross-slice
+        all-reduce of the 1/ici_group partial shard over DCN, in-slice
+        all-gather — the ``collective.hierarchical_psum`` schedule.
+        Against a flat all-reduce over the combined group this trades
+        ``2(n-1)/n × payload`` at DCN bandwidth for mostly-ICI traffic
+        plus a DCN hop carrying only ``payload / ici_group``."""
+        payload = float(payload_bytes)
+        ici_n, dcn_n = max(1, int(ici_group)), max(1, int(dcn_group))
+        self.add("reduce_scatter", payload, axes=ici_axes,
+                 group_size=ici_n, overlappable=overlappable)
+        self.add("all_reduce_sum", payload / ici_n, axes=dcn_axes,
+                 group_size=dcn_n, overlappable=overlappable)
+        self.add("all_gather", payload, axes=ici_axes,
+                 group_size=ici_n, overlappable=overlappable)
+
     def wire_bytes_total(self) -> float:
         return sum(e["wire_bytes"] for e in self.entries)
 
@@ -266,6 +335,22 @@ class CollectiveTraffic:
         return sum(link.seconds(e["wire_bytes"], e["axes"])
                    for e in self.entries)
 
+    def _entry_split(self, e: Dict[str, Any], link: LinkModel
+                     ) -> Tuple[str, float, float]:
+        """ONE owner of the α+β exposure rule, shared by
+        :meth:`overlap_split` and :meth:`overlap_split_by_class`:
+        returns ``(link_class, hideable_s, always_exposed_s)`` for one
+        entry. A non-overlappable dispatch is fully exposed; an
+        overlappable one hides only its bandwidth term — per-dispatch
+        setup latency (α) is fabric round-trip time pipelining cannot
+        absorb."""
+        s = link.seconds(e["wire_bytes"], e["axes"])
+        cls = link.link_class(e["axes"])
+        if not e["overlappable"]:
+            return cls, 0.0, s
+        alpha = link.latency(e["axes"]) if s > 0 else 0.0
+        return cls, s - alpha, alpha
+
     def overlap_split(self, link: Optional[LinkModel] = None,
                       compute_s: float = 0.0) -> Dict[str, float]:
         """Split this step's wire time into EXPOSED vs HIDDEN given the
@@ -274,20 +359,60 @@ class CollectiveTraffic:
         Deterministic model: overlappable entries hide under compute up
         to ``compute_s`` total (the latency-hiding scheduler cannot
         conjure more independent compute than the step has);
-        non-overlappable entries are always exposed. Returns
-        ``{"serial_s", "hideable_s", "hidden_s", "exposed_s"}`` with
-        ``serial_s == hidden_s + exposed_s`` exactly."""
+        non-overlappable entries are always exposed. Under an α+β link
+        model only the BANDWIDTH term of an overlappable dispatch is
+        hideable — per-dispatch latency is fabric/setup round-trip time
+        that pipelining cannot absorb, so every dispatch's α counts as
+        exposed (this is what makes bucket COUNT a real cost on
+        latency-dominated DCN links; with the default zero latencies it
+        changes nothing). Returns ``{"serial_s", "hideable_s",
+        "hidden_s", "exposed_s"}`` with ``serial_s == hidden_s +
+        exposed_s`` exactly."""
         link = link or LinkModel()
-        hideable = sum(link.seconds(e["wire_bytes"], e["axes"])
-                       for e in self.entries if e["overlappable"])
-        base_exposed = sum(link.seconds(e["wire_bytes"], e["axes"])
-                           for e in self.entries
-                           if not e["overlappable"])
+        hideable = 0.0
+        base_exposed = 0.0
+        for e in self.entries:
+            _cls, h, x = self._entry_split(e, link)
+            hideable += h
+            base_exposed += x
         hidden = min(hideable, max(0.0, float(compute_s)))
         return {"serial_s": hideable + base_exposed,
                 "hideable_s": hideable,
                 "hidden_s": hidden,
                 "exposed_s": base_exposed + (hideable - hidden)}
+
+    def overlap_split_by_class(self, link: Optional[LinkModel] = None,
+                               compute_s: float = 0.0
+                               ) -> Dict[str, Dict[str, float]]:
+        """The :meth:`overlap_split` attribution broken out PER LINK
+        CLASS (``"ici"`` vs ``"dcn"``), so a cross-slice DCN overlap
+        regression is nameable as such instead of collapsing into one
+        exposed-comm number. The hidden budget (what compute can
+        absorb) is allocated to each class proportionally to its
+        hideable wire time — deterministic, and the class figures sum
+        to the aggregate split's ``hidden_s``/``exposed_s`` exactly up
+        to float addition."""
+        link = link or LinkModel()
+        hideable = {"ici": 0.0, "dcn": 0.0}
+        base_exposed = {"ici": 0.0, "dcn": 0.0}
+        for e in self.entries:
+            cls, h, x = self._entry_split(e, link)
+            hideable[cls] += h
+            base_exposed[cls] += x
+        total_hideable = hideable["ici"] + hideable["dcn"]
+        hidden_total = min(total_hideable, max(0.0, float(compute_s)))
+        out: Dict[str, Dict[str, float]] = {}
+        for cls in ("ici", "dcn"):
+            share = (hideable[cls] / total_hideable
+                     if total_hideable > 0 else 0.0)
+            hidden = hidden_total * share
+            out[cls] = {
+                "serial_s": hideable[cls] + base_exposed[cls],
+                "hideable_s": hideable[cls],
+                "hidden_s": hidden,
+                "exposed_s": base_exposed[cls] + (hideable[cls] - hidden),
+            }
+        return out
 
     def by_op(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
@@ -338,6 +463,15 @@ class StepCost:
         compute available to hide it."""
         return self.overlap()["exposed_s"]
 
+    def exposed_network_by_class(self) -> Dict[str, float]:
+        """Exposed wire time split by link class:
+        ``{"ici": s, "dcn": s}`` (``overlap_split_by_class`` under this
+        step's own compute budget) — the per-class lane perf_doctor
+        reports next to the aggregate exposed-comm %."""
+        split = self.traffic.overlap_split_by_class(
+            self.link, self.compute_s())
+        return {cls: split[cls]["exposed_s"] for cls in ("ici", "dcn")}
+
     def exposed_comm_fraction(self) -> float:
         """Exposed wire time as a fraction of the modeled step
         (``exposed / (max(compute, memory) + exposed)``) — the number
@@ -384,7 +518,10 @@ class StepCost:
     def roofline(self) -> Dict[str, Any]:
         ai = self.arithmetic_intensity()
         ov = self.overlap()
+        by_class = self.exposed_network_by_class()
         return {
+            "exposed_network_ici_s": by_class["ici"],
+            "exposed_network_dcn_s": by_class["dcn"],
             "flops": self.flops,
             "hbm_bytes": self.hbm_bytes,
             "wire_bytes": self.traffic.wire_bytes_total(),
@@ -401,6 +538,24 @@ class StepCost:
             "ridge_point": self.ridge_point(),
             "chip": self.chip,
         }
+
+
+def pipeline_bubble_fraction(pp: int, microbatches: int,
+                             virtual_stages: int = 1) -> float:
+    """Idle-fraction of the 1F1B pipeline schedule as a multiple of the
+    useful compute: ``(p - 1) / (v * m)`` — the Megatron interleaved-VPP
+    figure (non-interleaved at v=1 is the classic ``(p-1)/m``). With
+    ``v`` virtual stages per device each warmup/cooldown slot costs
+    ``1/v`` of a full stage, which is exactly why the ladder's pp>=8
+    rungs need interleaving to clear the efficiency gate."""
+    p, m, v = int(pp), int(microbatches), int(virtual_stages)
+    if p <= 1:
+        return 0.0
+    if m < 1 or v < 1:
+        raise ValueError(
+            f"pipeline_bubble_fraction: microbatches={m} and "
+            f"virtual_stages={v} must be >= 1")
+    return (p - 1) / float(v * m)
 
 
 def chip_hbm_gb(device=None) -> float:
@@ -507,4 +662,8 @@ __all__ = ["CHIP_PEAKS", "CHIP_HBM_GB", "chip_peak", "chip_hbm_gb",
            "cost_analysis_of", "program_cost",
            "abstractify", "wire_bytes", "LinkModel", "CollectiveTraffic",
            "StepCost", "PhasedStepCost", "step_cost_of_program",
-           "PEAK_ENV", "HBM_ENV", "ICI_ENV", "DCN_ENV", "DCN_AXES_ENV"]
+           "pipeline_bubble_fraction",
+           "DEFAULT_ICI_GBPS", "DEFAULT_DCN_GBPS",
+           "DEFAULT_ICI_LATENCY_US", "DEFAULT_DCN_LATENCY_US",
+           "PEAK_ENV", "HBM_ENV", "ICI_ENV", "DCN_ENV", "DCN_AXES_ENV",
+           "ICI_LATENCY_ENV", "DCN_LATENCY_ENV"]

@@ -319,6 +319,20 @@ def summarize(streams: Dict[int, Dict[str, Any]],
             entry["exposed_comm_pct"] = (
                 100.0 * entry["mean_exposed_comm_s"]
                 / entry["mean_total_s"])
+        # ICI-vs-DCN components of the exposed-comm lane (cost x rate
+        # benches stamp exposed_comm_ici_s/exposed_comm_dcn_s from the
+        # cost model's per-link-class overlap split): a cross-slice DCN
+        # overlap regression is nameable as such instead of collapsing
+        # both wire classes into one number
+        for cls in ("ici", "dcn"):
+            key = f"exposed_comm_{cls}_s"
+            vals = [x[key] for x in steps if key in x]
+            if vals:
+                entry[f"mean_{key}"] = _mean(vals)
+                if entry["mean_total_s"] > 0:
+                    entry[f"exposed_comm_{cls}_pct"] = (
+                        100.0 * entry[f"mean_{key}"]
+                        / entry["mean_total_s"])
         toks = [x["tokens"] for x in steps if "tokens" in x]
         secs = [x["total_s"] for x in steps if "tokens" in x]
         if toks and sum(secs) > 0:
@@ -383,6 +397,14 @@ def summarize(streams: Dict[int, Dict[str, Any]],
                     if "exposed_comm_source" in e}
             agg["exposed_comm_source"] = (srcs.pop() if len(srcs) == 1
                                           else "mixed")
+        # per-link-class lanes aggregate only when EVERY rank carries
+        # them (same gating as the modeled/MFU lanes: a mixed stream
+        # would average a cost model against nothing)
+        for cls in ("ici", "dcn"):
+            cvals = [e.get(f"exposed_comm_{cls}_pct")
+                     for e in per.values()]
+            if cvals and all(v is not None for v in cvals):
+                agg[f"exposed_comm_{cls}_pct"] = _mean(cvals)
         # aggregate modeled lane only when EVERY rank carries it —
         # a mixed stream would average a cost model against nothing
         mods = [e.get("mean_modeled_step_s") for e in per.values()]
@@ -514,6 +536,15 @@ def diff(base: Dict[str, Any], new: Dict[str, Any],
             "base_source": sa, "new_source": sb,
             "comparable": sa == sb and sa is not None
             and sa != "mixed"}
+        # per-link-class deltas ride along when both streams carry the
+        # split, so the OVERLAP REGRESSION marker can name WHICH wire
+        # class stopped hiding (a grown DCN share is a cross-slice
+        # hierarchy/bucketing problem; a grown ICI share is in-slice)
+        for cls in ("ici", "dcn"):
+            ka = a.get(f"exposed_comm_{cls}_pct")
+            kb = b.get(f"exposed_comm_{cls}_pct")
+            if ka is not None and kb is not None:
+                out["exposed_comm_pct"][cls] = {"base": ka, "new": kb}
     # counter deltas that explain a regression (retries eat wall time)
     cdeltas = {}
     for cname in _RELIABILITY_COUNTERS:
@@ -556,8 +587,14 @@ def format_summary(report: Dict[str, Any], directory: str) -> str:
         L.append(f"  throughput: {agg['tokens_per_s_total']:,.0f} "
                  f"tokens/s aggregate")
     if "exposed_comm_pct" in agg:
+        split = ""
+        if ("exposed_comm_ici_pct" in agg
+                or "exposed_comm_dcn_pct" in agg):
+            split = (f" [ici {agg.get('exposed_comm_ici_pct', 0.0):.1f}%"
+                     f" + dcn "
+                     f"{agg.get('exposed_comm_dcn_pct', 0.0):.1f}%]")
         L.append(f"  exposed-comm: {agg['exposed_comm_pct']:.1f}% of "
-                 f"step (wire time NOT hidden under compute)")
+                 f"step (wire time NOT hidden under compute){split}")
     if "mfu_modeled" in agg:
         L.append(f"  MFU (modeled): {100.0 * agg['mfu_modeled']:.1f}% "
                  f"of chip peak over the roofline step time "
@@ -569,6 +606,10 @@ def format_summary(report: Dict[str, Any], directory: str) -> str:
         if "exposed_comm_pct" in e:
             extra += (f"  exposed-comm {e['exposed_comm_pct']:.1f}% "
                       f"[{e['exposed_comm_source']}]")
+            if "exposed_comm_dcn_pct" in e:
+                extra += (f" (ici "
+                          f"{e.get('exposed_comm_ici_pct', 0.0):.1f}%"
+                          f"/dcn {e['exposed_comm_dcn_pct']:.1f}%)")
         if "mfu_modeled" in e:
             extra += f"  MFU {100.0 * e['mfu_modeled']:.1f}%"
         if e.get("warmup_included"):
@@ -649,13 +690,26 @@ def format_diff(d: Dict[str, Any]) -> str:
     ec = d.get("exposed_comm_pct")
     if ec:
         if ec.get("comparable"):
-            tag = ("  (OVERLAP REGRESSION)"
-                   if ec["new"] > ec["base"] + 1.0 else "")
+            tag = ""
+            if ec["new"] > ec["base"] + 1.0:
+                # name the wire class that stopped hiding when the
+                # split lanes are present — a DCN regression is a
+                # cross-slice hierarchy/bucketing problem, an ICI one
+                # is in-slice overlap
+                cls_tags = [cls.upper() for cls in ("dcn", "ici")
+                            if ec.get(cls)
+                            and ec[cls]["new"] > ec[cls]["base"] + 1.0]
+                tag = (f"  ({' + '.join(cls_tags)} OVERLAP REGRESSION)"
+                       if cls_tags else "  (OVERLAP REGRESSION)")
         else:
             tag = (f"  [incomparable: {ec['base_source']} vs "
                    f"{ec['new_source']}]")
         L.append(f"  exposed-comm: {ec['base']:.1f}% -> "
                  f"{ec['new']:.1f}% of step{tag}")
+        for cls in ("ici", "dcn"):
+            if ec.get(cls):
+                L.append(f"    {cls}: {ec[cls]['base']:.1f}% -> "
+                         f"{ec[cls]['new']:.1f}%")
     mf = d.get("mfu_modeled")
     if mf:
         if mf.get("comparable"):
