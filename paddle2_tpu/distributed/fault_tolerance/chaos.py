@@ -65,7 +65,8 @@ from ...flags import define_flag, flag_value
 KINDS = ("corrupt_shard", "truncate_shard", "fail_commit", "poison_loss",
          "delay_collective", "worker_crash", "poison_grads",
          "stall_collective", "kill_rank", "flip_bits",
-         "kill_engine", "drop_decode_step", "corrupt_block_table")
+         "kill_engine", "drop_decode_step", "corrupt_block_table",
+         "corrupt_spill_block", "drop_migration")
 
 _FLIP_WHERES = ("grads", "collective")
 
@@ -540,6 +541,40 @@ def maybe_corrupt_block_table(block_lists) -> Optional[int]:
     return pos
 
 
+def maybe_corrupt_spill_block(host_tier) -> Optional[tuple]:
+    """Serving-engine step hook (ISSUE 16 host tier): flip one byte of
+    the oldest spilled block's payload while keeping its stored CRC —
+    the deterministic stand-in for a host-DMA scribble. The next fetch
+    of that prefix MUST detect the mismatch and fall back to
+    re-prefill. Ticks only when the tier holds something to corrupt,
+    so the one-shot fire is never consumed by an empty tier. Returns
+    the corrupted prefix key, or None."""
+    if _ACTIVE is None or host_tier is None or len(host_tier) == 0:
+        return None
+    if "corrupt_spill_block" not in _ACTIVE.targets:
+        return None
+    if not _ACTIVE.should_fire("corrupt_spill_block"):
+        return None
+    key = host_tier.corrupt_one()
+    _ACTIVE.record("corrupt_spill_block", f"{len(key)} prefix tokens"
+                   if key is not None else "empty")
+    return key
+
+
+def maybe_drop_migration() -> bool:
+    """Failover-router hook (ISSUE 16): lose one KV migration transfer
+    on the virtual DCN — the adopter must fall back to re-prefilling
+    from the harvested token log, costing time, never tokens."""
+    if _ACTIVE is None:
+        return False
+    if "drop_migration" not in _ACTIVE.targets:
+        return False
+    if _ACTIVE.should_fire("drop_migration"):
+        _ACTIVE.record("drop_migration", "kv transfer dropped")
+        return True
+    return False
+
+
 def maybe_poison_grads(optimizer) -> None:
     """GradScaler unscale hook: overwrite every gradient with NaN, the
     deterministic stand-in for an fp16 overflow — drives the skip-step
@@ -566,4 +601,5 @@ __all__ = ["ChaosInjector", "arm", "disarm", "active", "fired_log",
            "maybe_flip_bits_array", "compiled_grad_fault",
            "apply_compiled_grad_fault", "maybe_kill_engine",
            "maybe_drop_decode_step", "maybe_corrupt_block_table",
+           "maybe_corrupt_spill_block", "maybe_drop_migration",
            "CORRUPT_BLOCK_ID", "KINDS"]

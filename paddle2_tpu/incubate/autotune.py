@@ -185,9 +185,14 @@ _LN_FLOPS_PER_ELEM = 8.0        # two reduction passes + normalize+affine
 _GELU_FLOPS_PER_ELEM = 12.0     # tanh-approx gelu
 _ADD_FLOPS_PER_ELEM = 1.0
 
-# host-offload link (PCIe-class; v5e host DMA lands ~25 GB/s per dir)
-OFFLOAD_ENV = "PADDLE_OFFLOAD_GBPS"
-_DEFAULT_OFFLOAD_GBPS = 25.0
+# host-offload link rate is owned by cost_model (shared with the
+# serving KV spill tier — same channel, one owner, no drift); the old
+# local names stay as aliases for compatibility.
+from ..observability.cost_model import (
+    HOST_ENV as OFFLOAD_ENV,
+    DEFAULT_HOST_GBPS as _DEFAULT_OFFLOAD_GBPS,
+    host_link_bps as _host_link_bps,
+)
 
 
 @dataclass
@@ -331,9 +336,7 @@ def search_remat_policy(*, hidden: int, num_layers: int, num_heads: int,
         p, h, _ = chip_peak()
         peak_flops = peak_flops if peak_flops is not None else p
         hbm_bps = hbm_bps if hbm_bps is not None else h
-    offload_bps = float(
-        offload_gbps if offload_gbps is not None
-        else os.environ.get(OFFLOAD_ENV, _DEFAULT_OFFLOAD_GBPS)) * 1e9
+    offload_bps = _host_link_bps(offload_gbps)
     F = int(ffn if ffn is not None else 4 * hidden)
     tokens = int(batch) * int(seq)
     t, H = float(tokens), float(hidden)

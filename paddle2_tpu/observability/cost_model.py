@@ -88,6 +88,25 @@ _DEFAULT_DCN_GBPS = DEFAULT_DCN_GBPS
 DEFAULT_ICI_LATENCY_US = 1.0
 DEFAULT_DCN_LATENCY_US = 250.0
 
+# host-offload link (PCIe-class; v5e host DMA lands ~25 GB/s per dir).
+# Owned here so the remat offload policy (incubate/autotune.py) and the
+# serving KV spill tier price the SAME channel from one pair of names —
+# a literal duplicated in each lane would silently drift. The env name
+# predates this move and is kept for compatibility.
+HOST_ENV = "PADDLE_OFFLOAD_GBPS"
+DEFAULT_HOST_GBPS = 25.0
+_DEFAULT_HOST_GBPS = DEFAULT_HOST_GBPS
+
+
+def host_link_bps(override_gbps=None) -> float:
+    """Host<->device offload-link rate in bytes/s (env-overridable).
+
+    ``override_gbps`` (GB/s) wins over the ``PADDLE_OFFLOAD_GBPS`` env
+    var, which wins over :data:`DEFAULT_HOST_GBPS`."""
+    if override_gbps is not None:
+        return float(override_gbps) * 1e9
+    return float(os.environ.get(HOST_ENV, DEFAULT_HOST_GBPS)) * 1e9
+
 
 def chip_peak(device=None) -> Tuple[float, float, str]:
     """(peak_flops, hbm_bytes_per_s, label) for ``device`` (default:
@@ -665,5 +684,6 @@ __all__ = ["CHIP_PEAKS", "CHIP_HBM_GB", "chip_peak", "chip_hbm_gb",
            "pipeline_bubble_fraction",
            "DEFAULT_ICI_GBPS", "DEFAULT_DCN_GBPS",
            "DEFAULT_ICI_LATENCY_US", "DEFAULT_DCN_LATENCY_US",
+           "DEFAULT_HOST_GBPS", "HOST_ENV", "host_link_bps",
            "PEAK_ENV", "HBM_ENV", "ICI_ENV", "DCN_ENV", "DCN_AXES_ENV",
            "ICI_LATENCY_ENV", "DCN_LATENCY_ENV"]

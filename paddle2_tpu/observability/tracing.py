@@ -86,10 +86,13 @@ _DEFAULT_MAX_EVENTS = 200_000
 # exactly representable in the 53-bit mantissa on the way in
 PS_PER_S = 10 ** 12
 
-# decomposition components, canonical order (host is the residual)
+# decomposition components, canonical order (host is the residual).
+# spill_fetch_s: KV-tier promotion stalls (host-link / peer-DCN
+# fetches at admission); migration_stall_s: failover KV migration
+# transfers (ISSUE 16) — both exact intervals, not residuals.
 COMPONENTS = ("queue_wait_s", "prefill_s", "decode_compute_s",
               "eviction_stall_s", "failover_stall_s", "swap_stall_s",
-              "host_s")
+              "spill_fetch_s", "migration_stall_s", "host_s")
 
 # which waiting-interval cause feeds which component
 _WAIT_COMPONENT = {"queue": "queue_wait_s", "evict": "eviction_stall_s",
@@ -412,7 +415,8 @@ def decompose_request(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     last_fwd_end_ps: Optional[int] = None
     last_fwd_comp: Optional[str] = None
     counts = {"evictions": 0, "retries": 0, "failovers": 0,
-              "corruptions": 0, "swaps": 0}
+              "corruptions": 0, "swaps": 0, "spill_fetches": 0,
+              "migrations": 0}
     shed = False
     error = None
     tokens: Optional[int] = None
@@ -445,6 +449,35 @@ def decompose_request(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             last_fwd_end_ps, last_fwd_comp = end, "prefill_s"
             if first_token_ps is None:
                 first_token_ps = end
+        elif name == "spill_fetch":
+            # KV-tier promotion (host-link or peer-DCN fetch): starts
+            # exactly where the prefill interval ends, so it charges
+            # its own component without overlapping prefill_s. It IS
+            # forward work — the clip rule applies if a stall opens
+            # mid-fetch — and it delays the first token when it backs
+            # the first prefill.
+            end = _end_ps(rec)
+            comps_ps["spill_fetch_s"] += end - t_ps
+            if first_token_ps == last_fwd_end_ps and \
+                    last_fwd_end_ps == t_ps:
+                first_token_ps = end
+            last_fwd_end_ps, last_fwd_comp = end, "spill_fetch_s"
+            counts["spill_fetches"] += 1
+        elif name == "migrate":
+            # failover KV migration (ISSUE 16): the transfer rides
+            # INSIDE the failover wait window, so the open wait is
+            # credited up to the migration start, the transfer gets
+            # its own exact component, and the wait reopens at the
+            # transfer's end (admission is gated on kv_ready_t, so
+            # the re-admit stamp can never precede it)
+            end = _end_ps(rec)
+            if wait_start_ps is not None:
+                comps_ps[_WAIT_COMPONENT[wait_cause]] += \
+                    t_ps - wait_start_ps
+            comps_ps["migration_stall_s"] += end - t_ps
+            wait_start_ps = end
+            wait_cause = "failover"
+            counts["migrations"] += 1
         elif name in ("decode_step", "decode_step_dropped"):
             end = _end_ps(rec)
             comps_ps["decode_compute_s"] += end - t_ps

@@ -28,10 +28,18 @@ Entry points:
   :class:`EngineFailoverRouter`, and zero-drop weight hot-swap
   (:class:`HotSwapController`) — gated by
   ``bench.py --serving-reliability``.
+* Fleet-global KV (ISSUE 16) — the HBM -> host -> peer-DCN prefix
+  ladder: :class:`HostKVTier` (CRC-verified host-DRAM spill tier),
+  :class:`FleetKVRegistry` (peer fetch over DCN, priced against
+  re-prefill by the PR 14 LinkModel), prefix-affinity routing and
+  KV migration instead of re-prefill on failover, audited
+  cross-tier by :func:`audit_kv_ledger` — gated by
+  ``bench.py --fleet-kv``.
 """
 
 from .block_cache import (BlockAllocator, BlockTable, PagedKVCache,
-                          PrefixCache, blocks_for_tokens, GARBAGE_BLOCK)
+                          PrefixCache, HostKVTier, audit_kv_ledger,
+                          blocks_for_tokens, GARBAGE_BLOCK)
 from .block_cache import OutOfBlocksError, BlockFreeError
 from .paged_attention import (paged_attention_decode,
                               paged_attention_reference,
@@ -49,11 +57,11 @@ from .engine import ServingEngine, EngineConfig
 from .simulate import (ServingSimReport, simulate_serving,
                        simulate_predictor_baseline, poisson_trace,
                        EngineFailoverRouter, RouterSimReport,
-                       simulate_router)
+                       simulate_router, FleetKVRegistry)
 
 __all__ = [
     "BlockAllocator", "BlockTable", "PagedKVCache", "PrefixCache",
-    "blocks_for_tokens",
+    "HostKVTier", "audit_kv_ledger", "blocks_for_tokens",
     "GARBAGE_BLOCK", "OutOfBlocksError", "BlockFreeError",
     "paged_attention_decode", "paged_attention_reference",
     "paged_attention_split_reference", "gathered_dense_kv",
@@ -68,4 +76,5 @@ __all__ = [
     "ServingSimReport", "simulate_serving", "simulate_predictor_baseline",
     "poisson_trace",
     "EngineFailoverRouter", "RouterSimReport", "simulate_router",
+    "FleetKVRegistry",
 ]

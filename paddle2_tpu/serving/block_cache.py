@@ -57,14 +57,15 @@ read-only and therefore exact:
 
 from __future__ import annotations
 
+import zlib
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 __all__ = ["BlockAllocator", "BlockTable", "PagedKVCache",
-           "PrefixCache", "blocks_for_tokens", "GARBAGE_BLOCK",
-           "BlockFreeError"]
+           "PrefixCache", "HostKVTier", "audit_kv_ledger",
+           "blocks_for_tokens", "GARBAGE_BLOCK", "BlockFreeError"]
 
 # physical block id every padded/inactive batch row writes into
 GARBAGE_BLOCK = 0
@@ -462,6 +463,160 @@ class PagedKVCache:
         return g.reshape((-1,) + g.shape[2:])
 
 
+class HostKVTier:
+    """Pinned-host-DRAM spill tier for cold prefix blocks (ISSUE 16).
+
+    The second rung of the HBM -> host -> peer-DCN KV ladder: when the
+    allocator's reclaimer would DISCARD a cold cached prefix block,
+    the block's raw K/V bytes are copied here first — keyed by the
+    SAME chained prefix-tuple key the :class:`PrefixCache` uses, so a
+    later hit on the spilled prefix fetches the bytes back instead of
+    re-prefilling. Host entries are BYTES, not allocator block ids:
+    the allocator's ownership invariant (free + referenced == usable,
+    every block owned exactly once) is untouched by spilling, which is
+    what keeps ``rebuild_free_list`` auditable across tiers.
+
+    Every payload is stamped with a CRC at spill time and verified at
+    fetch: a scribbled spill (chaos ``corrupt_spill_block``, a real
+    host-DMA fault) is DROPPED at fetch, so the consumer falls back to
+    re-prefill — corruption can cost time, never correctness. The tier
+    keeps its own LRU ledger; ``capacity_blocks`` bounds occupancy
+    (oldest spills evicted — the ladder's final discard)."""
+
+    def __init__(self, capacity_blocks: Optional[int] = None):
+        # key -> (k_bytes, v_bytes, crc); _lru tracks recency
+        self._entries: Dict[tuple, Tuple[np.ndarray, np.ndarray, int]] = {}
+        self._lru: "OrderedDict[tuple, None]" = OrderedDict()
+        self.capacity_blocks = capacity_blocks
+        self.spilled = 0          # put()s (blocks entering the tier)
+        self.fetched = 0          # pop()s (blocks promoted back to HBM)
+        self.evictions = 0        # LRU discards past capacity
+        self.corrupt_drops = 0    # CRC mismatches dropped at get()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
+
+    @staticmethod
+    def _crc(k_np: np.ndarray, v_np: np.ndarray) -> int:
+        return zlib.crc32(v_np.tobytes(), zlib.crc32(k_np.tobytes()))
+
+    def put(self, key: tuple, k_np: np.ndarray, v_np: np.ndarray) -> None:
+        """Spill one block's K/V bytes under ``key`` (host-owned
+        copies; the CRC is stamped from the copies so a later fetch
+        verifies exactly what was stored)."""
+        k = np.array(k_np, copy=True)
+        v = np.array(v_np, copy=True)
+        self._entries[key] = (k, v, self._crc(k, v))
+        self._lru[key] = None
+        self._lru.move_to_end(key)
+        self.spilled += 1
+        while self.capacity_blocks is not None and \
+                len(self._entries) > self.capacity_blocks:
+            old, _ = self._lru.popitem(last=False)
+            del self._entries[old]
+            self.evictions += 1
+
+    def get(self, key: tuple
+            ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Verified, NON-destructive read. A CRC mismatch drops the
+        entry and returns None — the caller re-prefills; serving a
+        scribbled payload would be silent KV corruption."""
+        ent = self._entries.get(key)
+        if ent is None:
+            return None
+        k, v, crc = ent
+        if self._crc(k, v) != crc:
+            del self._entries[key]
+            del self._lru[key]
+            self.corrupt_drops += 1
+            return None
+        self._lru.move_to_end(key)
+        return k, v
+
+    def pop(self, key: tuple) -> None:
+        """Retire ``key`` after a successful promotion back to HBM —
+        a prefix lives in exactly one tier at a time."""
+        if key in self._entries:
+            del self._entries[key]
+            del self._lru[key]
+            self.fetched += 1
+
+    def keys(self) -> List[tuple]:
+        return list(self._entries)
+
+    def corrupt_one(self) -> Optional[tuple]:
+        """Chaos helper (``corrupt_spill_block``): flip one byte of
+        the OLDEST entry's K payload, keeping the stored CRC — the
+        next ``get`` must detect it. Returns the key hit (None when
+        the tier is empty). Deterministic: oldest entry, first byte."""
+        for key in self._lru:
+            k, v, crc = self._entries[key]
+            k = np.array(k, copy=True)
+            raw = k.view(np.uint8).reshape(-1)
+            raw[0] ^= 0xFF
+            self._entries[key] = (k, v, crc)
+            return key
+        return None
+
+
+def audit_kv_ledger(allocator: BlockAllocator, live_block_lists,
+                    prefix_cache: Optional["PrefixCache"] = None,
+                    in_migration=(), host_tier: Optional[HostKVTier] = None
+                    ) -> Dict[str, int]:
+    """Cross-tier ownership audit (ISSUE 16): every usable block is
+    owned EXACTLY once — on the free list, or referenced with a
+    refcount equal to its claim multiplicity across the live tables,
+    the prefix cache's own holds, and any in-migration claim list —
+    and ``free + claimed == usable``. Host-tier entries are byte
+    payloads, never allocator ids, so they cannot alias device blocks
+    by construction; the audit reports their count so the property
+    test can close the whole ladder. Raises :class:`BlockFreeError`
+    on any violation; returns the tier census when clean."""
+    claims: Dict[int, int] = {}
+    lists = [list(l) for l in live_block_lists]
+    if prefix_cache is not None:
+        lists.append(prefix_cache.held_blocks())
+    lists.append(list(in_migration))
+    for lst in lists:
+        for b in lst:
+            b = int(b)
+            if b == GARBAGE_BLOCK:
+                continue
+            claims[b] = claims.get(b, 0) + 1
+    free = list(allocator._free)
+    usable = allocator.num_blocks - 1
+    if len(set(free)) != len(free):
+        raise BlockFreeError("free list holds a duplicate id")
+    for b in free:
+        if not (0 < b < allocator.num_blocks):
+            raise BlockFreeError(f"free list holds bad id {b}")
+        if b in claims:
+            raise BlockFreeError(
+                f"block {b} is both free and claimed — owned twice")
+    for b, c in claims.items():
+        if not (0 < b < allocator.num_blocks):
+            raise BlockFreeError(f"claim on out-of-range block {b}")
+        if allocator.refcount(b) != c:
+            raise BlockFreeError(
+                f"block {b}: refcount {allocator.refcount(b)} != claim "
+                f"multiplicity {c}")
+    for b in allocator._rc:
+        if b not in claims:
+            raise BlockFreeError(
+                f"block {b} allocated (rc={allocator._rc[b]}) but "
+                f"claimed by no table, cache, or migration")
+    if len(free) + len(claims) != usable:
+        raise BlockFreeError(
+            f"ledger does not close: {len(free)} free + {len(claims)} "
+            f"claimed != {usable} usable")
+    return {"free": len(free), "claimed": len(claims),
+            "host_tier": len(host_tier) if host_tier is not None else 0,
+            "in_migration": len(list(in_migration))}
+
+
 class PrefixCache:
     """Content-addressed cache of full prompt-prefix blocks (CoW
     prefix sharing, the vLLM automatic-prefix-caching design).
@@ -485,7 +640,8 @@ class PrefixCache:
     """
 
     def __init__(self, allocator: BlockAllocator,
-                 max_blocks: Optional[int] = None):
+                 max_blocks: Optional[int] = None,
+                 host_tier: Optional[HostKVTier] = None):
         self._alloc = allocator
         self.block_size = allocator.block_size
         # prefix-key tuple -> block id; _lru tracks use recency for
@@ -496,7 +652,42 @@ class PrefixCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # ISSUE 16 tiering: host-DRAM spill tier + the device-byte I/O
+        # hooks (engine-installed: gather(block) -> (k, v) host arrays,
+        # scatter(block, k, v) writes them back) and an optional peer
+        # source (fleet-installed: missing keys -> payloads + modeled
+        # DCN seconds). All None = PR 13 HBM-only behavior.
+        self.host_tier = host_tier
+        self._gather = None
+        self._scatter = None
+        self._peer_fetch = None
+        self.host_fetches = 0
+        self.peer_fetches = 0
+        self.spills = 0
+        # per-lookup attribution for the admission path's stall
+        # accounting (the engine charges spill_fetch_s from these)
+        self.last_host_fetched = 0
+        self.last_peer_fetched = 0
+        self.last_peer_fetch_s = 0.0
         allocator.set_reclaimer(self)
+
+    def set_spill_io(self, gather, scatter) -> None:
+        """Install the device-byte movers the spill tier rides on:
+        ``gather(block) -> (k_np, v_np)`` and
+        ``scatter(block, k_np, v_np)`` (the engine owns the pools —
+        they are reassigned after every donated program, so the cache
+        must go through closures, not a pool reference)."""
+        self._gather = gather
+        self._scatter = scatter
+
+    def set_peer_source(self, fetch) -> None:
+        """Install the fleet's peer tier: ``fetch(missing_keys) ->
+        (payloads, modeled_seconds)`` returns device bytes for a
+        leading run of ``missing_keys`` from ONE peer over DCN — or
+        ``([], 0.0)`` when no peer holds them or the modeled transfer
+        loses to modeled re-prefill (the registry owns that cost-model
+        decision)."""
+        self._peer_fetch = fetch
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -514,21 +705,132 @@ class PrefixCache:
         path) every returned block gains this sequence's reference and
         the hit/miss ledger advances; ``share=False`` peeks (admission
         feasibility checks)."""
+        keys = self._keys(tokens)
         blocks: List[int] = []
-        for key in self._keys(tokens):
+        for key in keys:
             b = self._entries.get(key)
             if b is None:
                 break
             blocks.append(b)
             if share:
                 self._lru.move_to_end(key)
+        self.last_host_fetched = 0
+        self.last_peer_fetched = 0
+        self.last_peer_fetch_s = 0.0
         if share:
             if blocks:
-                self.hits += 1
+                # share the HBM chain FIRST: the fetch loops below
+                # allocate, which may trigger reclaim — the
+                # requester's references pin these blocks (refcount 2)
+                # so the reclaimer cannot evict them mid-lookup
                 self._alloc.share(blocks)
+            self._fetch_host(keys, blocks)
+            self._fetch_peer(keys, blocks)
+            if blocks:
+                self.hits += 1
             else:
                 self.misses += 1
         return blocks, len(blocks) * self.block_size
+
+    def _adopt_fetched(self, key: tuple, payload) -> Optional[int]:
+        """Promote one fetched payload into a fresh HBM block owned by
+        the cache (allocate's reference) AND shared to the requester.
+        Returns the block id, or None when the pool cannot cover it
+        (the caller stops fetching — re-prefill covers the rest)."""
+        if self._scatter is None:
+            return None
+        try:
+            nb = self._alloc.allocate(1)[0]
+        except OutOfBlocksError:
+            return None
+        self._scatter(nb, payload[0], payload[1])
+        self._entries[key] = nb
+        self._lru[key] = nb
+        self._alloc.share([nb])
+        return nb
+
+    def _fetch_host(self, keys: List[tuple], blocks: List[int]) -> int:
+        """Extend a commit-path lookup's chain from the host tier:
+        verified payloads are scattered back into fresh HBM blocks
+        (spill-tier promotion). Stops at the first miss, CRC drop, or
+        allocation failure — everything past that re-prefills."""
+        if self.host_tier is None:
+            return 0
+        fetched = 0
+        for key in keys[len(blocks):]:
+            payload = self.host_tier.get(key)
+            if payload is None:
+                break
+            nb = self._adopt_fetched(key, payload)
+            if nb is None:
+                break
+            self.host_tier.pop(key)
+            blocks.append(nb)
+            fetched += 1
+        self.host_fetches += fetched
+        self.last_host_fetched = fetched
+        return fetched
+
+    def _fetch_peer(self, keys: List[tuple], blocks: List[int]) -> int:
+        """Extend the chain from a peer engine over DCN (the fleet
+        registry's cost-model decision already chose transfer over
+        re-prefill when this returns payloads)."""
+        if self._peer_fetch is None:
+            return 0
+        missing = keys[len(blocks):]
+        if not missing:
+            return 0
+        payloads, seconds = self._peer_fetch(missing)
+        if not payloads:
+            return 0
+        fetched = 0
+        for key, payload in zip(missing, payloads):
+            nb = self._adopt_fetched(key, payload)
+            if nb is None:
+                break
+            blocks.append(nb)
+            fetched += 1
+        if fetched:
+            self.peer_fetches += fetched
+            self.last_peer_fetched = fetched
+            # a partial promotion pays for the blocks it landed
+            self.last_peer_fetch_s = float(seconds) * (fetched
+                                                       / len(payloads))
+        return fetched
+
+    def cached_prefix_tokens(self, tokens) -> int:
+        """Read-only: the longest block-aligned prefix of ``tokens``
+        servable WITHOUT recompute from this engine's tiers (HBM chain
+        + host-tier extension). No references taken, no fetches — the
+        prefix-affinity router and the peer advertisement both consult
+        this."""
+        n = 0
+        for key in self._keys(tokens):
+            if key in self._entries or (self.host_tier is not None
+                                        and key in self.host_tier):
+                n += 1
+            else:
+                break
+        return n * self.block_size
+
+    def export_chain(self, keys: List[tuple]
+                     ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Gather the payload bytes for a leading run of ``keys`` this
+        engine holds (HBM first, then host tier) — the peer-fetch /
+        migration SOURCE side. Stops at the first miss or corrupt
+        spill. Copies leave the local tiers untouched."""
+        out: List[Tuple[np.ndarray, np.ndarray]] = []
+        for key in keys:
+            b = self._entries.get(key)
+            if b is not None and self._gather is not None:
+                out.append(self._gather(b))
+                continue
+            payload = (self.host_tier.get(key)
+                       if self.host_tier is not None else None)
+            if payload is None:
+                break
+            out.append(payload)
+        return out
 
     def insert(self, tokens, blocks: List[int],
                n_prefix_tokens: Optional[int] = None) -> int:
@@ -581,8 +883,12 @@ class PrefixCache:
     def reclaim(self, n: int) -> int:
         """Evict up to ``n`` reclaimable blocks, least-recently-used
         first; blocks still shared with live sequences are skipped
-        (deferred until their last release). Returns how many were
-        actually freed."""
+        (deferred until their last release). With a host tier wired
+        (ISSUE 16) eviction prefers SPILL over discard: the block's
+        bytes move to host DRAM under the same prefix key before the
+        HBM block returns to the free list, so cache pressure degrades
+        to a fetch, not a recompute. Returns how many were actually
+        freed."""
         if n <= 0:
             return 0
         freed = 0
@@ -592,6 +898,10 @@ class PrefixCache:
             b = self._entries[key]
             if self._alloc.refcount(b) != 1:
                 continue
+            if self.host_tier is not None and self._gather is not None:
+                k_np, v_np = self._gather(b)
+                self.host_tier.put(key, k_np, v_np)
+                self.spills += 1
             del self._entries[key]
             del self._lru[key]
             self._alloc.free([b])

@@ -30,8 +30,8 @@ from typing import Dict, List, Optional, Sequence as Seq, Tuple
 
 import numpy as np
 
-from .block_cache import (BlockAllocator, PagedKVCache, PrefixCache,
-                          blocks_for_tokens, GARBAGE_BLOCK)
+from .block_cache import (BlockAllocator, HostKVTier, PagedKVCache,
+                          PrefixCache, blocks_for_tokens, GARBAGE_BLOCK)
 from .model_runner import PagedGPTRunner
 from .reliability import (EngineFailedError, PromptTooLongError,
                           ReliabilityConfig, RequestRejected,
@@ -86,6 +86,19 @@ class EngineConfig:
     # kernel's own VMEM-fit auto dispatch — PR 9 behavior at every
     # context PR 9 could serve)
     split_pages: Optional[int] = None
+    # fleet-global KV tiering (ISSUE 16, needs enable_prefix_cache):
+    # cold prefix blocks SPILL to a host-DRAM tier instead of being
+    # discarded, and fetch back on hit — priced over the shared
+    # offload host link (cost_model.DEFAULT_HOST_GBPS, the same
+    # channel autotune's offload-remat policy models). With tiering on
+    # the virtual clock also charges prefill for the UNCACHED tail
+    # only (a cached prefix is KV that exists — the real system skips
+    # its compute), which is what lets migration beat re-prefill.
+    enable_kv_spill: bool = False
+    # host-tier capacity in blocks (None = unbounded)
+    host_tier_blocks: Optional[int] = None
+    # host-link override in GB/s (None = env / shared default)
+    host_link_gbps: Optional[float] = None
 
 
 class ServingEngine:
@@ -164,10 +177,21 @@ class ServingEngine:
         self.scheduler = ContinuousBatchingScheduler(sched_cfg,
                                                      self.allocator)
         self.prefix_cache: Optional[PrefixCache] = None
+        self.host_tier: Optional[HostKVTier] = None
         if self.config.enable_prefix_cache:
+            if self.config.enable_kv_spill:
+                self.host_tier = HostKVTier(self.config.host_tier_blocks)
             self.prefix_cache = PrefixCache(
-                self.allocator, max_blocks=self.config.prefix_cache_blocks)
+                self.allocator, max_blocks=self.config.prefix_cache_blocks,
+                host_tier=self.host_tier)
+            if self.host_tier is not None:
+                self.prefix_cache.set_spill_io(self._kv_gather_block,
+                                               self._kv_scatter_block)
             self.scheduler.prefix_cache = self.prefix_cache
+        # metric-counter snapshot for the KV-tier totals (spill/fetch
+        # events fire deep inside the allocator's reclaim hook, so the
+        # engine emits deltas rather than instrumenting the cache)
+        self._kv_counts: Dict[str, int] = {}
         self.runner = PagedGPTRunner(model, cfg.num_heads, cfg.head_dim,
                                      interpret=self.config.interpret,
                                      split_pages=self.config.split_pages)
@@ -215,6 +239,32 @@ class ServingEngine:
         model = GPTForCausalLM(gpt_config)
         model.set_state_dict(loaded.state_dict())
         return model
+
+    # -- KV tier I/O (ISSUE 16) ------------------------------------------
+    def _kv_gather_block(self, block: int):
+        """One block's K/V bytes, device -> host arrays (the spill /
+        peer-export path). Goes through ``self.cache`` at call time —
+        the pools are reassigned after every donated program, so a
+        captured pool reference would go stale."""
+        return (np.asarray(self.cache.k[:, block]),
+                np.asarray(self.cache.v[:, block]))
+
+    def _kv_scatter_block(self, block: int, k_np, v_np) -> None:
+        """Write fetched/migrated K/V bytes into ``block`` on device
+        (the promotion path back into HBM)."""
+        import jax.numpy as jnp
+        self.cache.k = self.cache.k.at[:, block].set(
+            jnp.asarray(k_np, self.cache.dtype))
+        self.cache.v = self.cache.v.at[:, block].set(
+            jnp.asarray(v_np, self.cache.dtype))
+
+    @property
+    def host_link_bps(self) -> float:
+        """Host<->device offload-link rate the spill tier is priced
+        at — the SAME shared channel the offload-remat policy models
+        (one owner in ``cost_model``, no drift)."""
+        from ..observability.cost_model import host_link_bps
+        return host_link_bps(self.config.host_link_gbps)
 
     # -- request intake --------------------------------------------------
     def submit(self, prompt: Seq[int], max_new_tokens: int,
@@ -423,10 +473,38 @@ class ServingEngine:
             seq.table.num_tokens = n
             seq.tokens.append(tok)
             padded = self.runner.prefill_padded_len(n)
+            cost = self.runner.prefill_cost(padded)
             info = {"seq": seq, "prompt_tokens": n, "padded_len": padded,
-                    "cost": self.runner.prefill_cost(padded)}
-            seq.ready_at = (ready_at_fn(info) if ready_at_fn is not None
-                            else now)
+                    "cost": cost}
+            if self.host_tier is not None and cost and start > 0:
+                # tiering charges the clock for the UNCACHED tail only
+                # (linear token scaling of the full-prompt cost): the
+                # cached prefix's KV already exists, and a real system
+                # with paged-context prefill skips its compute. The
+                # full prefill still RUNS (exactness — the tail's
+                # hidden states need the prefix context); only the
+                # modeled charge shrinks. Off-tier engines keep the
+                # PR 13 full-charge behavior bitwise.
+                # a FULL-prompt hit still computes the last position
+                # (the first generated token's logits need it), so the
+                # charge floors at one token — never the flopless
+                # zero-dict that would trip the clock fallback
+                frac = (n - min(start, n - 1)) / n
+                info["charged_cost"] = {k: v * frac
+                                        for k, v in cost.items()}
+            ready = (ready_at_fn(info) if ready_at_fn is not None
+                     else now)
+            # tier-fetch stall: host-tier promotions pay the shared
+            # offload link, peer fetches carry their modeled DCN
+            # seconds from the registry's cost decision — both land
+            # AFTER the prefill interval so the decomposition's
+            # spill_fetch component never overlaps prefill_s
+            host_blocks = getattr(seq, "kv_fetched_host", 0)
+            peer_blocks = getattr(seq, "kv_fetched_peer", 0)
+            fetch_s = (host_blocks * self.cache.block_bytes
+                       / self.host_link_bps
+                       + getattr(seq, "kv_peer_fetch_s", 0.0))
+            seq.ready_at = ready + fetch_s
             if seq.first_token_t is None:
                 seq.first_token_t = seq.ready_at
                 metrics.observe("serving_ttft_s",
@@ -435,12 +513,18 @@ class ServingEngine:
             self.scheduler.mark_running(seq)
             # prefill span: admission -> first-token-ready on the
             # prefill lane (lane queueing included — the decode lane
-            # never waits on it). `end` is the EXACT ready_at stamp so
+            # never waits on it). `end` is the EXACT lane stamp so
             # a finish-at-prefill closes the sum bitwise.
             _flight_record(event="prefill", req=seq.req_id,
-                           tid=seq.trace_id, t=now, end=seq.ready_at,
+                           tid=seq.trace_id, t=now, end=ready,
                            engine=self.engine_id, tokens=n,
                            padded=padded)
+            if fetch_s:
+                _flight_record(event="spill_fetch", req=seq.req_id,
+                               tid=seq.trace_id, t=ready,
+                               end=seq.ready_at, engine=self.engine_id,
+                               host_blocks=host_blocks or None,
+                               peer_blocks=peer_blocks or None)
             metrics.inc("serving_prefill_tokens_total", n)
             if seq.done:
                 # its only token materializes when the prefill LANE
@@ -535,6 +619,8 @@ class ServingEngine:
         if chaos.active() is not None:
             chaos.maybe_corrupt_block_table(
                 [s.table.blocks for s in active])
+            if self.host_tier is not None:
+                chaos.maybe_corrupt_spill_block(self.host_tier)
         active = self._validate_tables(active, now=now)
         if not active:
             return None
@@ -720,6 +806,30 @@ class ServingEngine:
                 self.prefix_cache.shared_bytes(self.cache.block_bytes))
             metrics.set_gauge("serving_prefix_cached_blocks",
                               len(self.prefix_cache))
+            self._flush_kv_counters()
+        if self.host_tier is not None:
+            metrics.set_gauge("serving_kv_host_tier_blocks",
+                              len(self.host_tier))
+            metrics.set_gauge("serving_kv_host_tier_bytes",
+                              len(self.host_tier)
+                              * self.cache.block_bytes)
+
+    def _flush_kv_counters(self) -> None:
+        """Emit KV-tier counter DELTAS into the metrics plane. Spills
+        and fetches fire deep inside the allocator's reclaim hook and
+        the cache's lookup, so the engine reconciles the cache's
+        monotonic totals here (every _gauge call) instead of threading
+        the metrics plane through the block layer."""
+        from ..observability import metrics
+        pc = self.prefix_cache
+        totals = (("serving_kv_spill_blocks_total", pc.spills),
+                  ("serving_kv_fetch_host_blocks_total", pc.host_fetches),
+                  ("serving_kv_fetch_peer_blocks_total", pc.peer_fetches))
+        for name, total in totals:
+            delta = total - self._kv_counts.get(name, 0)
+            if delta:
+                metrics.inc(name, delta)
+                self._kv_counts[name] = total
 
     @property
     def num_decode_programs(self) -> int:

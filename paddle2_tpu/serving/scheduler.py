@@ -102,6 +102,16 @@ class Sequence:
         # leading tokens whose KV came from the prefix cache at the
         # LAST admission (the engine scatters only past this point)
         self.prefix_cached_tokens = 0
+        # KV-tier attribution for the LAST admission (ISSUE 16): how
+        # many of the cached blocks were promoted from the host tier /
+        # a DCN peer, and the peer transfer's modeled seconds — the
+        # engine prices the spill_fetch stall from these
+        self.kv_fetched_host = 0
+        self.kv_fetched_peer = 0
+        self.kv_peer_fetch_s = 0.0
+        # earliest stamp migrated KV is on-device (a failover
+        # migration's DCN transfer completes here; admission waits)
+        self.kv_ready_t = 0.0
 
     def check(self) -> "Sequence":
         """Raise the typed error a post-submission failure recorded
@@ -346,6 +356,12 @@ class ContinuousBatchingScheduler:
             seq = self.waiting[0]
             if len(self._running) + len(admitted) >= self.config.max_batch:
                 break
+            if seq.kv_ready_t > now:
+                # migrated KV still on the wire (DCN transfer from a
+                # dead engine's host tier): admitting before it lands
+                # would prefill positions the migration covers —
+                # head-of-line until the modeled transfer completes
+                break
             need_tokens = len(seq.tokens)
             cached: List[int] = []
             if self.prefix_cache is not None and not seq.table.blocks:
@@ -362,13 +378,26 @@ class ContinuousBatchingScheduler:
                 break                      # head-of-line until blocks free
             self.waiting.pop(0)
             seq.prefix_cached_tokens = 0
+            seq.kv_fetched_host = 0
+            seq.kv_fetched_peer = 0
+            seq.kv_peer_fetch_s = 0.0
             shared: List[int] = []
+            spills_before = (self.prefix_cache.spills
+                             if self.prefix_cache is not None else 0)
             if self.prefix_cache is not None:
                 from ..observability import metrics
                 shared, n_cached = self.prefix_cache.lookup(seq.tokens)
                 if shared:
                     seq.table.attach_shared(shared)
                     seq.prefix_cached_tokens = n_cached
+                    # tier attribution: the engine charges the
+                    # spill_fetch stall for promoted blocks
+                    seq.kv_fetched_host = \
+                        self.prefix_cache.last_host_fetched
+                    seq.kv_fetched_peer = \
+                        self.prefix_cache.last_peer_fetched
+                    seq.kv_peer_fetch_s = \
+                        self.prefix_cache.last_peer_fetch_s
                     metrics.inc("serving_prefix_hits_total")
                     metrics.inc("serving_prefix_hit_blocks_total",
                                 len(shared))
@@ -399,6 +428,15 @@ class ContinuousBatchingScheduler:
                 self.prefix_cache.insert(seq.request.prompt,
                                          seq.table.blocks,
                                          len(seq.request.prompt))
+                spilled = self.prefix_cache.spills - spills_before
+                if spilled:
+                    # this admission's allocations forced cold cached
+                    # blocks down to the host tier — join key is the
+                    # request whose admission applied the pressure
+                    _flight_record(event="kv_spill", req=seq.req_id,
+                                   tid=seq.trace_id, t=now,
+                                   engine=self.engine_id,
+                                   blocks=spilled)
             spent += need_tokens
             admitted.append(seq)
             _flight_record(event="admit", req=seq.req_id,

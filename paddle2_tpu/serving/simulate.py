@@ -33,7 +33,8 @@ import numpy as np
 
 __all__ = ["poisson_trace", "ServingSimReport", "simulate_serving",
            "simulate_predictor_baseline", "cost_seconds",
-           "EngineFailoverRouter", "RouterSimReport", "simulate_router"]
+           "EngineFailoverRouter", "RouterSimReport", "simulate_router",
+           "FleetKVRegistry"]
 
 
 def poisson_trace(n_requests: int, rate_per_s: float,
@@ -148,11 +149,16 @@ def simulate_serving(engine, trace: List[dict],
 
         def lane_ready(info):
             # prefill lane: starts no earlier than the admission
-            # instant or the lane's previous completion
+            # instant or the lane's previous completion. The lane pays
+            # the CHARGED cost when the engine provides one (KV
+            # tiering scales the charge to the uncached prompt tail;
+            # absent tiering the key is absent and this is info["cost"]
+            # bit-for-bit).
             nonlocal prefill_clock
             start = max(prefill_clock, decode_clock,
                         info["seq"].request.arrival_t)
-            prefill_clock = start + cost_seconds(info["cost"])
+            prefill_clock = start + cost_seconds(
+                info.get("charged_cost") or info["cost"])
             return prefill_clock
 
         infos = engine.admit_and_prefill(decode_clock,
@@ -222,6 +228,91 @@ def simulate_serving(engine, trace: List[dict],
     return rep.finalize(first_arrival, last_finish)
 
 
+# ------------------------------------------------- fleet-global KV tier
+class FleetKVRegistry:
+    """Fleet-global KV coordination: the peer tier over DCN plus the
+    prefix advertisement the affinity router consults (ROADMAP 2(e)).
+
+    Wires every engine's :class:`PrefixCache` with a peer-fetch
+    source: on a local HBM+host miss, the registry scans the other
+    alive engines for the longest contiguous run of the missing chain
+    (HBM or host tier), prices the DCN transfer with the PR 14
+    alpha+beta :class:`LinkModel`, prices the re-prefill of the same
+    tokens with the engine's own XLA cost model, and fetches ONLY
+    when the modeled transfer beats the modeled recompute — a pure
+    deterministic cost-model decision, gated both ways by
+    ``bench.py --fleet-kv``. The same LinkModel prices failover KV
+    migration (:meth:`EngineFailoverRouter._maybe_migrate`)."""
+
+    def __init__(self, engines: List, link=None):
+        from ..observability.cost_model import (
+            LinkModel, DEFAULT_DCN_LATENCY_US)
+        self.engines = list(engines)
+        # alpha+beta: DCN latency term ON (a prefix fetch is one RPC;
+        # pricing it latency-free would make tiny transfers free and
+        # break the gated-both-ways decision)
+        self.link = link if link is not None else LinkModel(
+            dcn_latency_us=DEFAULT_DCN_LATENCY_US)
+        self.peer_fetches = 0
+        self.peer_fetch_blocks = 0
+        self.peer_declined = 0
+        for e in self.engines:
+            if e.prefix_cache is not None:
+                e.prefix_cache.set_peer_source(self._source_for(e))
+
+    def modeled_prefill_s(self, eng, n_tokens: int,
+                          total_tokens: int) -> float:
+        """Modeled seconds re-prefilling ``n_tokens`` of a
+        ``total_tokens`` prompt would cost on ``eng`` — the same
+        linear-in-tokens charge the tiering clock uses, so the
+        fetch-vs-recompute decision and the clock agree."""
+        if total_tokens <= 0 or n_tokens <= 0:
+            return 0.0
+        padded = eng.runner.prefill_padded_len(total_tokens)
+        full = cost_seconds(eng.runner.prefill_cost(padded))
+        return full * (n_tokens / total_tokens)
+
+    def _source_for(self, eng):
+        def fetch(missing_keys):
+            # longest contiguous run any alive peer can serve
+            best, best_n = None, 0
+            for peer in self.engines:
+                if peer is eng or peer.failed \
+                        or peer.prefix_cache is None:
+                    continue
+                pc = peer.prefix_cache
+                n = 0
+                for key in missing_keys:
+                    if key in pc._entries or (
+                            pc.host_tier is not None
+                            and key in pc.host_tier):
+                        n += 1
+                    else:
+                        break
+                if n > best_n:
+                    best, best_n = peer, n
+            if best is None or best_n == 0:
+                return [], 0.0
+            bs = eng.cache.block_size
+            total = len(missing_keys[-1])    # keys ARE token prefixes
+            t_fetch = self.link.seconds(
+                best_n * eng.cache.block_bytes, axes=("dcn",))
+            t_prefill = self.modeled_prefill_s(eng, best_n * bs, total)
+            if t_fetch >= t_prefill:
+                self.peer_declined += 1
+                return [], 0.0
+            payloads = best.prefix_cache.export_chain(
+                list(missing_keys[:best_n]))
+            if not payloads:
+                return [], 0.0
+            self.peer_fetches += 1
+            self.peer_fetch_blocks += len(payloads)
+            # export may stop short (corrupt host entry): charge the
+            # transfer pro-rata for what actually moved
+            return payloads, t_fetch * (len(payloads) / best_n)
+        return fetch
+
+
 # ------------------------------------------------- multi-engine failover
 class EngineFailoverRouter:
     """Deterministic multi-engine router with session affinity, health
@@ -242,7 +333,8 @@ class EngineFailoverRouter:
     producing tokens again) is measured on the virtual clock and gated
     by ``bench.py --serving-reliability``."""
 
-    def __init__(self, engines: List, probe_interval_s: float = 1e-3):
+    def __init__(self, engines: List, probe_interval_s: float = 1e-3,
+                 kv_registry: Optional[FleetKVRegistry] = None):
         if not engines:
             raise ValueError("need at least one engine")
         if not probe_interval_s > 0.0:
@@ -253,6 +345,13 @@ class EngineFailoverRouter:
         self.engines = list(engines)
         for i, e in enumerate(self.engines):
             e.engine_id = i
+        # fleet KV tier: enables prefix-affinity routing and
+        # migrate-instead-of-re-prefill failover (None = PR 11
+        # behavior, bit-for-bit)
+        self.kv_registry = kv_registry
+        self.kv_migrated_blocks = 0
+        self.migrations = 0
+        self.migrations_declined = 0
         self.probe_interval_s = float(probe_interval_s)
         # anchored lazily to the FIRST maybe_probe stamp: a fixed 0.0
         # anchor would make a first call at a large `now` spin through
@@ -274,7 +373,7 @@ class EngineFailoverRouter:
         e = self.engines[idx]
         return len(e.scheduler.running()) + len(e.scheduler.waiting)
 
-    def _pick(self, session=None) -> int:
+    def _pick(self, session=None, prompt=None) -> int:
         alive = self.alive()
         if not alive:
             from .reliability import EngineFailedError
@@ -283,6 +382,23 @@ class EngineFailoverRouter:
             idx = self._affinity.get(session)
             if idx is not None and not self.engines[idx].failed:
                 return idx
+        if prompt is not None and self.kv_registry is not None:
+            # prefix affinity: the engine already holding the longest
+            # cached prefix (HBM or host tier) serves the request —
+            # ties break least-loaded then lowest index; zero cached
+            # tokens everywhere falls through to least-loaded
+            cached = {
+                i: self.engines[i].prefix_cache.cached_prefix_tokens(
+                    prompt)
+                for i in alive
+                if self.engines[i].prefix_cache is not None}
+            if cached:
+                idx = min(cached,
+                          key=lambda i: (-cached[i], self._load(i), i))
+                if cached[idx] > 0:
+                    if session is not None:
+                        self._affinity[session] = idx
+                    return idx
         idx = min(alive, key=lambda i: (self._load(i), i))
         if session is not None:
             self._affinity[session] = idx
@@ -294,7 +410,8 @@ class EngineFailoverRouter:
         """Route one request; returns a router-global request id.
         Typed rejections (queue full, prompt too long) propagate from
         the target engine."""
-        idx = self._pick(session)
+        idx = self._pick(session, prompt=prompt
+                         if self.kv_registry is not None else None)
         rid = self._next_rid
         local = self.engines[idx].submit(
             prompt, max_new_tokens, arrival_t=arrival_t,
@@ -379,6 +496,14 @@ class EngineFailoverRouter:
             # the original admission/FIFO order on the adopter
             inflight = [s for s in seqs if eng.scheduler._in_flight(s)]
             fresh = [s for s in seqs if not eng.scheduler._in_flight(s)]
+            # migrate-instead-of-re-prefill (tentpole c): before the
+            # adopter re-queues each sequence, pull its surviving
+            # host-tier KV across DCN when the modeled transfer beats
+            # the modeled re-prefill — the migrate span lands BEFORE
+            # the adopt span at the same stamp so the decomposition
+            # charges migration_stall then reopens the failover wait
+            for seq in inflight + fresh:
+                self._maybe_migrate(dead, eng, seq, now)
             for seq in list(reversed(inflight)) + fresh:
                 eng.adopt(seq, now=now)
                 if id(seq) in rid_of:       # keep home_of() truthful
@@ -395,6 +520,93 @@ class EngineFailoverRouter:
             "detected_t": now, "seqs": recovered,
             "recovered": len(recovered), "recovered_t": None,
             "mttr_s": None})
+
+    def _maybe_migrate(self, dead, eng, seq, now: float) -> int:
+        """KV migration instead of re-prefill (tentpole c): the dead
+        engine's HBM is gone, but its host-DRAM spill tier survives
+        the device. If it holds a leading run of ``seq``'s prefix
+        chain, price moving those blocks to the adopter over DCN
+        against the modeled re-prefill of the same tokens; migrate
+        only when the transfer wins. Migrated payloads are CRC-checked
+        into fresh blocks in the ADOPTER's prefix cache (cache-owned,
+        refcount 1), and ``seq.kv_ready_t`` holds the sequence out of
+        admission until the modeled transfer lands — so admission
+        re-prefills only the tail, and the decomposition's
+        migration-stall component is exact. A chaos-dropped or corrupt
+        transfer degrades to plain re-prefill. Returns blocks moved."""
+        if self.kv_registry is None:
+            return 0
+        tier = getattr(dead, "host_tier", None)
+        pc = eng.prefix_cache
+        if tier is None or len(tier) == 0 or pc is None:
+            return 0
+        from ..distributed.fault_tolerance import chaos
+        from ..observability import metrics
+        from .block_cache import OutOfBlocksError
+        from .reliability import flight_record
+        keys = pc._keys(seq.tokens)
+        n = 0
+        for key in keys:
+            if key in pc._entries:
+                # adopter already holds it (an earlier migration of a
+                # shared prefix) — admission's lookup will hit it
+                n += 1
+                continue
+            if key in tier:
+                n += 1
+            else:
+                break
+        todo = [k for k in keys[:n] if k not in pc._entries]
+        if not todo:
+            return 0
+        t_mig = self.kv_registry.link.seconds(
+            len(todo) * eng.cache.block_bytes, axes=("dcn",))
+        t_re = self.kv_registry.modeled_prefill_s(
+            eng, len(todo) * eng.cache.block_size, len(seq.tokens))
+        if t_mig >= t_re:
+            # short context / cheap recompute: re-prefill wins, by
+            # the same model the clock charges — counted, not silent
+            self.migrations_declined += 1
+            flight_record(event="migrate_declined",
+                          engine=eng.engine_id, tid=seq.trace_id,
+                          t=now, blocks=len(todo),
+                          src=getattr(dead, "engine_id", None))
+            return 0
+        if chaos.maybe_drop_migration():
+            # injected transfer loss: fall back to re-prefill — the
+            # token log still reproduces the KV exactly
+            flight_record(event="migration_dropped",
+                          engine=eng.engine_id, tid=seq.trace_id,
+                          t=now, blocks=len(todo),
+                          chaos="drop_migration")
+            return 0
+        moved = 0
+        for key in todo:
+            payload = tier.get(key)     # CRC-verified; corrupt -> None
+            if payload is None:
+                break                   # tail re-prefills
+            try:
+                nb = eng.allocator.allocate(1)[0]
+            except OutOfBlocksError:
+                break
+            eng._kv_scatter_block(nb, payload[0], payload[1])
+            pc._entries[key] = nb       # cache-owned: allocate's ref
+            pc._lru[key] = nb
+            tier.pop(key)               # one tier owns a prefix
+            moved += 1
+        if not moved:
+            return 0
+        stall = t_mig * (moved / len(todo))
+        seq.kv_ready_t = max(getattr(seq, "kv_ready_t", 0.0),
+                             now + stall)
+        self.migrations += 1
+        self.kv_migrated_blocks += moved
+        metrics.inc("serving_kv_migrated_blocks_total", moved)
+        flight_record(event="migrate", engine=eng.engine_id,
+                      tid=seq.trace_id, t=now, dur=stall,
+                      blocks=moved,
+                      src=getattr(dead, "engine_id", None))
+        return moved
 
     def note_recovery(self, now: float) -> None:
         """Stamp MTTR for failovers whose every recovered sequence has
@@ -436,6 +648,14 @@ class RouterSimReport(ServingSimReport):
     probes: int = 0
     hot_swaps: int = 0
     rids: List[int] = field(default_factory=list)
+    # fleet-global KV ladder (ISSUE 16)
+    kv_spilled_blocks: int = 0
+    kv_fetch_host_blocks: int = 0
+    kv_fetch_peer_blocks: int = 0
+    kv_migrated_blocks: int = 0
+    kv_migrations: int = 0
+    kv_migrations_declined: int = 0
+    kv_host_tier_blocks: int = 0
 
 
 def simulate_router(router: EngineFailoverRouter, trace: List[dict],
@@ -468,8 +688,14 @@ def simulate_router(router: EngineFailoverRouter, trace: List[dict],
     before = {id(e): (e.allocator.total_allocated, e.spec_accepted,
                       e.spec_rejected,
                       (e.prefix_cache.hits, e.prefix_cache.misses)
-                      if e.prefix_cache is not None else (0, 0))
+                      if e.prefix_cache is not None else (0, 0),
+                      (e.prefix_cache.spills,
+                       e.prefix_cache.host_fetches,
+                       e.prefix_cache.peer_fetches)
+                      if e.prefix_cache is not None else (0, 0, 0))
               for e in router.engines}
+    mig_before = (router.kv_migrated_blocks, router.migrations,
+                  router.migrations_declined)
 
     def submit_due(now: float):
         while pending and pending[0]["arrival_t"] <= now:
@@ -491,7 +717,10 @@ def simulate_router(router: EngineFailoverRouter, trace: List[dict],
         def lane_ready(info):
             start = max(prefill_clocks[idx], now,
                         info["seq"].request.arrival_t)
-            prefill_clocks[idx] = start + cost_seconds(info["cost"])
+            # charged_cost (KV tiering: pay for the uncached tail
+            # only) when present; identical to info["cost"] otherwise
+            prefill_clocks[idx] = start + cost_seconds(
+                info.get("charged_cost") or info["cost"])
             return prefill_clocks[idx]
         return lane_ready
 
@@ -550,6 +779,13 @@ def simulate_router(router: EngineFailoverRouter, trace: List[dict],
             for i in router.alive():
                 nxt.extend(getattr(s, "ready_at", 0.0) for s in
                            router.engines[i].scheduler.running())
+                # a migrated sequence is admission-gated until its KV
+                # transfer lands — wake at that stamp or the gate
+                # deadlocks an otherwise-idle fleet
+                nxt.extend(
+                    s.kv_ready_t
+                    for s in router.engines[i].scheduler.waiting
+                    if getattr(s, "kv_ready_t", 0.0) > clock)
             if not nxt:
                 break
             clock = max(clock, min(nxt)) + 1e-9
@@ -568,7 +804,7 @@ def simulate_router(router: EngineFailoverRouter, trace: List[dict],
     rep.evictions = sum(e.scheduler.total_evictions
                         for e in router.engines)
     for e in router.engines:
-        alloc0, acc0, rej0, (hit0, miss0) = before[id(e)]
+        alloc0, acc0, rej0, (hit0, miss0), (sp0, fh0, fp0) = before[id(e)]
         blocks = e.allocator.total_allocated - alloc0
         rep.kv_allocated_blocks += blocks
         rep.kv_allocated_bytes += e.cache.bytes_for_blocks(blocks)
@@ -577,6 +813,15 @@ def simulate_router(router: EngineFailoverRouter, trace: List[dict],
         if e.prefix_cache is not None:
             rep.prefix_hits += e.prefix_cache.hits - hit0
             rep.prefix_misses += e.prefix_cache.misses - miss0
+            rep.kv_spilled_blocks += e.prefix_cache.spills - sp0
+            rep.kv_fetch_host_blocks += e.prefix_cache.host_fetches - fh0
+            rep.kv_fetch_peer_blocks += e.prefix_cache.peer_fetches - fp0
+        if getattr(e, "host_tier", None) is not None:
+            rep.kv_host_tier_blocks += len(e.host_tier)
+    rep.kv_migrated_blocks = router.kv_migrated_blocks - mig_before[0]
+    rep.kv_migrations = router.migrations - mig_before[1]
+    rep.kv_migrations_declined = (router.migrations_declined
+                                  - mig_before[2])
     rep.kv_bytes_per_request = (rep.kv_allocated_bytes
                                 / max(rep.submitted, 1))
     rep.failovers = len(router.failovers)

@@ -487,8 +487,10 @@ def format_report(report: Dict[str, Any], directory: str) -> str:
 def _format_serving(report: Dict[str, Any]) -> List[str]:
     """SERVING section: what the serving reliability plane recorded —
     admit/evict/requeue/shed counts, decode steps, engine failures,
-    failovers, hot-swap stages — plus the newest events with their
-    trace id and clock stamp leading, so a flight dump JOINS the
+    failovers, hot-swap stages, and the fleet-KV ladder's spans
+    (``kv_spill``/``spill_fetch``/``migrate``/``migrate_declined``/
+    ``migration_dropped``) — plus the newest events with their trace
+    id and clock stamp leading, so a flight dump JOINS the
     request-tracing streams (``serve_doctor``'s trace_rank_N.jsonl)
     on ``tid``/``t`` instead of dead-ending at per-event counts."""
     sv = report.get("serving") or {}

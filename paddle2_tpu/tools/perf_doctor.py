@@ -82,6 +82,12 @@ _RELIABILITY_COUNTERS = (
     "serving_prefix_hits_total", "serving_prefix_misses_total",
     "serving_prefix_hit_blocks_total",
     "serving_spec_accepted_total", "serving_spec_rejected_total",
+    # fleet-global KV ladder (ISSUE 16): tier traffic — a spill surge
+    # is HBM cache pressure, a host/peer-fetch surge is the pressure
+    # being absorbed (fetch, not recompute), migrated blocks are
+    # failovers resuming without re-prefill
+    "serving_kv_spill_blocks_total", "serving_kv_fetch_host_blocks_total",
+    "serving_kv_fetch_peer_blocks_total", "serving_kv_migrated_blocks_total",
 )
 
 

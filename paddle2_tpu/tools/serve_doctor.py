@@ -58,6 +58,8 @@ _COMPONENT_LABEL = {
     "eviction_stall_s": "eviction-stall",
     "failover_stall_s": "failover-stall",
     "swap_stall_s": "swap-stall",
+    "spill_fetch_s": "spill-fetch",
+    "migration_stall_s": "migration-stall",
     "host_s": "host",
 }
 
@@ -140,7 +142,8 @@ def summarize(records: List[Dict[str, Any]],
         report["counters"] = {
             k: sum(c[k] for c in finished.values())
             for k in ("evictions", "retries", "failovers",
-                      "corruptions", "swaps")}
+                      "corruptions", "swaps", "spill_fetches",
+                      "migrations")}
     # chaos attribution: which injected fault touched which requests
     chaos: Dict[str, List] = {}
     for rec in records:
@@ -259,7 +262,7 @@ def diff(base: Dict[str, Any], new: Dict[str, Any],
     # counter deltas (retries eat steps, failovers eat re-prefills)
     cdeltas = {}
     for k in ("evictions", "retries", "failovers", "corruptions",
-              "swaps"):
+              "swaps", "spill_fetches", "migrations"):
         va = (base.get("counters") or {}).get(k, 0)
         vb = (new.get("counters") or {}).get(k, 0)
         if va != vb:
