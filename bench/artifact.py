@@ -1,0 +1,94 @@
+"""Byte-identical artifact emission shared by every bench lane.
+
+Each lane used to end with the same hand-copied tail: print the result
+as one JSON line, write the ``*_r01.json`` artifact with ``indent=2``,
+log the failing gate subset, return 0/1. Ten copies drifted in small
+ways (one printed the whole gates dict on failure, one checked a
+pre-computed ``ok``); this module is the single implementation, plus
+the run-twice determinism check CI's ``cmp`` performs across processes.
+"""
+
+import json
+import os
+import sys
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+_SCRATCH_ROOT = None
+
+
+def bench_scratch(name, env_var=None):
+    """Scratch directory for a bench lane's metric/trace streams.
+
+    An explicit ``env_var`` override wins (CI pins stable names so it
+    can diff base-vs-cand streams across two invocations); otherwise
+    the lane lands under ONE session tempdir that is removed at exit —
+    bench runs must never litter the repo root with ``_bench_*``
+    droppings (ISSUE 14 satellite)."""
+    if env_var:
+        override = os.environ.get(env_var)
+        if override:
+            return override
+    global _SCRATCH_ROOT
+    if _SCRATCH_ROOT is None:
+        import atexit
+        import shutil
+        import tempfile
+        _SCRATCH_ROOT = tempfile.mkdtemp(prefix="paddle2_bench_")
+        atexit.register(shutil.rmtree, _SCRATCH_ROOT,
+                        ignore_errors=True)
+    return os.path.join(_SCRATCH_ROOT, name)
+
+
+def artifact_bytes(result, indent=2, sort_keys=False):
+    """The exact bytes :func:`write_artifact` puts on disk — the unit
+    CI's ``cmp`` compares, so determinism checks must hash THIS, not a
+    re-serialization with different options."""
+    return json.dumps(result, indent=indent,
+                      sort_keys=sort_keys).encode()
+
+
+def write_artifact(path, result, indent=2, sort_keys=False,
+                   trailing_newline=False):
+    """Write the lane artifact; unwritable cwd (read-only CI mount) is
+    tolerated because the stdout JSON line already carries the result."""
+    try:
+        with open(path, "w") as f:
+            f.write(artifact_bytes(result, indent=indent,
+                                   sort_keys=sort_keys).decode())
+            if trailing_newline:
+                f.write("\n")
+    except OSError:
+        return False
+    return True
+
+
+def emit_result(lane, artifact, result, gates=None):
+    """The shared lane tail: stdout JSON line, artifact file, gate
+    verdict. ``gates`` defaults to ``result["gates"]``. Returns the
+    process exit code (0 all gates passed / 1 any failed)."""
+    if gates is None:
+        gates = result.get("gates", {})
+    print(json.dumps(result))
+    write_artifact(artifact, result)
+    if gates and not all(gates.values()):
+        log(f"{lane}: GATE FAILURE "
+            f"{ {k: v for k, v in gates.items() if not v} }")
+        return 1
+    log(f"{lane}: all gates passed")
+    return 0
+
+
+def runs_identical(build, n=2, **artifact_opts):
+    """Run ``build()`` ``n`` times and require every run's artifact
+    bytes identical — the in-process twin of CI's run-twice-and-cmp.
+    Returns (identical, first_result)."""
+    first = build()
+    ref = artifact_bytes(first, **artifact_opts)
+    for _ in range(n - 1):
+        if artifact_bytes(build(), **artifact_opts) != ref:
+            return False, first
+    return True, first

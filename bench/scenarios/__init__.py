@@ -1,0 +1,17 @@
+"""Declarative bench-scenario registry (ROADMAP item 2, seed slice).
+
+Importing this package registers every scenario module; ``bench.py``
+dispatches CLI flags through :func:`run_scenario`.
+"""
+
+from .registry import REGISTRY, Scenario, get, register, run
+
+# scenario modules self-register on import
+from . import serving_reliability   # noqa: F401  (side-effect import)
+from . import fleet_kv              # noqa: F401
+from . import million_user_day      # noqa: F401
+
+run_scenario = run
+
+__all__ = ["REGISTRY", "Scenario", "get", "register", "run",
+           "run_scenario"]

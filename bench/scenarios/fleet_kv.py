@@ -1,0 +1,481 @@
+"""Scenario: the fleet-global KV resilience gate (ISSUE 16), ported
+onto the declarative registry (ISSUE 17) with its artifact bytes
+unchanged.
+
+Drills and gates:
+  1. **Fleet economics** — a shared-prompt flood over a 4-engine
+     fleet: prefix-affinity routing concentrates the shared chain on
+     its holder, so fleet-wide KV bytes/request must be >= 2x better
+     than the SAME engines run as N independent caches —
+     token-for-token identical.
+  2. **Peer tier, gated both ways** — a cold engine fetches a LONG
+     warm prefix from its peer over the modeled DCN (alpha + beta
+     transfer < modeled re-prefill) but re-prefills a SHORT one; the
+     PR 12 decomposition stays integer-picosecond EXACT with
+     spill-fetch stalls charged as their own component.
+  3. **Migration instead of re-prefill** — a same-prefix request
+     queued on a killed engine: the adopter MIGRATES the dead engine's
+     surviving host-tier blocks when the modeled DCN transfer beats
+     modeled re-prefill; its MTTR must STRICTLY beat the re-prefill
+     twin (chaos ``drop_migration``) on a long context, while a short
+     context provably declines — token-for-token against the clean run
+     either way.
+  4. **PR 11 drills under tiering** — all four serving-reliability
+     chaos drills (kill / transient / overload / hot-swap) re-run with
+     the spill tier enabled: token-for-token, ledgers closed, and
+     tiering itself token-invisible vs the untired fleet.
+
+All deterministic (XLA cost model x seeded traces x virtual clock —
+ZERO wall-clock anywhere; run twice, the artifact is byte-identical).
+Writes the serving metrics stream (spill/fetch/migration counters) for
+perf_doctor and a request-lifecycle trace dir for serve_doctor.
+"""
+
+import numpy as np
+
+from ..artifact import bench_scratch, log
+from . import registry
+
+
+def build(scenario):
+    import zlib
+    import paddle2_tpu as paddle
+    from paddle2_tpu.distributed.fault_tolerance import chaos
+    from paddle2_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+    from paddle2_tpu.observability import metrics, tracing
+    from paddle2_tpu.serving import (
+        EngineConfig, EngineFailoverRouter, FleetKVRegistry,
+        HotSwapController, ReliabilityConfig, ServingEngine,
+        audit_kv_ledger, poisson_trace, simulate_router,
+        simulate_serving)
+    from paddle2_tpu.serving.simulate import cost_seconds
+
+    metrics_dir = bench_scratch("fleet_kv_metrics",
+                                env_var=scenario.streams["metrics"])
+    trace_dir = bench_scratch("fleet_kv_trace",
+                              env_var=scenario.streams["trace"])
+    paddle.seed(0)
+    cfg = gpt_tiny(use_scan=False, max_position_embeddings=128)
+    model = GPTForCausalLM(cfg)
+
+    def prompt(n, seed):
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, cfg.vocab_size, size=n).tolist()
+
+    def make_engine(reliability=None, tiered=True, **over):
+        kw = dict(block_size=16, num_blocks=40, max_batch=8,
+                  prefill_budget_tokens=128, max_model_len=128,
+                  reliability=reliability)
+        if tiered:
+            kw.update(enable_prefix_cache=True, enable_kv_spill=True,
+                      host_tier_blocks=64)
+        kw.update(over)
+        return ServingEngine(model, config=EngineConfig(**kw))
+
+    def toks_of(router, rep):
+        return [router.sequence(r).generated for r in rep.rids]
+
+    def crc(tok_lists):
+        payload = b"".join(np.asarray(t, np.int64).tobytes()
+                           for t in tok_lists)
+        return zlib.crc32(payload) & 0xFFFFFFFF
+
+    def drain(eng, max_steps=500):
+        step = 0
+        while not eng.idle() and step < max_steps:
+            eng.tick(now=float(step))
+            step += 1
+        assert eng.idle(), "engine did not drain"
+
+    # -- phase 0: probe the cost model (compiles prefill + b1 decode)
+    probe = make_engine(tiered=False)
+    simulate_serving(probe, poisson_trace(
+        2, rate_per_s=100.0, prompt_lens=[16, 24],
+        gen_tokens=[12, 24], vocab=cfg.vocab_size, seed=1))
+    b1_key = min(probe.runner._decode_costs)
+    decode_s = cost_seconds(probe.runner.decode_cost(b1_key))
+    prefill_s = max(cost_seconds(c)
+                    for c in probe.runner._prefill_costs.values())
+    base_capacity = 1.0 / decode_s
+    probe_interval_s = 2.0 * decode_s
+    log(f"fleet-kv probe: decode_s={decode_s*1e6:.1f}us "
+        f"prefill_s={prefill_s*1e6:.1f}us")
+
+    metrics.enable(metrics_dir, rank=0, flush_steps=1)
+    gates = {}
+
+    # -- drill 1: fleet economics — shared prompt, affinity vs N
+    # independent caches. One warm-up arrival parks the shared chain
+    # on engine 0; the flood then routes by prefix affinity
+    # (concentrated: ONE materialization fleet-wide) or least-loaded
+    # (independent: every engine materializes its own copy).
+    shared = prompt(112, seed=21)
+    flood = ([{"arrival_t": 0.0, "prompt": list(shared),
+               "max_new_tokens": 4}]
+             + [{"arrival_t": 0.05, "prompt": list(shared),
+                 "max_new_tokens": 4} for _ in range(8)])
+
+    def fleet(with_registry):
+        engines = [make_engine() for _ in range(4)]
+        reg = FleetKVRegistry(engines) if with_registry else None
+        return EngineFailoverRouter(engines,
+                                    probe_interval_s=probe_interval_s,
+                                    kv_registry=reg)
+
+    r_fleet = fleet(True)
+    rep_fleet = simulate_router(r_fleet, [dict(r) for r in flood])
+    fleet_toks = toks_of(r_fleet, rep_fleet)
+    r_indep = fleet(False)
+    rep_indep = simulate_router(r_indep, [dict(r) for r in flood])
+    indep_toks = toks_of(r_indep, rep_indep)
+    bytes_ratio = (rep_indep.kv_bytes_per_request
+                   / max(rep_fleet.kv_bytes_per_request, 1.0))
+    gates["fleet_kv_bytes_2x_vs_independent"] = bytes_ratio >= 2.0
+    gates["fleet_tokens_match_independent"] = (
+        fleet_toks == indep_toks
+        and rep_fleet.completed == rep_indep.completed == len(flood))
+    log(f"fleet-kv economics: fleet {rep_fleet.kv_allocated_blocks} "
+        f"blocks vs independent {rep_indep.kv_allocated_blocks} "
+        f"(ratio {bytes_ratio:.2f}x, gate >=2x) "
+        f"token-for-token={gates['fleet_tokens_match_independent']}")
+
+    # -- drill 2a: peer tier over DCN, cost-gated both ways
+    pe0 = make_engine(num_blocks=24, max_batch=4)
+    pe1 = make_engine(num_blocks=24, max_batch=4)
+    reg = FleetKVRegistry([pe0, pe1])
+    P96, S16 = prompt(96, seed=5), prompt(16, seed=6)
+    pe0.submit(P96, 2)
+    pe0.submit(S16, 2)
+    drain(pe0)
+    pe1.submit(prompt(16, seed=7), 2)   # real 16-token bucket on pe1
+    drain(pe1)
+    ref = make_engine(tiered=False, num_blocks=24, max_batch=4)
+    ref.submit(P96, 4)
+    drain(ref)
+    rid = pe1.submit(P96, 4)
+    drain(pe1)
+    declined0 = reg.peer_declined
+    pe1.submit(S16, 2)
+    drain(pe1)
+    gates["peer_fetch_long_token_for_token"] = (
+        reg.peer_fetches >= 1 and reg.peer_fetch_blocks >= 6
+        and pe1.sequence(rid).generated == ref.sequence(0).generated)
+    gates["peer_declines_short_context"] = reg.peer_declined > declined0
+    log(f"fleet-kv peer: fetches={reg.peer_fetches} "
+        f"blocks={reg.peer_fetch_blocks} declined={reg.peer_declined}")
+
+    # -- drill 2b: PR 12 decomposition stays EXACT under tiering —
+    # serial A/B alternation cycles prefixes through the spill tier,
+    # so every other lookup fetches and charges spill_fetch_s
+    rng = np.random.default_rng(3)
+    a = rng.integers(0, cfg.vocab_size, size=32).tolist()
+    b = rng.integers(0, cfg.vocab_size, size=32).tolist()
+    tracing.enable(trace_dir, rank=0)
+    te = make_engine(num_blocks=24, max_batch=4, prefix_cache_blocks=3)
+    step = 0
+    for i in range(8):
+        tail = rng.integers(0, cfg.vocab_size, size=16).tolist()
+        te.submit((a if i % 2 == 0 else b) + tail, 8,
+                  arrival_t=float(step), trace_id=i)
+        while not te.idle():
+            te.tick(now=float(step))
+            step += 1
+            assert step < 2000
+    tracing.flush()
+    tracing.disable()
+    dec = tracing.decompose(tracing.load_trace_dir(trace_dir))
+    fin = {t: c for t, c in dec.items() if c["finished"]}
+    n_spill_fetch = sum(c["spill_fetches"] for c in fin.values())
+    gates["decomposition_exact_with_spill_fetch"] = (
+        bool(fin) and all(c["exact"] for c in fin.values())
+        and n_spill_fetch > 0
+        and any(c["spill_fetch_s"] > 0 for c in fin.values()))
+    log(f"fleet-kv decomposition: {len(fin)} traces exact="
+        f"{gates['decomposition_exact_with_spill_fetch']} "
+        f"spill_fetches={n_spill_fetch}")
+
+    # -- drill 3: migration instead of re-prefill. Warm engine 0 with
+    # the target prefix, spill it to host DRAM via cache pressure
+    # (tight prefix_cache_blocks cap), queue a same-prefix request at
+    # t=1.0 (affinity -> engine 0), and kill engine 0 in the SAME
+    # round — before admission, so the KV survives ONLY in the dead
+    # engine's host tier. The paired same-arrival warm requests land
+    # one copy on EACH engine, so the adopter's 16- and 96-token
+    # prefill buckets carry REAL modeled costs (never the fallback)
+    # when the migrate-vs-re-prefill decision runs.
+    def mig_trace(plen):
+        tgt = prompt(plen, seed=5)
+        f96a, f96b = prompt(96, seed=31), prompt(96, seed=32)
+        short = prompt(16, seed=12)
+        filler = prompt(48, seed=8)
+        warm = [
+            {"arrival_t": 1e-4, "prompt": tgt, "max_new_tokens": 4},
+            {"arrival_t": 0.05, "prompt": f96a, "max_new_tokens": 4},
+            {"arrival_t": 0.05, "prompt": f96b, "max_new_tokens": 4},
+            {"arrival_t": 0.1, "prompt": short, "max_new_tokens": 4},
+            {"arrival_t": 0.1, "prompt": list(reversed(short)),
+             "max_new_tokens": 4},
+            {"arrival_t": 0.2, "prompt": filler, "max_new_tokens": 4},
+            {"arrival_t": 0.21, "prompt": filler[:32],
+             "max_new_tokens": 4},
+            {"arrival_t": 0.22, "prompt": filler[:16],
+             "max_new_tokens": 4},
+            {"arrival_t": 1.0, "prompt": tgt, "max_new_tokens": 4},
+        ]
+        return tgt, warm
+
+    def mig_run(plen, kill, arm=None):
+        tgt, warm = mig_trace(plen)
+        engines = [make_engine(num_blocks=24, max_batch=1,
+                               prefix_cache_blocks=2)
+                   for _ in range(2)]
+        router = EngineFailoverRouter(
+            engines, probe_interval_s=probe_interval_s,
+            kv_registry=FleetKVRegistry(engines))
+        state = {"killed": False, "spilled_ok": False}
+
+        def on_round(rt, clock, idx):
+            if state["killed"] or clock < 1.0:
+                return
+            e0 = rt.engines[0]
+            keys = e0.prefix_cache._keys(tgt)
+            state["spilled_ok"] = all(k in e0.host_tier for k in keys)
+            e0.fail("fleet-kv drill", now=clock)
+            state["killed"] = True
+
+        if arm:
+            chaos.arm(arm)
+        rep = simulate_router(router, [dict(r) for r in warm],
+                              on_round=on_round if kill else None)
+        fired = {k for k, _ in chaos.fired_log()} if arm else set()
+        if arm:
+            chaos.disarm()
+        return router, rep, toks_of(router, rep), state, fired
+
+    _, rep_mc, toks_mc, _, _ = mig_run(96, kill=False)
+    r_mig, rep_mig, toks_mig, st_mig, _ = mig_run(96, kill=True)
+    _, rep_tw, toks_tw, st_tw, fired_tw = mig_run(
+        96, kill=True, arm="drop_migration:1")
+    gates["migration_long_context"] = (
+        st_mig["spilled_ok"] and rep_mig.kv_migrations == 1
+        and rep_mig.kv_migrated_blocks >= 5
+        and rep_mig.completed == len(toks_mc) == rep_mc.completed
+        and toks_mig == toks_mc)
+    gates["migration_mttr_beats_reprefill_twin"] = (
+        "drop_migration" in fired_tw and rep_tw.kv_migrations == 0
+        and toks_tw == toks_mc
+        and 0.0 < rep_mig.mttr_s < rep_tw.mttr_s)
+    log(f"fleet-kv migration(96): migrated "
+        f"{rep_mig.kv_migrated_blocks} blocks "
+        f"mttr={rep_mig.mttr_s*1e6:.1f}us vs re-prefill twin "
+        f"{rep_tw.mttr_s*1e6:.1f}us "
+        f"token-for-token={toks_mig == toks_mc}")
+
+    _, rep_sc, toks_sc, _, _ = mig_run(16, kill=False)
+    r_dec, rep_dec, toks_dec, st_dec, _ = mig_run(16, kill=True)
+    gates["migration_declines_short_context"] = (
+        st_dec["spilled_ok"] and rep_dec.kv_migrations == 0
+        and rep_dec.kv_migrations_declined >= 1
+        and toks_dec == toks_sc)
+    log(f"fleet-kv migration(16): declined="
+        f"{rep_dec.kv_migrations_declined} "
+        f"token-for-token={toks_dec == toks_sc}")
+
+    # -- drill 4: the four PR 11 drills, re-run with tiering on
+    mean_gen = float(np.mean([12, 24]))
+
+    def make_trace(n, seed, rate, priorities=False):
+        t = poisson_trace(n, rate_per_s=rate, prompt_lens=[16, 24],
+                          gen_tokens=[12, 24], vocab=cfg.vocab_size,
+                          seed=seed)
+        if priorities:
+            for i, r in enumerate(t):
+                r["priority"] = 1 if i % 3 == 0 else 0
+        return t
+
+    kill_trace = make_trace(16, seed=101,
+                            rate=2.0 * base_capacity / mean_gen)
+    r_clean = EngineFailoverRouter([make_engine(), make_engine()],
+                                   probe_interval_s=probe_interval_s)
+    rep_clean = simulate_router(r_clean, [dict(r) for r in kill_trace])
+    clean_toks = toks_of(r_clean, rep_clean)
+    r_flat = EngineFailoverRouter(
+        [make_engine(tiered=False), make_engine(tiered=False)],
+        probe_interval_s=probe_interval_s)
+    rep_flat = simulate_router(r_flat, [dict(r) for r in kill_trace])
+    gates["tiering_token_invisible"] = (
+        toks_of(r_flat, rep_flat) == clean_toks)
+
+    chaos.arm("kill_engine:4:1")
+    r_kill = EngineFailoverRouter([make_engine(), make_engine()],
+                                  probe_interval_s=probe_interval_s)
+    rep_kill = simulate_router(r_kill, [dict(r) for r in kill_trace])
+    chaos.disarm()
+    kill_toks = toks_of(r_kill, rep_kill)
+    mttr_budget_s = 2.0 * (probe_interval_s
+                           + rep_kill.recovered_seqs * prefill_s
+                           + 4.0 * decode_s)
+    gates["kill_token_for_token_tiered"] = (
+        kill_toks == clean_toks
+        and rep_kill.completed == len(kill_trace))
+    gates["kill_within_mttr_budget_tiered"] = (
+        rep_kill.failovers == 1 and rep_kill.recovered_seqs >= 1
+        and 0.0 < rep_kill.mttr_s <= mttr_budget_s)
+    log(f"fleet-kv kill: completed {rep_kill.completed}/"
+        f"{len(kill_trace)} mttr={rep_kill.mttr_s*1e6:.1f}us "
+        f"(budget {mttr_budget_s*1e6:.1f}us)")
+
+    chaos.arm("drop_decode_step:3,corrupt_block_table:5:1")
+    r_tr = EngineFailoverRouter([make_engine()],
+                                probe_interval_s=probe_interval_s)
+    rep_tr = simulate_router(r_tr, [dict(r) for r in kill_trace])
+    fired = {k for k, _ in chaos.fired_log()}
+    chaos.disarm()
+    eng_tr = r_tr.engines[0]
+    try:
+        audit_kv_ledger(eng_tr.allocator,
+                        [s.table.blocks
+                         for s in eng_tr.scheduler.running()],
+                        prefix_cache=eng_tr.prefix_cache,
+                        host_tier=eng_tr.host_tier)
+        ledger_ok = not eng_tr.scheduler.running()
+    except Exception:
+        ledger_ok = False
+    gates["transient_token_invisible_tiered"] = (
+        fired == {"drop_decode_step", "corrupt_block_table"}
+        and toks_of(r_tr, rep_tr) == clean_toks
+        and rep_tr.completed == len(kill_trace))
+    gates["transient_cross_tier_ledger_closed"] = ledger_ok
+    log(f"fleet-kv transient: fired={sorted(fired)} "
+        f"ledger_closed={ledger_ok}")
+
+    over_trace = make_trace(40, seed=202,
+                            rate=10.0 * base_capacity / mean_gen,
+                            priorities=True)
+    r_over = EngineFailoverRouter(
+        [make_engine(ReliabilityConfig(max_queue_depth=6))],
+        probe_interval_s=probe_interval_s)
+    rep_over = simulate_router(r_over, [dict(r) for r in over_trace])
+    shed_n = rep_over.shed + rep_over.rejected
+    shed_frac = shed_n / len(over_trace)
+    shed_prios = [s.priority for s in r_over.engines[0].scheduler.shed]
+    ttft_bound = 10.0 * (prefill_s + decode_s)
+    gates["overload_bounded_tiered"] = (
+        0.0 < shed_frac <= 0.6 and all(p == 0 for p in shed_prios)
+        and rep_over.completed == rep_over.submitted - rep_over.shed
+        and rep_over.p99_ttft_s <= ttft_bound)
+    log(f"fleet-kv overload: shed {shed_n}/{len(over_trace)} p99 TTFT "
+        f"{rep_over.p99_ttft_s*1e3:.3f}ms (bound "
+        f"{ttft_bound*1e3:.3f}ms)")
+
+    swap_trace = make_trace(16, seed=303,
+                            rate=2.0 * base_capacity / mean_gen)
+    r_ref = EngineFailoverRouter([make_engine(), make_engine()],
+                                 probe_interval_s=probe_interval_s)
+    rep_ref = simulate_router(r_ref, [dict(r) for r in swap_trace])
+    census_ref = [e.num_decode_programs for e in r_ref.engines]
+    swap_engines = [make_engine(), make_engine()]
+    r_swap = EngineFailoverRouter(swap_engines,
+                                  probe_interval_s=probe_interval_s)
+    new_w = [w * 1.001 if "float" in str(getattr(w, "dtype", "")) else w
+             for w in swap_engines[0].runner._weights()]
+    ctl = HotSwapController(swap_engines, new_w)
+
+    def on_swap_round(rt, clock, idx):
+        if idx in (6, 9):
+            ctl.stage_next(now=clock)
+        elif idx == 14 and ctl.state == "committed":
+            ctl.rollback(now=clock)
+
+    rep_swap = simulate_router(r_swap, [dict(r) for r in swap_trace],
+                               on_round=on_swap_round)
+    census_swap = [e.num_decode_programs for e in swap_engines]
+    gates["hot_swap_zero_dropped_tiered"] = (
+        rep_swap.completed == len(swap_trace)
+        and ctl.state == "rolled_back" and len(ctl.staged) == 2
+        and census_swap == census_ref)
+    log(f"fleet-kv hot-swap: state={ctl.state} census {census_swap} "
+        f"vs ref {census_ref}")
+
+    metrics.flush()
+    metrics.export_prometheus()
+    metrics.disable()
+
+    return {
+        "metric": "fleet_kv_drills",
+        "value": sum(bool(v) for v in gates.values()),
+        "unit": "gates_passed",
+        "economics": {
+            "fleet_blocks": rep_fleet.kv_allocated_blocks,
+            "independent_blocks": rep_indep.kv_allocated_blocks,
+            "bytes_per_request_ratio": round(bytes_ratio, 4),
+            "tokens_crc": crc(fleet_toks),
+            "independent_tokens_crc": crc(indep_toks),
+        },
+        "peer": {
+            "fetches": reg.peer_fetches,
+            "fetch_blocks": reg.peer_fetch_blocks,
+            "declined": reg.peer_declined,
+        },
+        "decomposition": {
+            "traces": len(fin),
+            "spill_fetches": n_spill_fetch,
+        },
+        "migration": {
+            "migrated_blocks": rep_mig.kv_migrated_blocks,
+            "mttr_us": round(rep_mig.mttr_s * 1e6, 3),
+            "reprefill_twin_mttr_us": round(rep_tw.mttr_s * 1e6, 3),
+            "declined_short": rep_dec.kv_migrations_declined,
+            "tokens_crc": crc(toks_mig),
+            "clean_tokens_crc": crc(toks_mc),
+        },
+        "pr11_drills_tiered": {
+            "kill_completed": rep_kill.completed,
+            "kill_mttr_us": round(rep_kill.mttr_s * 1e6, 3),
+            "kill_mttr_budget_us": round(mttr_budget_s * 1e6, 3),
+            "kill_spilled_blocks": rep_kill.kv_spilled_blocks,
+            "transient_fired": sorted(fired),
+            "overload_shed": shed_n,
+            "overload_p99_ttft_ms": round(rep_over.p99_ttft_s * 1e3, 4),
+            "hot_swap_census": census_swap,
+            "tokens_crc": crc(kill_toks),
+            "clean_tokens_crc": crc(clean_toks),
+        },
+        "probe": {
+            "decode_us": round(decode_s * 1e6, 3),
+            "prefill_us": round(prefill_s * 1e6, 3),
+        },
+        "gates": gates,
+    }
+
+
+SCENARIO = registry.register(registry.Scenario(
+    name="fleet-kv",
+    artifact="FLEET_KV_r01.json",
+    build=build,
+    description="HBM -> host-DRAM -> peer-DCN prefix ladder, "
+                "prefix-affinity routing, and KV migration instead of "
+                "re-prefill on failover",
+    model={"family": "gpt_tiny", "use_scan": False,
+           "max_position_embeddings": 128},
+    parallelism={"engines": 4},
+    trace={"kind": "poisson+floods", "prompt_lens": [16, 24],
+           "gen_tokens": [12, 24]},
+    gates=("fleet_kv_bytes_2x_vs_independent",
+           "fleet_tokens_match_independent",
+           "peer_fetch_long_token_for_token",
+           "peer_declines_short_context",
+           "decomposition_exact_with_spill_fetch",
+           "migration_long_context",
+           "migration_mttr_beats_reprefill_twin",
+           "migration_declines_short_context",
+           "tiering_token_invisible",
+           "kill_token_for_token_tiered",
+           "kill_within_mttr_budget_tiered",
+           "transient_token_invisible_tiered",
+           "transient_cross_tier_ledger_closed",
+           "overload_bounded_tiered",
+           "hot_swap_zero_dropped_tiered"),
+    streams={"metrics": "BENCH_FLEET_KV_METRICS_DIR",
+             "trace": "BENCH_FLEET_KV_TRACE_DIR"},
+))

@@ -1,0 +1,787 @@
+"""Scenario: the million-user day (ISSUE 17 tentpole) — ONE closed
+loop from the training fleet to the serving fleet on one deterministic
+cost-model clock, chaos armed the whole way through.
+
+The day, on a single virtual 86,400 s clock:
+
+* **Train plane** — a 3-replica SDC-guarded trainer (the PR 6 voting
+  discipline) takes 12 real optimizer steps on the hour, priced as a
+  256-chip PR 14 ladder fleet (tp=2 x pp=4 x ZeRO-3/fsdp=4 x dp over
+  DCN — hierarchical collectives, interleaved VPP, DCN-aware buckets,
+  collective matmul all ON). Every 4th step a CRC-verified checkpoint
+  commits (PR 4 manager). Chaos: ``flip_bits`` corrupts the victim
+  replica's gradients mid-morning (detect -> rewind -> replay), and
+  ``kill_rank`` loses a node at step 7 (restore from the last verified
+  checkpoint, replay forward, charged at the modeled 256-chip MTTR —
+  the same kill-and-rescale pricing whose 32->256 doubling ratios must
+  stay sublinear).
+
+* **Control plane** — each committed checkpoint restores into a
+  rollout twin and hot-swaps into the serving fleet through the PR 11
+  ``HotSwapController`` (canary verify + rollback), carrying the
+  ``swap_source()`` lineage so every ``hot_swap`` span in the request
+  traces names the producing session/generation/step. The SECOND
+  rollout is deliberately poisoned (NaN weights): the canary must
+  catch it on the first engine and auto-roll back — the poison never
+  decodes a token.
+
+* **Serve plane** — a 3-engine tiered fleet (PR 15: HBM prefix cache
+  -> host spill tier -> peer DCN) behind the failover router serves a
+  seeded diurnal Poisson day of 71 requests, each standing in for
+  15,000 identical sessions (1.065M modeled sessions). Chaos:
+  ``kill_engine`` takes out engine 0 mid-burst at hour 10 (failover +
+  KV migration, with ``drop_migration`` forcing one re-prefill
+  fallback first), ``drop_decode_step`` / ``corrupt_block_table`` /
+  ``corrupt_spill_block`` fire along the way — all absorbed without
+  dropping a request.
+
+* **Economics** — the headline is modeled **cost per served token**:
+  (256-chip train day + per-session serve chip-seconds) / modeled
+  tokens delivered, written as a perf_doctor stream whose
+  ``cost_per_served_token`` lane must equal the headline and
+  self-diff at exactly 0%.
+
+A **degraded twin** re-runs the same trace + the same chaos arm with
+ONE reliability lever broken (failure detection slowed from seconds to
+a quarter-day): it must FAIL at least one of the mirrored gates —
+proof the gates measure the levers, not the weather.
+
+All deterministic (XLA cost model x seeded traces x virtual clock —
+zero wall-clock anywhere; run twice, the artifact is byte-identical).
+"""
+
+import math
+import os
+
+import numpy as np
+
+from ..artifact import bench_scratch, log
+from . import registry
+
+# ---- day geometry (all virtual seconds) ---------------------------
+DAY_S = 86400.0
+SESSIONS_PER_REQUEST = 15_000
+N_ENGINES = 3
+REPLICAS = 3
+TRAIN_STEPS = 12
+CKPT_EVERY = 4
+TRAIN_SLOT_S = 3600.0            # one train macro-step per hour
+MAX_TRAIN_SLOTS = 20             # 12 steps + replayed slots headroom
+FLEET_CHIPS = 256
+SDC_STEP, SDC_VICTIM = 3, 1      # flip_bits: victim's 3rd opt step
+KILL_RANK_STEP = 7               # kill_rank: victim's 7th first-try step
+T_MIG = 36000.0                  # hour 10: engine-kill + migration burst
+T_SPILL = 64800.0                # hour 18: host-tier spill/fetch cohort
+PROBE_INTERVAL_S = 60.0          # failure-detection sweep (the lever
+DEGRADED_PROBE_S = DAY_S / 4.0   # ... the degraded twin breaks)
+
+# engine-0's decode-step count at the hour-10 burst is deterministic
+# (seeded trace x cost clock, diag `e0@mig` in the lane log); the kill
+# lands on the burst's 3rd decode round, when the four session-pinned
+# burst requests fill the victim's batch and the two tgt re-requests
+# are still queued — recovered pre-admission, prefix still in the
+# dead host tier, so failover takes the migration path
+KILL_ENGINE_NTH = 391
+
+DAY_CHAOS = (f"kill_engine:{KILL_ENGINE_NTH}:0,"
+             "drop_decode_step:120,"
+             "corrupt_block_table:260,"
+             "corrupt_spill_block:90,"
+             "drop_migration:1,"
+             f"kill_rank:{KILL_RANK_STEP}:1,"
+             f"flip_bits:grads:2:{SDC_VICTIM}:{SDC_STEP}")
+CHAOS_FAMILIES = ("kill_engine", "drop_decode_step",
+                  "corrupt_block_table", "corrupt_spill_block",
+                  "drop_migration", "kill_rank", "flip_bits")
+
+# SLO targets sized to the reliability levers, not the hardware: a
+# kill-stalled request may wait up to one probe sweep (60 s) before
+# failover, so a healthy day holds these with margin while the
+# degraded twin (quarter-day detection) blows through them
+SLO_TTFT_S = 300.0
+SLO_TPOT_S = 60.0
+SLO_E2E_S = 600.0
+SLO_AVAILABILITY = 0.95
+
+
+class _OptState:
+    """state_dict/load_state_dict adapter: the optimizer exposes
+    paddle-style set_state_dict, the checkpoint manager's stateful
+    registry wants the torch-style name."""
+
+    def __init__(self, o):
+        self._o = o
+
+    def state_dict(self):
+        return self._o.state_dict()
+
+    def load_state_dict(self, sd):
+        self._o.set_state_dict(sd)
+
+
+def build(scenario):
+    import zlib
+
+    import paddle2_tpu as paddle
+    import paddle2_tpu.optimizer as opt
+    from paddle2_tpu.distributed.bucket import link_bucket_bytes
+    from paddle2_tpu.distributed.fault_tolerance import (
+        GradientCorruptionError, SDCGuard, chaos, health)
+    from paddle2_tpu.distributed.fault_tolerance.flight_recorder import \
+        GENERATION_ENV
+    from paddle2_tpu.distributed.fault_tolerance.manager import (
+        CheckpointManager, SESSION_ENV)
+    from paddle2_tpu.distributed.fault_tolerance.replica import \
+        tree_to_host
+    from paddle2_tpu.distributed.spec_layout import SpecLayout
+    from paddle2_tpu.jit.functional import _collect_state
+    from paddle2_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+    from paddle2_tpu.observability import tracing
+    from paddle2_tpu.observability.cost_model import (
+        DEFAULT_DCN_GBPS, DEFAULT_DCN_LATENCY_US, DEFAULT_ICI_GBPS,
+        DEFAULT_ICI_LATENCY_US, CollectiveTraffic, StepCost,
+        pipeline_bubble_fraction)
+    from paddle2_tpu.serving import (
+        EngineConfig, EngineFailoverRouter, FleetKVRegistry,
+        HotSwapController, ReliabilityConfig, ServingEngine, SLOConfig,
+        diurnal_poisson_trace, poisson_trace, simulate_router,
+        simulate_serving)
+    from paddle2_tpu.serving.simulate import cost_seconds
+    from paddle2_tpu.tools import perf_doctor
+
+    metrics_dir = bench_scratch("million_user_day_metrics",
+                                env_var=scenario.streams["metrics"])
+    trace_dir = bench_scratch("million_user_day_trace",
+                              env_var=scenario.streams["trace"])
+    ckpt_dir = bench_scratch("million_user_day_ckpt")
+    exchange = bench_scratch("million_user_day_sdc")
+    quarantine = bench_scratch("million_user_day_quarantine")
+
+    # ---- the 256-chip fleet economics (PR 14 ladder, all levers on)
+    layout = SpecLayout()
+    alink = layout.link_model(
+        ici_gbps=DEFAULT_ICI_GBPS, dcn_gbps=DEFAULT_DCN_GBPS,
+        ici_latency_us=DEFAULT_ICI_LATENCY_US,
+        dcn_latency_us=DEFAULT_DCN_LATENCY_US)
+    fsdp_ax, dcn_ax = layout.fsdp_axis, layout.data_axis
+    tgt_ici = link_bucket_bytes(alink, (fsdp_ax,))
+    tgt_dcn = link_bucket_bytes(alink, (dcn_ax,))
+    H5, L5, V5, T5 = 2560, 32, 50304, 2048
+    TP5, PP5, FSDP5 = 2, 4, 4
+    M5, VS5, B5 = 16, 4, 16
+    PEAK, HBM = 197e12, 819e9
+    n_params5 = V5 * H5 + T5 * H5 + 12 * L5 * H5 * H5
+    grad_bytes5 = n_params5 // (TP5 * PP5) * 4
+    ag_bytes5 = n_params5 // (TP5 * PP5) * 2
+
+    def fleet_step_cost(n_chips):
+        # the PR 14 rung with every lever on (hierarchical grad sync,
+        # VPP, DCN-aware buckets, collective matmul) — the config the
+        # 256-chip training fleet runs all day
+        fsdp = min(FSDP5, n_chips // (TP5 * PP5))
+        dcn = n_chips // (TP5 * PP5 * fsdp)
+        flops_chip = 6.0 * n_params5 * (B5 * T5) / (TP5 * PP5)
+        bubble = pipeline_bubble_fraction(PP5, M5, VS5)
+        t = CollectiveTraffic()
+        tp_payload = (B5 // M5) * T5 * H5 * 2
+        for _ in range(M5 * (L5 // PP5) * 4):
+            t.add("all_reduce_sum", tp_payload, axes=(layout.tp_axis,),
+                  group_size=TP5, overlappable=True)
+        if fsdp > 1:
+            for _ in range(2 * (L5 // PP5)):
+                t.add("all_gather", ag_bytes5 / (L5 // PP5),
+                      axes=(fsdp_ax,), group_size=fsdp,
+                      overlappable=True)
+        if fsdp * dcn > 1:
+            if dcn > 1:
+                bucket = tgt_dcn * fsdp
+                n_b = max(1, math.ceil(grad_bytes5 / bucket))
+                for i in range(n_b):
+                    b = min(bucket, grad_bytes5 - i * bucket)
+                    t.add_hierarchical_all_reduce(
+                        b, ici_axes=(fsdp_ax,), dcn_axes=(dcn_ax,),
+                        ici_group=fsdp, dcn_group=dcn,
+                        overlappable=i < n_b - 1)
+            else:
+                n_b = max(1, math.ceil(grad_bytes5 / tgt_ici))
+                for i in range(n_b):
+                    b = min(tgt_ici, grad_bytes5 - i * tgt_ici)
+                    t.add("all_reduce_sum", b, axes=(fsdp_ax,),
+                          group_size=fsdp, overlappable=i < n_b - 1)
+        return StepCost(flops=flops_chip * (1.0 + bubble),
+                        hbm_bytes=0.0, traffic=t, link=alink,
+                        peak_flops=PEAK, hbm_bps=HBM)
+
+    c256 = fleet_step_cost(FLEET_CHIPS)
+    step_s_256 = c256.step_time_modeled_s()
+
+    # kill-and-rescale MTTR model (PR 14 drill terms: probe cadence,
+    # quarantine verdict, log2 gossip, buddy shard fetch, warm-cache
+    # recompile, one replayed step) — sublinear in world size
+    shard_bytes = 3 * 4 * n_params5 // (TP5 * PP5 * FSDP5)
+
+    def fleet_mttr(n_chips):
+        comp = {
+            "detect_s": 1.0,
+            "quarantine_s": 0.05,
+            "rendezvous_s": 0.1 * math.log2(n_chips),
+            "replica_fetch_s": round(
+                alink.seconds(shard_bytes, (dcn_ax,)), 4),
+            "compile_s": 0.29,
+            "replay_step_s": round(
+                fleet_step_cost(n_chips).step_time_modeled_s(), 4),
+        }
+        comp["mttr_s"] = round(sum(comp.values()), 4)
+        return comp
+
+    drills = {n: fleet_mttr(n) for n in (32, 64, 128, 256)}
+    mttr_ratios = [drills[b]["mttr_s"] / drills[a]["mttr_s"]
+                   for a, b in ((32, 64), (64, 128), (128, 256))]
+
+    # ---- model + cost probe (compiles prefill/decode buckets, prices
+    # the virtual clock) — BEFORE chaos arms, so the probe cannot
+    # consume one-shot counters
+    paddle.seed(0)
+    cfg = gpt_tiny(use_scan=False, max_position_embeddings=160)
+    model = GPTForCausalLM(cfg)
+
+    def make_engine(**over):
+        kw = dict(block_size=16, num_blocks=64, max_batch=4,
+                  prefill_budget_tokens=256, max_model_len=160,
+                  enable_prefix_cache=True, enable_kv_spill=True,
+                  host_tier_blocks=64, prefix_cache_blocks=2,
+                  reliability=ReliabilityConfig(slo=SLOConfig(
+                      ttft_target_s=SLO_TTFT_S,
+                      tpot_target_s=SLO_TPOT_S,
+                      e2e_target_s=SLO_E2E_S,
+                      availability_target=SLO_AVAILABILITY)))
+        kw.update(over)
+        return ServingEngine(model, config=EngineConfig(**kw))
+
+    probe = make_engine(enable_prefix_cache=False,
+                        enable_kv_spill=False, reliability=None)
+    simulate_serving(probe, poisson_trace(
+        2, rate_per_s=100.0, prompt_lens=[24, 96],
+        gen_tokens=[8, 8], vocab=cfg.vocab_size, seed=1))
+    decode_s = cost_seconds(probe.runner.decode_cost(
+        min(probe.runner._decode_costs)))
+    prefill_s = max(cost_seconds(c)
+                    for c in probe.runner._prefill_costs.values())
+    log(f"million-user-day probe: decode_s={decode_s * 1e6:.1f}us "
+        f"prefill_s={prefill_s * 1e6:.1f}us "
+        f"fleet_step={step_s_256:.3f}s")
+
+    # ---- the diurnal day: 64 background arrivals on a raised-cosine
+    # intensity + two engineered cohorts (migration burst at hour 10,
+    # spill/fetch pair at hour 18). Cohort arrivals cluster within
+    # microseconds: on the cost-model clock a request LIVES for
+    # microseconds, so "concurrent" means micro-spaced, not minutes.
+    rng = np.random.default_rng(7)
+    tgt = rng.integers(0, cfg.vocab_size, size=96).tolist()
+    spillp = rng.integers(0, cfg.vocab_size, size=96).tolist()
+    fill = [rng.integers(0, cfg.vocab_size, size=96).tolist()
+            for _ in range(4)]
+    burst = [rng.integers(0, cfg.vocab_size, size=96).tolist()
+             for _ in range(4)]
+    # Migration needs the victim prefix in the dead engine's HOST TIER
+    # at kill time with its re-requests still QUEUED (an admitted
+    # request promotes the chunks back to doomed HBM): warm the prefix,
+    # spill it via cap-pressure fillers, fill the victim's batch with a
+    # session-pinned burst, then queue two re-requests behind it — the
+    # first recovered one's migration is chaos-dropped, the second
+    # moves the tier blocks. The hour-18 pair replays warm->spill->
+    # re-request on a survivor for the host-tier fetch path.
+    cohorts = [
+        (tgt, [T_MIG - 60.0]),            # warm the victim prefix
+        (fill[0], [T_MIG - 50.0]),        # cap pressure: tgt -> tier
+        (fill[1], [T_MIG - 40.0]),
+        (burst[0], [T_MIG]),              # fill the victim's batch
+        (burst[1], [T_MIG + 1e-6]),
+        (burst[2], [T_MIG + 2e-6]),
+        (burst[3], [T_MIG + 3e-6]),
+        (tgt, [T_MIG + 2e-5]),            # queued when e0 dies: dropped
+        (tgt, [T_MIG + 3e-5]),            # queued when e0 dies: migrates
+        (spillp, [T_SPILL]),              # warm a survivor's prefix
+        (fill[2], [T_SPILL + 10.0]),      # cap pressure: spillp -> tier
+        (fill[3], [T_SPILL + 20.0]),
+        (spillp, [T_SPILL + 40.0]),       # re-request: host-tier fetch
+    ]
+    trace = diurnal_poisson_trace(
+        64, DAY_S, prompt_lens=[24, 48, 96], gen_tokens=[8, 16, 24],
+        vocab=cfg.vocab_size, seed=11, cohorts=cohorts)
+    # the burst shares ONE session so the router's session affinity
+    # pins all four to the victim engine (least-loaded would disperse
+    # them across the fleet and leave the victim's batch unfilled)
+    burst_sessions = {f"cohort-{c}-0" for c in (3, 4, 5, 6)}
+    for r in trace:
+        if r["session"] in burst_sessions:
+            r["session"] = "mig-burst"
+    sessions_modeled = len(trace) * SESSIONS_PER_REQUEST
+
+    # ---- train plane state (3 SDC-guarded replicas, checkpoint
+    # manager with optimizer side-state, rollout reader twin)
+    env_keys = (SESSION_ENV, GENERATION_ENV, "PADDLE_TRAINER_ID",
+                "PADDLE_NODE_ID", "PADDLE_QUARANTINE_DIR")
+    env_prev = {k: os.environ.get(k) for k in env_keys}
+    os.environ[SESSION_ENV] = "million-user-day"
+    os.environ[GENERATION_ENV] = "0"
+    os.environ["PADDLE_QUARANTINE_DIR"] = quarantine
+
+    rs = np.random.RandomState(3)
+    batches = []
+    for _ in range(4):
+        ids = rs.randint(0, cfg.vocab_size, size=(2, 17)).astype("int64")
+        batches.append((paddle.to_tensor(ids[:, :-1]),
+                        paddle.to_tensor(ids[:, 1:])))
+
+    replicas = []
+    for r in range(REPLICAS):
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        g = SDCGuard(o, store_dir=exchange, rank=r, world=REPLICAS,
+                     timeout=2.0, evict=False)
+        replicas.append((m, o, g))
+    mgr = CheckpointManager(ckpt_dir, keep_last=3)
+    mgr.register_stateful("opt", _OptState(replicas[0][1]))
+    rmgr = CheckpointManager(ckpt_dir, keep_last=3)   # rollout reader:
+    # a SEPARATE instance with no stateful registration, so restoring
+    # rollout weights can never rewind the live optimizer
+
+    train = {"done": 0, "executed": 0, "slots": 0,
+             "sdc_detected": [], "sdc_replay_ok": False,
+             "kills": 0, "restored_from": None, "replayed": [],
+             "saves": [], "stall_s": 0.0, "attempt": {},
+             "generation": 0}
+    rollout = {"queue": [], "ctl": None, "step": None,
+               "committed": [], "canary_failed": []}
+    state = {"e0_steps_at_mig": None}
+
+    def step_once(s):
+        """One lock-step train step across the replicas with the SDC
+        vote; returns 'killed' | 'corrupt' | 'clean'."""
+        inj = chaos.active()
+        attempt = train["attempt"].get(s, 0)
+        x, y = batches[s % len(batches)]
+        for r, (m, o, g) in enumerate(replicas):
+            os.environ["PADDLE_TRAINER_ID"] = str(r)
+            os.environ["PADDLE_NODE_ID"] = f"sim-node-{r}"
+            if attempt == 0 and inj is not None \
+                    and inj.armed("kill_rank"):
+                # maybe_kill_rank SIGKILLs the process — the bench
+                # ticks the spec by hand and models the node loss
+                sp = inj.should_fire(
+                    "kill_rank",
+                    gate=lambda spc, rr=r: rr == (
+                        0 if spc.param is None else int(spc.param)))
+                if sp is not None:
+                    inj.record("kill_rank", f"rank{r}:step{s}")
+                    return "killed"
+            g.begin(s, attempt=attempt)
+            _, loss = m(x, labels=y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            g.post()
+        train["executed"] += 1
+        raised, suspects = 0, []
+        for m, o, g in replicas:
+            try:
+                g.verify()
+            except GradientCorruptionError as e:
+                raised += 1
+                suspects = e.suspects
+        if raised:
+            train["sdc_detected"].append(s)
+            train["sdc_vote"] = (raised == REPLICAS
+                                 and suspects == [SDC_VICTIM])
+            return "corrupt"
+        return "clean"
+
+    def recover_from_kill(s):
+        os.environ["PADDLE_TRAINER_ID"] = "0"
+        os.environ["PADDLE_NODE_ID"] = "sim-node-0"
+        step0 = mgr.restore(replicas[0][0].state_dict()) or 0
+        ms = tree_to_host(replicas[0][0].state_dict())
+        osn = tree_to_host(replicas[0][1].state_dict())
+        for m, o, g in replicas[1:]:
+            m.set_state_dict(ms)
+            o.set_state_dict(osn)
+        train["kills"] += 1
+        train["restored_from"] = step0
+        train["replayed"] = list(range(step0 + 1, s + 1))
+        train["done"] = step0
+        train["generation"] += 1
+        os.environ[GENERATION_ENV] = str(train["generation"])
+        # goodput loss: the modeled 256-chip MTTR plus re-running the
+        # steps since the last verified checkpoint
+        train["stall_s"] += (drills[FLEET_CHIPS]["mttr_s"]
+                             + (s - step0 - 1) * step_s_256)
+        for s2 in range(step0 + 1, TRAIN_STEPS + 1):
+            train["attempt"][s2] = train["attempt"].get(s2, 0) + 1
+
+    def advance_train():
+        s = train["done"] + 1
+        snaps = [(tree_to_host(m.state_dict()),
+                  tree_to_host(o.state_dict())) for m, o, g in replicas]
+        out = step_once(s)
+        if out == "killed":
+            recover_from_kill(s)
+            return
+        if out == "corrupt":
+            # rewind to the pre-step snapshot and replay — one wasted
+            # fleet step of goodput
+            train["stall_s"] += step_s_256
+            train["attempt"][s] = train["attempt"].get(s, 0) + 1
+            for (m, o, g), (ms, osn) in zip(replicas, snaps):
+                m.set_state_dict(ms)
+                o.set_state_dict(osn)
+            out = step_once(s)
+            train["sdc_replay_ok"] = (out == "clean"
+                                      and train.get("sdc_vote", False))
+            if out != "clean":
+                return
+        train["done"] = s
+        if s % CKPT_EVERY == 0:
+            os.environ["PADDLE_TRAINER_ID"] = "0"
+            os.environ["PADDLE_NODE_ID"] = "sim-node-0"
+            mgr.save(replicas[0][0].state_dict(), step=s)
+            train["saves"].append(s)
+            rollout["queue"].append(s)
+
+    def poisoned_payload(rm):
+        params, buffers = _collect_state([rm])
+        arrays = [t._data for t in params + buffers]
+        import jax.numpy as jnp
+        for i, a in enumerate(arrays):
+            if jnp.issubdtype(a.dtype, jnp.floating):
+                arrays[i] = jnp.full(a.shape, jnp.nan, a.dtype)
+                break
+        return arrays
+
+    def weights_finite(eng):
+        return all(bool(np.isfinite(np.asarray(w)).all())
+                   for w in eng.runner._weights()
+                   if "float" in str(getattr(w, "dtype", "")))
+
+    def stage_rollouts(rt, clock):
+        ctl = rollout["ctl"]
+        if ctl is None and rollout["queue"]:
+            step = rollout["queue"].pop(0)
+            rm = GPTForCausalLM(cfg)
+            rmgr.restore(rm.state_dict())
+            src = rmgr.swap_source()
+            # the SECOND checkpoint of the day ships poisoned weights:
+            # the canary must catch it before a single token decodes
+            poison = len(train["saves"]) >= 2 \
+                and step == train["saves"][1]
+            payload = poisoned_payload(rm) if poison else rm
+            ctl = HotSwapController(rt.engines, payload,
+                                    verify=weights_finite, source=src)
+            rollout["ctl"], rollout["step"] = ctl, step
+        if ctl is None:
+            return
+        # stage one engine per busy round so the engine-side hot_swap
+        # span lands while requests are in flight (tids= mirrors it
+        # into the per-request trace plane — the lineage join)
+        if not any(e.scheduler.running() for e in rt.engines
+                   if not e.failed):
+            return
+        ctl.stage_next(now=clock)
+        if ctl.state == "committed":
+            rollout["committed"].append(rollout["step"])
+            rollout["ctl"] = None
+        elif ctl.state == "rolled_back":
+            rollout["canary_failed"].append(rollout["step"])
+            rollout["ctl"] = None
+
+    def on_day_round(rt, clock, idx):
+        if state["e0_steps_at_mig"] is None and clock >= T_MIG:
+            state["e0_steps_at_mig"] = rt.engines[0].decode_steps
+        while (train["slots"] < MAX_TRAIN_SLOTS
+               and train["done"] < TRAIN_STEPS
+               and clock >= TRAIN_SLOT_S * (train["slots"] + 1)):
+            train["slots"] += 1
+            advance_train()
+        stage_rollouts(rt, clock)
+
+    # ---- the day itself: chaos armed END TO END
+    def run_day(probe_interval_s, on_round):
+        engines = [make_engine() for _ in range(N_ENGINES)]
+        router = EngineFailoverRouter(
+            engines, probe_interval_s=probe_interval_s,
+            kv_registry=FleetKVRegistry(engines))
+        chaos.arm(DAY_CHAOS)
+        rep = simulate_router(router, [dict(r) for r in trace],
+                              on_round=on_round)
+        fired = {k for k, _ in chaos.fired_log()}
+        chaos.disarm()
+        return router, rep, fired
+
+    pl = tracing.enable(trace_dir, rank=0)
+    try:
+        router, rep, fired = run_day(PROBE_INTERVAL_S, on_day_round)
+        tracing.flush()
+        swap_spans = [e for e in pl.events()
+                      if e.get("event") == "hot_swap"]
+    finally:
+        tracing.disable()
+
+    seqs = [router.sequence(r) for r in rep.rids]
+    toks = [s.generated for s in seqs]
+    toks_crc = zlib.crc32(b"".join(
+        np.asarray(t, np.int64).tobytes() for t in toks)) & 0xFFFFFFFF
+    tpots = [(s.finish_t - s.first_token_t) / (len(s.generated) - 1)
+             for s in seqs
+             if s.finish_t is not None and s.first_token_t is not None
+             and len(s.generated) > 1]
+    p99_tpot_s = float(np.percentile(tpots, 99)) if tpots else 0.0
+    slo_good = sum(e.scheduler.slo_good for e in router.engines)
+    slo_bad = sum(e.scheduler.slo_bad for e in router.engines)
+    budget = max(1.0 - SLO_AVAILABILITY, 1e-9)
+    burn = ((slo_bad / max(slo_good + slo_bad, 1)) / budget)
+
+    # ---- the degraded twin: same trace, same chaos (fresh one-shot
+    # counters), ONE lever broken — failure detection slowed from one
+    # probe sweep per minute to one per quarter-day
+    _, rep_twin, _ = run_day(DEGRADED_PROBE_S, None)
+    twin_gates = {
+        "zero_dropped_requests": (
+            rep_twin.completed == len(trace)
+            and rep_twin.rejected == 0 and rep_twin.shed == 0),
+        "serving_p99_ttft_holds": (
+            rep_twin.p99_ttft_s <= 2 * PROBE_INTERVAL_S),
+        "serving_mttr_within_detection_budget": (
+            0.0 < rep_twin.mttr_s <= PROBE_INTERVAL_S + 1.0),
+    }
+
+    # ---- economics: cost per served token, surfaced via perf_doctor
+    train_chip_s = FLEET_CHIPS * DAY_S
+    serve_busy_s = (rep.decode_steps * decode_s
+                    + (rep.submitted + rep.recovered_seqs) * prefill_s)
+    serve_chip_s = serve_busy_s * SESSIONS_PER_REQUEST
+    tokens_served = rep.total_tokens * SESSIONS_PER_REQUEST
+    cost_per_token = (train_chip_s + serve_chip_s) / tokens_served
+
+    os.makedirs(metrics_dir, exist_ok=True)
+    import json as _json
+    ov = c256.overlap()
+    cls = c256.exposed_network_by_class()
+    n_rec = 7   # 1 warmup + 6 counted; uniform stamps keep the
+    # post-warmup chips/tokens RATIO equal to the headline
+    rec = {"type": "step", "rank": 0,
+           "total_s": c256.step_time_modeled_s(),
+           "compute_s": c256.compute_s(),
+           "collective_s": ov["exposed_s"],
+           "input_wait_s": 0.0, "host_s": 0.0,
+           "exposed_comm_s": ov["exposed_s"],
+           "exposed_comm_ici_s": cls["ici"],
+           "exposed_comm_dcn_s": cls["dcn"],
+           "chip_seconds": (train_chip_s + serve_chip_s) / n_rec,
+           "served_tokens": tokens_served / n_rec}
+    with open(os.path.join(metrics_dir, "metrics_rank_0.jsonl"),
+              "w") as f:
+        for st in range(n_rec):
+            f.write(_json.dumps(dict(rec, step=st), sort_keys=True)
+                    + "\n")
+    pd_rep = perf_doctor.summarize(perf_doctor.load_streams(metrics_dir))
+    pd_cost = pd_rep["aggregate"].get("cost_per_served_token")
+    pd_diff = perf_doctor.diff(pd_rep, pd_rep)
+    pd_cost_diff = pd_diff.get("cost_per_served_token", {})
+
+    # ---- lineage: committed hot_swap spans in the request traces
+    # carry (generation, ckpt_step) — pre-kill generation 0 for the
+    # first rollout, generation 1 after the kill_rank recovery
+    span_keys = {(sp.get("generation"), sp.get("ckpt_step"))
+                 for sp in swap_spans}
+    traced_swaps = [sp for sp in swap_spans if sp.get("tids")]
+
+    # the serving fleet's survivors run the LAST verified checkpoint
+    final_w = [np.asarray(t._data) for t in sum(
+        _collect_state([replicas[0][0]]), [])]
+    alive = [e for e in router.engines if not e.failed]
+    fleet_on_lineage = all(
+        all(np.array_equal(np.asarray(w), fw)
+            for w, fw in zip(e.runner._weights(), final_w))
+        for e in alive)
+
+    store = health.QuarantineStore(quarantine)
+    quarantined = [e for e in store.entries()
+                   if e.get("rank") == SDC_VICTIM
+                   and e.get("reason") == "fingerprint_vote"]
+
+    train_weights = [np.asarray(
+        sum(_collect_state([m]), [])[0]._data) for m, o, g in replicas]
+    replicas_bitwise = (np.array_equal(train_weights[0],
+                                       train_weights[1])
+                        and np.array_equal(train_weights[0],
+                                           train_weights[2]))
+
+    gates = {
+        "million_sessions_modeled": sessions_modeled >= 1_000_000,
+        "zero_dropped_requests": (
+            rep.completed == rep.submitted == len(trace)
+            and rep.rejected == 0 and rep.shed == 0),
+        "slo_burn_within_budget": burn <= 1.0,
+        "serving_p99_ttft_holds": (
+            rep.p99_ttft_s <= 2 * PROBE_INTERVAL_S),
+        "serving_p99_tpot_holds": 0.0 < p99_tpot_s <= SLO_TPOT_S,
+        "serving_mttr_within_detection_budget": (
+            0.0 < rep.mttr_s <= PROBE_INTERVAL_S + 1.0),
+        "train_mttr_sublinear": all(r < 1.25 for r in mttr_ratios),
+        "train_day_completed_through_chaos": (
+            train["done"] == TRAIN_STEPS
+            and train["saves"] == [4, 8, 12]),
+        "sdc_detected_and_replayed": (
+            train["sdc_detected"] == [SDC_STEP]
+            and train["sdc_replay_ok"] and bool(quarantined)
+            and replicas_bitwise),
+        "kill_rank_recovered_from_checkpoint": (
+            train["kills"] == 1 and train["restored_from"] == 4
+            and train["replayed"] == [5, 6, 7]),
+        "checkpoints_swapped_into_fleet": (
+            rollout["committed"] == [4, 12] and fleet_on_lineage),
+        "poisoned_canary_rolled_back": (
+            rollout["canary_failed"] == [8]
+            and all(weights_finite(e) for e in alive)),
+        "generation_joins_serve_trace": (
+            (0, 4) in span_keys and (1, 12) in span_keys
+            and len(traced_swaps) >= 1),
+        "kv_tier_exercised": (
+            rep.kv_spilled_blocks > 0 and rep.kv_fetch_host_blocks > 0
+            and rep.kv_migrations >= 1),
+        "chaos_all_families_fired": fired == set(CHAOS_FAMILIES),
+        "cost_per_served_token_surfaced": (
+            pd_cost is not None
+            and math.isclose(pd_cost, cost_per_token, rel_tol=1e-9)),
+        "perf_doctor_self_diff_zero": (
+            pd_cost_diff.get("delta_pct") == 0.0
+            and not pd_diff.get("regressed", True)),
+        "degraded_twin_fails_a_gate": not all(twin_gates.values()),
+    }
+
+    log(f"million-user-day: {sessions_modeled:,} sessions "
+        f"completed={rep.completed}/{len(trace)} burn={burn:.3f} "
+        f"p99_ttft={rep.p99_ttft_s:.2f}s mttr={rep.mttr_s:.2f}s "
+        f"cost/token={cost_per_token:.3e} chip-s "
+        f"fired={sorted(fired)} "
+        f"e0@mig={state['e0_steps_at_mig']} "
+        f"twin_fail={[k for k, v in twin_gates.items() if not v]}")
+
+    for k in env_keys:
+        if env_prev[k] is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = env_prev[k]
+
+    return {
+        "metric": "cost_per_served_token",
+        "value": round(cost_per_token, 12),
+        "unit": "chip_seconds_per_token",
+        "scale": {
+            "sessions_modeled": sessions_modeled,
+            "requests": len(trace),
+            "sessions_per_request": SESSIONS_PER_REQUEST,
+            "tokens_served_modeled": tokens_served,
+            "day_s": DAY_S,
+        },
+        "serving": {
+            "completed": rep.completed,
+            "rejected": rep.rejected,
+            "shed": rep.shed,
+            "failovers": rep.failovers,
+            "recovered_seqs": rep.recovered_seqs,
+            "mttr_s": round(rep.mttr_s, 6),
+            "p99_ttft_s": round(rep.p99_ttft_s, 6),
+            "p99_tpot_s": round(p99_tpot_s, 6),
+            "slo_good": slo_good,
+            "slo_bad": slo_bad,
+            "slo_burn": round(burn, 6),
+            "kv": {
+                "spilled_blocks": rep.kv_spilled_blocks,
+                "host_fetch_blocks": rep.kv_fetch_host_blocks,
+                "migrations": rep.kv_migrations,
+                "migrated_blocks": rep.kv_migrated_blocks,
+                "migrations_declined": rep.kv_migrations_declined,
+            },
+            "tokens_crc": toks_crc,
+        },
+        "train": {
+            "steps": train["done"],
+            "executed": train["executed"],
+            "saves": train["saves"],
+            "sdc_detected_steps": train["sdc_detected"],
+            "kill_restored_from": train["restored_from"],
+            "kill_replayed": train["replayed"],
+            "generation": train["generation"],
+            "stall_s": round(train["stall_s"], 4),
+            "fleet_step_s": round(step_s_256, 6),
+            "mttr_model": drills,
+            "mttr_doubling_ratios": [round(r, 4) for r in mttr_ratios],
+        },
+        "rollouts": {
+            "committed": rollout["committed"],
+            "canary_failed": rollout["canary_failed"],
+            "hot_swap_spans": sorted(
+                [list(k) for k in span_keys if k[0] is not None]),
+            "traced_swaps": len(traced_swaps),
+        },
+        "chaos": {"armed": DAY_CHAOS, "fired": sorted(fired)},
+        "economics": {
+            "train_chip_s": train_chip_s,
+            "serve_chip_s": round(serve_chip_s, 4),
+            "cost_per_served_token": round(cost_per_token, 12),
+            "perf_doctor_cost": (round(pd_cost, 12)
+                                 if pd_cost is not None else None),
+            "perf_doctor_self_diff_pct": pd_cost_diff.get("delta_pct"),
+        },
+        "degraded_twin": {
+            "probe_interval_s": DEGRADED_PROBE_S,
+            "completed": rep_twin.completed,
+            "p99_ttft_s": round(rep_twin.p99_ttft_s, 6),
+            "mttr_s": round(rep_twin.mttr_s, 6),
+            "gates": twin_gates,
+            "failed": sorted(k for k, v in twin_gates.items() if not v),
+        },
+        "gates": gates,
+    }
+
+
+SCENARIO = registry.register(registry.Scenario(
+    name="million-user-day",
+    artifact="MILLION_USER_DAY_r01.json",
+    build=build,
+    description="one closed-loop train->serve day under always-armed "
+                "chaos: 256-chip modeled training fleet, CRC-verified "
+                "checkpoints hot-swapped through canary+rollback into "
+                "a tiered 3-engine serving fleet, gated on zero drops, "
+                "SLO burn, sublinear MTTR, and modeled cost per served "
+                "token",
+    model={"family": "gpt_tiny", "use_scan": False,
+           "max_position_embeddings": 160},
+    parallelism={"engines": N_ENGINES, "train_replicas": REPLICAS,
+                 "fleet_chips": FLEET_CHIPS},
+    trace={"kind": "diurnal_poisson+cohorts", "requests": 77,
+           "sessions_per_request": SESSIONS_PER_REQUEST,
+           "prompt_lens": [24, 48, 96], "gen_tokens": [8, 16, 24]},
+    gates=("million_sessions_modeled",
+           "zero_dropped_requests",
+           "slo_burn_within_budget",
+           "serving_p99_ttft_holds",
+           "serving_p99_tpot_holds",
+           "serving_mttr_within_detection_budget",
+           "train_mttr_sublinear",
+           "train_day_completed_through_chaos",
+           "sdc_detected_and_replayed",
+           "kill_rank_recovered_from_checkpoint",
+           "checkpoints_swapped_into_fleet",
+           "poisoned_canary_rolled_back",
+           "generation_joins_serve_trace",
+           "kv_tier_exercised",
+           "chaos_all_families_fired",
+           "cost_per_served_token_surfaced",
+           "perf_doctor_self_diff_zero",
+           "degraded_twin_fails_a_gate"),
+    streams={"metrics": "BENCH_DAY_METRICS_DIR",
+             "trace": "BENCH_DAY_TRACE_DIR"},
+))

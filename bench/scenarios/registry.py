@@ -1,0 +1,67 @@
+"""Declarative bench-scenario registry (ROADMAP item 2, seed slice).
+
+A scenario DECLARES what it is — model, parallelism, trace shape, the
+gate names it must satisfy, the streams it emits — and the runner
+supplies everything the lanes used to hand-roll: cost×rate pricing is
+probed inside the builder on the shared cost model, artifact emission
+is byte-identical through :func:`bench.artifact.emit_result`, and the
+metric/trace streams land in env-overridable scratch dirs so CI can
+diff them with perf_doctor/serve_doctor across two runs.
+
+The builder receives its :class:`Scenario` and returns the result
+dict (must carry a ``"gates"`` mapping that includes every DECLARED
+gate name — a scenario whose declaration drifts from its
+implementation fails loudly, not silently).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Tuple
+
+from ..artifact import emit_result
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One declarative bench lane."""
+
+    name: str                     # registry key; CLI flag is --<name>
+    artifact: str                 # byte-identical artifact filename
+    build: Callable[["Scenario"], Dict[str, Any]]
+    description: str = ""
+    model: Dict[str, Any] = field(default_factory=dict)
+    parallelism: Dict[str, Any] = field(default_factory=dict)
+    trace: Dict[str, Any] = field(default_factory=dict)
+    gates: Tuple[str, ...] = ()   # declared gate names (must all exist)
+    streams: Dict[str, str] = field(default_factory=dict)
+    # stream role -> env var that pins its directory (CI diffing)
+
+
+REGISTRY: Dict[str, Scenario] = {}
+
+
+def register(scenario: Scenario) -> Scenario:
+    if scenario.name in REGISTRY:
+        raise ValueError(f"duplicate scenario {scenario.name!r}")
+    REGISTRY[scenario.name] = scenario
+    return scenario
+
+
+def get(name: str) -> Scenario:
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"unknown scenario {name!r}; "
+                       f"registered: {sorted(REGISTRY)}") from None
+
+
+def run(name: str) -> int:
+    """Build the scenario's result and emit its artifact; the process
+    exit code is the gate verdict."""
+    sc = get(name)
+    result = sc.build(sc)
+    gates = result.get("gates", {})
+    missing = [g for g in sc.gates if g not in gates]
+    if missing:
+        raise KeyError(f"scenario {sc.name!r} declared gates the "
+                       f"builder never evaluated: {missing}")
+    return emit_result(sc.name, sc.artifact, result)

@@ -1,0 +1,270 @@
+"""Scenario: the serving robustness gate (ISSUE 11), ported onto the
+declarative registry (ISSUE 17) with its artifact bytes unchanged.
+
+Chaos drills and gates:
+  1. **Engine kill** — 2-engine failover router; chaos ``kill_engine``
+     murders engine 1 mid-decode. Every accepted in-flight request must
+     complete TOKEN-FOR-TOKEN identical to the fault-free run
+     (re-prefill from host token logs == eviction-exactness), within
+     the gated MTTR budget (probe detection + re-prefill, on the
+     virtual clock).
+  2. **Transient faults** — ``drop_decode_step`` +
+     ``corrupt_block_table`` on one engine: recovery must be
+     token-invisible (retry recomputes; table rebuild re-prefills) and
+     the allocator ledger must drain clean.
+  3. **Overload** — bounded admission queue under a burst at ~10x
+     capacity with mixed priorities: shed fraction bounded, ONLY
+     lowest-priority requests shed, every admitted request completes,
+     and p99 TTFT of admitted requests stays within the PR 9 bound
+     (10x the prefill+decode floor).
+  4. **Hot-swap** — staged rollout + rollback across the fleet
+     mid-traffic: zero dropped requests and a decode program census
+     IDENTICAL to the same trace served without any swap
+     (weights-as-args: a swap is an argument change, never a
+     recompile).
+
+All deterministic (XLA cost model x seeded traces x virtual clock —
+ZERO wall-clock anywhere; run twice, the artifact is byte-identical).
+Writes the serving metrics stream (shed/retry/failover counters +
+modeled step records) for perf_doctor.
+"""
+
+import numpy as np
+
+from ..artifact import bench_scratch, log
+from . import registry
+
+
+def build(scenario):
+    import zlib
+    import paddle2_tpu as paddle
+    from paddle2_tpu.distributed.fault_tolerance import chaos
+    from paddle2_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+    from paddle2_tpu.observability import metrics
+    from paddle2_tpu.serving import (
+        EngineConfig, EngineFailoverRouter, HotSwapController,
+        ReliabilityConfig, ServingEngine, poisson_trace,
+        simulate_router, simulate_serving)
+    from paddle2_tpu.serving.simulate import cost_seconds
+
+    metrics_dir = bench_scratch(
+        "serving_reliability_metrics",
+        env_var=scenario.streams["metrics"])
+    paddle.seed(0)
+    cfg = gpt_tiny(use_scan=False, max_position_embeddings=128)
+    model = GPTForCausalLM(cfg)
+    prompt_lens, gen_tokens = [16, 24], [12, 24]
+    mean_gen = float(np.mean(gen_tokens))
+
+    def make_engine(reliability=None):
+        return ServingEngine(model, config=EngineConfig(
+            block_size=16, num_blocks=40, max_batch=8,
+            prefill_budget_tokens=64, max_model_len=128,
+            reliability=reliability))
+
+    def make_trace(n, seed, rate, priorities=False):
+        t = poisson_trace(n, rate_per_s=rate, prompt_lens=prompt_lens,
+                          gen_tokens=gen_tokens, vocab=cfg.vocab_size,
+                          seed=seed)
+        if priorities:
+            for i, r in enumerate(t):
+                r["priority"] = 1 if i % 3 == 0 else 0
+        return t
+
+    def toks_of(router, rep):
+        return [router.sequence(r).generated for r in rep.rids]
+
+    def crc(tok_lists):
+        payload = b"".join(np.asarray(t, np.int64).tobytes()
+                           for t in tok_lists)
+        return zlib.crc32(payload) & 0xFFFFFFFF
+
+    # -- phase 0: probe the cost model (compiles prefill + b1 decode)
+    probe = make_engine()
+    simulate_serving(probe, make_trace(2, seed=1, rate=100.0))
+    b1_key = min(probe.runner._decode_costs)
+    decode_s = cost_seconds(probe.runner.decode_cost(b1_key))
+    prefill_s = max(cost_seconds(c)
+                    for c in probe.runner._prefill_costs.values())
+    base_capacity = 1.0 / decode_s
+    probe_interval_s = 2.0 * decode_s
+    log(f"serving-reliability probe: decode_s={decode_s*1e6:.1f}us "
+        f"prefill_s={prefill_s*1e6:.1f}us "
+        f"probe_interval={probe_interval_s*1e6:.1f}us")
+
+    metrics.enable(metrics_dir, rank=0, flush_steps=1)
+    gates = {}
+
+    # -- drill 1: engine kill mid-decode -> failover, token-for-token
+    kill_trace = make_trace(16, seed=101,
+                            rate=2.0 * base_capacity / mean_gen)
+    r_clean = EngineFailoverRouter([make_engine(), make_engine()],
+                                   probe_interval_s=probe_interval_s)
+    rep_clean = simulate_router(r_clean, [dict(r) for r in kill_trace])
+    clean_toks = toks_of(r_clean, rep_clean)
+    chaos.arm("kill_engine:4:1")
+    r_kill = EngineFailoverRouter([make_engine(), make_engine()],
+                                  probe_interval_s=probe_interval_s)
+    rep_kill = simulate_router(r_kill, [dict(r) for r in kill_trace])
+    chaos.disarm()
+    kill_toks = toks_of(r_kill, rep_kill)
+    # MTTR budget: one probe detection window + re-prefill of the
+    # recovered sequences on the survivor's prefill lane, with 2x
+    # headroom — all modeled quantities, so the budget is as
+    # deterministic as the measurement
+    mttr_budget_s = 2.0 * (probe_interval_s
+                           + rep_kill.recovered_seqs * prefill_s
+                           + 4.0 * decode_s)
+    gates["kill_all_requests_complete"] = (
+        rep_kill.completed == len(kill_trace) == rep_clean.completed)
+    gates["kill_token_for_token"] = kill_toks == clean_toks
+    gates["kill_failover_within_mttr_budget"] = (
+        rep_kill.failovers == 1 and rep_kill.recovered_seqs >= 1
+        and 0.0 < rep_kill.mttr_s <= mttr_budget_s)
+    log(f"serving-reliability kill: completed {rep_kill.completed}/"
+        f"{len(kill_trace)} failovers={rep_kill.failovers} "
+        f"recovered={rep_kill.recovered_seqs} "
+        f"mttr={rep_kill.mttr_s*1e6:.1f}us "
+        f"(budget {mttr_budget_s*1e6:.1f}us) "
+        f"token-for-token={gates['kill_token_for_token']}")
+
+    # -- drill 2: transient faults on one engine, token-invisible
+    chaos.arm("drop_decode_step:3,corrupt_block_table:5:1")
+    r_tr = EngineFailoverRouter([make_engine()],
+                                probe_interval_s=probe_interval_s)
+    rep_tr = simulate_router(r_tr, [dict(r) for r in kill_trace])
+    fired = {k for k, _ in chaos.fired_log()}
+    chaos.disarm()
+    tr_toks = toks_of(r_tr, rep_tr)
+    eng_tr = r_tr.engines[0]
+    gates["transient_faults_token_invisible"] = (
+        fired == {"drop_decode_step", "corrupt_block_table"}
+        and tr_toks == clean_toks
+        and rep_tr.completed == len(kill_trace))
+    gates["transient_allocator_drains_clean"] = (
+        eng_tr.allocator.free_count == eng_tr.allocator.num_blocks - 1)
+    log(f"serving-reliability transient: fired={sorted(fired)} "
+        f"token-invisible={gates['transient_faults_token_invisible']}")
+
+    # -- drill 3: overload burst vs bounded queue + priorities
+    over_trace = make_trace(40, seed=202,
+                            rate=10.0 * base_capacity / mean_gen,
+                            priorities=True)
+    r_over = EngineFailoverRouter(
+        [make_engine(ReliabilityConfig(max_queue_depth=6))],
+        probe_interval_s=probe_interval_s)
+    rep_over = simulate_router(r_over, [dict(r) for r in over_trace])
+    shed_n = rep_over.shed + rep_over.rejected
+    shed_frac = shed_n / len(over_trace)
+    shed_prios = [s.priority for s in r_over.engines[0].scheduler.shed]
+    ttft_bound = 10.0 * (prefill_s + decode_s)
+    gates["overload_shed_bounded"] = 0.0 < shed_frac <= 0.6
+    gates["overload_sheds_lowest_priority_only"] = (
+        all(p == 0 for p in shed_prios))
+    gates["overload_admitted_all_complete"] = (
+        rep_over.completed == rep_over.submitted - rep_over.shed)
+    gates["overload_p99_ttft_within_pr9_gate"] = (
+        rep_over.p99_ttft_s <= ttft_bound)
+    log(f"serving-reliability overload: shed {shed_n}/{len(over_trace)}"
+        f" ({100*shed_frac:.0f}%) p99 TTFT "
+        f"{rep_over.p99_ttft_s*1e3:.3f}ms (bound "
+        f"{ttft_bound*1e3:.3f}ms) completed {rep_over.completed}")
+
+    # -- drill 4: staged hot-swap rollout + rollback, zero-drop
+    swap_trace = make_trace(16, seed=303,
+                            rate=2.0 * base_capacity / mean_gen)
+    r_ref = EngineFailoverRouter([make_engine(), make_engine()],
+                                 probe_interval_s=probe_interval_s)
+    rep_ref = simulate_router(r_ref, [dict(r) for r in swap_trace])
+    census_ref = [e.num_decode_programs for e in r_ref.engines]
+    swap_engines = [make_engine(), make_engine()]
+    r_swap = EngineFailoverRouter(swap_engines,
+                                  probe_interval_s=probe_interval_s)
+    new_w = [w * 1.001 if "float" in str(getattr(w, "dtype", "")) else w
+             for w in swap_engines[0].runner._weights()]
+    ctl = HotSwapController(swap_engines, new_w)
+
+    def on_round(rt, clock, idx):
+        if idx in (6, 9):
+            ctl.stage_next(now=clock)
+        elif idx == 14 and ctl.state == "committed":
+            ctl.rollback(now=clock)
+
+    rep_swap = simulate_router(r_swap, [dict(r) for r in swap_trace],
+                               on_round=on_round)
+    census_swap = [e.num_decode_programs for e in swap_engines]
+    gates["hot_swap_zero_dropped"] = (
+        rep_swap.completed == len(swap_trace)
+        and ctl.state == "rolled_back" and len(ctl.staged) == 2)
+    gates["hot_swap_census_unchanged"] = census_swap == census_ref
+    log(f"serving-reliability hot-swap: state={ctl.state} completed "
+        f"{rep_swap.completed}/{len(swap_trace)} census "
+        f"{census_swap} vs ref {census_ref}")
+
+    metrics.flush()
+    metrics.export_prometheus()
+    metrics.disable()
+
+    return {
+        "metric": "serving_reliability_drills",
+        "value": sum(bool(v) for v in gates.values()),
+        "unit": "gates_passed",
+        "kill": {
+            "completed": rep_kill.completed,
+            "failovers": rep_kill.failovers,
+            "recovered_seqs": rep_kill.recovered_seqs,
+            "mttr_us": round(rep_kill.mttr_s * 1e6, 3),
+            "mttr_budget_us": round(mttr_budget_s * 1e6, 3),
+            "tokens_crc": crc(kill_toks),
+            "clean_tokens_crc": crc(clean_toks),
+        },
+        "transient": {
+            "fired": sorted(fired),
+            "completed": rep_tr.completed,
+            "tokens_crc": crc(tr_toks),
+        },
+        "overload": {
+            "shed": shed_n,
+            "shed_fraction": round(shed_frac, 4),
+            "completed": rep_over.completed,
+            "p99_ttft_ms": round(rep_over.p99_ttft_s * 1e3, 4),
+            "ttft_bound_ms": round(ttft_bound * 1e3, 4),
+        },
+        "hot_swap": {
+            "completed": rep_swap.completed,
+            "stages": len(ctl.staged),
+            "state": ctl.state,
+            "census": census_swap,
+            "census_ref": census_ref,
+        },
+        "probe": {
+            "decode_us": round(decode_s * 1e6, 3),
+            "prefill_us": round(prefill_s * 1e6, 3),
+            "probe_interval_us": round(probe_interval_s * 1e6, 3),
+        },
+        "gates": gates,
+    }
+
+
+SCENARIO = registry.register(registry.Scenario(
+    name="serving-reliability",
+    artifact="SERVING_RELIABILITY_r01.json",
+    build=build,
+    description="Admission control, engine-failure recovery, failover "
+                "routing, and zero-drop weight hot-swap under chaos",
+    model={"family": "gpt_tiny", "use_scan": False,
+           "max_position_embeddings": 128},
+    parallelism={"engines": 2},
+    trace={"kind": "poisson", "prompt_lens": [16, 24],
+           "gen_tokens": [12, 24]},
+    gates=("kill_all_requests_complete", "kill_token_for_token",
+           "kill_failover_within_mttr_budget",
+           "transient_faults_token_invisible",
+           "transient_allocator_drains_clean",
+           "overload_shed_bounded",
+           "overload_sheds_lowest_priority_only",
+           "overload_admitted_all_complete",
+           "overload_p99_ttft_within_pr9_gate",
+           "hot_swap_zero_dropped", "hot_swap_census_unchanged"),
+    streams={"metrics": "BENCH_SERVING_RELIABILITY_METRICS_DIR"},
+))

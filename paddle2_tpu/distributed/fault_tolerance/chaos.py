@@ -7,7 +7,7 @@ occurrence per kind, so a failing chaos run replays bit-identically.
 
 Spec grammar (flag ``FLAGS_chaos`` or :func:`arm`)::
 
-    kind[:nth[:param]][,kind...]
+    kind[:nth[:param]][,kind...]     (';' separates like ',')
 
     corrupt_shard:2        flip bytes of the 2nd shard file written
     truncate_shard:1       write only half of the 1st shard file
@@ -44,6 +44,18 @@ Spec grammar (flag ``FLAGS_chaos`` or :func:`arm`)::
                            flip_bits:grads:3:1:2 = 3 bits, rank 1,
                            2nd optimizer step
 
+One armed value may carry MANY specs — comma- or semicolon-separated,
+including several of the same kind — and each spec keeps its own
+independent one-shot occurrence counter and victim gate. A whole-day
+drill arms every fault family once up front::
+
+    kill_engine:40:1;kill_engine:90:0;drop_decode_step:25;
+    corrupt_block_table:60;corrupt_spill_block:3;drop_migration:1;
+    kill_rank:7:1;flip_bits:grads:3:0:11
+
+Here engine 1 dies at ITS 40th decode step and engine 0 at its 90th:
+two ``kill_engine`` specs, two counters, two fires.
+
 Clean-path cost is a single module-attribute load per hook site: every
 hook starts with ``if _ACTIVE is None: return`` — no device syncs, no
 flag lookups, no allocation when chaos is disarmed (the acceptance bar:
@@ -71,18 +83,47 @@ KINDS = ("corrupt_shard", "truncate_shard", "fail_commit", "poison_loss",
 _FLIP_WHERES = ("grads", "collective")
 
 
+class _Spec:
+    """One armed chaos spec: an independent one-shot occurrence counter
+    plus its param and (for flip_bits) sub-grammar fields. Several
+    specs — including several of the same kind — coexist in one
+    injector; each ticks and fires on its own clock."""
+
+    __slots__ = ("kind", "nth", "param", "count", "flip")
+
+    def __init__(self, kind: str, nth: int,
+                 param: Optional[float] = None,
+                 flip: Optional[Dict[str, Any]] = None):
+        self.kind = kind
+        self.nth = nth
+        self.param = param
+        self.count = 0
+        self.flip = flip
+
+    def __repr__(self) -> str:
+        return (f"_Spec({self.kind}, nth={self.nth}, "
+                f"param={self.param}, count={self.count})")
+
+
 class ChaosInjector:
-    """Per-kind occurrence counters + the fired-event log."""
+    """Per-spec occurrence counters + the fired-event log.
+
+    ``specs`` holds every armed spec in declaration order. The legacy
+    single-spec views stay for callers that predate multi-spec arming:
+    ``targets[kind]`` and ``flip`` reflect the FIRST spec of each kind,
+    ``counts[kind]`` aggregates ticks across all specs of the kind."""
 
     def __init__(self, spec: str):
         self.spec = spec
+        self.specs: List[_Spec] = []
+        self._by_kind: Dict[str, List[_Spec]] = {}
         self.targets: Dict[str, Tuple[int, Optional[float]]] = {}
         self.counts: Dict[str, int] = {}
         self.fired: List[Tuple[str, str]] = []
         # flip_bits rides its own grammar (WHERE is a word, not an nth):
         # flip_bits:WHERE:N[:RANK[:NTH]]
         self.flip: Optional[Dict[str, Any]] = None
-        for part in spec.split(","):
+        for part in spec.replace(";", ",").split(","):
             part = part.strip()
             if not part:
                 continue
@@ -97,27 +138,46 @@ class ChaosInjector:
                     raise ValueError(
                         f"flip_bits WHERE must be one of {_FLIP_WHERES},"
                         f" got {where!r}")
-                self.flip = {
+                fl = {
                     "where": where,
                     "bits": int(pieces[2]) if len(pieces) > 2 else 1,
                     "rank": int(pieces[3]) if len(pieces) > 3 else 0,
                     "nth": int(pieces[4]) if len(pieces) > 4 else 1,
                 }
-                self.targets[kind] = (self.flip["nth"],
-                                      float(self.flip["bits"]))
-                self.counts[kind] = 0
-                continue
-            nth = int(pieces[1]) if len(pieces) > 1 else 1
-            param = float(pieces[2]) if len(pieces) > 2 else None
-            self.targets[kind] = (nth, param)
-            self.counts[kind] = 0
+                sp = _Spec(kind, fl["nth"], float(fl["bits"]), fl)
+                if self.flip is None:
+                    self.flip = fl
+            else:
+                nth = int(pieces[1]) if len(pieces) > 1 else 1
+                param = float(pieces[2]) if len(pieces) > 2 else None
+                sp = _Spec(kind, nth, param)
+            self.specs.append(sp)
+            self._by_kind.setdefault(kind, []).append(sp)
+            if kind not in self.targets:
+                self.targets[kind] = (sp.nth, sp.param)
+            self.counts.setdefault(kind, 0)
 
-    def should_fire(self, kind: str) -> bool:
-        tgt = self.targets.get(kind)
-        if tgt is None:
-            return False
-        self.counts[kind] += 1
-        return self.counts[kind] == tgt[0]
+    def armed(self, kind: str) -> bool:
+        return kind in self._by_kind
+
+    def should_fire(self, kind: str, gate=None) -> Optional[_Spec]:
+        """Tick every armed spec of ``kind`` that ``gate`` admits at
+        this site (``gate=None`` admits all) and return the spec whose
+        counter just hit its nth — or None. A spec fires exactly once:
+        the counter keeps ticking past nth, it just can't equal it
+        again. Specs of the same kind tick independently, so two
+        ``kill_engine`` specs with different victim params coexist —
+        the hook's gate decides which specs this occurrence belongs
+        to. Truthiness matches the old bool contract."""
+        fired = None
+        for sp in self._by_kind.get(kind, ()):
+            if gate is not None and not gate(sp):
+                continue
+            sp.count += 1
+            self.counts[kind] = self.counts.get(kind, 0) + 1
+            if sp.count == sp.nth and fired is None:
+                fired = sp
+        return fired
 
     def param(self, kind: str, default: float) -> float:
         tgt = self.targets.get(kind)
@@ -224,8 +284,9 @@ def maybe_delay_collective(tag: str) -> None:
     """Watchdog waiter hook: hold the op in flight past its deadline."""
     if _ACTIVE is None:
         return
-    if _ACTIVE.should_fire("delay_collective"):
-        delay = _ACTIVE.param("delay_collective", 0.5)
+    sp = _ACTIVE.should_fire("delay_collective")
+    if sp is not None:
+        delay = 0.5 if sp.param is None else sp.param
         _ACTIVE.record("delay_collective", f"{tag}:{delay}")
         time.sleep(delay)
 
@@ -236,8 +297,9 @@ def maybe_stall_collective(tag: str) -> None:
     runs on the waiter/deadline helper thread, never the main thread."""
     if _ACTIVE is None:
         return
-    if _ACTIVE.should_fire("stall_collective"):
-        delay = _ACTIVE.param("stall_collective", 30.0)
+    sp = _ACTIVE.should_fire("stall_collective")
+    if sp is not None:
+        delay = 30.0 if sp.param is None else sp.param
         _ACTIVE.record("stall_collective", f"{tag}:{delay}")
         time.sleep(delay)
 
@@ -249,9 +311,10 @@ def maybe_crash_worker(pids) -> None:
     occurrence counter is single-process-deterministic."""
     if _ACTIVE is None:
         return
-    if _ACTIVE.should_fire("worker_crash"):
+    sp = _ACTIVE.should_fire("worker_crash")
+    if sp is not None:
         import signal as _signal
-        w = int(_ACTIVE.param("worker_crash", 0.0))
+        w = 0 if sp.param is None else int(sp.param)
         w = w if 0 <= w < len(pids) else 0
         _ACTIVE.record("worker_crash", f"worker{w}:pid{pids[w]}")
         try:
@@ -269,18 +332,16 @@ def maybe_kill_rank(step: Any = None) -> None:
     the survivors are doing. SIGKILL on purpose: no excepthook, no
     flight dump, no atexit — recovery must work from the OUTSIDE
     evidence (buddy replica, launcher supervision) alone."""
-    if _ACTIVE is None:
-        return
-    tgt = _ACTIVE.targets.get("kill_rank")
-    if tgt is None:
+    if _ACTIVE is None or not _ACTIVE.armed("kill_rank"):
         return
     from ..env import get_rank
-    victim = 0 if tgt[1] is None else int(tgt[1])
-    if get_rank() != victim:
-        return
-    if _ACTIVE.should_fire("kill_rank"):
+    rank = get_rank()
+    sp = _ACTIVE.should_fire(
+        "kill_rank",
+        gate=lambda s: rank == (0 if s.param is None else int(s.param)))
+    if sp is not None:
         import signal as _signal
-        _ACTIVE.record("kill_rank", f"rank{victim}:step{step}")
+        _ACTIVE.record("kill_rank", f"rank{rank}:step{step}")
         os.kill(os.getpid(), _signal.SIGKILL)
 
 
@@ -319,8 +380,10 @@ def flip_mantissa_bits(arr, n_bits: int, seed: int = 0):
 
 
 def _flip_armed(where: str) -> bool:
-    return (_ACTIVE is not None and _ACTIVE.flip is not None
-            and _ACTIVE.flip["where"] == where)
+    if _ACTIVE is None:
+        return False
+    return any(s.flip is not None and s.flip["where"] == where
+               for s in _ACTIVE._by_kind.get("flip_bits", ()))
 
 
 def maybe_flip_bits_grads(optimizer) -> None:
@@ -332,19 +395,22 @@ def maybe_flip_bits_grads(optimizer) -> None:
     if _ACTIVE is None or not _flip_armed("grads"):
         return
     from ..env import get_rank
-    if get_rank() != _ACTIVE.flip["rank"]:
+    rank = get_rank()
+    sp = _ACTIVE.should_fire(
+        "flip_bits",
+        gate=lambda s: (s.flip is not None
+                        and s.flip["where"] == "grads"
+                        and s.flip["rank"] == rank))
+    if sp is None:
         return
-    if not _ACTIVE.should_fire("flip_bits"):
-        return
-    n = _ACTIVE.flip["bits"]
+    n = sp.flip["bits"]
     for p in optimizer._parameter_list():
         if p.grad is None:
             continue
         p.grad._replace_data(
             flip_mantissa_bits(p.grad._data, n,
                                seed=_ACTIVE.counts["flip_bits"]))
-        _ACTIVE.record("flip_bits",
-                       f"grads:rank{_ACTIVE.flip['rank']}:{n}bits")
+        _ACTIVE.record("flip_bits", f"grads:rank{rank}:{n}bits")
         return
 
 
@@ -365,12 +431,16 @@ def maybe_flip_bits_array(where: str, arr, rank_axis: bool = False):
                                                        jnp.floating):
         return arr
     from ..env import get_rank
-    victim = _ACTIVE.flip["rank"]
-    if not rank_axis and get_rank() != victim:
+    rank = get_rank()
+    sp = _ACTIVE.should_fire(
+        "flip_bits",
+        gate=lambda s: (s.flip is not None
+                        and s.flip["where"] == where
+                        and (rank_axis or s.flip["rank"] == rank)))
+    if sp is None:
         return arr
-    if not _ACTIVE.should_fire("flip_bits"):
-        return arr
-    n = _ACTIVE.flip["bits"]
+    victim = sp.flip["rank"]
+    n = sp.flip["bits"]
     if rank_axis and getattr(arr, "ndim", 0) >= 1 \
             and 0 <= victim < arr.shape[0]:
         row = flip_mantissa_bits(arr[victim], n,
@@ -403,19 +473,23 @@ def compiled_grad_fault(amp: bool = False):
     :func:`maybe_flip_bits_grads`."""
     if _ACTIVE is None:
         return None
-    if amp and "poison_grads" in _ACTIVE.targets \
+    if amp and _ACTIVE.armed("poison_grads") \
             and _ACTIVE.should_fire("poison_grads"):
         _ACTIVE.record("poison_grads", "compiled")
         return ("poison",)
     if _flip_armed("grads"):
         from ..env import get_rank
-        if get_rank() == _ACTIVE.flip["rank"] \
-                and _ACTIVE.should_fire("flip_bits"):
-            n = int(_ACTIVE.flip["bits"])
+        rank = get_rank()
+        sp = _ACTIVE.should_fire(
+            "flip_bits",
+            gate=lambda s: (s.flip is not None
+                            and s.flip["where"] == "grads"
+                            and s.flip["rank"] == rank))
+        if sp is not None:
+            n = int(sp.flip["bits"])
             seed = int(_ACTIVE.counts["flip_bits"])
             _ACTIVE.record(
-                "flip_bits",
-                f"grads:rank{_ACTIVE.flip['rank']}:{n}bits:compiled")
+                "flip_bits", f"grads:rank{rank}:{n}bits:compiled")
             return ("flip", n, seed)
     return None
 
@@ -481,16 +555,14 @@ def maybe_kill_engine(engine_id: int, step: int = -1) -> bool:
     is doing. The engine marks itself failed and raises
     ``EngineFailedError`` — the failover router recovers its in-flight
     sequences from their host token logs."""
-    if _ACTIVE is None:
+    if _ACTIVE is None or not _ACTIVE.armed("kill_engine"):
         return False
-    tgt = _ACTIVE.targets.get("kill_engine")
-    if tgt is None:
-        return False
-    victim = 0 if tgt[1] is None else int(tgt[1])
-    if int(engine_id) != victim:
-        return False
-    if _ACTIVE.should_fire("kill_engine"):
-        _ACTIVE.record("kill_engine", f"engine{victim}:step{step}")
+    eid = int(engine_id)
+    sp = _ACTIVE.should_fire(
+        "kill_engine",
+        gate=lambda s: eid == (0 if s.param is None else int(s.param)))
+    if sp is not None:
+        _ACTIVE.record("kill_engine", f"engine{eid}:step{step}")
         return True
     return False
 
@@ -505,7 +577,7 @@ def maybe_drop_decode_step(engine_id: int = 0) -> bool:
     idempotent), costing one extra step of modeled time."""
     if _ACTIVE is None:
         return False
-    if "drop_decode_step" not in _ACTIVE.targets:
+    if not _ACTIVE.armed("drop_decode_step"):
         return False
     if _ACTIVE.should_fire("drop_decode_step"):
         _ACTIVE.record("drop_decode_step", f"engine{engine_id}")
@@ -526,12 +598,12 @@ def maybe_corrupt_block_table(block_lists) -> Optional[int]:
     empty round."""
     if _ACTIVE is None or not block_lists:
         return None
-    tgt = _ACTIVE.targets.get("corrupt_block_table")
-    if tgt is None:
+    if not _ACTIVE.armed("corrupt_block_table"):
         return None
-    if not _ACTIVE.should_fire("corrupt_block_table"):
+    sp = _ACTIVE.should_fire("corrupt_block_table")
+    if sp is None:
         return None
-    pos = (0 if tgt[1] is None else int(tgt[1])) % len(block_lists)
+    pos = (0 if sp.param is None else int(sp.param)) % len(block_lists)
     blocks = block_lists[pos]
     if blocks:
         blocks[len(blocks) // 2] = CORRUPT_BLOCK_ID
@@ -551,7 +623,7 @@ def maybe_corrupt_spill_block(host_tier) -> Optional[tuple]:
     the corrupted prefix key, or None."""
     if _ACTIVE is None or host_tier is None or len(host_tier) == 0:
         return None
-    if "corrupt_spill_block" not in _ACTIVE.targets:
+    if not _ACTIVE.armed("corrupt_spill_block"):
         return None
     if not _ACTIVE.should_fire("corrupt_spill_block"):
         return None
@@ -567,7 +639,7 @@ def maybe_drop_migration() -> bool:
     from the harvested token log, costing time, never tokens."""
     if _ACTIVE is None:
         return False
-    if "drop_migration" not in _ACTIVE.targets:
+    if not _ACTIVE.armed("drop_migration"):
         return False
     if _ACTIVE.should_fire("drop_migration"):
         _ACTIVE.record("drop_migration", "kv transfer dropped")

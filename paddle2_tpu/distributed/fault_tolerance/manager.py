@@ -123,6 +123,19 @@ class CheckpointManager:
         except (OSError, ValueError):
             return None
 
+    def swap_source(self) -> Dict[str, Any]:
+        """Provenance of the checkpoint the ``latest`` pointer names,
+        shaped for the serving hot-swap plane: ``{"session",
+        "generation", "step"}``. Handing this to a
+        ``HotSwapController(source=...)`` stamps the train-side restart
+        generation onto every serving hot-swap flight span, so a serve
+        trace answers "WHICH training lineage produced the weights this
+        request decoded under" without joining logs by wall clock —
+        the cross-plane join is in the span itself."""
+        sess, gen = self.committed_generation()
+        return {"session": sess, "generation": gen,
+                "step": self.latest_step()}
+
     # -- restart-generation fencing -------------------------------------
     def committed_generation(self):
         """(session, generation) recorded at the last pointer commit,

@@ -56,6 +56,7 @@ from .scheduler import (Request, Sequence, SeqState,
 from .engine import ServingEngine, EngineConfig
 from .simulate import (ServingSimReport, simulate_serving,
                        simulate_predictor_baseline, poisson_trace,
+                       diurnal_poisson_trace,
                        EngineFailoverRouter, RouterSimReport,
                        simulate_router, FleetKVRegistry)
 
@@ -74,7 +75,7 @@ __all__ = [
     "SchedulerConfig",
     "ServingEngine", "EngineConfig",
     "ServingSimReport", "simulate_serving", "simulate_predictor_baseline",
-    "poisson_trace",
+    "poisson_trace", "diurnal_poisson_trace",
     "EngineFailoverRouter", "RouterSimReport", "simulate_router",
     "FleetKVRegistry",
 ]

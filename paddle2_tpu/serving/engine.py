@@ -412,13 +412,20 @@ class ServingEngine:
         return rid
 
     # -- weight hot-swap -------------------------------------------------
-    def swap_weights(self, weights, now: float = 0.0) -> List:
+    def swap_weights(self, weights, now: float = 0.0,
+                     source=None) -> List:
         """Swap new checkpoint weights into the running engine between
         decode steps. ``weights`` is a model (``GPTForCausalLM``) or a
         flat array list matching the runner state. Weights-as-args
         means the compiled programs are untouched — the swap can never
         grow the decode program census. Returns the previous weight
-        arrays (the rollback payload)."""
+        arrays (the rollback payload).
+
+        ``source`` (``CheckpointManager.swap_source()`` shape) stamps
+        the producing checkpoint's restart generation onto the
+        ``hot_swap`` span — and because that span carries ``t=`` and
+        the in-flight ``tids=``, the generation rides into every
+        affected request's trace."""
         from ..observability import metrics
         self._check_alive()
         arrays = weights
@@ -434,8 +441,12 @@ class ServingEngine:
         # quiesce can price its pause into their swap_stall component
         tids = [s.trace_id for s in self.scheduler.running()
                 if s.trace_id is not None]
+        src = source or {}
         _flight_record(event="hot_swap", engine=self.engine_id, t=now,
-                       tids=tids or None, pause_s=0.0)
+                       tids=tids or None, pause_s=0.0,
+                       generation=src.get("generation"),
+                       ckpt_step=src.get("step"),
+                       session=src.get("session"))
         return prev
 
     # -- admission + prefill ---------------------------------------------

@@ -188,18 +188,35 @@ class HotSwapController:
 
     ``verify`` (optional) runs after every stage; returning ``False``
     triggers an automatic rollback and marks the controller
-    ``rolled_back`` — the staged-canary pattern."""
+    ``rolled_back`` — the staged-canary pattern.
+
+    ``source`` (optional) names the checkpoint lineage the new weights
+    came from — ``CheckpointManager.swap_source()`` returns the right
+    shape (``{"session", "generation", "step"}``). When present, every
+    hot-swap span (stage/commit/canary-failed/rollback, and the
+    engine-side ``hot_swap`` span that mirrors into per-request
+    traces) carries the train-side restart generation, so serve traces
+    join to the producing training lineage by construction."""
 
     def __init__(self, engines: Seq, new_weights,
-                 verify: Optional[Callable] = None):
+                 verify: Optional[Callable] = None,
+                 source: Optional[dict] = None):
         self.engines = list(engines)
         self.new_weights = new_weights
         self.verify = verify
+        self.source = dict(source) if source else None
         self._prev = {}              # engine idx -> pre-swap arrays
         self.staged: List[int] = []
         self.state = "pending"       # rolling|committed|rolled_back
 
     def _record(self, event: str, **fields) -> None:
+        # flatten the checkpoint lineage into the span so the fields
+        # are greppable in dumps (nested dicts survive JSON but defeat
+        # `serve_doctor`-style field scans)
+        if self.source is not None:
+            fields.setdefault("generation", self.source.get("generation"))
+            fields.setdefault("ckpt_step", self.source.get("step"))
+            fields.setdefault("session", self.source.get("session"))
         flight_record(event=event, **fields)
 
     def _done_staging(self) -> bool:
@@ -222,7 +239,8 @@ class HotSwapController:
         for idx, eng in enumerate(self.engines):
             if idx in self._prev or getattr(eng, "failed", False):
                 continue
-            self._prev[idx] = eng.swap_weights(self.new_weights, now=now)
+            self._prev[idx] = eng.swap_weights(self.new_weights, now=now,
+                                               source=self.source)
             self.staged.append(idx)
             self._record("hot_swap_stage", engine=idx, t=now,
                          stage=len(self.staged))

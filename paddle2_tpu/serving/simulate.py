@@ -31,8 +31,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["poisson_trace", "ServingSimReport", "simulate_serving",
-           "simulate_predictor_baseline", "cost_seconds",
+__all__ = ["poisson_trace", "diurnal_poisson_trace", "ServingSimReport",
+           "simulate_serving", "simulate_predictor_baseline",
+           "cost_seconds",
            "EngineFailoverRouter", "RouterSimReport", "simulate_router",
            "FleetKVRegistry"]
 
@@ -55,6 +56,54 @@ def poisson_trace(n_requests: int, rate_per_s: float,
             "prompt": rng.integers(0, vocab, size=plen).tolist(),
             "max_new_tokens": int(gen_tokens[i % len(gen_tokens)]),
         })
+    return out
+
+
+def diurnal_poisson_trace(n_requests: int, day_s: float,
+                          prompt_lens, gen_tokens, vocab: int,
+                          seed: int = 0, peak_hour: float = 14.0,
+                          trough_frac: float = 0.25,
+                          cohorts=()) -> List[dict]:
+    """Seeded NON-homogeneous Poisson trace over one simulated day:
+    arrival intensity follows a raised-cosine diurnal curve (peak at
+    ``peak_hour`` local, trough at ``trough_frac`` of the peak rate),
+    sampled by inverting the numeric rate integral — order statistics
+    of a day-long inhomogeneous Poisson process conditioned on
+    ``n_requests`` arrivals. Deterministic in ``seed``.
+
+    ``cohorts`` optionally injects shared-prefix sessions (the
+    fleet-KV exercise): each entry is ``(prefix_tokens, arrival_ts)``
+    and adds one request per listed arrival time whose prompt starts
+    with that exact prefix — same-prefix requests route by affinity
+    and exercise the prefix-cache / host-tier / migration ladder.
+    Every request carries a ``session`` id; arrivals come out sorted."""
+    rng = np.random.default_rng(seed)
+    hours = np.linspace(0.0, 24.0, 1441)
+    rate = trough_frac + (1.0 - trough_frac) * 0.5 * (
+        1.0 + np.cos(2.0 * np.pi * (hours - peak_hour) / 24.0))
+    cum = np.concatenate(
+        ([0.0], np.cumsum((rate[1:] + rate[:-1]) * 0.5)))
+    cum /= cum[-1]
+    u = np.sort(rng.random(n_requests))
+    arrivals = np.interp(u, cum, hours) / 24.0 * day_s
+    out = []
+    for i, t in enumerate(arrivals):
+        plen = int(prompt_lens[i % len(prompt_lens)])
+        out.append({
+            "arrival_t": float(t),
+            "prompt": rng.integers(0, vocab, size=plen).tolist(),
+            "max_new_tokens": int(gen_tokens[i % len(gen_tokens)]),
+            "session": f"day-{i}",
+        })
+    for c, (prefix, times) in enumerate(cohorts):
+        for j, t in enumerate(times):
+            out.append({
+                "arrival_t": float(t),
+                "prompt": list(prefix),
+                "max_new_tokens": int(gen_tokens[j % len(gen_tokens)]),
+                "session": f"cohort-{c}-{j}",
+            })
+    out.sort(key=lambda r: (r["arrival_t"], r["session"]))
     return out
 
 

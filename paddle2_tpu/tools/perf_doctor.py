@@ -366,6 +366,22 @@ def summarize(streams: Dict[int, Dict[str, Any]],
                 and x.get("peak_flops")]
         if mfus:
             entry["mfu_modeled"] = _mean(mfus)
+        # cost x rate economics lane (ISSUE 17): records stamped with
+        # (chip_seconds, served_tokens) pairs — modeled chip-seconds
+        # spent over tokens delivered. Deterministic like the modeled
+        # step, so the diff verdict can gate on COST PER SERVED TOKEN
+        # with zero wall-clock noise. The raw sums ride along so the
+        # aggregate below can divide fleet chips by fleet tokens
+        # instead of averaging per-rank ratios.
+        cpairs = [(x["chip_seconds"], x["served_tokens"]) for x in steps
+                  if "chip_seconds" in x and "served_tokens" in x]
+        if cpairs:
+            chip_sum = sum(c for c, _ in cpairs)
+            tok_sum = sum(t for _, t in cpairs)
+            entry["chip_seconds_total"] = chip_sum
+            entry["served_tokens_total"] = tok_sum
+            if tok_sum > 0:
+                entry["cost_per_served_token"] = chip_sum / tok_sum
         samp = [x["samples"] for x in steps if "samples" in x]
         if samp and entry["mean_total_s"] > 0:
             entry["samples_per_s"] = _mean(samp) / entry["mean_total_s"]
@@ -425,6 +441,19 @@ def summarize(streams: Dict[int, Dict[str, Any]],
         mfu_vals = [e.get("mfu_modeled") for e in per.values()]
         if mfu_vals and all(m is not None for m in mfu_vals):
             agg["mfu_modeled"] = _mean(mfu_vals)
+        # cost lane aggregates only when EVERY rank carries it, and as
+        # fleet-chips / fleet-tokens (NOT a mean of ratios: a rank that
+        # served 10 tokens would weigh as much as one that served 10k)
+        cost_vals = [e.get("cost_per_served_token") for e in per.values()]
+        if cost_vals and all(c is not None for c in cost_vals):
+            fleet_chips = sum(e["chip_seconds_total"]
+                              for e in per.values())
+            fleet_toks = sum(e["served_tokens_total"]
+                             for e in per.values())
+            if fleet_toks > 0:
+                agg["cost_per_served_token"] = fleet_chips / fleet_toks
+                agg["served_tokens_total"] = fleet_toks
+                agg["chip_seconds_total"] = fleet_chips
         if agg["mean_total_s"] > 0:
             agg["breakdown_pct"] = {
                 _COMPONENT_LABEL[c]: 100.0 * agg[f"mean_{c}"]
@@ -527,6 +556,28 @@ def diff(base: Dict[str, Any], new: Dict[str, Any],
             out["regressed"] = True
             out["verdict_source"] = "mfu"
             out["total_delta_pct"] = drop_pct
+    # cost-per-served-token delta (ISSUE 17): deterministic economics —
+    # a RISE is a regression (more chip-seconds bought per token
+    # delivered). Comparable only when both streams carry the lane, and
+    # then it fails the gate exactly like a modeled-step regression.
+    ca = a.get("cost_per_served_token")
+    cb = b.get("cost_per_served_token")
+    if ca is not None or cb is not None:
+        comparable = ca is not None and cb is not None
+        rise_pct = (100.0 * (cb - ca) / ca) if comparable and ca > 0 \
+            else None
+        out["cost_per_served_token"] = {
+            "base": ca, "new": cb, "delta_pct": rise_pct,
+            "comparable": comparable,
+            "base_served_tokens": a.get("served_tokens_total"),
+            "new_served_tokens": b.get("served_tokens_total"),
+            "regressed": bool(rise_pct is not None
+                              and rise_pct > threshold_pct)}
+        if out["cost_per_served_token"]["regressed"] \
+                and not out["regressed"]:
+            out["regressed"] = True
+            out["verdict_source"] = "cost"
+            out["total_delta_pct"] = rise_pct
     # exposed-comm % delta: an overlap regression (a bucket that
     # stopped hiding under backward, a prefetch that went eager) shows
     # up HERE even when total step time moved for other reasons too.
@@ -605,6 +656,11 @@ def format_summary(report: Dict[str, Any], directory: str) -> str:
         L.append(f"  MFU (modeled): {100.0 * agg['mfu_modeled']:.1f}% "
                  f"of chip peak over the roofline step time "
                  f"(deterministic cost model)")
+    if "cost_per_served_token" in agg:
+        L.append(f"  cost: {agg['cost_per_served_token']:.3e} "
+                 f"chip-seconds per served token "
+                 f"({agg['chip_seconds_total']:,.0f} chip-s over "
+                 f"{agg['served_tokens_total']:,.0f} tokens, modeled)")
     for r, e in sorted(report["per_rank"].items()):
         extra = ""
         if "tokens_per_s" in e:
@@ -618,6 +674,9 @@ def format_summary(report: Dict[str, Any], directory: str) -> str:
                           f"/dcn {e['exposed_comm_dcn_pct']:.1f}%)")
         if "mfu_modeled" in e:
             extra += f"  MFU {100.0 * e['mfu_modeled']:.1f}%"
+        if "cost_per_served_token" in e:
+            extra += (f"  cost {e['cost_per_served_token']:.3e} "
+                      f"chip-s/token")
         if e.get("warmup_included"):
             extra += "  [WARMUP INCLUDED: stream shorter than warmup]"
         L.append(f"  rank {r}: {e['steps']} steps, mean "
@@ -725,6 +784,16 @@ def format_diff(d: Dict[str, Any]) -> str:
         else:
             L.append("  MFU (modeled): [incomparable: only one stream "
                      "carries the roofline lane]")
+    co = d.get("cost_per_served_token")
+    if co:
+        if co.get("comparable"):
+            tag = "  (COST REGRESSION)" if co["regressed"] else ""
+            L.append(f"  cost/served-token: {co['base']:.3e} -> "
+                     f"{co['new']:.3e} chip-s "
+                     f"({co['delta_pct']:+.2f}%, deterministic){tag}")
+        else:
+            L.append("  cost/served-token: [incomparable: only one "
+                     "stream carries the cost lane]")
     ms = d.get("modeled_step")
     if ms:
         if ms.get("comparable"):

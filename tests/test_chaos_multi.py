@@ -1,0 +1,157 @@
+"""Multi-spec chaos arming (ISSUE 17 satellite).
+
+One ``FLAGS_chaos``/``PADDLE_CHAOS`` value now carries MANY specs —
+comma- or semicolon-separated, repeated kinds included — each with an
+independent one-shot counter and its own rank/engine victim gate. The
+million-user-day drill arms every fault family once up front and lets
+them fire on schedule; these tests pin the parsing, the counter
+independence, and re-arm semantics that drill depends on.
+"""
+
+import numpy as np
+import pytest
+
+from paddle2_tpu.distributed.fault_tolerance import chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    chaos.disarm()
+    yield
+    chaos.disarm()
+
+
+class _FakeHostTier:
+    """Minimal stand-in for the serving host KV tier: something to
+    corrupt, and a deterministic key to report."""
+
+    def __len__(self):
+        return 1
+
+    def corrupt_one(self):
+        return (1, 2, 3)
+
+
+# ======================================================== spec parsing
+class TestParsing:
+    def test_semicolon_separates_like_comma(self):
+        a = chaos.ChaosInjector("fail_commit:1,poison_loss:2")
+        b = chaos.ChaosInjector("fail_commit:1;poison_loss:2")
+        assert [(s.kind, s.nth, s.param) for s in a.specs] \
+            == [(s.kind, s.nth, s.param) for s in b.specs]
+
+    def test_mixed_separators_and_whitespace(self):
+        inj = chaos.ChaosInjector(
+            "drop_decode_step:2; corrupt_block_table:5:1 ,"
+            "drop_migration:1")
+        assert [s.kind for s in inj.specs] == [
+            "drop_decode_step", "corrupt_block_table", "drop_migration"]
+        assert inj.specs[1].param == 1.0
+
+    def test_repeated_kind_keeps_every_spec(self):
+        inj = chaos.ChaosInjector("kill_engine:3:0,kill_engine:5:1")
+        kinds = [s.kind for s in inj.specs]
+        assert kinds == ["kill_engine", "kill_engine"]
+        assert [(s.nth, s.param) for s in inj.specs] \
+            == [(3, 0.0), (5, 1.0)]
+
+    def test_legacy_views_reflect_first_spec(self):
+        inj = chaos.ChaosInjector(
+            "kill_engine:3:0,kill_engine:5:1,"
+            "flip_bits:grads:3:1:2,flip_bits:collective:1")
+        assert inj.targets["kill_engine"] == (3, 0.0)
+        assert inj.flip == {"where": "grads", "bits": 3,
+                            "rank": 1, "nth": 2}
+        assert inj.counts["kill_engine"] == 0
+
+    def test_multiple_flip_wheres_both_armed(self):
+        chaos.arm("flip_bits:grads:2:0:5,flip_bits:collective:1:0:1")
+        assert chaos._flip_armed("grads")
+        assert chaos._flip_armed("collective")
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError, match="unknown chaos kind"):
+            chaos.ChaosInjector("kill_engine:1;meteor_strike:1")
+
+    def test_bad_flip_where_raises(self):
+        with pytest.raises(ValueError, match="WHERE"):
+            chaos.ChaosInjector("flip_bits:loss:1")
+
+
+# ================================================ counter independence
+class TestIndependentCounters:
+    def test_two_victims_of_one_kind_fire_on_their_own_clocks(self):
+        chaos.arm("kill_engine:2:0,kill_engine:3:1")
+        # each victim's counter ticks only on ITS decode steps
+        assert not chaos.maybe_kill_engine(0, step=0)
+        assert not chaos.maybe_kill_engine(1, step=0)
+        assert chaos.maybe_kill_engine(0, step=1)        # e0's 2nd
+        assert not chaos.maybe_kill_engine(1, step=1)
+        assert chaos.maybe_kill_engine(1, step=2)        # e1's 3rd
+        assert not chaos.maybe_kill_engine(0, step=3)    # one-shot
+        assert not chaos.maybe_kill_engine(1, step=3)
+
+    def test_kinds_do_not_cross_tick(self):
+        chaos.arm("drop_decode_step:1,corrupt_spill_block:1")
+        assert chaos.maybe_drop_decode_step()
+        inj = chaos.active()
+        assert inj.counts["drop_decode_step"] == 1
+        assert inj.counts["corrupt_spill_block"] == 0
+
+    def test_aggregate_counts_view_sums_specs(self):
+        chaos.arm("kill_engine:2:0,kill_engine:2:1")
+        chaos.maybe_kill_engine(0)
+        chaos.maybe_kill_engine(1)
+        assert chaos.active().counts["kill_engine"] == 2
+
+    def test_flip_where_gates_are_independent(self):
+        chaos.arm("flip_bits:collective:1:0:1,flip_bits:grads:2:0:5")
+        arr = np.ones((8,), np.float32)
+        out = chaos.maybe_flip_bits_array("collective", arr)
+        assert int((np.asarray(out) != arr).sum()) >= 1
+        grads = [s for s in chaos.active().specs
+                 if s.flip and s.flip["where"] == "grads"]
+        assert grads[0].count == 0        # untouched by the other site
+
+    def test_five_families_fire_from_one_armed_value(self):
+        chaos.arm("kill_engine:1:0;drop_decode_step:2;"
+                  "corrupt_block_table:1;drop_migration:1;"
+                  "corrupt_spill_block:1")
+        assert chaos.maybe_kill_engine(0)
+        assert not chaos.maybe_drop_decode_step()
+        assert chaos.maybe_drop_decode_step()
+        table = [[1, 2, 3]]
+        assert chaos.maybe_corrupt_block_table(table) == 0
+        assert chaos.CORRUPT_BLOCK_ID in table[0]
+        assert chaos.maybe_drop_migration()
+        assert chaos.maybe_corrupt_spill_block(_FakeHostTier()) \
+            == (1, 2, 3)
+        fired = {k for k, _ in chaos.fired_log()}
+        assert fired == {"kill_engine", "drop_decode_step",
+                         "corrupt_block_table", "drop_migration",
+                         "corrupt_spill_block"}
+
+
+# ============================================================== re-arm
+class TestRearm:
+    def test_rearm_resets_every_counter(self):
+        chaos.arm("drop_decode_step:1")
+        assert chaos.maybe_drop_decode_step()
+        assert not chaos.maybe_drop_decode_step()    # spent
+        chaos.arm("drop_decode_step:1")              # fresh injector
+        assert chaos.maybe_drop_decode_step()
+
+    def test_disarm_silences_all_hooks(self):
+        chaos.arm("kill_engine:1:0,drop_migration:1")
+        chaos.disarm()
+        assert chaos.active() is None
+        assert not chaos.maybe_kill_engine(0)
+        assert not chaos.maybe_drop_migration()
+        assert chaos.fired_log() == []
+
+    def test_should_fire_truthiness_matches_old_bool_contract(self):
+        inj = chaos.ChaosInjector("fail_commit:2")
+        assert not inj.should_fire("fail_commit")
+        assert inj.should_fire("fail_commit")
+        assert not inj.should_fire("fail_commit")
+        assert not inj.should_fire("poison_loss")    # not armed

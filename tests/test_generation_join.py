@@ -1,0 +1,149 @@
+"""ISSUE 17 satellite: the checkpoint restart-generation id rides the
+hot-swap flight span into per-request traces.
+
+The join chain under test::
+
+    CheckpointManager.swap_source()          (train plane: lineage)
+        -> HotSwapController(source=...)     (control plane: rollout)
+            -> ServingEngine.swap_weights()  (serve plane: `hot_swap`
+               span with t= + tids= mirrors into request tracing)
+
+so a serve trace answers "which training lineage produced the weights
+this request decoded under" from the span itself — no wall-clock log
+joins.
+"""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+from paddle2_tpu.distributed.fault_tolerance import flight_recorder
+from paddle2_tpu.distributed.fault_tolerance.flight_recorder import \
+    GENERATION_ENV
+from paddle2_tpu.distributed.fault_tolerance.manager import \
+    CheckpointManager, SESSION_ENV
+from paddle2_tpu.observability import tracing
+from paddle2_tpu.serving import (EngineConfig, HotSwapController,
+                                 ServingEngine)
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from paddle2_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+    paddle.seed(0)
+    return GPTForCausalLM(gpt_tiny(use_scan=False))
+
+
+def _engine(model, **over):
+    kw = dict(block_size=8, num_blocks=32, max_batch=4,
+              prefill_budget_tokens=64, max_model_len=64)
+    kw.update(over)
+    return ServingEngine(model, config=EngineConfig(**kw))
+
+
+def _prompt(model, size=10, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, model.cfg.vocab_size, size=size).tolist()
+
+
+def _variant_weights(engine, scale=1.001):
+    return [w * scale if hasattr(w, "dtype") and "float" in str(w.dtype)
+            else w for w in engine.runner._weights()]
+
+
+def test_swap_source_names_committed_lineage(tiny_model, tmp_path,
+                                             monkeypatch):
+    monkeypatch.setenv(SESSION_ENV, "sess-day")
+    monkeypatch.setenv(GENERATION_ENV, "3")
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last=2)
+    mgr.save(tiny_model.state_dict(), step=7)
+    assert mgr.swap_source() == {"session": "sess-day",
+                                 "generation": 3, "step": 7}
+
+
+def test_generation_rides_hot_swap_span_into_request_trace(
+        tiny_model, tmp_path, monkeypatch):
+    monkeypatch.setenv(SESSION_ENV, "sess-day")
+    monkeypatch.setenv(GENERATION_ENV, "3")
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last=2)
+    mgr.save(tiny_model.state_dict(), step=7)
+    src = mgr.swap_source()
+
+    pl = tracing.enable(str(tmp_path / "trace"), rank=0)
+    flight_recorder.enable(str(tmp_path / "flight"), rank=0)
+    try:
+        eng = _engine(tiny_model)
+        rid = eng.submit(_prompt(tiny_model), max_new_tokens=6)
+        eng.tick(now=0.0)                    # admit + prefill: in flight
+        seq = eng.sequence(rid)
+        assert seq.trace_id is not None
+
+        ctl = HotSwapController([eng], _variant_weights(eng), source=src)
+        ctl.stage_next(now=1.0)
+        assert ctl.state == "committed"
+        tracing.flush()
+        spans = [e for e in pl.events() if e["event"] == "hot_swap"]
+        fr = flight_recorder.active()
+        flight = [f for _, _, kind, f in fr.events()
+                  if kind == "serving" and "hot_swap" in f.get("event", "")]
+    finally:
+        tracing.disable()
+        flight_recorder.disable()
+
+    # the engine-side span carries lineage AND the in-flight request id:
+    # the generation is in the request's trace by construction
+    assert spans, "hot_swap span did not mirror into the trace plane"
+    sp = spans[0]
+    assert sp["generation"] == 3
+    assert sp["ckpt_step"] == 7
+    assert sp["session"] == "sess-day"
+    assert seq.trace_id in sp["tids"]
+    # controller-side flight spans (stage + commit) carry it too
+    by_event = {f["event"]: f for f in flight}
+    assert by_event["hot_swap_stage"]["generation"] == 3
+    assert by_event["hot_swap_commit"]["ckpt_step"] == 7
+
+
+def test_canary_rollback_spans_carry_lineage(tiny_model, tmp_path,
+                                             monkeypatch):
+    monkeypatch.setenv(SESSION_ENV, "sess-day")
+    monkeypatch.setenv(GENERATION_ENV, "5")
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last=2)
+    mgr.save(tiny_model.state_dict(), step=11)
+
+    flight_recorder.enable(str(tmp_path / "flight"), rank=0)
+    try:
+        eng = _engine(tiny_model)
+        ctl = HotSwapController([eng], _variant_weights(eng),
+                                verify=lambda e: False,
+                                source=mgr.swap_source())
+        ctl.stage_next(now=2.0)
+        assert ctl.state == "rolled_back"
+        fr = flight_recorder.active()
+        events = {f["event"]: f for _, _, kind, f in fr.events()
+                  if kind == "serving"}
+    finally:
+        flight_recorder.disable()
+    # a bad canary is attributable to the checkpoint that shipped it
+    assert events["hot_swap_canary_failed"]["generation"] == 5
+    assert events["hot_swap_rollback"]["ckpt_step"] == 11
+
+
+def test_sourceless_swap_spans_unchanged(tiny_model, tmp_path):
+    # back-compat: no source -> no lineage fields on any span (None
+    # fields are dropped, so existing artifact bytes cannot move)
+    flight_recorder.enable(str(tmp_path / "flight"), rank=0)
+    try:
+        eng = _engine(tiny_model)
+        eng.swap_weights(_variant_weights(eng))
+        fr = flight_recorder.active()
+        spans = [f for _, _, kind, f in fr.events()
+                 if kind == "serving" and f.get("event") == "hot_swap"]
+    finally:
+        flight_recorder.disable()
+    assert spans
+    assert "generation" not in spans[0]
+    assert "ckpt_step" not in spans[0]
+    assert "session" not in spans[0]

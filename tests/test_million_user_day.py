@@ -1,0 +1,116 @@
+"""ISSUE 17: the million-user day.
+
+Fast checks for the pieces the closed-loop day lane is built from: the
+seeded non-homogeneous diurnal arrival process (raised-cosine intensity
+with engineered shared-prefix cohorts), and the declarative scenario
+registration the ``bench.py --million-user-day`` flag resolves to. The
+full closed-loop drill (train plane + hot swaps + chaos + economics)
+runs as the slow test below and byte-identically in CI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- trace
+
+def _trace(**over):
+    from paddle2_tpu.serving import diurnal_poisson_trace
+    kw = dict(n_requests=200, day_s=86400.0, prompt_lens=[24, 48],
+              gen_tokens=[8, 16], vocab=1000, seed=11)
+    kw.update(over)
+    return diurnal_poisson_trace(**kw)
+
+
+def test_diurnal_trace_deterministic_sorted_and_in_day():
+    a, b = _trace(), _trace()
+    assert a == b                       # bitwise-deterministic in seed
+    assert a != _trace(seed=12)
+    ts = [r["arrival_t"] for r in a]
+    assert ts == sorted(ts)
+    assert all(0.0 <= t <= 86400.0 for t in ts)
+    assert len({r["session"] for r in a}) == len(a)   # unique sessions
+
+
+def test_diurnal_intensity_peaks_at_peak_hour():
+    # raised-cosine: the 6 h window around the peak must hold more
+    # arrivals than the 6 h trough window on the opposite side
+    ts = np.array([r["arrival_t"] for r in _trace(n_requests=400)])
+    h = ts / 3600.0
+    peak = int(((h > 11.0) & (h < 17.0)).sum())
+    trough = int(((h < 3.0) | (h > 23.0)).sum())
+    assert peak > 2 * trough
+
+
+def test_diurnal_cohorts_carry_prefix_session_and_gen():
+    prefix = list(range(100, 132))
+    tr = _trace(cohorts=[(prefix, [10.0, 20.0]), (prefix[:16], [5.0])])
+    by_sess = {r["session"]: r for r in tr}
+    assert by_sess["cohort-0-0"]["arrival_t"] == 10.0
+    assert by_sess["cohort-0-1"]["arrival_t"] == 20.0
+    assert by_sess["cohort-0-0"]["prompt"] == prefix
+    assert by_sess["cohort-1-0"]["prompt"] == prefix[:16]
+    # gen budget cycles per-cohort: j-th arrival gets gen_tokens[j % n]
+    assert by_sess["cohort-0-0"]["max_new_tokens"] == 8
+    assert by_sess["cohort-0-1"]["max_new_tokens"] == 16
+    ts = [r["arrival_t"] for r in tr]
+    assert ts == sorted(ts)             # cohorts merge into the order
+
+
+# ------------------------------------------------------------- registry
+
+def test_scenario_registered_with_closed_loop_gates():
+    from bench.scenarios import registry
+    sc = registry.get("million-user-day")
+    assert sc.artifact == "MILLION_USER_DAY_r01.json"
+    assert sc.streams == {"metrics": "BENCH_DAY_METRICS_DIR",
+                          "trace": "BENCH_DAY_TRACE_DIR"}
+    # the headline gate set spans every plane of the closed loop
+    for g in ("million_sessions_modeled", "zero_dropped_requests",
+              "slo_burn_within_budget", "train_mttr_sublinear",
+              "kill_rank_recovered_from_checkpoint",
+              "checkpoints_swapped_into_fleet",
+              "poisoned_canary_rolled_back",
+              "generation_joins_serve_trace", "kv_tier_exercised",
+              "chaos_all_families_fired",
+              "cost_per_served_token_surfaced",
+              "degraded_twin_fails_a_gate"):
+        assert g in sc.gates, g
+    assert sc.trace["sessions_per_request"] * sc.trace["requests"] \
+        >= 1_000_000
+
+
+def test_unknown_scenario_lists_registered():
+    from bench.scenarios import registry
+    with pytest.raises(KeyError, match="million-user-day"):
+        registry.get("no-such-day")
+
+
+# ------------------------------------------------------ the day (slow)
+
+@pytest.mark.slow
+def test_million_user_day_lane_gates_and_determinism(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               BENCH_DAY_METRICS_DIR=str(tmp_path / "m"),
+               BENCH_DAY_TRACE_DIR=str(tmp_path / "t"))
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--million-user-day"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    res = json.loads([ln for ln in out.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert all(res["gates"].values()), res["gates"]
+    assert res["scale"]["sessions_modeled"] >= 1_000_000
+    assert set(res["chaos"]["fired"]) == {
+        "kill_engine", "drop_decode_step", "corrupt_block_table",
+        "corrupt_spill_block", "drop_migration", "kill_rank",
+        "flip_bits"}

@@ -243,7 +243,7 @@ class TestCostModel:
 
 # ------------------------------------------------------------- perf_doctor
 def _write_stream(d, rank, steps, inp=0.002, comp=0.010, coll=0.001,
-                  host=0.0005, tokens=2048, counters=None):
+                  host=0.0005, tokens=2048, counters=None, extra=None):
     os.makedirs(d, exist_ok=True)
     lines = []
     for i in range(steps):
@@ -251,7 +251,7 @@ def _write_stream(d, rank, steps, inp=0.002, comp=0.010, coll=0.001,
             "type": "step", "rank": rank, "step": i,
             "total_s": inp + comp + coll + host, "input_wait_s": inp,
             "compute_s": comp, "collective_s": coll, "host_s": host,
-            "tokens": tokens}))
+            "tokens": tokens, **(extra or {})}))
     lines.append(json.dumps({
         "type": "metrics", "rank": rank,
         "counters": {"steps_total": {"": steps}, **(counters or {})},
@@ -346,6 +346,98 @@ class TestPerfDoctor:
         tr = perf_doctor.load_trace_steps(str(p))
         assert tr["rank0"]["steps"] == 2
         assert tr["rank0"]["mean_step_s"] == pytest.approx(0.006)
+
+
+# ---------------------------------------------- perf_doctor cost lane
+class TestPerfDoctorCostLane:
+    """cost_per_served_token (ISSUE 17): chip-seconds over tokens
+    delivered, gated in diff like the modeled/MFU lanes."""
+
+    def test_per_rank_and_aggregate_ratio(self, tmp_path):
+        d = str(tmp_path / "m")
+        _write_stream(d, 0, 10, extra={"chip_seconds": 4.0,
+                                       "served_tokens": 1000})
+        rep = perf_doctor.summarize(perf_doctor.load_streams(d))
+        e = rep["per_rank"][0]
+        assert e["cost_per_served_token"] == pytest.approx(4.0 / 1000)
+        # warmup excluded: 9 records survive
+        assert e["served_tokens_total"] == 9000
+        assert rep["aggregate"]["cost_per_served_token"] == \
+            pytest.approx(4.0 / 1000)
+
+    def test_aggregate_gated_on_every_rank(self, tmp_path):
+        # one rank without the lane -> NO aggregate cost (a cost model
+        # averaged against nothing), per-rank entry still present
+        d = str(tmp_path / "m")
+        _write_stream(d, 0, 10, extra={"chip_seconds": 4.0,
+                                       "served_tokens": 1000})
+        _write_stream(d, 1, 10)
+        rep = perf_doctor.summarize(perf_doctor.load_streams(d))
+        assert "cost_per_served_token" in rep["per_rank"][0]
+        assert "cost_per_served_token" not in rep["per_rank"][1]
+        assert "cost_per_served_token" not in rep["aggregate"]
+
+    def test_aggregate_is_fleet_ratio_not_mean_of_ratios(self, tmp_path):
+        d = str(tmp_path / "m")
+        _write_stream(d, 0, 10, extra={"chip_seconds": 1.0,
+                                       "served_tokens": 1000})
+        _write_stream(d, 1, 10, extra={"chip_seconds": 4.0,
+                                       "served_tokens": 10})
+        rep = perf_doctor.summarize(perf_doctor.load_streams(d))
+        # fleet chips / fleet tokens, NOT mean(0.001, 0.4)
+        assert rep["aggregate"]["cost_per_served_token"] == \
+            pytest.approx(5.0 / 1010)
+
+    def test_diff_cost_regression_gates_exit_4(self, tmp_path, capsys):
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        _write_stream(a, 0, 10, extra={"chip_seconds": 4.0,
+                                       "served_tokens": 1000})
+        _write_stream(b, 0, 10, extra={"chip_seconds": 8.0,
+                                       "served_tokens": 1000})
+        rep_a = perf_doctor.summarize(perf_doctor.load_streams(a))
+        rep_b = perf_doctor.summarize(perf_doctor.load_streams(b))
+        d = perf_doctor.diff(rep_a, rep_b, threshold_pct=10)
+        # wall step time identical -> verdict comes from the cost lane
+        assert d["cost_per_served_token"]["delta_pct"] == \
+            pytest.approx(100.0)
+        assert d["regressed"] is True
+        assert d["verdict_source"] == "cost"
+        assert perf_doctor.main(["diff", a, b]) == \
+            perf_doctor.REGRESSION_EXIT
+        out = capsys.readouterr().out
+        assert "(COST REGRESSION)" in out
+        assert "verdict: REGRESSION (cost" in out
+
+    def test_diff_cost_improvement_and_self_diff_zero(self, tmp_path):
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        _write_stream(a, 0, 10, extra={"chip_seconds": 4.0,
+                                       "served_tokens": 1000})
+        _write_stream(b, 0, 10, extra={"chip_seconds": 8.0,
+                                       "served_tokens": 1000})
+        rep_a = perf_doctor.summarize(perf_doctor.load_streams(a))
+        rep_b = perf_doctor.summarize(perf_doctor.load_streams(b))
+        # cheaper tokens are not a regression
+        d = perf_doctor.diff(rep_b, rep_a, threshold_pct=10)
+        assert d["regressed"] is False
+        # identical streams diff at EXACTLY 0% (the CI byte gate)
+        d0 = perf_doctor.diff(rep_a, rep_a, threshold_pct=10)
+        assert d0["cost_per_served_token"]["delta_pct"] == 0.0
+        assert d0["regressed"] is False
+
+    def test_diff_incomparable_when_one_side_lacks_lane(self, tmp_path,
+                                                        capsys):
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        _write_stream(a, 0, 10, extra={"chip_seconds": 4.0,
+                                       "served_tokens": 1000})
+        _write_stream(b, 0, 10)
+        rep_a = perf_doctor.summarize(perf_doctor.load_streams(a))
+        rep_b = perf_doctor.summarize(perf_doctor.load_streams(b))
+        d = perf_doctor.diff(rep_a, rep_b, threshold_pct=10)
+        assert d["cost_per_served_token"]["comparable"] is False
+        assert d["cost_per_served_token"]["regressed"] is False
+        assert d["regressed"] is False
+        print(perf_doctor.format_diff(d))
+        assert "incomparable" in capsys.readouterr().out
 
 
 # ------------------------------------------------------------ wiring
