@@ -1061,230 +1061,11 @@ def bench_flight_recorder():
 
 
 def bench_sdc():
-    """``--sdc`` smoke: the silent-data-corruption defense, gated two
-    ways. (a) **Overhead**: the per-step cost of the gradient
-    fingerprint (device-side sum/xor/norm dispatch + the single host
-    readback + digest + exchange-dir post) is microbenched on the real
-    optimizer's gradients and gated at < 2% of the bare step floor —
-    the same deterministic cost×rate method as ``--flight-recorder``
-    (a wall-clock A/B on a shared host cannot resolve a sub-percent
-    effect). (b) **Detection**: a 3-replica in-process sim (one guard
-    per replica over a shared exchange dir, identical inputs) with
-    chaos ``flip_bits:grads:2:1`` must detect the corruption AT the
-    injected step (within-1-step contract), every replica must raise
-    ``GradientCorruptionError``, the rewound replay must pass, the
-    victim's node must land in the quarantine store, and the replicas'
-    weights must end bitwise identical."""
-    import tempfile
-
-    import paddle2_tpu as paddle
-    import paddle2_tpu.nn as nn
-    import paddle2_tpu.nn.functional as F
-    import paddle2_tpu.optimizer as opt
-    from paddle2_tpu.distributed.fault_tolerance import (
-        GradientCorruptionError, SDCGuard, chaos, health, numerics)
-    from paddle2_tpu.distributed.fault_tolerance.replica import \
-        tree_to_host
-
-    def build():
-        paddle.seed(0)
-        model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
-                              nn.Linear(128, 64))
-        o = opt.AdamW(learning_rate=1e-3,
-                      parameters=model.parameters())
-
-        def step(x, y):
-            loss = F.mse_loss(model(x), y)
-            loss.backward()
-            o.step()
-            o.clear_grad()
-            return loss
-
-        return model, o, step
-
-    rs_data = np.random.RandomState(0)
-    batches = [(paddle.to_tensor(rs_data.randn(32, 64)
-                                 .astype(np.float32)),
-                paddle.to_tensor(rs_data.randn(32, 64)
-                                 .astype(np.float32)))
-               for _ in range(8)]
-    steps, warm = 30, 8
-
-    chaos.disarm()
-    with tempfile.TemporaryDirectory() as td:
-        exchange = os.path.join(td, "sdc")
-        quarantine = os.path.join(td, "quarantine")
-
-        # ---- overhead leg: bare floor vs measured per-check cost ----
-        model, o, step = build()
-        import jax
-        for i in range(warm):
-            loss = step(*batches[i % len(batches)])
-        jax.block_until_ready(loss._data)
-        floors = []
-        for i in range(steps):
-            t0 = time.perf_counter()
-            loss = step(*batches[i % len(batches)])
-            jax.block_until_ready(loss._data)
-            floors.append(time.perf_counter() - t0)
-        bare_floor = float(min(floors))
-
-        # leave live grads behind, then microbench the per-step work
-        # the guard adds, in its two parts. (1) THE FINGERPRINT (the
-        # gated cost): device dispatch of the sum/xor/norm program +
-        # the single host readback + the CRC digest — measured in
-        # steady state, i.e. step N's fingerprint is read back while
-        # step N+1's is in flight, exactly how the guard's capture
-        # (mid-step) and post (after the step) bracket the remaining
-        # step work. (2) THE EXCHANGE (reported): the shared-dir
-        # record post + world-1 verify; on this sandboxed CI host
-        # file IO costs ~1 ms/op, on a pod the exchange rides
-        # shm/ICI — a transport property, not fingerprint cost.
-        from paddle2_tpu.distributed.fault_tolerance.sdc import \
-            digest_fingerprint
-        loss = F.mse_loss(model(*batches[0][:1]), batches[0][1])
-        loss.backward()
-        grads = [p.grad for p in o._parameter_list()
-                 if p.grad is not None]
-        # warm: the first call traces + compiles the fingerprint
-        # program — a once-per-shape cost, not a per-step one
-        digest_fingerprint(numerics.fingerprint_to_host(
-            numerics.tree_fingerprint(grads)))
-        s0 = numerics.host_sync_count()
-        # per-iteration floors: host contention only ever ADDS time
-        # (the --flight-recorder floor rationale), and this timeshared
-        # box wobbles whole-loop means by 2-4x. The pipeline reads
-        # back fingerprint N-1 while dispatching N, so it can never
-        # run more than one program ahead — each iteration's time is
-        # a full dispatch + ready-readback + digest cycle, and the
-        # min over many is the honest steady-state cost.
-        n_checks = 600
-        iter_times = []
-        fp_prev = None
-        for i in range(n_checks):
-            t0 = time.perf_counter()
-            fp = numerics.tree_fingerprint(grads)
-            if fp_prev is not None:
-                digest_fingerprint(
-                    numerics.fingerprint_to_host(fp_prev))
-            fp_prev = fp
-            iter_times.append(time.perf_counter() - t0)
-        digest_fingerprint(numerics.fingerprint_to_host(fp_prev))
-        per_fp_s = float(min(iter_times[1:]))
-        syncs_per_check = ((numerics.host_sync_count() - s0)
-                           / n_checks)
-        guard = SDCGuard(store_dir=exchange, rank=0, world=1,
-                         evict=False)
-        t0 = time.perf_counter()
-        for i in range(60):
-            guard.begin(i)
-            guard._device_fp = numerics.tree_fingerprint(grads)
-            guard._captured = True
-            guard.post()
-            guard.verify()
-        per_exchange_s = (time.perf_counter() - t0) / 60 - per_fp_s
-        o.clear_grad()
-        overhead_pct = per_fp_s / bare_floor * 100.0
-
-        # ---- detection leg: 3 replicas, flip_bits on replica 1 ----
-        os.environ["PADDLE_QUARANTINE_DIR"] = quarantine
-        prev_rank = os.environ.get("PADDLE_TRAINER_ID")
-        replicas = []
-        for r in range(3):
-            m, oo, st = build()
-            g = SDCGuard(oo, store_dir=exchange, rank=r, world=3,
-                         timeout=2.0, evict=False)
-            replicas.append((m, oo, st, g))
-        inject_step = 2
-        detected_steps, retried_ok = [], False
-        for s in range(5):
-            if s == inject_step:
-                # 2 mantissa bits, victim replica 1, its next opt step
-                chaos.arm("flip_bits:grads:2:1")
-            x, y = batches[s % len(batches)]
-            snaps = [(tree_to_host(m.state_dict()),
-                      tree_to_host(oo.state_dict()))
-                     for m, oo, st, g in replicas]
-            for r, (m, oo, st, g) in enumerate(replicas):
-                os.environ["PADDLE_TRAINER_ID"] = str(r)
-                os.environ["PADDLE_NODE_ID"] = f"sim-node-{r}"
-                g.begin(s)
-                st(x, y)
-                g.post()
-            raised = 0
-            suspects = []
-            for m, oo, st, g in replicas:
-                try:
-                    g.verify()
-                except GradientCorruptionError as e:
-                    raised += 1
-                    suspects = e.suspects
-            if raised:
-                detected_steps.append(s)
-                for (m, oo, st, g), (ms, osn) in zip(replicas, snaps):
-                    m.set_state_dict(ms)
-                    oo.set_state_dict(osn)
-                replay_clean = True
-                for r, (m, oo, st, g) in enumerate(replicas):
-                    os.environ["PADDLE_TRAINER_ID"] = str(r)
-                    os.environ["PADDLE_NODE_ID"] = f"sim-node-{r}"
-                    g.begin(s, attempt=1)
-                    st(x, y)
-                    g.post()
-                for m, oo, st, g in replicas:
-                    try:
-                        g.verify()
-                    except GradientCorruptionError:
-                        replay_clean = False
-                retried_ok = replay_clean and raised == 3 \
-                    and suspects == [1]
-        chaos.disarm()
-        if prev_rank is None:
-            os.environ.pop("PADDLE_TRAINER_ID", None)
-        else:
-            os.environ["PADDLE_TRAINER_ID"] = prev_rank
-        os.environ.pop("PADDLE_NODE_ID", None)
-        store = health.QuarantineStore(quarantine)
-        quarantined = [e for e in store.entries()
-                       if e.get("rank") == 1
-                       and e.get("reason") == "fingerprint_vote"]
-        os.environ.pop("PADDLE_QUARANTINE_DIR", None)
-        weights = [np.asarray(m.state_dict()["0.weight"]._data)
-                   for m, oo, st, g in replicas]
-        bitwise_equal = (np.array_equal(weights[0], weights[1])
-                         and np.array_equal(weights[0], weights[2]))
-
-    detected_within_1 = detected_steps == [inject_step]
-    ok = (overhead_pct < 2.0 and syncs_per_check <= 1.0
-          and detected_within_1 and retried_ok and bool(quarantined)
-          and bitwise_equal)
-    print(json.dumps({
-        "metric": "sdc_smoke",
-        "value": round(overhead_pct, 4),
-        "unit": "% step-time overhead of the gradient fingerprint "
-                "(gated)",
-        "gate_pct": 2.0,
-        "bare_step_ms": round(bare_floor * 1e3, 3),
-        "per_fingerprint_us": round(per_fp_s * 1e6, 2),
-        "per_exchange_us": round(per_exchange_s * 1e6, 2),
-        "host_syncs_per_check": round(syncs_per_check, 3),
-        "injected_step": inject_step,
-        "detected_steps": detected_steps,
-        "detected_within_1_step": bool(detected_within_1),
-        "replay_clean": bool(retried_ok),
-        "quarantined": [e.get("host") for e in quarantined],
-        "replicas_bitwise_equal_after_recovery": bool(bitwise_equal),
-        "stack": "SDCGuard fingerprint (jitted device sum/xor/norm, "
-                 "one packed uint32[3] readback, CRC digest) | "
-                 "3-replica vote with chaos flip_bits:grads:2:1",
-        "note": "gate = steady-state fingerprint cost (dispatch + "
-                "ready readback + digest) vs bare step floor; the "
-                "exchange post is reported separately — on this "
-                "sandboxed host file IO costs ~1ms/op, on a pod the "
-                "record rides shm/ICI",
-        "ok": bool(ok),
-    }))
-    return 0 if ok else 1
+    """``--sdc``: the silent-data-corruption defense gate, now a
+    registry lane. Drill and stdout JSON line unchanged; see
+    ``bench/scenarios/sdc.py``."""
+    from bench.scenarios import run_scenario
+    return run_scenario("sdc")
 
 
 def bench_reliable_step():
@@ -1611,141 +1392,11 @@ def bench_observability():
 
 
 def bench_elastic():
-    """``--elastic`` MTTR gate: spawn a 2-rank launcher gang on CPU,
-    SIGKILL rank 1 mid-run (node-loss injection — the dying rank stamps
-    the kill wall-clock first), and measure **MTTR = injected kill ->
-    first post-recovery optimizer step** on the respawned smaller gang.
-    GATES on three things at once: the gang recovers at world 1, the
-    respawned worker restores from the buddy's in-memory replica with
-    ZERO checkpoint-directory reads (the disk chain is instrumented),
-    and MTTR lands under the budget (env BENCH_MTTR_BUDGET_S, default
-    60 s — dominated by interpreter+jax import on CPU CI; on a pod the
-    same path is seconds). Prints one JSON line like the other
-    benches."""
-    import subprocess
-    import tempfile
-
-    budget_s = float(os.environ.get("BENCH_MTTR_BUDGET_S", "60"))
-    repo = os.path.dirname(os.path.abspath(__file__))
-    with tempfile.TemporaryDirectory() as td:
-        replica = os.path.join(td, "shm")
-        flight = os.path.join(td, "flight")
-        ckpt = os.path.join(td, "ckpt")
-        out = os.path.join(td, "result.json")
-        t_kill_file = os.path.join(td, "t_kill")
-        t_rec_file = os.path.join(td, "t_recover")
-        script = os.path.join(td, "train.py")
-        with open(script, "w") as f:
-            f.write(f"""
-import json, os, signal, sys, time
-import jax
-jax.config.update("jax_platforms", "cpu")
-import numpy as np
-import paddle2_tpu as paddle
-import paddle2_tpu.nn as nn
-import paddle2_tpu.optimizer as opt
-from paddle2_tpu.distributed import fault_tolerance as ft
-
-rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
-world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
-restart = int(os.environ.get("PADDLE_ELASTIC_RESTART_COUNT", 0))
-
-paddle.seed(0)
-m = nn.Linear(4, 1)
-o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
-rep = ft.BuddyReplicator(store_dir={replica!r})
-mgr = ft.CheckpointManager({ckpt!r})
-disk_reads = []
-_real = mgr.restore
-mgr.restore = lambda s: (disk_reads.append(1) or _real(s))
-
-state = {{"w": m.weight, "b": m.bias, "step": 0}}
-start, source = ft.elastic_restore(state, rep, mgr)
-start = 0 if start is None else start + 1
-
-rs = np.random.RandomState(0)
-W = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
-loss_fn = nn.MSELoss()
-losses = []
-for step in range(start, 12):
-    if world > 1:
-        time.sleep(0.25)
-    if rank == 1 and restart == 0 and step == 4:
-        with open({t_kill_file!r}, "w") as f:
-            f.write(repr(time.time()))
-        os.kill(os.getpid(), signal.SIGKILL)   # injected node loss
-    x = paddle.to_tensor(rs.randn(16, 4).astype(np.float32))
-    y = paddle.to_tensor(np.asarray(x._data) @ W)
-    loss = loss_fn(m(x), y)
-    loss.backward()
-    o.step()
-    o.clear_grad()
-    losses.append(float(np.asarray(loss._data)))
-    if restart > 0 and not losses[1:]:
-        with open({t_rec_file!r}, "w") as f:       # first recovered step
-            f.write(repr(time.time()))
-    state["step"] = step
-    rep.put(state, step)
-if rank == 0:
-    json.dump({{"world": world, "restart": restart, "source": source,
-               "start": start, "disk_reads": len(disk_reads),
-               "losses": losses}}, open({out!r}, "w"))
-""")
-        env = {k: v for k, v in os.environ.items()
-               if not k.startswith(("JAX_", "PADDLE_", "FLAGS_"))}
-        env["PYTHONPATH"] = repo
-        env["JAX_PLATFORMS"] = "cpu"
-        env["PADDLE_REPLICA_DIR"] = replica
-        env["PADDLE_FLIGHT_DIR"] = flight
-        proc = subprocess.run(
-            [sys.executable, "-m", "paddle2_tpu.distributed.launch",
-             "--nproc_per_node", "2", "--max_restarts", "2",
-             "--elastic_rescale", "--mttr_budget", str(budget_s),
-             script],
-            env=env, capture_output=True, text=True, timeout=600)
-        launch_ok = proc.returncode == 0
-        res = {}
-        mttr = float("inf")
-        try:
-            res = json.load(open(out))
-            mttr = (float(open(t_rec_file).read())
-                    - float(open(t_kill_file).read()))
-        except (OSError, ValueError):
-            launch_ok = False
-        detect_to_respawn = None
-        try:
-            for ln in open(os.path.join(flight,
-                                        "elastic_events.jsonl")):
-                ev = json.loads(ln)
-                if ev.get("kind") == "elastic.restart_latency":
-                    detect_to_respawn = ev.get("detect_to_respawn_s")
-        except OSError:
-            pass
-
-    recovered_smaller = res.get("world") == 1 and res.get("restart", 0) >= 1
-    ram_only = res.get("source") == "replica" and res.get("disk_reads") == 0
-    ok = bool(launch_ok and recovered_smaller and ram_only
-              and mttr <= budget_s)
-    if not launch_ok:
-        sys.stderr.write(proc.stderr[-2000:] + "\n")
-    print(json.dumps({
-        "metric": "elastic_mttr",
-        "value": round(mttr, 3) if mttr != float("inf") else None,
-        "unit": "s from injected SIGKILL to first post-recovery step "
-                "(gated)",
-        "budget_s": budget_s,
-        "recovered_world": res.get("world"),
-        "restore_source": res.get("source"),
-        "ckpt_dir_reads": res.get("disk_reads"),
-        "launcher_detect_to_respawn_s": detect_to_respawn,
-        "resumed_at_step": res.get("start"),
-        "stack": "2-rank launcher gang, --elastic_rescale; buddy "
-                 "replica over shm; SIGKILL rank 1 at step 4; "
-                 "CheckpointManager disk chain instrumented (must "
-                 "stay cold)",
-        "ok": ok,
-    }))
-    return 0 if ok else 1
+    """``--elastic``: the node-loss MTTR gate, now a registry lane.
+    Drill and stdout JSON line unchanged; see
+    ``bench/scenarios/elastic.py``."""
+    from bench.scenarios import run_scenario
+    return run_scenario("elastic")
 
 
 def bench_multichip_scaling():
@@ -2634,6 +2285,16 @@ def bench_fleet_kv():
     unchanged; see ``bench/scenarios/fleet_kv.py``."""
     from bench.scenarios import run_scenario
     return run_scenario("fleet-kv")
+
+
+def bench_ps_recommender():
+    """``--ps-recommender``: the ISSUE 18 tentpole — the fault-tolerant
+    parameter-server plane (hash-ring shards, primary+follower
+    replication, server-kill failover, bounded staleness, hot-key
+    follower caching), every drill on the virtual cost-model clock.
+    See ``bench/scenarios/ps_recommender.py``."""
+    from bench.scenarios import run_scenario
+    return run_scenario("ps-recommender")
 
 
 def bench_million_user_day():
@@ -3815,6 +3476,8 @@ def main():
         sys.exit(bench_fleet_kv())
     if "--million-user-day" in sys.argv:
         sys.exit(bench_million_user_day())
+    if "--ps-recommender" in sys.argv:
+        sys.exit(bench_ps_recommender())
     if "--serving" in sys.argv:
         sys.exit(bench_serving())
     if "--multichip-scaling" in sys.argv:

@@ -74,6 +74,9 @@ def emit_result(lane, artifact, result, gates=None):
         gates = result.get("gates", {})
     print(json.dumps(result))
     write_artifact(artifact, result)
+    if not gates and "ok" in result:
+        # legacy lanes gate on one precomputed verdict, not a dict
+        gates = {"ok": bool(result["ok"])}
     if gates and not all(gates.values()):
         log(f"{lane}: GATE FAILURE "
             f"{ {k: v for k, v in gates.items() if not v} }")

@@ -10,6 +10,9 @@ from .registry import REGISTRY, Scenario, get, register, run
 from . import serving_reliability   # noqa: F401  (side-effect import)
 from . import fleet_kv              # noqa: F401
 from . import million_user_day      # noqa: F401
+from . import ps_recommender        # noqa: F401
+from . import sdc                   # noqa: F401
+from . import elastic               # noqa: F401
 
 run_scenario = run
 

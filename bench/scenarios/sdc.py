@@ -1,0 +1,256 @@
+"""Scenario: the ``--sdc`` silent-data-corruption defense lane.
+
+Ported byte-for-byte from ``bench.py::bench_sdc`` onto the scenario
+registry (ISSUE 18 satellite): the body below is the original lane —
+only the tail changed from print-and-return to returning the result
+dict, which :func:`bench.artifact.emit_result` prints as the SAME
+stdout JSON line (and now also writes ``SDC_r01.json``). The verdict
+rides the legacy precomputed ``ok`` key (``gates=()``).
+"""
+
+import os
+import time
+
+import numpy as np
+
+from . import registry
+
+def build(scenario):
+    """``--sdc`` smoke: the silent-data-corruption defense, gated two
+    ways. (a) **Overhead**: the per-step cost of the gradient
+    fingerprint (device-side sum/xor/norm dispatch + the single host
+    readback + digest + exchange-dir post) is microbenched on the real
+    optimizer's gradients and gated at < 2% of the bare step floor —
+    the same deterministic cost×rate method as ``--flight-recorder``
+    (a wall-clock A/B on a shared host cannot resolve a sub-percent
+    effect). (b) **Detection**: a 3-replica in-process sim (one guard
+    per replica over a shared exchange dir, identical inputs) with
+    chaos ``flip_bits:grads:2:1`` must detect the corruption AT the
+    injected step (within-1-step contract), every replica must raise
+    ``GradientCorruptionError``, the rewound replay must pass, the
+    victim's node must land in the quarantine store, and the replicas'
+    weights must end bitwise identical."""
+    import tempfile
+
+    import paddle2_tpu as paddle
+    import paddle2_tpu.nn as nn
+    import paddle2_tpu.nn.functional as F
+    import paddle2_tpu.optimizer as opt
+    from paddle2_tpu.distributed.fault_tolerance import (
+        GradientCorruptionError, SDCGuard, chaos, health, numerics)
+    from paddle2_tpu.distributed.fault_tolerance.replica import \
+        tree_to_host
+
+    def build():
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                              nn.Linear(128, 64))
+        o = opt.AdamW(learning_rate=1e-3,
+                      parameters=model.parameters())
+
+        def step(x, y):
+            loss = F.mse_loss(model(x), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            return loss
+
+        return model, o, step
+
+    rs_data = np.random.RandomState(0)
+    batches = [(paddle.to_tensor(rs_data.randn(32, 64)
+                                 .astype(np.float32)),
+                paddle.to_tensor(rs_data.randn(32, 64)
+                                 .astype(np.float32)))
+               for _ in range(8)]
+    steps, warm = 30, 8
+
+    chaos.disarm()
+    with tempfile.TemporaryDirectory() as td:
+        exchange = os.path.join(td, "sdc")
+        quarantine = os.path.join(td, "quarantine")
+
+        # ---- overhead leg: bare floor vs measured per-check cost ----
+        model, o, step = build()
+        import jax
+        for i in range(warm):
+            loss = step(*batches[i % len(batches)])
+        jax.block_until_ready(loss._data)
+        floors = []
+        for i in range(steps):
+            t0 = time.perf_counter()
+            loss = step(*batches[i % len(batches)])
+            jax.block_until_ready(loss._data)
+            floors.append(time.perf_counter() - t0)
+        bare_floor = float(min(floors))
+
+        # leave live grads behind, then microbench the per-step work
+        # the guard adds, in its two parts. (1) THE FINGERPRINT (the
+        # gated cost): device dispatch of the sum/xor/norm program +
+        # the single host readback + the CRC digest — measured in
+        # steady state, i.e. step N's fingerprint is read back while
+        # step N+1's is in flight, exactly how the guard's capture
+        # (mid-step) and post (after the step) bracket the remaining
+        # step work. (2) THE EXCHANGE (reported): the shared-dir
+        # record post + world-1 verify; on this sandboxed CI host
+        # file IO costs ~1 ms/op, on a pod the exchange rides
+        # shm/ICI — a transport property, not fingerprint cost.
+        from paddle2_tpu.distributed.fault_tolerance.sdc import \
+            digest_fingerprint
+        loss = F.mse_loss(model(*batches[0][:1]), batches[0][1])
+        loss.backward()
+        grads = [p.grad for p in o._parameter_list()
+                 if p.grad is not None]
+        # warm: the first call traces + compiles the fingerprint
+        # program — a once-per-shape cost, not a per-step one
+        digest_fingerprint(numerics.fingerprint_to_host(
+            numerics.tree_fingerprint(grads)))
+        s0 = numerics.host_sync_count()
+        # per-iteration floors: host contention only ever ADDS time
+        # (the --flight-recorder floor rationale), and this timeshared
+        # box wobbles whole-loop means by 2-4x. The pipeline reads
+        # back fingerprint N-1 while dispatching N, so it can never
+        # run more than one program ahead — each iteration's time is
+        # a full dispatch + ready-readback + digest cycle, and the
+        # min over many is the honest steady-state cost.
+        n_checks = 600
+        iter_times = []
+        fp_prev = None
+        for i in range(n_checks):
+            t0 = time.perf_counter()
+            fp = numerics.tree_fingerprint(grads)
+            if fp_prev is not None:
+                digest_fingerprint(
+                    numerics.fingerprint_to_host(fp_prev))
+            fp_prev = fp
+            iter_times.append(time.perf_counter() - t0)
+        digest_fingerprint(numerics.fingerprint_to_host(fp_prev))
+        per_fp_s = float(min(iter_times[1:]))
+        syncs_per_check = ((numerics.host_sync_count() - s0)
+                           / n_checks)
+        guard = SDCGuard(store_dir=exchange, rank=0, world=1,
+                         evict=False)
+        t0 = time.perf_counter()
+        for i in range(60):
+            guard.begin(i)
+            guard._device_fp = numerics.tree_fingerprint(grads)
+            guard._captured = True
+            guard.post()
+            guard.verify()
+        per_exchange_s = (time.perf_counter() - t0) / 60 - per_fp_s
+        o.clear_grad()
+        overhead_pct = per_fp_s / bare_floor * 100.0
+
+        # ---- detection leg: 3 replicas, flip_bits on replica 1 ----
+        os.environ["PADDLE_QUARANTINE_DIR"] = quarantine
+        prev_rank = os.environ.get("PADDLE_TRAINER_ID")
+        replicas = []
+        for r in range(3):
+            m, oo, st = build()
+            g = SDCGuard(oo, store_dir=exchange, rank=r, world=3,
+                         timeout=2.0, evict=False)
+            replicas.append((m, oo, st, g))
+        inject_step = 2
+        detected_steps, retried_ok = [], False
+        for s in range(5):
+            if s == inject_step:
+                # 2 mantissa bits, victim replica 1, its next opt step
+                chaos.arm("flip_bits:grads:2:1")
+            x, y = batches[s % len(batches)]
+            snaps = [(tree_to_host(m.state_dict()),
+                      tree_to_host(oo.state_dict()))
+                     for m, oo, st, g in replicas]
+            for r, (m, oo, st, g) in enumerate(replicas):
+                os.environ["PADDLE_TRAINER_ID"] = str(r)
+                os.environ["PADDLE_NODE_ID"] = f"sim-node-{r}"
+                g.begin(s)
+                st(x, y)
+                g.post()
+            raised = 0
+            suspects = []
+            for m, oo, st, g in replicas:
+                try:
+                    g.verify()
+                except GradientCorruptionError as e:
+                    raised += 1
+                    suspects = e.suspects
+            if raised:
+                detected_steps.append(s)
+                for (m, oo, st, g), (ms, osn) in zip(replicas, snaps):
+                    m.set_state_dict(ms)
+                    oo.set_state_dict(osn)
+                replay_clean = True
+                for r, (m, oo, st, g) in enumerate(replicas):
+                    os.environ["PADDLE_TRAINER_ID"] = str(r)
+                    os.environ["PADDLE_NODE_ID"] = f"sim-node-{r}"
+                    g.begin(s, attempt=1)
+                    st(x, y)
+                    g.post()
+                for m, oo, st, g in replicas:
+                    try:
+                        g.verify()
+                    except GradientCorruptionError:
+                        replay_clean = False
+                retried_ok = replay_clean and raised == 3 \
+                    and suspects == [1]
+        chaos.disarm()
+        if prev_rank is None:
+            os.environ.pop("PADDLE_TRAINER_ID", None)
+        else:
+            os.environ["PADDLE_TRAINER_ID"] = prev_rank
+        os.environ.pop("PADDLE_NODE_ID", None)
+        store = health.QuarantineStore(quarantine)
+        quarantined = [e for e in store.entries()
+                       if e.get("rank") == 1
+                       and e.get("reason") == "fingerprint_vote"]
+        os.environ.pop("PADDLE_QUARANTINE_DIR", None)
+        weights = [np.asarray(m.state_dict()["0.weight"]._data)
+                   for m, oo, st, g in replicas]
+        bitwise_equal = (np.array_equal(weights[0], weights[1])
+                         and np.array_equal(weights[0], weights[2]))
+
+    detected_within_1 = detected_steps == [inject_step]
+    ok = (overhead_pct < 2.0 and syncs_per_check <= 1.0
+          and detected_within_1 and retried_ok and bool(quarantined)
+          and bitwise_equal)
+    return {
+        "metric": "sdc_smoke",
+        "value": round(overhead_pct, 4),
+        "unit": "% step-time overhead of the gradient fingerprint "
+                "(gated)",
+        "gate_pct": 2.0,
+        "bare_step_ms": round(bare_floor * 1e3, 3),
+        "per_fingerprint_us": round(per_fp_s * 1e6, 2),
+        "per_exchange_us": round(per_exchange_s * 1e6, 2),
+        "host_syncs_per_check": round(syncs_per_check, 3),
+        "injected_step": inject_step,
+        "detected_steps": detected_steps,
+        "detected_within_1_step": bool(detected_within_1),
+        "replay_clean": bool(retried_ok),
+        "quarantined": [e.get("host") for e in quarantined],
+        "replicas_bitwise_equal_after_recovery": bool(bitwise_equal),
+        "stack": "SDCGuard fingerprint (jitted device sum/xor/norm, "
+                 "one packed uint32[3] readback, CRC digest) | "
+                 "3-replica vote with chaos flip_bits:grads:2:1",
+        "note": "gate = steady-state fingerprint cost (dispatch + "
+                "ready readback + digest) vs bare step floor; the "
+                "exchange post is reported separately — on this "
+                "sandboxed host file IO costs ~1ms/op, on a pod the "
+                "record rides shm/ICI",
+        "ok": bool(ok),
+    }
+
+
+SCENARIO = registry.register(registry.Scenario(
+    name="sdc",
+    artifact="SDC_r01.json",
+    build=build,
+    description="SDC defense: gradient-fingerprint overhead gate + "
+                "3-replica detection/rewind/quarantine drill",
+    model={"net": "Linear(64,128)+ReLU+Linear(128,64)",
+           "optimizer": "AdamW"},
+    parallelism={"replicas": 3},
+    trace={"chaos": "flip_bits:grads:2:1"},
+    gates=(),          # legacy lane: verdict is the precomputed "ok"
+    streams={},
+))

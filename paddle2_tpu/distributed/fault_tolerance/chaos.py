@@ -78,7 +78,8 @@ KINDS = ("corrupt_shard", "truncate_shard", "fail_commit", "poison_loss",
          "delay_collective", "worker_crash", "poison_grads",
          "stall_collective", "kill_rank", "flip_bits",
          "kill_engine", "drop_decode_step", "corrupt_block_table",
-         "corrupt_spill_block", "drop_migration")
+         "corrupt_spill_block", "drop_migration",
+         "kill_ps_server", "corrupt_shard_delta", "drop_push")
 
 _FLIP_WHERES = ("grads", "collective")
 
@@ -647,6 +648,59 @@ def maybe_drop_migration() -> bool:
     return False
 
 
+def maybe_kill_ps_server(server_id: int, op: str = "?") -> bool:
+    """Parameter-server fleet hook (ISSUE 18), called on every op a
+    server handles: True when THIS server must die now. The occurrence
+    counter ticks only on the victim server (``kill_engine`` idiom —
+    param names the victim, default server 0), so ``nth`` means "the
+    victim's nth op". The fleet marks the server dead; its shards'
+    followers are promoted at the next probe sweep."""
+    if _ACTIVE is None or not _ACTIVE.armed("kill_ps_server"):
+        return False
+    sid = int(server_id)
+    sp = _ACTIVE.should_fire(
+        "kill_ps_server",
+        gate=lambda s: sid == (0 if s.param is None else int(s.param)))
+    if sp is not None:
+        _ACTIVE.record("kill_ps_server", f"server{sid}:{op}")
+        return True
+    return False
+
+
+def maybe_corrupt_shard_delta(payload) -> bool:
+    """PS replication hook: flip one byte of a primary->follower shard
+    delta AFTER its CRC was stamped — the deterministic stand-in for a
+    DCN bit-scribble. The follower MUST detect the mismatch and drop to
+    a full-shard resync. Ticks only on non-empty payloads, so the
+    one-shot fire is never consumed by a zero-row delta."""
+    if _ACTIVE is None or payload is None or len(payload) == 0:
+        return False
+    if not _ACTIVE.armed("corrupt_shard_delta"):
+        return False
+    if _ACTIVE.should_fire("corrupt_shard_delta"):
+        payload[len(payload) // 2] ^= 0xFF
+        _ACTIVE.record("corrupt_shard_delta",
+                       f"{len(payload)} delta bytes")
+        return True
+    return False
+
+
+def maybe_drop_push(shard_id: int = -1) -> bool:
+    """PS client hook: lose one worker push on the wire before ANY
+    shard applies it — the client times out (``PSTimeoutError``) and
+    re-sends through backoff; because nothing was applied, the retry
+    lands exactly once."""
+    if _ACTIVE is None:
+        return False
+    if not _ACTIVE.armed("drop_push"):
+        return False
+    if _ACTIVE.should_fire("drop_push"):
+        _ACTIVE.record("drop_push", f"shard{shard_id}"
+                       if shard_id >= 0 else "push dropped")
+        return True
+    return False
+
+
 def maybe_poison_grads(optimizer) -> None:
     """GradScaler unscale hook: overwrite every gradient with NaN, the
     deterministic stand-in for an fp16 overflow — drives the skip-step
@@ -674,4 +728,6 @@ __all__ = ["ChaosInjector", "arm", "disarm", "active", "fired_log",
            "apply_compiled_grad_fault", "maybe_kill_engine",
            "maybe_drop_decode_step", "maybe_corrupt_block_table",
            "maybe_corrupt_spill_block", "maybe_drop_migration",
+           "maybe_kill_ps_server", "maybe_corrupt_shard_delta",
+           "maybe_drop_push",
            "CORRUPT_BLOCK_ID", "KINDS"]

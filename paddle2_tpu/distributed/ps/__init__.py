@@ -28,11 +28,22 @@ resident in HBM:
 Modes: sync is exact. ``geo``/``async`` push-pull have no TPU analog by
 design — the hardware's strength is synchronous SPMD; both raise with
 the migration path (README "Deliberate omissions" decision record).
+
+**The fault-tolerant multi-host plane (ISSUE 18)** lives beside the
+single-host table: :class:`ShardedSparseTable` splits rows across N
+modeled PS servers by a stable hash ring (:mod:`.sharding`), replicates
+every shard primary+follower with CRC-stamped deltas (:mod:`.replica`,
+:mod:`.fleet`), retries dead-server pulls/pushes through typed
+``TransientStepError`` subclasses (:mod:`.errors`), and serves
+bounded-staleness reads while a shard re-forms. Both table classes
+route through the SAME jitted kernels (:mod:`.kernels`), which is what
+makes the ``staleness=0`` sharded table *step-bitwise* against the
+single-host one. The pull/push math here moves unchanged; this module
+now merely calls the shared programs.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import numpy as np
@@ -41,13 +52,32 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import mesh as mesh_mod
+from . import kernels
+from .errors import (PSError, PSReplicaCorruptError, PSServerFailedError,
+                     PSTimeoutError, PSWorkerNotInitializedError)
+from .sharding import HashRing, stable_hash64
+from .replica import ShardState, ShardDelta, ResyncPayload, RULE_ARRAYS
+from .fleet import PSServer, PSServerFleet
+from .client import ShardedSparseTable, VirtualClock
+from . import client as _client
 
 P = PartitionSpec
 
 __all__ = ["SparseTable", "DenseTable", "init_server", "run_server",
-           "init_worker", "stop_worker", "is_server", "is_worker"]
+           "init_worker", "stop_worker", "is_server", "is_worker",
+           "ShardedSparseTable", "VirtualClock", "PSServerFleet",
+           "PSServer", "HashRing", "stable_hash64", "ShardState",
+           "ShardDelta", "ResyncPayload", "RULE_ARRAYS",
+           "PSError", "PSServerFailedError", "PSTimeoutError",
+           "PSReplicaCorruptError", "PSWorkerNotInitializedError",
+           "kernels"]
 
 _RULES = ("naive", "adagrad", "adam")
+
+# the shared merge program (kept under its historical private name —
+# kernels.merge_push IS the old _merge_push, moved so the sharded plane
+# can call it too)
+_merge_push = kernels.merge_push
 
 
 def _row_spec(num_rows: int, axis: Optional[str]) -> P:
@@ -68,19 +98,6 @@ def _row_spec(num_rows: int, axis: Optional[str]) -> P:
 
 def _place(arr, spec: P):
     return jax.device_put(arr, NamedSharding(mesh_mod.get_mesh(), spec))
-
-
-def _merge_push(ids, grads, sentinel: int):
-    """SelectedRows merge-add: sum gradients of duplicate ids.
-
-    Returns (uids, summed) of the same static length as ``ids``; slots
-    beyond the unique count carry ``sentinel`` (dropped by the scatter).
-    """
-    n = ids.shape[0]
-    uids, inv = jnp.unique(ids, return_inverse=True, size=n,
-                           fill_value=sentinel)
-    summed = jax.ops.segment_sum(grads, inv, num_segments=n)
-    return uids, summed
 
 
 class SparseTable:
@@ -145,9 +162,13 @@ class SparseTable:
             self.g2sum = _place(jnp.zeros((self.num_rows,), jnp.float32),
                                 row0)
         elif rule == "adam":
-            z = jnp.zeros((self.num_rows, self.dim), jnp.float32)
-            self.gsum = _place(z, spec)
-            self.g2sum = _place(z, spec)
+            # distinct allocations: _place is a no-op on an already-
+            # placed array, and the donating adam kernel must never see
+            # the two moments aliased to one buffer
+            self.gsum = _place(
+                jnp.zeros((self.num_rows, self.dim), jnp.float32), spec)
+            self.g2sum = _place(
+                jnp.zeros((self.num_rows, self.dim), jnp.float32), spec)
             # beta powers START at beta (sparse_sgd_rule.cc:260-262) and
             # decay on each touch of that row
             self.beta1_pow = _place(
@@ -161,9 +182,9 @@ class SparseTable:
         """Gather rows; rows below the entry threshold read as zeros."""
         ids = jnp.asarray(ids, jnp.int32)
         if self.entry_threshold and update_show:
-            self.counts = _pull_count(self.counts, ids)
-        rows = _pull(self.weight, self.counts, ids,
-                     self.entry_threshold)
+            self.counts = kernels.pull_count(self.counts, ids)
+        rows = kernels.pull_rows(self.weight, self.counts, ids,
+                                 self.entry_threshold)
         return rows
 
     # -- push ----------------------------------------------------------
@@ -180,22 +201,23 @@ class SparseTable:
                 f"push grads shape {grads.shape} != {(ids.shape[0], self.dim)}")
         if ids.shape[0] == 0:
             return
+        uids, g = kernels.merge_scaled(ids, grads, float(scale),
+                                       self.num_rows)
         bounds = self.bounds if self.bounds is not None else (0.0, 0.0)
         if self.rule == "naive":
-            self.weight = _push_naive(
-                self.weight, ids, grads, self.lr, float(scale),
+            self.weight = kernels.apply_naive(
+                self.weight, uids, g, self.lr,
                 self.bounds is not None, *bounds)
         elif self.rule == "adagrad":
-            self.weight, self.g2sum = _push_adagrad(
-                self.weight, self.g2sum, ids, grads, self.lr,
-                self.initial_g2sum, float(scale),
-                self.bounds is not None, *bounds)
+            self.weight, self.g2sum = kernels.apply_adagrad(
+                self.weight, self.g2sum, uids, g, self.lr,
+                self.initial_g2sum, self.bounds is not None, *bounds)
         else:
             (self.weight, self.gsum, self.g2sum, self.beta1_pow,
-             self.beta2_pow) = _push_adam(
+             self.beta2_pow) = kernels.apply_adam(
                 self.weight, self.gsum, self.g2sum, self.beta1_pow,
-                self.beta2_pow, ids, grads, self.lr, self.beta1,
-                self.beta2, self.epsilon, float(scale),
+                self.beta2_pow, uids, g, self.lr, self.beta1,
+                self.beta2, self.epsilon,
                 self.bounds is not None, *bounds)
 
     def state_dict(self):
@@ -211,73 +233,6 @@ class SparseTable:
                                     self._spec if jnp.ndim(v) == 2
                                     else P(self._spec[0])
                                     if len(self._spec) else P()))
-
-
-def _clip(w, do_bound, lo, hi):
-    return jnp.clip(w, lo, hi) if do_bound else w
-
-
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _pull_count(counts, ids):
-    return counts.at[ids.reshape(-1)].add(1)
-
-
-@functools.partial(jax.jit, static_argnums=(3,))
-def _pull(weight, counts, ids, threshold):
-    rows = jnp.take(weight, ids, axis=0)
-    if threshold:
-        live = (jnp.take(counts, ids, axis=0) >= threshold)
-        rows = rows * live[..., None].astype(rows.dtype)
-    return rows
-
-
-@functools.partial(jax.jit, donate_argnums=(0,),
-                   static_argnums=(5, 6, 7))
-def _push_naive(weight, ids, grads, lr, scale, do_bound, lo, hi):
-    uids, g = _merge_push(ids, grads / scale, weight.shape[0])
-    cur = jnp.take(weight, jnp.clip(uids, 0, weight.shape[0] - 1), axis=0)
-    new = _clip(cur - lr * g, do_bound, lo, hi)
-    return weight.at[uids].set(new, mode="drop")
-
-
-@functools.partial(jax.jit, donate_argnums=(0, 1),
-                   static_argnums=(7, 8, 9))
-def _push_adagrad(weight, g2sum, ids, grads, lr, g0, scale,
-                  do_bound, lo, hi):
-    n_rows = weight.shape[0]
-    uids, g = _merge_push(ids, grads / scale, n_rows)
-    safe = jnp.clip(uids, 0, n_rows - 1)
-    cur_w = jnp.take(weight, safe, axis=0)
-    cur_s = jnp.take(g2sum, safe, axis=0)
-    new_w = cur_w - lr * g * jnp.sqrt(g0 / (g0 + cur_s))[:, None]
-    new_w = _clip(new_w, do_bound, lo, hi)
-    new_s = cur_s + jnp.mean(g * g, axis=-1)
-    return (weight.at[uids].set(new_w, mode="drop"),
-            g2sum.at[uids].set(new_s, mode="drop"))
-
-
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4),
-                   static_argnums=(11, 12, 13))
-def _push_adam(weight, gsum, g2sum, b1p, b2p, ids, grads, lr, b1, b2,
-               eps, scale, do_bound, lo, hi):
-    n_rows = weight.shape[0]
-    uids, g = _merge_push(ids, grads / scale, n_rows)
-    safe = jnp.clip(uids, 0, n_rows - 1)
-    w = jnp.take(weight, safe, axis=0)
-    m = jnp.take(gsum, safe, axis=0)
-    v = jnp.take(g2sum, safe, axis=0)
-    p1 = jnp.take(b1p, safe, axis=0)
-    p2 = jnp.take(b2p, safe, axis=0)
-    lr_t = lr * jnp.sqrt(1.0 - p2) / (1.0 - p1)
-    m = b1 * m + (1.0 - b1) * g
-    v = b2 * v + (1.0 - b2) * g * g
-    w = _clip(w - lr_t[:, None] * (m / (jnp.sqrt(v) + eps)),
-              do_bound, lo, hi)
-    return (weight.at[uids].set(w, mode="drop"),
-            gsum.at[uids].set(m, mode="drop"),
-            g2sum.at[uids].set(v, mode="drop"),
-            b1p.at[uids].set(p1 * b1, mode="drop"),
-            b2p.at[uids].set(p2 * b2, mode="drop"))
 
 
 class DenseTable:
@@ -321,10 +276,14 @@ class DenseTable:
 # -- the_one_ps runtime facade ----------------------------------------
 # In the reference, fleet PS mode splits processes into TRAINING_ROLE=
 # PSERVER (run_server blocks serving tables) and TRAINER (init_worker
-# connects). Single-controller SPMD has no server processes: every host
-# runs the same program and the tables live sharded in HBM. The facade
-# keeps reference scripts runnable: servers don't exist, so is_server()
-# is always False and server entry points are no-ops.
+# connects). Single-controller SPMD has no server processes — is_server()
+# stays False and every SPMD process is a worker — but the lifecycle is
+# no longer a no-op: init_server stores the modeled fleet config,
+# run_server marks it serving, and init_worker opens the session that
+# ShardedSparseTable requires when constructed without an explicit
+# fleet (PSWorkerNotInitializedError otherwise). Reference scripts keep
+# running unchanged; new code gets a legible failure instead of a
+# silent no-op when it skips the lifecycle.
 
 def is_server() -> bool:
     return False
@@ -334,17 +293,32 @@ def is_worker() -> bool:
     return True
 
 
-def init_server(*args, **kwargs) -> None:
-    """No-op: tables are mesh-resident (see module docstring)."""
+def init_server(num_servers: int = 2, num_shards: Optional[int] = None,
+                probe_interval_s: float = 0.02, link=None,
+                seed: int = 0, **_compat) -> None:
+    """Record the modeled PS fleet config. Extra keyword arguments from
+    reference scripts (dirnames, fleet descs) are accepted and ignored."""
+    _client._LIFECYCLE["server_cfg"] = {
+        "num_servers": int(num_servers), "num_shards": num_shards,
+        "probe_interval_s": float(probe_interval_s), "link": link,
+        "seed": int(seed)}
 
 
 def run_server() -> None:
-    """No-op: there is no server process to block in."""
+    """Mark the modeled fleet as serving (no process blocks — the
+    'servers' live inside the same SPMD program)."""
+    _client._LIFECYCLE["serving"] = True
 
 
 def init_worker(scopes=None) -> None:
-    """No-op: every SPMD process is a worker already."""
+    """Open the worker session: after this, ShardedSparseTable may be
+    constructed without an explicit fleet (it builds one from the
+    init_server config)."""
+    _client._LIFECYCLE["worker"] = True
 
 
 def stop_worker() -> None:
-    """No-op counterpart of init_worker."""
+    """Close the worker session opened by :func:`init_worker`."""
+    _client._LIFECYCLE["worker"] = False
+    _client._LIFECYCLE["serving"] = False
+    _client._LIFECYCLE["server_cfg"] = None

@@ -1,0 +1,467 @@
+"""Worker-side client of the sharded PS plane: ``ShardedSparseTable``.
+
+What the single-host :class:`~paddle2_tpu.distributed.ps.SparseTable`
+does in one HBM array, this class does against N modeled servers
+(:mod:`.fleet`), with the reliability semantics ISSUE 18 asks for:
+
+- **routing** — ids hash to shards (:mod:`.sharding`); pulls gather
+  per-shard slices, pushes merge duplicate ids ONCE (the same jitted
+  ``merge_scaled`` program the single-host table runs) and scatter the
+  merged rows per shard. Traffic is priced per link class: a worker is
+  co-located with one server (``host`` class), everything else rides
+  the DCN — both through the PR 14 alpha+beta LinkModel.
+- **retry/backoff** — a dead primary raises ``PSServerFailedError``,
+  a dropped push raises ``PSTimeoutError``; both are
+  ``TransientStepError`` subclasses retried through
+  ``retry.backoff_delays`` on the VIRTUAL clock, probing the fleet at
+  each rung so the sweep that promotes the follower actually runs.
+- **bounded staleness** — every fresh pull stamps a per-worker mirror
+  with the table version; while a shard is re-forming, reads within
+  ``max_staleness`` versions degrade to the mirror (counted in
+  ``ps_stale_reads_total`` + the staleness gauge) instead of stalling
+  the worker fleet. ``max_staleness=0`` never serves the mirror — the
+  transparency mode the bitwise parity gate runs in.
+- **follower-read hot-key caching** — a per-worker cache of the
+  hottest rows refreshed from FOLLOWER replicas every
+  ``hot_cache_refresh`` versions; the ``auto`` policy enables it only
+  when the observed key histogram says the saved pull bytes beat the
+  refresh bytes (a uniform trace must decline — gated both ways).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ...observability import metrics
+from ...observability.cost_model import LinkModel, sparse_transfer_seconds
+from ..fault_tolerance import chaos
+from ..fault_tolerance.retry import backoff_delays
+from .errors import (PSServerFailedError, PSTimeoutError,
+                     PSWorkerNotInitializedError)
+from .fleet import PSServerFleet, ps_flight
+from . import kernels
+
+__all__ = ["VirtualClock", "ShardedSparseTable"]
+
+# module-level lifecycle state, driven by the the_one_ps facade in
+# __init__.py (init_server stores the fleet config; init_worker opens
+# the session ShardedSparseTable() requires when no fleet is passed)
+_LIFECYCLE: Dict[str, Any] = {"worker": False, "serving": False,
+                              "server_cfg": None}
+
+
+def require_worker(what: str) -> None:
+    if not _LIFECYCLE["worker"]:
+        raise PSWorkerNotInitializedError(what)
+
+
+class VirtualClock:
+    """The drill's deterministic clock: every modeled transfer and
+    backoff sleep advances it; nothing reads the wall clock."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def advance(self, dt: float) -> float:
+        self.t += float(dt)
+        return self.t
+
+
+class ShardedSparseTable:
+    """Sharded, replicated, bounded-staleness sparse table."""
+
+    def __init__(self, num_rows: int, dim: int, rule: str = "adagrad",
+                 lr: float = 0.05, initial_range: float = 0.0,
+                 initial_g2sum: float = 3e-6,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8,
+                 weight_bounds: Optional[Tuple[float, float]] = None,
+                 entry_threshold: int = 0,
+                 max_staleness: int = 0,
+                 fleet: Optional[PSServerFleet] = None,
+                 num_servers: int = 2,
+                 num_shards: Optional[int] = None,
+                 probe_interval_s: float = 0.02,
+                 link: Optional[LinkModel] = None,
+                 hot_cache_rows: int = 0,
+                 hot_cache_refresh: int = 8,
+                 hot_cache_policy: str = "auto",
+                 retry_base_s: Optional[float] = None,
+                 retry_max_s: Optional[float] = None,
+                 retry_attempts: int = 8,
+                 rpc_timeout_s: float = 0.002,
+                 clock: Optional[VirtualClock] = None,
+                 seed: int = 0):
+        if hot_cache_policy not in ("auto", "on", "off"):
+            raise ValueError(
+                f"hot_cache_policy must be auto/on/off, "
+                f"got {hot_cache_policy!r}")
+        self.num_rows, self.dim, self.rule = int(num_rows), int(dim), rule
+        self.lr = float(lr)
+        self.entry_threshold = int(entry_threshold)
+        self.max_staleness = int(max_staleness)
+        self.hot_cache_rows = int(hot_cache_rows)
+        self.hot_cache_refresh = int(hot_cache_refresh)
+        self.hot_cache_policy = hot_cache_policy
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self.clock = clock or VirtualClock()
+        if fleet is None:
+            require_worker("ShardedSparseTable")
+            cfg = dict(_LIFECYCLE["server_cfg"] or {})
+            cfg.setdefault("num_servers", num_servers)
+            cfg.setdefault("num_shards", num_shards)
+            cfg.setdefault("probe_interval_s", probe_interval_s)
+            cfg.setdefault("link", link)
+            cfg.setdefault("seed", seed)
+            fleet = PSServerFleet(**cfg)
+        self.fleet = fleet
+        self.link = fleet.link
+        self.retry_base_s = (retry_base_s if retry_base_s is not None
+                             else fleet.probe_interval_s / 4.0)
+        self.retry_max_s = (retry_max_s if retry_max_s is not None
+                            else fleet.probe_interval_s * 4.0)
+        self.retry_attempts = int(retry_attempts)
+        # same init program as the single-host table (bitwise parity)
+        if initial_range:
+            import jax
+            import jax.numpy as jnp
+            key = jax.random.PRNGKey(seed)
+            init_w = np.asarray(jax.random.uniform(
+                key, (self.num_rows, self.dim), jnp.float32,
+                -initial_range, initial_range))
+        else:
+            init_w = None
+        fleet.attach_table(self.num_rows, self.dim, rule, self.lr,
+                           initial_g2sum, beta1, beta2, epsilon,
+                           weight_bounds, init_weight=init_w)
+        ring = fleet.ring
+        self._shard_of = ring.shard_of_rows(np.arange(self.num_rows))
+        self._local_of = np.zeros(self.num_rows, np.int64)
+        self._shard_rows: Dict[int, int] = {}
+        for shard in range(ring.num_shards):
+            rows = ring.rows_of_shard(shard, self.num_rows)
+            self._local_of[rows] = np.arange(len(rows))
+            self._shard_rows[shard] = len(rows)
+        self.counts = np.zeros(self.num_rows, np.int64)
+        self.version = 0
+        # per-worker state (lazily created)
+        self._mirror: Dict[int, np.ndarray] = {}
+        self._stamps: Dict[int, np.ndarray] = {}
+        self._hist: Dict[int, np.ndarray] = {}
+        self._hist_held: Dict[int, np.ndarray] = {}
+        self._hist_flip: Dict[int, int] = {}
+        self._hot: Dict[int, Dict[str, Any]] = {}
+        self._cache_on: Dict[int, Optional[bool]] = {}
+        # modeled-traffic ledgers (the hot-key gate reads these)
+        self.pull_wire_bytes = 0
+        self.push_wire_bytes = 0
+        self.refresh_wire_bytes = 0
+        self.pull_seconds = 0.0
+        self.push_seconds = 0.0
+        self.stale_reads = 0
+        self.retries = 0
+
+    # -- per-worker state ----------------------------------------------
+    def _worker(self, w: int) -> int:
+        w = int(w)
+        if w not in self._mirror:
+            self._mirror[w] = np.zeros((self.num_rows, self.dim),
+                                       np.float32)
+            self._stamps[w] = np.full(self.num_rows, -1, np.int64)
+            self._hist[w] = np.zeros(self.num_rows, np.int64)
+            self._hist_held[w] = np.zeros(self.num_rows, np.int64)
+            self._hist_flip[w] = 0
+            self._hot[w] = {"ids": None, "rows": None, "index": None,
+                            "at": -1}
+            self._cache_on[w] = (True if self.hot_cache_policy == "on"
+                                 else False if self.hot_cache_policy == "off"
+                                 else None)
+        return w
+
+    def _colocated(self, worker: int) -> int:
+        return int(worker) % len(self.fleet.servers)
+
+    def _link_class(self, worker: int, server: Optional[int]) -> str:
+        return ("host" if server is not None
+                and server == self._colocated(worker) else "dcn")
+
+    # -- retry ----------------------------------------------------------
+    def _retry(self, fn, first_exc):
+        last = first_exc
+        for d in backoff_delays(self.retry_base_s, self.retry_max_s,
+                                self.retry_attempts, jitter=0.0):
+            self.retries += 1
+            self.clock.advance(d)
+            self.fleet.maybe_probe(self.clock.t)
+            try:
+                return fn()
+            except (PSServerFailedError, PSTimeoutError) as e:
+                last = e
+        raise last
+
+    # -- pull -----------------------------------------------------------
+    def pull(self, ids, worker: int = 0,
+             update_show: bool = True) -> np.ndarray:
+        """Gather rows for ``ids`` (duplicates allowed). Serving order:
+        hot cache (when enabled + fresh) -> primary fetch -> bounded
+        stale mirror while the shard re-forms."""
+        w = self._worker(worker)
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        self.fleet.maybe_probe(self.clock.t)
+        metrics.inc("ps_pulls_total")
+        if self.entry_threshold and update_show:
+            # scatter-ADD like the jitted .at[ids].add(1): duplicate ids
+            # in one pull tick the show count once each (fancy-index +=
+            # would collapse them and break threshold parity)
+            np.add.at(self.counts, ids, 1)
+        # alternating pulls feed a held-out histogram so the auto-cache
+        # decision can estimate its hit rate out-of-sample (picking
+        # top-K on the SAME counts it scores against would make even a
+        # uniform trace look hot — pure selection bias)
+        if self._hist_flip[w] == 0:
+            self._hist[w][ids] += 1
+        else:
+            self._hist_held[w][ids] += 1
+        self._hist_flip[w] ^= 1
+        out = np.zeros((len(ids), self.dim), np.float32)
+        need = np.ones(len(ids), bool)
+        if self.hot_cache_rows > 0:
+            self._maybe_refresh_cache(w)
+            hc = self._hot[w]
+            if (self._cache_on[w] and hc["index"] is not None
+                    and self.version - hc["at"] <= self.max_staleness):
+                cpos = hc["index"][ids]
+                hit = cpos >= 0
+                out[hit] = hc["rows"][cpos[hit]]
+                need[hit] = False
+        max_served_age = 0
+        for shard in np.unique(self._shard_of[ids[need]]):
+            sel = need & (self._shard_of[ids] == shard)
+            gids = ids[sel]
+            lids = self._local_of[gids]
+            rows, age = self._fetch_shard(int(shard), gids, lids, w)
+            out[sel] = rows
+            max_served_age = max(max_served_age, age)
+        metrics.set_gauge("ps_staleness", float(max_served_age))
+        if self.entry_threshold:
+            live = (self.counts[ids] >= self.entry_threshold)
+            out = out * live[:, None].astype(np.float32)
+        ps_flight(event="pull", worker=w, rows=int(len(ids)),
+                  t=self.clock.t)
+        return out
+
+    def _fetch_shard(self, shard: int, gids: np.ndarray,
+                     lids: np.ndarray, w: int) -> Tuple[np.ndarray, int]:
+        """Fetch one shard's slice from its primary; on a dead primary
+        serve the bounded-stale mirror (counted) or block in retry
+        until the probe sweep promotes the follower. Returns the rows
+        and the served staleness (0 when fresh)."""
+
+        def fetch():
+            return self.fleet.serve_pull(shard, lids, self.clock.t)
+
+        try:
+            rows = fetch()
+        except PSServerFailedError as e:
+            stamps = self._stamps[w][gids]
+            age = (self.version - int(stamps.min())
+                   if len(stamps) and stamps.min() >= 0 else -1)
+            if 0 <= age <= self.max_staleness and self.max_staleness > 0:
+                self.stale_reads += 1
+                metrics.inc("ps_stale_reads_total")
+                ps_flight(event="stale_read", shard=shard,
+                          server=e.server, worker=w, age=age,
+                          t=self.clock.t)
+                return self._mirror[w][gids], age
+            rows = self._retry(fetch, e)
+        payload = len(gids) * (self.dim * 4 + 4)
+        primary = self.fleet.placement[shard][0]
+        cls = self._link_class(w, primary)
+        self.fleet.traffic.add("ps_pull", payload, axes=(cls,))
+        seconds = sparse_transfer_seconds(payload, cls, link=self.link)
+        self.pull_wire_bytes += payload
+        self.pull_seconds += seconds
+        self.clock.advance(seconds)
+        self._mirror[w][gids] = rows
+        self._stamps[w][gids] = self.version
+        return rows, 0
+
+    # -- hot-key cache ---------------------------------------------------
+    def _maybe_refresh_cache(self, w: int) -> None:
+        hc = self._hot[w]
+        due = (hc["at"] < 0
+               or self.version - hc["at"] >= self.hot_cache_refresh)
+        if not due:
+            return
+        if self._cache_on[w] is None:
+            # auto policy: first window only observes; decide at the
+            # first boundary with a histogram to read
+            if hc["at"] < 0:
+                hc["at"] = self.version
+                return
+            self._cache_on[w] = self._decide(w)
+        if not self._cache_on[w]:
+            hc["at"] = self.version   # keep the decision point anchored
+            return
+        top = self._top_rows(w)
+        rows = np.zeros((len(top), self.dim), np.float32)
+        for shard in np.unique(self._shard_of[top]):
+            sel = self._shard_of[top] == shard
+            lids = self._local_of[top[sel]]
+            primary, follower = self.fleet.placement[int(shard)]
+            try:  # follower-read: the refresh never loads the primary
+                rows[sel] = self.fleet.serve_pull(
+                    int(shard), lids, self.clock.t, role="follower")
+                src = follower
+            except PSServerFailedError:
+                try:
+                    rows[sel] = self.fleet.serve_pull(
+                        int(shard), lids, self.clock.t)
+                    src = primary
+                except PSServerFailedError:
+                    return  # shard re-forming: keep the old cache,
+                            # retry the refresh at the next pull
+            payload = int(sel.sum()) * (self.dim * 4 + 4)
+            cls = self._link_class(w, src)
+            self.fleet.traffic.add("ps_cache_refresh", payload,
+                                   axes=(cls,))
+            self.refresh_wire_bytes += payload
+            self.clock.advance(sparse_transfer_seconds(
+                payload, cls, link=self.link))
+        index = np.full(self.num_rows, -1, np.int64)
+        index[top] = np.arange(len(top))
+        hc.update(ids=top, rows=rows, index=index, at=self.version)
+        ps_flight(event="cache_refresh", worker=w, rows=int(len(top)),
+                  t=self.clock.t)
+
+    def _top_rows(self, w: int,
+                  h: Optional[np.ndarray] = None) -> np.ndarray:
+        """The hottest ``hot_cache_rows`` ids by observed pull count —
+        ties broken by id so the cache contents are deterministic."""
+        if h is None:
+            h = self._hist[w] + self._hist_held[w]
+        order = np.lexsort((np.arange(self.num_rows), -h))
+        top = order[:self.hot_cache_rows]
+        return np.sort(top[h[top] > 0])
+
+    def _decide(self, w: int) -> bool:
+        """Cost-model the cache: expected saved pull bytes per version
+        vs refresh bytes per version. The hit rate is estimated
+        OUT-OF-SAMPLE — top-K picked on one half of the observed pulls,
+        scored on the held-out half — and the margin keeps a break-even
+        uniform trace on the DECLINE side."""
+        held = self._hist_held[w]
+        held_total = int(held.sum())
+        total = int(held_total + self._hist[w].sum())
+        if total == 0 or held_total == 0:
+            return False
+        top = self._top_rows(w, h=self._hist[w])
+        if len(top) == 0:
+            return False
+        hit_frac = float(held[top].sum()) / float(held_total)
+        versions = max(1, self.version)
+        pulled_rows_per_version = float(total) / versions
+        row_b = self.dim * 4 + 4
+        saved = hit_frac * pulled_rows_per_version * row_b
+        refresh = len(top) * row_b / float(self.hot_cache_refresh)
+        decision = saved > 1.5 * refresh
+        ps_flight(event="cache_decision", worker=w,
+                  enabled=bool(decision),
+                  hit_frac=round(hit_frac, 6), t=self.clock.t)
+        return decision
+
+    def cache_enabled(self, worker: int = 0) -> Optional[bool]:
+        return self._cache_on.get(int(worker))
+
+    # -- push -----------------------------------------------------------
+    def push(self, ids, grads, worker: int = 0,
+             scale: float = 1.0) -> None:
+        """Merge duplicate ids once (the shared jitted program), route
+        the merged rows per shard, apply on each primary, replicate."""
+        import jax.numpy as jnp
+        w = self._worker(worker)
+        ids = np.asarray(ids, np.int64).reshape(-1) \
+            if np.ndim(ids) == 1 else np.asarray(ids)
+        if np.ndim(ids) != 1:
+            raise ValueError(f"push ids must be 1-D, got shape "
+                             f"{np.shape(ids)}")
+        grads = np.asarray(grads, np.float32)
+        if grads.shape != (len(ids), self.dim):
+            raise ValueError(f"push grads shape {grads.shape} != "
+                             f"{(len(ids), self.dim)}")
+        if len(ids) == 0:
+            return
+        self.fleet.maybe_probe(self.clock.t)
+        metrics.inc("ps_pushes_total")
+        uids, g = kernels.merge_scaled(
+            jnp.asarray(ids, jnp.int32), jnp.asarray(grads),
+            float(scale), self.num_rows)
+        uids_np = np.asarray(uids, np.int64)
+        g_np = np.asarray(g)
+        n = len(uids_np)
+        real = uids_np < self.num_rows
+        safe = np.clip(uids_np, 0, self.num_rows - 1)
+
+        def send():
+            if chaos.maybe_drop_push():
+                self.clock.advance(self.rpc_timeout_s)
+                raise PSTimeoutError("push", timeout_s=self.rpc_timeout_s)
+            for shard in np.unique(self._shard_of[uids_np[real]]):
+                sel = real & (self._shard_of[safe] == shard)
+                local_full = np.full(n, self._shard_rows[int(shard)],
+                                     np.int32)
+                local_full[sel] = self._local_of[uids_np[sel]]
+
+                def apply(shard=int(shard), local_full=local_full):
+                    return self.fleet.apply_push(
+                        shard, local_full, g_np, self.version + 1,
+                        self.clock.t)
+
+                try:
+                    rep_s = apply()
+                except (PSServerFailedError, PSTimeoutError) as e:
+                    rep_s = self._retry(apply, e)
+                payload = int(sel.sum()) * (self.dim * 4 + 4)
+                primary = self.fleet.placement[int(shard)][0]
+                cls = self._link_class(w, primary)
+                self.fleet.traffic.add("ps_push", payload, axes=(cls,))
+                seconds = sparse_transfer_seconds(payload, cls,
+                                                  link=self.link)
+                self.push_wire_bytes += payload
+                self.push_seconds += seconds + rep_s
+                self.clock.advance(seconds + rep_s)
+
+        try:
+            send()
+        except PSTimeoutError as e:
+            self._retry(send, e)
+        self.version += 1
+        ps_flight(event="push", worker=w, rows=int(real.sum()),
+                  version=self.version, t=self.clock.t)
+
+    # -- introspection ---------------------------------------------------
+    def assembled_weight(self) -> np.ndarray:
+        """The full table re-assembled from the shard primaries (the
+        parity gate compares this bitwise vs the single-host table)."""
+        out = np.zeros((self.num_rows, self.dim), np.float32)
+        for shard in range(self.fleet.ring.num_shards):
+            st = self.fleet.shard_state(shard, "primary")
+            out[st.rows] = st.weight
+        return out
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        from .replica import RULE_ARRAYS
+        out: Dict[str, np.ndarray] = {
+            "weight": self.assembled_weight(),
+            "counts": self.counts.copy()}
+        for name in RULE_ARRAYS[self.rule][1:]:
+            st0 = self.fleet.shard_state(0, "primary")
+            shape = (self.num_rows,) + getattr(st0, name).shape[1:]
+            arr = np.zeros(shape, np.float32)
+            for shard in range(self.fleet.ring.num_shards):
+                st = self.fleet.shard_state(shard, "primary")
+                arr[st.rows] = getattr(st, name)
+            out[name] = arr
+        return out

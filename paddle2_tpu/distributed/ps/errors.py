@@ -1,0 +1,73 @@
+"""Typed failures of the parameter-server plane.
+
+``PSServerFailedError`` and ``PSTimeoutError`` subclass
+:class:`~paddle2_tpu.distributed.fault_tolerance.TransientStepError` on
+purpose: a PS fault inside a training step is transient-by-contract
+(the fleet promotes a follower at the next probe sweep; a dropped push
+re-sends), so ``ReliableStep`` replays and the client's
+``retry.backoff_delays`` loop both compose with it without a special
+case. ``PSReplicaCorruptError`` is NOT transient: a CRC-mismatched
+delta means the follower's bytes can no longer be trusted and the only
+exit is a full-shard resync — retrying the apply would hide divergence.
+"""
+
+from __future__ import annotations
+
+from ..fault_tolerance.reliable import TransientStepError
+
+__all__ = ["PSError", "PSServerFailedError", "PSTimeoutError",
+           "PSReplicaCorruptError", "PSWorkerNotInitializedError"]
+
+
+class PSError(RuntimeError):
+    """Base of every typed parameter-server failure."""
+
+
+class PSServerFailedError(PSError, TransientStepError):
+    """The shard's primary (or the addressed server) is dead. Retry
+    through backoff; the probe sweep promotes the follower."""
+
+    def __init__(self, server: int, shard: int = -1, op: str = "?"):
+        self.server, self.shard, self.op = int(server), int(shard), op
+        super().__init__(
+            f"ps server {server} failed during {op}"
+            + (f" (shard {shard})" if shard >= 0 else "")
+            + "; retry after the next probe sweep promotes its follower")
+
+
+class PSTimeoutError(PSError, TransientStepError):
+    """An RPC was lost on the wire (modeled ``drop_push`` chaos): the
+    client timed out waiting for the ack. Safe to re-send — a dropped
+    push never reached the table, so the retry applies exactly once."""
+
+    def __init__(self, op: str, shard: int = -1,
+                 timeout_s: float = 0.0):
+        self.op, self.shard, self.timeout_s = op, int(shard), timeout_s
+        super().__init__(
+            f"ps {op} timed out after {timeout_s:.6f}s"
+            + (f" (shard {shard})" if shard >= 0 else "") + "; re-send")
+
+
+class PSReplicaCorruptError(PSError):
+    """A follower received a delta whose payload does not match its CRC
+    stamp. Terminal for the incremental stream: the follower must drop
+    to a full-shard resync from the primary."""
+
+    def __init__(self, shard: int, server: int, expect: int, got: int):
+        self.shard, self.server = int(shard), int(server)
+        super().__init__(
+            f"shard {shard} delta to follower {server}: payload crc "
+            f"{got:#010x} != stamped {expect:#010x}; full resync required")
+
+
+class PSWorkerNotInitializedError(PSError):
+    """A worker API was called before ``ps.init_worker()``. The
+    reference's the_one_ps trainer has the same precondition; the stub
+    used to silently no-op, which hid the missing lifecycle call."""
+
+    def __init__(self, what: str = "worker API"):
+        super().__init__(
+            f"{what} called before ps.init_worker(). Call "
+            "ps.init_server(...) (builds the modeled server fleet), "
+            "ps.run_server(), then ps.init_worker() — see README "
+            "'Parameter-server recommender'.")

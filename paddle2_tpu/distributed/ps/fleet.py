@@ -1,0 +1,369 @@
+"""The modeled PS server fleet: replication, probe sweeps, failover.
+
+Single-process stand-ins for N parameter-server hosts, driven entirely
+by the caller's virtual clock (``now`` arguments) — no wall-clock
+anywhere, so every drill that runs on this fleet is bit-reproducible.
+
+The reliability contract mirrors the PR 11 serving fleet:
+
+- every shard has a **primary** and a **follower** (consistent-hash
+  placement, :mod:`.sharding`); pushes apply to the primary through the
+  shared jitted kernels and ship a CRC-stamped delta to the follower
+  (:mod:`.replica`);
+- a dead server is detected at the next **probe sweep**
+  (:meth:`PSServerFleet.maybe_probe`, the ``health.py`` prober idiom:
+  lazily anchored cadence, one :class:`HealthReport` per server per
+  sweep) — detection latency is INSIDE the gated MTTR;
+- promotion is a placement recomputation: the ring guarantees the dead
+  primary's first distinct successor is exactly the current follower,
+  so the data is already there; only the replacement follower pays a
+  full-shard resync (priced on the DCN);
+- a CRC-mismatched delta (``corrupt_shard_delta`` chaos) drops the
+  follower to the same full-shard resync instead of diverging.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...observability import metrics
+from ...observability.cost_model import (CollectiveTraffic, LinkModel,
+                                         sparse_transfer_seconds)
+from ..fault_tolerance import chaos
+from ..fault_tolerance.health import HealthReport
+from .errors import PSError, PSReplicaCorruptError, PSServerFailedError
+from .replica import ShardState
+from .sharding import HashRing
+from . import kernels
+
+__all__ = ["PSServer", "PSServerFleet", "ps_flight"]
+
+
+def ps_flight(**fields) -> None:
+    """One shared emitter for every PS flight-recorder span
+    (``kind="ps"``): pull/push/failover/resync with shard + server ids,
+    rendered by flight_doctor's PS section. None-valued fields are
+    dropped; the recorder keeps its one-attribute-load no-op when
+    disabled."""
+    from ..fault_tolerance import flight_recorder
+    flight_recorder.record("ps", **{k: v for k, v in fields.items()
+                                    if v is not None})
+
+
+class PSServer:
+    """One modeled server host: alive flag + the shard replicas it
+    currently holds (primary AND follower roles — the fleet's placement
+    says which is which)."""
+
+    def __init__(self, server_id: int):
+        self.id = int(server_id)
+        self.alive = True
+        self.shards: Dict[int, ShardState] = {}
+        self.ops = 0
+
+
+class PSServerFleet:
+    """N modeled servers serving ONE sharded table (a table builds its
+    own fleet; the lifecycle facade hands each table the server-side
+    config). All methods take the caller's virtual ``now``."""
+
+    def __init__(self, num_servers: int = 2,
+                 num_shards: Optional[int] = None,
+                 probe_interval_s: float = 0.02,
+                 link: Optional[LinkModel] = None,
+                 seed: int = 0):
+        if probe_interval_s <= 0:
+            raise ValueError(
+                f"probe_interval_s must be > 0, got {probe_interval_s}")
+        self.ring = HashRing(num_servers, num_shards=num_shards, seed=seed)
+        self.servers = [PSServer(i) for i in range(int(num_servers))]
+        self.probe_interval_s = float(probe_interval_s)
+        self.link = link or LinkModel()
+        self.traffic = CollectiveTraffic()
+        self.placement: Dict[int, Tuple[int, Optional[int]]] = {}
+        self.events: List[Dict[str, Any]] = []
+        self.mttrs: List[float] = []
+        self.repair_s = 0.0
+        self.resyncs = 0
+        self.failovers = 0
+        self._table: Optional[Dict[str, Any]] = None
+        self._next_probe_t: Optional[float] = None
+        self._kill_t: Dict[int, float] = {}
+        self._handled_failures: set = set()
+
+    # -- table hosting --------------------------------------------------
+    def attach_table(self, num_rows: int, dim: int, rule: str,
+                     lr: float, initial_g2sum: float,
+                     beta1: float, beta2: float, epsilon: float,
+                     bounds: Optional[Tuple[float, float]],
+                     init_weight: Optional[np.ndarray] = None) -> None:
+        """Build primary+follower ShardStates on the ring placement.
+        ``init_weight`` is the FULL (num_rows, dim) initial table (the
+        client computes it with the same PRNG as the single-host twin),
+        sliced per shard here so staleness-0 parity starts bitwise."""
+        if self._table is not None:
+            raise PSError("this modeled fleet already hosts a table; "
+                          "build one fleet per ShardedSparseTable")
+        self._table = {
+            "num_rows": int(num_rows), "dim": int(dim), "rule": rule,
+            "lr": float(lr), "g0": float(initial_g2sum),
+            "beta1": float(beta1), "beta2": float(beta2),
+            "eps": float(epsilon), "bounds": bounds,
+        }
+        self.placement = self.ring.placement(self._alive_ids())
+        for shard in range(self.ring.num_shards):
+            rows = self.ring.rows_of_shard(shard, num_rows)
+            init = (None if init_weight is None
+                    else np.asarray(init_weight, np.float32)[rows])
+            primary, follower = self.placement[shard]
+            for sid in (primary, follower):
+                if sid is None:
+                    continue
+                self.servers[sid].shards[shard] = ShardState(
+                    shard, rows, dim, rule, beta1=beta1, beta2=beta2,
+                    init_weight=init)
+
+    @property
+    def table(self) -> Dict[str, Any]:
+        if self._table is None:
+            raise PSError("no table attached to this fleet")
+        return self._table
+
+    def _alive_ids(self) -> Tuple[int, ...]:
+        return tuple(s.id for s in self.servers if s.alive)
+
+    def shard_state(self, shard: int, role: str = "primary") -> ShardState:
+        primary, follower = self.placement[shard]
+        sid = primary if role == "primary" else follower
+        if sid is None:
+            raise PSServerFailedError(-1, shard, f"{role} lookup")
+        return self.servers[sid].shards[shard]
+
+    # -- liveness / chaos entry of every op -----------------------------
+    def _op(self, sid: int, op: str, shard: int, now: float) -> PSServer:
+        srv = self.servers[sid]
+        srv.ops += 1
+        if chaos.maybe_kill_ps_server(sid, op=op):
+            self.kill_server(sid, now)
+        if not srv.alive:
+            raise PSServerFailedError(sid, shard, op)
+        return srv
+
+    def kill_server(self, sid: int, now: float) -> None:
+        srv = self.servers[sid]
+        if not srv.alive:
+            return
+        srv.alive = False
+        self._kill_t[sid] = float(now)
+        self.events.append({"event": "server_kill", "server": sid,
+                            "t": float(now)})
+        ps_flight(event="server_kill", server=sid, t=float(now))
+
+    # -- serving --------------------------------------------------------
+    def serve_pull(self, shard: int, local_ids: np.ndarray,
+                   now: float, role: str = "primary") -> np.ndarray:
+        """Gather weight rows from the shard's primary (or follower for
+        hot-key cache refreshes). Raises PSServerFailedError when the
+        addressed replica's server is dead."""
+        primary, follower = self.placement[shard]
+        sid = primary if role == "primary" else follower
+        if sid is None or not self.servers[sid].alive:
+            raise PSServerFailedError(-1 if sid is None else sid,
+                                      shard, f"pull[{role}]")
+        srv = self._op(sid, f"pull[{role}]", shard, now)
+        st = srv.shards[shard]
+        return st.weight[np.asarray(local_ids, np.int64)]
+
+    def apply_push(self, shard: int, local_uids: np.ndarray,
+                   merged_g: np.ndarray, version: int,
+                   now: float) -> float:
+        """Apply pre-merged gradient rows to the shard primary through
+        the SHARED jitted kernels, then ship the CRC-stamped delta to
+        the follower. ``local_uids`` has the client's full static merge
+        length; non-owned slots carry the shard's local sentinel
+        (``num_rows`` of the shard) and are dropped by the scatter.
+        Returns the modeled replication seconds (delta over the DCN)."""
+        import jax.numpy as jnp
+        primary, follower = self.placement[shard]
+        if primary is None or not self.servers[primary].alive:
+            raise PSServerFailedError(
+                -1 if primary is None else primary, shard, "push")
+        srv = self._op(primary, "push", shard, now)
+        st = srv.shards[shard]
+        cfg = self.table
+        bounds = cfg["bounds"] if cfg["bounds"] is not None else (0.0, 0.0)
+        bounded = cfg["bounds"] is not None
+        uids = jnp.asarray(np.asarray(local_uids, np.int32))
+        g = jnp.asarray(np.asarray(merged_g, np.float32))
+        if cfg["rule"] == "naive":
+            st.weight[...] = np.asarray(kernels.apply_naive(
+                jnp.asarray(st.weight), uids, g, cfg["lr"],
+                bounded, *bounds))
+        elif cfg["rule"] == "adagrad":
+            w, s = kernels.apply_adagrad(
+                jnp.asarray(st.weight), jnp.asarray(st.g2sum), uids, g,
+                cfg["lr"], cfg["g0"], bounded, *bounds)
+            st.weight[...] = np.asarray(w)
+            st.g2sum[...] = np.asarray(s)
+        else:
+            w, m, v, p1, p2 = kernels.apply_adam(
+                jnp.asarray(st.weight), jnp.asarray(st.gsum),
+                jnp.asarray(st.g2sum), jnp.asarray(st.beta1_pow),
+                jnp.asarray(st.beta2_pow), uids, g, cfg["lr"],
+                cfg["beta1"], cfg["beta2"], cfg["eps"], bounded, *bounds)
+            st.weight[...] = np.asarray(w)
+            st.gsum[...] = np.asarray(m)
+            st.g2sum[...] = np.asarray(v)
+            st.beta1_pow[...] = np.asarray(p1)
+            st.beta2_pow[...] = np.asarray(p2)
+        st.version = int(version)
+        touched = np.asarray(local_uids, np.int64)
+        touched = touched[touched < st.num_rows]
+        return self._replicate(shard, st, touched, now)
+
+    def _replicate(self, shard: int, primary_state: ShardState,
+                   touched: np.ndarray, now: float) -> float:
+        primary, follower = self.placement[shard]
+        if follower is None or not self.servers[follower].alive:
+            return 0.0
+        delta = primary_state.make_delta(touched)
+        if chaos.maybe_corrupt_shard_delta(delta.payload):
+            ps_flight(event="delta_corrupt", shard=shard,
+                      server=follower, t=float(now))
+        self.traffic.add("ps_delta", delta.nbytes, axes=("dcn",))
+        seconds = sparse_transfer_seconds(delta.nbytes, "dcn",
+                                          link=self.link)
+        fst = self.servers[follower].shards[shard]
+        try:
+            fst.apply_delta(delta, server=follower)
+        except PSReplicaCorruptError:
+            # bytes can't be trusted any more: full-shard resync, never
+            # silent divergence
+            seconds += self._resync(shard, fst, now, reason="corrupt_delta")
+        return seconds
+
+    def _resync(self, shard: int, follower_state: ShardState,
+                now: float, reason: str) -> float:
+        primary_state = self.shard_state(shard, "primary")
+        rp = primary_state.make_resync()
+        follower_state.load_resync(rp)
+        self.resyncs += 1
+        metrics.inc("ps_resyncs_total", reason=reason)
+        self.traffic.add("ps_resync", rp.nbytes, axes=("dcn",))
+        seconds = sparse_transfer_seconds(rp.nbytes, "dcn", link=self.link)
+        self.events.append({"event": "resync", "shard": shard,
+                            "reason": reason, "bytes": rp.nbytes,
+                            "t": float(now)})
+        ps_flight(event="resync", shard=shard, reason=reason,
+                  bytes=rp.nbytes, t=float(now))
+        return seconds
+
+    # -- probe sweeps / failover ----------------------------------------
+    def maybe_probe(self, now: float) -> None:
+        """Lazily-anchored probe cadence (the EngineFailoverRouter /
+        health prober idiom): the first call anchors the sweep clock;
+        each elapsed interval runs one sweep. Failover happens HERE, so
+        detection latency is part of the gated MTTR."""
+        if self._next_probe_t is None:
+            self._next_probe_t = float(now) + self.probe_interval_s
+            return
+        while now >= self._next_probe_t:
+            self.probe_now(self._next_probe_t)
+            self._next_probe_t += self.probe_interval_s
+
+    def probe_now(self, t: float) -> List[HealthReport]:
+        """One sweep: a HealthReport per server; newly-dead servers get
+        their shards failed over (promotion + follower recruit)."""
+        reports, newly_dead = [], []
+        for srv in self.servers:
+            rep = HealthReport(ok=srv.alive, probe="ps_liveness",
+                               reason="" if srv.alive
+                               else f"server {srv.id} unreachable")
+            reports.append(rep)
+            if not rep.ok and srv.id not in self._handled_failures:
+                self._handled_failures.add(srv.id)
+                newly_dead.append(srv.id)
+                metrics.inc("ps_server_failures_total")
+        if newly_dead:
+            self._failover(newly_dead, t)
+        return reports
+
+    def _failover(self, newly_dead: List[int], t: float) -> None:
+        old = dict(self.placement)
+        self.placement = self.ring.placement(self._alive_ids())
+        for shard, (new_p, new_f) in sorted(self.placement.items()):
+            old_p, old_f = old[shard]
+            if new_p != old_p:
+                # the ring guarantees the successor is the old follower:
+                # the data is already on new_p — promotion is placement
+                if shard not in self.servers[new_p].shards:
+                    raise PSError(
+                        f"shard {shard}: promoted server {new_p} holds "
+                        f"no replica — both replicas lost")
+                self.failovers += 1
+                metrics.inc("ps_failovers_total")
+                if old_p in self._kill_t:
+                    self.mttrs.append(float(t) - self._kill_t[old_p])
+                self.events.append({"event": "failover", "shard": shard,
+                                    "old": old_p, "new": new_p,
+                                    "t": float(t)})
+                ps_flight(event="failover", shard=shard, server=new_p,
+                          old_server=old_p, t=float(t))
+            if new_f is not None and shard not in self.servers[new_f].shards:
+                # recruit: the replacement follower starts empty — full
+                # resync from the (possibly just-promoted) primary
+                rows = self.servers[new_p].shards[shard].rows
+                cfg = self.table
+                self.servers[new_f].shards[shard] = ShardState(
+                    shard, rows, cfg["dim"], cfg["rule"],
+                    beta1=cfg["beta1"], beta2=cfg["beta2"])
+                self.repair_s += self._resync(
+                    shard, self.servers[new_f].shards[shard], t,
+                    reason="recruit")
+        for sid in newly_dead:
+            self.servers[sid].shards.clear()
+
+    def last_mttr_s(self) -> float:
+        return max(self.mttrs) if self.mttrs else 0.0
+
+    def quiesce(self, now: float) -> None:
+        """Run one forced sweep so anything dead-but-undetected fails
+        over before the ledger is audited."""
+        self.probe_now(float(now))
+
+    # -- the cross-shard row ledger -------------------------------------
+    def ledger(self) -> Dict[str, Any]:
+        """Exact bookkeeping at drill end: every row owned by exactly
+        one alive primary, the row partition covering range(num_rows)
+        with no overlap, and every follower CRC-equal to its primary."""
+        cfg = self.table
+        rows_seen: List[np.ndarray] = []
+        one_primary = True
+        crc_equal = True
+        for shard in range(self.ring.num_shards):
+            primary, follower = self.placement[shard]
+            if primary is None or not self.servers[primary].alive \
+                    or shard not in self.servers[primary].shards:
+                one_primary = False
+                continue
+            pst = self.servers[primary].shards[shard]
+            rows_seen.append(pst.rows)
+            if follower is not None and self.servers[follower].alive:
+                fst = self.servers[follower].shards.get(shard)
+                if fst is None or fst.crc() != pst.crc():
+                    crc_equal = False
+        allr = (np.concatenate(rows_seen) if rows_seen
+                else np.zeros((0,), np.int64))
+        partition_exact = (len(allr) == cfg["num_rows"]
+                           and len(np.unique(allr)) == len(allr)
+                           and bool(np.array_equal(
+                               np.sort(allr),
+                               np.arange(cfg["num_rows"], dtype=np.int64))))
+        return {"ok": bool(one_primary and partition_exact and crc_equal),
+                "one_primary_per_row": bool(one_primary),
+                "row_partition_exact": bool(partition_exact),
+                "replicas_crc_equal": bool(crc_equal),
+                "shards": self.ring.num_shards,
+                "alive_servers": list(self._alive_ids())}

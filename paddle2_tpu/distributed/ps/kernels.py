@@ -1,0 +1,117 @@
+"""Shared jitted pull / merge / apply kernels for the PS tables.
+
+The single-host :class:`~paddle2_tpu.distributed.ps.SparseTable` and the
+sharded plane (:mod:`.fleet` / :mod:`.client`) both route through the
+programs in this module. That is a correctness requirement, not a
+convenience: the ISSUE 18 transparency gate says a ``staleness=0``
+sharded table must be *step-bitwise* against the single-host table, and
+float bitwise equality only survives when every update runs the exact
+same compiled program shape. The split is therefore:
+
+- :func:`merge_scaled` — ONE client-side SelectedRows merge (duplicate
+  ids summed, gradient divided by the show-scale) producing static-length
+  ``(uids, summed)`` arrays with a sentinel fill. Both paths merge once,
+  at the full batch length.
+- :func:`apply_naive` / :func:`apply_adagrad` / :func:`apply_adam` —
+  the server-side rule applied to pre-merged rows. The sharded plane
+  passes the SAME static-length merged arrays to every shard (non-owned
+  slots carry the shard's local sentinel and are dropped by the
+  ``mode="drop"`` scatter), so each owned row's arithmetic is the same
+  per-row program in both worlds; only the gather/scatter endpoints
+  (full table vs shard slice) differ, and those move bytes exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _clip(w, do_bound, lo, hi):
+    return jnp.clip(w, lo, hi) if do_bound else w
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def pull_count(counts, ids):
+    return counts.at[ids.reshape(-1)].add(1)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def pull_rows(weight, counts, ids, threshold):
+    rows = jnp.take(weight, ids, axis=0)
+    if threshold:
+        live = (jnp.take(counts, ids, axis=0) >= threshold)
+        rows = rows * live[..., None].astype(rows.dtype)
+    return rows
+
+
+def merge_push(ids, grads, sentinel: int):
+    """SelectedRows merge-add: sum gradients of duplicate ids.
+
+    Returns (uids, summed) of the same static length as ``ids``; slots
+    beyond the unique count carry ``sentinel`` (dropped by the scatter).
+    """
+    n = ids.shape[0]
+    uids, inv = jnp.unique(ids, return_inverse=True, size=n,
+                           fill_value=sentinel)
+    summed = jax.ops.segment_sum(grads, inv, num_segments=n)
+    return uids, summed
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def merge_scaled(ids, grads, scale, sentinel):
+    """The client half of a push: show-scale division + duplicate-id
+    merge, jitted standalone so the sharded plane can merge ONCE and
+    route the same merged arrays to every shard."""
+    return merge_push(ids, grads / scale, sentinel)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnums=(4, 5, 6))
+def apply_naive(weight, uids, g, lr, do_bound, lo, hi):
+    cur = jnp.take(weight, jnp.clip(uids, 0, weight.shape[0] - 1), axis=0)
+    new = _clip(cur - lr * g, do_bound, lo, hi)
+    return weight.at[uids].set(new, mode="drop")
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnums=(6, 7, 8))
+def apply_adagrad(weight, g2sum, uids, g, lr, g0, do_bound, lo, hi):
+    n_rows = weight.shape[0]
+    safe = jnp.clip(uids, 0, n_rows - 1)
+    cur_w = jnp.take(weight, safe, axis=0)
+    cur_s = jnp.take(g2sum, safe, axis=0)
+    new_w = cur_w - lr * g * jnp.sqrt(g0 / (g0 + cur_s))[:, None]
+    new_w = _clip(new_w, do_bound, lo, hi)
+    new_s = cur_s + jnp.mean(g * g, axis=-1)
+    return (weight.at[uids].set(new_w, mode="drop"),
+            g2sum.at[uids].set(new_s, mode="drop"))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4),
+                   static_argnums=(11, 12, 13))
+def apply_adam(weight, gsum, g2sum, b1p, b2p, uids, g, lr, b1, b2,
+               eps, do_bound, lo, hi):
+    n_rows = weight.shape[0]
+    safe = jnp.clip(uids, 0, n_rows - 1)
+    w = jnp.take(weight, safe, axis=0)
+    m = jnp.take(gsum, safe, axis=0)
+    v = jnp.take(g2sum, safe, axis=0)
+    p1 = jnp.take(b1p, safe, axis=0)
+    p2 = jnp.take(b2p, safe, axis=0)
+    lr_t = lr * jnp.sqrt(1.0 - p2) / (1.0 - p1)
+    m = b1 * m + (1.0 - b1) * g
+    v = b2 * v + (1.0 - b2) * g * g
+    w = _clip(w - lr_t[:, None] * (m / (jnp.sqrt(v) + eps)),
+              do_bound, lo, hi)
+    return (weight.at[uids].set(w, mode="drop"),
+            gsum.at[uids].set(m, mode="drop"),
+            g2sum.at[uids].set(v, mode="drop"),
+            b1p.at[uids].set(p1 * b1, mode="drop"),
+            b2p.at[uids].set(p2 * b2, mode="drop"))
+
+
+__all__ = ["pull_count", "pull_rows", "merge_push", "merge_scaled",
+           "apply_naive", "apply_adagrad", "apply_adam"]

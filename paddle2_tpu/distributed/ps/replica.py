@@ -1,0 +1,160 @@
+"""Shard replica state + the CRC-stamped delta/resync byte protocol.
+
+The replication discipline is the PR 15 spill-tier one: **bytes, never
+trust** — a delta ships the touched rows' raw float bytes (weights AND
+optimizer state, so a promoted follower resumes the rule mid-stream
+bitwise) plus the touched local ids, all covered by one CRC32 stamp.
+The follower verifies the stamp before applying; a mismatch raises
+:class:`~.errors.PSReplicaCorruptError` and the fleet drops that
+follower to a full-shard resync instead of letting it silently diverge.
+Resync payloads carry the whole shard under the same stamp.
+
+State lives as host numpy arrays: a shard is a modeled remote server,
+so its arrays are the serialization substrate — ``tobytes()`` IS the
+wire format, and two replicas are equal iff their payload CRCs are.
+The update rule itself never runs on these arrays directly; the fleet
+round-trips through the shared jitted kernels (:mod:`.kernels`) so the
+math is bit-identical to the single-host table.
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .errors import PSReplicaCorruptError
+
+__all__ = ["ShardState", "ShardDelta", "ResyncPayload",
+           "RULE_ARRAYS", "crc32"]
+
+# serialization order per rule — fixed, so payload layout is stable
+RULE_ARRAYS: Dict[str, Tuple[str, ...]] = {
+    "naive": ("weight",),
+    "adagrad": ("weight", "g2sum"),
+    "adam": ("weight", "gsum", "g2sum", "beta1_pow", "beta2_pow"),
+}
+
+
+def crc32(payload: bytes) -> int:
+    return zlib.crc32(payload) & 0xFFFFFFFF
+
+
+@dataclass
+class ShardDelta:
+    """Touched rows of one push, as shipped primary -> follower."""
+    shard: int
+    version: int
+    local_ids: bytes        # int32 row indices within the shard
+    payload: bytearray      # concatenated per-array row bytes
+    crc: int                # stamp over local_ids + payload AT SHIP TIME
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.local_ids) + len(self.payload) + 4
+
+
+@dataclass
+class ResyncPayload:
+    """The whole shard, CRC-stamped — recruit and corruption recovery."""
+    shard: int
+    version: int
+    payload: bytes
+    crc: int
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.payload) + 4
+
+
+class ShardState:
+    """One replica of one shard: the shard's rows (sorted global ids)
+    plus per-rule arrays, dimensioned ``(rows, dim)`` / ``(rows,)``."""
+
+    def __init__(self, shard: int, rows: np.ndarray, dim: int,
+                 rule: str, beta1: float = 0.9, beta2: float = 0.999,
+                 init_weight: Optional[np.ndarray] = None):
+        if rule not in RULE_ARRAYS:
+            raise ValueError(f"unknown rule {rule!r}")
+        self.shard = int(shard)
+        self.rows = np.asarray(rows, np.int64).reshape(-1)
+        self.dim = int(dim)
+        self.rule = rule
+        n = len(self.rows)
+        self.weight = (np.array(init_weight, np.float32, copy=True)
+                       if init_weight is not None
+                       else np.zeros((n, self.dim), np.float32))
+        if rule == "adagrad":
+            self.g2sum = np.zeros((n,), np.float32)
+        elif rule == "adam":
+            self.gsum = np.zeros((n, self.dim), np.float32)
+            self.g2sum = np.zeros((n, self.dim), np.float32)
+            self.beta1_pow = np.full((n,), beta1, np.float32)
+            self.beta2_pow = np.full((n,), beta2, np.float32)
+        self.version = 0
+
+    # -- introspection --------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        return len(self.rows)
+
+    def arrays(self) -> Tuple[np.ndarray, ...]:
+        return tuple(getattr(self, name) for name in RULE_ARRAYS[self.rule])
+
+    # -- delta protocol -------------------------------------------------
+    def make_delta(self, local_ids: np.ndarray) -> ShardDelta:
+        """Serialize the given rows of every rule array (ship-side)."""
+        lid = np.asarray(local_ids, np.int32).reshape(-1)
+        ids_b = lid.tobytes()
+        payload = bytearray()
+        for arr in self.arrays():
+            payload += arr[lid].tobytes()
+        return ShardDelta(self.shard, self.version, ids_b, payload,
+                          crc32(ids_b + bytes(payload)))
+
+    def apply_delta(self, delta: ShardDelta, server: int = -1) -> int:
+        """Verify the CRC stamp, then overwrite the named rows. Returns
+        the number of rows applied; raises PSReplicaCorruptError on a
+        stamp mismatch (the corrupt-delta chaos path)."""
+        got = crc32(delta.local_ids + bytes(delta.payload))
+        if got != delta.crc:
+            raise PSReplicaCorruptError(delta.shard, server,
+                                        delta.crc, got)
+        lid = np.frombuffer(delta.local_ids, np.int32)
+        buf = bytes(delta.payload)
+        off = 0
+        for name, arr in zip(RULE_ARRAYS[self.rule], self.arrays()):
+            per_row = arr[0:1].nbytes if arr.ndim > 1 else arr.dtype.itemsize
+            size = per_row * len(lid)
+            chunk = np.frombuffer(buf[off:off + size], arr.dtype)
+            arr[lid] = chunk.reshape((len(lid),) + arr.shape[1:])
+            off += size
+        self.version = delta.version
+        return len(lid)
+
+    # -- full-shard resync ----------------------------------------------
+    def full_payload(self) -> bytes:
+        return b"".join(arr.tobytes() for arr in self.arrays())
+
+    def make_resync(self) -> ResyncPayload:
+        p = self.full_payload()
+        return ResyncPayload(self.shard, self.version, p, crc32(p))
+
+    def load_resync(self, rp: ResyncPayload, server: int = -1) -> None:
+        got = crc32(rp.payload)
+        if got != rp.crc:
+            raise PSReplicaCorruptError(rp.shard, server, rp.crc, got)
+        off = 0
+        for arr in self.arrays():
+            size = arr.nbytes
+            chunk = np.frombuffer(rp.payload[off:off + size], arr.dtype)
+            arr[...] = chunk.reshape(arr.shape)
+            off += size
+        self.version = rp.version
+
+    def crc(self) -> int:
+        """Replica identity: CRC over the full payload — two replicas
+        of a shard are in sync iff their crcs match (the ledger check)."""
+        return crc32(self.full_payload())

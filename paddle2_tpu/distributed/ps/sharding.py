@@ -1,0 +1,115 @@
+"""Stable hash ring: rows -> shards -> (primary, follower) servers.
+
+Two layers, both pure functions of the configuration (never of arrival
+order), so every worker computes the same routing without coordination:
+
+1. **row -> shard** is a fixed hash (:func:`stable_hash64` mod
+   ``num_shards``) — it NEVER changes, so the cross-shard row ledger
+   ("every row owned by exactly one primary") is closed by construction
+   and auditable by re-hashing.
+2. **shard -> servers** is consistent hashing: each server projects
+   ``vnodes`` points onto a 64-bit ring; a shard's primary is the first
+   *alive* server clockwise from the shard's own point, its follower
+   the next *distinct* alive server. The property the failover plane
+   leans on: when a server dies, the first distinct successor — exactly
+   the shard's current follower — becomes the new primary, so promotion
+   is a placement recomputation, not a data move; only the recruited
+   replacement follower needs a resync. Shards whose primary survives
+   keep their placement bit-for-bit (minimal disruption).
+
+Python's builtin ``hash`` is process-seeded (PYTHONHASHSEED) and would
+break cross-run determinism, hence the explicit splitmix64.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, FrozenSet, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["stable_hash64", "HashRing"]
+
+_MASK = (1 << 64) - 1
+
+
+def stable_hash64(x: int, seed: int = 0) -> int:
+    """splitmix64 of ``x`` (salted by ``seed``): deterministic across
+    processes and runs, well-mixed enough that row->shard assignment is
+    near-uniform even for dense integer id ranges."""
+    z = (int(x) + 0x9E3779B97F4A7C15 * (int(seed) + 1)) & _MASK
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK
+    return z ^ (z >> 31)
+
+
+class HashRing:
+    """Consistent-hash placement of ``num_shards`` shards over
+    ``num_servers`` modeled PS servers, with one follower per shard."""
+
+    def __init__(self, num_servers: int, num_shards: Optional[int] = None,
+                 vnodes: int = 16, seed: int = 0):
+        if num_servers < 2:
+            raise ValueError(
+                f"HashRing needs >= 2 servers for primary+follower "
+                f"replication, got {num_servers}")
+        self.num_servers = int(num_servers)
+        self.num_shards = int(num_shards if num_shards is not None
+                              else 2 * num_servers)
+        self.seed = int(seed)
+        points: List[Tuple[int, int]] = []
+        for s in range(self.num_servers):
+            for v in range(int(vnodes)):
+                points.append(
+                    (stable_hash64(s * 1_000_003 + v, seed=seed + 1), s))
+        points.sort()
+        self._points = points
+        self._keys = [p for p, _ in points]
+        # each shard's own ring point (where its clockwise walk starts)
+        self._shard_points = [stable_hash64(sh, seed=seed + 2)
+                              for sh in range(self.num_shards)]
+
+    # -- row -> shard ---------------------------------------------------
+    def shard_of_row(self, row_id: int) -> int:
+        return stable_hash64(row_id, seed=self.seed) % self.num_shards
+
+    def shard_of_rows(self, row_ids: np.ndarray) -> np.ndarray:
+        """Vectorized :meth:`shard_of_row` (same values, one pass)."""
+        return np.array([self.shard_of_row(int(r))
+                         for r in np.asarray(row_ids).reshape(-1)],
+                        dtype=np.int64)
+
+    def rows_of_shard(self, shard: int, num_rows: int) -> np.ndarray:
+        """Sorted global row ids this shard owns out of
+        ``range(num_rows)`` — the audit inverse of shard_of_row."""
+        return np.array([r for r in range(int(num_rows))
+                         if self.shard_of_row(r) == int(shard)],
+                        dtype=np.int64)
+
+    # -- shard -> servers -----------------------------------------------
+    def owners(self, shard: int,
+               alive: Iterable[int]) -> Tuple[int, Optional[int]]:
+        """(primary, follower) for ``shard`` given the alive set: the
+        first alive server clockwise from the shard's point, then the
+        next distinct alive server (None when only one survives)."""
+        alive_set = frozenset(int(a) for a in alive)
+        if not alive_set:
+            raise ValueError(f"shard {shard}: no alive servers")
+        start = bisect.bisect_left(self._keys, self._shard_points[shard])
+        n = len(self._points)
+        primary: Optional[int] = None
+        for i in range(n):
+            srv = self._points[(start + i) % n][1]
+            if srv not in alive_set:
+                continue
+            if primary is None:
+                primary = srv
+            elif srv != primary:
+                return primary, srv
+        return primary, None  # type: ignore[return-value]
+
+    def placement(self, alive: Iterable[int]
+                  ) -> Dict[int, Tuple[int, Optional[int]]]:
+        alive_f: FrozenSet[int] = frozenset(int(a) for a in alive)
+        return {sh: self.owners(sh, alive_f)
+                for sh in range(self.num_shards)}

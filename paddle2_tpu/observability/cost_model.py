@@ -290,6 +290,36 @@ class LinkModel:
         return float(bytes_on_wire) / bw + self.latency(axes)
 
 
+def sparse_transfer_seconds(wire_bytes: float, link_class: str = "dcn",
+                            link: Optional["LinkModel"] = None,
+                            dispatches: int = 1,
+                            host_gbps: Optional[float] = None) -> float:
+    """α+β time of point-to-point sparse traffic (PS pull/push/delta)
+    under one named link class, priced from the SAME LinkModel the
+    collectives use so a sparse byte and a dense byte never drift.
+
+    - ``"host"``: a worker talking to its co-located server — the
+      PCIe-class :func:`host_link_bps` channel, no dispatch α (no
+      fabric rendezvous on-host).
+    - ``"dcn"`` / ``"ici"``: remote server — LinkModel bandwidth plus
+      its per-dispatch latency, ``dispatches`` times (a pull fanning
+      out to k remote shards pays k setups, not one).
+    """
+    if wire_bytes <= 0 and link_class == "host":
+        return 0.0
+    if link_class == "host":
+        return float(wire_bytes) / host_link_bps(host_gbps)
+    link = link or LinkModel()
+    if link_class == "dcn":
+        bw, alpha = link.dcn_bps, link.dcn_latency_s
+    elif link_class == "ici":
+        bw, alpha = link.ici_bps, link.ici_latency_s
+    else:
+        raise ValueError(f"unknown link class {link_class!r} "
+                         "(expected host/ici/dcn)")
+    return float(wire_bytes) / bw + alpha * max(1, int(dispatches))
+
+
 class CollectiveTraffic:
     """Accumulator of per-step collective dispatches -> wire bytes and
     a deterministic transfer-time estimate.
@@ -679,7 +709,8 @@ def step_cost_of_program(program, link: Optional[LinkModel] = None
 
 __all__ = ["CHIP_PEAKS", "CHIP_HBM_GB", "chip_peak", "chip_hbm_gb",
            "cost_analysis_of", "program_cost",
-           "abstractify", "wire_bytes", "LinkModel", "CollectiveTraffic",
+           "abstractify", "wire_bytes", "sparse_transfer_seconds",
+           "LinkModel", "CollectiveTraffic",
            "StepCost", "PhasedStepCost", "step_cost_of_program",
            "pipeline_bubble_fraction",
            "DEFAULT_ICI_GBPS", "DEFAULT_DCN_GBPS",

@@ -202,6 +202,7 @@ def diagnose(dumps: Dict[int, Dict[str, Any]],
         "nodes": {r: dumps[r]["header"].get("node") for r in ranks},
         "sdc": [],
         "serving": {},
+        "ps": {},
     }
     # serving plane (PR 11): scheduler admit/evict/requeue/shed, engine
     # decode steps, failures/failovers, and hot-swap events — per-event
@@ -220,6 +221,22 @@ def diagnose(dumps: Dict[int, Dict[str, Any]],
     if serving_counts:
         report["serving"] = {"counts": serving_counts,
                              "last": serving_tail[-10:]}
+    # parameter-server plane (ISSUE 18): pull/push spans plus the
+    # failure narrative (server_kill -> stale_read/retry -> failover ->
+    # resync), each span carrying shard + server ids so a dead drill is
+    # attributable to a specific modeled host
+    ps_counts: Dict[str, int] = {}
+    ps_tail: List[Dict[str, Any]] = []
+    for r in ranks:
+        for ev in dumps[r]["events"]:
+            if ev.get("kind") != "ps":
+                continue
+            name = ev.get("event", "?")
+            ps_counts[name] = ps_counts.get(name, 0) + 1
+            ps_tail.append({"rank": r, **{k: v for k, v in ev.items()
+                                          if k != "kind"}})
+    if ps_counts:
+        report["ps"] = {"counts": ps_counts, "last": ps_tail[-10:]}
     # SDC evidence: fingerprint-vote mismatches and self-evictions the
     # workers recorded. Deduped by (rank, step) — every voter records
     # the same verdict; the report wants the verdict once per witness.
@@ -479,9 +496,40 @@ def format_report(report: Dict[str, Any], directory: str) -> str:
                          f"(step time > {_STRAGGLER_K:g} x median)")
 
     L.extend(_format_serving(report))
+    L.extend(_format_ps(report))
     L.extend(_format_quarantine(report))
     L.extend(_format_elastic_timeline(report))
     return "\n".join(L)
+
+
+def _format_ps(report: Dict[str, Any]) -> List[str]:
+    """PARAMETER SERVER section: the sharded-table plane's spans —
+    pull/push volume plus the failure narrative (``server_kill`` ->
+    ``stale_read``/retry -> ``failover`` -> ``resync``). The shard and
+    server ids lead each event so a drill post-mortem attributes every
+    promotion and resync to a specific modeled host."""
+    psr = report.get("ps") or {}
+    if not psr:
+        return []
+    L = ["PARAMETER SERVER"]
+    counts = psr.get("counts") or {}
+    L.append("  events: " + " ".join(f"{k}={counts[k]}"
+                                     for k in sorted(counts)))
+    for ev in (psr.get("last") or [])[-10:]:
+        rank = ev.get("rank", "?")
+        lead = []
+        if "shard" in ev:
+            lead.append(f"shard={ev['shard']}")
+        if "server" in ev:
+            lead.append(f"server={ev['server']}")
+        if "t" in ev:
+            lead.append(f"t={ev['t']:.9f}")
+        detail = " ".join(f"{k}={ev[k]}" for k in sorted(ev)
+                          if k not in ("rank", "event", "shard",
+                                       "server", "t"))
+        L.append(f"  rank {rank}: {ev.get('event', '?')} "
+                 + " ".join(lead + [detail]).strip())
+    return L
 
 
 def _format_serving(report: Dict[str, Any]) -> List[str]:

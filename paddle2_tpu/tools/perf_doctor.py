@@ -88,6 +88,13 @@ _RELIABILITY_COUNTERS = (
     # failovers resuming without re-prefill
     "serving_kv_spill_blocks_total", "serving_kv_fetch_host_blocks_total",
     "serving_kv_fetch_peer_blocks_total", "serving_kv_migrated_blocks_total",
+    # parameter-server plane (ISSUE 18): pull/push volume, server
+    # failures vs failovers (they should pair 1:1 per dead primary),
+    # stale reads (bounded-staleness degradation, not an error — but a
+    # surge means shards are re-forming), resyncs (corrupt deltas or
+    # follower recruits), and the staleness gauge
+    "ps_pulls_total", "ps_pushes_total", "ps_server_failures_total",
+    "ps_failovers_total", "ps_stale_reads_total", "ps_resyncs_total",
 )
 
 

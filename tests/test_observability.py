@@ -133,7 +133,11 @@ class TestStepWindows:
         t = threading.Thread(target=hammer, daemon=True)
         t.start()
         try:
-            for _ in range(200):
+            # 50 snapshot flushes against an unthrottled inserter give
+            # thousands of mid-iteration upsert chances; more steps only
+            # grow the (quadratic) snapshot-serialization cost, not the
+            # race window
+            for _ in range(50):
                 pl.step_end()               # flushes a snapshot each step
         finally:
             stop.set()

@@ -165,3 +165,102 @@ def test_push_empty_and_bad_rank_ids():
     np.testing.assert_array_equal(np.asarray(t.weight), before)
     with pytest.raises(ValueError, match="1-D"):
         t.push(np.array([[1], [2]], np.int32), np.ones((2, 1, 2), np.float32))
+
+
+# -- ISSUE 18 satellite: reference-math + protocol coverage ------------
+
+def test_merge_push_sums_duplicates_with_sentinel_padding():
+    import jax.numpy as jnp
+    ids = jnp.array([3, 7, 3, 1], jnp.int32)
+    g = jnp.arange(8, dtype=jnp.float32).reshape(4, 2)
+    uids, summed = ps._merge_push(ids, g, sentinel=32)
+    uids, summed = np.asarray(uids), np.asarray(summed)
+    assert uids.shape == (4,) and summed.shape == (4, 2)  # static length
+    # unique ids sorted first, then sentinel fill
+    np.testing.assert_array_equal(uids, [1, 3, 7, 32])
+    np.testing.assert_allclose(summed[0], g[3])
+    np.testing.assert_allclose(summed[1], np.asarray(g[0]) + np.asarray(g[2]))
+    np.testing.assert_allclose(summed[2], g[1])
+
+
+def test_naive_rule_matches_numpy_reference_sequence():
+    t = ps.SparseTable(16, 4, rule="naive", lr=0.3, initial_range=0.2,
+                       seed=11)
+    w = np.asarray(t.weight).copy()
+    rng = np.random.RandomState(5)
+    for step in range(4):
+        ids = rng.randint(0, 16, size=6)
+        g = rng.randn(6, 4).astype(np.float32)
+        t.push(ids, g, scale=2.0)
+        merged = np.zeros((16, 4), np.float32)
+        np.add.at(merged, ids, g / np.float32(2.0))
+        touched = np.unique(ids)
+        w[touched] -= np.float32(0.3) * merged[touched]
+    np.testing.assert_allclose(np.asarray(t.weight), w, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_adam_rule_matches_numpy_reference_sequence():
+    b1, b2, eps, lr = 0.9, 0.999, 1e-8, 0.05
+    t = ps.SparseTable(12, 3, rule="adam", lr=lr, beta1=b1, beta2=b2,
+                       epsilon=eps, initial_range=0.1, seed=9)
+    w = np.asarray(t.weight).copy().astype(np.float64)
+    m = np.zeros((12, 3)); v = np.zeros((12, 3))
+    p1 = np.full(12, b1); p2 = np.full(12, b2)
+    rng = np.random.RandomState(6)
+    for step in range(3):
+        ids = rng.randint(0, 12, size=5)
+        g = rng.randn(5, 3).astype(np.float32)
+        t.push(ids, g)
+        merged = np.zeros((12, 3))
+        np.add.at(merged, ids, g.astype(np.float64))
+        for r in np.unique(ids):
+            lr_t = lr * np.sqrt(1 - p2[r]) / (1 - p1[r])
+            m[r] = b1 * m[r] + (1 - b1) * merged[r]
+            v[r] = b2 * v[r] + (1 - b2) * merged[r] ** 2
+            w[r] -= lr_t * m[r] / (np.sqrt(v[r]) + eps)
+            p1[r] *= b1
+            p2[r] *= b2
+    np.testing.assert_allclose(np.asarray(t.weight), w, rtol=1e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(t.beta1_pow), p1, rtol=1e-5)
+
+
+def test_pull_update_show_false_does_not_tick_counts():
+    t = ps.SparseTable(8, 2, rule="naive", initial_range=0.3,
+                       entry_threshold=2, seed=5)
+    ids = np.array([4], np.int32)
+    for _ in range(5):
+        rows = np.asarray(t.pull(ids, update_show=False))
+        np.testing.assert_array_equal(rows, 0.0)  # count never advances
+    assert int(np.asarray(t.counts)[4]) == 0
+    t.pull(ids)
+    t.pull(ids)
+    assert int(np.asarray(t.counts)[4]) == 2  # show path ticks
+
+
+def test_state_dict_roundtrip_is_bitwise():
+    t = ps.SparseTable(16, 4, rule="adam", initial_range=0.1, seed=2)
+    t.push(np.array([3, 3, 9], np.int32), np.ones((3, 4), np.float32))
+    state = {k: np.asarray(v) for k, v in t.state_dict().items()}
+    t2 = ps.SparseTable(16, 4, rule="adam")
+    t2.set_state_dict(state)
+    for k, v in t2.state_dict().items():
+        assert np.asarray(v).tobytes() == state[k].tobytes(), k
+
+
+def test_dense_adam_matches_numpy_reference_sequence():
+    b1, b2, eps, lr = 0.9, 0.999, 1e-8, 0.1
+    d = ps.DenseTable([3], rule="adam", lr=lr, beta1=b1, beta2=b2,
+                      epsilon=eps)
+    val = np.zeros(3); m = np.zeros(3); v = np.zeros(3)
+    rng = np.random.RandomState(4)
+    for step in range(1, 5):
+        g = rng.randn(3).astype(np.float32)
+        d.push(g)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2 ** step) / (1 - b1 ** step)
+        val -= lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(np.asarray(d.pull()), val, rtol=1e-4,
+                               atol=1e-6)
