@@ -11,8 +11,11 @@ from . import serving_reliability   # noqa: F401  (side-effect import)
 from . import fleet_kv              # noqa: F401
 from . import million_user_day      # noqa: F401
 from . import ps_recommender        # noqa: F401
+from . import moe_training          # noqa: F401
 from . import sdc                   # noqa: F401
 from . import elastic               # noqa: F401
+from . import reliable_step         # noqa: F401
+from . import single_chip_speed     # noqa: F401
 
 run_scenario = run
 

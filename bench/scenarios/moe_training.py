@@ -1,0 +1,353 @@
+"""Scenario: fault-tolerant expert-parallel MoE training (ISSUE 19).
+
+A GShard top-k MoE layer trained through the expert-parallel plane —
+expert weights sharded over modeled hosts by a stable hash ring,
+replicated primary+follower, the routed all-to-all priced per link
+class from the step's EXACT dispatch decisions — everything on the
+virtual cost-model clock (ZERO wall-clock; run twice, the artifact is
+byte-identical).
+
+Drills and gates:
+  1. **Transparency** — the fleet-mediated plane replays the same trace
+     as a plain single-host training loop: per-step loss CRC chains AND
+     final expert weights must be bitwise.
+  2. **Host-kill failover** — ``kill_expert_host`` chaos mid-trace: the
+     follower is promoted at the next probe sweep (MTTR inside the
+     2x-probe-interval budget), the interrupted step replays BITWISE vs
+     the clean twin through ReliableStep (the transactional store means
+     an aborted step commits nothing), and the cross-host expert ledger
+     closes exactly (every expert owned by one alive primary, replicas
+     CRC-equal).
+  3. **Token conservation** — the dispatch ledger (routed +
+     capacity-dropped + residual-passthrough == total tokens, per step
+     per expert) closes after EVERY step of EVERY drill, chaos
+     included.
+  4. **α-dominance, gated both ways** — at these per-expert payloads
+     the DCN dispatch α dominates the a2a: the hierarchical
+     slice-bucketed schedule must fit the per-step dispatch budget and
+     the flat rank-pair schedule must FAIL it (the lever is
+     load-bearing, not decorative).
+  5. **Capacity, gated both ways** — a generous capacity factor routes
+     every pick (zero drops); a tight one MUST drop, deterministically
+     counted, with the ledger still closing.
+  6. **Router health** — a rigged collapsed router (all tokens on two
+     experts) trips the entropy-floor watchdog inside its window with
+     the typed RouterCollapseError; aux and z losses match the float64
+     numpy reference.
+  7. **Degraded twin** — the same kill drill with the probe sweep
+     slowed 50x must FAIL at least one gate (the gates measure the
+     recovery machinery, not the weather).
+"""
+
+import numpy as np
+
+from ..artifact import bench_scratch, log
+from . import registry
+
+E, M, S, K = 8, 16, 32, 2
+HOSTS, HOSTS_PER_SLICE = 4, 2
+PROBE_S = 0.02
+STEPS = 4
+CF = 4.0                    # generous default: routes every pick
+A2A_BUDGET_S = 1e-3         # per-step dispatch budget (4 DCN alphas)
+
+
+def build(scenario):
+    import zlib
+    import paddle2_tpu as paddle
+    import paddle2_tpu.optimizer as opt
+    from paddle2_tpu.distributed import mesh as mesh_mod
+    from paddle2_tpu.distributed.fault_tolerance import chaos
+    from paddle2_tpu.distributed.moe_fleet import (
+        ExpertHostFleet, ExpertParallelMoE, RouterCollapseError,
+        params_crc)
+    from paddle2_tpu.incubate.moe import (MoELayer, router_reference_f64)
+    from paddle2_tpu.nn import functional as F
+    from paddle2_tpu.observability import metrics
+    from paddle2_tpu.observability.cost_model import LinkModel
+
+    mesh_mod.init_mesh({"dp": 1})
+    metrics_dir = bench_scratch("moe_training_metrics",
+                                env_var=scenario.streams["metrics"])
+    link = LinkModel(ici_latency_us=1.0, dcn_latency_us=250.0)
+
+    def make_layer(capacity_factor=CF):
+        paddle.seed(0)
+        experts = [paddle.nn.Linear(M, M) for _ in range(E)]
+        return MoELayer(M, experts, top_k=K,
+                        capacity_factor=capacity_factor)
+
+    def make_plane(capacity_factor=CF, probe_interval_s=PROBE_S,
+                   a2a_mode="hierarchical"):
+        layer = make_layer(capacity_factor)
+        o = opt.SGD(learning_rate=0.05, parameters=layer.parameters())
+        fleet = ExpertHostFleet(
+            num_hosts=HOSTS, num_experts=E,
+            hosts_per_slice=HOSTS_PER_SLICE,
+            probe_interval_s=probe_interval_s, link=link, seed=0)
+        return ExpertParallelMoE(layer, o, fleet, link=link,
+                                 aux_weight=0.01, a2a_mode=a2a_mode)
+
+    def trace(seed=7):
+        rng = np.random.RandomState(seed)
+        return (rng.randn(S, M).astype(np.float32),
+                rng.randn(S, M).astype(np.float32))
+
+    def crc(b):
+        return zlib.crc32(b) & 0xFFFFFFFF
+
+    def expert_crcs(layer):
+        return [params_crc({k: np.asarray(v.numpy())
+                            for k, v in ex.state_dict().items()})
+                for ex in layer.experts]
+
+    xv, yv = trace()
+    metrics.enable(metrics_dir, rank=0, flush_steps=1)
+    gates = {}
+
+    # -- drill 1: fleet transparency vs a single-host twin -------------
+    plane = make_plane()
+    chain_plane = 0
+    drops_total = 0
+    spent = 0.0
+    for _ in range(STEPS):
+        loss = plane.train_step(paddle.to_tensor(xv.copy()),
+                                paddle.to_tensor(yv.copy()))
+        chain_plane = crc(np.int64(chain_plane).tobytes()
+                          + loss.numpy().tobytes())
+        drops_total += int(plane.layer.last_stats["dropped_picks"])
+        # stamp the virtual step cost as the modeled step lane so
+        # perf_doctor diff verdicts ride it (exactly 0% across runs)
+        metrics.step_end(
+            modeled_step_s=round(plane.clock.t - spent, 12), tokens=S)
+        spent = plane.clock.t
+
+    twin = make_layer()
+    o = opt.SGD(learning_rate=0.05, parameters=twin.parameters())
+    chain_twin = 0
+    for _ in range(STEPS):
+        out = twin(paddle.to_tensor(xv.copy()))
+        loss = F.mse_loss(out, paddle.to_tensor(yv.copy())) \
+            + twin.aux_loss * 0.01
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        chain_twin = crc(np.int64(chain_twin).tobytes()
+                         + loss.numpy().tobytes())
+    gates["sync_parity_bitwise"] = bool(
+        chain_plane == chain_twin
+        and expert_crcs(plane.layer) == expert_crcs(twin))
+    gates["generous_capacity_no_drops"] = bool(
+        drops_total == 0 and all(plane.ledgers_ok))
+    clean_chain, clean_crcs = chain_plane, expert_crcs(plane.layer)
+    log(f"moe-training parity: chain {chain_plane:#010x} vs "
+        f"{chain_twin:#010x} drops={drops_total} "
+        f"a2a={plane.a2a_counts}")
+
+    # -- drill 2: host-kill failover vs the clean twin -----------------
+    def kill_drill(probe_interval_s):
+        p = make_plane(probe_interval_s=probe_interval_s)
+        victim = sorted({p.fleet.primary_of(e) for e in range(E)})[0]
+        owned = sum(1 for e in range(E)
+                    if p.fleet.primary_of(e) == victim)
+        # victim ops/step = fetch + store per owned expert; fire on
+        # step 3's FIRST op (a fetch: nothing of the step is committed)
+        nth = 2 * 2 * owned + 1
+        chaos.arm(f"kill_expert_host:{nth}:{victim}")
+        chain = 0
+        try:
+            for _ in range(STEPS):
+                loss = p.train_step(paddle.to_tensor(xv.copy()),
+                                    paddle.to_tensor(yv.copy()))
+                chain = crc(np.int64(chain).tobytes()
+                            + loss.numpy().tobytes())
+            fired = [k for k, _ in chaos.fired_log()]
+        finally:
+            chaos.disarm()
+        p.fleet.quiesce(p.clock.t)
+        return {
+            "fired": "kill_expert_host" in fired,
+            "victim": victim,
+            "retries": p.reliable.stats["retries"],
+            "mttr_s": p.fleet.last_mttr_s(),
+            "failovers": p.fleet.failovers,
+            "resyncs": p.fleet.resyncs,
+            "ledger": p.fleet.ledger(),
+            "token_ledgers_ok": bool(all(p.ledgers_ok)
+                                     and len(p.ledgers_ok) == STEPS),
+            "bitwise_vs_clean": bool(
+                chain == clean_chain
+                and expert_crcs(p.layer) == clean_crcs),
+        }
+
+    mttr_budget_s = 2.0 * PROBE_S  # from the BASE probe interval
+    kd = kill_drill(PROBE_S)
+    gates["kill_fired_and_replayed"] = bool(
+        kd["fired"] and kd["retries"] >= 1 and kd["failovers"] >= 1)
+    gates["kill_mttr_within_budget"] = bool(
+        kd["fired"] and 0.0 < kd["mttr_s"] <= mttr_budget_s)
+    gates["kill_bitwise_vs_clean"] = bool(kd["bitwise_vs_clean"])
+    gates["expert_ledger_closes"] = bool(kd["ledger"]["ok"])
+    gates["token_ledger_closes_after_chaos"] = bool(
+        kd["token_ledgers_ok"] and all(plane.ledgers_ok))
+    log(f"moe-training kill: victim=host{kd['victim']} "
+        f"mttr={kd['mttr_s']*1e3:.3f}ms (budget "
+        f"{mttr_budget_s*1e3:.1f}ms) retries={kd['retries']} "
+        f"failovers={kd['failovers']} bitwise={kd['bitwise_vs_clean']}")
+
+    # -- drill 3: a2a alpha-dominance, gated both ways -----------------
+    flat = make_plane(a2a_mode="flat")
+    for _ in range(2):
+        flat.train_step(paddle.to_tensor(xv.copy()),
+                        paddle.to_tensor(yv.copy()))
+    hier_step_s = float(np.mean(plane.dispatch_seconds))
+    flat_step_s = float(np.mean(flat.dispatch_seconds))
+    gates["hierarchical_a2a_within_budget"] = bool(
+        0.0 < hier_step_s <= A2A_BUDGET_S)
+    gates["flat_a2a_fails_budget"] = bool(flat_step_s > A2A_BUDGET_S)
+    log(f"moe-training a2a: hier={hier_step_s*1e6:.1f}us/step "
+        f"({plane.a2a_counts}) flat={flat_step_s*1e6:.1f}us/step "
+        f"({flat.a2a_counts}) budget={A2A_BUDGET_S*1e6:.0f}us")
+
+    # -- drill 4: tight capacity must drop, counted, ledger closes -----
+    tight = make_plane(capacity_factor=0.25)
+    tight_drops = 0
+    for _ in range(2):
+        tight.train_step(paddle.to_tensor(xv.copy()),
+                         paddle.to_tensor(yv.copy()))
+        tight_drops += int(tight.layer.last_stats["dropped_picks"])
+    gates["tight_capacity_drops_counted"] = bool(
+        tight_drops > 0 and all(tight.ledgers_ok))
+    log(f"moe-training capacity: cf=0.25 "
+        f"cap={tight.layer.last_stats['capacity']} "
+        f"dropped_picks={tight_drops} ledgers={all(tight.ledgers_ok)}")
+
+    # -- drill 5: router collapse trips the typed watchdog -------------
+    # S identical tokens: every step routes the WHOLE batch to one
+    # top-1/top-2 expert pair (identical logits rows), so the load
+    # histogram stays two-hot no matter how the router weights move —
+    # the deterministic stand-in for a collapsed gate
+    rigged = make_plane()
+    xc = np.tile(xv[:1], (S, 1))
+    collapse = None
+    collapse_steps = 0
+    try:
+        for _ in range(rigged.watchdog.window + 1):
+            rigged.train_step(paddle.to_tensor(xc.copy()),
+                              paddle.to_tensor(yv.copy()))
+            collapse_steps += 1
+    except RouterCollapseError as e:
+        collapse = e
+    gates["router_collapse_detected"] = bool(
+        collapse is not None
+        and collapse_steps + 1 == rigged.watchdog.window
+        and collapse.entropy < rigged.watchdog.entropy_floor)
+    log(f"moe-training router: collapse after "
+        f"{collapse_steps + 1} steps "
+        f"H={getattr(collapse, 'entropy', -1.0):.4f} "
+        f"(floor {rigged.watchdog.entropy_floor})")
+
+    # -- drill 6: aux/z losses vs the float64 numpy reference ----------
+    ref_layer = make_layer()
+    xt = paddle.to_tensor(xv.copy())
+    aux_t, z_t = ref_layer.gate.router_losses(xt)
+    logits = ref_layer.gate.wg(xt).numpy()
+    ref = router_reference_f64(logits, K, ref_layer.gate.capacity(S))
+    aux_err = abs(float(aux_t.numpy()) - ref["aux"])
+    z_err = abs(float(z_t.numpy()) - ref["z_loss"])
+    gates["aux_loss_matches_f64_reference"] = bool(
+        aux_err <= 1e-4 * max(1.0, abs(ref["aux"]))
+        and z_err <= 1e-4 * max(1.0, abs(ref["z_loss"])))
+    log(f"moe-training router losses: aux_err={aux_err:.2e} "
+        f"z_err={z_err:.2e}")
+
+    # -- drill 7: the degraded twin must fail --------------------------
+    kd_slow = kill_drill(50.0 * PROBE_S)
+    degraded_gates = {
+        "kill_mttr_within_budget": bool(
+            kd_slow["fired"]
+            and 0.0 < kd_slow["mttr_s"] <= mttr_budget_s),
+        "kill_bitwise_vs_clean": bool(kd_slow["bitwise_vs_clean"]),
+        "expert_ledger_closes": bool(kd_slow["ledger"]["ok"]),
+    }
+    gates["degraded_twin_fails"] = not all(degraded_gates.values())
+    log(f"moe-training degraded twin: "
+        f"mttr={kd_slow['mttr_s']*1e3:.1f}ms gates={degraded_gates} "
+        f"-> fails={gates['degraded_twin_fails']}")
+
+    metrics.flush()
+    metrics.export_prometheus()
+    metrics.disable()
+
+    return {
+        "metric": "moe_training_drills",
+        "value": sum(bool(v) for v in gates.values()),
+        "unit": "gates_passed",
+        "moe": {"experts": E, "d_model": M, "tokens": S, "top_k": K,
+                "capacity_factor": CF,
+                "capacity": make_layer().gate.capacity(S)},
+        "fleet": {"hosts": HOSTS, "hosts_per_slice": HOSTS_PER_SLICE,
+                  "probe_interval_us": round(PROBE_S * 1e6, 3)},
+        "parity": {"loss_crc_chain": chain_plane,
+                   "single_host_crc_chain": chain_twin},
+        "kill": {
+            "victim": kd["victim"],
+            "mttr_us": round(kd["mttr_s"] * 1e6, 3),
+            "mttr_budget_us": round(mttr_budget_s * 1e6, 3),
+            "retries": kd["retries"],
+            "failovers": kd["failovers"],
+            "resyncs": kd["resyncs"],
+            "ledger": kd["ledger"],
+        },
+        "a2a": {
+            "hier_step_us": round(hier_step_s * 1e6, 3),
+            "flat_step_us": round(flat_step_s * 1e6, 3),
+            "budget_us": round(A2A_BUDGET_S * 1e6, 3),
+            "hier_dispatches": plane.a2a_counts,
+            "flat_dispatches": flat.a2a_counts,
+        },
+        "capacity": {
+            "generous_dropped_picks": drops_total,
+            "tight_capacity": int(tight.layer.last_stats["capacity"]),
+            "tight_dropped_picks": tight_drops,
+        },
+        "router": {
+            "collapse_step": collapse_steps + 1,
+            "collapse_entropy": round(
+                getattr(collapse, "entropy", -1.0), 6),
+            "entropy_floor": rigged.watchdog.entropy_floor,
+            "healthy_entropy": round(plane.watchdog.entropies[0], 6),
+            "aux_err": round(aux_err, 9),
+            "z_err": round(z_err, 9),
+        },
+        "degraded_twin": {
+            "probe_slowdown": 50.0,
+            "mttr_us": round(kd_slow["mttr_s"] * 1e6, 3),
+            "gates": degraded_gates,
+        },
+        "gates": gates,
+    }
+
+
+SCENARIO = registry.register(registry.Scenario(
+    name="moe-training",
+    artifact="MOE_TRAINING_r01.json",
+    build=build,
+    description="fault-tolerant expert-parallel MoE: hash-ring expert "
+                "placement, host-kill failover with bitwise replay, "
+                "priced hierarchical a2a dispatch, router-collapse "
+                "watchdog, exact token-conservation ledger",
+    model={"experts": E, "d_model": M, "top_k": K,
+           "capacity_factor": CF},
+    parallelism={"expert_hosts": HOSTS,
+                 "hosts_per_slice": HOSTS_PER_SLICE},
+    trace={"tokens": S, "steps": STEPS, "seed": 7},
+    gates=("sync_parity_bitwise", "generous_capacity_no_drops",
+           "kill_fired_and_replayed", "kill_mttr_within_budget",
+           "kill_bitwise_vs_clean", "expert_ledger_closes",
+           "token_ledger_closes_after_chaos",
+           "hierarchical_a2a_within_budget", "flat_a2a_fails_budget",
+           "tight_capacity_drops_counted", "router_collapse_detected",
+           "aux_loss_matches_f64_reference", "degraded_twin_fails"),
+    streams={"metrics": "BENCH_MOE_TRAINING_METRICS_DIR"},
+))

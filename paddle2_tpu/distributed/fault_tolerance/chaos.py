@@ -79,7 +79,8 @@ KINDS = ("corrupt_shard", "truncate_shard", "fail_commit", "poison_loss",
          "stall_collective", "kill_rank", "flip_bits",
          "kill_engine", "drop_decode_step", "corrupt_block_table",
          "corrupt_spill_block", "drop_migration",
-         "kill_ps_server", "corrupt_shard_delta", "drop_push")
+         "kill_ps_server", "corrupt_shard_delta", "drop_push",
+         "kill_expert_host")
 
 _FLIP_WHERES = ("grads", "collective")
 
@@ -667,6 +668,27 @@ def maybe_kill_ps_server(server_id: int, op: str = "?") -> bool:
     return False
 
 
+def maybe_kill_expert_host(host_id: int, op: str = "?") -> bool:
+    """Expert-parallel MoE fleet hook (ISSUE 19), called on every op an
+    expert host handles (weight fetch at step start, CRC-replicated
+    store after the optimizer applies): True when THIS host must die
+    now. The occurrence counter ticks only on the victim host (the
+    ``kill_ps_server`` idiom — param names the victim, default host 0),
+    so ``nth`` means "the victim's nth op". The fleet marks the host
+    dead; its experts' buddies are promoted at the next probe sweep and
+    the interrupted step replays through ``ReliableStep``."""
+    if _ACTIVE is None or not _ACTIVE.armed("kill_expert_host"):
+        return False
+    hid = int(host_id)
+    sp = _ACTIVE.should_fire(
+        "kill_expert_host",
+        gate=lambda s: hid == (0 if s.param is None else int(s.param)))
+    if sp is not None:
+        _ACTIVE.record("kill_expert_host", f"host{hid}:{op}")
+        return True
+    return False
+
+
 def maybe_corrupt_shard_delta(payload) -> bool:
     """PS replication hook: flip one byte of a primary->follower shard
     delta AFTER its CRC was stamped — the deterministic stand-in for a
@@ -729,5 +751,5 @@ __all__ = ["ChaosInjector", "arm", "disarm", "active", "fired_log",
            "maybe_drop_decode_step", "maybe_corrupt_block_table",
            "maybe_corrupt_spill_block", "maybe_drop_migration",
            "maybe_kill_ps_server", "maybe_corrupt_shard_delta",
-           "maybe_drop_push",
+           "maybe_drop_push", "maybe_kill_expert_host",
            "CORRUPT_BLOCK_ID", "KINDS"]

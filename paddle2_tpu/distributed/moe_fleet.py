@@ -1,0 +1,623 @@
+"""The fault-tolerant expert-parallel MoE training plane.
+
+Single-process stand-ins for N expert hosts driven entirely by the
+caller's virtual clock (``now`` arguments) — no wall-clock anywhere, so
+every drill on this plane is bit-reproducible. The reliability contract
+mirrors the PR 17 parameter-server fleet, applied to MoE experts:
+
+- every expert's weights live on a **primary** and a **follower** host
+  (consistent-hash placement, :class:`~.ps.sharding.HashRing`); the
+  transactional post-step store commits to the primary and ships a
+  full-copy replica to the follower, priced on the fabric between their
+  slices;
+- a dead host is detected at the next **probe sweep**
+  (:meth:`ExpertHostFleet.maybe_probe` — the lazily-anchored cadence of
+  ``health.py``), so detection latency is INSIDE the gated MTTR;
+- promotion is a placement recomputation: the ring guarantees the dead
+  primary's first distinct successor is exactly the current follower,
+  so the bytes are already there; only the replacement follower pays a
+  full-copy resync (priced per link class);
+- ``kill_expert_host`` chaos enters through the same per-op gate as
+  every real op (:meth:`ExpertHostFleet._op`), raising the typed
+  :class:`ExpertHostFailedError` — a ``TransientStepError`` — so a
+  :class:`~.fault_tolerance.reliable.ReliableStep`-wrapped step replays
+  BITWISE once the probe sweep heals the placement;
+- the router is watched: a per-expert load histogram whose normalized
+  entropy stays under the floor for ``window`` consecutive steps raises
+  the typed, flight-recorded :class:`RouterCollapseError` (NOT
+  transient — retrying a collapsed router wastes the fleet);
+- token conservation is EXACT: the dispatch ledger
+  (:func:`~..incubate.moe.token_ledger_closes`) must close after every
+  step, chaos included.
+
+The all-to-all dispatch/combine is priced from the step's actual
+routing decisions (an exact per-pair byte matrix) through
+:meth:`CollectiveTraffic.add_all_to_all_matrix`: α dominates at small
+per-expert payloads, so the hierarchical slice-bucketing lever is
+load-bearing and the flat configuration is required to FAIL the lane's
+dispatch budget — the PR 14 discipline.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability import metrics
+from ..observability.cost_model import (CollectiveTraffic, LinkModel,
+                                        sparse_transfer_seconds)
+from .fault_tolerance import chaos
+from .fault_tolerance.health import HealthReport
+from .fault_tolerance.reliable import ReliableStep, TransientStepError
+from .ps.client import VirtualClock
+from .ps.sharding import HashRing
+
+__all__ = ["MoEPlaneError", "ExpertHostFailedError", "RouterCollapseError",
+           "ExpertHost", "ExpertHostFleet", "RouterWatchdog",
+           "ExpertParallelMoE", "moe_flight", "params_crc",
+           "price_all_to_all"]
+
+
+def moe_flight(**fields) -> None:
+    """One shared emitter for every MoE flight-recorder span
+    (``kind="moe"``): host kills, failovers, resyncs, router collapse,
+    ledger violations — rendered by flight_doctor's MoE section.
+    None-valued fields are dropped; the recorder keeps its
+    one-attribute-load no-op when disabled."""
+    from .fault_tolerance import flight_recorder
+    flight_recorder.record("moe", **{k: v for k, v in fields.items()
+                                     if v is not None})
+
+
+class MoEPlaneError(RuntimeError):
+    """Base for expert-parallel plane failures."""
+
+
+class ExpertHostFailedError(MoEPlaneError, TransientStepError):
+    """An expert host died under an op. Transient: the probe sweep
+    recomputes the placement (the follower already holds the bytes), so
+    a ReliableStep retry after backoff replays the step bitwise."""
+
+    def __init__(self, host: int, expert: int = -1, op: str = "?"):
+        self.host, self.expert, self.op = int(host), int(expert), op
+        MoEPlaneError.__init__(
+            self, f"expert host {host} failed during {op!r}"
+            + (f" (expert {expert})" if expert >= 0 else ""))
+
+
+class RouterCollapseError(MoEPlaneError):
+    """The router degenerated: per-expert load entropy stayed under the
+    floor for ``window`` consecutive steps. NOT transient — replaying
+    the step reproduces the same logits; the fix is a training-recipe
+    change (aux-loss weight, z-loss, router LR), so this propagates."""
+
+    def __init__(self, step: int, entropy: float, floor: float,
+                 window: int):
+        self.step, self.entropy = int(step), float(entropy)
+        self.floor, self.window = float(floor), int(window)
+        super().__init__(
+            f"router collapse at step {step}: normalized load entropy "
+            f"{entropy:.4f} < floor {floor:.4f} for {window} "
+            f"consecutive steps")
+
+
+def params_crc(params: Dict[str, np.ndarray]) -> int:
+    """Order-independent CRC32 over a named param dict — the
+    replica-equality check the fleet ledger audits."""
+    crc = 0
+    for name in sorted(params):
+        crc = zlib.crc32(name.encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(params[name]).tobytes(),
+                         crc)
+    return crc
+
+
+def _params_nbytes(params: Dict[str, np.ndarray]) -> int:
+    return int(sum(int(np.asarray(a).nbytes) for a in params.values()))
+
+
+def price_all_to_all(pair_bytes: np.ndarray, ranks_per_slice: int,
+                     link: Optional[LinkModel] = None,
+                     hierarchical: bool = False
+                     ) -> Tuple[float, Dict[str, int], CollectiveTraffic]:
+    """Price ONE routed all-to-all from its exact per-pair byte matrix:
+    returns ``(seconds, {"ici": n, "dcn": n} dispatch counts, traffic)``
+    so callers can advance the virtual clock, gate α-dominance, and
+    merge the entries into a fleet-wide ledger."""
+    link = link or LinkModel()
+    t = CollectiveTraffic()
+    counts = t.add_all_to_all_matrix(pair_bytes, ranks_per_slice,
+                                     hierarchical=hierarchical)
+    return t.seconds(link), counts, t
+
+
+class ExpertHost:
+    """One modeled host: alive flag + the expert replicas it currently
+    holds (primary AND follower roles — the fleet's placement says
+    which is which)."""
+
+    def __init__(self, host_id: int):
+        self.id = int(host_id)
+        self.alive = True
+        self.experts: Dict[int, Dict[str, np.ndarray]] = {}
+        self.ops = 0
+
+
+class ExpertHostFleet:
+    """N modeled expert hosts serving one MoE layer's expert weights.
+    All methods take the caller's virtual ``now``. Hosts are grouped
+    into ICI slices of ``hosts_per_slice`` consecutive ids; traffic
+    between slices rides the DCN."""
+
+    def __init__(self, num_hosts: int = 4, num_experts: int = 8,
+                 hosts_per_slice: int = 2,
+                 probe_interval_s: float = 0.02,
+                 link: Optional[LinkModel] = None, seed: int = 0):
+        if probe_interval_s <= 0:
+            raise ValueError(
+                f"probe_interval_s must be > 0, got {probe_interval_s}")
+        self.ring = HashRing(num_hosts, num_shards=num_experts, seed=seed)
+        self.hosts = [ExpertHost(i) for i in range(int(num_hosts))]
+        self.num_hosts = int(num_hosts)
+        self.num_experts = int(num_experts)
+        self.hosts_per_slice = max(1, int(hosts_per_slice))
+        self.probe_interval_s = float(probe_interval_s)
+        self.link = link or LinkModel()
+        self.traffic = CollectiveTraffic()
+        self.placement: Dict[int, Tuple[int, Optional[int]]] = {}
+        self.events: List[Dict[str, Any]] = []
+        self.mttrs: List[float] = []
+        self.repair_s = 0.0
+        self.resyncs = 0
+        self.failovers = 0
+        self._next_probe_t: Optional[float] = None
+        self._kill_t: Dict[int, float] = {}
+        self._handled_failures: set = set()
+
+    # -- placement ------------------------------------------------------
+    def _alive_ids(self) -> Tuple[int, ...]:
+        return tuple(h.id for h in self.hosts if h.alive)
+
+    def slice_of(self, host_id: int) -> int:
+        return int(host_id) // self.hosts_per_slice
+
+    def _link_class(self, a: int, b: int) -> str:
+        """Link class of a transfer between two hosts: co-located ⇒
+        the PCIe-class host channel (no fabric α), same slice ⇒ ICI,
+        cross-slice ⇒ DCN."""
+        if a == b:
+            return "host"
+        return "ici" if self.slice_of(a) == self.slice_of(b) else "dcn"
+
+    def primary_of(self, expert: int) -> int:
+        primary, _ = self.placement[int(expert)]
+        if primary is None:
+            raise MoEPlaneError(f"expert {expert} has no primary")
+        return primary
+
+    def worker_of(self, expert: int) -> int:
+        """The compute rank an expert's batch is materialized on —
+        the fixed round-robin home, independent of where the WEIGHTS
+        currently live (failover moves weights, not compute)."""
+        return int(expert) % self.num_hosts
+
+    def attach_experts(self,
+                       init_params: Dict[int, Dict[str, np.ndarray]]
+                       ) -> None:
+        """Place primary+follower replicas of every expert's initial
+        weights on the ring placement."""
+        if self.placement:
+            raise MoEPlaneError("experts already attached to this fleet")
+        if len(init_params) != self.num_experts:
+            raise MoEPlaneError(
+                f"expected {self.num_experts} experts, got "
+                f"{len(init_params)}")
+        self.placement = self.ring.placement(self._alive_ids())
+        for e in range(self.num_experts):
+            params = {k: np.asarray(v).copy()
+                      for k, v in init_params[e].items()}
+            primary, follower = self.placement[e]
+            for hid in (primary, follower):
+                if hid is None:
+                    continue
+                self.hosts[hid].experts[e] = {
+                    k: v.copy() for k, v in params.items()}
+
+    # -- liveness / chaos entry of every op -----------------------------
+    def _op(self, hid: int, op: str, expert: int, now: float
+            ) -> ExpertHost:
+        host = self.hosts[hid]
+        host.ops += 1
+        if chaos.maybe_kill_expert_host(hid, op=op):
+            self.kill_host(hid, now)
+        if not host.alive:
+            raise ExpertHostFailedError(hid, expert, op)
+        return host
+
+    def kill_host(self, hid: int, now: float) -> None:
+        host = self.hosts[hid]
+        if not host.alive:
+            return
+        host.alive = False
+        self._kill_t[hid] = float(now)
+        self.events.append({"event": "host_kill", "host": hid,
+                            "t": float(now)})
+        moe_flight(event="host_kill", host=hid, t=float(now))
+
+    # -- serving --------------------------------------------------------
+    def fetch(self, expert: int, now: float
+              ) -> Tuple[Dict[str, np.ndarray], float]:
+        """Pull an expert's weights from its primary to its compute
+        rank at step start. Returns ``(params copy, modeled seconds)``;
+        raises the typed transient when the primary is dead (or chaos
+        kills it under this very op)."""
+        primary, _ = self.placement[int(expert)]
+        if primary is None or not self.hosts[primary].alive:
+            raise ExpertHostFailedError(
+                -1 if primary is None else primary, expert, "fetch")
+        host = self._op(primary, "fetch", expert, now)
+        params = {k: v.copy() for k, v in host.experts[expert].items()}
+        nbytes = _params_nbytes(params)
+        cls = self._link_class(primary, self.worker_of(expert))
+        self.traffic.add("moe_fetch", nbytes,
+                         axes=("dcn",) if cls == "dcn" else ("ici",),
+                         group_size=2)
+        seconds = sparse_transfer_seconds(nbytes, cls, link=self.link)
+        metrics.inc("moe_expert_fetches_total")
+        return params, seconds
+
+    def store_all(self, updates: Dict[int, Dict[str, np.ndarray]],
+                  now: float) -> float:
+        """TRANSACTIONAL post-step commit of every expert's updated
+        weights: phase 1 walks each primary through the per-op
+        chaos/liveness gate WITHOUT writing, phase 2 commits primaries
+        and ships follower replicas. A host death in phase 1 aborts the
+        whole transaction with nothing written, so the ReliableStep
+        replay restarts from exactly the pre-step fleet state — the
+        property the bitwise-vs-clean-twin gate rests on."""
+        staged: List[Tuple[int, int, Optional[int],
+                           Dict[str, np.ndarray]]] = []
+        seconds = 0.0
+        for e in sorted(updates):
+            primary, follower = self.placement[e]
+            if primary is None or not self.hosts[primary].alive:
+                raise ExpertHostFailedError(
+                    -1 if primary is None else primary, e, "store")
+            self._op(primary, "store", e, now)
+            staged.append((e, primary, follower, updates[e]))
+        for e, primary, follower, params in staged:
+            clean = {k: np.asarray(v).copy() for k, v in params.items()}
+            nbytes = _params_nbytes(clean)
+            wcls = self._link_class(self.worker_of(e), primary)
+            self.traffic.add(
+                "moe_store", nbytes,
+                axes=("dcn",) if wcls == "dcn" else ("ici",),
+                group_size=2)
+            seconds += sparse_transfer_seconds(nbytes, wcls,
+                                               link=self.link)
+            self.hosts[primary].experts[e] = clean
+            metrics.inc("moe_expert_stores_total")
+            if follower is not None and self.hosts[follower].alive:
+                rcls = self._link_class(primary, follower)
+                self.traffic.add(
+                    "moe_replica", nbytes,
+                    axes=("dcn",) if rcls == "dcn" else ("ici",),
+                    group_size=2)
+                seconds += sparse_transfer_seconds(nbytes, rcls,
+                                                   link=self.link)
+                self.hosts[follower].experts[e] = {
+                    k: v.copy() for k, v in clean.items()}
+        return seconds
+
+    # -- probe sweeps / failover ----------------------------------------
+    def maybe_probe(self, now: float) -> None:
+        """Lazily-anchored probe cadence (the health-prober idiom): the
+        first call anchors the sweep clock; each elapsed interval runs
+        one sweep. Failover happens HERE, so detection latency is part
+        of the gated MTTR."""
+        if self._next_probe_t is None:
+            self._next_probe_t = float(now) + self.probe_interval_s
+            return
+        while now >= self._next_probe_t:
+            self.probe_now(self._next_probe_t)
+            self._next_probe_t += self.probe_interval_s
+
+    def probe_now(self, t: float) -> List[HealthReport]:
+        """One sweep: a HealthReport per host; newly-dead hosts get
+        their experts failed over (promotion + follower recruit)."""
+        reports, newly_dead = [], []
+        for host in self.hosts:
+            rep = HealthReport(ok=host.alive, probe="moe_liveness",
+                               reason="" if host.alive
+                               else f"expert host {host.id} unreachable")
+            reports.append(rep)
+            if not rep.ok and host.id not in self._handled_failures:
+                self._handled_failures.add(host.id)
+                newly_dead.append(host.id)
+                metrics.inc("moe_expert_host_failures_total")
+        if newly_dead:
+            self._failover(newly_dead, t)
+        return reports
+
+    def _failover(self, newly_dead: List[int], t: float) -> None:
+        old = dict(self.placement)
+        self.placement = self.ring.placement(self._alive_ids())
+        for e, (new_p, new_f) in sorted(self.placement.items()):
+            old_p, old_f = old[e]
+            if new_p != old_p:
+                # the ring guarantees the successor is the old
+                # follower: the bytes are already on new_p — promotion
+                # is a placement recomputation, not a copy
+                if e not in self.hosts[new_p].experts:
+                    raise MoEPlaneError(
+                        f"expert {e}: promoted host {new_p} holds no "
+                        f"replica — both replicas lost")
+                self.failovers += 1
+                metrics.inc("moe_failovers_total")
+                if old_p in self._kill_t:
+                    self.mttrs.append(float(t) - self._kill_t[old_p])
+                self.events.append({"event": "failover", "expert": e,
+                                    "old": old_p, "new": new_p,
+                                    "t": float(t)})
+                moe_flight(event="failover", expert=e, host=new_p,
+                           old_host=old_p, t=float(t))
+            if new_f is not None \
+                    and e not in self.hosts[new_f].experts:
+                # recruit: the replacement follower starts empty — a
+                # full-copy resync from the (possibly just-promoted)
+                # primary, priced on the fabric between their slices
+                self.repair_s += self._resync(e, new_p, new_f, t,
+                                              reason="recruit")
+        for hid in newly_dead:
+            self.hosts[hid].experts.clear()
+
+    def _resync(self, expert: int, src: int, dst: int, t: float,
+                reason: str) -> float:
+        params = {k: v.copy()
+                  for k, v in self.hosts[src].experts[expert].items()}
+        self.hosts[dst].experts[expert] = params
+        nbytes = _params_nbytes(params)
+        cls = self._link_class(src, dst)
+        self.resyncs += 1
+        metrics.inc("moe_resyncs_total", reason=reason)
+        self.traffic.add("moe_resync", nbytes,
+                         axes=("dcn",) if cls == "dcn" else ("ici",),
+                         group_size=2)
+        seconds = sparse_transfer_seconds(nbytes, cls, link=self.link)
+        self.events.append({"event": "resync", "expert": expert,
+                            "reason": reason, "bytes": nbytes,
+                            "t": float(t)})
+        moe_flight(event="resync", expert=expert, reason=reason,
+                   bytes=nbytes, t=float(t))
+        return seconds
+
+    def last_mttr_s(self) -> float:
+        return max(self.mttrs) if self.mttrs else 0.0
+
+    def quiesce(self, now: float) -> None:
+        """Run one forced sweep so anything dead-but-undetected fails
+        over before the ledger is audited."""
+        self.probe_now(float(now))
+
+    # -- the cross-host expert ledger -----------------------------------
+    def ledger(self) -> Dict[str, Any]:
+        """Exact bookkeeping at drill end: every expert owned by
+        exactly one alive primary, the expert partition covering
+        range(num_experts), and every follower CRC-equal to its
+        primary."""
+        owned: List[int] = []
+        one_primary = True
+        crc_equal = True
+        for e in range(self.num_experts):
+            primary, follower = self.placement[e]
+            if primary is None or not self.hosts[primary].alive \
+                    or e not in self.hosts[primary].experts:
+                one_primary = False
+                continue
+            owned.append(e)
+            pp = self.hosts[primary].experts[e]
+            if follower is not None and self.hosts[follower].alive:
+                fp = self.hosts[follower].experts.get(e)
+                if fp is None or params_crc(fp) != params_crc(pp):
+                    crc_equal = False
+        partition_exact = (sorted(owned)
+                           == list(range(self.num_experts)))
+        return {"ok": bool(one_primary and partition_exact
+                           and crc_equal),
+                "one_primary_per_expert": bool(one_primary),
+                "expert_partition_exact": bool(partition_exact),
+                "replicas_crc_equal": bool(crc_equal),
+                "experts": self.num_experts,
+                "alive_hosts": list(self._alive_ids())}
+
+
+class RouterWatchdog:
+    """Router-collapse detection on the virtual clock: per-expert load
+    histogram → normalized entropy (f64, base ``num_experts``); under
+    the floor for ``window`` CONSECUTIVE steps raises the typed
+    :class:`RouterCollapseError` before a degenerate gate silently
+    wastes the fleet. One healthy step resets the streak."""
+
+    def __init__(self, num_experts: int, entropy_floor: float = 0.35,
+                 window: int = 3):
+        if not 0.0 <= entropy_floor <= 1.0:
+            raise ValueError(
+                f"entropy_floor must be in [0, 1], got {entropy_floor}")
+        self.num_experts = int(num_experts)
+        self.entropy_floor = float(entropy_floor)
+        self.window = max(1, int(window))
+        self.entropies: List[float] = []
+        self._streak = 0
+
+    @staticmethod
+    def normalized_entropy(load: np.ndarray) -> float:
+        """H(load) / log(E) in float64: 1.0 = perfectly balanced,
+        0.0 = every token on one expert. An all-zero histogram (no
+        tokens routed at all) is maximal collapse."""
+        p = np.asarray(load, np.float64)
+        total = p.sum()
+        if total <= 0:
+            return 0.0
+        p = p / total
+        nz = p[p > 0]
+        h = float(-(nz * np.log(nz)).sum())
+        return h / float(np.log(len(p))) if len(p) > 1 else 1.0
+
+    def observe(self, load_per_expert: np.ndarray, now: float,
+                step: int) -> float:
+        h = self.normalized_entropy(load_per_expert)
+        self.entropies.append(h)
+        if h < self.entropy_floor:
+            self._streak += 1
+        else:
+            self._streak = 0
+        if self._streak >= self.window:
+            metrics.inc("moe_router_collapses_total")
+            moe_flight(event="router_collapse", step=int(step),
+                       entropy=round(h, 6),
+                       floor=self.entropy_floor, t=float(now))
+            raise RouterCollapseError(step, h, self.entropy_floor,
+                                      self.window)
+        return h
+
+
+class ExpertParallelMoE:
+    """The expert-parallel training plane: wires a
+    :class:`~..incubate.moe.MoELayer` to an :class:`ExpertHostFleet`
+    and drives each step through :class:`ReliableStep` on a virtual
+    clock.
+
+    One step = fetch every expert's weights from its primary (priced),
+    forward/backward/optimizer on the layer (loss = task + aux·w),
+    price the routed all-to-all from the step's EXACT dispatch ledger,
+    transactionally store the updated experts (primary + follower
+    replica), then audit: token-conservation ledger + router watchdog.
+    ``ExpertHostFailedError`` anywhere in the step aborts it with
+    nothing committed; the injected ``sleep`` advances the virtual
+    clock THROUGH the fleet's probe cadence, so backoff is also when
+    failover detection happens — MTTR is modeled, not elided."""
+
+    def __init__(self, layer: Any, optimizer: Any,
+                 fleet: ExpertHostFleet,
+                 link: Optional[LinkModel] = None,
+                 aux_weight: float = 0.01,
+                 a2a_mode: str = "hierarchical",
+                 entropy_floor: float = 0.35, watchdog_window: int = 3,
+                 retry_base_s: float = 0.02, max_retries: int = 8,
+                 retry_budget: int = 32):
+        if a2a_mode not in ("hierarchical", "flat"):
+            raise ValueError(f"a2a_mode={a2a_mode!r}")
+        self.layer = layer
+        self.optimizer = optimizer
+        self.fleet = fleet
+        self.link = link or fleet.link
+        self.aux_weight = float(aux_weight)
+        self.a2a_mode = a2a_mode
+        # the ledger needs the routing pieces on host — opt the layer in
+        self.layer.collect_stats = True
+        self.watchdog = RouterWatchdog(layer.num_experts,
+                                       entropy_floor=entropy_floor,
+                                       window=watchdog_window)
+        self.clock = VirtualClock()
+        self.reliable = ReliableStep(
+            model=layer, optimizer=optimizer, snapshot_every=1,
+            max_retries=max_retries, retry_budget=retry_budget,
+            base_delay=retry_base_s, max_delay=2.0, check_finite=False,
+            sleep=self._sleep)
+        self.step_no = 0
+        self._last_a2a_s = 0.0
+        self.dispatch_seconds: List[float] = []
+        self.a2a_counts = {"ici": 0, "dcn": 0}
+        self.ledgers_ok: List[bool] = []
+        self.last_pair_bytes: Optional[np.ndarray] = None
+        fleet.attach_experts({
+            e: {k: np.asarray(v.numpy()).copy()
+                for k, v in expert.state_dict().items()}
+            for e, expert in enumerate(layer.experts)})
+
+    # backoff sleeps advance the virtual clock THROUGH the probe
+    # cadence: waiting is when the prober finds the corpse
+    def _sleep(self, seconds: float) -> None:
+        self.clock.advance(seconds)
+        self.fleet.maybe_probe(self.clock.t)
+
+    def train_step(self, x: Any, y: Any) -> Any:
+        out = self.reliable.run(self._step_fn, x, y)
+        self.step_no += 1
+        return out
+
+    def _step_fn(self, x: Any, y: Any) -> Any:
+        from ..nn import functional as F
+        fleet, layer, clock = self.fleet, self.layer, self.clock
+        fleet.maybe_probe(clock.t)
+        # fetch: every expert's weights from its current primary
+        for e, expert in enumerate(layer.experts):
+            params, secs = fleet.fetch(e, clock.t)
+            clock.advance(secs)
+            expert.set_state_dict(params)
+        out = layer(x)
+        loss = F.mse_loss(out, y) + layer.aux_loss * self.aux_weight
+        loss.backward()
+        self.optimizer.step()
+        self.optimizer.clear_grad()
+        stats = layer.last_stats
+        secs, counts = self._price_dispatch(stats)
+        clock.advance(secs)
+        self._last_a2a_s = secs
+        # transactional commit; ExpertHostFailedError in its liveness
+        # phase leaves the fleet at pre-step bytes → bitwise replay
+        store_s = fleet.store_all({
+            e: {k: np.asarray(v.numpy())
+                for k, v in expert.state_dict().items()}
+            for e, expert in enumerate(layer.experts)}, clock.t)
+        clock.advance(store_s)
+        self._account(stats, counts)
+        return loss
+
+    def _price_dispatch(self, stats: Dict[str, Any]
+                        ) -> Tuple[float, Dict[str, int]]:
+        """Exact per-pair byte matrix of this step's dispatch+combine:
+        token source rank = contiguous split of the flat token batch
+        over hosts; destination = the chosen expert's CURRENT primary
+        (failover visibly reroutes traffic). Each kept pick pays its
+        row both ways (dispatch there, combine back)."""
+        idx = np.asarray(stats["idx"])                          # [k, S]
+        keep = np.asarray(stats["keep"])                        # [k, S]
+        H = self.fleet.num_hosts
+        k, S = idx.shape
+        row_bytes = float(self.layer.d_model * 4)               # f32 row
+        src = (np.arange(S, dtype=np.int64) * H) // S           # [S]
+        prim = np.asarray([self.fleet.primary_of(e)
+                           for e in range(self.fleet.num_experts)],
+                          np.int64)
+        pair = np.zeros((H, H), np.float64)
+        for j in range(k):
+            kj = keep[j]
+            dst = prim[idx[j][kj]]
+            np.add.at(pair, (src[kj], dst), row_bytes)
+            np.add.at(pair, (dst, src[kj]), row_bytes)
+        self.last_pair_bytes = pair
+        seconds, counts, t = price_all_to_all(
+            pair, self.fleet.hosts_per_slice, link=self.link,
+            hierarchical=(self.a2a_mode == "hierarchical"))
+        self.fleet.traffic.entries.extend(t.entries)
+        return seconds, counts
+
+    def _account(self, stats: Dict[str, Any],
+                 counts: Dict[str, int]) -> None:
+        from ..incubate.moe import token_ledger_closes
+        self.dispatch_seconds.append(self._last_a2a_s)
+        self.a2a_counts["ici"] += counts["ici"]
+        self.a2a_counts["dcn"] += counts["dcn"]
+        ok = token_ledger_closes(stats)
+        self.ledgers_ok.append(ok)
+        if not ok:
+            moe_flight(event="ledger_violation", step=self.step_no,
+                       t=self.clock.t)
+        metrics.inc("moe_steps_total")
+        # router health last: a collapse propagates OUT of the step
+        # (non-transient), after the ledger has already been audited
+        self.watchdog.observe(np.asarray(stats["assigned_per_expert"]),
+                              self.clock.t, self.step_no)

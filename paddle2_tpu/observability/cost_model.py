@@ -365,6 +365,73 @@ class CollectiveTraffic:
         self.add("all_gather", payload, axes=ici_axes,
                  group_size=ici_n, overlappable=overlappable)
 
+    def add_all_to_all_matrix(self, pair_bytes: Sequence[Sequence[float]],
+                              ranks_per_slice: int,
+                              ici_axes: Sequence[str] = ("ici",),
+                              dcn_axes: Sequence[str] = ("dcn",),
+                              hierarchical: bool = False,
+                              op: str = "moe_a2a",
+                              overlappable: bool = False
+                              ) -> Dict[str, int]:
+        """Price one token-routing all-to-all from an EXACT per-pair
+        byte matrix (``pair_bytes[src][dst]``, diagonal ignored) — the
+        MoE dispatch/combine case, where the payload each rank owes each
+        expert host is known from the step's routing decisions rather
+        than assumed uniform. Ranks are grouped into ICI slices of
+        ``ranks_per_slice`` consecutive ranks; a pair within a slice
+        rides ICI, a cross-slice pair rides DCN.
+
+        - **flat**: one point-to-point dispatch per nonzero pair — every
+          cross-slice pair pays its own DCN α. At small per-expert
+          payloads (a few KB of routed tokens) the α term dominates:
+          this is the configuration the lane requires to FAIL.
+        - **hierarchical**: cross-slice payloads are bucketed per
+          (src slice, dst slice) — each contributing rank forwards its
+          chunk to the slice egress over ICI, ONE DCN dispatch carries
+          the whole bucket, and the destination slice scatters it over
+          ICI. Same bytes on the DCN, slice-pair-many α's instead of
+          rank-pair-many (the ``add_hierarchical_all_reduce`` trade,
+          applied to a2a).
+
+        Returns the dispatch counts per link class (``{"ici": n,
+        "dcn": n}``) so a lane can gate α-dominance explicitly. Entries
+        use ``group_size=2`` so the point-to-point payload is charged in
+        full (``group_size=1`` means "no wire" to :func:`wire_bytes`).
+        """
+        n = len(pair_bytes)
+        rps = max(1, int(ranks_per_slice))
+        counts = {"ici": 0, "dcn": 0}
+
+        def _p2p(suffix: str, b: float, axes: Sequence[str],
+                 cls: str) -> None:
+            self.add(f"{op}_{suffix}", b, axes=axes, group_size=2,
+                     overlappable=overlappable)
+            counts[cls] += 1
+
+        buckets: Dict[Tuple[int, int], float] = {}
+        for i in range(n):
+            for j in range(n):
+                if i == j:
+                    continue
+                b = float(pair_bytes[i][j])
+                if b <= 0:
+                    continue
+                si, sj = i // rps, j // rps
+                if si == sj:
+                    _p2p("p2p", b, ici_axes, "ici")
+                elif not hierarchical:
+                    _p2p("p2p", b, dcn_axes, "dcn")
+                else:
+                    # slice-local gather hop to the egress rank, then
+                    # the mirrored scatter hop at the destination; the
+                    # DCN bucket itself is added once per slice pair
+                    _p2p("gather_ici", b, ici_axes, "ici")
+                    _p2p("scatter_ici", b, ici_axes, "ici")
+                    buckets[(si, sj)] = buckets.get((si, sj), 0.0) + b
+        for (_si, _sj), b in sorted(buckets.items()):
+            _p2p("bucket", b, dcn_axes, "dcn")
+        return counts
+
     def wire_bytes_total(self) -> float:
         return sum(e["wire_bytes"] for e in self.entries)
 

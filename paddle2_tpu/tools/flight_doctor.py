@@ -203,6 +203,7 @@ def diagnose(dumps: Dict[int, Dict[str, Any]],
         "sdc": [],
         "serving": {},
         "ps": {},
+        "moe": {},
     }
     # serving plane (PR 11): scheduler admit/evict/requeue/shed, engine
     # decode steps, failures/failovers, and hot-swap events — per-event
@@ -237,6 +238,22 @@ def diagnose(dumps: Dict[int, Dict[str, Any]],
                                           if k != "kind"}})
     if ps_counts:
         report["ps"] = {"counts": ps_counts, "last": ps_tail[-10:]}
+    # expert-parallel MoE plane (ISSUE 19): the failure narrative
+    # (host_kill -> failover -> resync), router_collapse trips, and
+    # ledger_violation markers, each span carrying expert + host ids so
+    # a dead drill is attributable to a specific modeled expert host
+    moe_counts: Dict[str, int] = {}
+    moe_tail: List[Dict[str, Any]] = []
+    for r in ranks:
+        for ev in dumps[r]["events"]:
+            if ev.get("kind") != "moe":
+                continue
+            name = ev.get("event", "?")
+            moe_counts[name] = moe_counts.get(name, 0) + 1
+            moe_tail.append({"rank": r, **{k: v for k, v in ev.items()
+                                           if k != "kind"}})
+    if moe_counts:
+        report["moe"] = {"counts": moe_counts, "last": moe_tail[-10:]}
     # SDC evidence: fingerprint-vote mismatches and self-evictions the
     # workers recorded. Deduped by (rank, step) — every voter records
     # the same verdict; the report wants the verdict once per witness.
@@ -497,6 +514,7 @@ def format_report(report: Dict[str, Any], directory: str) -> str:
 
     L.extend(_format_serving(report))
     L.extend(_format_ps(report))
+    L.extend(_format_moe(report))
     L.extend(_format_quarantine(report))
     L.extend(_format_elastic_timeline(report))
     return "\n".join(L)
@@ -527,6 +545,37 @@ def _format_ps(report: Dict[str, Any]) -> List[str]:
         detail = " ".join(f"{k}={ev[k]}" for k in sorted(ev)
                           if k not in ("rank", "event", "shard",
                                        "server", "t"))
+        L.append(f"  rank {rank}: {ev.get('event', '?')} "
+                 + " ".join(lead + [detail]).strip())
+    return L
+
+
+def _format_moe(report: Dict[str, Any]) -> List[str]:
+    """EXPERT-PARALLEL MOE section: the expert-fleet plane's spans —
+    the failure narrative (``host_kill`` -> ``failover`` -> ``resync``)
+    plus ``router_collapse`` and ``ledger_violation`` markers. The
+    expert and host ids lead each event so a drill post-mortem
+    attributes every promotion and resync to a specific modeled expert
+    host."""
+    mr = report.get("moe") or {}
+    if not mr:
+        return []
+    L = ["EXPERT-PARALLEL MOE"]
+    counts = mr.get("counts") or {}
+    L.append("  events: " + " ".join(f"{k}={counts[k]}"
+                                     for k in sorted(counts)))
+    for ev in (mr.get("last") or [])[-10:]:
+        rank = ev.get("rank", "?")
+        lead = []
+        if "expert" in ev:
+            lead.append(f"expert={ev['expert']}")
+        if "host" in ev:
+            lead.append(f"host={ev['host']}")
+        if "t" in ev:
+            lead.append(f"t={ev['t']:.9f}")
+        detail = " ".join(f"{k}={ev[k]}" for k in sorted(ev)
+                          if k not in ("rank", "event", "expert",
+                                       "host", "t"))
         L.append(f"  rank {rank}: {ev.get('event', '?')} "
                  + " ".join(lead + [detail]).strip())
     return L

@@ -95,6 +95,16 @@ _RELIABILITY_COUNTERS = (
     # follower recruits), and the staleness gauge
     "ps_pulls_total", "ps_pushes_total", "ps_server_failures_total",
     "ps_failovers_total", "ps_stale_reads_total", "ps_resyncs_total",
+    # expert-parallel MoE plane (ISSUE 19): routed vs capacity-dropped
+    # picks (a drop surge is a capacity-factor/balance problem, not an
+    # error — the ledger still closes), host failures vs failovers
+    # (pair per dead primary), resyncs (follower recruits), and router
+    # collapses (typed watchdog trips — ALWAYS worth reading back)
+    "moe_steps_total", "moe_tokens_routed_total",
+    "moe_tokens_dropped_total", "moe_expert_fetches_total",
+    "moe_expert_stores_total", "moe_expert_host_failures_total",
+    "moe_failovers_total", "moe_resyncs_total",
+    "moe_router_collapses_total",
 )
 
 

@@ -135,3 +135,120 @@ def test_dispatch_mode_auto_and_validation():
         MoELayer(8, experts, dispatch_mode="bogus")
     m = MoELayer(8, experts, dispatch_mode="auto")
     assert m._mode() in ("sort", "dense")
+
+
+# -- capacity audit (ISSUE 19): drops deterministic, counted, surfaced --
+
+def test_capacity_tiebreak_lower_token_index_wins_last_slot():
+    """Regression pin on the drop order at an exactly-full expert: the
+    in-expert position is a cumsum over token order, so the LOWER token
+    index wins the last slot — every run, every host."""
+    import jax.numpy as jnp
+    from paddle2_tpu.incubate.moe import (_topk_pieces, dispatch_stats,
+                                          token_ledger_closes)
+    # 4 tokens, all preferring expert 0, capacity 2: tokens 0 and 1
+    # take the slots; 2 and 3 drop (zero combine weight)
+    logits = jnp.asarray(np.tile([[5.0, 0.0]], (4, 1)), jnp.float32)
+    idxs, gates, poss, _ = _topk_pieces(logits, 1, 2)
+    np.testing.assert_array_equal(np.asarray(poss[0]), [0, 1, 2, 3])
+    g = np.asarray(gates[0])
+    assert (g[:2] > 0).all() and (g[2:] == 0).all()
+    stats = dispatch_stats(np.asarray(idxs), np.asarray(poss), 2, 2)
+    assert stats["dropped_per_expert"].tolist() == [2, 0]
+    assert stats["tokens_residual"] == 2
+    assert token_ledger_closes(stats)
+    # interleaved preference, capacity 1: within each expert the
+    # earlier token still wins
+    lg = jnp.asarray([[5.0, 0.0], [0.0, 5.0], [5.0, 0.0], [0.0, 5.0]],
+                     jnp.float32)
+    idxs, gates, poss, _ = _topk_pieces(lg, 1, 1)
+    keep = np.asarray(poss[0]) < 1
+    np.testing.assert_array_equal(keep, [True, True, False, False])
+
+
+def test_capacity_rounding_edges():
+    """cf below 1.0 and token counts not divisible by num_experts: the
+    capacity is ceil'd and floored at top_k."""
+    gate = TopKGate(8, 4, top_k=2, capacity_factor=0.5)
+    assert gate.capacity(10) == 3      # ceil(0.5 * 2 * 10 / 4) = 3
+    assert gate.capacity(4) == 2       # floor: max(top_k, ceil(1)) = 2
+    tight = TopKGate(8, 4, top_k=2, capacity_factor=0.01)
+    assert tight.capacity(400) == 2    # floor holds at any scale
+    # a forward at S % E != 0 with a sub-1.0 cf: drops are counted and
+    # the ledger still closes, no expert over capacity
+    paddle.seed(0)
+    moe = MoELayer(8, _experts(4, 8, 16), top_k=2, capacity_factor=0.5,
+                   collect_stats=True)
+    from paddle2_tpu.incubate.moe import token_ledger_closes
+    y = moe(paddle.randn([7, 8]))
+    assert tuple(y.shape) == (7, 8)
+    st = moe.last_stats
+    assert st is not None and token_ledger_closes(st)
+    assert int(st["routed_per_expert"].max()) <= st["capacity"]
+
+
+def test_topk_picks_are_distinct_experts():
+    """The k picks of one token never name the same expert twice (the
+    remaining-probs masking), even when k == num_experts."""
+    import jax.numpy as jnp
+    from paddle2_tpu.incubate.moe import _topk_pieces
+    rs = np.random.RandomState(0)
+    lg = jnp.asarray(rs.randn(32, 2), jnp.float32)
+    idxs, gates, _, _ = _topk_pieces(lg, 2, 32)
+    a, b = np.asarray(idxs[0]), np.asarray(idxs[1])
+    assert (a != b).all()
+    # normalized combine weights sum to 1 when nothing dropped
+    tot = np.asarray(gates).sum(axis=0)
+    np.testing.assert_allclose(tot, 1.0, rtol=1e-5)
+
+
+def test_gate_numerics_match_f64_reference():
+    """The jitted f32 gate against the float64 numpy oracle: routing
+    decisions exact, gate probs and both router losses within f32
+    tolerance."""
+    from paddle2_tpu.incubate.moe import router_reference_f64
+    paddle.seed(0)
+    gate = TopKGate(16, 4, top_k=2, capacity_factor=1.25)
+    rs = np.random.RandomState(3)
+    x = paddle.to_tensor(rs.randn(24, 16).astype(np.float32))
+    idxs, gates, poss, aux = gate.pieces(x)
+    aux_t, z_t = gate.router_losses(x)
+    ref = router_reference_f64(gate.wg(x).numpy(), 2, gate.capacity(24))
+    np.testing.assert_array_equal(np.asarray(idxs.numpy()), ref["idxs"])
+    np.testing.assert_array_equal(np.asarray(poss.numpy()), ref["poss"])
+    np.testing.assert_allclose(gates.numpy(), ref["gates"],
+                               rtol=1e-4, atol=1e-6)
+    assert abs(float(aux.numpy()) - ref["aux"]) <= 1e-4 * abs(ref["aux"])
+    assert abs(float(aux_t.numpy()) - ref["aux"]) \
+        <= 1e-4 * abs(ref["aux"])
+    assert abs(float(z_t.numpy()) - ref["z_loss"]) \
+        <= 1e-4 * abs(ref["z_loss"])
+
+
+def test_collect_stats_surfaces_drops_and_counters():
+    """collect_stats publishes the exact dispatch ledger and the moe_*
+    counters; the default path keeps last_stats None (no readback)."""
+    from paddle2_tpu.incubate.moe import token_ledger_closes
+    from paddle2_tpu.observability import metrics
+    paddle.seed(0)
+    quiet = MoELayer(8, _experts(4, 8, 16), top_k=2,
+                     capacity_factor=0.25)
+    quiet(paddle.randn([16, 8]))
+    assert quiet.last_stats is None
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        pl = metrics.enable(td, rank=0, flush_steps=1)
+        try:
+            paddle.seed(0)
+            moe = MoELayer(8, _experts(4, 8, 16), top_k=2,
+                           capacity_factor=0.25, collect_stats=True)
+            moe(paddle.randn([16, 8]))
+            st = moe.last_stats
+            assert st["dropped_picks"] > 0 and token_ledger_closes(st)
+            snap = pl.snapshot()["counters"]
+            assert sum(snap["moe_tokens_routed_total"].values()) \
+                == st["routed_picks"]
+            assert sum(snap["moe_tokens_dropped_total"].values()) \
+                == st["dropped_picks"]
+        finally:
+            metrics.disable()

@@ -1,0 +1,352 @@
+"""The fault-tolerant expert-parallel MoE plane (ISSUE 19): hash-ring
+expert placement with primary+follower replicas, transactional post-step
+stores, probe-sweep failover inside the gated MTTR, priced all-to-all
+dispatch, router-collapse watchdog, and the exact token ledger — all on
+the virtual cost-model clock, with a fleet-mediated twin held bitwise
+against plain single-host training."""
+
+import numpy as np
+import pytest
+
+import paddle2_tpu as paddle
+import paddle2_tpu.optimizer as opt
+from paddle2_tpu.distributed import mesh as mesh_mod
+from paddle2_tpu.distributed import moe_fleet as mf
+from paddle2_tpu.distributed.fault_tolerance import chaos
+from paddle2_tpu.distributed.fault_tolerance.reliable import \
+    TransientStepError
+from paddle2_tpu.incubate.moe import MoELayer
+from paddle2_tpu.observability.cost_model import LinkModel
+
+E, M, S = 4, 8, 16
+LINK = LinkModel(ici_latency_us=1.0, dcn_latency_us=250.0)
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    mesh_mod.init_mesh({"dp": 8})
+    yield
+    chaos.disarm()
+
+
+def _params(e, scale=1.0):
+    rs = np.random.RandomState(e)
+    return {"w": (rs.randn(M, M) * scale).astype(np.float32),
+            "b": (rs.randn(M) * scale).astype(np.float32)}
+
+
+def _fleet(num_hosts=4, probe_interval_s=0.02, attach=True):
+    fleet = mf.ExpertHostFleet(num_hosts=num_hosts, num_experts=E,
+                               hosts_per_slice=2,
+                               probe_interval_s=probe_interval_s,
+                               link=LINK, seed=0)
+    if attach:
+        fleet.attach_experts({e: _params(e) for e in range(E)})
+    return fleet
+
+
+def _layer(capacity_factor=4.0):
+    paddle.seed(0)
+    experts = [paddle.nn.Linear(M, M) for _ in range(E)]
+    return MoELayer(M, experts, top_k=2,
+                    capacity_factor=capacity_factor)
+
+
+def _plane(probe_interval_s=0.02, a2a_mode="hierarchical", **kw):
+    layer = _layer()
+    o = opt.SGD(learning_rate=0.05, parameters=layer.parameters())
+    return mf.ExpertParallelMoE(
+        layer, o, _fleet(probe_interval_s=probe_interval_s,
+                         attach=False),
+        link=LINK, aux_weight=0.01, a2a_mode=a2a_mode, **kw)
+
+
+def _trace(seed=7):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(S, M).astype(np.float32),
+            rng.randn(S, M).astype(np.float32))
+
+
+def _expert_crcs(layer):
+    return [mf.params_crc({k: np.asarray(v.numpy())
+                           for k, v in ex.state_dict().items()})
+            for ex in layer.experts]
+
+
+# -- placement / serving ------------------------------------------------
+
+def test_attach_places_primary_and_follower_replicas():
+    fleet = _fleet()
+    assert sorted(fleet.placement) == list(range(E))
+    for e, (p, f) in fleet.placement.items():
+        assert f is not None and f != p
+        assert e in fleet.hosts[p].experts
+        assert e in fleet.hosts[f].experts
+    ledger = fleet.ledger()
+    assert ledger["ok"] and ledger["replicas_crc_equal"], ledger
+    with pytest.raises(mf.MoEPlaneError, match="already attached"):
+        fleet.attach_experts({e: _params(e) for e in range(E)})
+
+
+def test_fetch_returns_a_priced_copy():
+    fleet = _fleet()
+    params, secs = fleet.fetch(0, 0.0)
+    assert secs > 0.0
+    params["w"][:] = 0.0  # mutating the copy must not touch the host
+    again, _ = fleet.fetch(0, 0.0)
+    assert np.abs(again["w"]).sum() > 0
+    ops = {e["op"] for e in fleet.traffic.entries}
+    assert "moe_fetch" in ops
+
+
+def test_store_updates_primary_and_follower_bitwise():
+    fleet = _fleet()
+    secs = fleet.store_all({e: _params(e, scale=2.0) for e in range(E)},
+                           0.0)
+    assert secs > 0.0
+    for e, (p, f) in fleet.placement.items():
+        assert mf.params_crc(fleet.hosts[p].experts[e]) == \
+            mf.params_crc(fleet.hosts[f].experts[e])
+        assert mf.params_crc(fleet.hosts[p].experts[e]) == \
+            mf.params_crc(_params(e, scale=2.0))
+    assert fleet.ledger()["ok"]
+
+
+def test_store_is_transactional_under_mid_store_kill():
+    """A host death in the liveness phase aborts the WHOLE store with
+    nothing written — the property the bitwise replay rests on."""
+    fleet = _fleet()
+    # a victim whose first expert (in sorted commit order) is not
+    # expert 0, so an earlier expert has already passed its gate
+    victim = next(fleet.primary_of(e) for e in range(1, E)
+                  if fleet.primary_of(e) != fleet.primary_of(0))
+    before = {e: mf.params_crc(
+        fleet.hosts[fleet.primary_of(e)].experts[e]) for e in range(E)}
+    chaos.arm(f"kill_expert_host:1:{victim}")
+    with pytest.raises(mf.ExpertHostFailedError):
+        fleet.store_all({e: _params(e, scale=3.0) for e in range(E)},
+                        0.0)
+    chaos.disarm()
+    for e in range(E):
+        p, f = fleet.placement[e]
+        holder = p if fleet.hosts[p].alive else f
+        assert mf.params_crc(fleet.hosts[holder].experts[e]) \
+            == before[e], f"expert {e} partially committed"
+
+
+def test_kill_fails_over_at_probe_sweep_within_mttr():
+    fleet = _fleet()
+    victim = fleet.primary_of(0)
+    before = dict(fleet.placement)
+    fleet.kill_host(victim, 1.0)
+    with pytest.raises(mf.ExpertHostFailedError):
+        fleet.fetch(0, 1.0)                # dead primary: typed raise
+    fleet.maybe_probe(1.0)                 # anchors the cadence
+    fleet.maybe_probe(1.0 + 2 * fleet.probe_interval_s)
+    # promotion == the old follower (the ring successor property)
+    assert fleet.primary_of(0) == before[0][1]
+    assert fleet.failovers >= 1 and fleet.resyncs >= 1
+    assert 0.0 < fleet.last_mttr_s() <= 2.0 * fleet.probe_interval_s
+    ledger = fleet.ledger()
+    assert ledger["ok"] and victim not in ledger["alive_hosts"]
+    params, _ = fleet.fetch(0, 2.0)        # serves from the promotee
+    assert mf.params_crc(params) == mf.params_crc(_params(0))
+
+
+def test_errors_are_typed():
+    assert issubclass(mf.ExpertHostFailedError, TransientStepError)
+    assert not issubclass(mf.RouterCollapseError, TransientStepError)
+    err = mf.ExpertHostFailedError(3, expert=1, op="fetch")
+    assert err.host == 3 and err.expert == 1 and "fetch" in str(err)
+    col = mf.RouterCollapseError(5, 0.12, 0.35, 3)
+    assert col.step == 5 and col.entropy == pytest.approx(0.12)
+    assert "0.3500" in str(col)
+
+
+def test_chaos_kill_expert_host_is_victim_gated_and_one_shot():
+    chaos.arm("kill_expert_host:2:1")
+    assert not chaos.maybe_kill_expert_host(0)   # not the victim
+    assert not chaos.maybe_kill_expert_host(1)   # victim op 1 of 2
+    assert chaos.maybe_kill_expert_host(1)       # fires on the 2nd op
+    assert not chaos.maybe_kill_expert_host(1)   # one-shot
+    assert [k for k, _ in chaos.fired_log()] == ["kill_expert_host"]
+
+
+# -- params crc ---------------------------------------------------------
+
+def test_params_crc_is_order_independent_and_value_sensitive():
+    a = {"w": np.arange(4, dtype=np.float32),
+         "b": np.ones(2, np.float32)}
+    b = {"b": np.ones(2, np.float32),
+         "w": np.arange(4, dtype=np.float32)}
+    assert mf.params_crc(a) == mf.params_crc(b)
+    b["w"] = b["w"] + 1e-7
+    assert mf.params_crc(a) != mf.params_crc(b)
+
+
+# -- priced all-to-all --------------------------------------------------
+
+def test_price_all_to_all_hierarchical_beats_flat_on_alpha():
+    """At small per-expert payloads the DCN alpha dominates: slice
+    bucketing collapses the cross-slice dispatch count, so the
+    hierarchical schedule is cheaper and the flat one pays one alpha
+    per remote rank pair."""
+    H = 4
+    pair = np.full((H, H), 1024.0)
+    np.fill_diagonal(pair, 0.0)
+    flat_s, flat_c, _ = mf.price_all_to_all(pair, 2, link=LINK,
+                                            hierarchical=False)
+    hier_s, hier_c, _ = mf.price_all_to_all(pair, 2, link=LINK,
+                                            hierarchical=True)
+    assert flat_c["dcn"] == 8           # every cross-slice rank pair
+    assert hier_c["dcn"] == 2           # one bucket per direction
+    assert hier_s < flat_s
+    # all-ICI matrix prices no DCN at all
+    intra = np.zeros((H, H))
+    intra[0, 1] = intra[1, 0] = intra[2, 3] = intra[3, 2] = 1024.0
+    _, c, _ = mf.price_all_to_all(intra, 2, link=LINK)
+    assert c["dcn"] == 0 and c["ici"] == 4
+
+
+# -- router watchdog ----------------------------------------------------
+
+def test_watchdog_entropy_math():
+    h = mf.RouterWatchdog.normalized_entropy
+    assert h(np.ones(8)) == pytest.approx(1.0)
+    one_hot = np.zeros(8)
+    one_hot[3] = 64
+    assert h(one_hot) == pytest.approx(0.0)
+    assert h(np.zeros(8)) == 0.0        # no tokens at all: collapse
+    two_hot = np.zeros(8)
+    two_hot[0] = two_hot[5] = 16
+    assert h(two_hot) == pytest.approx(np.log(2) / np.log(8))
+
+
+def test_watchdog_streak_resets_and_raises_at_window():
+    wd = mf.RouterWatchdog(8, entropy_floor=0.35, window=3)
+    bad = np.zeros(8)
+    bad[0] = 16
+    wd.observe(bad, 0.0, 0)
+    wd.observe(bad, 0.0, 1)
+    wd.observe(np.ones(8), 0.0, 2)      # one healthy step resets
+    wd.observe(bad, 0.0, 3)
+    wd.observe(bad, 0.0, 4)
+    with pytest.raises(mf.RouterCollapseError) as ei:
+        wd.observe(bad, 0.0, 5)
+    assert ei.value.step == 5 and ei.value.window == 3
+    assert len(wd.entropies) == 6
+
+
+def test_plane_raises_router_collapse_on_rigged_trace():
+    # identical tokens make the load two-hot: H = log2/log4 = 0.5 on
+    # 4 experts, so the floor must sit above that to catch it
+    plane = _plane(entropy_floor=0.6)
+    xv, yv = _trace()
+    xc = np.tile(xv[:1], (S, 1))        # identical tokens: two-hot load
+    with pytest.raises(mf.RouterCollapseError):
+        for _ in range(plane.watchdog.window + 1):
+            plane.train_step(paddle.to_tensor(xc.copy()),
+                             paddle.to_tensor(yv.copy()))
+    assert all(plane.ledgers_ok)        # ledger audited before the raise
+
+
+# -- the full plane -----------------------------------------------------
+
+def test_plane_is_bitwise_transparent_vs_single_host_twin():
+    from paddle2_tpu.nn import functional as F
+    plane = _plane()
+    xv, yv = _trace()
+    plane_losses = []
+    for _ in range(3):
+        loss = plane.train_step(paddle.to_tensor(xv.copy()),
+                                paddle.to_tensor(yv.copy()))
+        plane_losses.append(loss.numpy().tobytes())
+    twin = _layer()
+    o = opt.SGD(learning_rate=0.05, parameters=twin.parameters())
+    twin_losses = []
+    for _ in range(3):
+        out = twin(paddle.to_tensor(xv.copy()))
+        loss = F.mse_loss(out, paddle.to_tensor(yv.copy())) \
+            + twin.aux_loss * 0.01
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        twin_losses.append(loss.numpy().tobytes())
+    assert plane_losses == twin_losses
+    assert _expert_crcs(plane.layer) == _expert_crcs(twin)
+    assert all(plane.ledgers_ok) and len(plane.ledgers_ok) == 3
+    assert plane.clock.t > 0.0          # fetch/a2a/store all priced
+    assert plane.a2a_counts["ici"] + plane.a2a_counts["dcn"] > 0
+
+
+def test_plane_replays_killed_step_bitwise_vs_clean_twin():
+    clean = _plane()
+    xv, yv = _trace()
+    for _ in range(3):
+        clean.train_step(paddle.to_tensor(xv.copy()),
+                         paddle.to_tensor(yv.copy()))
+    plane = _plane()
+    victim = sorted({plane.fleet.primary_of(e) for e in range(E)})[0]
+    owned = sum(1 for e in range(E)
+                if plane.fleet.primary_of(e) == victim)
+    # victim ops/step = fetch + store per owned expert; fire on step
+    # 2's FIRST op (a fetch — nothing of the step committed yet)
+    chaos.arm(f"kill_expert_host:{2 * owned + 1}:{victim}")
+    for _ in range(3):
+        plane.train_step(paddle.to_tensor(xv.copy()),
+                         paddle.to_tensor(yv.copy()))
+    chaos.disarm()
+    assert plane.reliable.stats["retries"] >= 1
+    assert plane.fleet.failovers >= 1
+    assert 0.0 < plane.fleet.last_mttr_s() \
+        <= 2.0 * plane.fleet.probe_interval_s
+    assert _expert_crcs(plane.layer) == _expert_crcs(clean.layer)
+    assert all(plane.ledgers_ok)
+    plane.fleet.quiesce(plane.clock.t)
+    assert plane.fleet.ledger()["ok"]
+
+
+# -- observability ------------------------------------------------------
+
+def test_moe_metrics_counters_flow_to_the_plane(tmp_path):
+    from paddle2_tpu.observability import metrics
+    pl = metrics.enable(str(tmp_path), rank=0, flush_steps=1)
+    try:
+        plane = _plane()
+        xv, yv = _trace()
+        plane.train_step(paddle.to_tensor(xv.copy()),
+                         paddle.to_tensor(yv.copy()))
+        plane.fleet.kill_host(plane.fleet.primary_of(0),
+                              plane.clock.t)
+        plane.fleet.maybe_probe(plane.clock.t)
+        plane.fleet.maybe_probe(plane.clock.t
+                                + 2 * plane.fleet.probe_interval_s)
+        snap = pl.snapshot()["counters"]
+        for name in ("moe_steps_total", "moe_expert_fetches_total",
+                     "moe_expert_stores_total",
+                     "moe_tokens_routed_total",
+                     "moe_expert_host_failures_total",
+                     "moe_failovers_total", "moe_resyncs_total"):
+            assert name in snap and sum(snap[name].values()) > 0, name
+    finally:
+        metrics.disable()
+
+
+def test_flight_doctor_renders_moe_section():
+    from paddle2_tpu.tools import flight_doctor
+    dumps = {0: {"header": {"node": "host0"}, "events": [
+        {"kind": "moe", "event": "host_kill", "host": 2, "t": 0.5},
+        {"kind": "moe", "event": "failover", "expert": 3, "host": 1,
+         "old_host": 2, "t": 0.52},
+        {"kind": "moe", "event": "resync", "expert": 3,
+         "reason": "recruit", "bytes": 4096, "t": 0.52},
+        {"kind": "moe", "event": "router_collapse", "step": 7,
+         "entropy": 0.1234, "floor": 0.35, "t": 0.9},
+    ]}}
+    report = flight_doctor.diagnose(dumps)
+    assert report["moe"]["counts"] == {"host_kill": 1, "failover": 1,
+                                       "resync": 1,
+                                       "router_collapse": 1}
+    text = flight_doctor.format_report(report, "/tmp/moe-dumps")
+    assert "EXPERT-PARALLEL MOE" in text
+    assert "expert=3" in text and "host=1" in text
