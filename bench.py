@@ -1079,153 +1079,13 @@ def bench_reliable_step():
 
 
 def bench_observability():
-    """``--observability``: gates the always-on metrics plane + the
-    deterministic cost model + the perf_doctor triage path, all without
-    wall-clock A/B (unreliable on this shared host):
-
-    * metrics overhead < 1% of step FLOPs by DETERMINISTIC record
-      accounting: events recorded per step x a pessimistic per-event
-      host-op cost (``metrics.EVENT_COST_OPS``) against the step's XLA
-      cost_analysis FLOPs;
-    * the clean path performs ZERO extra host syncs with the plane on
-      (telemetry reads host-known values only — never the device);
-    * every step record's four breakdown components (input-wait /
-      compute / collective / host) sum to the recorded step total
-      exactly (host is the residual by construction; the gate proves
-      the plumbing doesn't double-count);
-    * the cost model's FLOPs equal XLA ``cost_analysis`` of the same
-      lowered program EXACTLY (three independent readers of one
-      deterministic source);
-    * ``perf_doctor diff`` names an injected slowdown — chaos
-      ``stall_collective`` held inside a deadline-watched all_reduce —
-      as the top regressed component, and exits nonzero (the CI gate).
-    """
-    import contextlib
-    import io
-    import json as _json
-    import tempfile
-    import paddle2_tpu as paddle
-    import paddle2_tpu.nn as nn
-    import paddle2_tpu.optimizer as opt
-    from paddle2_tpu.distributed import collective as C
-    from paddle2_tpu.distributed.fault_tolerance import chaos, numerics
-    from paddle2_tpu.observability import cost_model, metrics
-    from paddle2_tpu.tools import perf_doctor
-
-    def build(seed=0):
-        paddle.seed(seed)
-        model = nn.Sequential(nn.Linear(128, 256), nn.ReLU(),
-                              nn.Linear(256, 128))
-        o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
-        step = paddle.jit.train_step(
-            lambda x, y: ((model(x) - y) ** 2).mean(), o,
-            layers=[model])
-        return model, o, step
-
-    rs = np.random.RandomState(0)
-    batches = [(paddle.to_tensor(rs.randn(256, 128).astype(np.float32)),
-                paddle.to_tensor(rs.randn(256, 128).astype(np.float32)))
-               for _ in range(8)]
-    steps = 16
-    chaos.disarm()
-    metrics.disable()
-
-    with tempfile.TemporaryDirectory() as td:
-        # ---- overhead + sync + breakdown + cost-model legs ----------
-        mdir = os.path.join(td, "metrics")
-        pl = metrics.enable(mdir, rank=0)
-        _, _, prog = build()
-        prog.collect_cost = True
-        s0 = numerics.host_sync_count()
-        ev0 = pl.events_recorded
-        for i in range(steps):
-            prog(*batches[i % len(batches)])
-        clean_syncs = (numerics.host_sync_count() - s0) / steps
-        events_per_step = (pl.events_recorded - ev0) / steps
-        step_flops = prog.last_cost_flops
-        overhead_pct = (None if not step_flops else
-                        events_per_step * metrics.EVENT_COST_OPS
-                        / step_flops * 100.0)
-        metrics.flush()
-        recs = [_json.loads(ln) for ln in open(pl.stream_path)]
-        srecs = [r for r in recs if r["type"] == "step"]
-        sums_ok = bool(srecs) and all(
-            abs(r["total_s"] - (r["input_wait_s"] + r["compute_s"]
-                                + r["collective_s"] + r["host_s"]))
-            <= 1e-9 for r in srecs)
-        host_ok = all(r["host_s"] >= -1e-9 for r in srecs)
-        # three independent readers of the SAME lowered program must
-        # agree bit-for-bit: the program's own collect_cost pass, the
-        # cost model's StepCost, and a direct cost_analysis here
-        direct = cost_model.cost_analysis_of(
-            prog.last_entry.lower(*prog.last_abstract_args)).get("flops")
-        sc = cost_model.step_cost_of_program(prog)
-        cost_exact = (direct is not None and sc is not None
-                      and direct == sc.flops == step_flops)
-        metrics.disable()
-
-        # ---- perf_doctor diff leg: injected collective slowdown -----
-        def run_stream(sub, spec):
-            d = os.path.join(td, sub)
-            metrics.enable(d, rank=0)
-            _, _, sp = build()
-            t = paddle.to_tensor(np.ones((1, 64), np.float32))
-            try:
-                if spec:
-                    chaos.arm(spec)
-                for i in range(12):
-                    sp(*batches[i % len(batches)])
-                    # deadline-watched: the stall blocks the caller
-                    # inside the collective span (not just a waiter
-                    # thread), exactly like a real slow ring
-                    C.all_reduce(t, timeout=120.0)
-            finally:
-                chaos.disarm()
-                metrics.flush()
-                metrics.disable()
-            return d
-
-        # 2s one-shot stall ≈ +180ms/step mean over the counted steps —
-        # far above this sandbox's load-spike noise floor, so the diff
-        # verdict stays deterministic even though the stall is wall time
-        base_dir = run_stream("a", None)
-        slow_dir = run_stream("b", "stall_collective:6:2.0")
-        rep_a = perf_doctor.summarize(perf_doctor.load_streams(base_dir))
-        rep_b = perf_doctor.summarize(perf_doctor.load_streams(slow_dir))
-        d = perf_doctor.diff(rep_a, rep_b, threshold_pct=10.0)
-        with contextlib.redirect_stdout(io.StringIO()) as cli_out:
-            cli_rc = perf_doctor.main(["diff", base_dir, slow_dir,
-                                       "--threshold", "10"])
-        diff_ok = (d["top_regressed"] == "collective" and d["regressed"]
-                   and cli_rc == perf_doctor.REGRESSION_EXIT)
-        log(cli_out.getvalue().strip())
-
-    ok = (overhead_pct is not None and overhead_pct < 1.0
-          and clean_syncs == 0.0 and sums_ok and host_ok
-          and cost_exact and diff_ok)
-    print(json.dumps({
-        "metric": "observability",
-        "value": round(overhead_pct, 5) if overhead_pct is not None
-        else None,
-        "unit": "% of step FLOPs charged by metric events "
-                "(deterministic events-per-step x EVENT_COST_OPS, no "
-                "wall clock)",
-        "events_per_step": events_per_step,
-        "step_flops": step_flops,
-        "clean_host_syncs_per_step": clean_syncs,
-        "breakdown_sums_exact": bool(sums_ok),
-        "host_residual_nonnegative": bool(host_ok),
-        "cost_model_flops_exact": bool(cost_exact),
-        "perf_doctor_top_regressed": d["top_regressed"],
-        "perf_doctor_cli_exit": cli_rc,
-        "note": "GATES: overhead<1% by deterministic record "
-                "accounting, 0 extra clean-path syncs, components sum "
-                "to step total, cost-model==cost_analysis, and "
-                "perf_doctor diff names an injected stall_collective "
-                "as the regressed component with a nonzero exit",
-        "ok": bool(ok),
-    }))
-    return 0 if ok else 1
+    """``--observability``: the metrics-plane / cost-model / perf_doctor
+    triage gate, ported byte-for-byte onto the ``bench/scenarios``
+    registry (ISSUE 20 satellite): drills, gates, and stdout JSON line
+    unchanged (the lane now also writes ``OBSERVABILITY_r01.json``);
+    see ``bench/scenarios/observability.py``."""
+    from bench.scenarios import run_scenario
+    return run_scenario("observability")
 
 
 def bench_elastic():
@@ -2145,6 +2005,18 @@ def bench_moe_training():
     return run_scenario("moe-training")
 
 
+def bench_long_context():
+    """``--long-context``: the ISSUE 20 tentpole — fault-tolerant
+    sequence-parallel training (hash-ring K/V shard placement,
+    chaos-hardened ring attention with mid-pass kill healed by ring
+    re-formation and bitwise step replay, exact LSE-merge conservation
+    ledger, 32k ring/Ulysses schedule budgets gated both ways), every
+    drill on the virtual cost-model clock.
+    See ``bench/scenarios/long_context.py``."""
+    from bench.scenarios import run_scenario
+    return run_scenario("long-context")
+
+
 def bench_million_user_day():
     """``--million-user-day``: the ISSUE 17 tentpole — one closed-loop
     train->serve day on the deterministic cost-model clock, chaos
@@ -2156,314 +2028,12 @@ def bench_million_user_day():
 
 def bench_tracing():
     """``--tracing``: request-lifecycle tracing + exact tail-latency
-    attribution (ISSUE 13) — all deterministic (virtual clock x seeded
-    traces x integer-picosecond decomposition; run twice, the
-    TRACING_r01.json artifact is byte-identical).
-
-    Gates:
-      1. **Transparency** — the PR 11 kill drill produces a
-         token-for-token identical stream with tracing ON vs OFF
-         (tracing is pure recording, it must never perturb the DES).
-      2. **Exact decomposition** — every finished request of all four
-         PR 11 chaos drills (kill / transient / overload / hot-swap)
-         decomposes into queue_wait + prefill + decode_compute +
-         eviction_stall + failover_stall + swap_stall + host summing
-         EXACTLY (integer-ps, bitwise-stable) to its e2e latency.
-      3. **Fault attribution** — serve_doctor names the injected
-         overload as the ``queue-wait`` owner of the p99-p50 gap, and
-         a drop_decode_step chaos diff names ``decode-compute`` as the
-         top regressed component with the dropped steps attributed to
-         specific trace ids.
-      4. **Overhead** — trace events x EVENT_COST_OPS < 1% of the
-         drills' executed modeled FLOPs (deterministic accounting, no
-         wall-clock A/B). The disabled path is one attribute load
-         (gated by tests/test_tracing.py).
-      5. **SLO plane** — the overload drill's SLOConfig ledger closes
-         (good == completed, bad == shed), the burn-rate gauge rides
-         the metrics snapshot, and perf_doctor reconstructs TTFT
-         p50/p99 from the histogram bucket counts.
-    """
-    import io
-    import shutil
-    import zlib
-    from contextlib import redirect_stdout
-
-    import paddle2_tpu as paddle
-    from paddle2_tpu.distributed.fault_tolerance import chaos
-    from paddle2_tpu.models.gpt import GPTForCausalLM, gpt_tiny
-    from paddle2_tpu.observability import metrics, tracing
-    from paddle2_tpu.serving import (
-        EngineConfig, EngineFailoverRouter, HotSwapController,
-        ReliabilityConfig, SLOConfig, ServingEngine, poisson_trace,
-        simulate_router, simulate_serving)
-    from paddle2_tpu.serving.simulate import cost_seconds
-    from paddle2_tpu.tools import perf_doctor, serve_doctor
-
-    trace_root = bench_scratch("tracing", env_var="BENCH_TRACING_DIR")
-    metrics_dir = bench_scratch("tracing_metrics",
-                                env_var="BENCH_TRACING_METRICS_DIR")
-    for d in (trace_root, metrics_dir):
-        shutil.rmtree(d, ignore_errors=True)   # streams append
-
-    paddle.seed(0)
-    cfg = gpt_tiny(use_scan=False, max_position_embeddings=128)
-    model = GPTForCausalLM(cfg)
-    prompt_lens, gen_tokens = [16, 24], [12, 24]
-    mean_gen = float(np.mean(gen_tokens))
-
-    def make_engine(reliability=None):
-        return ServingEngine(model, config=EngineConfig(
-            block_size=16, num_blocks=40, max_batch=8,
-            prefill_budget_tokens=64, max_model_len=128,
-            reliability=reliability))
-
-    def make_trace(n, seed, rate, priorities=False, gen=None):
-        t = poisson_trace(n, rate_per_s=rate, prompt_lens=prompt_lens,
-                          gen_tokens=gen or gen_tokens,
-                          vocab=cfg.vocab_size, seed=seed)
-        if priorities:
-            for i, r in enumerate(t):
-                r["priority"] = 1 if i % 3 == 0 else 0
-        return t
-
-    def crc(router, rep):
-        payload = b"".join(
-            np.asarray(router.sequence(r).generated, np.int64).tobytes()
-            for r in rep.rids)
-        return zlib.crc32(payload) & 0xFFFFFFFF
-
-    # -- phase 0: probe the cost model (compiles prefill + b1 decode)
-    probe = make_engine()
-    simulate_serving(probe, make_trace(2, seed=1, rate=100.0))
-    b1_key = min(probe.runner._decode_costs)
-    decode_s = cost_seconds(probe.runner.decode_cost(b1_key))
-    prefill_s = max(cost_seconds(c)
-                    for c in probe.runner._prefill_costs.values())
-    base_capacity = 1.0 / decode_s
-    probe_interval_s = 2.0 * decode_s
-    log(f"tracing probe: decode_s={decode_s*1e6:.1f}us "
-        f"prefill_s={prefill_s*1e6:.1f}us")
-
-    drill_stats = {}   # name -> {events, flops, completed, exact, ...}
-
-    def run_drill(name, n_engines, rel=None, arm=None, n=16, seed=101,
-                  rate=None, priorities=False, gen=None, on_round=None,
-                  traced=True):
-        rate = rate if rate is not None else 2.0 * base_capacity / mean_gen
-        tdir = os.path.join(trace_root, name)
-        if traced:
-            shutil.rmtree(tdir, ignore_errors=True)
-            tracing.enable(tdir, rank=0)
-        if arm:
-            chaos.arm(arm)
-        router = EngineFailoverRouter(
-            [make_engine(rel) for _ in range(n_engines)],
-            probe_interval_s=probe_interval_s)
-        rep = simulate_router(
-            router, [dict(r) for r in
-                     make_trace(n, seed, rate, priorities, gen)],
-            on_round=on_round)
-        chaos.disarm()
-        events = 0
-        if traced:
-            events = tracing.active().events_recorded
-            tracing.flush()
-            tracing.disable()
-        return router, rep, tdir, events
-
-    gates = {}
-    total_events = 0
-    total_flops = 0.0
-    exact_by_drill = {}
-
-    def audit(name, tdir, rep, events):
-        """Decompose one drill's traces; returns (gate_ok, decomps)."""
-        nonlocal total_events, total_flops
-        dec = tracing.decompose(tracing.load_trace_dir(tdir))
-        fin = {t: c for t, c in dec.items() if c["finished"]}
-        exact_by_drill[name] = {
-            "finished": len(fin),
-            "completed": rep.completed,
-            "exact": sum(1 for c in fin.values() if c["exact"]),
-            "events": events,
-        }
-        total_events += events
-        total_flops += rep.modeled_flops
-        ok = (len(fin) == rep.completed
-              and all(c["exact"] for c in fin.values()))
-        return ok, dec
-
-    # -- drill 1: engine kill -> failover (traced vs untraced twin)
-    r_off, rep_off, _, _ = run_drill("kill_off", 2,
-                                     arm="kill_engine:4:1",
-                                     traced=False)
-    r_kill, rep_kill, d_kill, ev_kill = run_drill(
-        "kill", 2, arm="kill_engine:4:1")
-    kill_crc = crc(r_kill, rep_kill)
-    gates["tracing_transparent_token_for_token"] = (
-        kill_crc == crc(r_off, rep_off)
-        and rep_kill.completed == rep_off.completed)
-    gates["decomposition_exact_kill"], _ = audit("kill", d_kill,
-                                                 rep_kill, ev_kill)
-
-    # -- drill 2: transient faults (drop + corrupt), single engine
-    _, rep_tr, d_tr, ev_tr = run_drill(
-        "transient", 1, arm="drop_decode_step:3,corrupt_block_table:5:1")
-    gates["decomposition_exact_transient"], _ = audit(
-        "transient", d_tr, rep_tr, ev_tr)
-
-    # -- drill 3: overload burst + SLO plane (+ metrics join)
-    metrics.enable(metrics_dir, rank=0, flush_steps=1)
-    ttft_bound = 10.0 * (prefill_s + decode_s)
-    slo = SLOConfig(ttft_target_s=ttft_bound,
-                    availability_target=0.99)
-    # uniform generation length: every request costs the same decode
-    # work, so the ONLY source of tail spread is the injected overload
-    # itself — what queue_wait should (and must) be blamed for
-    r_over, rep_over, d_over, ev_over = run_drill(
-        "overload", 1,
-        rel=ReliabilityConfig(max_queue_depth=6, slo=slo),
-        n=40, seed=202, rate=20.0 * base_capacity / 16.0,
-        priorities=True, gen=[16])
-    metrics.flush()
-    metrics.export_prometheus()
-    metrics.disable()
-    gates["decomposition_exact_overload"], _ = audit(
-        "overload", d_over, rep_over, ev_over)
-    over_report = serve_doctor.summarize(
-        serve_doctor._load(d_over), metrics_dir=metrics_dir)
-    tail = over_report["tail"]
-    gates["overload_tail_owned_by_queue_wait"] = (
-        tail["owner"] == "queue_wait_s" and tail["owner_gap_s"] > 0)
-    eng_over = r_over.engines[0]
-    slo_led = over_report["slo"]
-    gates["slo_ledger_closes"] = (
-        slo_led["good"] == rep_over.completed
-        and slo_led["bad"] == rep_over.shed
-        and slo_led["bad"] > 0
-        and slo_led["burn_rate"] is not None
-        and eng_over.scheduler.slo_good + eng_over.scheduler.slo_bad
-        == rep_over.completed + rep_over.shed)
-    # histogram satellite: perf_doctor reconstructs TTFT percentiles
-    # from the cumulative bucket counts the snapshot now carries
-    pd_report = perf_doctor.summarize(
-        perf_doctor.load_streams(metrics_dir), warmup=0)
-    hist = pd_report.get("histograms") or {}
-    ttft_lane = next((v for k, v in hist.items()
-                      if k.startswith("serving_ttft_s")), None)
-    gates["perf_doctor_histogram_ttft_lane"] = (
-        ttft_lane is not None and ttft_lane["count"] > 0
-        and ttft_lane["p99"] is not None and ttft_lane["p99"] > 0)
-    slo_counters_seen = pd_report.get("counters") or {}
-    gates["perf_doctor_slo_counters"] = (
-        slo_counters_seen.get("serving_slo_good_total", 0) > 0
-        and slo_counters_seen.get("serving_slo_bad_total", 0) > 0)
-
-    # -- drill 4: staged hot-swap rollout + rollback mid-traffic
-    swap_state = {}
-
-    def on_round(rt, clock, idx):
-        ctl = swap_state.get("ctl")
-        if ctl is None:
-            new_w = [w * 1.001 if "float" in str(getattr(w, "dtype", ""))
-                     else w for w in rt.engines[0].runner._weights()]
-            ctl = swap_state["ctl"] = HotSwapController(
-                rt.engines, new_w)
-        if idx in (6, 9):
-            ctl.stage_next(now=clock)
-        elif idx == 14 and ctl.state == "committed":
-            ctl.rollback(now=clock)
-
-    _, rep_swap, d_swap, ev_swap = run_drill(
-        "swap", 2, n=16, seed=303, on_round=on_round)
-    gates["decomposition_exact_swap"], swap_dec = audit(
-        "swap", d_swap, rep_swap, ev_swap)
-    gates["swap_spans_cover_requests"] = any(
-        c["swaps"] > 0 for c in swap_dec.values())
-
-    # -- drill 5: drop-chaos diff pair (BASE clean vs CAND dropped)
-    _, rep_db, d_drop_base, ev_db = run_drill(
-        "drop_base", 1, n=8, seed=404)
-
-    def rearm(rt, clock, idx):
-        if idx in (4, 6, 8, 10):
-            chaos.arm("drop_decode_step:1")
-
-    _, rep_dc, d_drop_cand, ev_dc = run_drill(
-        "drop", 1, n=8, seed=404, on_round=rearm)
-    base_rep = serve_doctor.summarize(serve_doctor._load(d_drop_base))
-    cand_rep = serve_doctor.summarize(serve_doctor._load(d_drop_cand))
-    drop_diff = serve_doctor.diff(base_rep, cand_rep)
-    drop_tids = (cand_rep.get("chaos") or {}).get("drop_decode_step",
-                                                  [])
-    gates["drop_diff_names_decode_compute"] = (
-        drop_diff["top_regressed"] == "decode-compute"
-        and drop_diff["components"]["decode-compute"]["delta_s"] > 0)
-    gates["drop_chaos_attributed_to_tids"] = (
-        len(drop_tids) > 0
-        and drop_diff["counter_deltas"].get("retries", {}).get("new", 0)
-        > 0)
-
-    # -- overhead: deterministic event-cost accounting vs step FLOPs
-    overhead_pct = (100.0 * total_events * metrics.EVENT_COST_OPS
-                    / max(total_flops, 1.0))
-    gates["tracing_overhead_under_1pct_of_flops"] = overhead_pct < 1.0
-
-    # -- serve_doctor CLI round-trips (quiet: bench stdout is one line)
-    sink = io.StringIO()
-    with redirect_stdout(sink):
-        rc_summary = serve_doctor.main(
-            [d_over, "--metrics-dir", metrics_dir])
-        rc_diff_same = serve_doctor.main(["diff", d_kill, d_kill])
-    gates["serve_doctor_cli_exit_codes"] = (
-        rc_summary == 0 and rc_diff_same == 0)
-
-    log(f"tracing: events={total_events} flops={total_flops:.3e} "
-        f"overhead={overhead_pct:.4f}% tail_owner="
-        f"{tail['owner_label']} drop_top="
-        f"{drop_diff['top_regressed']} slo good/bad="
-        f"{slo_led['good']:g}/{slo_led['bad']:g} "
-        f"burn={slo_led['burn_rate']:.2f}x")
-
-    result = {
-        "metric": "request_tracing",
-        "value": round(overhead_pct, 6),
-        "unit": "overhead_pct_of_step_flops",
-        "drills": exact_by_drill,
-        "kill_tokens_crc": kill_crc,
-        "tail": {
-            "owner": tail["owner_label"],
-            "gap_us": round(tail["gap_s"] * 1e6, 3),
-            "owner_gap_us": round(tail["owner_gap_s"] * 1e6, 3),
-        },
-        "drop_diff": {
-            "top_regressed": drop_diff["top_regressed"],
-            "decode_delta_us": round(
-                drop_diff["components"]["decode-compute"]["delta_s"]
-                * 1e6, 3),
-            "retries": drop_diff["counter_deltas"].get(
-                "retries", {}).get("new", 0),
-            "chaos_tids": drop_tids,
-        },
-        "slo": {
-            "good": slo_led["good"], "bad": slo_led["bad"],
-            "attainment": round(slo_led["attainment"], 4),
-            "burn_rate": round(slo_led["burn_rate"], 4),
-            "ttft_target_us": round(ttft_bound * 1e6, 3),
-        },
-        "histogram_ttft": {
-            "count": ttft_lane["count"] if ttft_lane else 0,
-            "p50_us": round(ttft_lane["p50"] * 1e6, 3)
-            if ttft_lane and ttft_lane["p50"] is not None else None,
-            "p99_us": round(ttft_lane["p99"] * 1e6, 3)
-            if ttft_lane and ttft_lane["p99"] is not None else None,
-        },
-        "events": total_events,
-        "event_cost_ops": metrics.EVENT_COST_OPS,
-        "modeled_flops": total_flops,
-        "gates": gates,
-    }
-    return emit_result("tracing", "TRACING_r01.json", result)
+    attribution (ISSUE 13) — ported onto the declarative
+    ``bench/scenarios`` registry (ISSUE 20 satellite): the drills,
+    gates, streams, and artifact bytes are unchanged; see
+    ``bench/scenarios/tracing.py``."""
+    from bench.scenarios import run_scenario
+    return run_scenario("tracing")
 
 
 def bench_serving_throughput():
@@ -3007,6 +2577,8 @@ def main():
         sys.exit(bench_ps_recommender())
     if "--moe-training" in sys.argv:
         sys.exit(bench_moe_training())
+    if "--long-context" in sys.argv:
+        sys.exit(bench_long_context())
     if "--serving" in sys.argv:
         sys.exit(bench_serving())
     if "--multichip-scaling" in sys.argv:

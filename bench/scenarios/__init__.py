@@ -12,6 +12,9 @@ from . import fleet_kv              # noqa: F401
 from . import million_user_day      # noqa: F401
 from . import ps_recommender        # noqa: F401
 from . import moe_training          # noqa: F401
+from . import long_context          # noqa: F401
+from . import tracing               # noqa: F401
+from . import observability         # noqa: F401
 from . import sdc                   # noqa: F401
 from . import elastic               # noqa: F401
 from . import reliable_step         # noqa: F401

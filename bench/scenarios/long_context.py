@@ -1,0 +1,395 @@
+"""Scenario: fault-tolerant long-context (sequence-parallel) training
+(ISSUE 20).
+
+Ring attention trained through the long-context plane — every sequence
+shard's K/V block placed primary+follower on the stable hash ring, the
+blockwise pass running THROUGH the fleet (pass-start reads and every
+ring hop chaos/liveness-gated and priced per link class), the
+``(o, lse)`` accumulator merged only on pass COMPLETION — everything on
+the virtual cost-model clock (ZERO wall-clock; run twice, the artifact
+is byte-identical). The 32k budget gates price the target shape
+(SEP composed with interleaved-VPP and hierarchical collectives)
+through the same cost model as the multichip ladder.
+
+Drills and gates:
+  1. **Transparency** — the fleet-mediated 8-host ring replays the same
+     trace as a single-host twin running the identical blockwise
+     arithmetic without the fleet: per-step loss CRC chains, the
+     trained head, AND the final attention output must be bitwise.
+  2. **LSE-merge conservation ledger** — after EVERY step (and re-run
+     after chaos heals), every query block's merged output is
+     re-derived from the recorded per-block partials (softmax weights
+     must sum to exactly 1) and checked against the float64
+     full-attention oracle, causal mask included — at f64 resolution.
+  3. **Mid-pass host kill** — ``kill_seq_host`` chaos fires on a ring
+     hop of step 3: the partial pass commits NOTHING, the follower is
+     promoted at the next probe sweep (MTTR inside the 2x-probe
+     budget), the ring re-forms over the survivors, and the interrupted
+     step replays BITWISE vs the clean twin through ReliableStep.
+  4. **32k schedule budgets, gated both ways** — at the 32k target
+     shape the slice-contiguous ring order and the slice-bucketed
+     Ulysses a2a must fit their per-step budgets while the interleaved
+     / flat schedules must FAIL them (the lever is load-bearing).
+  5. **Interleaved-VPP composition** — virtual stages shrink the
+     pipeline bubble (3/32 vs 3/8 at pp=4, m=8) and therefore the
+     modeled 32k step; the composed step must beat the uninterleaved
+     one.
+  6. **Ring vs Ulysses selection** — the selector must respect head
+     divisibility (heads % n != 0 leaves ring as the only option) and
+     otherwise pick the cheaper priced schedule; a real (small)
+     Ulysses plane must close its ledger and the indivisible
+     configuration must be rejected with the typed HeadShardingError.
+  7. **Degraded twin** — the same kill drill with the probe sweep
+     slowed 50x must FAIL at least one gate (the gates measure the
+     recovery machinery, not the weather).
+"""
+
+import numpy as np
+
+from ..artifact import bench_scratch, log
+from . import registry
+
+SEQ, HEADS, HEAD_DIM, BATCH = 512, 4, 8, 1
+E = HEADS * HEAD_DIM
+HOSTS, HOSTS_PER_SLICE = 8, 2
+PROBE_S = 0.02
+STEPS = 4
+LR = 0.05
+# 32k target shape priced through the cost model (the ladder idiom)
+SEQ32K, HEADS32, DIM32, LAYERS32 = 32768, 8, 64, 8
+PP, MICROBATCHES, VSTAGES = 4, 8, 4
+RING_STEP_BUDGET_S = 0.35   # hier ~0.271 fits, flat ~0.478 fails
+A2A_BUDGET_S = 0.12         # hier ~0.109 fits, flat ~0.135 fails
+
+
+def build(scenario):
+    import zlib
+    from paddle2_tpu.distributed import mesh as mesh_mod
+    from paddle2_tpu.distributed.fault_tolerance import chaos
+    from paddle2_tpu.distributed.longseq_fleet import (
+        LongSeqPlane, SeqHostFleet, head_step_np,
+        model_long_context_step, preferred_attention, ring_attend_np)
+    from paddle2_tpu.distributed.sep import HeadShardingError
+    from paddle2_tpu.observability import metrics
+    from paddle2_tpu.observability.cost_model import LinkModel
+
+    mesh_mod.init_mesh({"dp": 1})
+    metrics_dir = bench_scratch("long_context_metrics",
+                                env_var=scenario.streams["metrics"])
+    link = LinkModel(ici_latency_us=1.0, dcn_latency_us=250.0)
+
+    def make_plane(probe_interval_s=PROBE_S, attn="ring",
+                   heads=HEADS, head_dim=HEAD_DIM,
+                   schedule="hierarchical"):
+        fleet = SeqHostFleet(
+            num_hosts=HOSTS, hosts_per_slice=HOSTS_PER_SLICE,
+            probe_interval_s=probe_interval_s, link=link, seed=0)
+        return LongSeqPlane(
+            fleet, seq_len=SEQ, heads=heads, head_dim=head_dim,
+            batch=BATCH, causal=True, attn=attn, schedule=schedule,
+            link=link, lr=LR, seed=0)
+
+    rng = np.random.RandomState(7)
+    trace = [(rng.standard_normal((BATCH, SEQ, E)),
+              rng.standard_normal((BATCH, SEQ, E)))
+             for _ in range(STEPS)]
+
+    def crc(b):
+        return zlib.crc32(b) & 0xFFFFFFFF
+
+    def chain_and_crcs(plane_losses, plane):
+        chain = 0
+        for loss in plane_losses:
+            chain = crc(np.int64(chain).tobytes()
+                        + np.float64(loss).tobytes())
+        return (chain, crc(plane.head.wo.tobytes()),
+                crc(plane.last_output.tobytes()))
+
+    metrics.enable(metrics_dir, rank=0, flush_steps=1)
+    gates = {}
+
+    # -- drill 1+2: fleet transparency + the LSE ledger every step -----
+    plane = make_plane()
+    losses = []
+    spent = 0.0
+    for x, y in trace:
+        losses.append(plane.train_step(x.copy(), y.copy()))
+        # stamp the virtual step cost as the modeled step lane so
+        # perf_doctor diff verdicts ride it (exactly 0% across runs)
+        metrics.step_end(
+            modeled_step_s=round(plane.clock.t - spent, 12),
+            tokens=BATCH * SEQ)
+        spent = plane.clock.t
+    clean = chain_and_crcs(losses, plane)
+
+    twin = make_plane()            # parameter container only: no fleet
+    wo = twin.head.wo.copy()
+    twin_losses = []
+    o = None
+    for x, y in trace:
+        q, k, v = twin.project(x.copy())
+        o, _lse, _parts = ring_attend_np(
+            q, k, v, n=HOSTS, scale=twin.scale, causal=True)
+        loss, wo = head_step_np(o, y.copy(), wo, LR)
+        twin_losses.append(loss)
+    twin_chain = 0
+    for loss in twin_losses:
+        twin_chain = crc(np.int64(twin_chain).tobytes()
+                         + np.float64(loss).tobytes())
+    gates["sync_parity_bitwise"] = bool(
+        clean[0] == twin_chain
+        and clean[1] == crc(wo.tobytes())
+        and clean[2] == crc(o.tobytes()))
+    gates["lse_ledger_closes_every_step"] = bool(
+        plane.audits_ok() and len(plane.lse_audits) == STEPS)
+    worst = max(max(a["max_conservation_err"], a["max_oracle_err"])
+                for a in plane.lse_audits)
+    log(f"long-context parity: chain {clean[0]:#010x} vs "
+        f"{twin_chain:#010x} ledger_worst_err={worst:.3e} "
+        f"hops={plane.hop_counts}")
+
+    # -- drill 3: mid-pass host kill vs the clean twin -----------------
+    def kill_drill(probe_interval_s):
+        p = make_plane(probe_interval_s=probe_interval_s)
+        fleet = p.fleet
+        victim = sorted({fleet.primary_of(s)
+                         for s in range(HOSTS)})[0]
+        owned = sum(1 for s in range(HOSTS)
+                    if fleet.primary_of(s) == victim)
+        # victim ops/step = (distribute + pass-start read + n-1 hop
+        # sends) per owned shard; fire on step 3's FIRST ring hop —
+        # mid-pass, with the accumulator un-merged
+        nth = 2 * 9 * owned + 2 * owned + 1
+        chaos.arm(f"kill_seq_host:{nth}:{victim}")
+        kl = []
+        try:
+            for x, y in trace:
+                kl.append(p.train_step(x.copy(), y.copy()))
+            fired = [k for k, _ in chaos.fired_log()]
+        finally:
+            chaos.disarm()
+        fleet.quiesce(p.clock.t)
+        post = p.audit_now()          # the post-chaos ledger audit
+        return {
+            "fired": "kill_seq_host" in fired,
+            "victim": victim,
+            "retries": p.reliable.stats["retries"],
+            "mttr_s": fleet.last_mttr_s(),
+            "failovers": fleet.failovers,
+            "reformations": fleet.reformations,
+            "resyncs": fleet.resyncs,
+            "ledger": fleet.ledger(),
+            "audits_ok": bool(p.audits_ok() and post["ok"]),
+            "bitwise_vs_clean": bool(
+                chain_and_crcs(kl, p) == clean),
+        }
+
+    mttr_budget_s = 2.0 * PROBE_S  # from the BASE probe interval
+    kd = kill_drill(PROBE_S)
+    gates["kill_fired_and_replayed"] = bool(
+        kd["fired"] and kd["retries"] >= 1 and kd["failovers"] >= 1
+        and kd["reformations"] >= 1)
+    gates["kill_mttr_within_budget"] = bool(
+        kd["fired"] and 0.0 < kd["mttr_s"] <= mttr_budget_s)
+    gates["kill_bitwise_vs_clean"] = bool(kd["bitwise_vs_clean"])
+    gates["shard_ledger_closes"] = bool(kd["ledger"]["ok"])
+    gates["lse_ledger_closes_after_chaos"] = bool(kd["audits_ok"])
+    log(f"long-context kill: victim=host{kd['victim']} "
+        f"mttr={kd['mttr_s']*1e3:.3f}ms (budget "
+        f"{mttr_budget_s*1e3:.1f}ms) retries={kd['retries']} "
+        f"reformations={kd['reformations']} "
+        f"bitwise={kd['bitwise_vs_clean']}")
+
+    # -- drill 4: 32k schedule budgets, gated both ways ----------------
+    ring_h = model_long_context_step(
+        seq_len=SEQ32K, heads=HEADS32, head_dim=DIM32, batch=BATCH,
+        layers=LAYERS32, num_hosts=HOSTS,
+        hosts_per_slice=HOSTS_PER_SLICE, attn="ring",
+        schedule="hierarchical", pp=PP, microbatches=MICROBATCHES,
+        virtual_stages=VSTAGES, link=link)
+    ring_f = model_long_context_step(
+        seq_len=SEQ32K, heads=HEADS32, head_dim=DIM32, batch=BATCH,
+        layers=LAYERS32, num_hosts=HOSTS,
+        hosts_per_slice=HOSTS_PER_SLICE, attn="ring",
+        schedule="flat", pp=PP, microbatches=MICROBATCHES,
+        virtual_stages=VSTAGES, link=link)
+    uly_h = model_long_context_step(
+        seq_len=SEQ32K, heads=HEADS32, head_dim=DIM32, batch=BATCH,
+        layers=LAYERS32, num_hosts=HOSTS,
+        hosts_per_slice=HOSTS_PER_SLICE, attn="ulysses",
+        schedule="hierarchical", pp=PP, microbatches=MICROBATCHES,
+        virtual_stages=VSTAGES, link=link)
+    uly_f = model_long_context_step(
+        seq_len=SEQ32K, heads=HEADS32, head_dim=DIM32, batch=BATCH,
+        layers=LAYERS32, num_hosts=HOSTS,
+        hosts_per_slice=HOSTS_PER_SLICE, attn="ulysses",
+        schedule="flat", pp=PP, microbatches=MICROBATCHES,
+        virtual_stages=VSTAGES, link=link)
+    gates["ring_hier_within_budget"] = bool(
+        0.0 < ring_h["step_s"] <= RING_STEP_BUDGET_S)
+    gates["ring_flat_fails_budget"] = bool(
+        ring_f["step_s"] > RING_STEP_BUDGET_S)
+    gates["a2a_hier_within_budget"] = bool(
+        0.0 < uly_h["attn_comm_s"] <= A2A_BUDGET_S)
+    gates["a2a_flat_fails_budget"] = bool(
+        uly_f["attn_comm_s"] > A2A_BUDGET_S)
+    log(f"long-context 32k: ring hier={ring_h['step_s']*1e3:.1f}ms "
+        f"flat={ring_f['step_s']*1e3:.1f}ms "
+        f"(budget {RING_STEP_BUDGET_S*1e3:.0f}ms) a2a "
+        f"hier={uly_h['attn_comm_s']*1e3:.1f}ms "
+        f"flat={uly_f['attn_comm_s']*1e3:.1f}ms "
+        f"(budget {A2A_BUDGET_S*1e3:.0f}ms)")
+
+    # -- drill 5: interleaved-VPP composition --------------------------
+    ring_v1 = model_long_context_step(
+        seq_len=SEQ32K, heads=HEADS32, head_dim=DIM32, batch=BATCH,
+        layers=LAYERS32, num_hosts=HOSTS,
+        hosts_per_slice=HOSTS_PER_SLICE, attn="ring",
+        schedule="hierarchical", pp=PP, microbatches=MICROBATCHES,
+        virtual_stages=1, link=link)
+    gates["vpp_interleave_reduces_bubble"] = bool(
+        ring_h["bubble_fraction"] < ring_v1["bubble_fraction"]
+        and ring_h["step_s"] < ring_v1["step_s"])
+    log(f"long-context vpp: bubble v{VSTAGES}="
+        f"{ring_h['bubble_fraction']:.4f} v1="
+        f"{ring_v1['bubble_fraction']:.4f} step "
+        f"{ring_h['step_s']*1e3:.1f}ms vs "
+        f"{ring_v1['step_s']*1e3:.1f}ms")
+
+    # -- drill 6: ring-vs-Ulysses selection + a real Ulysses plane -----
+    sel_indiv = preferred_attention(
+        seq_len=SEQ32K, heads=HEADS32 - 2, head_dim=DIM32,
+        batch=BATCH, layers=LAYERS32, num_hosts=HOSTS,
+        hosts_per_slice=HOSTS_PER_SLICE, link=link)
+    sel_div = preferred_attention(
+        seq_len=SEQ32K, heads=HEADS32, head_dim=DIM32, batch=BATCH,
+        layers=LAYERS32, num_hosts=HOSTS,
+        hosts_per_slice=HOSTS_PER_SLICE, link=link)
+    cheaper = "ring" if sel_div["ring_comm_s"] \
+        <= sel_div["ulysses_comm_s"] else "ulysses"
+    gates["selection_respects_head_divisibility"] = bool(
+        sel_indiv["choice"] == "ring"
+        and sel_indiv["reason"] == "heads_not_divisible"
+        and sel_div["choice"] == cheaper)
+    plane_u = make_plane(attn="ulysses", heads=8, head_dim=4)
+    for x, y in trace[:2]:
+        plane_u.train_step(x.copy(), y.copy())
+    try:
+        make_plane(attn="ulysses", heads=HEADS, head_dim=HEAD_DIM)
+        typed_rejection = False
+    except HeadShardingError:
+        typed_rejection = True
+    gates["ulysses_ledger_and_typed_rejection"] = bool(
+        plane_u.audits_ok() and len(plane_u.lse_audits) == 2
+        and typed_rejection)
+    log(f"long-context selection: heads={HEADS32 - 2} -> "
+        f"{sel_indiv['choice']} ({sel_indiv['reason']}); "
+        f"heads={HEADS32} -> {sel_div['choice']} "
+        f"(ring={sel_div['ring_comm_s']*1e3:.1f}ms "
+        f"uly={sel_div['ulysses_comm_s']*1e3:.1f}ms); "
+        f"ulysses plane audits={plane_u.audits_ok()}")
+
+    # -- drill 7: the degraded twin must fail --------------------------
+    kd_slow = kill_drill(50.0 * PROBE_S)
+    degraded_gates = {
+        "kill_mttr_within_budget": bool(
+            kd_slow["fired"]
+            and 0.0 < kd_slow["mttr_s"] <= mttr_budget_s),
+        "kill_bitwise_vs_clean": bool(kd_slow["bitwise_vs_clean"]),
+        "shard_ledger_closes": bool(kd_slow["ledger"]["ok"]),
+        "lse_ledger_closes_after_chaos": bool(kd_slow["audits_ok"]),
+    }
+    gates["degraded_twin_fails"] = not all(degraded_gates.values())
+    log(f"long-context degraded twin: "
+        f"mttr={kd_slow['mttr_s']*1e3:.1f}ms gates={degraded_gates} "
+        f"-> fails={gates['degraded_twin_fails']}")
+
+    metrics.flush()
+    metrics.export_prometheus()
+    metrics.disable()
+
+    return {
+        "metric": "long_context_drills",
+        "value": sum(bool(v) for v in gates.values()),
+        "unit": "gates_passed",
+        "model": {"seq_len": SEQ, "heads": HEADS,
+                  "head_dim": HEAD_DIM, "batch": BATCH,
+                  "chunk": SEQ // HOSTS},
+        "fleet": {"hosts": HOSTS, "hosts_per_slice": HOSTS_PER_SLICE,
+                  "probe_interval_us": round(PROBE_S * 1e6, 3)},
+        "parity": {"loss_crc_chain": clean[0],
+                   "single_host_crc_chain": twin_chain,
+                   "head_crc": clean[1], "output_crc": clean[2]},
+        "lse_ledger": {
+            "audits": len(plane.lse_audits),
+            "worst_err": float(f"{worst:.6e}"),
+            "tolerance": plane.ledger_tol,
+        },
+        "kill": {
+            "victim": kd["victim"],
+            "mttr_us": round(kd["mttr_s"] * 1e6, 3),
+            "mttr_budget_us": round(mttr_budget_s * 1e6, 3),
+            "retries": kd["retries"],
+            "failovers": kd["failovers"],
+            "ring_reformations": kd["reformations"],
+            "resyncs": kd["resyncs"],
+            "ledger": kd["ledger"],
+        },
+        "schedule_32k": {
+            "ring_hier_step_ms": round(ring_h["step_s"] * 1e3, 6),
+            "ring_flat_step_ms": round(ring_f["step_s"] * 1e3, 6),
+            "ring_budget_ms": round(RING_STEP_BUDGET_S * 1e3, 3),
+            "ring_hier_dispatches": ring_h["counts"],
+            "ring_flat_dispatches": ring_f["counts"],
+            "a2a_hier_ms": round(uly_h["attn_comm_s"] * 1e3, 6),
+            "a2a_flat_ms": round(uly_f["attn_comm_s"] * 1e3, 6),
+            "a2a_budget_ms": round(A2A_BUDGET_S * 1e3, 3),
+            "tokens_per_s": round(ring_h["tokens_per_s"], 3),
+            "bubble_fraction": ring_h["bubble_fraction"],
+            "bubble_fraction_v1": ring_v1["bubble_fraction"],
+        },
+        "selection": {
+            "indivisible_choice": sel_indiv["choice"],
+            "indivisible_reason": sel_indiv["reason"],
+            "divisible_choice": sel_div["choice"],
+            "ring_comm_ms": round(sel_div["ring_comm_s"] * 1e3, 6),
+            "ulysses_comm_ms": round(
+                sel_div["ulysses_comm_s"] * 1e3, 6),
+        },
+        "degraded_twin": {
+            "probe_slowdown": 50.0,
+            "mttr_us": round(kd_slow["mttr_s"] * 1e6, 3),
+            "gates": degraded_gates,
+        },
+        "gates": gates,
+    }
+
+
+SCENARIO = registry.register(registry.Scenario(
+    name="long-context",
+    artifact="LONG_CONTEXT_r01.json",
+    build=build,
+    description="fault-tolerant sequence-parallel training: hash-ring "
+                "K/V shard placement, chaos-hardened ring attention "
+                "with mid-pass kill healed by ring re-formation and "
+                "bitwise step replay, exact LSE-merge conservation "
+                "ledger, 32k schedule budgets gated both ways",
+    model={"seq_len": SEQ, "heads": HEADS, "head_dim": HEAD_DIM,
+           "target": {"seq_len": SEQ32K, "heads": HEADS32,
+                      "head_dim": DIM32, "layers": LAYERS32}},
+    parallelism={"seq_hosts": HOSTS,
+                 "hosts_per_slice": HOSTS_PER_SLICE,
+                 "pp": PP, "virtual_stages": VSTAGES},
+    trace={"steps": STEPS, "seed": 7},
+    gates=("sync_parity_bitwise", "lse_ledger_closes_every_step",
+           "kill_fired_and_replayed", "kill_mttr_within_budget",
+           "kill_bitwise_vs_clean", "shard_ledger_closes",
+           "lse_ledger_closes_after_chaos",
+           "ring_hier_within_budget", "ring_flat_fails_budget",
+           "a2a_hier_within_budget", "a2a_flat_fails_budget",
+           "vpp_interleave_reduces_bubble",
+           "selection_respects_head_divisibility",
+           "ulysses_ledger_and_typed_rejection",
+           "degraded_twin_fails"),
+    streams={"metrics": "BENCH_LONG_CONTEXT_METRICS_DIR"},
+))

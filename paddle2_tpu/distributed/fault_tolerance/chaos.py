@@ -80,7 +80,7 @@ KINDS = ("corrupt_shard", "truncate_shard", "fail_commit", "poison_loss",
          "kill_engine", "drop_decode_step", "corrupt_block_table",
          "corrupt_spill_block", "drop_migration",
          "kill_ps_server", "corrupt_shard_delta", "drop_push",
-         "kill_expert_host")
+         "kill_expert_host", "kill_seq_host")
 
 _FLIP_WHERES = ("grads", "collective")
 
@@ -689,6 +689,30 @@ def maybe_kill_expert_host(host_id: int, op: str = "?") -> bool:
     return False
 
 
+def maybe_kill_seq_host(host_id: int, op: str = "?") -> bool:
+    """Sequence-parallel fleet hook (ISSUE 20), called on every op a
+    ring host handles (the per-step K/V distribute and EVERY ring hop
+    of the blockwise-attention pass): True when THIS host must die now.
+    The occurrence counter ticks only on the victim host (the
+    ``kill_expert_host`` idiom — param names the victim, default host
+    0), so ``nth`` means "the victim's nth op" — which is how the lane
+    lands the kill mid-ring-pass. The fleet marks the host dead; the
+    partial ``(o, lse)`` accumulator is discarded (a partial pass
+    commits NOTHING), the shard's follower is promoted at the next
+    probe sweep, the ring re-forms over the survivors, and the
+    interrupted step replays bitwise through ``ReliableStep``."""
+    if _ACTIVE is None or not _ACTIVE.armed("kill_seq_host"):
+        return False
+    hid = int(host_id)
+    sp = _ACTIVE.should_fire(
+        "kill_seq_host",
+        gate=lambda s: hid == (0 if s.param is None else int(s.param)))
+    if sp is not None:
+        _ACTIVE.record("kill_seq_host", f"host{hid}:{op}")
+        return True
+    return False
+
+
 def maybe_corrupt_shard_delta(payload) -> bool:
     """PS replication hook: flip one byte of a primary->follower shard
     delta AFTER its CRC was stamped — the deterministic stand-in for a
@@ -752,4 +776,4 @@ __all__ = ["ChaosInjector", "arm", "disarm", "active", "fired_log",
            "maybe_corrupt_spill_block", "maybe_drop_migration",
            "maybe_kill_ps_server", "maybe_corrupt_shard_delta",
            "maybe_drop_push", "maybe_kill_expert_host",
-           "CORRUPT_BLOCK_ID", "KINDS"]
+           "maybe_kill_seq_host", "CORRUPT_BLOCK_ID", "KINDS"]

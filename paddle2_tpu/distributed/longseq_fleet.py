@@ -1,0 +1,1036 @@
+"""The fault-tolerant long-context (sequence-parallel) training plane.
+
+Single-process stand-ins for N ring hosts driven entirely by the
+caller's virtual clock (``now`` arguments) — no wall-clock anywhere, so
+every drill on this plane is bit-reproducible. The reliability contract
+mirrors the PR 17 parameter-server fleet and the PR 18 MoE plane,
+applied to ring attention (Liu et al., Ring Attention with Blockwise
+Transformers) and Ulysses sequence parallelism:
+
+- every sequence shard's K/V block lives on a **primary** and a
+  **follower** host (consistent-hash placement,
+  :class:`~.ps.sharding.HashRing`); the per-step distribute commits the
+  new batch's blocks transactionally (liveness phase first, nothing
+  written on abort) to the primary and ships a full-copy replica to the
+  follower, priced on the fabric between their slices;
+- a dead host is detected at the next **probe sweep**
+  (:meth:`SeqHostFleet.maybe_probe` — the lazily-anchored cadence of
+  ``health.py``), so detection latency is INSIDE the gated MTTR;
+- promotion is a placement recomputation — the ring guarantees the dead
+  primary's first distinct successor is exactly the current follower,
+  so the K/V bytes are already there; the blockwise RING RE-FORMS over
+  the survivors (the rotation order is recomputed from the live
+  placement on the next pass) and only the replacement follower pays a
+  full-copy resync (priced per link class);
+- ``kill_seq_host`` chaos enters through the same per-op gate as every
+  real op (:meth:`SeqHostFleet._op` — the distribute walk, the
+  pass-start block read, EVERY ring hop), raising the typed
+  :class:`SeqHostFailedError` — a ``TransientStepError`` — so a
+  :class:`~.fault_tolerance.reliable.ReliableStep`-wrapped step replays
+  BITWISE once the probe sweep heals the placement. The property that
+  makes the replay bitwise: a partial ring pass commits NOTHING. The
+  online-softmax ``(o, lse)`` accumulator is a step-local value merged
+  only on a COMPLETED pass, so the replayed step starts from exactly
+  the pre-step state;
+- correctness is audited by the **LSE-merge conservation ledger**
+  (:meth:`LongSeqPlane._audit`): after every step and every chaos
+  event, every query block's merged output is re-derived in float64
+  from the recorded per-block partials (the softmax weights of a
+  merged block must sum to EXACTLY one, and the weighted block outputs
+  must reproduce the merged output) and checked against the float64
+  full-attention oracle (:func:`block_attn_lse_np` over the whole
+  sequence, causal masking included). Exact means exact at f64
+  resolution: the gate tolerance (1e-9) sits six orders of magnitude
+  above the observed f64 re-association noise (~1e-13 for the lane's
+  shapes) and six below any real accumulator corruption.
+
+Transport is priced per ICI/DCN link class through
+:class:`CollectiveTraffic`: each ring hop is a point-to-point K/V block
+pass between consecutive ring members (slice-contiguous member order
+pays one DCN α per slice boundary per rotation; the interleaved "flat"
+order pays one per hop — the lane requires the flat schedule to FAIL
+the step budget), and each Ulysses all-to-all is priced from its exact
+per-pair byte matrix via ``add_all_to_all_matrix`` — the PR 14
+α-dominance discipline.
+
+Numerics note (load-bearing for the bitwise gates): the blockwise merge
+order for query chunk ``i`` is the canonical ring arrival order
+``j = i, i-1, ..., i-n+1 (mod n)`` — a function of SHARD ids only.
+Failover moves a shard's bytes to a different HOST and the transport
+schedule decides which fabric carries each hop, but neither changes the
+merge order, which is why the 8-host ring, the post-failover ring, and
+the single-host full-attention twin (same blockwise arithmetic, no
+fleet) agree bitwise.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability import metrics
+from ..observability.cost_model import (CollectiveTraffic, LinkModel,
+                                        pipeline_bubble_fraction,
+                                        sparse_transfer_seconds)
+from .fault_tolerance import chaos
+from .fault_tolerance.health import HealthReport
+from .fault_tolerance.reliable import ReliableStep, TransientStepError
+from .moe_fleet import params_crc, price_all_to_all
+from .ps.client import VirtualClock
+from .ps.sharding import HashRing
+from .sep import HeadShardingError
+
+__all__ = ["LongSeqPlaneError", "SeqHostFailedError", "SeqHost",
+           "SeqHostFleet", "LongSeqPlane", "seq_flight",
+           "block_attn_lse_np", "merge_np", "causal_block_mask",
+           "ring_attend_np", "full_attention_np", "head_step_np",
+           "ring_member_slices", "model_long_context_step",
+           "preferred_attention"]
+
+_NEG = float("-inf")
+
+
+def seq_flight(**fields) -> None:
+    """One shared emitter for every sequence-parallel flight-recorder
+    span (``kind="sep"``): host kills, failovers / ring re-formations,
+    resyncs, LSE-ledger breaches — rendered by flight_doctor's
+    SEQUENCE PARALLEL section. None-valued fields are dropped; the
+    recorder keeps its one-attribute-load no-op when disabled."""
+    from .fault_tolerance import flight_recorder
+    flight_recorder.record("sep", **{k: v for k, v in fields.items()
+                                     if v is not None})
+
+
+class LongSeqPlaneError(RuntimeError):
+    """Base for sequence-parallel plane failures."""
+
+
+class SeqHostFailedError(LongSeqPlaneError, TransientStepError):
+    """A ring host died under an op (distribute walk, pass-start block
+    read, or a mid-pass ring hop). Transient: the partial ``(o, lse)``
+    accumulator is discarded (a partial pass commits NOTHING), the
+    probe sweep promotes the shard's follower and re-forms the ring,
+    and a ReliableStep retry after backoff replays the step bitwise."""
+
+    def __init__(self, host: int, shard: int = -1, op: str = "?"):
+        self.host, self.shard, self.op = int(host), int(shard), op
+        LongSeqPlaneError.__init__(
+            self, f"seq host {host} failed during {op!r}"
+            + (f" (shard {shard})" if shard >= 0 else ""))
+
+
+# ------------------------------------------------------------------ oracle
+# float64 numpy mirrors of sep.py's jnp _block_attn_lse / _merge — the
+# arithmetic is IDENTICAL term for term (same m_safe clamp, same 1e-30
+# floor, same masked-row conventions) so the plane's blockwise math IS
+# the oracle's math, just blockwise vs full-sequence.
+
+def block_attn_lse_np(q: np.ndarray, k: np.ndarray, v: np.ndarray,
+                      scale: float, mask: Optional[np.ndarray] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Full (small-block) attention in float64 returning ``(out, lse)``.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; mask: None or a bool
+    [Sq, Sk] matrix (True = attend). Fully-masked rows return
+    ``lse = -inf`` and a zero output row (weight 0 under
+    :func:`merge_np`)."""
+    qh = np.swapaxes(np.asarray(q, np.float64), 1, 2)
+    kh = np.swapaxes(np.asarray(k, np.float64), 1, 2)
+    vh = np.swapaxes(np.asarray(v, np.float64), 1, 2)
+    s = np.einsum("bhsd,bhtd->bhst", qh, kh) * float(scale)
+    if mask is not None:
+        s = np.where(mask, s, _NEG)
+    m = np.max(s, axis=-1)                                   # [B,H,Sq]
+    m_safe = np.where(m == _NEG, 0.0, m)
+    p = np.exp(s - m_safe[..., None])
+    p = np.where(s == _NEG, 0.0, p)
+    l = np.sum(p, axis=-1)                                   # [B,H,Sq]
+    o = np.einsum("bhst,bhtd->bhsd", p, vh)
+    o = o / np.maximum(l, 1e-30)[..., None]
+    lse = np.where(l == 0.0, _NEG,
+                   m_safe + np.log(np.maximum(l, 1e-30)))
+    return np.swapaxes(o, 1, 2), lse
+
+
+def merge_np(o1: np.ndarray, lse1: np.ndarray,
+             o2: np.ndarray, lse2: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray]:
+    """Log-sum-exp merge of two partial attention results in float64 —
+    sep.py's ``_merge`` term for term. Stable under large-negative lse
+    (the exp is always of a non-positive shifted value) and under
+    fully-masked ``-inf`` blocks (weight exactly 0, so merging with an
+    ``-inf`` accumulator returns the other side BITWISE — which is why
+    the zero-init accumulator never perturbs the first block)."""
+    o1 = np.asarray(o1, np.float64)
+    o2 = np.asarray(o2, np.float64)
+    m = np.maximum(lse1, lse2)
+    m_safe = np.where(m == _NEG, 0.0, m)
+    with np.errstate(invalid="ignore"):
+        w1 = np.where(lse1 == _NEG, 0.0, np.exp(lse1 - m_safe))
+        w2 = np.where(lse2 == _NEG, 0.0, np.exp(lse2 - m_safe))
+    tot = np.maximum(w1 + w2, 1e-30)
+    o = (o1 * np.swapaxes(w1, 1, 2)[..., None]
+         + o2 * np.swapaxes(w2, 1, 2)[..., None]) \
+        / np.swapaxes(tot, 1, 2)[..., None]
+    with np.errstate(divide="ignore"):
+        lse = np.where((w1 + w2) == 0.0, _NEG, m_safe + np.log(tot))
+    return o, lse
+
+
+def causal_block_mask(i: int, j: int, chunk: int
+                      ) -> Optional[np.ndarray]:
+    """The ring's causal block predicate (sep.py's ``_ring_body``
+    convention, block-major token order): query rows live at global
+    indices ``[i*chunk, (i+1)*chunk)`` and the held KV block originated
+    on chunk ``j`` — so ``j < i`` attends the full block, ``j == i`` is
+    intra-chunk lower-triangular, ``j > i`` is fully masked (every KV
+    column is in the future). Returns None for the full block (no mask
+    needed), else the bool [chunk, chunk] mask."""
+    if j < i:
+        return None
+    if j == i:
+        return np.tril(np.ones((chunk, chunk), bool))
+    return np.zeros((chunk, chunk), bool)
+
+
+def ring_attend_np(q: np.ndarray, k: np.ndarray, v: np.ndarray, *,
+                   n: int, scale: float, causal: bool = True,
+                   blocks: Optional[Dict[int, Dict[str, np.ndarray]]]
+                   = None
+                   ) -> Tuple[np.ndarray, np.ndarray,
+                              List[List[Tuple[int, np.ndarray,
+                                              np.ndarray]]]]:
+    """The blockwise ring-attention arithmetic in float64, shared by
+    the fleet-mediated plane and the single-host twin so their outputs
+    are BITWISE equal: query chunk ``i`` merges KV blocks in the
+    canonical ring arrival order ``j = (i - t) mod n``. ``blocks``
+    optionally supplies the KV bytes (the plane passes the
+    fleet-stored replicas; the twin slices locally). Returns
+    ``(o [B,S,H,D], lse [B,H,S], partials)`` where ``partials[i]`` is
+    the per-block ``(j, o_b, lse_b)`` list the conservation ledger
+    re-derives the merge from."""
+    q = np.asarray(q, np.float64)
+    B, S, H, D = q.shape
+    if S % n != 0:
+        raise LongSeqPlaneError(
+            f"seq len {S} not divisible by ring degree {n}")
+    chunk = S // n
+    if blocks is None:
+        k = np.asarray(k, np.float64)
+        v = np.asarray(v, np.float64)
+        blocks = {j: {"k": k[:, j * chunk:(j + 1) * chunk],
+                      "v": v[:, j * chunk:(j + 1) * chunk]}
+                  for j in range(n)}
+    o = np.zeros((B, S, H, D), np.float64)
+    lse = np.full((B, H, S), _NEG, np.float64)
+    partials: List[List[Tuple[int, np.ndarray, np.ndarray]]] = []
+    for i in range(n):
+        qi = q[:, i * chunk:(i + 1) * chunk]
+        oi = np.zeros((B, chunk, H, D), np.float64)
+        li = np.full((B, H, chunk), _NEG, np.float64)
+        parts: List[Tuple[int, np.ndarray, np.ndarray]] = []
+        for t in range(n):
+            j = (i - t) % n
+            mask = causal_block_mask(i, j, chunk) if causal else None
+            o_b, lse_b = block_attn_lse_np(
+                qi, blocks[j]["k"], blocks[j]["v"], scale, mask)
+            parts.append((j, o_b, lse_b))
+            oi, li = merge_np(oi, li, o_b, lse_b)
+        o[:, i * chunk:(i + 1) * chunk] = oi
+        lse[:, :, i * chunk:(i + 1) * chunk] = li
+        partials.append(parts)
+    return o, lse, partials
+
+
+def full_attention_np(q: np.ndarray, k: np.ndarray, v: np.ndarray, *,
+                      scale: float, causal: bool = True
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """The float64 full-softmax oracle: one global block, one global
+    causal mask — what every ring/Ulysses result is audited against."""
+    q = np.asarray(q, np.float64)
+    S = q.shape[1]
+    mask = np.tril(np.ones((S, S), bool)) if causal else None
+    return block_attn_lse_np(q, k, v, scale, mask)
+
+
+def head_step_np(o: np.ndarray, y: np.ndarray, wo: np.ndarray,
+                 lr: float) -> Tuple[float, np.ndarray]:
+    """The plane's (deliberately small) trainable tail: a linear output
+    head under MSE, closed-form gradient, shared by plane and twin so
+    the training trajectory is bitwise-comparable. Returns
+    ``(loss, updated wo)``."""
+    B, S, H, D = o.shape
+    flat = o.reshape(B * S, H * D)
+    err = flat @ wo - np.asarray(y, np.float64).reshape(B * S, -1)
+    loss = float(np.mean(err * err))
+    grad = (2.0 / err.size) * (flat.T @ err)
+    return loss, wo - float(lr) * grad
+
+
+# ------------------------------------------------------------------- fleet
+class SeqHost:
+    """One modeled ring host: alive flag + the K/V sequence-shard
+    replicas it currently holds (primary AND follower roles — the
+    fleet's placement says which is which)."""
+
+    def __init__(self, host_id: int):
+        self.id = int(host_id)
+        self.alive = True
+        self.shards: Dict[int, Dict[str, np.ndarray]] = {}
+        self.ops = 0
+
+
+class SeqHostFleet:
+    """N modeled ring hosts holding one sequence shard each (shard s =
+    sequence chunk s of the current batch's K/V). All methods take the
+    caller's virtual ``now``. Hosts are grouped into ICI slices of
+    ``hosts_per_slice`` consecutive ids; traffic between slices rides
+    the DCN."""
+
+    def __init__(self, num_hosts: int = 8, hosts_per_slice: int = 2,
+                 probe_interval_s: float = 0.02,
+                 link: Optional[LinkModel] = None, seed: int = 0):
+        if probe_interval_s <= 0:
+            raise ValueError(
+                f"probe_interval_s must be > 0, got {probe_interval_s}")
+        self.num_hosts = int(num_hosts)
+        self.num_shards = int(num_hosts)
+        self.hosts_per_slice = max(1, int(hosts_per_slice))
+        self.probe_interval_s = float(probe_interval_s)
+        self.ring = HashRing(num_hosts, num_shards=self.num_shards,
+                             seed=seed)
+        self.hosts = [SeqHost(i) for i in range(self.num_hosts)]
+        self.link = link or LinkModel()
+        self.traffic = CollectiveTraffic()
+        self.placement: Dict[int, Tuple[int, Optional[int]]] = \
+            self.ring.placement(tuple(range(self.num_hosts)))
+        self.events: List[Dict[str, Any]] = []
+        self.mttrs: List[float] = []
+        self.repair_s = 0.0
+        self.resyncs = 0
+        self.failovers = 0
+        self.reformations = 0
+        self._next_probe_t: Optional[float] = None
+        self._kill_t: Dict[int, float] = {}
+        self._handled_failures: set = set()
+        # flips True after the first COMMITTED distribute: before that,
+        # a failover has no bytes to inherit or resync (the replayed
+        # step re-attaches onto the re-formed placement from scratch)
+        self._attached = False
+
+    # -- placement ------------------------------------------------------
+    def _alive_ids(self) -> Tuple[int, ...]:
+        return tuple(h.id for h in self.hosts if h.alive)
+
+    def slice_of(self, host_id: int) -> int:
+        return int(host_id) // self.hosts_per_slice
+
+    def _link_class(self, a: int, b: int) -> str:
+        """Link class of a transfer between two hosts: co-located ⇒
+        the PCIe-class host channel (no fabric α), same slice ⇒ ICI,
+        cross-slice ⇒ DCN."""
+        if a == b:
+            return "host"
+        return "ici" if self.slice_of(a) == self.slice_of(b) else "dcn"
+
+    def primary_of(self, shard: int) -> int:
+        primary, _ = self.placement[int(shard)]
+        if primary is None:
+            raise LongSeqPlaneError(f"shard {shard} has no primary")
+        return primary
+
+    def worker_of(self, shard: int) -> int:
+        """The compute rank a shard's Q/K/V chunk is materialized on —
+        the fixed data-parallel home, independent of where the K/V
+        BYTES currently live (failover moves bytes, not compute)."""
+        return int(shard) % self.num_hosts
+
+    def ring_order(self, schedule: str = "hierarchical"
+                   ) -> List[Tuple[int, int]]:
+        """The transport schedule: ``(shard, primary host)`` pairs in
+        ring-member order, recomputed from the LIVE placement — which
+        is what "ring re-formation" means after a failover. The order
+        is the pricing lever only (the merge order is canonical, see
+        the module docstring):
+
+        - ``hierarchical``: slice-contiguous — consecutive members
+          share a slice wherever possible, one DCN α per slice
+          boundary per rotation;
+        - ``flat``: round-robin across slices — every hop crosses a
+          slice boundary, one DCN α per hop (the order the lane
+          requires to FAIL the budget).
+        """
+        if schedule not in ("hierarchical", "flat"):
+            raise ValueError(f"schedule={schedule!r}")
+        pairs = sorted(
+            ((s, self.primary_of(s)) for s in range(self.num_shards)),
+            key=lambda p: (self.slice_of(p[1]), p[1], p[0]))
+        if schedule == "hierarchical":
+            return pairs
+        groups: Dict[int, List[Tuple[int, int]]] = {}
+        for p in pairs:
+            groups.setdefault(self.slice_of(p[1]), []).append(p)
+        out: List[Tuple[int, int]] = []
+        chains = [groups[k] for k in sorted(groups)]
+        i = 0
+        while any(chains):
+            chain = chains[i % len(chains)]
+            if chain:
+                out.append(chain.pop(0))
+            i += 1
+        return out
+
+    def attach_shards(self, kv: Dict[int, Dict[str, np.ndarray]],
+                      now: float = 0.0) -> float:
+        """First-time placement of every shard's K/V block (primary +
+        follower), then :meth:`distribute` per step."""
+        if any(h.shards for h in self.hosts):
+            raise LongSeqPlaneError(
+                "shards already attached to this fleet")
+        return self.distribute(kv, now)
+
+    # -- liveness / chaos entry of every op -----------------------------
+    def _op(self, hid: int, op: str, shard: int, now: float) -> SeqHost:
+        host = self.hosts[hid]
+        host.ops += 1
+        if chaos.maybe_kill_seq_host(hid, op=op):
+            self.kill_host(hid, now)
+        if not host.alive:
+            raise SeqHostFailedError(hid, shard, op)
+        return host
+
+    def kill_host(self, hid: int, now: float) -> None:
+        host = self.hosts[hid]
+        if not host.alive:
+            return
+        host.alive = False
+        self._kill_t[hid] = float(now)
+        self.events.append({"event": "host_kill", "host": hid,
+                            "t": float(now)})
+        seq_flight(event="host_kill", host=hid, t=float(now))
+
+    # -- per-step K/V placement -----------------------------------------
+    def distribute(self, kv: Dict[int, Dict[str, np.ndarray]],
+                   now: float) -> float:
+        """TRANSACTIONAL placement of the step's K/V blocks: phase 1
+        walks each shard's primary through the per-op chaos/liveness
+        gate WITHOUT writing, phase 2 commits primaries and ships
+        follower replicas, priced per link class. A host death in
+        phase 1 aborts the whole transaction with nothing written, so
+        the ReliableStep replay re-distributes the SAME bytes onto the
+        re-formed placement — the property the bitwise-vs-clean-twin
+        gate rests on."""
+        if len(kv) != self.num_shards:
+            raise LongSeqPlaneError(
+                f"expected {self.num_shards} shards, got {len(kv)}")
+        staged: List[Tuple[int, int, Optional[int],
+                           Dict[str, np.ndarray]]] = []
+        seconds = 0.0
+        for s in sorted(kv):
+            primary, follower = self.placement[s]
+            if primary is None or not self.hosts[primary].alive:
+                raise SeqHostFailedError(
+                    -1 if primary is None else primary, s, "distribute")
+            self._op(primary, "distribute", s, now)
+            staged.append((s, primary, follower, kv[s]))
+        for s, primary, follower, blk in staged:
+            clean = {k: np.ascontiguousarray(np.asarray(v)).copy()
+                     for k, v in blk.items()}
+            nbytes = int(sum(a.nbytes for a in clean.values()))
+            wcls = self._link_class(self.worker_of(s), primary)
+            self.traffic.add(
+                "sep_kv_distribute", nbytes,
+                axes=("dcn",) if wcls == "dcn" else ("ici",),
+                group_size=2)
+            seconds += sparse_transfer_seconds(nbytes, wcls,
+                                               link=self.link)
+            self.hosts[primary].shards[s] = clean
+            if follower is not None and self.hosts[follower].alive:
+                rcls = self._link_class(primary, follower)
+                self.traffic.add(
+                    "sep_kv_replica", nbytes,
+                    axes=("dcn",) if rcls == "dcn" else ("ici",),
+                    group_size=2)
+                seconds += sparse_transfer_seconds(nbytes, rcls,
+                                                   link=self.link)
+                self.hosts[follower].shards[s] = {
+                    k: v.copy() for k, v in clean.items()}
+        self._attached = True
+        return seconds
+
+    # -- the ring pass transport ----------------------------------------
+    def read_block(self, shard: int, now: float
+                   ) -> Dict[str, np.ndarray]:
+        """Pass-start read of a shard's K/V bytes on its CURRENT
+        primary (after a failover this is the promoted follower — the
+        attention consumes the replica bytes, so replica fidelity is
+        load-bearing, not decorative). On-host, so no wire cost; still
+        a chaos/liveness-gated op."""
+        primary, _ = self.placement[int(shard)]
+        if primary is None or not self.hosts[primary].alive:
+            raise SeqHostFailedError(
+                -1 if primary is None else primary, shard, "ring_read")
+        host = self._op(primary, "ring_read", shard, now)
+        blk = host.shards.get(int(shard))
+        if blk is None:
+            raise LongSeqPlaneError(
+                f"shard {shard}: primary {primary} holds no bytes")
+        return {k: v.copy() for k, v in blk.items()}
+
+    def hop(self, src: int, dst: int, shard: int, block_bytes: int,
+            now: float) -> float:
+        """One ring hop: the member on ``src`` forwards its held K/V
+        block to its ring successor on ``dst``, chaos/liveness-gated on
+        the SENDER (a mid-pass death surfaces here) and priced per the
+        link class between their slices."""
+        self._op(src, "ring_hop", shard, now)
+        cls = self._link_class(src, dst)
+        self.traffic.add("sep_ring_hop", block_bytes,
+                         axes=("dcn",) if cls == "dcn" else ("ici",),
+                         group_size=2)
+        return sparse_transfer_seconds(block_bytes, cls,
+                                       link=self.link)
+
+    # -- probe sweeps / failover ----------------------------------------
+    def maybe_probe(self, now: float) -> None:
+        """Lazily-anchored probe cadence (the health-prober idiom): the
+        first call anchors the sweep clock; each elapsed interval runs
+        one sweep. Failover happens HERE, so detection latency is part
+        of the gated MTTR."""
+        if self._next_probe_t is None:
+            self._next_probe_t = float(now) + self.probe_interval_s
+            return
+        while now >= self._next_probe_t:
+            self.probe_now(self._next_probe_t)
+            self._next_probe_t += self.probe_interval_s
+
+    def probe_now(self, t: float) -> List[HealthReport]:
+        """One sweep: a HealthReport per host; newly-dead hosts get
+        their shards failed over (promotion + follower recruit) and
+        the ring re-forms."""
+        reports, newly_dead = [], []
+        for host in self.hosts:
+            rep = HealthReport(ok=host.alive, probe="sep_liveness",
+                               reason="" if host.alive
+                               else f"seq host {host.id} unreachable")
+            reports.append(rep)
+            if not rep.ok and host.id not in self._handled_failures:
+                self._handled_failures.add(host.id)
+                newly_dead.append(host.id)
+                metrics.inc("sep_host_failures_total")
+        if newly_dead:
+            self._failover(newly_dead, t)
+        return reports
+
+    def _failover(self, newly_dead: List[int], t: float) -> None:
+        old = dict(self.placement)
+        self.placement = self.ring.placement(self._alive_ids())
+        for s, (new_p, new_f) in sorted(self.placement.items()):
+            old_p, old_f = old[s]
+            if new_p != old_p:
+                # the ring guarantees the successor is the old
+                # follower: the K/V bytes are already on new_p —
+                # promotion is a placement recomputation, not a copy.
+                # Before the first committed distribute there are no
+                # bytes anywhere, so there is nothing to have lost.
+                if self._attached and s not in self.hosts[new_p].shards:
+                    raise LongSeqPlaneError(
+                        f"shard {s}: promoted host {new_p} holds no "
+                        f"replica — both replicas lost")
+                self.failovers += 1
+                metrics.inc("sep_failovers_total")
+                if old_p in self._kill_t:
+                    self.mttrs.append(float(t) - self._kill_t[old_p])
+                self.events.append({"event": "failover", "shard": s,
+                                    "old": old_p, "new": new_p,
+                                    "t": float(t)})
+                seq_flight(event="failover", shard=s, host=new_p,
+                           old_host=old_p, t=float(t))
+            if new_f is not None and self._attached \
+                    and s not in self.hosts[new_f].shards:
+                # recruit: the replacement follower starts empty — a
+                # full-copy resync from the (possibly just-promoted)
+                # primary, priced on the fabric between their slices
+                self.repair_s += self._resync(s, new_p, new_f, t,
+                                              reason="recruit")
+        # the rotation schedule is recomputed from the live placement
+        # on the next pass — record the re-formation as its own event
+        self.reformations += 1
+        metrics.inc("sep_ring_reformations_total")
+        self.events.append({"event": "ring_reform",
+                            "members": [h for _, h in
+                                        self.ring_order()],
+                            "t": float(t)})
+        seq_flight(event="ring_reform", t=float(t),
+                   hosts=len(self._alive_ids()))
+        for hid in newly_dead:
+            self.hosts[hid].shards.clear()
+
+    def _resync(self, shard: int, src: int, dst: int, t: float,
+                reason: str) -> float:
+        blk = {k: v.copy()
+               for k, v in self.hosts[src].shards[shard].items()}
+        self.hosts[dst].shards[shard] = blk
+        nbytes = int(sum(a.nbytes for a in blk.values()))
+        cls = self._link_class(src, dst)
+        self.resyncs += 1
+        metrics.inc("sep_resyncs_total", reason=reason)
+        self.traffic.add("sep_resync", nbytes,
+                         axes=("dcn",) if cls == "dcn" else ("ici",),
+                         group_size=2)
+        seconds = sparse_transfer_seconds(nbytes, cls, link=self.link)
+        self.events.append({"event": "resync", "shard": shard,
+                            "reason": reason, "bytes": nbytes,
+                            "t": float(t)})
+        seq_flight(event="resync", shard=shard, reason=reason,
+                   bytes=nbytes, t=float(t))
+        return seconds
+
+    def last_mttr_s(self) -> float:
+        return max(self.mttrs) if self.mttrs else 0.0
+
+    def quiesce(self, now: float) -> None:
+        """Run one forced sweep so anything dead-but-undetected fails
+        over before the ledger is audited."""
+        self.probe_now(float(now))
+
+    # -- the cross-host shard ledger ------------------------------------
+    def ledger(self) -> Dict[str, Any]:
+        """Exact bookkeeping at drill end: every shard owned by exactly
+        one alive primary, the shard partition covering
+        range(num_shards), and every follower CRC-equal to its
+        primary."""
+        owned: List[int] = []
+        one_primary = True
+        crc_equal = True
+        for s in range(self.num_shards):
+            primary, follower = self.placement[s]
+            if primary is None or not self.hosts[primary].alive \
+                    or s not in self.hosts[primary].shards:
+                one_primary = False
+                continue
+            owned.append(s)
+            pp = self.hosts[primary].shards[s]
+            if follower is not None and self.hosts[follower].alive:
+                fp = self.hosts[follower].shards.get(s)
+                if fp is None or params_crc(fp) != params_crc(pp):
+                    crc_equal = False
+        partition_exact = (sorted(owned)
+                           == list(range(self.num_shards)))
+        return {"ok": bool(one_primary and partition_exact
+                           and crc_equal),
+                "one_primary_per_shard": bool(one_primary),
+                "shard_partition_exact": bool(partition_exact),
+                "replicas_crc_equal": bool(crc_equal),
+                "shards": self.num_shards,
+                "alive_hosts": list(self._alive_ids())}
+
+
+# ------------------------------------------------------------------- plane
+class LongSeqPlane:
+    """The long-context training plane: ring (or Ulysses) attention
+    over a :class:`SeqHostFleet`, each step driven through
+    :class:`ReliableStep` on a virtual clock.
+
+    One step = transactionally distribute the batch's K/V blocks onto
+    the placement (priced), run the blockwise pass THROUGH the fleet
+    (pass-start reads + every ring hop chaos/liveness-gated and priced;
+    Ulysses prices its two all-to-alls from the exact per-pair matrix),
+    merge the ``(o, lse)`` accumulator only on pass COMPLETION, train
+    the linear head (closed-form gradient), then audit the LSE-merge
+    conservation ledger. ``SeqHostFailedError`` anywhere in the step
+    aborts it with nothing committed; the injected ``sleep`` advances
+    the virtual clock THROUGH the fleet's probe cadence, so backoff is
+    also when failover detection happens — MTTR is modeled, not
+    elided."""
+
+    def __init__(self, fleet: SeqHostFleet, *, seq_len: int = 512,
+                 heads: int = 4, head_dim: int = 8, batch: int = 1,
+                 causal: bool = True, attn: str = "ring",
+                 schedule: str = "hierarchical",
+                 link: Optional[LinkModel] = None, lr: float = 0.05,
+                 ledger_tol: float = 1e-9, retry_base_s: float = 0.02,
+                 max_retries: int = 8, retry_budget: int = 32,
+                 seed: int = 0):
+        if attn not in ("ring", "ulysses"):
+            raise ValueError(f"attn={attn!r}")
+        if schedule not in ("hierarchical", "flat"):
+            raise ValueError(f"schedule={schedule!r}")
+        n = fleet.num_hosts
+        if seq_len % n != 0:
+            raise LongSeqPlaneError(
+                f"seq len {seq_len} not divisible by ring degree {n}")
+        if attn == "ulysses" and heads % n != 0:
+            raise HeadShardingError(
+                f"num_heads {heads} not divisible by sep degree {n}")
+        self.fleet = fleet
+        self.link = link or fleet.link
+        self.seq_len, self.heads, self.head_dim = seq_len, heads, \
+            head_dim
+        self.batch, self.causal = batch, bool(causal)
+        self.attn, self.schedule = attn, schedule
+        self.chunk = seq_len // n
+        self.scale = 1.0 / math.sqrt(head_dim)
+        self.lr = float(lr)
+        self.ledger_tol = float(ledger_tol)
+        E = heads * head_dim
+        rng = np.random.RandomState(seed)
+        # frozen projections; only the output head trains (closed-form
+        # MSE gradient — real state evolution, replay-testable)
+        self.wq = rng.standard_normal((E, E)) / math.sqrt(E)
+        self.wk = rng.standard_normal((E, E)) / math.sqrt(E)
+        self.wv = rng.standard_normal((E, E)) / math.sqrt(E)
+        self.head = _HeadHolder(rng.standard_normal((E, E))
+                                / math.sqrt(E))
+        self.opt = _NullOptimizer()
+        self.clock = VirtualClock()
+        self.reliable = ReliableStep(
+            model=self.head, optimizer=self.opt, snapshot_every=1,
+            max_retries=max_retries, retry_budget=retry_budget,
+            base_delay=retry_base_s, max_delay=2.0, check_finite=False,
+            sleep=self._sleep)
+        self.step_no = 0
+        self.ring_passes = 0
+        self.hop_counts = {"ici": 0, "dcn": 0}
+        self.comm_seconds: List[float] = []
+        self.lse_audits: List[Dict[str, Any]] = []
+        self.last_output: Optional[np.ndarray] = None
+
+    # backoff sleeps advance the virtual clock THROUGH the probe
+    # cadence: waiting is when the prober finds the corpse
+    def _sleep(self, seconds: float) -> None:
+        self.clock.advance(seconds)
+        self.fleet.maybe_probe(self.clock.t)
+
+    def project(self, x: np.ndarray
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """x [B, S, E] -> (q, k, v) [B, S, H, D] in float64, through
+        the frozen projections — shared with the twin."""
+        x = np.asarray(x, np.float64)
+        B, S, _ = x.shape
+        shp = (B, S, self.heads, self.head_dim)
+        return ((x @ self.wq).reshape(shp),
+                (x @ self.wk).reshape(shp),
+                (x @ self.wv).reshape(shp))
+
+    def train_step(self, x: np.ndarray, y: np.ndarray) -> float:
+        before = self.reliable.stats["retries"]
+        loss = self.reliable.run(self._step_fn, x, y)
+        if self.reliable.stats["retries"] > before:
+            metrics.inc("sep_replayed_steps_total")
+        self.step_no += 1
+        return loss
+
+    def _step_fn(self, x: np.ndarray, y: np.ndarray) -> float:
+        fleet, clock = self.fleet, self.clock
+        fleet.maybe_probe(clock.t)
+        q, k, v = self.project(x)
+        kv = {s: {"k": k[:, s * self.chunk:(s + 1) * self.chunk],
+                  "v": v[:, s * self.chunk:(s + 1) * self.chunk]}
+              for s in range(fleet.num_shards)}
+        clock.advance(fleet.distribute(kv, clock.t))
+        if self.attn == "ring":
+            o, lse, partials, comm_s = self._ring_pass(q)
+        else:
+            o, lse, partials, comm_s = self._ulysses_pass(q)
+        clock.advance(comm_s)
+        self.comm_seconds.append(comm_s)
+        # pass COMPLETED — only now does anything commit
+        loss, new_wo = head_step_np(o, y, self.head.wo, self.lr)
+        self.head.wo = new_wo
+        self.last_output = o
+        self._audit(q, k, v, o, lse, partials)
+        metrics.inc("sep_steps_total")
+        return loss
+
+    def _ring_pass(self, q: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray, list, float]:
+        """The fleet-mediated blockwise pass: pass-start block reads on
+        every primary, then n-1 rotations of chaos-gated, per-link-
+        class-priced hops between consecutive ring members in the
+        chosen transport order. The ``(o, lse)`` accumulator is merged
+        ONLY after the transport completed — a mid-pass death leaves
+        step-local garbage for the collector, never a partial merge."""
+        fleet, now = self.fleet, self.clock.t
+        n = fleet.num_shards
+        blocks = {s: fleet.read_block(s, now) for s in range(n)}
+        block_bytes = int(sum(a.nbytes
+                              for a in blocks[0].values()))
+        order = fleet.ring_order(self.schedule)
+        seconds = 0.0
+        for _t in range(1, n):
+            for pos, (s, h) in enumerate(order):
+                succ = order[(pos + 1) % n][1]
+                seconds += fleet.hop(h, succ, s, block_bytes, now)
+                cls = fleet._link_class(h, succ)
+                if cls != "host":
+                    self.hop_counts[cls] += 1
+        o, lse, partials = ring_attend_np(
+            q, None, None, n=n, scale=self.scale, causal=self.causal,
+            blocks=blocks)
+        self.ring_passes += 1
+        metrics.inc("sep_ring_passes_total")
+        return o, lse, partials, seconds
+
+    def _ulysses_pass(self, q: np.ndarray
+                      ) -> Tuple[np.ndarray, np.ndarray, list, float]:
+        """The Ulysses alternative: two all-to-alls (seq-shard ->
+        head-shard, then back) priced from the exact uniform per-pair
+        matrix, full attention per head group (numerically the global
+        oracle). Chaos/liveness-gated per participating host."""
+        fleet, now = self.fleet, self.clock.t
+        n = fleet.num_shards
+        for s in range(n):
+            fleet._op(fleet.primary_of(s), "a2a", s, now)
+        blocks = {s: fleet.read_block(s, now) for s in range(n)}
+        k = np.concatenate([blocks[s]["k"] for s in range(n)], axis=1)
+        v = np.concatenate([blocks[s]["v"] for s in range(n)], axis=1)
+        # per pair: q+k+v chunks out (seq->head) and o back
+        per_pair = 4.0 * self.batch * self.chunk \
+            * (self.heads // n) * self.head_dim * 8.0
+        pair = np.full((n, n), per_pair, np.float64)
+        np.fill_diagonal(pair, 0.0)
+        seconds, counts, t = price_all_to_all(
+            pair, fleet.hosts_per_slice, link=self.link,
+            hierarchical=(self.schedule == "hierarchical"))
+        fleet.traffic.entries.extend(t.entries)
+        self.hop_counts["ici"] += counts["ici"]
+        self.hop_counts["dcn"] += counts["dcn"]
+        o, lse = full_attention_np(q, k, v, scale=self.scale,
+                                   causal=self.causal)
+        partials = [[(i, o[:, i * self.chunk:(i + 1) * self.chunk],
+                      lse[:, :, i * self.chunk:(i + 1) * self.chunk])]
+                    for i in range(n)]
+        return o, lse, partials, seconds
+
+    # -- the LSE-merge conservation ledger ------------------------------
+    def _audit(self, q, k, v, o, lse, partials) -> Dict[str, Any]:
+        """After every step (and re-run after every chaos event via
+        :meth:`audit_now`): for each query block, (a) CONSERVATION —
+        re-derive the merge single-pass from the recorded per-block
+        partials: the softmax weights ``exp(lse_b - lse_merged)`` must
+        sum to exactly 1 and reproduce the merged output; (b) ORACLE —
+        the merged ``(o, lse)`` must equal the float64 full-attention
+        softmax over the whole sequence, causal mask included. Both at
+        f64 resolution (``ledger_tol``)."""
+        n = self.fleet.num_shards
+        chunk = self.chunk
+        max_cons = 0.0
+        max_orac = 0.0
+        o_ref, lse_ref = full_attention_np(
+            q, k, v, scale=self.scale, causal=self.causal)
+        for i in range(n):
+            oi = o[:, i * chunk:(i + 1) * chunk]
+            li = lse[:, :, i * chunk:(i + 1) * chunk]
+            live = li != _NEG
+            wsum = np.zeros_like(li)
+            osum = np.zeros_like(oi)
+            for j, o_b, lse_b in partials[i]:
+                with np.errstate(invalid="ignore"):
+                    w = np.where(lse_b == _NEG, 0.0,
+                                 np.exp(lse_b - np.where(live, li,
+                                                         0.0)))
+                wsum += w
+                osum += o_b * np.swapaxes(w, 1, 2)[..., None]
+            if live.any():
+                max_cons = max(max_cons, float(
+                    np.max(np.abs(wsum[live] - 1.0))))
+                rows = np.swapaxes(live, 1, 2)[..., None] \
+                    & np.ones_like(oi, bool)
+                max_cons = max(max_cons, float(
+                    np.max(np.abs(osum[rows] - oi[rows]))))
+            max_orac = max(max_orac, float(np.max(np.abs(
+                oi - o_ref[:, i * chunk:(i + 1) * chunk]))))
+            lref = lse_ref[:, :, i * chunk:(i + 1) * chunk]
+            both = live & (lref != _NEG)
+            if both.any():
+                max_orac = max(max_orac, float(
+                    np.max(np.abs(li[both] - lref[both]))))
+        ok = (max_cons <= self.ledger_tol
+              and max_orac <= self.ledger_tol)
+        audit = {"step": self.step_no, "ok": bool(ok),
+                 "max_conservation_err": max_cons,
+                 "max_oracle_err": max_orac}
+        self.lse_audits.append(audit)
+        metrics.inc("sep_lse_audits_total")
+        if not ok:
+            seq_flight(event="lse_ledger_breach", step=self.step_no,
+                       conservation_err=round(max_cons, 12),
+                       oracle_err=round(max_orac, 12), t=self.clock.t)
+        self._last_audit_inputs = (q, k, v, o, lse, partials)
+        return audit
+
+    def audit_now(self) -> Optional[Dict[str, Any]]:
+        """Re-run the ledger on the last completed step's recorded
+        pass — the post-chaos audit the lane runs after ``quiesce``
+        (a healed placement must not have changed what was merged)."""
+        if getattr(self, "_last_audit_inputs", None) is None:
+            return None
+        return self._audit(*self._last_audit_inputs)
+
+    def audits_ok(self) -> bool:
+        return bool(self.lse_audits) and \
+            all(a["ok"] for a in self.lse_audits)
+
+
+class _HeadHolder:
+    """ReliableStep holder for the trainable output head."""
+
+    def __init__(self, wo: np.ndarray):
+        self.wo = np.asarray(wo, np.float64)
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        return {"wo": self.wo.copy()}
+
+    def set_state_dict(self, state: Dict[str, np.ndarray]) -> None:
+        self.wo = np.asarray(state["wo"], np.float64).copy()
+
+
+class _NullOptimizer:
+    """Stateless-SGD stand-in holder (the head's update is closed-form
+    inside the step); ReliableStep still snapshots/restores it."""
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state_dict(self, state: Dict[str, Any]) -> None:
+        pass
+
+
+# --------------------------------------------------- 32k modeled pricing
+def ring_member_slices(num_hosts: int, hosts_per_slice: int,
+                       schedule: str = "hierarchical") -> List[int]:
+    """Slice id of each ring member IN RING ORDER for the two transport
+    schedules (the :meth:`CollectiveTraffic.add_ring_hops` input):
+    slice-contiguous (``hierarchical``) vs round-robin interleaved
+    (``flat``)."""
+    hps = max(1, int(hosts_per_slice))
+    num_slices = (int(num_hosts) + hps - 1) // hps
+    if schedule == "hierarchical":
+        return [h // hps for h in range(int(num_hosts))]
+    if schedule == "flat":
+        return [h % num_slices for h in range(int(num_hosts))]
+    raise ValueError(f"schedule={schedule!r}")
+
+
+def model_long_context_step(*, seq_len: int = 32768, heads: int = 8,
+                            head_dim: int = 64, batch: int = 1,
+                            layers: int = 8, dtype_bytes: int = 2,
+                            num_hosts: int = 8, hosts_per_slice: int = 2,
+                            schedule: str = "hierarchical",
+                            attn: str = "ring", pp: int = 4,
+                            microbatches: int = 8,
+                            virtual_stages: int = 4,
+                            grad_bytes: float = 64e6,
+                            flops_per_s: float = 180e12,
+                            mfu: float = 0.4,
+                            link: Optional[LinkModel] = None
+                            ) -> Dict[str, Any]:
+    """Deterministic cost-only model of ONE 32k-sequence training step
+    composing SEP with interleaved-VPP and hierarchical collectives —
+    the lane's budget-gate surface (the real numerics run on the small
+    fleet; this prices the target shape, the multichip-ladder
+    discipline). Attention comm per layer:
+
+    - **ring**: n-1 rotations, each a full ring of K/V block hops
+      (block = this host's K+V chunk), via ``add_ring_hops`` under the
+      chosen member order;
+    - **ulysses**: two all-to-alls (q/k/v out, o back) from the exact
+      uniform per-pair matrix.
+
+    Plus ONE grad sync per step (hierarchical reduce-scatter / DCN
+    all-reduce / all-gather when ``schedule="hierarchical"``, flat DCN
+    all-reduce otherwise) and the interleaved-VPP bubble stretching the
+    whole step. Returns the decomposed seconds and dispatch counts so
+    the lane can gate hierarchical-fits / flat-fails both ways."""
+    link = link or LinkModel()
+    n = int(num_hosts)
+    chunk = int(seq_len) // n
+    t = CollectiveTraffic()
+    if attn == "ring":
+        block_bytes = 2.0 * batch * chunk * heads * head_dim \
+            * dtype_bytes
+        counts = {"ici": 0, "dcn": 0}
+        for _ in range(int(layers)):
+            c = t.add_ring_hops(
+                block_bytes,
+                ring_member_slices(n, hosts_per_slice, schedule))
+            counts["ici"] += c["ici"]
+            counts["dcn"] += c["dcn"]
+    elif attn == "ulysses":
+        if heads % n != 0:
+            raise HeadShardingError(
+                f"num_heads {heads} not divisible by sep degree {n}")
+        per_pair = 4.0 * batch * chunk * (heads // n) * head_dim \
+            * dtype_bytes
+        pair = np.full((n, n), per_pair, np.float64)
+        np.fill_diagonal(pair, 0.0)
+        counts = {"ici": 0, "dcn": 0}
+        for _ in range(int(layers)):
+            c = t.add_all_to_all_matrix(
+                pair, hosts_per_slice, op="sep_a2a",
+                hierarchical=(schedule == "hierarchical"))
+            counts["ici"] += c["ici"]
+            counts["dcn"] += c["dcn"]
+    else:
+        raise ValueError(f"attn={attn!r}")
+    attn_comm_s = t.seconds(link)
+    gs = CollectiveTraffic()
+    num_slices = (n + hosts_per_slice - 1) // hosts_per_slice
+    if schedule == "hierarchical":
+        gs.add_hierarchical_all_reduce(
+            grad_bytes, ici_axes=("ici",), dcn_axes=("dcn",),
+            ici_group=hosts_per_slice, dcn_group=num_slices)
+    else:
+        gs.add("all_reduce_sum", grad_bytes, axes=("dcn",),
+               group_size=n)
+    grad_sync_s = gs.seconds(link)
+    # causal attention flops per chip: 2 matmuls over S^2/2 scores
+    attn_flops = 2.0 * 2.0 * batch * heads * (seq_len ** 2 / 2.0) \
+        * head_dim * layers / n
+    compute_s = attn_flops / (flops_per_s * mfu)
+    bubble = pipeline_bubble_fraction(pp, microbatches,
+                                      virtual_stages=virtual_stages)
+    step_s = (compute_s + attn_comm_s + grad_sync_s) * (1.0 + bubble)
+    tokens = float(batch * seq_len)
+    return {"attn": attn, "schedule": schedule,
+            "attn_comm_s": attn_comm_s, "counts": counts,
+            "grad_sync_s": grad_sync_s, "compute_s": compute_s,
+            "bubble_fraction": bubble, "step_s": step_s,
+            "tokens_per_s": tokens / step_s if step_s > 0 else 0.0}
+
+
+def preferred_attention(*, seq_len: int, heads: int, head_dim: int,
+                        batch: int = 1, layers: int = 8,
+                        dtype_bytes: int = 2, num_hosts: int = 8,
+                        hosts_per_slice: int = 2,
+                        link: Optional[LinkModel] = None
+                        ) -> Dict[str, Any]:
+    """Ring-vs-Ulysses selection from the priced hierarchical comm
+    costs of the same shape: Ulysses moves ~4·S·E/n bytes per rank per
+    layer (two a2a) against the ring's (n-1)·2·S·E/n — the ring wins
+    on bytes as n grows, Ulysses wins on dispatch count; head
+    divisibility is a hard constraint (no integral head group -> ring
+    is the only option). Returns the decision and both priced costs —
+    the README's selection table is generated from exactly this."""
+    ring = model_long_context_step(
+        seq_len=seq_len, heads=heads, head_dim=head_dim, batch=batch,
+        layers=layers, dtype_bytes=dtype_bytes, num_hosts=num_hosts,
+        hosts_per_slice=hosts_per_slice, attn="ring",
+        schedule="hierarchical", link=link)
+    if heads % num_hosts != 0:
+        return {"choice": "ring", "reason": "heads_not_divisible",
+                "ring_comm_s": ring["attn_comm_s"],
+                "ulysses_comm_s": None}
+    uly = model_long_context_step(
+        seq_len=seq_len, heads=heads, head_dim=head_dim, batch=batch,
+        layers=layers, dtype_bytes=dtype_bytes, num_hosts=num_hosts,
+        hosts_per_slice=hosts_per_slice, attn="ulysses",
+        schedule="hierarchical", link=link)
+    choice = "ring" if ring["attn_comm_s"] <= uly["attn_comm_s"] \
+        else "ulysses"
+    return {"choice": choice, "reason": "priced_comm",
+            "ring_comm_s": ring["attn_comm_s"],
+            "ulysses_comm_s": uly["attn_comm_s"]}

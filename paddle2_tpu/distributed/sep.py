@@ -35,9 +35,26 @@ from ..framework.tensor import Tensor
 from ..ops.dispatch import apply_op, ensure_tensor
 from . import mesh as mesh_mod
 
-__all__ = ["ring_attention", "ulysses_attention"]
+__all__ = ["ring_attention", "ulysses_attention",
+           "SequenceAxisError", "HeadShardingError"]
 
 _NEG = float("-inf")
+
+
+class SequenceAxisError(ValueError):
+    """The requested (or inferred) sequence-parallel mesh axis does not
+    exist on the current mesh. Subclasses ValueError so pre-existing
+    callers that caught the untyped inference failure keep working —
+    the fix (ISSUE 20) is that a *named* ``mesh_axis=`` absent from the
+    mesh now raises this instead of a bare ``KeyError`` from the later
+    ``mesh.shape[axis]`` lookup."""
+
+
+class HeadShardingError(ValueError):
+    """Ulysses head sharding is impossible: the head count does not
+    divide by the sequence-parallel degree, so the seq->head all-to-all
+    has no integral head group per rank. Subclasses ValueError for
+    backward compatibility with callers catching the untyped raise."""
 
 
 def _block_attn_lse(q, k, v, scale, mask):
@@ -91,11 +108,23 @@ def _ring_body(q, k, v, *, axis, n, scale, causal):
     cur_k, cur_v = k, v
     chunk = q.shape[1]
     for t in range(n):
+        # Block-offset convention (load-bearing for causal masking, and
+        # mirrored float64-for-float64 by the longseq_fleet oracle): KV
+        # blocks rotate FORWARD around the ring (rank r sends to r+1),
+        # so after t hops rank i holds the KV chunk that ORIGINATED on
+        # rank j = (i - t) mod n. Global token indices are block-major:
+        # query rows of rank i are [i*chunk, (i+1)*chunk) and the held
+        # KV columns are [j*chunk, (j+1)*chunk), which makes causality
+        # a pure block predicate on (i, j) — no per-token global-index
+        # arithmetic is ever needed.
         j = (i - t) % n  # origin chunk of the kv currently held
         if causal:
             # bottom-right-aligned global causality across chunks, as ONE
-            # mask select (no duplicated attention): j < i full block,
-            # j == i intra-chunk causal, j > i fully masked
+            # mask select (no duplicated attention): j < i full block
+            # (every KV column is strictly in the past), j == i
+            # intra-chunk lower-triangular, j > i fully masked (the
+            # whole block is in the future; _block_attn_lse returns
+            # lse = -inf rows and _merge drops them with weight 0)
             tril = jnp.tril(jnp.ones((chunk, chunk), bool))
             full = jnp.ones((chunk, chunk), bool)
             none = jnp.zeros((chunk, chunk), bool)
@@ -113,12 +142,18 @@ def _ring_body(q, k, v, *, axis, n, scale, causal):
 def _seq_axis(mesh_axis: Optional[str]) -> str:
     mesh = mesh_mod.get_mesh()
     if mesh_axis is not None:
+        if mesh_axis not in mesh.axis_names:
+            raise SequenceAxisError(
+                f"mesh axis {mesh_axis!r} not on the current mesh "
+                f"(axes: {tuple(mesh.axis_names)}); init a mesh with "
+                f"that axis or drop mesh_axis= to auto-detect")
         return mesh_axis
     for name in ("sep", "cp", "sp"):
         if name in mesh.axis_names and mesh.shape[name] > 1:
             return name
-    raise ValueError("no sequence-parallel mesh axis found; init a mesh "
-                     "with a 'sep' axis or pass mesh_axis=")
+    raise SequenceAxisError(
+        "no sequence-parallel mesh axis found; init a mesh "
+        "with a 'sep' axis or pass mesh_axis=")
 
 
 def ring_attention(query, key, value, causal: bool = False,
@@ -173,8 +208,9 @@ def ulysses_attention(query, key, value, causal: bool = False,
     mesh = mesh_mod.get_mesh()
     axis = _seq_axis(mesh_axis)
     if q.shape[2] % mesh.shape[axis] != 0:
-        raise ValueError(f"num_heads {q.shape[2]} not divisible by sep "
-                         f"degree {mesh.shape[axis]}")
+        raise HeadShardingError(
+            f"num_heads {q.shape[2]} not divisible by sep "
+            f"degree {mesh.shape[axis]}")
     batch_axis = "dp" if "dp" in mesh.axis_names else None
     from .fleet.mp_layers import _constrain_tensor
     head_spec = P(batch_axis, None, axis, None)

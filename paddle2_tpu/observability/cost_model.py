@@ -432,6 +432,46 @@ class CollectiveTraffic:
             _p2p("bucket", b, dcn_axes, "dcn")
         return counts
 
+    def add_ring_hops(self, block_bytes: float,
+                      member_slices: Sequence[int],
+                      rotations: Optional[int] = None,
+                      ici_axes: Sequence[str] = ("ici",),
+                      dcn_axes: Sequence[str] = ("dcn",),
+                      op: str = "sep_ring",
+                      overlappable: bool = False) -> Dict[str, int]:
+        """Price a ring-attention K/V rotation schedule (ISSUE 20): on
+        every rotation step each ring member forwards its currently-held
+        K/V block to its successor, so one rotation is ``len(members)``
+        point-to-point hops of ``block_bytes`` each, and a full pass is
+        ``rotations`` (default ``n - 1``) such steps. ``member_slices``
+        gives the ICI-slice id of each member IN RING ORDER — the ring
+        ORDER is the scheduling lever this method exposes: a
+        slice-contiguous order pays one DCN α per slice boundary per
+        rotation, while an interleaved ("flat") order pays one per hop.
+        Entries use ``group_size=2`` (point-to-point, full payload on
+        the wire). Returns dispatch counts per link class, mirroring
+        :meth:`add_all_to_all_matrix`, so a lane can gate α-dominance
+        of the two orders both ways.
+        """
+        members = list(member_slices)
+        n = len(members)
+        if n < 2:
+            return {"ici": 0, "dcn": 0}
+        rot = (n - 1) if rotations is None else max(0, int(rotations))
+        counts = {"ici": 0, "dcn": 0}
+        for _ in range(rot):
+            for m in range(n):
+                same = members[m] == members[(m + 1) % n]
+                if same:
+                    self.add(f"{op}_hop_ici", block_bytes, axes=ici_axes,
+                             group_size=2, overlappable=overlappable)
+                    counts["ici"] += 1
+                else:
+                    self.add(f"{op}_hop_dcn", block_bytes, axes=dcn_axes,
+                             group_size=2, overlappable=overlappable)
+                    counts["dcn"] += 1
+        return counts
+
     def wire_bytes_total(self) -> float:
         return sum(e["wire_bytes"] for e in self.entries)
 

@@ -204,6 +204,7 @@ def diagnose(dumps: Dict[int, Dict[str, Any]],
         "serving": {},
         "ps": {},
         "moe": {},
+        "sep": {},
     }
     # serving plane (PR 11): scheduler admit/evict/requeue/shed, engine
     # decode steps, failures/failovers, and hot-swap events — per-event
@@ -254,6 +255,22 @@ def diagnose(dumps: Dict[int, Dict[str, Any]],
                                            if k != "kind"}})
     if moe_counts:
         report["moe"] = {"counts": moe_counts, "last": moe_tail[-10:]}
+    # sequence-parallel plane (ISSUE 20): the failure narrative
+    # (host_kill -> failover -> ring_reform -> resync) plus
+    # lse_ledger_breach markers, each span carrying shard + host ids
+    # so a dead ring pass is attributable to a specific modeled host
+    sep_counts: Dict[str, int] = {}
+    sep_tail: List[Dict[str, Any]] = []
+    for r in ranks:
+        for ev in dumps[r]["events"]:
+            if ev.get("kind") != "sep":
+                continue
+            name = ev.get("event", "?")
+            sep_counts[name] = sep_counts.get(name, 0) + 1
+            sep_tail.append({"rank": r, **{k: v for k, v in ev.items()
+                                           if k != "kind"}})
+    if sep_counts:
+        report["sep"] = {"counts": sep_counts, "last": sep_tail[-10:]}
     # SDC evidence: fingerprint-vote mismatches and self-evictions the
     # workers recorded. Deduped by (rank, step) — every voter records
     # the same verdict; the report wants the verdict once per witness.
@@ -515,6 +532,7 @@ def format_report(report: Dict[str, Any], directory: str) -> str:
     L.extend(_format_serving(report))
     L.extend(_format_ps(report))
     L.extend(_format_moe(report))
+    L.extend(_format_sep(report))
     L.extend(_format_quarantine(report))
     L.extend(_format_elastic_timeline(report))
     return "\n".join(L)
@@ -575,6 +593,37 @@ def _format_moe(report: Dict[str, Any]) -> List[str]:
             lead.append(f"t={ev['t']:.9f}")
         detail = " ".join(f"{k}={ev[k]}" for k in sorted(ev)
                           if k not in ("rank", "event", "expert",
+                                       "host", "t"))
+        L.append(f"  rank {rank}: {ev.get('event', '?')} "
+                 + " ".join(lead + [detail]).strip())
+    return L
+
+
+def _format_sep(report: Dict[str, Any]) -> List[str]:
+    """SEQUENCE PARALLEL section: what the long-context plane recorded
+    — host_kill / failover / ring_reform / resync spans and
+    lse_ledger_breach markers — per-event counts plus the newest few,
+    each carrying shard + host ids and the virtual clock stamp, so an
+    aborted ring pass post-mortem shows which host died mid-rotation
+    and when the ring re-formed."""
+    sp = report.get("sep") or {}
+    if not sp:
+        return []
+    L = ["SEQUENCE PARALLEL"]
+    counts = sp.get("counts") or {}
+    L.append("  events: " + " ".join(f"{k}={counts[k]}"
+                                     for k in sorted(counts)))
+    for ev in (sp.get("last") or [])[-10:]:
+        rank = ev.get("rank", "?")
+        lead = []
+        if "shard" in ev:
+            lead.append(f"shard={ev['shard']}")
+        if "host" in ev:
+            lead.append(f"host={ev['host']}")
+        if "t" in ev:
+            lead.append(f"t={ev['t']:.9f}")
+        detail = " ".join(f"{k}={ev[k]}" for k in sorted(ev)
+                          if k not in ("rank", "event", "shard",
                                        "host", "t"))
         L.append(f"  rank {rank}: {ev.get('event', '?')} "
                  + " ".join(lead + [detail]).strip())

@@ -105,6 +105,17 @@ _RELIABILITY_COUNTERS = (
     "moe_expert_stores_total", "moe_expert_host_failures_total",
     "moe_failovers_total", "moe_resyncs_total",
     "moe_router_collapses_total",
+    # sequence-parallel plane (ISSUE 20): ring passes per step (one
+    # per layer per attention call — a shortfall vs steps means passes
+    # are aborting), host failures vs failovers (pair per dead
+    # primary), ring re-formations (each one is a topology change —
+    # read the flight recorder), replayed steps (chaos healed through
+    # ReliableStep), resyncs (follower recruits), and LSE-merge ledger
+    # audits (one per pass; fewer than passes means audits are skipped)
+    "sep_steps_total", "sep_ring_passes_total",
+    "sep_ring_reformations_total", "sep_replayed_steps_total",
+    "sep_lse_audits_total", "sep_host_failures_total",
+    "sep_failovers_total", "sep_resyncs_total",
 )
 
 

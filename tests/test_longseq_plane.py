@@ -1,0 +1,351 @@
+"""The fault-tolerant long-context plane (ISSUE 20): hash-ring K/V
+shard placement with primary+follower replicas, transactional per-step
+distribution, chaos-hardened ring hops with probe-sweep failover and
+ring re-formation inside the gated MTTR, typed transient errors through
+ReliableStep with bitwise step replay, and the exact LSE-merge
+conservation ledger — all on the virtual cost-model clock."""
+
+import numpy as np
+import pytest
+
+from paddle2_tpu.distributed import longseq_fleet as lf
+from paddle2_tpu.distributed import mesh as mesh_mod
+from paddle2_tpu.distributed.fault_tolerance import chaos
+from paddle2_tpu.distributed.fault_tolerance.reliable import \
+    TransientStepError
+from paddle2_tpu.observability.cost_model import LinkModel
+
+N, S, H, D = 8, 64, 4, 4
+E = H * D
+LINK = LinkModel(ici_latency_us=1.0, dcn_latency_us=250.0)
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    mesh_mod.init_mesh({"dp": 8})
+    yield
+    chaos.disarm()
+
+
+def _kv(seed=0):
+    rs = np.random.RandomState(seed)
+    chunk = S // N
+    return {s: {"k": rs.standard_normal((1, chunk, H, D)),
+                "v": rs.standard_normal((1, chunk, H, D))}
+            for s in range(N)}
+
+
+def _fleet(probe_interval_s=0.02, attach=True):
+    fleet = lf.SeqHostFleet(num_hosts=N, hosts_per_slice=2,
+                            probe_interval_s=probe_interval_s,
+                            link=LINK, seed=0)
+    if attach:
+        fleet.attach_shards(_kv())
+    return fleet
+
+
+def _plane(probe_interval_s=0.02, **kw):
+    kw.setdefault("heads", H)
+    kw.setdefault("head_dim", D)
+    return lf.LongSeqPlane(
+        _fleet(probe_interval_s=probe_interval_s, attach=False),
+        seq_len=S, link=LINK, lr=0.05, seed=0, **kw)
+
+
+def _trace(steps=3, seed=7):
+    rng = np.random.RandomState(seed)
+    return [(rng.standard_normal((1, S, E)),
+             rng.standard_normal((1, S, E))) for _ in range(steps)]
+
+
+# -- placement / transport ----------------------------------------------
+
+def test_attach_places_primary_and_follower_replicas():
+    fleet = _fleet()
+    assert sorted(fleet.placement) == list(range(N))
+    for s, (p, f) in fleet.placement.items():
+        assert s in fleet.hosts[p].shards
+        assert f is not None and f != p
+        assert s in fleet.hosts[f].shards
+    assert fleet.ledger()["ok"]
+
+
+def test_attach_twice_raises():
+    fleet = _fleet()
+    with pytest.raises(lf.LongSeqPlaneError):
+        fleet.attach_shards(_kv())
+
+
+def test_ring_order_schedules_differ_only_in_transport():
+    fleet = _fleet()
+    hier = fleet.ring_order("hierarchical")
+    flat = fleet.ring_order("flat")
+    assert sorted(s for s, _ in hier) == list(range(N))
+    assert sorted(s for s, _ in flat) == list(range(N))
+    # hierarchical is slice-contiguous (few DCN boundary crossings);
+    # flat interleaves across slices so almost every hop crosses one
+    # (a host owning 2 shards can force one same-slice adjacency) —
+    # the pricing lever the lane gates both ways
+    def dcn_hops(order):
+        return sum(
+            1 for i, (_, h) in enumerate(order)
+            if fleet.slice_of(h)
+            != fleet.slice_of(order[(i + 1) % N][1]))
+    assert dcn_hops(flat) >= N - 1
+    assert dcn_hops(hier) < dcn_hops(flat)
+    with pytest.raises(ValueError):
+        fleet.ring_order("diagonal")
+
+
+def test_distribute_is_transactional_under_mid_walk_kill():
+    """A kill during the phase-1 liveness walk must leave NOTHING
+    written — the replay re-distributes the same bytes cleanly."""
+    fleet = _fleet(attach=False)
+    victim = fleet.primary_of(sorted(
+        s for s in range(N))[N // 2])
+    chaos.arm(f"kill_seq_host:2:{victim}")
+    try:
+        with pytest.raises(lf.SeqHostFailedError):
+            fleet.attach_shards(_kv())
+    finally:
+        chaos.disarm()
+    assert all(not h.shards for h in fleet.hosts)
+
+
+def test_read_block_returns_replica_copies():
+    fleet = _fleet()
+    blk = fleet.read_block(3, now=0.0)
+    p = fleet.primary_of(3)
+    assert (blk["k"] == fleet.hosts[p].shards[3]["k"]).all()
+    blk["k"][:] = 0.0  # mutating the copy must not touch the store
+    assert fleet.hosts[p].shards[3]["k"].any()
+
+
+# -- failover / ring re-formation ---------------------------------------
+
+def test_kill_fails_over_at_probe_sweep_within_mttr():
+    fleet = _fleet(probe_interval_s=0.02)
+    victim = fleet.primary_of(0)
+    owned = [s for s in range(N) if fleet.primary_of(s) == victim]
+    followers = {s: fleet.placement[s][1] for s in owned}
+    fleet.kill_host(victim, now=0.005)
+    fleet.maybe_probe(0.0)      # anchors the cadence
+    fleet.maybe_probe(0.021)    # first sweep: detection + promotion
+    for s in owned:
+        assert fleet.primary_of(s) == followers[s]
+    assert fleet.failovers == len(owned)
+    assert fleet.reformations == 1
+    assert 0.0 < fleet.last_mttr_s() <= 2 * 0.02
+    assert fleet.ledger()["ok"]
+    # re-formed ring excludes the corpse
+    assert victim not in [h for _, h in fleet.ring_order()]
+
+
+def test_errors_are_typed():
+    err = lf.SeqHostFailedError(3, shard=5, op="ring_hop")
+    assert isinstance(err, TransientStepError)
+    assert isinstance(err, lf.LongSeqPlaneError)
+    assert "3" in str(err) and "5" in str(err)
+
+
+def test_chaos_kill_seq_host_is_victim_gated_and_one_shot():
+    chaos.arm("kill_seq_host:2:5")
+    try:
+        assert not chaos.maybe_kill_seq_host(4, op="x")  # wrong victim
+        assert not chaos.maybe_kill_seq_host(5, op="x")  # nth=2: 1st
+        assert chaos.maybe_kill_seq_host(5, op="x")      # fires
+        assert not chaos.maybe_kill_seq_host(5, op="x")  # one-shot
+        assert [k for k, _ in chaos.fired_log()] == ["kill_seq_host"]
+    finally:
+        chaos.disarm()
+
+
+# -- the plane ----------------------------------------------------------
+
+def test_plane_is_bitwise_transparent_vs_single_host_twin():
+    plane = _plane()
+    trace = _trace()
+    losses = [plane.train_step(x.copy(), y.copy()) for x, y in trace]
+    twin = _plane()   # parameter container only; no fleet mediation
+    wo = twin.head.wo.copy()
+    for (x, y), loss in zip(trace, losses):
+        q, k, v = twin.project(x.copy())
+        o, _, _ = lf.ring_attend_np(q, k, v, n=N, scale=twin.scale,
+                                    causal=True)
+        tl, wo = lf.head_step_np(o, y.copy(), wo, 0.05)
+        assert tl == loss
+    assert (wo == plane.head.wo).all()
+    assert plane.audits_ok() and len(plane.lse_audits) == len(trace)
+    assert plane.clock.t > 0.0     # transport + distribution priced
+
+
+def test_plane_replays_killed_step_bitwise_vs_clean_twin():
+    trace = _trace(steps=3)
+    clean = _plane()
+    clean_losses = [clean.train_step(x.copy(), y.copy())
+                    for x, y in trace]
+    plane = _plane()
+    victim = plane.fleet.primary_of(0)
+    owned = sum(1 for s in range(N)
+                if plane.fleet.primary_of(s) == victim)
+    # fire mid-ring-pass on step 2: past step 1's ops (9 per owned
+    # shard) and step 2's distribute+read, onto the first hop
+    nth = 9 * owned + 2 * owned + 1
+    chaos.arm(f"kill_seq_host:{nth}:{victim}")
+    try:
+        losses = [plane.train_step(x.copy(), y.copy())
+                  for x, y in trace]
+        fired = [k for k, _ in chaos.fired_log()]  # disarm clears it
+    finally:
+        chaos.disarm()
+    assert fired == ["kill_seq_host"]
+    assert plane.reliable.stats["retries"] >= 1
+    assert plane.fleet.failovers >= 1
+    assert plane.fleet.reformations == 1
+    assert losses == clean_losses
+    assert (plane.head.wo == clean.head.wo).all()
+    assert (plane.last_output == clean.last_output).all()
+    assert plane.audits_ok()
+    plane.fleet.quiesce(plane.clock.t)
+    post = plane.audit_now()       # post-chaos ledger audit
+    assert post is not None and post["ok"]
+    assert plane.fleet.ledger()["ok"]
+
+
+def test_plane_ulysses_passes_audit_and_prices_a2a():
+    plane = _plane(attn="ulysses", heads=8, head_dim=2)
+    for x, y in _trace(steps=2):
+        plane.train_step(x.copy(), y.copy())
+    assert plane.audits_ok() and len(plane.lse_audits) == 2
+    assert plane.hop_counts["ici"] + plane.hop_counts["dcn"] > 0
+
+
+def test_plane_rejects_indivisible_shapes():
+    with pytest.raises(lf.LongSeqPlaneError):
+        lf.LongSeqPlane(_fleet(attach=False), seq_len=60, heads=H,
+                        head_dim=D)
+    from paddle2_tpu.distributed.sep import HeadShardingError
+    with pytest.raises(HeadShardingError):
+        lf.LongSeqPlane(_fleet(attach=False), seq_len=S, heads=6,
+                        head_dim=D, attn="ulysses")
+
+
+def test_sep_metrics_counters_flow_to_the_plane(tmp_path):
+    from paddle2_tpu.observability import metrics
+    from paddle2_tpu.tools.perf_doctor import _RELIABILITY_COUNTERS
+    pl = metrics.enable(str(tmp_path), rank=0, flush_steps=1)
+    try:
+        plane = _plane()
+        victim = plane.fleet.primary_of(0)
+        owned = sum(1 for s in range(N)
+                    if plane.fleet.primary_of(s) == victim)
+        chaos.arm(f"kill_seq_host:{9 * owned + 2 * owned + 1}:{victim}")
+        try:
+            for x, y in _trace(steps=2):
+                plane.train_step(x.copy(), y.copy())
+        finally:
+            chaos.disarm()
+        snap = pl.snapshot()["counters"]
+        for name in ("sep_steps_total", "sep_ring_passes_total",
+                     "sep_lse_audits_total", "sep_host_failures_total",
+                     "sep_failovers_total", "sep_resyncs_total",
+                     "sep_ring_reformations_total",
+                     "sep_replayed_steps_total"):
+            assert name in _RELIABILITY_COUNTERS, name
+            assert name in snap and sum(snap[name].values()) > 0, name
+    finally:
+        metrics.disable()
+
+
+def test_kill_during_first_ever_distribute_heals_and_replays():
+    """A host death on the VERY FIRST op — before any distribute has
+    ever committed — must heal like any other: the pre-attach fleet
+    has no bytes to inherit, so failover is a pure placement
+    recomputation (no both-replicas-lost, no recruit resync) and the
+    replayed step re-distributes onto the re-formed placement."""
+    trace = _trace(steps=2)
+    clean = _plane()
+    clean_losses = [clean.train_step(x.copy(), y.copy())
+                    for x, y in trace]
+    plane = _plane()
+    victim = plane.fleet.primary_of(sorted(plane.fleet.placement)[0])
+    chaos.arm(f"kill_seq_host:1:{victim}")
+    try:
+        losses = [plane.train_step(x.copy(), y.copy())
+                  for x, y in trace]
+        fired = [k for k, _ in chaos.fired_log()]
+    finally:
+        chaos.disarm()
+    assert fired == ["kill_seq_host"]
+    assert plane.reliable.stats["retries"] >= 1
+    assert plane.fleet.failovers >= 1
+    # nothing existed pre-attach, so the recruit path must not have
+    # fabricated a resync out of thin air
+    assert plane.fleet.resyncs == 0
+    assert losses == clean_losses
+    assert (plane.head.wo == clean.head.wo).all()
+    assert plane.audits_ok()
+    plane.fleet.quiesce(plane.clock.t)
+    assert plane.fleet.ledger()["ok"]
+
+
+# -- tooling ------------------------------------------------------------
+
+def test_flight_doctor_renders_sep_section():
+    from paddle2_tpu.tools import flight_doctor
+    dumps = {0: {"header": {"node": "host0"}, "events": [
+        {"kind": "sep", "event": "host_kill", "host": 2, "t": 0.5},
+        {"kind": "sep", "event": "failover", "shard": 3, "host": 1,
+         "old_host": 2, "t": 0.52},
+        {"kind": "sep", "event": "ring_reform", "hosts": 7, "t": 0.52},
+        {"kind": "sep", "event": "resync", "shard": 3,
+         "reason": "recruit", "bytes": 4096, "t": 0.52},
+    ]}}
+    report = flight_doctor.diagnose(dumps)
+    assert report["sep"]["counts"] == {"host_kill": 1, "failover": 1,
+                                       "ring_reform": 1, "resync": 1}
+    text = flight_doctor.format_report(report, "/tmp/sep-dumps")
+    assert "SEQUENCE PARALLEL" in text
+    assert "shard=3" in text and "host=1" in text
+
+
+def test_add_ring_hops_counts_and_pricing():
+    from paddle2_tpu.observability.cost_model import CollectiveTraffic
+    t = CollectiveTraffic()
+    # slice-contiguous: 4 slices of 2 -> 4 DCN boundary hops + 4 ICI
+    # hops per rotation, 7 rotations
+    c = t.add_ring_hops(1e6, lf.ring_member_slices(8, 2,
+                                                   "hierarchical"))
+    assert c == {"ici": 28, "dcn": 28}
+    t2 = CollectiveTraffic()
+    c2 = t2.add_ring_hops(1e6, lf.ring_member_slices(8, 2, "flat"))
+    assert c2 == {"ici": 0, "dcn": 56}     # every hop crosses a slice
+    assert t2.seconds(LINK) > t.seconds(LINK)   # alpha dominance
+    assert CollectiveTraffic().add_ring_hops(1e6, [0]) \
+        == {"ici": 0, "dcn": 0}
+
+
+def test_model_long_context_step_budget_lever():
+    hier = lf.model_long_context_step(schedule="hierarchical",
+                                      link=LINK)
+    flat = lf.model_long_context_step(schedule="flat", link=LINK)
+    assert flat["step_s"] > hier["step_s"] > 0.0
+    assert flat["counts"]["dcn"] > hier["counts"]["dcn"]
+    v1 = lf.model_long_context_step(schedule="hierarchical",
+                                    virtual_stages=1, link=LINK)
+    assert hier["bubble_fraction"] < v1["bubble_fraction"]
+    assert hier["step_s"] < v1["step_s"]
+
+
+def test_preferred_attention_respects_head_divisibility():
+    sel = lf.preferred_attention(seq_len=32768, heads=6, head_dim=64,
+                                 link=LINK)
+    assert sel["choice"] == "ring"
+    assert sel["reason"] == "heads_not_divisible"
+    sel2 = lf.preferred_attention(seq_len=32768, heads=8, head_dim=64,
+                                  link=LINK)
+    assert sel2["reason"] == "priced_comm"
+    assert sel2["choice"] in ("ring", "ulysses")
+    want = "ring" if sel2["ring_comm_s"] <= sel2["ulysses_comm_s"] \
+        else "ulysses"
+    assert sel2["choice"] == want

@@ -1,0 +1,177 @@
+"""SEP numerics (ISSUE 20): the float64 oracle for the online-softmax
+LSE merge — stability under large-negative lse and fully-masked -inf
+blocks, blockwise-ring vs full-attention parity across shard counts,
+and the Ulysses head-sharding divisibility contract."""
+
+import numpy as np
+import pytest
+
+from paddle2_tpu.distributed.longseq_fleet import (
+    LongSeqPlaneError, block_attn_lse_np, causal_block_mask,
+    full_attention_np, head_step_np, merge_np, ring_attend_np)
+
+NEG = float("-inf")
+
+
+def _rand_block(seed, B=1, S=8, H=2, D=4):
+    rs = np.random.RandomState(seed)
+    return (rs.standard_normal((B, S, H, D)),
+            rs.standard_normal((B, H, S)))
+
+
+# -- merge_np stability -------------------------------------------------
+
+def test_merge_is_stable_under_large_negative_lse():
+    """lse values around -1e4 would overflow a naive exp(lse) weight;
+    the shifted merge must stay finite and keep relative weighting."""
+    o1, _ = _rand_block(0)
+    o2, _ = _rand_block(1)
+    lse1 = np.full((1, 2, 8), -1e4)
+    lse2 = np.full((1, 2, 8), -1e4 + np.log(3.0))  # 3x the weight
+    o, lse = merge_np(o1, lse1, o2, lse2)
+    assert np.isfinite(o).all() and np.isfinite(lse).all()
+    np.testing.assert_allclose(o, (o1 + 3.0 * o2) / 4.0, atol=1e-12)
+    np.testing.assert_allclose(lse, -1e4 + np.log(4.0), atol=1e-9)
+
+
+def test_merge_with_neg_inf_block_returns_other_side_bitwise():
+    """A fully-masked block carries lse = -inf (weight exactly 0):
+    merging it in must return the other side BITWISE — the property
+    that lets the ring accumulator start at (0, -inf) without ever
+    perturbing the first real block."""
+    o1, lse1 = _rand_block(2)
+    dead_o = np.zeros_like(o1)
+    dead_lse = np.full_like(lse1, NEG)
+    for a, b in (((o1, lse1), (dead_o, dead_lse)),
+                 ((dead_o, dead_lse), (o1, lse1))):
+        o, lse = merge_np(a[0], a[1], b[0], b[1])
+        assert (o == o1).all() and (lse == lse1).all()
+    # both sides dead: stays dead (zero rows, -inf lse), no NaNs
+    o, lse = merge_np(dead_o, dead_lse, dead_o, dead_lse)
+    assert (o == 0.0).all() and (lse == NEG).all()
+
+
+def test_merge_order_associativity_at_f64():
+    """The sequential ring merge and a single-pass softmax over the
+    concatenated blocks must agree to f64 re-association noise — the
+    exact identity the plane's conservation ledger audits."""
+    rs = np.random.RandomState(3)
+    q = rs.standard_normal((1, 4, 2, 4))
+    ks = [rs.standard_normal((1, 4, 2, 4)) for _ in range(3)]
+    vs = [rs.standard_normal((1, 4, 2, 4)) for _ in range(3)]
+    o = np.zeros_like(q)
+    lse = np.full((1, 2, 4), NEG)
+    for k, v in zip(ks, vs):
+        o_b, lse_b = block_attn_lse_np(q, k, v, 0.5, None)
+        o, lse = merge_np(o, lse, o_b, lse_b)
+    o_ref, lse_ref = block_attn_lse_np(
+        q, np.concatenate(ks, 1), np.concatenate(vs, 1), 0.5, None)
+    np.testing.assert_allclose(o, o_ref, atol=1e-13)
+    np.testing.assert_allclose(lse, lse_ref, atol=1e-13)
+
+
+def test_fully_masked_rows_carry_neg_inf_lse():
+    q, _ = _rand_block(4)
+    k, _ = _rand_block(5)
+    v, _ = _rand_block(6)
+    o, lse = block_attn_lse_np(q, k, v, 0.5,
+                               np.zeros((8, 8), bool))
+    assert (lse == NEG).all() and (o == 0.0).all()
+
+
+# -- causal block predicate ---------------------------------------------
+
+def test_causal_block_mask_convention():
+    """j < i: full block (None); j == i: intra-chunk tril; j > i:
+    fully masked — the block-offset convention documented in
+    sep.py's _ring_body."""
+    assert causal_block_mask(2, 1, 4) is None
+    tri = causal_block_mask(2, 2, 4)
+    assert (tri == np.tril(np.ones((4, 4), bool))).all()
+    assert not causal_block_mask(1, 2, 4).any()
+
+
+# -- ring vs full-attention parity across shard counts ------------------
+
+@pytest.mark.parametrize("n", [1, 2, 4, 8])
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attend_matches_full_attention(n, causal):
+    rs = np.random.RandomState(10 + n)
+    B, S, H, D = 1, 32, 2, 4
+    q = rs.standard_normal((B, S, H, D))
+    k = rs.standard_normal((B, S, H, D))
+    v = rs.standard_normal((B, S, H, D))
+    o, lse, partials = ring_attend_np(q, k, v, n=n, scale=0.5,
+                                      causal=causal)
+    o_ref, lse_ref = full_attention_np(q, k, v, scale=0.5,
+                                       causal=causal)
+    if n == 1:
+        # one block IS the full softmax: bitwise, not just close
+        assert (o == o_ref).all() and (lse == lse_ref).all()
+    else:
+        np.testing.assert_allclose(o, o_ref, atol=1e-13)
+        np.testing.assert_allclose(lse, lse_ref, atol=1e-13)
+    assert len(partials) == n and all(len(p) == n for p in partials)
+
+
+def test_ring_attend_is_deterministic_bitwise():
+    """Same inputs, same shard count -> bitwise-identical outputs (the
+    property every plane-vs-twin gate in the lane rests on)."""
+    rs = np.random.RandomState(42)
+    q = rs.standard_normal((1, 16, 2, 4))
+    k = rs.standard_normal((1, 16, 2, 4))
+    v = rs.standard_normal((1, 16, 2, 4))
+    o1, l1, _ = ring_attend_np(q, k, v, n=4, scale=0.5)
+    o2, l2, _ = ring_attend_np(q.copy(), k.copy(), v.copy(), n=4,
+                               scale=0.5)
+    assert (o1 == o2).all() and (l1 == l2).all()
+
+
+def test_ring_attend_rejects_indivisible_seq():
+    q = np.zeros((1, 10, 2, 4))
+    with pytest.raises(LongSeqPlaneError):
+        ring_attend_np(q, q, q, n=4, scale=0.5)
+
+
+# -- ulysses head sharding ----------------------------------------------
+
+def test_ulysses_head_sharding_parity_and_typed_rejection():
+    """Ulysses reshards heads across ranks: per-head-group attention
+    concatenated back must equal the full result exactly (heads are
+    independent), and heads % n != 0 must raise the typed
+    HeadShardingError through the plane constructor."""
+    from paddle2_tpu.distributed.longseq_fleet import (LongSeqPlane,
+                                                       SeqHostFleet)
+    from paddle2_tpu.distributed.sep import HeadShardingError
+    rs = np.random.RandomState(7)
+    B, S, H, D, n = 1, 16, 4, 4, 2
+    q = rs.standard_normal((B, S, H, D))
+    k = rs.standard_normal((B, S, H, D))
+    v = rs.standard_normal((B, S, H, D))
+    o_ref, lse_ref = full_attention_np(q, k, v, scale=0.5, causal=True)
+    per = H // n
+    for g in range(n):
+        sl = slice(g * per, (g + 1) * per)
+        o_g, lse_g = full_attention_np(q[:, :, sl], k[:, :, sl],
+                                       v[:, :, sl], scale=0.5,
+                                       causal=True)
+        assert (o_g == o_ref[:, :, sl]).all()
+        assert (lse_g == lse_ref[:, sl]).all()
+    fleet = SeqHostFleet(num_hosts=8, probe_interval_s=0.02)
+    with pytest.raises(HeadShardingError):
+        LongSeqPlane(fleet, seq_len=64, heads=4, head_dim=4,
+                     attn="ulysses")
+
+
+# -- the trainable tail -------------------------------------------------
+
+def test_head_step_reduces_loss_and_is_deterministic():
+    rs = np.random.RandomState(0)
+    o = rs.standard_normal((1, 16, 2, 4))
+    y = rs.standard_normal((1, 16, 8))
+    wo = rs.standard_normal((8, 8))
+    l1, w1 = head_step_np(o, y, wo, 0.05)
+    l2, w2 = head_step_np(o, y, w1, 0.05)
+    assert l2 < l1
+    l1b, w1b = head_step_np(o.copy(), y.copy(), wo.copy(), 0.05)
+    assert l1b == l1 and (w1b == w1).all()

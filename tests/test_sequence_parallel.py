@@ -97,3 +97,26 @@ def test_mixed_placement_grad_accumulation():
     np.testing.assert_allclose(w.grad.numpy(),
                                2 * np.arange(8, dtype="float32") + 2.0)
     dist.init_mesh({"dp": 8})
+
+
+def test_absent_named_axis_raises_typed_error():
+    """ISSUE 20 regression: a named ``mesh_axis=`` absent from the mesh
+    must raise the typed SequenceAxisError (naming the available axes),
+    not a bare KeyError from the later mesh.shape lookup — and the
+    no-axis-found fallback uses the same type."""
+    from paddle2_tpu.distributed.sep import SequenceAxisError
+    dist.init_mesh({"dp": 2, "sep": 4})
+    q, k, v = _qkv(S=8)
+    try:
+        with pytest.raises(SequenceAxisError) as ei:
+            ring_attention(q, k, v, mesh_axis="nope")
+        assert "'nope'" in str(ei.value)
+        assert "sep" in str(ei.value)  # the message names the real axes
+        assert isinstance(ei.value, ValueError)  # back-compat contract
+        with pytest.raises(SequenceAxisError):
+            ulysses_attention(q, k, v, mesh_axis="nope")
+        dist.init_mesh({"dp": 8})  # no sep/cp/sp axis on the mesh
+        with pytest.raises(SequenceAxisError):
+            ring_attention(q, k, v)
+    finally:
+        dist.init_mesh({"dp": 8})
